@@ -1,0 +1,74 @@
+"""skypilot_tpu — a TPU-native sky orchestration framework.
+
+Declare a Task (YAML or Python), let the optimizer pick the cheapest
+feasible TPU slice / VM, provision it on GCP (or run it hermetically on
+the Local cloud), gang-schedule the command across every TPU host with a
+rank/IP/topology env contract feeding ``jax.distributed.initialize()``,
+stream logs, and manage lifecycle: status reconciliation, autostop,
+failover, managed spot recovery, storage mounts, and serving.
+
+Re-design (not a port) of SkyPilot — see SURVEY.md for the mapping.
+"""
+from skypilot_tpu.admin_policy import AdminPolicy
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.exceptions import SkyTpuError
+from skypilot_tpu.optimizer import Optimizer
+from skypilot_tpu.optimizer import OptimizeTarget
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils.tpu_utils import TpuSlice
+from skypilot_tpu.utils.tpu_utils import parse as parse_tpu
+
+__version__ = '0.1.0'
+
+
+def __getattr__(name):
+    """Lazy accessors for the heavier layers (execution, core ops).
+
+    Keeps `import skypilot_tpu` fast and free of optional deps, like the
+    reference's lazy import structure (sky/__init__.py:94-116).
+    """
+    _lazy = {
+        'launch': ('skypilot_tpu.execution', 'launch'),
+        'exec': ('skypilot_tpu.execution', 'exec_'),
+        'status': ('skypilot_tpu.core', 'status'),
+        'stop': ('skypilot_tpu.core', 'stop'),
+        'start': ('skypilot_tpu.core', 'start'),
+        'down': ('skypilot_tpu.core', 'down'),
+        'autostop': ('skypilot_tpu.core', 'autostop'),
+        'queue': ('skypilot_tpu.core', 'queue'),
+        'cancel': ('skypilot_tpu.core', 'cancel'),
+        'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+        'job_status': ('skypilot_tpu.core', 'job_status'),
+        'Storage': ('skypilot_tpu.data.storage', 'Storage'),
+    }
+    if name in _lazy:
+        import importlib
+        module, attr = _lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = [
+    'AdminPolicy',
+    'Dag',
+    'Optimizer',
+    'OptimizeTarget',
+    'Resources',
+    'SkyTpuError',
+    'Task',
+    'TpuSlice',
+    'parse_tpu',
+    'launch',
+    'exec',
+    'status',
+    'stop',
+    'start',
+    'down',
+    'autostop',
+    'queue',
+    'cancel',
+    'tail_logs',
+    'job_status',
+    'Storage',
+]

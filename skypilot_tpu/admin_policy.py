@@ -1,0 +1,80 @@
+"""Pluggable org-level request mutator/validator.
+
+Re-design of reference ``sky/admin_policy.py:61-101``: a user-supplied
+class (configured as ``admin_policy: my_module.MyPolicy`` in the config
+file) sees every UserRequest (dag + config) before execution and may
+mutate or reject it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import typing
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import skypilot_config
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    cluster_name: Optional[str] = None
+    idle_minutes_to_autostop: Optional[int] = None
+    down: bool = False
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    dag: 'dag_lib.Dag'
+    skypilot_config: Dict[str, Any]
+    request_options: Optional[RequestOptions] = None
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    dag: 'dag_lib.Dag'
+    skypilot_config: Dict[str, Any]
+
+
+class AdminPolicy:
+    """Subclass and override validate_and_mutate."""
+
+    @classmethod
+    def validate_and_mutate(cls,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        return MutatedUserRequest(dag=user_request.dag,
+                                  skypilot_config=user_request.skypilot_config)
+
+
+def apply(dag: 'dag_lib.Dag',
+          request_options: Optional[RequestOptions] = None) -> 'dag_lib.Dag':
+    """Apply the configured policy (if any) to the dag.
+
+    Called from execution._execute on every request (reference
+    sky/execution.py:180).
+    """
+    policy_path = skypilot_config.get_nested(('admin_policy',))
+    if policy_path is None:
+        return dag
+    module_path, _, class_name = policy_path.rpartition('.')
+    try:
+        module = importlib.import_module(module_path)
+        policy_cls = getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.SkyTpuError(
+            f'Cannot load admin policy {policy_path!r}: {e}') from e
+    if not issubclass(policy_cls, AdminPolicy):
+        raise exceptions.SkyTpuError(
+            f'{policy_path} must subclass skypilot_tpu.AdminPolicy')
+    request = UserRequest(dag=dag,
+                          skypilot_config=skypilot_config.to_dict(),
+                          request_options=request_options)
+    mutated = policy_cls.validate_and_mutate(request)
+    if mutated.skypilot_config != request.skypilot_config:
+        # Config mutations apply for the rest of this request.
+        skypilot_config.override_config(mutated.skypilot_config).__enter__()
+    return mutated.dag

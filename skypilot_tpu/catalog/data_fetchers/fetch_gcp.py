@@ -1,0 +1,147 @@
+"""Generate the offline GCP catalog CSV snapshot.
+
+Re-design of reference ``sky/clouds/service_catalog/data_fetchers/
+fetch_gcp.py`` (which scrapes GCP SKU APIs and hand-codes v5p/v6e TPU
+prices at :34-79). With zero egress in the build image we hand-code the
+whole snapshot: per-chip-hour TPU prices and per-hour GCE host prices,
+by region. Run::
+
+    python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp
+
+to regenerate ``skypilot_tpu/catalog/data/{tpu,gce}_catalog.csv``.
+Prices are an approximation of public list prices (2025 snapshot);
+they only need to be *relatively* correct for the optimizer's ranking.
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+from skypilot_tpu.utils import tpu_utils
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), 'data')
+
+# generation -> on-demand USD per chip-hour (base region us-central1)
+_TPU_CHIP_HOUR = {
+    'v2': 1.125,
+    'v3': 2.00,
+    'v4': 3.22,
+    'v5e': 1.20,
+    'v5p': 4.20,
+    'v6e': 2.70,
+}
+# spot multiplier per generation
+_SPOT_FACTOR = {
+    'v2': 0.30, 'v3': 0.30, 'v4': 0.35,
+    'v5e': 0.40, 'v5p': 0.40, 'v6e': 0.40,
+}
+# generation -> zones offering it (approximate public availability)
+_TPU_ZONES = {
+    'v2': ['us-central1-b', 'us-central1-c', 'us-central1-f',
+           'europe-west4-a', 'asia-east1-c'],
+    'v3': ['us-central1-a', 'us-central1-b', 'europe-west4-a'],
+    'v4': ['us-central2-b'],
+    'v5e': ['us-central1-a', 'us-west4-a', 'us-west4-b', 'us-east1-c',
+            'us-east5-b', 'europe-west4-b', 'asia-southeast1-b'],
+    'v5p': ['us-east5-a', 'us-central1-a', 'europe-west4-b'],
+    'v6e': ['us-east5-b', 'us-east1-d', 'us-central2-b', 'europe-west4-a',
+            'asia-northeast1-b', 'us-south1-a'],
+}
+# region -> price multiplier vs us-central1
+_REGION_FACTOR = {
+    'us-central1': 1.00,
+    'us-central2': 1.00,
+    'us-east1': 1.00,
+    'us-east5': 1.00,
+    'us-west4': 1.05,
+    'us-south1': 1.00,
+    'europe-west4': 1.10,
+    'asia-east1': 1.15,
+    'asia-southeast1': 1.17,
+    'asia-northeast1': 1.20,
+}
+
+# GCE instance families: name pattern, per-vCPU $/hr, per-GiB-mem $/hr,
+# memory GiB per vCPU.
+_GCE_FAMILIES = {
+    'n2-standard': (0.0315, 0.0042, 4),
+    'n2-highmem': (0.0315, 0.0042, 8),
+    'e2-standard': (0.0218, 0.0029, 4),
+    'c3-standard': (0.0335, 0.0045, 4),
+}
+_GCE_SIZES = [2, 4, 8, 16, 32, 48, 64, 96]
+_GCE_REGIONS = sorted(_REGION_FACTOR)
+_GCE_SPOT_FACTOR = 0.30
+
+
+def _region_of(zone: str) -> str:
+    return zone.rsplit('-', 1)[0]
+
+
+def write_tpu_catalog(path: str) -> int:
+    rows = []
+    for gen, zones in _TPU_ZONES.items():
+        for acc_name in tpu_utils.list_sizes(gen):
+            s = tpu_utils.parse(acc_name)
+            for zone in zones:
+                region = _region_of(zone)
+                factor = _REGION_FACTOR[region]
+                price = _TPU_CHIP_HOUR[gen] * factor
+                spot = price * _SPOT_FACTOR[gen]
+                rows.append({
+                    'AcceleratorName': s.name,
+                    'AcceleratorCount': 1,
+                    'NumChips': s.num_chips,
+                    'NumHosts': s.num_hosts,
+                    'Topology': s.topology,
+                    'Region': region,
+                    'AvailabilityZone': zone,
+                    'PricePerChipHour': round(price, 4),
+                    'SpotPricePerChipHour': round(spot, 4),
+                })
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+    return len(rows)
+
+
+def write_gce_catalog(path: str) -> int:
+    rows = []
+    for family, (vcpu_price, mem_price, mem_ratio) in _GCE_FAMILIES.items():
+        for size in _GCE_SIZES:
+            if family.startswith('e2') and size > 32:
+                continue
+            mem = size * mem_ratio
+            base = size * vcpu_price + mem * mem_price
+            for region in _GCE_REGIONS:
+                factor = _REGION_FACTOR[region]
+                for zone_suffix in ('a', 'b', 'c'):
+                    zone = f'{region}-{zone_suffix}'
+                    rows.append({
+                        'InstanceType': f'{family}-{size}',
+                        'vCPUs': size,
+                        'MemoryGiB': mem,
+                        'Region': region,
+                        'AvailabilityZone': zone,
+                        'Price': round(base * factor, 4),
+                        'SpotPrice': round(base * factor * _GCE_SPOT_FACTOR,
+                                           4),
+                    })
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+    return len(rows)
+
+
+def main() -> None:
+    n_tpu = write_tpu_catalog(os.path.join(_DATA_DIR, 'tpu_catalog.csv'))
+    n_gce = write_gce_catalog(os.path.join(_DATA_DIR, 'gce_catalog.csv'))
+    print(f'Wrote {n_tpu} TPU rows, {n_gce} GCE rows to {_DATA_DIR}')
+
+
+if __name__ == '__main__':
+    main()

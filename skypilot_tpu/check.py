@@ -1,0 +1,55 @@
+"""`skytpu check` — credential checks and the enabled-clouds cache.
+
+Re-design of reference ``sky/check.py``: probes each registered cloud's
+credentials, stores the enabled list in global user state, and the
+optimizer consults the cache. The Local cloud is always enabled so the
+hermetic path never depends on cloud credentials.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu import skypilot_config
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
+
+_ENABLED_CLOUDS_KEY = 'enabled_clouds'
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe all registered clouds; persist and return the enabled list."""
+    import skypilot_tpu.clouds  # noqa: F401  (registers built-in clouds)
+    enabled = []
+    results: List[Tuple[str, bool, Optional[str]]] = []
+    allowed = skypilot_config.get_nested(('allowed_clouds',))
+    for name in registry.CLOUD_REGISTRY.keys():
+        if allowed is not None and name not in [c.lower() for c in allowed]:
+            continue
+        cloud = registry.CLOUD_REGISTRY.from_str(name)()
+        ok, reason = cloud.check_credentials()
+        results.append((name, ok, reason))
+        if ok:
+            enabled.append(name)
+    global_user_state.set_config_value(_ENABLED_CLOUDS_KEY, enabled)
+    if not quiet:
+        for name, ok, reason in results:
+            mark = 'enabled' if ok else f'disabled: {reason}'
+            logger.info('  %s: %s', name, mark)
+    return enabled
+
+
+def get_cached_enabled_clouds(refresh_if_empty: bool = True) -> list:
+    """Cloud instances from the cache (runs `check` on first use)."""
+    import skypilot_tpu.clouds  # noqa: F401
+    names = global_user_state.get_config_value(_ENABLED_CLOUDS_KEY)
+    if not names and refresh_if_empty:
+        names = check(quiet=True)
+    names = names or ['local']
+    out = []
+    for name in names:
+        if name in registry.CLOUD_REGISTRY:
+            out.append(registry.CLOUD_REGISTRY.from_str(name)())
+    return out

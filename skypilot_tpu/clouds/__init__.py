@@ -1,0 +1,14 @@
+"""Cloud plugins. Importing this package registers all built-in clouds."""
+from skypilot_tpu.clouds.cloud import Cloud
+from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
+from skypilot_tpu.clouds.cloud import Region
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = [
+    'Cloud',
+    'CloudImplementationFeatures',
+    'Region',
+    'GCP',
+    'Local',
+]

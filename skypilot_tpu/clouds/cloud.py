@@ -1,0 +1,155 @@
+"""Cloud abstract base class.
+
+Re-design of reference ``sky/clouds/cloud.py:117``: capability flags,
+feasibility filtering, pricing, deploy variables, credential checks, and
+region/zone enumeration for the failover provisioner. TPU-specific
+quantities (slice topology, host count) flow through Resources, so cloud
+plugins only translate them into provider API calls.
+"""
+from __future__ import annotations
+
+import enum
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Features a cloud may or may not support (reference :29)."""
+    STOP = 'stop'
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    AUTOSTOP = 'autostop'
+    STORAGE_MOUNTING = 'storage_mounting'
+    OPEN_PORTS = 'open_ports'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+
+
+class Region:
+
+    def __init__(self, name: str, zones: Optional[List[str]] = None) -> None:
+        self.name = name
+        self.zones = zones or []
+
+    def __repr__(self) -> str:
+        return f'Region({self.name}, zones={self.zones})'
+
+
+class Cloud:
+    """Base class for cloud providers."""
+
+    _REPR = 'Cloud'
+    # Max cluster name length on this provider (None = unlimited).
+    MAX_CLUSTER_NAME_LEN_LIMIT: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    # Identity
+    @classmethod
+    def canonical_name(cls) -> str:
+        return cls.__name__.lower()
+
+    def provider_name(self) -> str:
+        """Module name under skypilot_tpu/provision/ handling this cloud."""
+        return self.canonical_name()
+
+    def is_same_cloud(self, other: Optional['Cloud']) -> bool:
+        return other is not None and self.canonical_name(
+        ) == other.canonical_name()
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Cloud) and self.is_same_cloud(other)
+
+    def __hash__(self) -> int:
+        return hash(self.canonical_name())
+
+    # ------------------------------------------------------------------
+    # Capabilities
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'Resources'
+    ) -> Dict[CloudImplementationFeatures, str]:
+        """Map of unsupported feature -> reason, for these resources."""
+        return {}
+
+    @classmethod
+    def check_features_are_supported(
+            cls, resources: 'Resources',
+            requested: set) -> None:
+        unsupported = cls.unsupported_features_for_resources(resources)
+        bad = {f: r for f, r in unsupported.items() if f in requested}
+        if bad:
+            raise exceptions.NotSupportedError(
+                f'{cls._REPR} does not support: '
+                + '; '.join(f'{f.value} ({r})' for f, r in bad.items()))
+
+    # ------------------------------------------------------------------
+    # Catalog / feasibility
+    def regions_with_offering(self, resources: 'Resources') -> List[Region]:
+        """Regions (with zones) that can host these resources."""
+        raise NotImplementedError
+
+    def zones_provision_loop(
+            self, resources: 'Resources',
+            region: Optional[str] = None
+    ) -> Iterator[Tuple[str, Optional[str]]]:
+        """Yield (region, zone) candidates in failover order.
+
+        TPU capacity is zonal, so we yield per-zone for TPUs and spot,
+        per-region otherwise (mirrors the reference's failover
+        granularity, sky/optimizer.py:1140).
+        """
+        for r in self.regions_with_offering(resources):
+            if region is not None and r.name != region:
+                continue
+            if resources.is_tpu or resources.use_spot:
+                for zone in r.zones:
+                    if resources.zone is not None and zone != resources.zone:
+                        continue
+                    yield (r.name, zone)
+            else:
+                yield (r.name, None)
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        """Concretize a (possibly partial) spec into launchable candidates.
+
+        Returns [] if infeasible on this cloud.
+        """
+        raise NotImplementedError
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        raise NotImplementedError
+
+    def validate_region_zone(
+            self, region: Optional[str],
+            zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Provisioning support
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        """Variables consumed by the provision plugin (reference :280)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Credentials
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        """remote_path -> local_path credential files to ship to clusters."""
+        return {}
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        """Active cloud identities, for multi-identity safety checks."""
+        return None

@@ -1,0 +1,187 @@
+"""GCP — the TPU cloud.
+
+Re-design of reference ``sky/clouds/gcp.py``: TPU-VM pod slices are the
+primary resource (not an accelerator bolt-on, cf. reference :473-497
+where TPU handling is special-cased into deploy variables). Plain GCE
+VMs are supported for CPU tasks and controllers.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_CREDENTIAL_HINT = (
+    'Run `gcloud auth application-default login` or set '
+    'GOOGLE_APPLICATION_CREDENTIALS to a service-account key.')
+
+DEFAULT_HOST_VM = 'n2-standard-8'
+
+
+@registry.CLOUD_REGISTRY.register(name='gcp', default=True)
+class GCP(cloud_lib.Cloud):
+    """Google Cloud Platform with TPU pod slices first-class."""
+
+    _REPR = 'GCP'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 35
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        out: Dict[cloud_lib.CloudImplementationFeatures, str] = {}
+        if resources.is_tpu and resources.tpu.is_pod:
+            # Reference gcp.py:206-211: TPU pods cannot be stopped.
+            out[cloud_lib.CloudImplementationFeatures.STOP] = (
+                'TPU pod slices cannot be stopped, only terminated.')
+            out[cloud_lib.CloudImplementationFeatures.AUTOSTOP] = (
+                'TPU pod slices support autodown, not autostop.')
+        return out
+
+    # ------------------------------------------------------------------
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        regions: Dict[str, List[str]] = {}
+        if resources.is_tpu:
+            offerings = catalog.get_tpu_offerings(
+                resources.tpu.name, resources.region, resources.zone)
+            for o in offerings:
+                regions.setdefault(o.region, []).append(o.zone)
+        else:
+            instance_type = (resources.instance_type or
+                             catalog.get_default_instance_type(
+                                 resources.cpus, resources.memory))
+            if instance_type is None:
+                return []
+            for o in catalog.get_instance_offerings(
+                    instance_type, resources.region, resources.zone):
+                regions.setdefault(o.region, []).append(o.zone)
+        return [
+            cloud_lib.Region(name, sorted(set(zones)))
+            for name, zones in sorted(regions.items())
+        ]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        if resources.cloud is not None and not self.is_same_cloud(
+                resources.cloud):
+            return []
+        if resources.is_tpu:
+            offerings = catalog.get_tpu_offerings(
+                resources.tpu.name, resources.region, resources.zone)
+            if not offerings:
+                return []
+            return [resources.copy(cloud=self)]
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = catalog.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return []
+        if not catalog.get_instance_offerings(
+                instance_type, resources.region, resources.zone):
+            return []
+        return [resources.copy(cloud=self, instance_type=instance_type)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        if resources.is_tpu:
+            return catalog.get_tpu_hourly_cost(resources.tpu.name,
+                                               resources.use_spot,
+                                               resources.region,
+                                               resources.zone)
+        instance_type = resources.instance_type
+        assert instance_type is not None, resources
+        return catalog.get_hourly_cost(instance_type, resources.use_spot,
+                                       resources.region, resources.zone)
+
+    def validate_region_zone(self, region, zone):
+        return catalog.validate_region_zone(region, zone)
+
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        """Variables consumed by provision/gcp (reference gcp.py:473-497)."""
+        vars_: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'labels': resources.labels or {},
+            'ports': resources.ports or [],
+        }
+        if resources.is_tpu:
+            tpu = resources.tpu
+            args = resources.accelerator_args or {}
+            vars_.update({
+                'tpu_vm': True,
+                'tpu_type': tpu.gcp_accelerator_type,
+                'tpu_topology': tpu.topology,
+                'num_hosts': tpu.num_hosts,
+                'runtime_version': args.get('runtime_version',
+                                            tpu.runtime_version),
+                'network_tier': args.get('network_tier'),
+            })
+        else:
+            vars_.update({
+                'tpu_vm': False,
+                'instance_type': resources.instance_type,
+                'image_id': resources.image_id,
+                'num_hosts': 1,
+            })
+        return vars_
+
+    # ------------------------------------------------------------------
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        try:
+            import google.auth  # pylint: disable=import-outside-toplevel
+            credentials, project = google.auth.default()
+            del credentials
+            if not project:
+                return False, ('No default GCP project configured. ' +
+                               _CREDENTIAL_HINT)
+            return True, None
+        except Exception as e:  # pylint: disable=broad-except
+            return False, f'{e}. {_CREDENTIAL_HINT}'
+
+    def get_project_id(self) -> str:
+        import google.auth  # pylint: disable=import-outside-toplevel
+        _, project = google.auth.default()
+        if not project:
+            raise exceptions.SkyTpuError(
+                'No GCP project found. ' + _CREDENTIAL_HINT)
+        return project
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        out = {}
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.path.exists(adc):
+            out['~/.config/gcloud/application_default_credentials.json'] = adc
+        key = os.environ.get('GOOGLE_APPLICATION_CREDENTIALS')
+        if key and os.path.exists(key):
+            out['~/.gcp_key.json'] = key
+        return out
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        try:
+            proc = subprocess.run(
+                'gcloud config list account --format "value(core.account)"',
+                shell=True, capture_output=True, text=True, check=True,
+                timeout=10)
+            account = proc.stdout.strip()
+            if account:
+                return [[account]]
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return None

@@ -1,0 +1,90 @@
+"""Local cloud — hermetic fake provider for tests and development.
+
+The reference has no fake cluster layer and compensates with an
+expensive real-cloud smoke-test matrix (SURVEY.md §4). Here the Local
+cloud is a first-class plugin: "instances" are directories under
+``~/.skytpu/local_cloud/<cluster>/`` plus real local processes, the
+command runner executes directly via subprocess, and a simulated
+"pod slice" exposes N hosts that are all localhost. This lets the full
+launch → gang exec → status → autostop → teardown path (and preemption
+recovery, via a fault-injection hook) run in CI with no cloud at all.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_LOCAL_REGION = 'local'
+_LOCAL_ZONE = 'local-a'
+# Flat sim prices so the optimizer has something to rank.
+_PRICE_PER_CPU_HOUR = 0.01
+
+
+@registry.CLOUD_REGISTRY.register(name='local')
+class Local(cloud_lib.Cloud):
+    """Runs 'clusters' as processes on this machine."""
+
+    _REPR = 'Local'
+
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        if resources.region not in (None, _LOCAL_REGION):
+            return []
+        if resources.zone not in (None, _LOCAL_ZONE):
+            return []
+        return [cloud_lib.Region(_LOCAL_REGION, [_LOCAL_ZONE])]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        # Local is opt-in: only feasible when the spec names it, so a
+        # real TPU request never "wins" by landing on the simulator.
+        if resources.cloud is None or not self.is_same_cloud(resources.cloud):
+            return []
+        if resources.is_tpu:
+            # Simulated slice: hosts become local processes. Feasible so
+            # gang logic is testable hermetically.
+            return [resources.copy(cloud=self)]
+        if not self.regions_with_offering(resources):
+            return []
+        instance_type = resources.instance_type or 'local'
+        return [resources.copy(cloud=self, instance_type=instance_type)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        if resources.is_tpu:
+            return 0.0
+        return _PRICE_PER_CPU_HOUR * 8
+
+    def validate_region_zone(self, region, zone):
+        if region is not None and region != _LOCAL_REGION:
+            from skypilot_tpu import exceptions
+            raise exceptions.InvalidResourcesError(
+                f'Local cloud has a single region {_LOCAL_REGION!r}.')
+        if zone is not None and zone != _LOCAL_ZONE:
+            from skypilot_tpu import exceptions
+            raise exceptions.InvalidResourcesError(
+                f'Local cloud has a single zone {_LOCAL_ZONE!r}.')
+        return region, zone
+
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        num_hosts = resources.tpu.num_hosts if resources.is_tpu else 1
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone or _LOCAL_ZONE,
+            'use_spot': resources.use_spot,
+            'num_hosts': num_hosts,
+            'tpu_vm': resources.is_tpu,
+            'tpu_topology': (resources.tpu.topology
+                             if resources.is_tpu else ''),
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None

@@ -1,0 +1,84 @@
+"""Dag — a DAG of Tasks.
+
+Re-design of reference ``sky/dag.py:11``. Like the reference, today's
+executable shapes are a single task or a linear chain; general DAGs are
+validated and stored (networkx) for the optimizer.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+from skypilot_tpu import task as task_lib
+
+
+class Dag:
+    """A directed acyclic graph of Tasks. Usable as a context manager."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List[task_lib.Task] = []
+        self.policy_applied = False
+
+    def add(self, task: task_lib.Task) -> None:
+        self.graph.add_node(task)
+        self.tasks.append(task)
+        task.dag = self
+
+    def remove(self, task: task_lib.Task) -> None:
+        self.graph.remove_node(task)
+        self.tasks.remove(task)
+        task.dag = None
+
+    def add_edge(self, op1: task_lib.Task, op2: task_lib.Task) -> None:
+        assert op1 in self.graph.nodes and op2 in self.graph.nodes
+        self.graph.add_edge(op1, op2)
+        if not nx.is_directed_acyclic_graph(self.graph):
+            self.graph.remove_edge(op1, op2)
+            raise ValueError('Adding this edge would create a cycle.')
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name}, tasks={self.tasks})'
+
+    def is_chain(self) -> bool:
+        degrees = [self.graph.out_degree(t) for t in self.tasks]
+        return all(d <= 1 for d in degrees) and sum(
+            1 for d in degrees if d == 0) <= 1
+
+    def get_sorted_tasks(self) -> List[task_lib.Task]:
+        return list(nx.topological_sort(self.graph))
+
+
+_thread_local = threading.local()
+
+
+def _stack() -> List[Dag]:
+    if not hasattr(_thread_local, 'stack'):
+        _thread_local.stack = []
+    return _thread_local.stack
+
+
+def push_dag(dag: Dag) -> None:
+    _stack().append(dag)
+
+
+def pop_dag() -> Dag:
+    return _stack().pop()
+
+
+def get_current_dag() -> Optional[Dag]:
+    stack = _stack()
+    return stack[-1] if stack else None

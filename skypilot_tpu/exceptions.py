@@ -1,0 +1,141 @@
+"""Exception taxonomy.
+
+Re-design of reference ``sky/exceptions.py``. The provisioning failover
+machinery (backend + jobs recovery) dispatches on these types, so they
+are part of the public API surface.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidTaskError(SkyTpuError, ValueError):
+    """Malformed Task / YAML."""
+
+
+class InvalidResourcesError(SkyTpuError, ValueError):
+    """Malformed or unsatisfiable Resources spec."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No candidate (cloud, region, zone) could satisfy the request.
+
+    Carries ``failover_history`` so callers (managed jobs) can tell quota
+    errors from stockouts (reference sky/exceptions.py ResourcesUnavailableError).
+    """
+
+    def __init__(self,
+                 message: str,
+                 no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the existing cluster's."""
+
+
+class ProvisionError(SkyTpuError):
+    """A cloud provisioning call failed.
+
+    ``errors`` is a list of dicts with at least ``code`` and ``message``;
+    the failover handler maps codes to blocked-resource granularity
+    (zone / region / cloud), mirroring the reference's
+    FailoverCloudErrorHandlerV2 (sky/backends/cloud_vm_ray_backend.py:888).
+    """
+
+    def __init__(self,
+                 message: str,
+                 errors: Optional[Sequence[Dict[str, Any]]] = None) -> None:
+        super().__init__(message)
+        self.errors: List[Dict[str, Any]] = list(errors or [])
+
+
+class QuotaExceededError(ProvisionError):
+    """Out of quota in this region — block the whole region."""
+
+
+class StockoutError(ProvisionError):
+    """Capacity unavailable in this zone — block the zone, try next."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in state."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created under a different cloud identity."""
+
+
+class NotSupportedError(SkyTpuError):
+    """Requested feature unsupported for this cloud/resource combination."""
+
+
+class CommandError(SkyTpuError):
+    """A (remote) command exited nonzero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str,
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        if len(command) > 100:
+            command = command[:100] + '...'
+        super().__init__(
+            f'Command {command} failed with return code {returncode}.\n'
+            f'{error_msg}')
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id missing from the cluster job table."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted max_restarts_on_errors."""
+
+
+class StorageError(SkyTpuError):
+    """Storage layer failure."""
+
+
+class StorageSpecError(StorageError, ValueError):
+    """Malformed storage spec."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service terminated by user mid-operation."""
+
+
+class RequestCancelled(SkyTpuError):
+    """API-server request was cancelled by the client."""
+
+
+class ApiServerConnectionError(SkyTpuError):
+    """Cannot reach the API server."""
+
+    def __init__(self, server_url: str) -> None:
+        super().__init__(
+            f'Could not connect to API server at {server_url}. '
+            'Start one with `skytpu api start`.')
+        self.server_url = server_url

@@ -1,0 +1,337 @@
+"""Client-side persistent state (SQLite).
+
+Re-design of reference ``sky/global_user_state.py``: the ``clusters``
+table holds the pickled ResourceHandle, status, autostop settings; plus
+``cluster_history`` and a ``config`` kv table. WAL mode + a module lock
+make it safe for the multi-process executor (reference :40-52).
+
+DB path: ``~/.skytpu/state.db`` (override: SKYTPU_STATE_DB for tests).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import status_lib
+
+_lock = threading.Lock()
+_conn_local = threading.local()
+
+
+def _db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DB', '~/.skytpu/state.db'))
+
+
+def _conn() -> sqlite3.Connection:
+    path = _db_path()
+    cached = getattr(_conn_local, 'conn', None)
+    if cached is not None and getattr(_conn_local, 'path', None) == path:
+        return cached
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    _create_tables(conn)
+    _conn_local.conn = conn
+    _conn_local.path = path
+    return conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            owner TEXT DEFAULT NULL,
+            cluster_hash TEXT DEFAULT NULL,
+            config_hash TEXT DEFAULT NULL)""")
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_hash TEXT PRIMARY KEY,
+            name TEXT,
+            num_nodes INTEGER,
+            requested_resources BLOB,
+            launched_resources BLOB,
+            usage_intervals BLOB)""")
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY, value TEXT)""")
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT)""")
+    conn.commit()
+
+
+# ----------------------------------------------------------------------
+# Clusters
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[set] = None,
+                          is_launch: bool = True,
+                          ready: bool = False) -> None:
+    status = (status_lib.ClusterStatus.UP
+              if ready else status_lib.ClusterStatus.INIT)
+    handle_blob = pickle.dumps(cluster_handle)
+    cluster_hash = _get_hash_for_existing_cluster(
+        cluster_name) or common_utils.generate_run_id(16)
+    now = int(time.time())
+    usage_intervals = _get_usage_intervals(cluster_hash)
+    if is_launch and (not usage_intervals or
+                      usage_intervals[-1][1] is not None):
+        usage_intervals.append((now, None))
+    with _lock:
+        conn = _conn()
+        conn.execute(
+            """INSERT INTO clusters
+               (name, launched_at, handle, last_use, status, autostop,
+                to_down, owner, cluster_hash)
+               VALUES (?,?,?,?,?,
+                       COALESCE((SELECT autostop FROM clusters
+                                 WHERE name=?), -1),
+                       COALESCE((SELECT to_down FROM clusters
+                                 WHERE name=?), 0),
+                       NULL, ?)
+               ON CONFLICT(name) DO UPDATE SET
+                 launched_at=excluded.launched_at,
+                 handle=excluded.handle,
+                 last_use=excluded.last_use,
+                 status=excluded.status,
+                 cluster_hash=excluded.cluster_hash""",
+            (cluster_name, now, handle_blob, _command_for_last_use(),
+             status.value, cluster_name, cluster_name, cluster_hash))
+        if requested_resources is not None:
+            launched = getattr(cluster_handle, 'launched_resources', None)
+            conn.execute(
+                """INSERT INTO cluster_history
+                   (cluster_hash, name, num_nodes, requested_resources,
+                    launched_resources, usage_intervals)
+                   VALUES (?,?,?,?,?,?)
+                   ON CONFLICT(cluster_hash) DO UPDATE SET
+                     num_nodes=excluded.num_nodes,
+                     requested_resources=excluded.requested_resources,
+                     launched_resources=excluded.launched_resources,
+                     usage_intervals=excluded.usage_intervals""",
+                (cluster_hash, cluster_name,
+                 getattr(cluster_handle, 'launched_nodes', None),
+                 pickle.dumps(requested_resources),
+                 pickle.dumps(launched), pickle.dumps(usage_intervals)))
+        else:
+            conn.execute(
+                'UPDATE cluster_history SET usage_intervals=? '
+                'WHERE cluster_hash=?',
+                (pickle.dumps(usage_intervals), cluster_hash))
+        conn.commit()
+
+
+def _command_for_last_use() -> str:
+    import sys
+    return ' '.join(sys.argv)[:200]
+
+
+def update_cluster_status(cluster_name: str,
+                          status: status_lib.ClusterStatus) -> None:
+    with _lock:
+        conn = _conn()
+        conn.execute('UPDATE clusters SET status=? WHERE name=?',
+                     (status.value, cluster_name))
+        conn.commit()
+
+
+def update_cluster_handle(cluster_name: str, cluster_handle: Any) -> None:
+    with _lock:
+        conn = _conn()
+        conn.execute('UPDATE clusters SET handle=? WHERE name=?',
+                     (pickle.dumps(cluster_handle), cluster_name))
+        conn.commit()
+
+
+def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
+                               to_down: bool) -> None:
+    with _lock:
+        conn = _conn()
+        conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+                     (idle_minutes, int(to_down), cluster_name))
+        conn.commit()
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name)
+    now = int(time.time())
+    with _lock:
+        conn = _conn()
+        if terminate:
+            conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+        else:
+            conn.execute(
+                'UPDATE clusters SET status=? WHERE name=?',
+                (status_lib.ClusterStatus.STOPPED.value, cluster_name))
+        conn.commit()
+    if cluster_hash is not None:
+        usage_intervals = _get_usage_intervals(cluster_hash)
+        if usage_intervals and usage_intervals[-1][1] is None:
+            start, _ = usage_intervals.pop()
+            usage_intervals.append((start, now))
+            with _lock:
+                conn = _conn()
+                conn.execute(
+                    'UPDATE cluster_history SET usage_intervals=? '
+                    'WHERE cluster_hash=?',
+                    (pickle.dumps(usage_intervals), cluster_hash))
+                conn.commit()
+
+
+def get_cluster_from_name(
+        cluster_name: Optional[str]) -> Optional[Dict[str, Any]]:
+    rows = _query_clusters('WHERE name=?', (cluster_name,))
+    return rows[0] if rows else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    return _query_clusters('', ())
+
+
+def _query_clusters(where: str, params: tuple) -> List[Dict[str, Any]]:
+    conn = _conn()
+    cursor = conn.execute(
+        f"""SELECT name, launched_at, handle, last_use, status, autostop,
+                   to_down, owner, cluster_hash FROM clusters {where}
+            ORDER BY launched_at DESC""", params)
+    rows = []
+    for (name, launched_at, handle, last_use, status, autostop, to_down,
+         owner, cluster_hash) in cursor.fetchall():
+        rows.append({
+            'name': name,
+            'launched_at': launched_at,
+            'handle': pickle.loads(handle),
+            'last_use': last_use,
+            'status': status_lib.ClusterStatus(status),
+            'autostop': autostop,
+            'to_down': bool(to_down),
+            'owner': owner,
+            'cluster_hash': cluster_hash,
+        })
+    return rows
+
+
+def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
+    conn = _conn()
+    cursor = conn.execute('SELECT cluster_hash FROM clusters WHERE name=?',
+                          (cluster_name,))
+    row = cursor.fetchone()
+    return row[0] if row else None
+
+
+def _get_usage_intervals(cluster_hash: Optional[str]) -> list:
+    if cluster_hash is None:
+        return []
+    conn = _conn()
+    cursor = conn.execute(
+        'SELECT usage_intervals FROM cluster_history WHERE cluster_hash=?',
+        (cluster_hash,))
+    row = cursor.fetchone()
+    if row is None or row[0] is None:
+        return []
+    return pickle.loads(row[0])
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    conn = _conn()
+    cursor = conn.execute(
+        """SELECT cluster_hash, name, num_nodes, requested_resources,
+                  launched_resources, usage_intervals FROM cluster_history""")
+    rows = []
+    for (cluster_hash, name, num_nodes, requested, launched,
+         usage_intervals) in cursor.fetchall():
+        intervals = pickle.loads(usage_intervals) if usage_intervals else []
+        duration = sum((end or int(time.time())) - start
+                       for start, end in intervals)
+        rows.append({
+            'cluster_hash': cluster_hash,
+            'name': name,
+            'num_nodes': num_nodes,
+            'requested_resources':
+                pickle.loads(requested) if requested else None,
+            'launched_resources':
+                pickle.loads(launched) if launched else None,
+            'usage_intervals': intervals,
+            'duration': duration,
+        })
+    return rows
+
+
+# ----------------------------------------------------------------------
+# Storage records
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: str) -> None:
+    with _lock:
+        conn = _conn()
+        conn.execute(
+            """INSERT INTO storage (name, launched_at, handle, last_use,
+                                    status)
+               VALUES (?,?,?,?,?)
+               ON CONFLICT(name) DO UPDATE SET handle=excluded.handle,
+                 status=excluded.status, last_use=excluded.last_use""",
+            (storage_name, int(time.time()), pickle.dumps(storage_handle),
+             _command_for_last_use(), storage_status))
+        conn.commit()
+
+
+def remove_storage(storage_name: str) -> None:
+    with _lock:
+        conn = _conn()
+        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
+        conn.commit()
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    conn = _conn()
+    cursor = conn.execute(
+        'SELECT name, launched_at, handle, last_use, status FROM storage')
+    return [{
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': status,
+    } for name, launched_at, handle, last_use, status in cursor.fetchall()]
+
+
+def get_storage_from_name(name: str) -> Optional[Dict[str, Any]]:
+    for row in get_storage():
+        if row['name'] == name:
+            return row
+    return None
+
+
+# ----------------------------------------------------------------------
+# Generic config kv
+def get_config_value(key: str) -> Optional[Any]:
+    conn = _conn()
+    cursor = conn.execute('SELECT value FROM config WHERE key=?', (key,))
+    row = cursor.fetchone()
+    return json.loads(row[0]) if row else None
+
+
+def set_config_value(key: str, value: Any) -> None:
+    with _lock:
+        conn = _conn()
+        conn.execute(
+            """INSERT INTO config (key, value) VALUES (?,?)
+               ON CONFLICT(key) DO UPDATE SET value=excluded.value""",
+            (key, json.dumps(value)))
+        conn.commit()

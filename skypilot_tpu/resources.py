@@ -1,0 +1,393 @@
+"""Declarative resource spec with TPU pod slices first-class.
+
+Re-design of reference ``sky/resources.py`` (`Resources` :31,
+`_set_accelerators` :563, `get_cost` :1040, `less_demanding_than` :1146,
+`from_yaml_config` :1348). Differences, TPU-first:
+
+- ``accelerators='tpu-v5e-16'`` parses into a :class:`TpuSlice` with chip
+  / host / topology math done eagerly (utils/tpu_utils.py) instead of the
+  reference's string-keyed dict passed opaquely to GCP.
+- One Task "node" = one slice; ``num_hosts`` on Resources tells the
+  backend the gang fan-out width without a cloud round-trip.
+- No GPU catalog: this framework targets TPUs (the cloud plugin seam
+  still allows other clouds/accelerators to be registered).
+"""
+from __future__ import annotations
+
+import textwrap
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import tpu_utils
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+_RESOURCES_FIELDS = frozenset({
+    'cloud', 'region', 'zone', 'instance_type', 'accelerators',
+    'accelerator_args', 'cpus', 'memory', 'use_spot', 'job_recovery',
+    'disk_size', 'disk_tier', 'image_id', 'ports', 'labels', 'any_of',
+})
+
+
+class Resources:
+    """An (immutable) resource requirement / launchable description.
+
+    A Resources is *launchable* when cloud and either instance_type or a
+    TPU accelerator are pinned down; the optimizer turns user Resources
+    into launchable ones (one per candidate region/zone).
+    """
+
+    def __init__(
+        self,
+        cloud: Optional[Union[str, 'Any']] = None,
+        instance_type: Optional[str] = None,
+        accelerators: Optional[Union[str, Dict[str, int]]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        cpus: Optional[Union[int, float, str]] = None,
+        memory: Optional[Union[int, float, str]] = None,
+        use_spot: Optional[bool] = None,
+        job_recovery: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        image_id: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self._cloud = self._resolve_cloud(cloud)
+        self._region: Optional[str] = region
+        self._zone: Optional[str] = zone
+        self._instance_type = instance_type
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._job_recovery = job_recovery.lower() if job_recovery else None
+        self._disk_size = (int(disk_size)
+                           if disk_size is not None else _DEFAULT_DISK_SIZE_GB)
+        self._disk_tier = disk_tier
+        self._image_id = image_id
+        self._labels = dict(labels) if labels else None
+
+        self._set_accelerators(accelerators, accelerator_args)
+        # cpus/memory: '4', '4+', 4 — validated here, matched in catalog.
+        self._cpus = str(cpus) if cpus is not None else None
+        self._memory = str(memory) if memory is not None else None
+        common_utils.parse_cpus_memory(self._cpus)
+        common_utils.parse_cpus_memory(self._memory)
+        self._ports = self._normalize_ports(ports)
+        self._validate()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_cloud(cloud):
+        if cloud is None or not isinstance(cloud, str):
+            return cloud
+        import skypilot_tpu.clouds  # noqa: F401 (registers built-ins)
+        cls = registry.CLOUD_REGISTRY.from_str(cloud)
+        return cls()  # type: ignore[operator]
+
+    def _set_accelerators(self, accelerators, accelerator_args) -> None:
+        """Normalize accelerators to {name: count}; parse TPU topology.
+
+        Mirrors reference sky/resources.py:563 `_set_accelerators` (which
+        detects `tpu-` names and forces GCP); here the TPU path is the
+        main path.
+        """
+        self._tpu: Optional[tpu_utils.TpuSlice] = None
+        self._accelerator_args = (dict(accelerator_args)
+                                  if accelerator_args else None)
+        if accelerators is None:
+            self._accelerators: Optional[Dict[str, int]] = None
+            return
+        if isinstance(accelerators, str):
+            if ':' in accelerators:
+                name, count_s = accelerators.split(':', 1)
+                try:
+                    count = int(count_s)
+                except ValueError:
+                    raise exceptions.InvalidResourcesError(
+                        f'Invalid accelerators {accelerators!r}.') from None
+                accelerators = {name: count}
+            else:
+                accelerators = {accelerators: 1}
+        if len(accelerators) != 1:
+            raise exceptions.InvalidResourcesError(
+                'accelerators must name exactly one accelerator type, '
+                f'got {accelerators!r}')
+        name, count = next(iter(accelerators.items()))
+        if tpu_utils.is_tpu_name(name):
+            if count != 1:
+                raise exceptions.InvalidResourcesError(
+                    f'TPU slices are atomic; use a larger slice (e.g. '
+                    f'tpu-v5e-{8 * count}) instead of count={count}.')
+            self._tpu = tpu_utils.parse(name)
+            name = self._tpu.name
+        self._accelerators = {name: int(count)}
+
+    @staticmethod
+    def _normalize_ports(ports) -> Optional[List[str]]:
+        if ports is None:
+            return None
+        if isinstance(ports, (int, str)):
+            ports = [ports]
+        out = [str(p) for p in ports]
+        return out or None
+
+    def _validate(self) -> None:
+        if self._region is not None or self._zone is not None:
+            if self._cloud is not None:
+                self._cloud.validate_region_zone(self._region, self._zone)
+        if self._tpu is not None and self._instance_type is not None:
+            raise exceptions.InvalidResourcesError(
+                'Specify either a TPU accelerator or an instance_type, '
+                'not both (TPU-VM hosts are implied by the slice).')
+        if self._disk_size <= 0:
+            raise exceptions.InvalidResourcesError(
+                f'disk_size must be positive, got {self._disk_size}')
+
+    # ------------------------------------------------------------------
+    # Accessors
+    @property
+    def cloud(self):
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        return self._accelerators
+
+    @property
+    def accelerator_args(self) -> Optional[Dict[str, Any]]:
+        return self._accelerator_args
+
+    @property
+    def tpu(self) -> Optional[tpu_utils.TpuSlice]:
+        return self._tpu
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._tpu is not None
+
+    @property
+    def num_hosts(self) -> int:
+        """Hosts behind one logical node (gang fan-out width)."""
+        return self._tpu.num_hosts if self._tpu is not None else 1
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def job_recovery(self) -> Optional[str]:
+        return self._job_recovery
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    # ------------------------------------------------------------------
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and (self._instance_type is not None or
+                                            self._tpu is not None)
+
+    def assert_launchable(self) -> None:
+        if not self.is_launchable():
+            raise exceptions.InvalidResourcesError(
+                f'Resources not launchable: {self!r}')
+
+    def copy(self, **override) -> 'Resources':
+        """New Resources with fields overridden."""
+        current = {
+            'cloud': override.pop('cloud', self._cloud),
+            'instance_type': override.pop('instance_type',
+                                          self._instance_type),
+            'accelerators': override.pop('accelerators', self._accelerators),
+            'accelerator_args': override.pop('accelerator_args',
+                                             self._accelerator_args),
+            'cpus': override.pop('cpus', self._cpus),
+            'memory': override.pop('memory', self._memory),
+            'use_spot': override.pop(
+                'use_spot',
+                self._use_spot if self._use_spot_specified else None),
+            'job_recovery': override.pop('job_recovery', self._job_recovery),
+            'region': override.pop('region', self._region),
+            'zone': override.pop('zone', self._zone),
+            'disk_size': override.pop('disk_size', self._disk_size),
+            'disk_tier': override.pop('disk_tier', self._disk_tier),
+            'image_id': override.pop('image_id', self._image_id),
+            'ports': override.pop('ports', self._ports),
+            'labels': override.pop('labels', self._labels),
+        }
+        if override:
+            raise ValueError(f'Unknown Resources fields: {list(override)}')
+        return Resources(**current)
+
+    # ------------------------------------------------------------------
+    def hourly_price(self) -> float:
+        """Catalog price for one logical node of this launchable."""
+        self.assert_launchable()
+        return self._cloud.hourly_price(self)
+
+    def get_cost(self, seconds: float) -> float:
+        return self.hourly_price() * seconds / 3600.0
+
+    # ------------------------------------------------------------------
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if `other` (an existing cluster) can serve `self`.
+
+        Mirrors reference sky/resources.py:1146 — used by `exec` and the
+        optimizer to reuse clusters.
+        """
+        if self._cloud is not None and not self._cloud.is_same_cloud(
+                other.cloud):
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if (self._instance_type is not None and
+                self._instance_type != other.instance_type):
+            return False
+        if self._accelerators is not None:
+            if other.accelerators is None:
+                return False
+            for name, count in self._accelerators.items():
+                if other.accelerators.get(name, 0) < count:
+                    return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(
+            cls, config: Optional[Dict[str, Any]]) -> Union[
+                'Resources', List['Resources']]:
+        """Build from a `resources:` YAML section.
+
+        Supports `any_of:` (a list of alternative specs) like the
+        reference (sky/resources.py:1348).
+        """
+        if config is None:
+            return cls()
+        config = dict(config)
+        unknown = set(config) - _RESOURCES_FIELDS
+        if unknown:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown resources fields: {sorted(unknown)}. '
+                f'Valid: {sorted(_RESOURCES_FIELDS)}')
+        any_of = config.pop('any_of', None)
+        if any_of is not None:
+            out = []
+            for alt in any_of:
+                merged = {**config, **alt}
+                r = cls.from_yaml_config(merged)
+                assert isinstance(r, Resources)
+                out.append(r)
+            return out
+        return cls(**config)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None:
+                config[key] = value
+
+        add('cloud', str(self._cloud) if self._cloud else None)
+        add('region', self._region)
+        add('zone', self._zone)
+        add('instance_type', self._instance_type)
+        add('accelerators', self._accelerators)
+        add('accelerator_args', self._accelerator_args)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        if self._use_spot_specified:
+            config['use_spot'] = self._use_spot
+        add('job_recovery', self._job_recovery)
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            config['disk_size'] = self._disk_size
+        add('disk_tier', self._disk_tier)
+        add('image_id', self._image_id)
+        add('ports', self._ports)
+        add('labels', self._labels)
+        return config
+
+    # ------------------------------------------------------------------
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud is not None:
+            parts.append(str(self._cloud))
+        if self._instance_type is not None:
+            parts.append(self._instance_type)
+        if self._tpu is not None:
+            parts.append(f'{self._tpu.name}[{self._tpu.topology}, '
+                         f'{self._tpu.num_hosts} host'
+                         f'{"s" if self._tpu.num_hosts > 1 else ""}]')
+        elif self._accelerators is not None:
+            parts.append(str(self._accelerators))
+        if self._cpus is not None:
+            parts.append(f'cpus={self._cpus}')
+        if self._memory is not None:
+            parts.append(f'mem={self._memory}')
+        if self._use_spot:
+            parts.append('[Spot]')
+        if self._region is not None:
+            parts.append(self._region)
+        if self._zone is not None:
+            parts.append(self._zone)
+        inner = ', '.join(parts) if parts else 'default'
+        return f'Resources({inner})'
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(common_utils.dump_yaml_str(self.to_yaml_config()))
+
+    def pretty(self) -> str:
+        return textwrap.indent(
+            common_utils.dump_yaml_str(self.to_yaml_config()), '  ')

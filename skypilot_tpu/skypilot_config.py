@@ -1,0 +1,104 @@
+"""Layered user/config system.
+
+Re-design of reference ``sky/skypilot_config.py`` (:1-60): a YAML config
+at ``~/.skytpu/config.yaml`` (override with env SKYTPU_CONFIG), nested
+get/set by dotted path, plus an override context used by the API server
+to apply per-request config (reference server/requests/executor.py:171).
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
+
+ENV_VAR_CONFIG_PATH = 'SKYTPU_CONFIG'
+DEFAULT_CONFIG_PATH = '~/.skytpu/config.yaml'
+
+_lock = threading.Lock()
+_loaded = False
+_config: Dict[str, Any] = {}
+_overrides = threading.local()
+
+
+def config_path() -> str:
+    return os.path.expanduser(
+        os.environ.get(ENV_VAR_CONFIG_PATH, DEFAULT_CONFIG_PATH))
+
+
+def _load() -> None:
+    global _loaded, _config
+    with _lock:
+        if _loaded:
+            return
+        path = config_path()
+        if os.path.exists(path):
+            config = common_utils.read_yaml(path) or {}
+            schemas.validate_config(config)
+            _config = config
+        else:
+            _config = {}
+        _loaded = True
+
+
+def reload_config() -> None:
+    global _loaded
+    _loaded = False
+    _load()
+
+
+def _active_config() -> Dict[str, Any]:
+    _load()
+    override = getattr(_overrides, 'config', None)
+    if override is not None:
+        return override
+    return _config
+
+
+def get_nested(keys, default_value: Any = None) -> Any:
+    """get_nested(('gcp', 'project_id')) -> value or default."""
+    if isinstance(keys, str):
+        keys = keys.split('.')
+    node: Any = _active_config()
+    for k in keys:
+        if not isinstance(node, dict) or k not in node:
+            return default_value
+        node = node[k]
+    return node
+
+
+def set_nested(keys, value: Any) -> Dict[str, Any]:
+    """Pure update: returns a new config dict with keys set."""
+    if isinstance(keys, str):
+        keys = keys.split('.')
+    config = copy.deepcopy(_active_config())
+    node = config
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+    node[keys[-1]] = value
+    return config
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_active_config())
+
+
+@contextlib.contextmanager
+def override_config(config: Optional[Dict[str, Any]]) -> Iterator[None]:
+    """Thread-local full-config override (API-server per-request config)."""
+    if config is not None:
+        schemas.validate_config(config)
+    previous = getattr(_overrides, 'config', None)
+    _overrides.config = config
+    try:
+        yield
+    finally:
+        _overrides.config = previous
+
+
+def loaded_config_exists() -> bool:
+    return os.path.exists(config_path())

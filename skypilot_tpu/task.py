@@ -1,0 +1,229 @@
+"""Task — the unit of work.
+
+Re-design of reference ``sky/task.py`` (`Task` :192, `from_yaml_config`
+:432, `set_resources` :717, `to_yaml_config` :1179). A Task declares
+*what* to run (setup/run commands, workdir, envs, file mounts, a set of
+acceptable Resources); the optimizer+backend decide *where/how*.
+
+TPU-first deltas: ``num_nodes`` counts logical nodes (= pod slices); the
+per-host gang fan-out is derived from the chosen Resources' slice
+topology, so `num_nodes: 1` with `tpu-v5e-64` still launches a 16-host
+gang. Env vars are injected per the contract in utils/env_contract.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
+
+_VALID_NAME_REGEX = re.compile(r'^[a-zA-Z0-9]+[a-zA-Z0-9._-]*$')
+
+CommandOrGen = Union[str, Callable[[int, List[str]], Optional[str]], None]
+
+
+class Task:
+    """A coarse-grained unit of work: setup once, run on every rank."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGen = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
+        service: Optional[Any] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self._envs = dict(envs) if envs else {}
+        self.file_mounts: Optional[Dict[str, str]] = (dict(file_mounts)
+                                                      if file_mounts else None)
+        self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
+        self.service = service
+        self._resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        # Best resources chosen by the optimizer (a launchable Resources).
+        self.best_resources: Optional[resources_lib.Resources] = None
+        # DAG wiring (set by Dag).
+        self.dag: Optional[Any] = None
+        self._validate()
+        # Auto-register with an enclosing `with Dag():` block.
+        from skypilot_tpu import dag as dag_lib
+        current = dag_lib.get_current_dag()
+        if current is not None:
+            current.add(self)
+
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_REGEX.match(self.name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {self.name!r}.')
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.run is not None and not (isinstance(self.run, str) or
+                                         callable(self.run)):
+            raise exceptions.InvalidTaskError(
+                'run must be a string command or a callable '
+                '(rank, ips) -> Optional[str].')
+        if self.workdir is not None:
+            full = os.path.abspath(os.path.expanduser(self.workdir))
+            if not os.path.isdir(full):
+                raise exceptions.InvalidTaskError(
+                    f'workdir {self.workdir!r} is not an existing directory.')
+        for env_key in self._envs:
+            if not re.match(r'^[A-Za-z_][A-Za-z0-9_]*$', env_key):
+                raise exceptions.InvalidTaskError(
+                    f'Invalid env var name {env_key!r}.')
+        if self.file_mounts is not None:
+            for dst, src in self.file_mounts.items():
+                if not isinstance(dst, str) or not isinstance(src, str):
+                    raise exceptions.InvalidTaskError(
+                        f'file_mounts entries must be str: str, got '
+                        f'{dst!r}: {src!r}')
+
+    # ------------------------------------------------------------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    def update_envs(self, envs: Dict[str, Optional[str]]) -> 'Task':
+        for k, v in envs.items():
+            if v is None:
+                self._envs.pop(k, None)
+            else:
+                self._envs[k] = str(v)
+        return self
+
+    @property
+    def resources(self) -> Set[resources_lib.Resources]:
+        return self._resources
+
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               List[resources_lib.Resources],
+                               Set[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self._resources = set(resources)
+        if not self._resources:
+            raise exceptions.InvalidTaskError('resources set cannot be empty')
+        return self
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        self.file_mounts = dict(file_mounts) if file_mounts else None
+        self._validate()
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        if self.file_mounts is None:
+            self.file_mounts = {}
+        self.file_mounts.update(file_mounts)
+        self._validate()
+        return self
+
+    # ------------------------------------------------------------------
+    # Chaining sugar: task_a >> task_b (reference sky/task.py:1263)
+    def __rshift__(self, other: 'Task') -> 'Task':
+        assert self.dag is not None and other.dag is self.dag, (
+            'Both tasks must be added to the same Dag (use `with '
+            'sky.Dag() as dag:`).')
+        self.dag.add_edge(self, other)
+        return other
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        schemas.validate_task(config)
+        config = dict(config)
+        envs = {
+            k: ('' if v is None else str(v))
+            for k, v in (config.get('envs') or {}).items()
+        }
+        if env_overrides:
+            envs.update({k: str(v) for k, v in env_overrides.items()})
+        # Any `envs:` key with null value must be provided at launch time.
+        missing = [k for k, v in envs.items() if v == '']
+        if missing and (config.get('envs') or {}):
+            null_keys = [
+                k for k in missing if (config.get('envs') or {}).get(k) is None
+            ]
+            if null_keys:
+                raise exceptions.InvalidTaskError(
+                    f'Env var(s) {null_keys} require values; pass --env.')
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            file_mounts=config.get('file_mounts'),
+            storage_mounts=config.get('storage_mounts'),
+        )
+        if 'service' in config:
+            from skypilot_tpu.serve import service_spec
+            task.service = service_spec.ServiceSpec.from_yaml_config(
+                config['service'])
+        resources_config = config.get('resources')
+        parsed = resources_lib.Resources.from_yaml_config(resources_config)
+        task.set_resources(parsed if isinstance(parsed, list) else {parsed})
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        config = common_utils.read_yaml(yaml_path)
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'{yaml_path} does not contain a task mapping.')
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None and value != {} and value != []:
+                config[key] = value
+
+        add('name', self.name)
+        resources_list = [r.to_yaml_config() for r in sorted(
+            self._resources, key=repr)]
+        if len(resources_list) == 1:
+            add('resources', resources_list[0])
+        else:
+            add('resources', {'any_of': resources_list})
+        if self.num_nodes != 1:
+            config['num_nodes'] = self.num_nodes
+        add('workdir', self.workdir)
+        add('setup', self.setup)
+        add('run', self.run if isinstance(self.run, str) else None)
+        add('envs', self._envs or None)
+        add('file_mounts', self.file_mounts)
+        add('storage_mounts', self.storage_mounts or None)
+        if self.service is not None:
+            add('service', self.service.to_yaml_config())
+        return config
+
+    # ------------------------------------------------------------------
+    def __repr__(self) -> str:
+        label = self.name or '-'
+        r = (repr(self.best_resources)
+             if self.best_resources is not None else
+             ', '.join(repr(x) for x in sorted(self._resources, key=repr)))
+        return f'Task({label}, num_nodes={self.num_nodes}, resources={r})'

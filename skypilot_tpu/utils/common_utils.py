@@ -1,0 +1,211 @@
+"""Small shared helpers: ids, name validation, yaml, sizes, retries.
+
+Re-design of reference ``sky/utils/common_utils.py`` (subset we need).
+"""
+from __future__ import annotations
+
+import difflib
+import functools
+import getpass
+import hashlib
+import os
+import re
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+_USER_HASH_FILE = os.path.expanduser('~/.skytpu/user_hash')
+USER_HASH_LENGTH = 8
+
+
+def generate_user_hash() -> str:
+    hash_str = hashlib.md5(
+        (getpass.getuser() + str(uuid.getnode())).encode()).hexdigest()
+    return hash_str[:USER_HASH_LENGTH]
+
+
+@functools.lru_cache(maxsize=1)
+def get_user_hash() -> str:
+    """Stable per-user hash; persisted so cluster names are stable."""
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env:
+        return env[:USER_HASH_LENGTH]
+    if os.path.exists(_USER_HASH_FILE):
+        with open(_USER_HASH_FILE, encoding='utf-8') as f:
+            cached = f.read().strip()
+        if cached:
+            return cached[:USER_HASH_LENGTH]
+    user_hash = generate_user_hash()
+    os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+    with open(_USER_HASH_FILE, 'w', encoding='utf-8') as f:
+        f.write(user_hash)
+    return user_hash
+
+
+def get_user_name() -> str:
+    return os.environ.get('SKYTPU_USER', None) or getpass.getuser()
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if not CLUSTER_NAME_VALID_REGEX.match(name):
+        from skypilot_tpu import exceptions
+        raise exceptions.InvalidTaskError(
+            f'Cluster name {name!r} is invalid: must start with a letter, '
+            'contain only letters, digits, -, _, . and end alphanumeric.')
+
+
+def make_cluster_name_on_cloud(display_name: str, max_length: int = 35) -> str:
+    """Append user hash; truncate+hash long names for cloud resource limits."""
+    suffix = f'-{get_user_hash()}'
+    base = display_name.lower().replace('_', '-').replace('.', '-')
+    if len(base) + len(suffix) > max_length:
+        digest = hashlib.md5(base.encode()).hexdigest()[:4]
+        base = base[:max_length - len(suffix) - 5] + '-' + digest
+    return base + suffix
+
+
+def get_global_job_id(run_timestamp: str, cluster_name: str,
+                      job_id: Union[int, str]) -> str:
+    return f'{run_timestamp}_{cluster_name}_{job_id}'
+
+
+def base36(n: int) -> str:
+    chars = '0123456789abcdefghijklmnopqrstuvwxyz'
+    out = ''
+    while True:
+        n, r = divmod(n, 36)
+        out = chars[r] + out
+        if n == 0:
+            return out
+
+
+def generate_run_id(length: int = 8) -> str:
+    return uuid.uuid4().hex[:length]
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    with open(os.path.expanduser(path), encoding='utf-8') as f:
+        configs = list(yaml.safe_load_all(f))
+    return [c for c in configs if c is not None] or [{}]
+
+
+def dump_yaml(path: str, config: Union[Dict, List[Dict]]) -> None:
+    with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Union[Dict, List[Dict]]) -> str:
+
+    class LineBreakDumper(yaml.SafeDumper):
+
+        def write_line_break(self, data=None):
+            super().write_line_break(data)
+            if len(self.indents) == 1:
+                super().write_line_break()
+
+    if isinstance(config, list):
+        dump_func = yaml.dump_all
+    else:
+        dump_func = yaml.dump
+    return dump_func(config,
+                     Dumper=LineBreakDumper,
+                     sort_keys=False,
+                     default_flow_style=False)
+
+
+def parse_cpus_memory(value: Optional[Union[int, float, str]]
+                      ) -> Optional[tuple]:
+    """Parse '4', '4+', 4 → (4.0, is_plus). None → None."""
+    if value is None:
+        return None
+    s = str(value).strip()
+    plus = s.endswith('+')
+    if plus:
+        s = s[:-1]
+    try:
+        num = float(s)
+    except ValueError:
+        from skypilot_tpu import exceptions
+        raise exceptions.InvalidResourcesError(
+            f'Invalid cpus/memory spec {value!r}; expected e.g. "4" or "4+".'
+        ) from None
+    return num, plus
+
+
+def format_float(x: Union[int, float], precision: int = 2) -> str:
+    if isinstance(x, int) or x == int(x):
+        return str(int(x))
+    return f'{x:.{precision}f}'
+
+
+def close_matches(word: str, candidates: List[str]) -> List[str]:
+    return difflib.get_close_matches(word, candidates, n=3, cutoff=0.7)
+
+
+def retry(fn: Optional[Callable] = None,
+          *,
+          max_retries: int = 3,
+          initial_backoff: float = 1.0,
+          exceptions_to_retry=(Exception,)) -> Callable:
+    """Exponential-backoff retry decorator."""
+    if fn is None:
+        return functools.partial(retry,
+                                 max_retries=max_retries,
+                                 initial_backoff=initial_backoff,
+                                 exceptions_to_retry=exceptions_to_retry)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        backoff = initial_backoff
+        for attempt in range(max_retries):
+            try:
+                return fn(*args, **kwargs)
+            except exceptions_to_retry:
+                if attempt == max_retries - 1:
+                    raise
+                time.sleep(backoff)
+                backoff *= 2
+
+    return wrapper
+
+
+class Backoff:
+    """Capped exponential backoff with jitter-free determinism for tests."""
+
+    def __init__(self, initial: float = 5.0, cap: float = 300.0,
+                 factor: float = 1.6) -> None:
+        self._value = initial
+        self._cap = cap
+        self._factor = factor
+
+    def current_backoff(self) -> float:
+        value = self._value
+        self._value = min(self._value * self._factor, self._cap)
+        return value
+
+
+def format_exception(e: BaseException, use_bracket: bool = False) -> str:
+    name = type(e).__name__
+    if use_bracket:
+        return f'[{name}] {e}'
+    return f'{name}: {e}'
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+def expand_path(path: str) -> str:
+    return os.path.abspath(os.path.expanduser(path))

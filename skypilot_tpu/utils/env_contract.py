@@ -1,0 +1,105 @@
+"""The rank/IP/topology environment-variable contract.
+
+The reference injects ``SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES/
+NUM_GPUS_PER_NODE`` into every rank (sky/skylet/constants.py:325-328) and
+lets the user command feed them to torchrun/deepspeed. Our TPU-native
+contract instead targets ``jax.distributed.initialize()``: each TPU *host*
+of a pod slice is a rank, the coordinator is rank 0's IP, and the slice
+topology is exposed so recipes can build their device mesh without
+querying the cloud.
+
+One logical "node" in a Task maps to one TPU pod slice; a slice of H
+hosts contributes H ranks (the reference's `num_ips_per_node` fan-out,
+sky/backends/cloud_vm_ray_backend.py:2531-2538,5052).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+# Names visible inside the user's `run` command.
+NODE_RANK = 'SKYTPU_NODE_RANK'
+NODE_IPS = 'SKYTPU_NODE_IPS'
+NUM_NODES = 'SKYTPU_NUM_NODES'
+NUM_CHIPS_PER_NODE = 'SKYTPU_NUM_CHIPS_PER_NODE'
+COORDINATOR_ADDR = 'SKYTPU_COORDINATOR_ADDR'
+COORDINATOR_PORT_DEFAULT = 8476
+TPU_TOPOLOGY = 'SKYTPU_TPU_TOPOLOGY'
+ACCELERATOR_TYPE = 'SKYTPU_ACCELERATOR_TYPE'
+TASK_ID = 'SKYTPU_TASK_ID'
+CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+JOB_ID = 'SKYTPU_JOB_ID'
+# Compatibility aliases so recipes written against the reference's
+# contract keep working (same semantics, per-host ranks).
+_COMPAT_ALIASES = {
+    NODE_RANK: 'SKYPILOT_NODE_RANK',
+    NODE_IPS: 'SKYPILOT_NODE_IPS',
+    NUM_NODES: 'SKYPILOT_NUM_NODES',
+    TASK_ID: 'SKYPILOT_TASK_ID',
+}
+
+
+def make_rank_env(rank: int,
+                  ips: List[str],
+                  *,
+                  num_chips_per_node: int = 0,
+                  topology: str = '',
+                  accelerator_type: str = '',
+                  task_id: str = '',
+                  cluster_name: str = '',
+                  job_id: Optional[int] = None,
+                  coordinator_port: int = COORDINATOR_PORT_DEFAULT
+                  ) -> Dict[str, str]:
+    """Env dict for one rank of a gang job.
+
+    Rank = index of this host's IP in the stable sorted host list
+    (reference rank assignment: cloud_vm_ray_backend.py:536-541).
+    """
+    assert 0 <= rank < len(ips), (rank, ips)
+    env = {
+        NODE_RANK: str(rank),
+        NODE_IPS: '\n'.join(ips),
+        NUM_NODES: str(len(ips)),
+        NUM_CHIPS_PER_NODE: str(num_chips_per_node),
+        COORDINATOR_ADDR: f'{ips[0]}:{coordinator_port}',
+        TPU_TOPOLOGY: topology,
+        ACCELERATOR_TYPE: accelerator_type,
+        TASK_ID: task_id,
+        CLUSTER_NAME: cluster_name,
+    }
+    if job_id is not None:
+        env[JOB_ID] = str(job_id)
+    for ours, theirs in _COMPAT_ALIASES.items():
+        env[theirs] = env[ours]
+    return env
+
+
+def export_statements(env: Dict[str, str]) -> str:
+    """Render env as shell `export` lines (IP list newline-safe)."""
+    lines = []
+    for k, v in env.items():
+        escaped = v.replace('"', '\\"').replace('\n', '\\n')
+        lines.append(f'export {k}=$(echo -e "{escaped}")'
+                     if '\\n' in escaped else f'export {k}="{escaped}"')
+    return '\n'.join(lines)
+
+
+def jax_distributed_kwargs(env: Optional[Dict[str, str]] = None) -> Dict:
+    """Map the contract to jax.distributed.initialize() kwargs.
+
+    Recipes call::
+
+        import jax
+        from skypilot_tpu.utils import env_contract
+        kw = env_contract.jax_distributed_kwargs()
+        if kw['num_processes'] > 1:
+            jax.distributed.initialize(**kw)
+    """
+    e = os.environ if env is None else env
+    num = int(e.get(NUM_NODES, '1'))
+    return {
+        'coordinator_address': e.get(COORDINATOR_ADDR,
+                                     f'127.0.0.1:{COORDINATOR_PORT_DEFAULT}'),
+        'num_processes': num,
+        'process_id': int(e.get(NODE_RANK, '0')),
+    }

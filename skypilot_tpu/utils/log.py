@@ -1,0 +1,80 @@
+"""Logging for skypilot_tpu.
+
+TPU-native re-design of the reference's ``sky/sky_logging.py`` (see
+/root/reference/sky/sky_logging.py:60-131): env-tunable level, a single
+stream handler on the package root logger, and helpers to temporarily
+silence or re-route output.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_setup_lock = threading.Lock()
+_initialized = False
+
+
+def _env_level() -> int:
+    if os.environ.get('SKYTPU_DEBUG', '0') == '1':
+        return logging.DEBUG
+    if os.environ.get('SKYTPU_MINIMIZE_LOGGING', '0') == '1':
+        return logging.WARNING
+    return logging.INFO
+
+
+class NoPrefixFormatter(logging.Formatter):
+    """Plain message formatter for user-facing output lines."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        return record.getMessage()
+
+
+def _setup() -> None:
+    global _initialized
+    with _setup_lock:
+        if _initialized:
+            return
+        root = logging.getLogger('skypilot_tpu')
+        root.setLevel(logging.DEBUG)
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setLevel(_env_level())
+        handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+        root.addHandler(handler)
+        root.propagate = False
+        _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup()
+    return logging.getLogger(name)
+
+
+def logging_enabled(logger: logging.Logger, level: int) -> bool:
+    return logger.isEnabledFor(level)
+
+
+@contextlib.contextmanager
+def silent():
+    """Suppress INFO-level package output inside the context."""
+    _setup()
+    root = logging.getLogger('skypilot_tpu')
+    previous = [h.level for h in root.handlers]
+    try:
+        for h in root.handlers:
+            h.setLevel(max(h.level, logging.WARNING))
+        yield
+    finally:
+        for h, lvl in zip(root.handlers, previous):
+            h.setLevel(lvl)
+
+
+def get_run_timestamp() -> str:
+    import time
+    return 'skytpu-' + time.strftime('%Y-%m-%d-%H-%M-%S-%f',
+                                     time.localtime())[:len('skytpu-') + 26]

@@ -1,0 +1,70 @@
+"""Name → class registries.
+
+Re-design of the reference's ``sky/utils/registry.py:16`` — a tiny
+case-insensitive registry used for clouds, backends, and jobs-recovery
+strategies, so new implementations plug in with a decorator.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+
+    def __init__(self, registry_name: str) -> None:
+        self._name = registry_name
+        self._entries: Dict[str, T] = {}
+        self._aliases: Dict[str, str] = {}
+        self._default: Optional[str] = None
+
+    def register(self,
+                 name: Optional[str] = None,
+                 aliases: Optional[List[str]] = None,
+                 default: bool = False) -> Callable[[Type], Type]:
+
+        def decorator(cls: Type) -> Type:
+            key = (name or cls.__name__).lower()
+            if key in self._entries:
+                raise ValueError(
+                    f'{self._name} registry: duplicate entry {key!r}')
+            self._entries[key] = cls
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = key
+            if default:
+                self._default = key
+            return cls
+
+        return decorator
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._entries:
+            raise ValueError(
+                f'{self._name} {name!r} is not registered. '
+                f'Registered: {sorted(self._entries)}')
+        return self._entries[key]
+
+    def get_default(self) -> T:
+        assert self._default is not None, f'{self._name}: no default set'
+        return self._entries[self._default]
+
+    def keys(self) -> List[str]:
+        return sorted(self._entries)
+
+    def values(self) -> List[T]:
+        return [self._entries[k] for k in sorted(self._entries)]
+
+    def __contains__(self, name: str) -> bool:
+        key = name.lower()
+        return key in self._entries or key in self._aliases
+
+
+# Instantiated registries (populated by decorators at import time).
+CLOUD_REGISTRY: Registry = Registry('Cloud')
+BACKEND_REGISTRY: Registry = Registry('Backend')
+JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry('JobsRecoveryStrategy')

@@ -1,0 +1,156 @@
+"""JSON-schema validation for task YAML and config files.
+
+Re-design of reference ``sky/utils/schemas.py`` (985 LoC) trimmed to the
+fields this framework implements. Validation errors surface as
+InvalidTaskError with the offending path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jsonschema
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'cloud': {'type': 'string'},
+        'region': {'type': 'string'},
+        'zone': {'type': 'string'},
+        'instance_type': {'type': 'string'},
+        'accelerators': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object', 'additionalProperties': {'type': 'integer'}},
+            ]
+        },
+        'accelerator_args': {'type': 'object'},
+        'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'memory': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
+        'use_spot': {'type': 'boolean'},
+        'job_recovery': {'type': 'string'},
+        'disk_size': {'type': 'integer'},
+        'disk_tier': {'type': 'string'},
+        'image_id': {'type': 'string'},
+        'ports': {
+            'anyOf': [
+                {'type': 'integer'},
+                {'type': 'string'},
+                {'type': 'array',
+                 'items': {'anyOf': [{'type': 'integer'},
+                                     {'type': 'string'}]}},
+            ]
+        },
+        'labels': {'type': 'object',
+                   'additionalProperties': {'type': 'string'}},
+        'any_of': {'type': 'array', 'items': {'type': 'object'}},
+    },
+}
+
+_SERVICE_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'required': ['readiness_probe'],
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [
+                {'type': 'string'},
+                {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'required': ['path'],
+                    'properties': {
+                        'path': {'type': 'string'},
+                        'initial_delay_seconds': {'type': 'number'},
+                        'timeout_seconds': {'type': 'number'},
+                        'post_data': {},
+                    },
+                },
+            ]
+        },
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer'},
+                'max_replicas': {'type': 'integer'},
+                'target_qps_per_replica': {'type': 'number'},
+                'upscale_delay_seconds': {'type': 'number'},
+                'downscale_delay_seconds': {'type': 'number'},
+                'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'spot_placer': {'type': 'string'},
+            },
+        },
+        'replicas': {'type': 'integer'},
+        'load_balancing_policy': {'type': 'string'},
+    },
+}
+
+TASK_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'workdir': {'type': 'string'},
+        'setup': {'type': 'string'},
+        'run': {'type': 'string'},
+        'envs': {
+            'type': 'object',
+            'additionalProperties': {
+                'anyOf': [{'type': 'string'}, {'type': 'number'},
+                          {'type': 'null'}]
+            },
+        },
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'resources': _RESOURCES_SCHEMA,
+        'file_mounts': {'type': 'object'},
+        'storage_mounts': {'type': 'object'},
+        'service': _SERVICE_SCHEMA,
+    },
+}
+
+CONFIG_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': True,
+    'properties': {
+        'jobs': {
+            'type': 'object',
+            'properties': {
+                'controller': {'type': 'object'},
+            },
+        },
+        'gcp': {
+            'type': 'object',
+            'properties': {
+                'project_id': {'type': 'string'},
+            },
+        },
+        'api_server': {
+            'type': 'object',
+            'properties': {
+                'endpoint': {'type': 'string'},
+            },
+        },
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+    },
+}
+
+
+def validate(config: Dict[str, Any], schema: Dict[str, Any],
+             what: str = 'task') -> None:
+    try:
+        jsonschema.validate(instance=config, schema=schema)
+    except jsonschema.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidTaskError(
+            f'Invalid {what} YAML at {path}: {e.message}') from None
+
+
+def validate_task(config: Dict[str, Any]) -> None:
+    validate(config, TASK_SCHEMA, 'task')
+
+
+def validate_config(config: Dict[str, Any]) -> None:
+    validate(config, CONFIG_SCHEMA, 'config')

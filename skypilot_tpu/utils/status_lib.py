@@ -1,0 +1,130 @@
+"""Status enums shared across layers.
+
+Mirrors the state machines of the reference (cluster status
+``sky/utils/status_lib.py``, job status ``sky/skylet/job_lib.py:121``,
+managed-job status ``sky/jobs/state.py:54``) with TPU-pod semantics:
+a pod slice is provisioned and fails as a unit, so there is no
+per-node partial-UP state.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Cluster lifecycle: INIT -> UP -> STOPPED -> (terminated: row removed)."""
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+    def colored_str(self) -> str:
+        color = {'INIT': 'yellow', 'UP': 'green', 'STOPPED': 'cyan'}[self.value]
+        return f'[{color}]{self.value}[/{color}]'
+
+
+class JobStatus(enum.Enum):
+    """Per-cluster job lifecycle (agent job table).
+
+    INIT -> PENDING -> SETTING_UP -> RUNNING -> terminal.
+    """
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_JOB_STATUSES
+
+    @classmethod
+    def nonterminal_statuses(cls):
+        return [s for s in cls if not s.is_terminal()]
+
+
+_TERMINAL_JOB_STATUSES = frozenset({
+    JobStatus.SUCCEEDED,
+    JobStatus.FAILED,
+    JobStatus.FAILED_SETUP,
+    JobStatus.CANCELLED,
+})
+
+
+class ManagedJobStatus(enum.Enum):
+    """Managed (auto-recovering) job lifecycle, controller-side.
+
+    Mirrors reference sky/jobs/state.py:54 & sky/jobs/README.md:30-60:
+    PENDING -> SUBMITTED -> STARTING -> RUNNING -> {SUCCEEDED, ...};
+    RUNNING -> RECOVERING -> RUNNING on preemption.
+    """
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_MANAGED_STATUSES
+
+    def is_failed(self) -> bool:
+        return self in {
+            ManagedJobStatus.FAILED,
+            ManagedJobStatus.FAILED_SETUP,
+            ManagedJobStatus.FAILED_PRECHECKS,
+            ManagedJobStatus.FAILED_NO_RESOURCE,
+            ManagedJobStatus.FAILED_CONTROLLER,
+        }
+
+
+_TERMINAL_MANAGED_STATUSES = frozenset({
+    ManagedJobStatus.SUCCEEDED,
+    ManagedJobStatus.CANCELLED,
+    ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS,
+    ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+})
+
+
+class ReplicaStatus(enum.Enum):
+    """Serve replica lifecycle (reference sky/serve/serve_state.py)."""
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
+    FAILED_PROBING = 'FAILED_PROBING'
+    FAILED_PROVISION = 'FAILED_PROVISION'
+    PREEMPTED = 'PREEMPTED'
+
+    def is_failed(self) -> bool:
+        return self.value.startswith('FAILED')
+
+    @classmethod
+    def terminal_statuses(cls):
+        return [s for s in cls if s.is_failed() or s is cls.SHUTTING_DOWN]
+
+
+class ServiceStatus(enum.Enum):
+    """Serve service lifecycle."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    CONTROLLER_FAILED = 'CONTROLLER_FAILED'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    NO_REPLICA = 'NO_REPLICA'

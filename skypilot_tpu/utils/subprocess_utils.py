@@ -1,0 +1,192 @@
+"""Subprocess helpers: parallel fan-out, process-tree kill, streaming run.
+
+Re-design of the reference's ``sky/utils/subprocess_utils.py`` and parts of
+``sky/skylet/log_lib.py:138`` — a single place for: running a command with
+its output teed to a log file, killing a process tree (needed when
+cancelling a gang job so every rank's process group dies), and running a
+function over many hosts in parallel (the SSH fan-out used for TPU pod
+slices, where one logical node has many worker hosts).
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, IO, List, Optional, Sequence, Tuple, Union
+
+import psutil
+
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def get_parallel_threads(num_tasks: int) -> int:
+    cpus = os.cpu_count() or 4
+    return max(1, min(num_tasks, cpus * 4))
+
+
+def run_in_parallel(fn: Callable[..., Any],
+                    args_list: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Run fn over args_list in a thread pool; preserves order.
+
+    Exceptions propagate (first one raised). Used for per-host operations
+    on a pod slice: rsync, setup, gang start.
+    """
+    if not args_list:
+        return []
+    if len(args_list) == 1:
+        return [fn(args_list[0])]
+    n = num_threads or get_parallel_threads(len(args_list))
+    with ThreadPoolExecutor(max_workers=n) as pool:
+        return list(pool.map(fn, args_list))
+
+
+def kill_process_tree(pid: int, include_parent: bool = True) -> None:
+    """SIGTERM then SIGKILL a whole process tree rooted at pid."""
+    try:
+        root = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = root.children(recursive=True)
+    if include_parent:
+        procs.append(root)
+    for p in procs:
+        try:
+            p.terminate()
+        except psutil.NoSuchProcess:
+            pass
+    _, alive = psutil.wait_procs(procs, timeout=3)
+    for p in alive:
+        try:
+            p.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def kill_children_processes() -> None:
+    kill_process_tree(os.getpid(), include_parent=False)
+
+
+def run(cmd: Union[str, List[str]],
+        *,
+        shell: Optional[bool] = None,
+        check: bool = True,
+        capture: bool = True,
+        env: Optional[dict] = None,
+        cwd: Optional[str] = None,
+        timeout: Optional[float] = None) -> subprocess.CompletedProcess:
+    """Thin wrapper over subprocess.run with sane defaults."""
+    if shell is None:
+        shell = isinstance(cmd, str)
+    return subprocess.run(
+        cmd,
+        shell=shell,
+        check=check,
+        capture_output=capture,
+        text=True,
+        env=env,
+        cwd=cwd,
+        timeout=timeout,
+    )
+
+
+def run_with_log(cmd: Union[str, List[str]],
+                 log_path: str,
+                 *,
+                 stream_logs: bool = False,
+                 env: Optional[dict] = None,
+                 cwd: Optional[str] = None,
+                 shell: Optional[bool] = None,
+                 line_processor: Optional[Callable[[str], None]] = None,
+                 start_new_session: bool = True) -> int:
+    """Run cmd, teeing combined stdout/stderr to log_path.
+
+    Equivalent of reference sky/skylet/log_lib.py:138 `run_with_log`.
+    Returns the exit code. The child is started in its own session so a
+    cancel can kill the entire process group.
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    if shell is None:
+        shell = isinstance(cmd, str)
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(
+            cmd,
+            shell=shell,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            bufsize=1,
+            env=env,
+            cwd=cwd,
+            start_new_session=start_new_session,
+        )
+        assert proc.stdout is not None
+        try:
+            for line in proc.stdout:
+                log_file.write(line)
+                log_file.flush()
+                if stream_logs:
+                    print(line, end='', flush=True)
+                if line_processor is not None:
+                    line_processor(line)
+        finally:
+            proc.stdout.close()
+        return proc.wait()
+
+
+def command_with_rc_and_output(cmd: str) -> Tuple[int, str, str]:
+    proc = subprocess.run(cmd,
+                          shell=True,
+                          capture_output=True,
+                          text=True,
+                          check=False)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def quote(s: str) -> str:
+    return shlex.quote(s)
+
+
+def daemonize(argv: List[str],
+              log_path: str,
+              env: Optional[dict] = None,
+              cwd: Optional[str] = None) -> int:
+    """Start argv fully detached (own session, output to log file).
+
+    Used for the per-cluster agent daemon and detached job drivers —
+    the equivalent of the reference's `nohup python -m sky.skylet.skylet`
+    (sky/provision/instance_setup.py:467).
+    Returns the child PID.
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(
+            argv,
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,
+            env=env,
+            cwd=cwd,
+        )
+    return proc.pid
+
+
+def wait_for(predicate: Callable[[], bool],
+             timeout: float,
+             interval: float = 0.2,
+             desc: str = 'condition') -> None:
+    """Poll predicate until true or raise TimeoutError."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f'Timed out after {timeout}s waiting for {desc}')

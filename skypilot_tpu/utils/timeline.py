@@ -1,0 +1,98 @@
+"""Chrome-trace timeline tracing.
+
+Re-design of reference ``sky/utils/timeline.py:22-121``: an
+``@timeline.event`` decorator and ``Event`` context manager that append
+Chrome trace events (phase B/E) to the file named by
+``SKYTPU_TIMELINE_FILE_PATH``. Zero overhead when the env var is unset.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+_ENV = 'SKYTPU_TIMELINE_FILE_PATH'
+_events: List[dict] = []
+_lock = threading.Lock()
+_save_registered = False
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(_ENV))
+
+
+class Event:
+    """Context manager emitting a begin/end trace-event pair."""
+
+    def __init__(self, name: str, message: Optional[str] = None) -> None:
+        self._name = name
+        self._message = message
+
+    def begin(self) -> None:
+        if not enabled():
+            return
+        self._record('B')
+
+    def end(self) -> None:
+        if not enabled():
+            return
+        self._record('E')
+
+    def _record(self, phase: str) -> None:
+        global _save_registered
+        event = {
+            'name': self._name,
+            'cat': 'skypilot_tpu',
+            'ph': phase,
+            'pid': str(os.getpid()),
+            'tid': str(threading.get_ident()),
+            'ts': f'{time.time() * 10 ** 6: .3f}',
+        }
+        if self._message is not None:
+            event['args'] = {'message': self._message}
+        with _lock:
+            _events.append(event)
+            if not _save_registered:
+                atexit.register(save_timeline)
+                _save_registered = True
+
+    def __enter__(self) -> 'Event':
+        self.begin()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.end()
+
+
+def event(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
+    """Decorator tracing a function call as a timeline event."""
+    if fn is None:
+        return functools.partial(event, name=name)
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any):
+        event_name = name or getattr(fn, '__qualname__', fn.__name__)
+        with Event(name=f'[event] {event_name}'):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def save_timeline() -> None:
+    path = os.environ.get(_ENV)
+    if not path or not _events:
+        return
+    with _lock:
+        payload = {
+            'traceEvents': list(_events),
+            'displayTimeUnit': 'ms',
+            'otherData': {'pid': os.getpid()},
+        }
+        _events.clear()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)

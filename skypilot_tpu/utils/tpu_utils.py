@@ -1,0 +1,225 @@
+"""TPU pod-slice topology math — the heart of TPU-first Resources.
+
+The reference treats TPUs as a GCP special case bolted onto a GPU-shaped
+``accelerators`` dict (sky/resources.py:563 `_set_accelerators`,
+sky/clouds/gcp.py:473-497). Here slice topology is a first-class concept:
+an accelerator name like ``tpu-v5e-16`` deterministically yields chip
+count, host count, chips/host, ICI topology, per-chip HBM and peak
+bf16 FLOPs — all of which feed the optimizer (pricing is per chip-hour),
+the provisioner (one slice = N hosts gang-provisioned atomically) and the
+recipes (mesh shape from topology without querying the cloud).
+
+Public per-generation facts (cloud.google.com/tpu/docs):
+  generation  chips/host  cores/chip  HBM GiB/chip  bf16 TFLOP/s/chip
+  v2          4           2           8             45
+  v3          4           2           16            123
+  v4          4           2           32            275
+  v5e         8 (<=8) /4  1           16            197
+  v5p         4           2           95            459
+  v6e         8 (<=8) /4  1           32            918
+For v2/v3/v4/v5p the trailing number in the accelerator name counts
+TensorCores; for v5e/v6e it counts chips.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+# generation -> (cores_per_chip, default_chips_per_host, hbm_gib_per_chip,
+#                bf16_tflops_per_chip, ici_dims)
+_GEN_INFO: Dict[str, Tuple[int, int, float, float, int]] = {
+    'v2': (2, 4, 8, 45.0, 2),
+    'v3': (2, 4, 16, 123.0, 2),
+    'v4': (2, 4, 32, 275.0, 3),
+    'v5e': (1, 4, 16, 197.0, 2),
+    'v5p': (2, 4, 95, 459.0, 3),
+    'v6e': (1, 4, 32, 918.0, 2),
+}
+
+# Accelerator-name aliases (reference catalog uses `tpu-v5litepod-N`).
+_GEN_ALIASES = {'v5litepod': 'v5e', 'v5lite': 'v5e'}
+
+_NAME_RE = re.compile(r'^tpu-(v\d+[a-z]*)-(\d+)$')
+
+# 2D slice topologies for v2/v3/v5e/v6e by chip count (public shapes).
+_TOPO_2D: Dict[int, str] = {
+    1: '1x1',
+    4: '2x2',
+    8: '2x4',
+    16: '4x4',
+    32: '4x8',
+    64: '8x8',
+    128: '8x16',
+    256: '16x16',
+    512: '16x32',
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSlice:
+    """Static description of one TPU pod slice."""
+    name: str            # canonical accelerator name, e.g. 'tpu-v5e-16'
+    generation: str      # 'v5e'
+    num_chips: int
+    num_hosts: int
+    chips_per_host: int
+    cores_per_chip: int
+    topology: str        # ICI topology, e.g. '4x4' or '2x2x2'
+    hbm_gib_per_chip: float
+    bf16_tflops_per_chip: float
+
+    @property
+    def is_pod(self) -> bool:
+        """Multi-host slice (requires gang fan-out)."""
+        return self.num_hosts > 1
+
+    @property
+    def total_hbm_gib(self) -> float:
+        return self.hbm_gib_per_chip * self.num_chips
+
+    @property
+    def total_bf16_tflops(self) -> float:
+        return self.bf16_tflops_per_chip * self.num_chips
+
+    @property
+    def mesh_shape(self) -> Tuple[int, ...]:
+        return tuple(int(x) for x in self.topology.split('x'))
+
+    @property
+    def runtime_version(self) -> str:
+        """Default TPU-VM runtime image for this generation."""
+        return {
+            'v2': 'tpu-ubuntu2204-base',
+            'v3': 'tpu-ubuntu2204-base',
+            'v4': 'tpu-ubuntu2204-base',
+            'v5e': 'v2-alpha-tpuv5-lite',
+            'v5p': 'v2-alpha-tpuv5',
+            'v6e': 'v2-alpha-tpuv6e',
+        }[self.generation]
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """Name used by tpu.googleapis.com, e.g. 'v5litepod-16'."""
+        gen = 'v5litepod' if self.generation == 'v5e' else self.generation
+        if self.generation in ('v5e', 'v6e'):
+            return f'{gen}-{self.num_chips}'
+        return f'{gen}-{self.num_chips * self.cores_per_chip}'
+
+
+def _topology_3d(num_chips: int) -> str:
+    """Smallest-surface 3D torus factorization (v4/v5p slices)."""
+    best: Optional[Tuple[int, int, int]] = None
+    for x in range(1, num_chips + 1):
+        if num_chips % x:
+            continue
+        rest = num_chips // x
+        for y in range(x, rest + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            if z < y:
+                continue
+            dims = (x, y, z)
+            if best is None or max(dims) < max(best):
+                best = dims
+    assert best is not None
+    return 'x'.join(str(d) for d in best)
+
+
+def is_tpu_name(accelerator_name: str) -> bool:
+    name = accelerator_name.lower()
+    return bool(_NAME_RE.match(name)) or name.startswith('tpu-')
+
+
+def parse(accelerator_name: str) -> TpuSlice:
+    """Parse 'tpu-<gen>-<N>' into a TpuSlice.
+
+    Raises InvalidResourcesError for unknown generations or invalid sizes.
+    """
+    name = accelerator_name.lower()
+    m = _NAME_RE.match(name)
+    if m is None:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid TPU accelerator name {accelerator_name!r}; expected '
+            "'tpu-<generation>-<size>', e.g. 'tpu-v5e-16'.")
+    gen, size_s = m.group(1), m.group(2)
+    gen = _GEN_ALIASES.get(gen, gen)
+    if gen not in _GEN_INFO:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown TPU generation {gen!r} in {accelerator_name!r}. '
+            f'Known: {sorted(_GEN_INFO)}')
+    size = int(size_s)
+    cores_per_chip, chips_per_host, hbm, tflops, ici_dims = _GEN_INFO[gen]
+
+    if gen in ('v5e', 'v6e'):
+        num_chips = size
+    else:
+        if size % cores_per_chip:
+            raise exceptions.InvalidResourcesError(
+                f'{accelerator_name}: size counts TensorCores for {gen} and '
+                f'must be a multiple of {cores_per_chip}.')
+        num_chips = size // cores_per_chip
+
+    if gen in ('v5e', 'v6e'):
+        # Single-host slices pack up to 8 chips on one host; multi-host
+        # slices use 4-chip hosts (GCP ct5lp/ct6e machine shapes).
+        if num_chips <= 8:
+            num_hosts = 1
+            chips_per_host = num_chips
+        else:
+            chips_per_host = 4
+            num_hosts = num_chips // chips_per_host
+        if num_chips not in _TOPO_2D:
+            raise exceptions.InvalidResourcesError(
+                f'{accelerator_name}: unsupported slice size {num_chips}; '
+                f'valid chip counts: {sorted(_TOPO_2D)}')
+        topology = _TOPO_2D[num_chips]
+    elif ici_dims == 2:  # v2/v3
+        num_hosts = max(1, num_chips // chips_per_host)
+        chips_per_host = min(chips_per_host, num_chips)
+        if num_chips not in _TOPO_2D:
+            raise exceptions.InvalidResourcesError(
+                f'{accelerator_name}: unsupported slice size.')
+        topology = _TOPO_2D[num_chips]
+    else:  # v4/v5p: 3D torus, 4-chip hosts
+        num_hosts = max(1, num_chips // chips_per_host)
+        chips_per_host = min(chips_per_host, num_chips)
+        topology = _topology_3d(num_chips)
+
+    return TpuSlice(
+        name=f'tpu-{gen}-{size}',
+        generation=gen,
+        num_chips=num_chips,
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        cores_per_chip=cores_per_chip,
+        topology=topology,
+        hbm_gib_per_chip=hbm,
+        bf16_tflops_per_chip=tflops,
+    )
+
+
+def try_parse(accelerator_name: str) -> Optional[TpuSlice]:
+    try:
+        return parse(accelerator_name)
+    except exceptions.InvalidResourcesError:
+        return None
+
+
+def list_sizes(generation: str) -> List[str]:
+    """All supported accelerator names for a generation (catalog seed)."""
+    cores_per_chip = _GEN_INFO[generation][0]
+    names = []
+    for chips in sorted(_TOPO_2D):
+        if generation in ('v5e', 'v6e'):
+            names.append(f'tpu-{generation}-{chips}')
+        elif generation in ('v2', 'v3'):
+            if chips >= 4:
+                names.append(f'tpu-{generation}-{chips * cores_per_chip}')
+    if generation in ('v4', 'v5p'):
+        for chips in (4, 8, 16, 32, 64, 128, 256, 512, 1024):
+            names.append(f'tpu-{generation}-{chips * cores_per_chip}')
+    return names

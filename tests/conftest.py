@@ -1,0 +1,55 @@
+"""Shared fixtures.
+
+Modeled on the reference's hermetic strategy (SURVEY.md §4): an
+`enable_all_clouds` fixture fakes credential checks so optimizer/CLI
+paths run fully offline, and every test gets an isolated state DB.
+JAX tests run on a virtual 8-device CPU mesh.
+"""
+import os
+import sys
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def isolated_state(tmp_path, monkeypatch):
+    """Isolated sqlite state + config + home artifacts per test."""
+    monkeypatch.setenv('SKYTPU_STATE_DB', str(tmp_path / 'state.db'))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'nonexistent.yaml'))
+    monkeypatch.setenv('SKYTPU_USER_HASH', 'testhash')
+    monkeypatch.setenv('SKYTPU_DATA_DIR', str(tmp_path / 'skytpu_data'))
+    from skypilot_tpu import skypilot_config
+    skypilot_config.reload_config()
+    yield tmp_path
+
+
+@pytest.fixture
+def enable_all_clouds(monkeypatch):
+    """Make GCP + Local appear credentialed (reference
+    tests/common_test_fixtures.py:132-172)."""
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu.clouds import GCP, Local
+
+    monkeypatch.setattr(check_lib, 'get_cached_enabled_clouds',
+                        lambda *a, **k: [GCP(), Local()])
+    monkeypatch.setattr(GCP, 'check_credentials',
+                        lambda self: (True, None))
+    yield
+
+
+@pytest.fixture
+def local_cloud_only(monkeypatch):
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu.clouds import Local
+    monkeypatch.setattr(check_lib, 'get_cached_enabled_clouds',
+                        lambda *a, **k: [Local()])
+    yield

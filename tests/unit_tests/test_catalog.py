@@ -1,0 +1,49 @@
+"""Catalog lookups."""
+import pytest
+
+from skypilot_tpu import catalog
+
+
+def test_list_accelerators_filter():
+    accs = catalog.list_accelerators('v5e')
+    assert any('tpu-v5e-16' in name for name in accs)
+    assert all('v5e' in name for name in accs)
+
+
+def test_tpu_offerings_sorted_by_price():
+    offerings = catalog.get_tpu_offerings('tpu-v6e-16')
+    assert offerings
+    prices = [o.price_per_chip_hour for o in offerings]
+    assert prices == sorted(prices)
+    assert all(o.num_hosts == 4 for o in offerings)
+
+
+def test_tpu_cost_spot_cheaper():
+    on_demand = catalog.get_tpu_hourly_cost('tpu-v5e-16')
+    spot = catalog.get_tpu_hourly_cost('tpu-v5e-16', use_spot=True)
+    assert spot < on_demand
+    # price scales with chips
+    assert catalog.get_tpu_hourly_cost('tpu-v5e-32') == pytest.approx(
+        2 * on_demand)
+
+
+def test_default_instance_type():
+    t = catalog.get_default_instance_type()
+    assert t is not None
+    vcpus, mem = catalog.get_vcpus_mem_from_instance_type(t)
+    assert vcpus >= 8
+
+    t4 = catalog.get_default_instance_type(cpus='4')
+    vcpus, _ = catalog.get_vcpus_mem_from_instance_type(t4)
+    assert vcpus == 4
+
+
+def test_validate_region_zone():
+    catalog.validate_region_zone('us-central1', 'us-central1-a')
+    with pytest.raises(Exception):
+        catalog.validate_region_zone('us-central1', 'us-east1-b')
+
+
+def test_regions_with_tpu():
+    regions = catalog.regions_with_tpu('tpu-v4-8')
+    assert regions == ['us-central2']

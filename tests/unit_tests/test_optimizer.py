@@ -1,0 +1,104 @@
+"""Optimizer candidate generation, DP chain, ILP DAG."""
+import pytest
+
+from skypilot_tpu import Dag
+from skypilot_tpu import Optimizer
+from skypilot_tpu import OptimizeTarget
+from skypilot_tpu import Resources
+from skypilot_tpu import Task
+from skypilot_tpu import exceptions
+
+
+@pytest.fixture(autouse=True)
+def _clouds(enable_all_clouds):
+    yield
+
+
+def _single_task_dag(resources) -> Dag:
+    with Dag() as dag:
+        task = Task('t', run='true')
+        task.set_resources(resources)
+    return dag
+
+
+def test_picks_cheapest_zone():
+    dag = _single_task_dag({Resources(accelerators='tpu-v6e-8')})
+    Optimizer.optimize(dag, quiet=True)
+    best = dag.tasks[0].best_resources
+    assert best is not None and best.is_launchable()
+    # us regions are cheapest in the catalog snapshot.
+    assert best.region.startswith('us-')
+
+
+def test_any_of_prefers_cheaper_generation():
+    dag = _single_task_dag({
+        Resources(accelerators='tpu-v5e-8'),
+        Resources(accelerators='tpu-v5p-8'),
+    })
+    Optimizer.optimize(dag, quiet=True)
+    best = dag.tasks[0].best_resources
+    # v5e-8 ($9.6/h) beats v5p-8 (4 chips * $4.2 = $16.8/h).
+    assert best.tpu.generation == 'v5e'
+
+
+def test_time_target_prefers_bigger_slice():
+    t = Task('t', run='true')
+    t.estimate_runtime = 3600.0  # seconds on 8 chips
+    with Dag() as dag:
+        pass
+    dag.add(t)
+    t.set_resources({
+        Resources(accelerators='tpu-v5e-8'),
+        Resources(accelerators='tpu-v5e-32'),
+    })
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert t.best_resources.tpu.num_chips == 32
+    Optimizer.optimize(dag, minimize=OptimizeTarget.COST, quiet=True)
+    assert t.best_resources.tpu.num_chips == 8
+
+
+def test_infeasible_raises():
+    dag = _single_task_dag(
+        {Resources(cloud='gcp', accelerators='tpu-v4-8',
+                   region='us-central1')})  # v4 only in us-central2
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Optimizer.optimize(dag, quiet=True)
+
+
+def test_chain_dp():
+    with Dag() as dag:
+        a = Task('a', run='true')
+        b = Task('b', run='true')
+        a >> b
+    a.set_resources({Resources(accelerators='tpu-v5e-8')})
+    b.set_resources({Resources(cpus='4')})
+    Optimizer.optimize(dag, quiet=True)
+    assert a.best_resources.is_tpu
+    assert b.best_resources.instance_type is not None
+
+
+def test_general_dag_ilp():
+    with Dag() as dag:
+        a = Task('a', run='true')
+        b = Task('b', run='true')
+        c = Task('c', run='true')
+        d = Task('d', run='true')
+        dag.add_edge(a, b)
+        dag.add_edge(a, c)
+        dag.add_edge(b, d)
+        dag.add_edge(c, d)
+    for t in (a, b, c, d):
+        t.set_resources({Resources(cpus='2+')})
+    assert not dag.is_chain()
+    Optimizer.optimize(dag, quiet=True)
+    for t in (a, b, c, d):
+        assert t.best_resources is not None
+
+
+def test_blocked_resources_respected():
+    dag = _single_task_dag({Resources(accelerators='tpu-v6e-8')})
+    # Block every launchable; expect failure.
+    from skypilot_tpu.optimizer import _fill_in_launchable_resources
+    all_candidates = _fill_in_launchable_resources(dag.tasks[0])
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        Optimizer.optimize(dag, blocked_resources=all_candidates, quiet=True)

@@ -1,0 +1,92 @@
+"""Resources spec parsing, pricing, comparison."""
+import pytest
+
+from skypilot_tpu import Resources
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import GCP
+
+
+def test_tpu_accelerator_string():
+    r = Resources(accelerators='tpu-v5e-16')
+    assert r.is_tpu
+    assert r.tpu.num_hosts == 4
+    assert r.num_hosts == 4
+    assert r.accelerators == {'tpu-v5e-16': 1}
+
+
+def test_tpu_accelerator_dict():
+    r = Resources(accelerators={'tpu-v5p-8': 1})
+    assert r.is_tpu and r.tpu.generation == 'v5p'
+
+
+def test_tpu_count_not_allowed():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators={'tpu-v5e-8': 2})
+
+
+def test_tpu_with_instance_type_conflicts():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators='tpu-v5e-8', instance_type='n2-standard-8')
+
+
+def test_cloud_string_resolution():
+    r = Resources(cloud='gcp')
+    assert isinstance(r.cloud, GCP)
+
+
+def test_pricing_tpu():
+    r = Resources(cloud='gcp', accelerators='tpu-v5e-8')
+    price = r.hourly_price()
+    assert price == pytest.approx(8 * 1.20, rel=0.2)
+    spot = Resources(cloud='gcp', accelerators='tpu-v5e-8', use_spot=True)
+    assert spot.hourly_price() < price
+
+
+def test_pricing_region_sensitivity():
+    us = Resources(cloud='gcp', accelerators='tpu-v6e-8',
+                   region='us-east5').hourly_price()
+    eu = Resources(cloud='gcp', accelerators='tpu-v6e-8',
+                   region='europe-west4').hourly_price()
+    assert eu > us
+
+
+def test_yaml_roundtrip():
+    r = Resources(cloud='gcp', accelerators='tpu-v5e-16', use_spot=True,
+                  region='us-west4', disk_size=100,
+                  labels={'team': 'ml'})
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r == r2
+
+
+def test_any_of():
+    out = Resources.from_yaml_config({
+        'use_spot': True,
+        'any_of': [
+            {'accelerators': 'tpu-v5e-16'},
+            {'accelerators': 'tpu-v6e-16'},
+        ],
+    })
+    assert isinstance(out, list) and len(out) == 2
+    assert all(r.use_spot for r in out)
+
+
+def test_less_demanding_than():
+    want = Resources(accelerators='tpu-v5e-8')
+    have = Resources(cloud='gcp', accelerators='tpu-v5e-8',
+                     region='us-west4', zone='us-west4-a')
+    assert want.less_demanding_than(have)
+    bigger = Resources(accelerators='tpu-v5e-16')
+    assert not bigger.less_demanding_than(have)
+
+
+def test_invalid_region():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(cloud='gcp', region='mars-central1')
+
+
+def test_copy_override():
+    r = Resources(accelerators='tpu-v5e-8')
+    r2 = r.copy(use_spot=True, region='us-west4')
+    assert r2.use_spot and r2.region == 'us-west4'
+    assert r2.tpu.name == 'tpu-v5e-8'
+    assert not r.use_spot

@@ -1,0 +1,68 @@
+"""Global user state DB and layered config."""
+from skypilot_tpu import global_user_state
+from skypilot_tpu import skypilot_config
+from skypilot_tpu.utils import status_lib
+
+
+class FakeHandle:
+    def __init__(self, name):
+        self.cluster_name = name
+        self.launched_nodes = 1
+        self.launched_resources = None
+
+
+def test_cluster_crud():
+    h = FakeHandle('c1')
+    global_user_state.add_or_update_cluster('c1', h, requested_resources=set())
+    rec = global_user_state.get_cluster_from_name('c1')
+    assert rec is not None
+    assert rec['status'] == status_lib.ClusterStatus.INIT
+    assert rec['handle'].cluster_name == 'c1'
+
+    global_user_state.update_cluster_status(
+        'c1', status_lib.ClusterStatus.UP)
+    assert (global_user_state.get_cluster_from_name('c1')['status'] ==
+            status_lib.ClusterStatus.UP)
+
+    global_user_state.set_cluster_autostop_value('c1', 10, to_down=True)
+    rec = global_user_state.get_cluster_from_name('c1')
+    assert rec['autostop'] == 10 and rec['to_down']
+
+    # Stop keeps the row; terminate removes it.
+    global_user_state.remove_cluster('c1', terminate=False)
+    assert (global_user_state.get_cluster_from_name('c1')['status'] ==
+            status_lib.ClusterStatus.STOPPED)
+    global_user_state.remove_cluster('c1', terminate=True)
+    assert global_user_state.get_cluster_from_name('c1') is None
+    # History survives termination.
+    assert any(r['name'] == 'c1'
+               for r in global_user_state.get_cluster_history())
+
+
+def test_autostop_preserved_across_update():
+    h = FakeHandle('c2')
+    global_user_state.add_or_update_cluster('c2', h)
+    global_user_state.set_cluster_autostop_value('c2', 30, to_down=False)
+    global_user_state.add_or_update_cluster('c2', h, ready=True)
+    rec = global_user_state.get_cluster_from_name('c2')
+    assert rec['autostop'] == 30
+
+
+def test_config_kv():
+    global_user_state.set_config_value('k', ['a', 'b'])
+    assert global_user_state.get_config_value('k') == ['a', 'b']
+    assert global_user_state.get_config_value('missing') is None
+
+
+def test_config_nested_and_override(tmp_path, monkeypatch):
+    cfg = tmp_path / 'config.yaml'
+    cfg.write_text('gcp:\n  project_id: proj-1\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(cfg))
+    skypilot_config.reload_config()
+    assert skypilot_config.get_nested(('gcp', 'project_id')) == 'proj-1'
+    assert skypilot_config.get_nested('gcp.project_id') == 'proj-1'
+    assert skypilot_config.get_nested(('gcp', 'zone'), 'default') == 'default'
+
+    with skypilot_config.override_config({'gcp': {'project_id': 'proj-2'}}):
+        assert skypilot_config.get_nested(('gcp', 'project_id')) == 'proj-2'
+    assert skypilot_config.get_nested(('gcp', 'project_id')) == 'proj-1'

@@ -1,0 +1,78 @@
+"""Task YAML parsing and Dag wiring."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag
+from skypilot_tpu import Task
+from skypilot_tpu import exceptions
+
+
+def test_task_from_yaml(tmp_path):
+    yaml_path = tmp_path / 'task.yaml'
+    yaml_path.write_text(
+        textwrap.dedent("""\
+            name: train
+            resources:
+              accelerators: tpu-v5e-16
+              use_spot: true
+            num_nodes: 1
+            setup: pip list
+            run: |
+              python train.py
+            envs:
+              MODEL: llama3
+            """))
+    task = Task.from_yaml(str(yaml_path))
+    assert task.name == 'train'
+    assert task.num_nodes == 1
+    r = next(iter(task.resources))
+    assert r.tpu.name == 'tpu-v5e-16'
+    assert r.use_spot
+    assert task.envs['MODEL'] == 'llama3'
+    # Round trip.
+    task2 = Task.from_yaml_config(task.to_yaml_config())
+    assert task2.to_yaml_config() == task.to_yaml_config()
+
+
+def test_task_yaml_unknown_field(tmp_path):
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({'nonexistent_field': 1})
+
+
+def test_null_env_requires_value():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({'run': 'echo hi', 'envs': {'TOKEN': None}})
+    # Providing it via overrides works.
+    t = Task.from_yaml_config({'run': 'echo hi', 'envs': {'TOKEN': None}},
+                              env_overrides={'TOKEN': 'abc'})
+    assert t.envs['TOKEN'] == 'abc'
+
+
+def test_dag_context_and_chain():
+    with Dag() as dag:
+        a = Task('a', run='echo a')
+        b = Task('b', run='echo b')
+        a >> b
+    assert len(dag) == 2
+    assert dag.is_chain()
+    assert dag.get_sorted_tasks() == [a, b]
+
+
+def test_dag_cycle_rejected():
+    with Dag() as dag:
+        a = Task('a', run='true')
+        b = Task('b', run='true')
+        dag.add_edge(a, b)
+        with pytest.raises(ValueError):
+            dag.add_edge(b, a)
+
+
+def test_invalid_num_nodes():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task(num_nodes=0)
+
+
+def test_callable_run():
+    t = Task(run=lambda rank, ips: f'echo rank {rank}')
+    assert callable(t.run)

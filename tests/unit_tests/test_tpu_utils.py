@@ -1,0 +1,71 @@
+"""TPU slice topology math."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import tpu_utils
+
+
+@pytest.mark.parametrize(
+    'name,chips,hosts,chips_per_host,topology',
+    [
+        ('tpu-v2-8', 4, 1, 4, '2x2'),
+        ('tpu-v3-32', 16, 4, 4, '4x4'),
+        ('tpu-v4-8', 4, 1, 4, '1x2x2'),
+        ('tpu-v5e-1', 1, 1, 1, '1x1'),
+        ('tpu-v5e-4', 4, 1, 4, '2x2'),
+        ('tpu-v5e-8', 8, 1, 8, '2x4'),
+        ('tpu-v5e-16', 16, 4, 4, '4x4'),
+        ('tpu-v5e-64', 64, 16, 4, '8x8'),
+        ('tpu-v5e-256', 256, 64, 4, '16x16'),
+        ('tpu-v5p-8', 4, 1, 4, '1x2x2'),
+        ('tpu-v5p-128', 64, 16, 4, '4x4x4'),
+        ('tpu-v6e-8', 8, 1, 8, '2x4'),
+        ('tpu-v6e-16', 16, 4, 4, '4x4'),
+    ])
+def test_parse(name, chips, hosts, chips_per_host, topology):
+    s = tpu_utils.parse(name)
+    assert s.num_chips == chips
+    assert s.num_hosts == hosts
+    assert s.chips_per_host == chips_per_host
+    assert s.topology == topology
+    assert s.num_hosts * s.chips_per_host == s.num_chips
+
+
+def test_aliases():
+    assert tpu_utils.parse('tpu-v5litepod-16').name == 'tpu-v5e-16'
+
+
+def test_pod_detection():
+    assert not tpu_utils.parse('tpu-v5e-8').is_pod
+    assert tpu_utils.parse('tpu-v5e-16').is_pod
+
+
+def test_mesh_shape_matches_chips():
+    for name in ('tpu-v5e-32', 'tpu-v5p-64', 'tpu-v6e-128'):
+        s = tpu_utils.parse(name)
+        prod = 1
+        for d in s.mesh_shape:
+            prod *= d
+        assert prod == s.num_chips, name
+
+
+def test_gcp_accelerator_type():
+    assert tpu_utils.parse('tpu-v5e-16').gcp_accelerator_type == (
+        'v5litepod-16')
+    assert tpu_utils.parse('tpu-v5p-8').gcp_accelerator_type == 'v5p-8'
+    assert tpu_utils.parse('tpu-v3-32').gcp_accelerator_type == 'v3-32'
+
+
+def test_invalid_names():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_utils.parse('tpu-v9z-8')
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_utils.parse('a100')
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_utils.parse('tpu-v5e-13')
+
+
+def test_flops_and_hbm():
+    s = tpu_utils.parse('tpu-v5e-8')
+    assert s.total_hbm_gib == 8 * 16
+    assert s.total_bf16_tflops == pytest.approx(8 * 197.0)
