@@ -9,66 +9,50 @@ failover, managed spot recovery, storage mounts, and serving.
 
 Re-design (not a port) of SkyPilot — see SURVEY.md for the mapping.
 """
-from skypilot_tpu.admin_policy import AdminPolicy
-from skypilot_tpu.dag import Dag
-from skypilot_tpu.exceptions import SkyTpuError
-from skypilot_tpu.optimizer import Optimizer
-from skypilot_tpu.optimizer import OptimizeTarget
-from skypilot_tpu.resources import Resources
-from skypilot_tpu.task import Task
-from skypilot_tpu.utils.tpu_utils import TpuSlice
-from skypilot_tpu.utils.tpu_utils import parse as parse_tpu
-
 __version__ = '0.1.0'
+
+# Everything is lazy (reference sky/__init__.py:94-116 uses the same
+# pattern): agent/driver subprocesses import subpackages of
+# skypilot_tpu hundreds of times per session, and must not pay for
+# optimizer/scipy/jsonschema imports they never use.
+_LAZY_ATTRS = {
+    'AdminPolicy': ('skypilot_tpu.admin_policy', 'AdminPolicy'),
+    'Dag': ('skypilot_tpu.dag', 'Dag'),
+    'SkyTpuError': ('skypilot_tpu.exceptions', 'SkyTpuError'),
+    'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
+    'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
+    'Resources': ('skypilot_tpu.resources', 'Resources'),
+    'Task': ('skypilot_tpu.task', 'Task'),
+    'TpuSlice': ('skypilot_tpu.utils.tpu_utils', 'TpuSlice'),
+    'parse_tpu': ('skypilot_tpu.utils.tpu_utils', 'parse'),
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec_'),
+    'status': ('skypilot_tpu.core', 'status'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'job_status': ('skypilot_tpu.core', 'job_status'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    'Storage': ('skypilot_tpu.data.storage', 'Storage'),
+}
 
 
 def __getattr__(name):
-    """Lazy accessors for the heavier layers (execution, core ops).
-
-    Keeps `import skypilot_tpu` fast and free of optional deps, like the
-    reference's lazy import structure (sky/__init__.py:94-116).
-    """
-    _lazy = {
-        'launch': ('skypilot_tpu.execution', 'launch'),
-        'exec': ('skypilot_tpu.execution', 'exec_'),
-        'status': ('skypilot_tpu.core', 'status'),
-        'stop': ('skypilot_tpu.core', 'stop'),
-        'start': ('skypilot_tpu.core', 'start'),
-        'down': ('skypilot_tpu.core', 'down'),
-        'autostop': ('skypilot_tpu.core', 'autostop'),
-        'queue': ('skypilot_tpu.core', 'queue'),
-        'cancel': ('skypilot_tpu.core', 'cancel'),
-        'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
-        'job_status': ('skypilot_tpu.core', 'job_status'),
-        'Storage': ('skypilot_tpu.data.storage', 'Storage'),
-    }
-    if name in _lazy:
+    if name in _LAZY_ATTRS:
         import importlib
-        module, attr = _lazy[name]
-        return getattr(importlib.import_module(module), attr)
+        module, attr = _LAZY_ATTRS[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache
+        return value
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
 
 
-__all__ = [
-    'AdminPolicy',
-    'Dag',
-    'Optimizer',
-    'OptimizeTarget',
-    'Resources',
-    'SkyTpuError',
-    'Task',
-    'TpuSlice',
-    'parse_tpu',
-    'launch',
-    'exec',
-    'status',
-    'stop',
-    'start',
-    'down',
-    'autostop',
-    'queue',
-    'cancel',
-    'tail_logs',
-    'job_status',
-    'Storage',
-]
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY_ATTRS))
+
+
+__all__ = list(_LAZY_ATTRS)

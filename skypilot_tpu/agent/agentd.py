@@ -1,0 +1,124 @@
+"""agentd — the per-cluster daemon (skylet equivalent).
+
+Re-design of reference ``sky/skylet/skylet.py:18-36`` +
+``sky/skylet/events.py``: an event loop on the head host ticking every
+EVENT_INTERVAL_SECONDS. Events:
+
+- JobSchedulerEvent: reconcile dead drivers, start next queued job.
+- AutostopEvent: if idle budget exceeded, stop/terminate the cluster
+  *from the cluster* through the provision layer (the Local provider
+  makes this testable hermetically; on GCP the agent uses the TPU/GCE
+  APIs with the cluster's service account).
+
+Run: ``python -m skypilot_tpu.agent.agentd --state-dir <dir>`` —
+daemonized by the backend at provision time.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Event:
+    interval_seconds: float = constants.EVENT_INTERVAL_SECONDS
+
+    def __init__(self, state_dir: str) -> None:
+        self.state_dir = state_dir
+        self._last = 0.0
+
+    def maybe_run(self) -> None:
+        now = time.time()
+        if now - self._last < self.interval_seconds:
+            return
+        self._last = now
+        try:
+            self.run()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('%s failed: %r', type(self).__name__, e)
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+
+class JobSchedulerEvent(Event):
+
+    def run(self) -> None:
+        job_lib.schedule_step(self.state_dir)
+
+
+class AutostopEvent(Event):
+
+    def run(self) -> None:
+        config = autostop_lib.get_autostop(self.state_dir)
+        if not config or config['idle_minutes'] < 0:
+            return
+        # Busy clusters are never stopped.
+        active = job_lib.get_jobs(self.state_dir,
+                                  job_lib.JobStatus.nonterminal_statuses())
+        if active:
+            autostop_lib.touch_activity(self.state_dir)
+            return
+        idle = autostop_lib.idle_seconds(self.state_dir)
+        if idle < config['idle_minutes'] * 60:
+            return
+        logger.info('Autostop: idle %.0fs >= %d min; %s cluster.', idle,
+                    config['idle_minutes'],
+                    'terminating' if config['down'] else 'stopping')
+        from skypilot_tpu import provision
+        if config['down']:
+            provision.terminate_instances(config['provider_name'],
+                                          config['cluster_name_on_cloud'],
+                                          config['region'], config['zone'])
+        else:
+            provision.stop_instances(config['provider_name'],
+                                     config['cluster_name_on_cloud'],
+                                     config['region'], config['zone'])
+        # Our cluster is gone (or stopped); this daemon's work is done.
+        # On real clouds the host dies with the instance; on the Local
+        # cloud we must exit explicitly. SystemExit bypasses the event
+        # loop's broad Exception handler.
+        raise SystemExit(0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--state-dir', default=constants.DEFAULT_STATE_DIR)
+    parser.add_argument('--interval', type=float,
+                        default=None, help='override event tick seconds')
+    args = parser.parse_args()
+    state_dir = os.path.expanduser(args.state_dir)
+    os.makedirs(state_dir, exist_ok=True)
+    with open(os.path.join(state_dir, constants.AGENT_PID_FILE), 'w',
+              encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+
+    events = [JobSchedulerEvent(state_dir), AutostopEvent(state_dir)]
+    if args.interval is not None:
+        for e in events:
+            e.interval_seconds = args.interval
+    logger.info('agentd started for %s (tick %.1fs)', state_dir,
+                events[0].interval_seconds)
+    hosts_path = os.path.join(state_dir, constants.HOSTS_FILE)
+    while True:
+        # hosts.json is written by the provisioner and never recreated
+        # here, so its absence reliably means the cluster was torn down
+        # (agentd's own startup may race teardown and re-mkdir the
+        # state dir — checking the dir alone is not enough).
+        if not os.path.exists(hosts_path):
+            logger.info('%s removed; agentd exiting.', hosts_path)
+            return
+        for event in events:
+            event.maybe_run()
+        time.sleep(min(e.interval_seconds for e in events) / 4)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,77 @@
+"""Autostop bookkeeping on the cluster.
+
+Re-design of reference ``sky/skylet/autostop_lib.py:55``: the client
+stores an idle budget (+ stop-vs-down flag) in the agent state dir; the
+agentd AutostopEvent compares it against the last-activity timestamp
+(touched by job drivers) and, when exceeded, tears the cluster down
+*from the cluster itself* via the cloud API.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.agent import constants
+
+AUTOSTOP_DISABLED = -1
+
+
+def _path(state_dir: str) -> str:
+    return os.path.join(os.path.expanduser(state_dir),
+                        constants.AUTOSTOP_FILE)
+
+
+def _activity_path(state_dir: str) -> str:
+    return os.path.join(os.path.expanduser(state_dir),
+                        constants.LAST_ACTIVITY_FILE)
+
+
+def set_autostop(state_dir: str, idle_minutes: int, down: bool,
+                 provider_name: str, cluster_name_on_cloud: str,
+                 region: str, zone: Optional[str]) -> None:
+    os.makedirs(os.path.expanduser(state_dir), exist_ok=True)
+    with open(_path(state_dir), 'w', encoding='utf-8') as f:
+        json.dump(
+            {
+                'idle_minutes': idle_minutes,
+                'down': down,
+                'provider_name': provider_name,
+                'cluster_name_on_cloud': cluster_name_on_cloud,
+                'region': region,
+                'zone': zone,
+                'set_at': time.time(),
+            }, f)
+    touch_activity(state_dir)
+
+
+def get_autostop(state_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_path(state_dir), encoding='utf-8') as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def touch_activity(state_dir: str) -> None:
+    os.makedirs(os.path.expanduser(state_dir), exist_ok=True)
+    with open(_activity_path(state_dir), 'w', encoding='utf-8') as f:
+        f.write(str(time.time()))
+
+
+def last_activity(state_dir: str) -> float:
+    try:
+        with open(_activity_path(state_dir), encoding='utf-8') as f:
+            return float(f.read().strip())
+    except (FileNotFoundError, ValueError):
+        return 0.0
+
+
+def idle_seconds(state_dir: str) -> float:
+    config = get_autostop(state_dir)
+    anchor = max(last_activity(state_dir),
+                 config['set_at'] if config else 0.0)
+    if anchor == 0.0:
+        return 0.0
+    return time.time() - anchor

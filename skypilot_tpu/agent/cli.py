@@ -1,0 +1,141 @@
+"""Agent CLI — the codegen target the client invokes on the head host.
+
+Replacement for the reference's `python -c <generated code>` pattern
+(JobLibCodeGen, sky/skylet/job_lib.py:930): the client runs
+``python -m skypilot_tpu.agent.cli <op> --state-dir ...`` over the
+cluster's command runner and parses one JSON document from stdout
+(between sentinel markers, so stray prints from login shells don't
+corrupt parsing).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+
+BEGIN = '<skytpu-agent-output>'
+END = '</skytpu-agent-output>'
+
+
+def emit(payload: Any) -> None:
+    print(BEGIN + json.dumps(payload) + END, flush=True)
+
+
+def parse_output(text: str) -> Any:
+    start = text.rfind(BEGIN)
+    end = text.rfind(END)
+    if start == -1 or end == -1 or end < start:
+        raise ValueError(f'No agent output found in: {text[-500:]!r}')
+    return json.loads(text[start + len(BEGIN):end])
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-agent')
+    parser.add_argument('--state-dir', default=constants.DEFAULT_STATE_DIR)
+    sub = parser.add_subparsers(dest='op', required=True)
+
+    p = sub.add_parser('add-job')
+    p.add_argument('--name', default=None)
+    p.add_argument('--username', required=True)
+    p.add_argument('--run-timestamp', required=True)
+    p.add_argument('--resources', default='')
+    p.add_argument('--spec-json', required=True,
+                   help='JobSpec as a JSON string')
+
+    p = sub.add_parser('queue-job')
+    p.add_argument('--job-id', type=int, required=True)
+
+    p = sub.add_parser('job-status')
+    p.add_argument('--job-ids', type=int, nargs='*', default=None)
+
+    sub.add_parser('queue')
+
+    p = sub.add_parser('cancel')
+    p.add_argument('--job-ids', type=int, nargs='*', default=None)
+
+    p = sub.add_parser('tail-logs')
+    p.add_argument('--job-id', type=int, default=None)
+    p.add_argument('--follow', action='store_true')
+    p.add_argument('--tail', type=int, default=0)
+
+    p = sub.add_parser('set-autostop')
+    p.add_argument('--idle-minutes', type=int, required=True)
+    p.add_argument('--down', action='store_true')
+    p.add_argument('--provider-name', required=True)
+    p.add_argument('--cluster-name-on-cloud', required=True)
+    p.add_argument('--region', required=True)
+    p.add_argument('--zone', default=None)
+
+    sub.add_parser('version')
+
+    args = parser.parse_args()
+    state_dir = os.path.expanduser(args.state_dir)
+
+    if args.op == 'add-job':
+        spec = json.loads(args.spec_json)
+        job_id = job_lib.add_job(state_dir, args.name, args.username,
+                                 args.run_timestamp, args.resources, spec)
+        emit({'job_id': job_id})
+    elif args.op == 'queue-job':
+        job_lib.queue_job(state_dir, args.job_id)
+        emit({'ok': True})
+    elif args.op == 'job-status':
+        job_lib.update_dead_drivers(state_dir)
+        if args.job_ids:
+            jobs = [job_lib.get_job(state_dir, j) for j in args.job_ids]
+        else:
+            jobs = job_lib.get_jobs(state_dir)[:1]
+        emit({
+            str(j['job_id']): j['status'].value
+            for j in jobs if j is not None
+        })
+    elif args.op == 'queue':
+        job_lib.update_dead_drivers(state_dir)
+        jobs = job_lib.get_jobs(state_dir)
+        emit([{
+            'job_id': j['job_id'],
+            'name': j['name'],
+            'username': j['username'],
+            'submitted_at': j['submitted_at'],
+            'status': j['status'].value,
+            'start_at': j['start_at'],
+            'end_at': j['end_at'],
+            'resources': j['resources'],
+        } for j in jobs])
+    elif args.op == 'cancel':
+        job_ids = args.job_ids
+        if not job_ids:
+            running = job_lib.get_jobs(
+                state_dir, [job_lib.JobStatus.SETTING_UP,
+                            job_lib.JobStatus.RUNNING,
+                            job_lib.JobStatus.PENDING])
+            job_ids = [j['job_id'] for j in running]
+        cancelled = [
+            j for j in job_ids if job_lib.cancel_job(state_dir, j)
+        ]
+        emit({'cancelled': cancelled})
+    elif args.op == 'tail-logs':
+        # Streams raw lines (not JSON): consumed with stream_logs=True.
+        for line in log_lib.tail_logs(state_dir, args.job_id,
+                                      follow=args.follow, tail=args.tail):
+            sys.stdout.write(line)
+            sys.stdout.flush()
+    elif args.op == 'set-autostop':
+        autostop_lib.set_autostop(state_dir, args.idle_minutes, args.down,
+                                  args.provider_name,
+                                  args.cluster_name_on_cloud, args.region,
+                                  args.zone)
+        emit({'ok': True})
+    elif args.op == 'version':
+        emit({'version': constants.AGENT_VERSION})
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,39 @@
+"""On-cluster agent constants.
+
+Counterpart of reference ``sky/skylet/constants.py``: runtime version
+gate (client refuses to talk to an older agent), canonical directory
+layout on every cluster host, and the env-var contract re-export.
+"""
+import os
+
+# Bumped whenever the client<->agent codegen protocol changes
+# (reference SKYLET_VERSION, sky/skylet/constants.py:92).
+AGENT_VERSION = 1
+
+# Directory on the head host holding all agent state for a cluster.
+# Local-cloud clusters override via --state-dir so many clusters can
+# coexist on one machine.
+DEFAULT_STATE_DIR = '~/.skytpu-agent'
+
+# Remote path of the synced workdir (reference SKY_REMOTE_WORKDIR).
+REMOTE_WORKDIR = '~/skytpu_workdir'
+
+HOSTS_FILE = 'hosts.json'
+JOBS_DB = 'jobs.db'
+AUTOSTOP_FILE = 'autostop.json'
+LAST_ACTIVITY_FILE = 'last_activity'
+AGENT_PID_FILE = 'agentd.pid'
+AGENT_LOG = 'agentd.log'
+
+# Seconds between agentd event ticks (reference
+# events.EVENT_CHECKING_INTERVAL_SECONDS = 20).
+EVENT_INTERVAL_SECONDS = float(os.environ.get(
+    'SKYTPU_AGENT_EVENT_INTERVAL', '20'))
+
+
+def jobs_dir(state_dir: str) -> str:
+    return os.path.join(state_dir, 'jobs')
+
+
+def job_dir(state_dir: str, job_id: int) -> str:
+    return os.path.join(jobs_dir(state_dir), str(job_id))

@@ -1,0 +1,174 @@
+"""Gang job driver — one detached process per job.
+
+TPU-native replacement for the reference's generated Ray driver program
+(RayCodeGen, sky/backends/cloud_vm_ray_backend.py:225-672). Where the
+reference builds a Ray placement group with STRICT_SPREAD bundles and
+wraps each rank in a `ray.remote` bash task, a TPU pod slice is already
+gang-provisioned — so the driver simply fans out over every host with a
+command runner, injects the rank/IP/topology env contract, streams
+per-rank output into rank files plus a merged run.log, and reduces the
+exit codes. Setup failure on any host -> FAILED_SETUP; any nonzero run
+rc -> FAILED; all zero -> SUCCEEDED.
+
+Runs on the head host (or locally for the Local cloud), spawned by
+job_lib.schedule_step.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import Dict, List
+
+from skypilot_tpu.agent import autostop_lib
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import env_contract
+from skypilot_tpu.utils import subprocess_utils
+
+JobStatus = job_lib.JobStatus
+
+
+def load_hosts(state_dir: str) -> List[Dict]:
+    path = os.path.join(state_dir, constants.HOSTS_FILE)
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+class _MergedLog:
+    """Thread-safe merged log with rank prefixes."""
+
+    def __init__(self, path: str, multi_rank: bool) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._f = open(path, 'a', buffering=1, encoding='utf-8')
+        self._lock = threading.Lock()
+        self._multi = multi_rank
+
+    def writer(self, rank: int):
+
+        def write(line: str) -> None:
+            with self._lock:
+                if self._multi:
+                    self._f.write(f'(rank {rank}) {line}')
+                else:
+                    self._f.write(line)
+
+        return write
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def _run_setup(state_dir: str, job_id: int, spec: Dict,
+               runners: List[runner_lib.CommandRunner]) -> bool:
+    setup = spec.get('setup')
+    if not setup:
+        return True
+    rcs = subprocess_utils.run_in_parallel(
+        lambda pair: pair[1].run(
+            setup,
+            env={**spec.get('env', {}), 'SKYTPU_SETUP_NODE_RANK':
+                 str(pair[0])},
+            log_path=log_lib.setup_log_path(state_dir, job_id, pair[0]),
+            cwd=_work_cwd(spec, pair[1])),
+        list(enumerate(runners)))
+    return all(rc == 0 for rc in rcs)
+
+
+def _work_cwd(spec: Dict, runner: runner_lib.CommandRunner):
+    if not spec.get('has_workdir'):
+        return None
+    if isinstance(runner, runner_lib.LocalProcessRunner):
+        return runner.translate(constants.REMOTE_WORKDIR)
+    return constants.REMOTE_WORKDIR
+
+
+def _run_ranks(state_dir: str, job_id: int, spec: Dict,
+               runners: List[runner_lib.CommandRunner]) -> List[int]:
+    num_ranks = len(runners)
+    ips = spec.get('ips') or [r.ip for r in runners]
+    run_commands: List[str] = spec['run_commands']
+    merged = _MergedLog(log_lib.run_log_path(state_dir, job_id),
+                        multi_rank=num_ranks > 1)
+    rcs: List[int] = [0] * num_ranks
+
+    def run_one(rank: int) -> None:
+        cmd = run_commands[rank]
+        if cmd is None:
+            rcs[rank] = 0
+            return
+        env = dict(spec.get('env', {}))
+        env.update(
+            env_contract.make_rank_env(
+                rank,
+                ips,
+                num_chips_per_node=spec.get('num_chips_per_host', 0),
+                topology=spec.get('topology', ''),
+                accelerator_type=spec.get('accelerator_type', ''),
+                task_id=spec.get('task_id', ''),
+                cluster_name=spec.get('cluster_name', ''),
+                job_id=job_id,
+            ))
+        rcs[rank] = runners[rank].run(
+            cmd,
+            env=env,
+            log_path=log_lib.rank_log_path(state_dir, job_id, rank),
+            line_processor=merged.writer(rank),
+            cwd=_work_cwd(spec, runners[rank]),
+        )
+
+    threads = [
+        threading.Thread(target=run_one, args=(rank,), daemon=True)
+        for rank in range(num_ranks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    merged.close()
+    return rcs
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--state-dir', required=True)
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    state_dir = os.path.expanduser(args.state_dir)
+    job_id = args.job_id
+
+    job = job_lib.get_job(state_dir, job_id)
+    assert job is not None, (state_dir, job_id)
+    spec = job['spec']
+    hosts = load_hosts(state_dir)
+    runners = [runner_lib.runner_from_host_entry(h) for h in hosts]
+    autostop_lib.touch_activity(state_dir)
+
+    try:
+        job_lib.set_status(state_dir, job_id, JobStatus.SETTING_UP)
+        if not _run_setup(state_dir, job_id, spec, runners):
+            job_lib.set_status(state_dir, job_id, JobStatus.FAILED_SETUP)
+            return
+        job_lib.set_status(state_dir, job_id, JobStatus.RUNNING)
+        rcs = _run_ranks(state_dir, job_id, spec, runners)
+        if any(rc != 0 for rc in rcs):
+            print(f'Job {job_id} failed: per-rank return codes {rcs}')
+            job_lib.set_status(state_dir, job_id, JobStatus.FAILED)
+        else:
+            job_lib.set_status(state_dir, job_id, JobStatus.SUCCEEDED)
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'Driver exception for job {job_id}: {e!r}')
+        job_lib.set_status(state_dir, job_id, JobStatus.FAILED)
+        raise
+    finally:
+        autostop_lib.touch_activity(state_dir)
+        # Wake the scheduler for the next queued job.
+        job_lib.schedule_step(state_dir)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,248 @@
+"""Per-cluster job table + FIFO scheduler.
+
+Re-design of reference ``sky/skylet/job_lib.py`` (JobStatus :121,
+JobScheduler :204, driver liveness :538). State lives in a SQLite DB in
+the cluster's agent state dir. Jobs run strictly FIFO, one gang at a
+time (a TPU slice is a single atomic resource, so there is no
+fractional-accelerator packing to do — simpler than the reference's
+resource-counting scheduler, same observable semantics for TPU tasks).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+import filelock
+import psutil
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import status_lib
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+JobStatus = status_lib.JobStatus
+
+
+def _db_path(state_dir: str) -> str:
+    return os.path.join(os.path.expanduser(state_dir), constants.JOBS_DB)
+
+
+_LOCKS: Dict[str, filelock.FileLock] = {}
+
+
+def _lock(state_dir: str) -> filelock.FileLock:
+    """One FileLock object per path — FileLock is only reentrant when
+    the same instance is re-acquired, and schedule_step nests over
+    set_status."""
+    path = _db_path(state_dir) + '.lock'
+    if path not in _LOCKS:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _LOCKS[path] = filelock.FileLock(path)
+    return _LOCKS[path]
+
+
+def _connect(state_dir: str) -> sqlite3.Connection:
+    path = _db_path(state_dir)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""\
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            status TEXT,
+            run_timestamp TEXT,
+            start_at REAL,
+            end_at REAL,
+            resources TEXT,
+            driver_pid INTEGER,
+            spec TEXT)""")
+    conn.commit()
+    return conn
+
+
+# ----------------------------------------------------------------------
+def add_job(state_dir: str,
+            name: Optional[str],
+            username: str,
+            run_timestamp: str,
+            resources_str: str,
+            spec: Dict[str, Any]) -> int:
+    """Insert a job in INIT status; returns job_id."""
+    with _lock(state_dir):
+        conn = _connect(state_dir)
+        cur = conn.execute(
+            """INSERT INTO jobs
+               (name, username, submitted_at, status, run_timestamp,
+                resources, spec)
+               VALUES (?,?,?,?,?,?,?)""",
+            (name, username, time.time(), JobStatus.INIT.value,
+             run_timestamp, resources_str, json.dumps(spec)))
+        conn.commit()
+        job_id = cur.lastrowid
+    os.makedirs(constants.job_dir(state_dir, job_id), exist_ok=True)
+    return int(job_id)
+
+
+def queue_job(state_dir: str, job_id: int) -> None:
+    """INIT -> PENDING; then let the scheduler try to start it."""
+    set_status(state_dir, job_id, JobStatus.PENDING)
+    schedule_step(state_dir)
+
+
+def set_status(state_dir: str, job_id: int, status: JobStatus) -> None:
+    with _lock(state_dir):
+        conn = _connect(state_dir)
+        updates = {'status': status.value}
+        if status == JobStatus.SETTING_UP:
+            updates['start_at'] = time.time()
+        if status.is_terminal():
+            updates['end_at'] = time.time()
+        sets = ', '.join(f'{k}=?' for k in updates)
+        conn.execute(f'UPDATE jobs SET {sets} WHERE job_id=?',
+                     (*updates.values(), job_id))
+        conn.commit()
+
+
+def set_driver_pid(state_dir: str, job_id: int, pid: int) -> None:
+    with _lock(state_dir):
+        conn = _connect(state_dir)
+        conn.execute('UPDATE jobs SET driver_pid=? WHERE job_id=?',
+                     (pid, job_id))
+        conn.commit()
+
+
+def get_job(state_dir: str, job_id: int) -> Optional[Dict[str, Any]]:
+    rows = _query(state_dir, 'WHERE job_id=?', (job_id,))
+    return rows[0] if rows else None
+
+
+def get_latest_job_id(state_dir: str) -> Optional[int]:
+    rows = _query(state_dir, 'ORDER BY job_id DESC LIMIT 1', ())
+    return rows[0]['job_id'] if rows else None
+
+
+def get_jobs(state_dir: str,
+             statuses: Optional[List[JobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    rows = _query(state_dir, 'ORDER BY job_id DESC', ())
+    if statuses is not None:
+        wanted = {s for s in statuses}
+        rows = [r for r in rows if r['status'] in wanted]
+    return rows
+
+
+def _query(state_dir: str, suffix: str, params: tuple
+           ) -> List[Dict[str, Any]]:
+    if not os.path.exists(_db_path(state_dir)):
+        return []
+    conn = _connect(state_dir)
+    cur = conn.execute(
+        f"""SELECT job_id, name, username, submitted_at, status,
+                   run_timestamp, start_at, end_at, resources, driver_pid,
+                   spec FROM jobs {suffix}""", params)
+    out = []
+    for row in cur.fetchall():
+        (job_id, name, username, submitted_at, status, run_timestamp,
+         start_at, end_at, resources, driver_pid, spec) = row
+        out.append({
+            'job_id': job_id,
+            'name': name,
+            'username': username,
+            'submitted_at': submitted_at,
+            'status': JobStatus(status),
+            'run_timestamp': run_timestamp,
+            'start_at': start_at,
+            'end_at': end_at,
+            'resources': resources,
+            'driver_pid': driver_pid,
+            'spec': json.loads(spec) if spec else None,
+        })
+    return out
+
+
+# ----------------------------------------------------------------------
+# Scheduler
+def _driver_alive(pid: Optional[int]) -> bool:
+    if pid is None:
+        return False
+    try:
+        proc = psutil.Process(pid)
+        return proc.is_running() and proc.status() != psutil.STATUS_ZOMBIE
+    except psutil.NoSuchProcess:
+        return False
+
+
+def update_dead_drivers(state_dir: str) -> None:
+    """Jobs whose driver died without a terminal status -> FAILED.
+
+    The reference does the same liveness reconciliation in
+    job_lib.py:538 (`_update_status`).
+    """
+    for job in get_jobs(state_dir, JobStatus.nonterminal_statuses()):
+        if job['status'] in (JobStatus.INIT, JobStatus.PENDING):
+            continue
+        if not _driver_alive(job['driver_pid']):
+            logger.warning('Job %s driver (pid %s) died; marking FAILED.',
+                           job['job_id'], job['driver_pid'])
+            set_status(state_dir, job['job_id'], JobStatus.FAILED)
+
+
+def schedule_step(state_dir: str) -> Optional[int]:
+    """Start the oldest PENDING job if nothing is running.
+
+    Returns the started job_id, or None. The driver process is spawned
+    detached (`python -m skypilot_tpu.agent.driver`), exactly one per
+    job, like the reference's generated driver program.
+    """
+    with _lock(state_dir):
+        update_dead_drivers(state_dir)
+        active = get_jobs(state_dir,
+                          [JobStatus.SETTING_UP, JobStatus.RUNNING])
+        if active:
+            return None
+        pending = get_jobs(state_dir, [JobStatus.PENDING])
+        if not pending:
+            return None
+        job = pending[-1]  # oldest (rows are DESC)
+        job_id = job['job_id']
+        log_path = os.path.join(constants.job_dir(state_dir, job_id),
+                                'driver.log')
+        pid = subprocess_utils.daemonize(
+            ['python', '-u', '-m', 'skypilot_tpu.agent.driver',
+             '--state-dir', state_dir, '--job-id', str(job_id)],
+            log_path=log_path)
+        set_driver_pid(state_dir, job_id, pid)
+        # Driver moves it to SETTING_UP/RUNNING; mark it out of PENDING
+        # now so a concurrent schedule_step won't double-start.
+        set_status(state_dir, job_id, JobStatus.SETTING_UP)
+        return job_id
+
+
+def cancel_job(state_dir: str, job_id: int) -> bool:
+    """Kill the driver tree and mark CANCELLED. Returns True if it was
+    non-terminal."""
+    job = get_job(state_dir, job_id)
+    if job is None:
+        from skypilot_tpu import exceptions
+        raise exceptions.JobNotFoundError(f'No job {job_id} on cluster.')
+    if job['status'].is_terminal():
+        return False
+    if job['driver_pid']:
+        subprocess_utils.kill_process_tree(job['driver_pid'])
+    set_status(state_dir, job_id, JobStatus.CANCELLED)
+    schedule_step(state_dir)
+    return True
+
+
+def fail_all_in_progress(state_dir: str) -> None:
+    """On agent restart after host reboot: no drivers survive."""
+    for job in get_jobs(state_dir, JobStatus.nonterminal_statuses()):
+        set_status(state_dir, job['job_id'], JobStatus.FAILED)

@@ -1,0 +1,104 @@
+"""Job log files and tailing.
+
+Re-design of reference ``sky/skylet/log_lib.py`` (tail_logs /
+_follow_job_logs :388,304). Per-job layout under the agent state dir::
+
+    jobs/<id>/driver.log      gang driver output
+    jobs/<id>/setup-<k>.log   per-host setup
+    jobs/<id>/rank-<k>.log    per-rank run output
+    jobs/<id>/run.log         merged, rank-prefixed stream (tail target)
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Iterator, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+_POLL_SECONDS = 0.2
+
+
+def run_log_path(state_dir: str, job_id: int) -> str:
+    return os.path.join(constants.job_dir(state_dir, job_id), 'run.log')
+
+
+def rank_log_path(state_dir: str, job_id: int, rank: int) -> str:
+    return os.path.join(constants.job_dir(state_dir, job_id),
+                        f'rank-{rank}.log')
+
+
+def setup_log_path(state_dir: str, job_id: int, rank: int) -> str:
+    return os.path.join(constants.job_dir(state_dir, job_id),
+                        f'setup-{rank}.log')
+
+
+def tail_logs(state_dir: str,
+              job_id: Optional[int],
+              follow: bool = True,
+              tail: int = 0) -> Iterator[str]:
+    """Yield log lines; with follow=True, stream until the job ends.
+
+    Survives the log file not existing yet (job still PENDING).
+    """
+    if job_id is None:
+        job_id = job_lib.get_latest_job_id(state_dir)
+        if job_id is None:
+            yield 'No jobs submitted to this cluster.\n'
+            return
+    path = run_log_path(state_dir, job_id)
+
+    # Wait for the job to start producing logs.
+    deadline_notice = time.time() + 5
+    while follow and not os.path.exists(path):
+        job = job_lib.get_job(state_dir, job_id)
+        if job is None:
+            yield f'Job {job_id} not found.\n'
+            return
+        if job['status'].is_terminal():
+            break
+        if time.time() > deadline_notice:
+            yield f'Waiting for job {job_id} to start...\n'
+            deadline_notice = float('inf')
+        time.sleep(_POLL_SECONDS)
+
+    if not os.path.exists(path):
+        # Job finished without producing a run log (e.g. failed setup):
+        # surface setup/driver logs instead.
+        for fallback in (setup_log_path(state_dir, job_id, 0),
+                         os.path.join(constants.job_dir(state_dir, job_id),
+                                      'driver.log')):
+            if os.path.exists(fallback):
+                with open(fallback, encoding='utf-8') as f:
+                    yield from f
+                return
+        yield f'Job {job_id} produced no logs.\n'
+        return
+
+    with open(path, encoding='utf-8') as f:
+        if tail > 0:
+            lines = f.readlines()
+            yield from lines[-tail:]
+        else:
+            yield from _read_available(f)
+        while follow:
+            job = job_lib.get_job(state_dir, job_id)
+            line_seen = False
+            for line in _read_available(f):
+                line_seen = True
+                yield line
+            if job is None or job['status'].is_terminal():
+                # One final drain after the status flips.
+                yield from _read_available(f)
+                return
+            if not line_seen:
+                time.sleep(_POLL_SECONDS)
+
+
+def _read_available(f) -> Iterator[str]:
+    while True:
+        line = f.readline()
+        if not line:
+            return
+        yield line

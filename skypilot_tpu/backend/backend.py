@@ -1,0 +1,108 @@
+"""Backend ABC + ResourceHandle.
+
+Re-design of reference ``sky/backends/backend.py:24-151``: the
+provision/sync/setup/execute/teardown contract every backend satisfies.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Generic, List, Optional, TypeVar
+
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Pickled per-cluster record stored in global user state."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleType = TypeVar('_HandleType', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleType]):
+    """Lifecycle driver for one kind of cluster runtime."""
+
+    NAME = 'backend'
+
+    # --- Lifecycle stages (wrapped with tracing; subclasses implement
+    # the _underscore methods). -----------------------------------------
+    @timeline.event
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool,
+                  stream_logs: bool,
+                  cluster_name: str,
+                  retry_until_up: bool = False) -> Optional[_HandleType]:
+        return self._provision(task, to_provision, dryrun, stream_logs,
+                               cluster_name, retry_until_up)
+
+    @timeline.event
+    def sync_workdir(self, handle: _HandleType, workdir: str) -> None:
+        return self._sync_workdir(handle, workdir)
+
+    @timeline.event
+    def sync_file_mounts(
+        self,
+        handle: _HandleType,
+        all_file_mounts: Optional[Dict[str, str]],
+        storage_mounts: Optional[Dict[str, Any]],
+    ) -> None:
+        return self._sync_file_mounts(handle, all_file_mounts,
+                                      storage_mounts)
+
+    @timeline.event
+    def setup(self, handle: _HandleType, task: 'task_lib.Task',
+              detach_setup: bool) -> None:
+        return self._setup(handle, task, detach_setup)
+
+    @timeline.event
+    def execute(self,
+                handle: _HandleType,
+                task: 'task_lib.Task',
+                detach_run: bool,
+                dryrun: bool = False) -> Optional[int]:
+        """Submit the task as a job; returns job_id (None for dryrun)."""
+        return self._execute(handle, task, detach_run, dryrun)
+
+    @timeline.event
+    def teardown(self,
+                 handle: _HandleType,
+                 terminate: bool,
+                 purge: bool = False) -> None:
+        return self._teardown(handle, terminate, purge)
+
+    # --- Subclass API ---------------------------------------------------
+    def _provision(self, task, to_provision, dryrun, stream_logs,
+                   cluster_name, retry_until_up):
+        raise NotImplementedError
+
+    def _sync_workdir(self, handle, workdir):
+        raise NotImplementedError
+
+    def _sync_file_mounts(self, handle, all_file_mounts, storage_mounts):
+        raise NotImplementedError
+
+    def _setup(self, handle, task, detach_setup):
+        raise NotImplementedError
+
+    def _execute(self, handle, task, detach_run, dryrun):
+        raise NotImplementedError
+
+    def _teardown(self, handle, terminate, purge):
+        raise NotImplementedError
+
+    # Optional capabilities.
+    def cancel_jobs(self, handle: _HandleType,
+                    job_ids: Optional[List[int]]) -> List[int]:
+        raise NotImplementedError
+
+    def tail_logs(self, handle: _HandleType, job_id: Optional[int],
+                  follow: bool = True) -> int:
+        raise NotImplementedError

@@ -1,0 +1,129 @@
+"""Backend utilities: status reconciliation, cluster locks, handles.
+
+Re-design of reference ``sky/backends/backend_utils.py``
+(`_update_cluster_status` :1757, `refresh_cluster_record` :2072). The
+local DB's view of a cluster is a cache; the cloud is the truth. Every
+status read that matters (jobs recovery, serve probing, `status
+--refresh`) reconciles the two here.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Optional
+
+import filelock
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import status_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backend import gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+CLUSTER_STATUS_LOCK_TIMEOUT_SECONDS = 20
+
+
+def cluster_lock_path(cluster_name: str) -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_DATA_DIR', '~/.skytpu'))
+    lock_dir = os.path.join(base, 'locks')
+    os.makedirs(lock_dir, exist_ok=True)
+    return os.path.join(lock_dir, f'{cluster_name}.lock')
+
+
+def cluster_file_lock(cluster_name: str) -> filelock.FileLock:
+    return filelock.FileLock(cluster_lock_path(cluster_name))
+
+
+def _query_cloud_status(
+        handle: 'gang_backend.GangResourceHandle'
+) -> Optional[status_lib.ClusterStatus]:
+    """Ask the provider; None means no instances exist (terminated)."""
+    statuses = provision.query_instances(
+        handle.provider_name,
+        handle.cluster_name_on_cloud,
+        handle.region,
+        handle.zone,
+        non_terminated_only=False,
+    )
+    if not statuses:
+        return None
+    values = set(statuses.values())
+    if values == {'running'}:
+        return status_lib.ClusterStatus.UP
+    if 'terminated' in values or None in values:
+        # Partial termination (e.g. one TPU host preempted) downs the
+        # whole slice from the scheduler's perspective.
+        return None
+    if values == {'stopped'}:
+        return status_lib.ClusterStatus.STOPPED
+    return status_lib.ClusterStatus.INIT
+
+
+def refresh_cluster_record(
+        cluster_name: str,
+        *,
+        force_refresh: bool = False,
+        acquire_lock: bool = True) -> Optional[Dict[str, Any]]:
+    """Return the cluster record with status reconciled against the
+    cloud. None if the cluster does not exist (and its record, if any,
+    is removed)."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        return None
+    if not force_refresh and record['status'] == (
+            status_lib.ClusterStatus.STOPPED):
+        return record
+
+    def _refresh() -> Optional[Dict[str, Any]]:
+        rec = global_user_state.get_cluster_from_name(cluster_name)
+        if rec is None:
+            return None
+        handle = rec['handle']
+        try:
+            cloud_status = _query_cloud_status(handle)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Failed to query cloud status for %s: %r',
+                           cluster_name, e)
+            return rec
+        if cloud_status is None:
+            logger.info('Cluster %s no longer exists on the cloud; '
+                        'removing record.', cluster_name)
+            global_user_state.remove_cluster(cluster_name, terminate=True)
+            return None
+        if cloud_status != rec['status']:
+            global_user_state.update_cluster_status(cluster_name,
+                                                    cloud_status)
+            rec = global_user_state.get_cluster_from_name(cluster_name)
+        return rec
+
+    if not acquire_lock:
+        return _refresh()
+    lock = cluster_file_lock(cluster_name)
+    try:
+        with lock.acquire(timeout=CLUSTER_STATUS_LOCK_TIMEOUT_SECONDS):
+            return _refresh()
+    except filelock.Timeout:
+        logger.debug('Lock timeout refreshing %s; returning cached.',
+                     cluster_name)
+        return record
+
+
+def check_cluster_available(
+        cluster_name: str) -> 'gang_backend.GangResourceHandle':
+    """Cluster exists and is UP, else raise."""
+    record = refresh_cluster_record(cluster_name, force_refresh=True)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    if record['status'] != status_lib.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}, '
+            'not UP.', cluster_status=record['status'],
+            handle=record['handle'])
+    return record['handle']

@@ -1,0 +1,479 @@
+"""GangBackend — the cluster runtime driver (the framework's heart).
+
+TPU-native re-design of reference ``CloudVmRayBackend``
+(sky/backends/cloud_vm_ray_backend.py:2618) with Ray removed entirely
+(SURVEY.md §7 design delta (a)): a TPU pod slice is gang-provisioned by
+the cloud, so gang semantics come from a plain per-host fan-out driven
+by the on-cluster agent (skypilot_tpu/agent/), not placement groups.
+
+Responsibilities:
+- RetryingProvisioner: zone→region failover with a blocked-resources
+  set and typed error granularity (reference RetryingVmProvisioner
+  :1125 + FailoverCloudErrorHandlerV2 :888), optional retry_until_up.
+- Runtime setup via provisioner.post_provision_runtime_setup.
+- Job submission through agent codegen (add-job/queue-job), with the
+  rank/IP/topology env contract resolved from the slice topology.
+- Log tailing, cancel, autostop, teardown.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu.agent import cli as agent_cli
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.backend import backend as backend_lib
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import status_lib
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_PROVISION_BACKOFF_INITIAL = 5.0
+
+
+def log_root() -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_DATA_DIR', '~/.skytpu'))
+    return os.path.join(base, 'logs')
+
+
+class GangResourceHandle(backend_lib.ResourceHandle):
+    """Everything needed to reach and drive a provisioned cluster."""
+
+    def __init__(self, *, cluster_name: str, cluster_name_on_cloud: str,
+                 launched_resources: 'resources_lib.Resources',
+                 launched_nodes: int,
+                 cluster_info: provision_common.ClusterInfo,
+                 state_dir: str,
+                 ssh_private_key: Optional[str] = None) -> None:
+        self.cluster_name = cluster_name
+        self.cluster_name_on_cloud = cluster_name_on_cloud
+        self.launched_resources = launched_resources
+        self.launched_nodes = launched_nodes
+        self.cluster_info = cluster_info
+        self.state_dir = state_dir
+        self.ssh_private_key = ssh_private_key
+
+    # -- identity ------------------------------------------------------
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+    @property
+    def provider_name(self) -> str:
+        return self.cluster_info.provider_name
+
+    @property
+    def region(self) -> str:
+        return self.cluster_info.region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self.cluster_info.zone
+
+    # -- hosts ---------------------------------------------------------
+    @property
+    def num_hosts(self) -> int:
+        """Gang width: total TPU hosts across all logical nodes
+        (reference num_ips_per_node fan-out :2531,5052)."""
+        return self.cluster_info.num_hosts()
+
+    def ip_list(self) -> List[str]:
+        return self.cluster_info.ip_list()
+
+    def runners(self) -> List[runner_lib.CommandRunner]:
+        return provisioner.make_runners(self.cluster_info,
+                                        self.ssh_private_key)
+
+    def head_runner(self) -> runner_lib.CommandRunner:
+        return self.runners()[0]
+
+    def __repr__(self) -> str:
+        return (f'GangResourceHandle({self.cluster_name}, '
+                f'{self.launched_resources!r}, hosts={self.num_hosts})')
+
+
+# ----------------------------------------------------------------------
+class RetryingProvisioner:
+    """Candidate iteration with blocked-resource failover."""
+
+    def __init__(self, cluster_name: str, cluster_name_on_cloud: str,
+                 log_dir: str, retry_until_up: bool) -> None:
+        self._cluster_name = cluster_name
+        self._cluster_name_on_cloud = cluster_name_on_cloud
+        self._log_dir = log_dir
+        self._retry_until_up = retry_until_up
+        # (region, zone) pairs proven unavailable this request.
+        self._blocked: set = set()
+
+    def _candidates(self, to_provision: 'resources_lib.Resources'):
+        cloud = to_provision.cloud
+        for region, zone in cloud.zones_provision_loop(
+                to_provision, region=to_provision.region):
+            if (region, zone) in self._blocked:
+                continue
+            if (region, None) in self._blocked:
+                continue
+            yield region, zone
+
+    def _one_attempt(
+            self, to_provision: 'resources_lib.Resources',
+            num_nodes: int, region: str, zone: Optional[str]
+    ) -> provision_common.ClusterInfo:
+        cloud = to_provision.cloud
+        deploy_vars = cloud.make_deploy_resources_variables(
+            to_provision, self._cluster_name_on_cloud, region, zone)
+        config = provision_common.ProvisionConfig(
+            provider_name=cloud.provider_name(),
+            cluster_name=self._cluster_name,
+            cluster_name_on_cloud=self._cluster_name_on_cloud,
+            region=region,
+            zone=zone,
+            node_config=deploy_vars,
+            count=num_nodes,
+            ports_to_open=to_provision.ports,
+        )
+        record = provisioner.bulk_provision(config)
+        return provision.get_cluster_info(config.provider_name,
+                                          record.cluster_name_on_cloud,
+                                          record.region, record.zone)
+
+    def provision_with_retries(
+            self, to_provision: 'resources_lib.Resources',
+            num_nodes: int) -> provision_common.ClusterInfo:
+        """Iterate candidates; block failed ones at the right granularity
+        (zone for stockouts, region for quota)."""
+        backoff = common_utils.Backoff(_PROVISION_BACKOFF_INITIAL)
+        failover_history: List[Exception] = []
+        while True:
+            for region, zone in self._candidates(to_provision):
+                where = f'{region}/{zone or "*"}'
+                logger.info('Provisioning %s (%r) in %s...',
+                            self._cluster_name, to_provision, where)
+                try:
+                    return self._one_attempt(to_provision, num_nodes,
+                                             region, zone)
+                except exceptions.QuotaExceededError as e:
+                    logger.warning('Quota exceeded in %s: %s', region, e)
+                    failover_history.append(e)
+                    self._blocked.add((region, None))
+                except exceptions.ProvisionError as e:
+                    # Stockout or generic capacity error: block the zone.
+                    logger.warning('Provision failed in %s: %s', where, e)
+                    failover_history.append(e)
+                    self._blocked.add((region, zone))
+                # Best-effort cleanup of partially-created resources.
+                try:
+                    provision.terminate_instances(
+                        to_provision.cloud.provider_name(),
+                        self._cluster_name_on_cloud, region, zone)
+                except Exception:  # pylint: disable=broad-except
+                    pass
+            if not self._retry_until_up:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Failed to provision {to_provision!r} in all '
+                    'candidate zones.',
+                    failover_history=failover_history)
+            sleep = backoff.current_backoff()
+            logger.info('retry_until_up: retrying in %.0fs.', sleep)
+            self._blocked.clear()
+            time.sleep(sleep)
+
+
+# ----------------------------------------------------------------------
+@registry.BACKEND_REGISTRY.register(name='gang', default=True)
+class GangBackend(backend_lib.Backend[GangResourceHandle]):
+    """Provision clusters and gang-execute jobs on them."""
+
+    NAME = 'gang'
+
+    def __init__(self) -> None:
+        self.run_timestamp = sky_logging.get_run_timestamp()
+        self.log_dir = os.path.join(log_root(), self.run_timestamp)
+
+    # ------------------------------------------------------------------
+    def _provision(self, task: 'task_lib.Task',
+                   to_provision: Optional['resources_lib.Resources'],
+                   dryrun: bool, stream_logs: bool, cluster_name: str,
+                   retry_until_up: bool = False
+                   ) -> Optional[GangResourceHandle]:
+        assert to_provision is not None
+        to_provision.assert_launchable()
+        if dryrun:
+            logger.info('Dryrun: would provision %r as %s.', to_provision,
+                        cluster_name)
+            return None
+        cloud = to_provision.cloud
+        max_len = cloud.MAX_CLUSTER_NAME_LEN_LIMIT or 64
+        cluster_name_on_cloud = common_utils.make_cluster_name_on_cloud(
+            cluster_name, max_len)
+
+        with backend_utils.cluster_file_lock(self._lock_name(cluster_name)):
+            record = backend_utils.refresh_cluster_record(
+                cluster_name, force_refresh=True, acquire_lock=False)
+            if record is not None:
+                handle = record['handle']
+                if record['status'] == status_lib.ClusterStatus.UP:
+                    self._check_resources_match(handle, task)
+                    logger.info('Reusing existing cluster %s.',
+                                cluster_name)
+                    return handle
+                # STOPPED / INIT: restart through the same provisioner
+                # (run_instances resumes stopped instances).
+                to_provision = handle.launched_resources
+                cluster_name_on_cloud = handle.cluster_name_on_cloud
+
+            prov = RetryingProvisioner(cluster_name, cluster_name_on_cloud,
+                                       self.log_dir, retry_until_up)
+            cluster_info = prov.provision_with_retries(
+                to_provision, task.num_nodes)
+            launched = to_provision.copy(
+                region=cluster_info.region,
+                zone=cluster_info.zone,
+            )
+            ssh_key = os.path.expanduser('~/.skytpu/keys/skytpu.pem')
+            state_dir = provisioner.post_provision_runtime_setup(
+                cluster_info,
+                ssh_private_key=ssh_key,
+                log_dir=self.log_dir)
+            handle = GangResourceHandle(
+                cluster_name=cluster_name,
+                cluster_name_on_cloud=cluster_info.cluster_name_on_cloud,
+                launched_resources=launched,
+                launched_nodes=task.num_nodes,
+                cluster_info=cluster_info,
+                state_dir=state_dir,
+                ssh_private_key=ssh_key,
+            )
+            global_user_state.add_or_update_cluster(
+                cluster_name, handle, requested_resources=set(task.resources),
+                ready=True)
+            return handle
+
+    @staticmethod
+    def _lock_name(cluster_name: str) -> str:
+        return f'{cluster_name}.provision'
+
+    def _check_resources_match(self, handle: GangResourceHandle,
+                               task: 'task_lib.Task') -> None:
+        launched = handle.launched_resources
+        for want in task.resources:
+            if want.less_demanding_than(launched):
+                return
+        raise exceptions.ResourcesMismatchError(
+            f'Cluster {handle.cluster_name} was launched with {launched!r}, '
+            f'which does not satisfy the requested {task.resources}. '
+            'Use a new cluster name or tear this one down.')
+
+    # ------------------------------------------------------------------
+    def _sync_workdir(self, handle: GangResourceHandle,
+                      workdir: str) -> None:
+        workdir = os.path.abspath(os.path.expanduser(workdir))
+        source = workdir.rstrip('/') + '/'
+
+        def sync_one(runner: runner_lib.CommandRunner) -> None:
+            runner.rsync(source, agent_constants.REMOTE_WORKDIR, up=True,
+                         log_path=os.path.join(self.log_dir, 'workdir.log'))
+
+        subprocess_utils.run_in_parallel(sync_one, handle.runners())
+        logger.info('Synced workdir %s to %d host(s).', workdir,
+                    handle.num_hosts)
+
+    def _sync_file_mounts(self, handle: GangResourceHandle,
+                          all_file_mounts: Optional[Dict[str, str]],
+                          storage_mounts: Optional[Dict[str, Any]]) -> None:
+        if all_file_mounts:
+            runners = handle.runners()
+
+            def sync_mounts(runner: runner_lib.CommandRunner) -> None:
+                for dst, src in all_file_mounts.items():
+                    src = os.path.expanduser(src)
+                    if os.path.isdir(src):
+                        # file_mounts semantics: the source dir's
+                        # contents appear AT dst (not nested under it).
+                        src = src.rstrip('/') + '/'
+                    runner.rsync(src, dst, up=True,
+                                 log_path=os.path.join(
+                                     self.log_dir, 'file_mounts.log'))
+
+            subprocess_utils.run_in_parallel(sync_mounts, runners)
+        if storage_mounts:
+            from skypilot_tpu.data import storage_mounting
+            storage_mounting.mount_storage_on_cluster(
+                handle, storage_mounts, self.log_dir)
+
+    # ------------------------------------------------------------------
+    def _setup(self, handle: GangResourceHandle, task: 'task_lib.Task',
+               detach_setup: bool) -> None:
+        # Setup runs inside the job driver (per-host, before ranks), so
+        # it shares the env contract and logging; mirroring the
+        # reference's detached setup mode. Nothing to do eagerly.
+        del handle, task, detach_setup
+
+    # ------------------------------------------------------------------
+    def _resolve_run_commands(self, task: 'task_lib.Task',
+                              ips: List[str]) -> List[Optional[str]]:
+        n = len(ips)
+        if task.run is None:
+            return [None] * n
+        if isinstance(task.run, str):
+            return [task.run] * n
+        return [task.run(rank, ips) for rank in range(n)]
+
+    def _job_spec(self, handle: GangResourceHandle,
+                  task: 'task_lib.Task') -> Dict[str, Any]:
+        ips = handle.ip_list()
+        tpu = handle.launched_resources.tpu
+        task_id = (f'{self.run_timestamp}-'
+                   f'{common_utils.generate_run_id(4)}')
+        return {
+            'setup': task.setup,
+            'run_commands': self._resolve_run_commands(task, ips),
+            'env': task.envs,
+            'ips': ips,
+            'num_chips_per_host': tpu.chips_per_host if tpu else 0,
+            'topology': tpu.topology if tpu else '',
+            'accelerator_type': tpu.name if tpu else '',
+            'task_id': task_id,
+            'cluster_name': handle.cluster_name,
+            'has_workdir': task.workdir is not None,
+        }
+
+    def run_on_head(self, handle: GangResourceHandle, args: List[str],
+                    *, stream_logs: bool = False,
+                    log_path: str = '/dev/null') -> Any:
+        """Invoke the agent CLI on the head host; parse its JSON."""
+        cmd = ('export PYTHONPATH="$HOME/.skytpu_runtime:$PYTHONPATH"; '
+               'python -u -m skypilot_tpu.agent.cli '
+               f'--state-dir {runner_lib.shell_path(handle.state_dir)} ' +
+               ' '.join(shlex.quote(a) for a in args))
+        runner = handle.head_runner()
+        rc, stdout, stderr = runner.run(cmd, require_outputs=True,
+                                        log_path=log_path)
+        if rc != 0:
+            raise exceptions.CommandError(rc, f'agent {args[0]}',
+                                          stderr or stdout)
+        return agent_cli.parse_output(stdout)
+
+    def _execute(self, handle: GangResourceHandle, task: 'task_lib.Task',
+                 detach_run: bool, dryrun: bool = False) -> Optional[int]:
+        if dryrun:
+            logger.info('Dryrun: would submit job to %s.',
+                        handle.cluster_name)
+            return None
+        spec = self._job_spec(handle, task)
+        out = self.run_on_head(handle, [
+            'add-job',
+            *(['--name', task.name] if task.name else []),
+            '--username', common_utils.get_user_name(),
+            '--run-timestamp', self.run_timestamp,
+            '--resources', repr(handle.launched_resources),
+            '--spec-json', json.dumps(spec),
+        ])
+        job_id = int(out['job_id'])
+        self.run_on_head(handle, ['queue-job', '--job-id', str(job_id)])
+        logger.info('Job %d submitted to cluster %s.', job_id,
+                    handle.cluster_name)
+        if not detach_run:
+            self.tail_logs(handle, job_id)
+        return job_id
+
+    # ------------------------------------------------------------------
+    def tail_logs(self, handle: GangResourceHandle,
+                  job_id: Optional[int], follow: bool = True) -> int:
+        args = ['tail-logs']
+        if job_id is not None:
+            args += ['--job-id', str(job_id)]
+        if follow:
+            args += ['--follow']
+        cmd = ('export PYTHONPATH="$HOME/.skytpu_runtime:$PYTHONPATH"; '
+               'python -u -m skypilot_tpu.agent.cli '
+               f'--state-dir {runner_lib.shell_path(handle.state_dir)} ' +
+               ' '.join(args))
+        runner = handle.head_runner()
+        return runner.run(cmd, stream_logs=True,
+                          log_path=os.path.join(self.log_dir, 'tail.log'))
+
+    def cancel_jobs(self, handle: GangResourceHandle,
+                    job_ids: Optional[List[int]]) -> List[int]:
+        args = ['cancel']
+        if job_ids:
+            args += ['--job-ids'] + [str(j) for j in job_ids]
+        out = self.run_on_head(handle, args)
+        return out['cancelled']
+
+    def get_job_status(
+            self, handle: GangResourceHandle,
+            job_ids: Optional[List[int]] = None
+    ) -> Dict[int, Optional[status_lib.JobStatus]]:
+        args = ['job-status']
+        if job_ids:
+            args += ['--job-ids'] + [str(j) for j in job_ids]
+        out = self.run_on_head(handle, args)
+        return {
+            int(k): status_lib.JobStatus(v) if v else None
+            for k, v in out.items()
+        }
+
+    def get_job_queue(self, handle: GangResourceHandle) -> List[Dict]:
+        return self.run_on_head(handle, ['queue'])
+
+    def set_autostop(self, handle: GangResourceHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        if idle_minutes >= 0 and not down:
+            cloud = handle.launched_resources.cloud
+            from skypilot_tpu.clouds import cloud as cloud_lib
+            cloud.check_features_are_supported(
+                handle.launched_resources,
+                {cloud_lib.CloudImplementationFeatures.AUTOSTOP})
+        args = [
+            'set-autostop',
+            '--idle-minutes', str(idle_minutes),
+            '--provider-name', handle.provider_name,
+            '--cluster-name-on-cloud', handle.cluster_name_on_cloud,
+            '--region', handle.region,
+        ]
+        if handle.zone:
+            args += ['--zone', handle.zone]
+        if down:
+            args += ['--down']
+        self.run_on_head(handle, args)
+        global_user_state.set_cluster_autostop_value(
+            handle.cluster_name, idle_minutes, down)
+
+    # ------------------------------------------------------------------
+    def _teardown(self, handle: GangResourceHandle, terminate: bool,
+                  purge: bool = False) -> None:
+        cluster_name = handle.cluster_name
+        with backend_utils.cluster_file_lock(self._lock_name(cluster_name)):
+            try:
+                provisioner.teardown_cluster(handle.provider_name,
+                                             handle.cluster_name_on_cloud,
+                                             handle.region, handle.zone,
+                                             terminate=terminate)
+            except Exception as e:  # pylint: disable=broad-except
+                if not purge:
+                    raise
+                logger.warning('Purging %s despite teardown error: %r',
+                               cluster_name, e)
+            global_user_state.remove_cluster(cluster_name,
+                                             terminate=terminate)
+        logger.info('%s cluster %s.',
+                    'Terminated' if terminate else 'Stopped', cluster_name)

@@ -1,0 +1,159 @@
+"""Core cluster operations: status/stop/start/down/autostop/queue/...
+
+Re-design of reference ``sky/core.py``. These are the in-process
+implementations; the API server (skypilot_tpu/server) exposes each as a
+route and the CLI/SDK call through it (or directly in local mode).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.backend import gang_backend
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import status_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+def status(cluster_names: Optional[Union[str, List[str]]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records, optionally reconciled against the cloud."""
+    if isinstance(cluster_names, str):
+        cluster_names = [cluster_names]
+    records = global_user_state.get_clusters()
+    if cluster_names is not None:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        refreshed = []
+        for r in records:
+            rec = backend_utils.refresh_cluster_record(r['name'],
+                                                       force_refresh=True)
+            if rec is not None:
+                refreshed.append(rec)
+        records = refreshed
+    return records
+
+
+def _get_handle(cluster_name: str) -> gang_backend.GangResourceHandle:
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record['handle']
+
+
+def stop(cluster_name: str, purge: bool = False) -> None:
+    """Stop a cluster's instances (restartable with `start`)."""
+    handle = _get_handle(cluster_name)
+    from skypilot_tpu.clouds import cloud as cloud_lib
+    resources = handle.launched_resources
+    resources.cloud.check_features_are_supported(
+        resources, {cloud_lib.CloudImplementationFeatures.STOP})
+    backend = gang_backend.GangBackend()
+    backend.teardown(handle, terminate=False, purge=purge)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    """Terminate a cluster and all its resources."""
+    handle = _get_handle(cluster_name)
+    backend = gang_backend.GangBackend()
+    backend.teardown(handle, terminate=True, purge=purge)
+
+
+def start(cluster_name: str,
+          idle_minutes_to_autostop: Optional[int] = None,
+          retry_until_up: bool = False) -> gang_backend.GangResourceHandle:
+    """Restart a stopped cluster (same resources/zone)."""
+    record = global_user_state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    from skypilot_tpu import task as task_lib
+    task = task_lib.Task()
+    task.set_resources(record['handle'].launched_resources)
+    task.num_nodes = record['handle'].launched_nodes
+    backend = gang_backend.GangBackend()
+    handle = backend.provision(task,
+                               record['handle'].launched_resources,
+                               dryrun=False,
+                               stream_logs=True,
+                               cluster_name=cluster_name,
+                               retry_until_up=retry_until_up)
+    assert handle is not None
+    if idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop)
+    return handle
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # pylint: disable=redefined-outer-name
+    """Set (or cancel with idle_minutes=-1) the autostop budget."""
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = gang_backend.GangBackend()
+    backend.set_autostop(handle, idle_minutes, down=down)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    """The cluster's job table."""
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = gang_backend.GangBackend()
+    return backend.get_job_queue(handle)
+
+
+def job_status(cluster_name: str,
+               job_ids: Optional[List[int]] = None
+               ) -> Dict[int, Optional[status_lib.JobStatus]]:
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = gang_backend.GangBackend()
+    return backend.get_job_status(handle, job_ids)
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Cancel queued/running jobs (all non-terminal if all_jobs)."""
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = gang_backend.GangBackend()
+    if all_jobs:
+        job_ids = None
+    elif not job_ids:
+        raise ValueError('Specify job_ids or all_jobs=True.')
+    return backend.cancel_jobs(handle, job_ids)
+
+
+def tail_logs(cluster_name: str,
+              job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """Stream a job's merged rank logs to stdout."""
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = gang_backend.GangBackend()
+    return backend.tail_logs(handle, job_id, follow=follow)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Accumulated cost per cluster from usage intervals (reference
+    sky/core.py cost_report)."""
+    import time as time_lib
+    out = []
+    for row in global_user_state.get_cluster_history():
+        launched = row['launched_resources']
+        duration = row['duration']
+        cost = None
+        if launched is not None:
+            try:
+                cost = (launched.hourly_price() * row['num_nodes'] *
+                        duration / 3600.0)
+            except Exception:  # pylint: disable=broad-except
+                cost = None
+        out.append({
+            'name': row['name'],
+            'duration': duration,
+            'num_nodes': row['num_nodes'],
+            'resources': launched,
+            'cost': cost,
+            'queried_at': time_lib.time(),
+        })
+    return out

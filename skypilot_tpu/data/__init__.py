@@ -1,0 +1,6 @@
+"""Data & storage layer."""
+from skypilot_tpu.data.storage import Storage
+from skypilot_tpu.data.storage import StorageMode
+from skypilot_tpu.data.storage import StoreType
+
+__all__ = ['Storage', 'StorageMode', 'StoreType']

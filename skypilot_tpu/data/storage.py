@@ -1,0 +1,242 @@
+"""Storage — named buckets synced or FUSE-mounted onto clusters.
+
+Re-design of reference ``sky/data/storage.py`` (Storage :484, StoreType
+:114, GcsStore :1802) trimmed to the TPU-relevant stores:
+
+- GCS (primary): data/checkpoint buckets for TPU jobs; COPY downloads
+  to each host, MOUNT uses gcsfuse. The durable MOUNT bucket is the
+  checkpoint/resume substrate for managed spot jobs (reference §5
+  checkpoint discussion).
+- LOCAL (hermetic): a directory under $SKYTPU_DATA_DIR/buckets acts as
+  the bucket; MOUNT is a symlink. Lets recovery tests exercise the
+  checkpoint-resume path with zero cloud deps.
+
+All cloud interaction goes through the ``gsutil``/``gcloud storage``
+CLI (like the reference's mounting shell, mounting_utils.py), so this
+layer stays dependency-light.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+class StoreType(enum.Enum):
+    GCS = 'GCS'
+    LOCAL = 'LOCAL'
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+class AbstractStore:
+    """One physical bucket in one store type."""
+
+    def __init__(self, name: str, source: Optional[str] = None) -> None:
+        self.name = name
+        self.source = source
+
+    def upload(self) -> None:
+        """Sync self.source into the bucket (no-op if source is None)."""
+        raise NotImplementedError
+
+    def download_command(self, dst: str) -> str:
+        """Shell command fetching bucket contents to dst (COPY mode)."""
+        raise NotImplementedError
+
+    def mount_command(self, mount_path: str) -> str:
+        """Shell command mounting the bucket at mount_path (MOUNT mode)."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def url(self) -> str:
+        raise NotImplementedError
+
+
+class GcsStore(AbstractStore):
+    """Google Cloud Storage bucket via gsutil/gcsfuse."""
+
+    def url(self) -> str:
+        return f'gs://{self.name}'
+
+    def upload(self) -> None:
+        if self.source is None:
+            return
+        src = os.path.abspath(os.path.expanduser(self.source))
+        self._run(f'gsutil mb -c standard {self.url()} || true')
+        if os.path.isdir(src):
+            self._run(f'gsutil -m rsync -r -x ".git/*" {src} {self.url()}')
+        else:
+            self._run(f'gsutil cp {src} {self.url()}/')
+
+    def download_command(self, dst: str) -> str:
+        return (f'mkdir -p {dst} && '
+                f'gsutil -m rsync -r {self.url()} {dst}')
+
+    def mount_command(self, mount_path: str) -> str:
+        # gcsfuse with implicit dirs; install if missing (reference
+        # mounting_utils.py:25-268 installs FUSE adapters the same way).
+        install = ('which gcsfuse >/dev/null 2>&1 || '
+                   '(curl -sSL https://github.com/GoogleCloudPlatform/'
+                   'gcsfuse/releases/download/v2.4.0/'
+                   'gcsfuse_2.4.0_amd64.deb -o /tmp/gcsfuse.deb && '
+                   'sudo dpkg -i /tmp/gcsfuse.deb)')
+        return (f'{install}; mkdir -p {mount_path} && '
+                f'(mountpoint -q {mount_path} || '
+                f'gcsfuse --implicit-dirs {self.name} {mount_path})')
+
+    def delete(self) -> None:
+        self._run(f'gsutil -m rm -r {self.url()} || true')
+
+    @staticmethod
+    def _run(cmd: str) -> None:
+        proc = subprocess.run(cmd, shell=True, capture_output=True,
+                              text=True, check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageError(
+                f'Storage command failed ({cmd}): {proc.stderr}')
+
+
+class LocalStore(AbstractStore):
+    """Directory-backed fake bucket for hermetic tests."""
+
+    @staticmethod
+    def bucket_root() -> str:
+        base = os.path.expanduser(
+            os.environ.get('SKYTPU_DATA_DIR', '~/.skytpu'))
+        path = os.path.join(base, 'buckets')
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def path(self) -> str:
+        return os.path.join(self.bucket_root(), self.name)
+
+    def url(self) -> str:
+        return f'local://{self.name}'
+
+    def upload(self) -> None:
+        os.makedirs(self.path(), exist_ok=True)
+        if self.source is None:
+            return
+        src = os.path.abspath(os.path.expanduser(self.source))
+        if os.path.isdir(src):
+            shutil.copytree(src, self.path(), dirs_exist_ok=True)
+        else:
+            shutil.copy2(src, self.path())
+
+    def download_command(self, dst: str) -> str:
+        return f'mkdir -p {dst} && cp -a {self.path()}/. {dst}/'
+
+    def mount_command(self, mount_path: str) -> str:
+        # Symlink stands in for a FUSE mount: writes are immediately
+        # durable in the "bucket", which is exactly the property the
+        # checkpoint-recovery path needs.
+        return (f'mkdir -p {self.path()} && '
+                f'mkdir -p $(dirname {mount_path}) && '
+                f'rm -rf {mount_path} && '
+                f'ln -sfn {self.path()} {mount_path}')
+
+    def delete(self) -> None:
+        shutil.rmtree(self.path(), ignore_errors=True)
+
+
+_STORE_CLASSES = {
+    StoreType.GCS: GcsStore,
+    StoreType.LOCAL: LocalStore,
+}
+
+
+class Storage:
+    """User-facing named storage object.
+
+    YAML form (under ``storage_mounts:``)::
+
+        /checkpoints:
+          name: my-ckpt-bucket
+          store: gcs          # or local
+          mode: MOUNT         # or COPY
+          source: ./data      # optional: upload at launch
+    """
+
+    def __init__(self,
+                 name: str,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 store: Optional[StoreType] = None,
+                 persistent: bool = True) -> None:
+        if not name:
+            raise exceptions.StorageSpecError('Storage needs a name.')
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self.stores: Dict[StoreType, AbstractStore] = {}
+        if store is not None:
+            self.add_store(store)
+        if source is not None and not os.path.exists(
+                os.path.expanduser(source)):
+            raise exceptions.StorageSpecError(
+                f'Storage source {source!r} does not exist.')
+
+    def add_store(self, store_type: StoreType) -> AbstractStore:
+        if store_type not in self.stores:
+            cls = _STORE_CLASSES[store_type]
+            self.stores[store_type] = cls(self.name, self.source)
+        return self.stores[store_type]
+
+    def get_store(self) -> AbstractStore:
+        if not self.stores:
+            self.add_store(StoreType.GCS)
+        return next(iter(self.stores.values()))
+
+    def sync(self) -> None:
+        """Upload source to every store."""
+        for store in self.stores.values():
+            store.upload()
+
+    def delete(self) -> None:
+        for store in self.stores.values():
+            store.delete()
+        from skypilot_tpu import global_user_state
+        global_user_state.remove_storage(self.name)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        if not isinstance(config, dict):
+            raise exceptions.StorageSpecError(
+                f'storage mount spec must be a mapping, got {config!r}')
+        mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        store = config.get('store')
+        store_type = StoreType(store.upper()) if store else None
+        return cls(name=config.get('name'),
+                   source=config.get('source'),
+                   mode=mode,
+                   store=store_type,
+                   persistent=config.get('persistent', True))
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {'name': self.name, 'mode': self.mode.value}
+        if self.source is not None:
+            out['source'] = self.source
+        if self.stores:
+            out['store'] = next(iter(self.stores)).value.lower()
+        if not self.persistent:
+            out['persistent'] = False
+        return out
+
+    def __repr__(self) -> str:
+        stores = ','.join(s.value for s in self.stores) or 'unbound'
+        return f'Storage({self.name}, {self.mode.value}, {stores})'

@@ -1,0 +1,81 @@
+"""Mount/download storage onto every cluster host.
+
+Counterpart of reference ``sky/data/mounting_utils.py:293-365`` +
+``cloud_vm_ray_backend._execute_storage_mounts`` (:4803): resolve each
+``storage_mounts`` entry to a Storage, upload any local source, then run
+the store's mount (MOUNT) or download (COPY) command on every host via
+its command runner.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backend import gang_backend
+
+logger = sky_logging.init_logger(__name__)
+
+
+def resolve_storage(spec: Any) -> storage_lib.Storage:
+    if isinstance(spec, storage_lib.Storage):
+        return spec
+    if isinstance(spec, dict):
+        return storage_lib.Storage.from_yaml_config(spec)
+    raise exceptions.StorageSpecError(
+        f'Invalid storage mount spec: {spec!r}')
+
+
+def mount_storage_on_cluster(handle: 'gang_backend.GangResourceHandle',
+                             storage_mounts: Dict[str, Any],
+                             log_dir: str) -> None:
+    resolved = {
+        dst: resolve_storage(spec) for dst, spec in storage_mounts.items()
+    }
+    # Default hermetic clusters to the local store, real ones to GCS.
+    is_local_cluster = handle.provider_name == 'local'
+    for storage in resolved.values():
+        if not storage.stores:
+            storage.add_store(storage_lib.StoreType.LOCAL
+                              if is_local_cluster
+                              else storage_lib.StoreType.GCS)
+        storage.sync()
+        global_user_state.add_or_update_storage(storage.name, {
+            'name': storage.name,
+            'stores': [s.value for s in storage.stores],
+        }, 'READY')
+
+    runners = handle.runners()
+
+    def mount_all(runner: runner_lib.CommandRunner) -> None:
+        for dst, storage in resolved.items():
+            store = storage.get_store()
+            if storage.mode == storage_lib.StorageMode.MOUNT:
+                cmd = store.mount_command(_host_path(runner, dst))
+            else:
+                cmd = store.download_command(_host_path(runner, dst))
+            runner.run(cmd,
+                       log_path=os.path.join(log_dir, 'storage_mounts.log'),
+                       check=True)
+
+    subprocess_utils.run_in_parallel(mount_all, runners)
+    logger.info('Mounted %d storage(s) on %d host(s).', len(resolved),
+                len(runners))
+
+
+def _host_path(runner: runner_lib.CommandRunner, path: str) -> str:
+    """Local simulated hosts sandbox absolute paths under the host dir;
+    real hosts use the path as-is."""
+    if isinstance(runner, runner_lib.LocalProcessRunner):
+        if path.startswith('~'):
+            return runner.translate(path)
+        return os.path.join(runner.host_dir, path.lstrip('/'))
+    return path

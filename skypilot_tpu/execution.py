@@ -1,0 +1,191 @@
+"""Execution engine — the task lifecycle state machine.
+
+Re-design of reference ``sky/execution.py`` (Stage :35, _execute :99,
+launch :377, exec :557): OPTIMIZE -> PROVISION -> SYNC_WORKDIR ->
+SYNC_FILE_MOUNTS -> SETUP -> PRE_EXEC -> EXEC -> (optional) DOWN.
+`exec_` skips optimize/provision/setup and reuses the cluster handle —
+the fast path for iterating on a running TPU slice.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Tuple, Union
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.backend import gang_backend
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _to_dag(entrypoint: Union[task_lib.Task, dag_lib.Dag]) -> dag_lib.Dag:
+    if isinstance(entrypoint, task_lib.Task):
+        dag = dag_lib.Dag()
+        dag.add(entrypoint)
+        return dag
+    return entrypoint
+
+
+def _default_cluster_name() -> str:
+    return f'skytpu-{common_utils.generate_run_id(4)}'
+
+
+@timeline.event
+def _execute(
+    entrypoint: Union[task_lib.Task, dag_lib.Dag],
+    *,
+    cluster_name: Optional[str],
+    stages: List[Stage],
+    dryrun: bool = False,
+    stream_logs: bool = True,
+    optimize_target: optimizer_lib.OptimizeTarget = (
+        optimizer_lib.OptimizeTarget.COST),
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    down: bool = False,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[gang_backend.GangResourceHandle]]:
+    """Returns (job_id, handle) of the last task executed."""
+    dag = _to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        # Chain pipelines run through the managed-jobs controller (one
+        # cluster per task), like the reference (sky/execution.py:99).
+        raise exceptions.NotSupportedError(
+            'launch()/exec() take a single-task dag; submit multi-task '
+            'pipelines via `skytpu jobs launch`.')
+    common_utils.check_cluster_name_is_valid(cluster_name)
+    dag = admin_policy.apply(
+        dag,
+        admin_policy.RequestOptions(
+            cluster_name=cluster_name,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            down=down,
+            dryrun=dryrun))
+
+    if Stage.OPTIMIZE in stages:
+        needs_optimize = any(t.best_resources is None for t in dag.tasks)
+        if needs_optimize:
+            optimizer_lib.Optimizer.optimize(dag, minimize=optimize_target,
+                                             quiet=not stream_logs)
+
+    backend = gang_backend.GangBackend()
+    job_id: Optional[int] = None
+    handle: Optional[gang_backend.GangResourceHandle] = None
+    name = cluster_name or _default_cluster_name()
+
+    for task in dag.get_sorted_tasks():
+        if Stage.PROVISION in stages:
+            handle = backend.provision(task, task.best_resources,
+                                       dryrun=dryrun,
+                                       stream_logs=stream_logs,
+                                       cluster_name=name,
+                                       retry_until_up=retry_until_up)
+        else:
+            handle = backend_utils.check_cluster_available(name)
+        if dryrun:
+            logger.info('Dryrun finished.')
+            return None, None
+        assert handle is not None
+
+        if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+            backend.sync_workdir(handle, task.workdir)
+        if Stage.SYNC_FILE_MOUNTS in stages:
+            if task.file_mounts or task.storage_mounts:
+                backend.sync_file_mounts(handle, task.file_mounts,
+                                         task.storage_mounts)
+        if no_setup:
+            task.setup = None
+        if Stage.PRE_EXEC in stages:
+            # `down=True` without an idle budget means "autodown when
+            # idle" (reference sky/execution.py maps it to autostop 0);
+            # tearing down inline would race the detached job.
+            if down and idle_minutes_to_autostop is None:
+                idle_minutes_to_autostop = 0
+            if idle_minutes_to_autostop is not None:
+                backend.set_autostop(handle, idle_minutes_to_autostop,
+                                     down=down)
+        if Stage.EXEC in stages:
+            job_id = backend.execute(handle, task, detach_run=detach_run)
+    return job_id, handle
+
+
+def launch(
+    entrypoint: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    stream_logs: bool = True,
+    optimize_target: optimizer_lib.OptimizeTarget = (
+        optimizer_lib.OptimizeTarget.COST),
+    detach_run: bool = True,
+    idle_minutes_to_autostop: Optional[int] = None,
+    down: bool = False,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[gang_backend.GangResourceHandle]]:
+    """Provision (or reuse) a cluster and run the task on it."""
+    return _execute(
+        entrypoint,
+        cluster_name=cluster_name,
+        stages=[
+            Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+            Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.PRE_EXEC,
+            Stage.EXEC, Stage.DOWN
+        ],
+        dryrun=dryrun,
+        stream_logs=stream_logs,
+        optimize_target=optimize_target,
+        detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop,
+        down=down,
+        retry_until_up=retry_until_up,
+        no_setup=no_setup,
+    )
+
+
+def exec_(  # pylint: disable=redefined-builtin
+    entrypoint: Union[task_lib.Task, dag_lib.Dag],
+    cluster_name: str,
+    *,
+    dryrun: bool = False,
+    detach_run: bool = True,
+) -> Tuple[Optional[int], Optional[gang_backend.GangResourceHandle]]:
+    """Fast path: submit to an existing cluster, skipping provisioning
+    and setup (reference sky/execution.py:557)."""
+    dag = _to_dag(entrypoint)
+    # Validate the cluster can serve the requested resources.
+    handle = backend_utils.check_cluster_available(cluster_name)
+    for task in dag.tasks:
+        task.best_resources = handle.launched_resources
+        for want in task.resources:
+            if not want.less_demanding_than(handle.launched_resources):
+                raise exceptions.ResourcesMismatchError(
+                    f'Task requests {want!r}; cluster {cluster_name} has '
+                    f'{handle.launched_resources!r}.')
+    return _execute(
+        dag,
+        cluster_name=cluster_name,
+        stages=[Stage.SYNC_WORKDIR, Stage.PRE_EXEC, Stage.EXEC],
+        dryrun=dryrun,
+        detach_run=detach_run,
+        no_setup=True,
+    )

@@ -1,0 +1,105 @@
+"""Provision layer — stateless per-cloud modules behind a router.
+
+Re-design of reference ``sky/provision/__init__.py:37-197``: every
+operation ``<op>(provider_name, ...)`` routes to
+``skypilot_tpu.provision.<provider>.instance.<op>``. Plugins are
+stateless; all cluster state lives with the cloud provider (queried
+fresh) and in the client DB.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _route(op_name: str):
+    """Decorator: dispatch to the provider module's same-named function."""
+
+    def decorator(stub):
+
+        @functools.wraps(stub)
+        @timeline.event(name=f'provision.{op_name}')
+        def wrapper(provider_name: str, *args, **kwargs):
+            module = importlib.import_module(
+                f'skypilot_tpu.provision.{provider_name}.instance')
+            impl = getattr(module, op_name, None)
+            if impl is None:
+                raise NotImplementedError(
+                    f'Provider {provider_name!r} does not implement '
+                    f'{op_name}()')
+            return impl(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+@_route('bootstrap_instances')
+def bootstrap_instances(provider_name: str,
+                        config: common.ProvisionConfig
+                        ) -> common.ProvisionConfig:
+    """Provider-specific pre-launch setup (networks, firewalls, ...)."""
+    raise AssertionError  # replaced by router
+
+
+@_route('run_instances')
+def run_instances(provider_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create (or reuse/restart) instances. Idempotent."""
+    raise AssertionError
+
+
+@_route('wait_instances')
+def wait_instances(provider_name: str, cluster_name_on_cloud: str,
+                   region: str, zone: Optional[str],
+                   state: Optional[str]) -> None:
+    """Block until all instances reach `state` ('running'/'stopped')."""
+    raise AssertionError
+
+
+@_route('query_instances')
+def query_instances(
+        provider_name: str, cluster_name_on_cloud: str, region: str,
+        zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    """instance_id -> status string ('running'/'stopped'/'terminated')."""
+    raise AssertionError
+
+
+@_route('get_cluster_info')
+def get_cluster_info(provider_name: str, cluster_name_on_cloud: str,
+                     region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    raise AssertionError
+
+
+@_route('stop_instances')
+def stop_instances(provider_name: str, cluster_name_on_cloud: str,
+                   region: str, zone: Optional[str]) -> None:
+    raise AssertionError
+
+
+@_route('terminate_instances')
+def terminate_instances(provider_name: str, cluster_name_on_cloud: str,
+                        region: str, zone: Optional[str]) -> None:
+    raise AssertionError
+
+
+@_route('open_ports')
+def open_ports(provider_name: str, cluster_name_on_cloud: str,
+               ports: List[str], region: str,
+               zone: Optional[str]) -> None:
+    raise AssertionError
+
+
+@_route('cleanup_ports')
+def cleanup_ports(provider_name: str, cluster_name_on_cloud: str,
+                  region: str, zone: Optional[str]) -> None:
+    raise AssertionError

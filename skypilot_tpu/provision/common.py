@@ -1,0 +1,107 @@
+"""Provision-layer dataclasses.
+
+Re-design of reference ``sky/provision/common.py:39-109``
+(ProvisionConfig / ProvisionRecord / InstanceInfo / ClusterInfo), with
+TPU pod semantics: one *instance* may expose several *hosts* (the TPU-VM
+workers of a slice), each of which becomes a gang rank.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider plugin needs to create a cluster."""
+    provider_name: str
+    cluster_name: str
+    cluster_name_on_cloud: str
+    region: str
+    zone: Optional[str]
+    # Output of Cloud.make_deploy_resources_variables().
+    node_config: Dict[str, Any]
+    # Logical node count (slices for TPU; VMs otherwise).
+    count: int
+    # Authentication / ssh info.
+    ssh_user: str = 'skytpu'
+    ssh_private_key: Optional[str] = None
+    ports_to_open: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances."""
+    provider_name: str
+    cluster_name_on_cloud: str
+    region: str
+    zone: Optional[str]
+    # instance ids created or reused in this call
+    created_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    head_instance_id: Optional[str] = None
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One host (a TPU-VM worker or a VM)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    # index of this host within its instance (TPU worker index).
+    host_index: int = 0
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # ssh port (command runner)
+    ssh_port: int = 22
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Full description of a provisioned cluster's hosts."""
+    provider_name: str
+    cluster_name_on_cloud: str
+    region: str
+    zone: Optional[str]
+    # instance_id -> hosts of that instance (len>1 for TPU pod slices).
+    instances: Dict[str, List[InstanceInfo]]
+    head_instance_id: Optional[str]
+    ssh_user: str = 'skytpu'
+    # Provider-specific extras (e.g. TPU topology string).
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def all_hosts(self) -> List[InstanceInfo]:
+        """Hosts in stable rank order: head instance first, then by id;
+        within an instance, by host_index.
+
+        Rank = position in this list (reference rank assignment via
+        sorted stable IP list, cloud_vm_ray_backend.py:536-541).
+        """
+        out: List[InstanceInfo] = []
+        ids = sorted(self.instances)
+        if self.head_instance_id in self.instances:
+            ids.remove(self.head_instance_id)
+            ids.insert(0, self.head_instance_id)
+        for instance_id in ids:
+            hosts = sorted(self.instances[instance_id],
+                           key=lambda h: h.host_index)
+            out.extend(hosts)
+        return out
+
+    def ip_list(self) -> List[str]:
+        return [h.get_feasible_ip() for h in self.all_hosts()]
+
+    def num_hosts(self) -> int:
+        return sum(len(v) for v in self.instances.values())
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        hosts = self.instances.get(self.head_instance_id)
+        return hosts[0] if hosts else None

@@ -1,0 +1,215 @@
+"""Local provider — simulated instances backed by the local filesystem.
+
+The hermetic counterpart of a cloud plugin (SURVEY.md §4: the fake
+provisioner the reference lacks). A "cluster" is a directory under
+``$SKYTPU_DATA_DIR/local_cloud/<cluster>``; a simulated pod slice of N
+hosts is N host slots that all resolve to 127.0.0.1. Fault injection:
+``skypilot_tpu.provision.local.instance.preempt(cluster)`` flips the
+cluster to terminated, exactly like a spot reclaim, which the managed
+jobs tests use to exercise recovery.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu.provision import common
+
+
+def _root() -> str:
+    base = os.environ.get('SKYTPU_DATA_DIR',
+                          os.path.expanduser('~/.skytpu'))
+    return os.path.join(os.path.expanduser(base), 'local_cloud')
+
+
+def _cluster_dir(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_root(), cluster_name_on_cloud)
+
+
+def _meta_path(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name_on_cloud), 'metadata.json')
+
+
+def _read_meta(cluster_name_on_cloud: str) -> Optional[dict]:
+    try:
+        with open(_meta_path(cluster_name_on_cloud), encoding='utf-8') as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def _write_meta(cluster_name_on_cloud: str, meta: dict) -> None:
+    os.makedirs(_cluster_dir(cluster_name_on_cloud), exist_ok=True)
+    with open(_meta_path(cluster_name_on_cloud), 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+
+
+# ----------------------------------------------------------------------
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    return config
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    name = config.cluster_name_on_cloud
+    meta = _read_meta(name)
+    num_hosts = int(config.node_config.get('num_hosts', 1)) * config.count
+    created, resumed = [], []
+    if meta is None or meta.get('status') == 'terminated':
+        meta = {
+            'status': 'running',
+            'num_hosts': num_hosts,
+            'launched_at': time.time(),
+            'node_config': config.node_config,
+            'cluster_name': config.cluster_name,
+        }
+        created = [f'local-{name}-{i}' for i in range(num_hosts)]
+    elif meta.get('status') == 'stopped':
+        meta['status'] = 'running'
+        resumed = [f'local-{name}-{i}' for i in range(meta['num_hosts'])]
+    else:
+        if meta.get('num_hosts') != num_hosts:
+            raise RuntimeError(
+                f'Cluster {name} exists with {meta.get("num_hosts")} hosts; '
+                f'requested {num_hosts}.')
+    _write_meta(name, meta)
+    # Per-host state dirs (simulated filesystems for rank isolation).
+    for i in range(meta['num_hosts']):
+        os.makedirs(os.path.join(_cluster_dir(name), f'host{i}'),
+                    exist_ok=True)
+    return common.ProvisionRecord(
+        provider_name='local',
+        cluster_name_on_cloud=name,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=f'local-{name}-0',
+    )
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    meta = _read_meta(cluster_name_on_cloud)
+    want = state or 'running'
+    have = meta.get('status') if meta else 'terminated'
+    if want != have:
+        raise RuntimeError(
+            f'Local cluster {cluster_name_on_cloud} is {have}, '
+            f'expected {want}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    meta = _read_meta(cluster_name_on_cloud)
+    if meta is None:
+        return {}
+    status = meta['status']
+    if non_terminated_only and status == 'terminated':
+        return {}
+    return {
+        f'local-{cluster_name_on_cloud}-{i}': status
+        for i in range(meta['num_hosts'])
+    }
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    meta = _read_meta(cluster_name_on_cloud)
+    if meta is None or meta['status'] != 'running':
+        raise RuntimeError(
+            f'Local cluster {cluster_name_on_cloud} is not running.')
+    instance_id = f'local-{cluster_name_on_cloud}'
+    hosts = [
+        common.InstanceInfo(
+            instance_id=instance_id,
+            internal_ip='127.0.0.1',
+            external_ip='127.0.0.1',
+            host_index=i,
+            tags={'host_dir': os.path.join(_cluster_dir(
+                cluster_name_on_cloud), f'host{i}')},
+        ) for i in range(meta['num_hosts'])
+    ]
+    return common.ClusterInfo(
+        provider_name='local',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances={instance_id: hosts},
+        head_instance_id=instance_id,
+        ssh_user=os.environ.get('USER', 'root'),
+        provider_config={
+            'tpu_topology': meta.get('node_config', {}).get(
+                'tpu_topology', ''),
+            'cluster_dir': _cluster_dir(cluster_name_on_cloud),
+        },
+    )
+
+
+def _kill_agentd(cluster_name_on_cloud: str) -> None:
+    """Stop the cluster's agentd (real clouds lose it with the VM).
+
+    The pid file may be stale (agentd restart racing a teardown), so
+    also sweep by command line for this cluster's state dir.
+    """
+    from skypilot_tpu.utils import subprocess_utils
+    agent_dir = os.path.join(_cluster_dir(cluster_name_on_cloud), 'agent')
+    pid_path = os.path.join(agent_dir, 'agentd.pid')
+    me = os.getpid()
+    try:
+        with open(pid_path, encoding='utf-8') as f:
+            pid = int(f.read().strip())
+        # Autostop runs this *inside* agentd — never kill the caller
+        # (it exits itself after the stop completes).
+        if pid != me:
+            subprocess_utils.kill_process_tree(pid)
+    except (FileNotFoundError, ValueError):
+        pass
+    import psutil
+    for proc in psutil.process_iter(['cmdline']):
+        try:
+            cmdline = proc.info['cmdline'] or []
+            if proc.pid != me and (
+                    'skypilot_tpu.agent.agentd' in cmdline) and (
+                    agent_dir in cmdline):
+                subprocess_utils.kill_process_tree(proc.pid)
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            continue
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    _kill_agentd(cluster_name_on_cloud)
+    meta = _read_meta(cluster_name_on_cloud)
+    if meta is not None:
+        meta['status'] = 'stopped'
+        _write_meta(cluster_name_on_cloud, meta)
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    _kill_agentd(cluster_name_on_cloud)
+    shutil.rmtree(_cluster_dir(cluster_name_on_cloud), ignore_errors=True)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str], region: str,
+               zone: Optional[str]) -> None:
+    pass
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass
+
+
+# ----------------------------------------------------------------------
+# Fault injection (test-only API, mirrors a spot preemption).
+def preempt(cluster_name_on_cloud: str) -> None:
+    meta = _read_meta(cluster_name_on_cloud)
+    if meta is not None:
+        meta['status'] = 'terminated'
+        _write_meta(cluster_name_on_cloud, meta)

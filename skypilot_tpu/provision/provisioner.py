@@ -1,0 +1,208 @@
+"""Provision orchestration: bulk_provision + post-provision runtime setup.
+
+Re-design of reference ``sky/provision/provisioner.py:101,349,639``.
+bulk_provision drives one provider attempt (bootstrap -> run -> wait ->
+cluster info); post_provision_runtime_setup turns raw hosts into a
+usable cluster: reachability check, hosts.json for the gang driver,
+framework runtime install (real clouds), and the agentd daemon on the
+head host. TPU pods arrive gang-provisioned, so there is no Ray
+cluster to assemble (design delta (a) of SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+@timeline.event
+def bulk_provision(config: common.ProvisionConfig
+                   ) -> common.ProvisionRecord:
+    """One provisioning attempt against one (region, zone)."""
+    provider = config.provider_name
+    config = provision.bootstrap_instances(provider, config)
+    record = provision.run_instances(provider, config)
+    provision.wait_instances(provider, record.cluster_name_on_cloud,
+                             record.region, record.zone, state='running')
+    if config.ports_to_open:
+        provision.open_ports(provider, record.cluster_name_on_cloud,
+                             config.ports_to_open, record.region,
+                             record.zone)
+    return record
+
+
+def host_entries(cluster_info: common.ClusterInfo,
+                 ssh_private_key: Optional[str]) -> List[Dict]:
+    """hosts.json content: one entry per host in stable rank order."""
+    entries = []
+    for host in cluster_info.all_hosts():
+        host_dir = host.tags.get('host_dir')
+        if host_dir is not None:
+            entries.append({
+                'kind': 'local',
+                'host_id': f'{host.instance_id}-h{host.host_index}',
+                'ip': host.get_feasible_ip(),
+                'host_dir': host_dir,
+            })
+        else:
+            entries.append({
+                'kind': 'ssh',
+                'host_id': f'{host.instance_id}-h{host.host_index}',
+                'ip': host.get_feasible_ip(),
+                'user': cluster_info.ssh_user,
+                'key': ssh_private_key,
+                'port': host.ssh_port,
+            })
+    return entries
+
+
+def make_runners(cluster_info: common.ClusterInfo,
+                 ssh_private_key: Optional[str]
+                 ) -> List[runner_lib.CommandRunner]:
+    return [
+        runner_lib.runner_from_host_entry(e)
+        for e in host_entries(cluster_info, ssh_private_key)
+    ]
+
+
+def head_state_dir(cluster_info: common.ClusterInfo) -> str:
+    """Agent state dir on the head host.
+
+    Local clusters get a per-cluster dir (many clusters share this
+    machine); real clusters use the canonical home-dir location.
+    """
+    cluster_dir = cluster_info.provider_config.get('cluster_dir')
+    if cluster_dir is not None:
+        return os.path.join(cluster_dir, 'agent')
+    return agent_constants.DEFAULT_STATE_DIR
+
+
+def write_file_via_runner(runner: runner_lib.CommandRunner, path: str,
+                          content: str) -> None:
+    """Write a file on the host, safe against quoting (base64 transport)."""
+    import base64
+    encoded = base64.b64encode(content.encode()).decode()
+    quoted = runner_lib.shell_path(path)
+    runner.run(
+        f'mkdir -p $(dirname {quoted}) && '
+        f'echo {encoded} | base64 -d > {quoted}',
+        check=True)
+
+
+def wait_for_connectivity(runners: List[runner_lib.CommandRunner],
+                          timeout: float = 300.0) -> None:
+    """All hosts reachable (reference wait_for_ssh :349)."""
+
+    def check(runner: runner_lib.CommandRunner) -> None:
+        subprocess_utils.wait_for(runner.check_connection,
+                                  timeout=timeout,
+                                  interval=2.0,
+                                  desc=f'connectivity to {runner.host_id}')
+
+    subprocess_utils.run_in_parallel(check, runners)
+
+
+_RUNTIME_SETUP_SENTINEL = '~/.skytpu_runtime_ready'
+
+# Installs the framework on a real TPU-VM host. The package is rsynced
+# (not pip-published), mirroring the reference's wheel build+ship
+# (sky/backends/wheel_utils.py:140) with plain file sync.
+_REMOTE_PKG_DIR = '~/.skytpu_runtime/skypilot_tpu'
+
+
+def setup_runtime_on_cluster(runners: List[runner_lib.CommandRunner],
+                             log_dir: str) -> None:
+    """Ship the framework package to every host (skip if current)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def setup_one(pair) -> None:
+        idx, runner = pair
+        log_path = os.path.join(log_dir, f'runtime_setup-{idx}.log')
+        if isinstance(runner, runner_lib.LocalProcessRunner):
+            return  # already importable locally
+        runner.rsync(pkg_root + '/', _REMOTE_PKG_DIR, up=True,
+                     log_path=log_path)
+        sentinel = runner_lib.shell_path(_RUNTIME_SETUP_SENTINEL)
+        # Idempotent: the bashrc line is appended at most once.
+        runner.run(
+            f'if [ ! -f {sentinel} ]; then '
+            'echo "export PYTHONPATH=\\"$HOME/.skytpu_runtime:'
+            '$PYTHONPATH\\"" >> ~/.bashrc && '
+            f'touch {sentinel}; fi',
+            log_path=log_path, check=True)
+
+    subprocess_utils.run_in_parallel(setup_one, list(enumerate(runners)))
+
+
+def start_agent_on_head(head_runner: runner_lib.CommandRunner,
+                        state_dir: str, log_dir: str) -> None:
+    """Start (or restart) agentd detached on the head host."""
+    pid_file = runner_lib.shell_path(
+        os.path.join(state_dir, agent_constants.AGENT_PID_FILE))
+    agent_log = runner_lib.shell_path(
+        os.path.join(state_dir, agent_constants.AGENT_LOG))
+    state_q = runner_lib.shell_path(state_dir)
+    interval = agent_constants.EVENT_INTERVAL_SECONDS
+    cmd = (
+        f'mkdir -p {state_q} && '
+        f'if [ -f {pid_file} ] && '
+        f'kill -0 $(cat {pid_file}) 2>/dev/null; then '
+        f'echo agentd already running; else '
+        f'nohup python -u -m skypilot_tpu.agent.agentd '
+        f'--state-dir {state_q} --interval {interval} '
+        f'>> {agent_log} 2>&1 & '
+        f'echo started agentd pid $!; fi')
+    head_runner.run(cmd,
+                    log_path=os.path.join(log_dir, 'agent_start.log'),
+                    check=True)
+
+
+@timeline.event
+def post_provision_runtime_setup(
+        cluster_info: common.ClusterInfo,
+        ssh_private_key: Optional[str],
+        log_dir: str) -> str:
+    """Returns the head state dir after the cluster is fully usable."""
+    os.makedirs(os.path.expanduser(log_dir), exist_ok=True)
+    runners = make_runners(cluster_info, ssh_private_key)
+    if not runners:
+        raise exceptions.ProvisionError('Cluster has no hosts.')
+    wait_for_connectivity(runners)
+    setup_runtime_on_cluster(runners, log_dir)
+    state_dir = head_state_dir(cluster_info)
+    head_runner = runners[0]
+    entries = host_entries(cluster_info, ssh_private_key)
+    hosts_path = os.path.join(state_dir, agent_constants.HOSTS_FILE)
+    if isinstance(head_runner, runner_lib.LocalProcessRunner):
+        os.makedirs(os.path.expanduser(state_dir), exist_ok=True)
+        with open(os.path.expanduser(hosts_path), 'w',
+                  encoding='utf-8') as f:
+            json.dump(entries, f)
+    else:
+        write_file_via_runner(head_runner, hosts_path,
+                              json.dumps(entries))
+    start_agent_on_head(head_runner, state_dir, log_dir)
+    return state_dir
+
+
+def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
+                     region: str, zone: Optional[str],
+                     terminate: bool) -> None:
+    if terminate:
+        provision.terminate_instances(provider_name, cluster_name_on_cloud,
+                                      region, zone)
+    else:
+        provision.stop_instances(provider_name, cluster_name_on_cloud,
+                                 region, zone)

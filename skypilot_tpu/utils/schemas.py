@@ -8,8 +8,6 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import jsonschema
-
 from skypilot_tpu import exceptions
 
 _RESOURCES_SCHEMA = {
@@ -140,6 +138,9 @@ CONFIG_SCHEMA = {
 
 def validate(config: Dict[str, Any], schema: Dict[str, Any],
              what: str = 'task') -> None:
+    # Deferred: jsonschema's format registry costs >1s to import, which
+    # would tax every agent subprocess spawn.
+    import jsonschema
     try:
         jsonschema.validate(instance=config, schema=schema)
     except jsonschema.ValidationError as e:

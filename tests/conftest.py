@@ -17,6 +17,11 @@ if '--xla_force_host_platform_device_count' not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Agent/driver subprocesses inherit the environment; without this, the
+# image's sitecustomize imports jax (+1.7s) into every control-plane
+# process. Tests never need the TPU tunnel.
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,193 @@
+"""Hermetic end-to-end: launch → gang exec → logs → lifecycle on the
+Local cloud. This is the integration tier the reference lacks
+(SURVEY.md §4): the full control plane runs with real processes but no
+cloud APIs.
+"""
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.utils import status_lib
+
+JobStatus = status_lib.JobStatus
+
+
+def _wait_job(cluster: str, job_id: int, timeout: float = 30.0) -> JobStatus:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = core.job_status(cluster, [job_id])[job_id]
+        if st is not None and st.is_terminal():
+            return st
+        time.sleep(0.3)
+    raise TimeoutError(f'job {job_id} still not terminal; last={st}')
+
+
+def _job_log(handle, job_id: int) -> str:
+    path = os.path.expanduser(
+        log_lib.run_log_path(handle.state_dir, job_id))
+    with open(path, encoding='utf-8') as f:
+        return f.read()
+
+
+@pytest.fixture
+def cluster_name():
+    name = 'testc'
+    yield name
+    try:
+        core.down(name)
+    except exceptions.ClusterDoesNotExist:
+        pass
+
+
+def test_launch_single_node(cluster_name):
+    task = sky.Task('hello', run='echo hello-from-skytpu')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = sky.launch(task, cluster_name=cluster_name,
+                                stream_logs=False)
+    assert job_id == 1
+    assert _wait_job(cluster_name, job_id) == JobStatus.SUCCEEDED
+    assert 'hello-from-skytpu' in _job_log(handle, job_id)
+
+    # Cluster visible in status as UP.
+    records = core.status(cluster_name)
+    assert records and records[0]['status'] == (
+        status_lib.ClusterStatus.UP)
+
+
+def test_gang_execution_env_contract(cluster_name):
+    """A simulated v5e-16 slice: 4 hosts, rank env vars per host."""
+    task = sky.Task(
+        'gang',
+        run='echo RANK=$SKYTPU_NODE_RANK/$SKYTPU_NUM_NODES '
+            'TOPO=$SKYTPU_TPU_TOPOLOGY ACC=$SKYTPU_ACCELERATOR_TYPE '
+            'COORD=$SKYTPU_COORDINATOR_ADDR')
+    task.set_resources(
+        sky.Resources(cloud='local', accelerators='tpu-v5e-16'))
+    job_id, handle = sky.launch(task, cluster_name=cluster_name,
+                                stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.SUCCEEDED
+    log = _job_log(handle, job_id)
+    for rank in range(4):
+        assert f'RANK={rank}/4' in log
+    assert 'TOPO=4x4' in log
+    assert 'ACC=tpu-v5e-16' in log
+    assert 'COORD=127.0.0.1:8476' in log
+    # Merged log is rank-prefixed.
+    assert '(rank 3)' in log
+
+
+def test_exec_fast_path_and_queue(cluster_name):
+    task = sky.Task('first', run='echo one')
+    task.set_resources(sky.Resources(cloud='local'))
+    job1, handle = sky.launch(task, cluster_name=cluster_name,
+                              stream_logs=False)
+    assert _wait_job(cluster_name, job1) == JobStatus.SUCCEEDED
+
+    task2 = sky.Task('second', run='echo two')
+    job2, _ = sky.exec(task2, cluster_name)
+    assert job2 == 2
+    assert _wait_job(cluster_name, job2) == JobStatus.SUCCEEDED
+    assert 'two' in _job_log(handle, job2)
+
+    q = core.queue(cluster_name)
+    assert [j['job_id'] for j in q] == [2, 1]
+    assert all(j['status'] == 'SUCCEEDED' for j in q)
+
+
+def test_setup_failure(cluster_name):
+    task = sky.Task('badsetup', setup='exit 3', run='echo never')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = sky.launch(task, cluster_name=cluster_name,
+                           stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.FAILED_SETUP
+
+
+def test_run_failure(cluster_name):
+    task = sky.Task('badrun', run='exit 7')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = sky.launch(task, cluster_name=cluster_name,
+                           stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.FAILED
+
+
+def test_cancel_running_job(cluster_name):
+    task = sky.Task('sleepy', run='sleep 120')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = sky.launch(task, cluster_name=cluster_name,
+                           stream_logs=False)
+    # Wait for it to be RUNNING, then cancel.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if core.job_status(cluster_name,
+                           [job_id])[job_id] == JobStatus.RUNNING:
+            break
+        time.sleep(0.2)
+    cancelled = core.cancel(cluster_name, [job_id])
+    assert cancelled == [job_id]
+    assert core.job_status(cluster_name,
+                           [job_id])[job_id] == JobStatus.CANCELLED
+
+
+def test_workdir_and_callable_run(cluster_name, tmp_path):
+    workdir = tmp_path / 'wd'
+    workdir.mkdir()
+    (workdir / 'data.txt').write_text('payload42')
+    task = sky.Task('wd', run='cat data.txt', workdir=str(workdir))
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, handle = sky.launch(task, cluster_name=cluster_name,
+                                stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.SUCCEEDED
+    assert 'payload42' in _job_log(handle, job_id)
+
+    # Callable run: per-rank command generation.
+    task2 = sky.Task('call', run=lambda rank, ips: f'echo gen-rank-{rank}')
+    job2, _ = sky.exec(task2, cluster_name)
+    assert _wait_job(cluster_name, job2) == JobStatus.SUCCEEDED
+    assert 'gen-rank-0' in _job_log(handle, job2)
+
+
+def test_stop_start_cycle(cluster_name):
+    task = sky.Task('s', run='echo up')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = sky.launch(task, cluster_name=cluster_name,
+                           stream_logs=False)
+    _wait_job(cluster_name, job_id)
+    core.stop(cluster_name)
+    rec = core.status(cluster_name)[0]
+    assert rec['status'] == status_lib.ClusterStatus.STOPPED
+    # exec on a stopped cluster fails cleanly.
+    with pytest.raises(exceptions.ClusterNotUpError):
+        sky.exec(sky.Task(run='echo x'), cluster_name)
+    core.start(cluster_name)
+    rec = core.status(cluster_name, refresh=True)[0]
+    assert rec['status'] == status_lib.ClusterStatus.UP
+
+
+def test_down_removes_record(cluster_name):
+    task = sky.Task(run='echo bye')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = sky.launch(task, cluster_name=cluster_name,
+                           stream_logs=False)
+    _wait_job(cluster_name, job_id)
+    core.down(cluster_name)
+    assert core.status(cluster_name) == []
+    with pytest.raises(exceptions.ClusterDoesNotExist):
+        core.down(cluster_name)
+
+
+def test_tpu_pod_stop_rejected(cluster_name):
+    """TPU pods cannot be stopped (GCP semantics enforced at core)."""
+    task = sky.Task(run='echo x')
+    task.set_resources(
+        sky.Resources(cloud='gcp', accelerators='tpu-v5e-16'))
+    # Don't launch (no creds); validate the feature gate directly.
+    from skypilot_tpu.clouds import GCP, cloud as cloud_lib
+    r = next(iter(task.resources))
+    with pytest.raises(exceptions.NotSupportedError):
+        GCP.check_features_are_supported(
+            r, {cloud_lib.CloudImplementationFeatures.STOP})
