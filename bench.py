@@ -1,0 +1,111 @@
+"""Headline bench: Llama train-step MFU on the real TPU chip.
+
+Mirrors the reference's published TPU training benchmark
+(examples/tpu/v6e/train-llama3-8b.yaml: Llama-3-8B, seq 8192, bf16,
+FSDP, adafactor, flash attention → 0.476 samples/s on v6e-8, i.e.
+~487 tokens/s/chip). MFU is the hardware-normalized comparison:
+
+    baseline: 487 tok/s/chip x 5.9e10 FLOPs/tok (8B, seq 8192)
+              / 918e12 peak (v6e) = 3.1% MFU
+
+We run a 1B-class Llama train step (adafactor like the baseline, bf16
+compute, Pallas flash attention, remat) on whatever single chip is
+visible and report steady-state MFU; ``vs_baseline`` is the MFU ratio.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+# Peak bf16 TFLOP/s per chip by generation (public specs).
+_PEAK_TFLOPS = {'v2': 45.0, 'v3': 123.0, 'v4': 275.0, 'v5e': 197.0,
+                'v5p': 459.0, 'v6e': 918.0}
+
+# Reference baseline (examples/tpu/v6e/README.md:34-46 + recipe):
+# 0.476 samples/s, seq 8192, 8 chips, 8B params, v6e peak 918.
+_BASELINE_TOKENS_PER_SEC_PER_CHIP = 0.476 * 8192 / 8
+_BASELINE_FLOPS_PER_TOKEN = 6 * 8.03e9 + 6 * 32 * 8192 * 4096
+_BASELINE_MFU = (_BASELINE_TOKENS_PER_SEC_PER_CHIP *
+                 _BASELINE_FLOPS_PER_TOKEN / 918e12)
+
+
+def _detect_generation(device) -> str:
+    kind = getattr(device, 'device_kind', '').lower()
+    for gen in ('v6e', 'v5p', 'v5e', 'v5 lite', 'v4', 'v3', 'v2'):
+        if gen in kind:
+            return 'v5e' if gen == 'v5 lite' else gen
+    env = os.environ.get('PALLAS_AXON_TPU_GEN', '')
+    return env if env in _PEAK_TFLOPS else 'v5e'
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from skypilot_tpu import models
+
+    dev = jax.devices()[0]
+    gen = _detect_generation(dev)
+    peak = _PEAK_TFLOPS[gen] * 1e12
+    on_tpu = jax.default_backend() not in ('cpu',)
+
+    seq = int(os.environ.get('BENCH_SEQ', '8192'))
+    batch = int(os.environ.get('BENCH_BATCH', '2'))
+    steps = int(os.environ.get('BENCH_STEPS', '10'))
+    if not on_tpu:
+        # CPU smoke fallback so the bench never hard-fails.
+        seq, batch, steps = 256, 2, 2
+        cfg = models.LlamaConfig.tiny(max_seq=seq)
+    else:
+        cfg = models.LlamaConfig.tpu_1b(max_seq=seq)
+
+    from skypilot_tpu.models.llama import num_params
+    n_params = num_params(cfg)
+    # flops/token: 6N (matmuls fwd+bwd) + causal attention
+    # 6*L*S*d (QK^T + PV fwd+bwd, halved by causality).
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq * cfg.dim
+
+    # Adafactor matches the baseline recipe's --optim adafactor and has
+    # built-in update clipping (no extra full-size grad copy).
+    optimizer = optax.adafactor(3e-4)
+    state, optimizer = models.init_train_state(
+        cfg, jax.random.PRNGKey(0), optimizer=optimizer)
+    step_fn = models.make_train_step(cfg, optimizer)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch, seq + 1), 0, cfg.vocab_size)
+    batch_d = {'tokens': tokens}
+
+    # Warmup: compile + 1 step.
+    state, m = step_fn(state, batch_d)
+    jax.block_until_ready(m['loss'])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, batch_d)
+    jax.block_until_ready(m['loss'])
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    mfu = tokens_per_sec * flops_per_token / peak
+    result = {
+        'metric': 'llama_train_mfu',
+        'value': round(mfu * 100, 2),
+        'unit': '%',
+        'vs_baseline': round(mfu / _BASELINE_MFU, 2),
+        'detail': {
+            'tokens_per_sec_per_chip': round(tokens_per_sec, 1),
+            'step_time_s': round(dt, 4),
+            'seq': seq, 'batch': batch, 'n_params': n_params,
+            'chip': gen, 'backend': jax.default_backend(),
+            'baseline_mfu_pct': round(_BASELINE_MFU * 100, 2),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

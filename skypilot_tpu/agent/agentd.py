@@ -100,6 +100,11 @@ def main() -> None:
               encoding='utf-8') as f:
         f.write(str(os.getpid()))
 
+    # Restart recovery: reconcile jobs whose driver died while agentd
+    # was down (pid-liveness-checked, so drivers that survived an
+    # agentd-only restart are left alone).
+    job_lib.update_dead_drivers(state_dir)
+
     events = [JobSchedulerEvent(state_dir), AutostopEvent(state_dir)]
     if args.interval is not None:
         for e in events:

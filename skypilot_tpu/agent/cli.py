@@ -89,13 +89,19 @@ def main() -> None:
     elif args.op == 'job-status':
         job_lib.update_dead_drivers(state_dir)
         if args.job_ids:
-            jobs = [job_lib.get_job(state_dir, j) for j in args.job_ids]
+            # Unknown ids map to null (core.job_status's
+            # Dict[int, Optional[JobStatus]] contract).
+            emit({
+                str(jid): (j['status'].value if j is not None else None)
+                for jid in args.job_ids
+                for j in [job_lib.get_job(state_dir, jid)]
+            })
         else:
             jobs = job_lib.get_jobs(state_dir)[:1]
-        emit({
-            str(j['job_id']): j['status'].value
-            for j in jobs if j is not None
-        })
+            emit({
+                str(j['job_id']): j['status'].value
+                for j in jobs if j is not None
+            })
     elif args.op == 'queue':
         job_lib.update_dead_drivers(state_dir)
         jobs = job_lib.get_jobs(state_dir)

@@ -241,8 +241,3 @@ def cancel_job(state_dir: str, job_id: int) -> bool:
     schedule_step(state_dir)
     return True
 
-
-def fail_all_in_progress(state_dir: str) -> None:
-    """On agent restart after host reboot: no drivers survive."""
-    for job in get_jobs(state_dir, JobStatus.nonterminal_statuses()):
-        set_status(state_dir, job['job_id'], JobStatus.FAILED)

@@ -115,10 +115,9 @@ class RetryingProvisioner:
     """Candidate iteration with blocked-resource failover."""
 
     def __init__(self, cluster_name: str, cluster_name_on_cloud: str,
-                 log_dir: str, retry_until_up: bool) -> None:
+                 retry_until_up: bool) -> None:
         self._cluster_name = cluster_name
         self._cluster_name_on_cloud = cluster_name_on_cloud
-        self._log_dir = log_dir
         self._retry_until_up = retry_until_up
         # (region, zone) pairs proven unavailable this request.
         self._blocked: set = set()
@@ -241,7 +240,7 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 cluster_name_on_cloud = handle.cluster_name_on_cloud
 
             prov = RetryingProvisioner(cluster_name, cluster_name_on_cloud,
-                                       self.log_dir, retry_until_up)
+                                       retry_until_up)
             cluster_info = prov.provision_with_retries(
                 to_provision, task.num_nodes)
             launched = to_provision.copy(
@@ -356,14 +355,20 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
             'has_workdir': task.workdir is not None,
         }
 
+    @staticmethod
+    def _agent_cli_command(handle: GangResourceHandle,
+                           args: List[str]) -> str:
+        """The one place the on-host agent CLI invocation is built."""
+        return ('export PYTHONPATH="$HOME/.skytpu_runtime:$PYTHONPATH"; '
+                'python -u -m skypilot_tpu.agent.cli '
+                f'--state-dir {runner_lib.shell_path(handle.state_dir)} ' +
+                ' '.join(shlex.quote(a) for a in args))
+
     def run_on_head(self, handle: GangResourceHandle, args: List[str],
                     *, stream_logs: bool = False,
                     log_path: str = '/dev/null') -> Any:
         """Invoke the agent CLI on the head host; parse its JSON."""
-        cmd = ('export PYTHONPATH="$HOME/.skytpu_runtime:$PYTHONPATH"; '
-               'python -u -m skypilot_tpu.agent.cli '
-               f'--state-dir {runner_lib.shell_path(handle.state_dir)} ' +
-               ' '.join(shlex.quote(a) for a in args))
+        cmd = self._agent_cli_command(handle, args)
         runner = handle.head_runner()
         rc, stdout, stderr = runner.run(cmd, require_outputs=True,
                                         log_path=log_path)
@@ -403,10 +408,7 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
             args += ['--job-id', str(job_id)]
         if follow:
             args += ['--follow']
-        cmd = ('export PYTHONPATH="$HOME/.skytpu_runtime:$PYTHONPATH"; '
-               'python -u -m skypilot_tpu.agent.cli '
-               f'--state-dir {runner_lib.shell_path(handle.state_dir)} ' +
-               ' '.join(args))
+        cmd = self._agent_cli_command(handle, args)
         runner = handle.head_runner()
         return runner.run(cmd, stream_logs=True,
                           log_path=os.path.join(self.log_dir, 'tail.log'))

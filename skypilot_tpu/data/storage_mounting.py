@@ -75,7 +75,5 @@ def _host_path(runner: runner_lib.CommandRunner, path: str) -> str:
     """Local simulated hosts sandbox absolute paths under the host dir;
     real hosts use the path as-is."""
     if isinstance(runner, runner_lib.LocalProcessRunner):
-        if path.startswith('~'):
-            return runner.translate(path)
-        return os.path.join(runner.host_dir, path.lstrip('/'))
+        return runner.translate(path)
     return path

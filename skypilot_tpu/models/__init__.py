@@ -1,0 +1,20 @@
+"""Flagship JAX model family (Llama-style decoder) + sharded training.
+
+The reference ships models only as *recipes* (llm/llama-3_1-finetuning,
+llm/gpt-2 — user YAML invoking torchtune/llm.c; SURVEY.md §2.11). Our
+TPU-first build promotes the model layer to a library: a functional
+Llama implementation whose forward/train step is pjit-shardable over a
+(dp, fsdp, sp, tp) mesh, using the Pallas flash-attention kernel on TPU
+and ring attention for long-context sequence parallelism.
+"""
+from skypilot_tpu.models.llama import (LlamaConfig, forward, init_params,
+                                       loss_fn, param_specs)
+from skypilot_tpu.models.train import (TrainState, init_train_state,
+                                       make_eval_step, make_optimizer,
+                                       make_train_step, shard_batch)
+
+__all__ = [
+    'LlamaConfig', 'forward', 'init_params', 'loss_fn', 'param_specs',
+    'TrainState', 'init_train_state', 'make_eval_step', 'make_optimizer',
+    'make_train_step', 'shard_batch',
+]

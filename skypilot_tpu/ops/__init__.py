@@ -1,0 +1,12 @@
+"""TPU compute kernels (Pallas) + XLA reference implementations.
+
+The reference framework ships no kernels (it is pure-Python
+orchestration; SURVEY.md §2 native-code note) — its GPU recipes lean on
+torch/NCCL. Our TPU-first equivalent keeps the hot ops here: flash
+attention on the MXU via Pallas, with an XLA einsum reference used for
+CPU tests and as the autodiff fallback.
+"""
+from skypilot_tpu.ops.flash_attention import (flash_attention,
+                                              reference_attention)
+
+__all__ = ['flash_attention', 'reference_attention']

@@ -1,0 +1,425 @@
+"""Flash attention for TPU: Pallas forward + backward kernels.
+
+Forward: blockwise online-softmax tiled for the MXU — 128-lane blocks,
+f32 accumulation in VMEM scratch, the K dimension as the innermost
+'arbitrary' grid axis so the running (m, l, acc) state persists in
+scratch across K blocks. The log-sum-exp is saved (broadcast across a
+128-lane trailing dim, the standard TPU layout) for the backward.
+
+Backward: two kernels recomputing P from the saved lse — a dQ kernel
+(grid over Q blocks, accumulating over K blocks) and a dK/dV kernel
+(grid over K blocks, accumulating over Q blocks). Nothing of size
+S x S ever touches HBM, so memory stays O(S) and long-context training
+(seq 8k+) fits on one chip.
+
+Layout convention at the public API: [batch, seq, heads, head_dim]
+(model layout); kernels run in [batch, heads, seq, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def reference_attention(q, k, v, *, causal=True, scale=None):
+    """O(S^2)-memory einsum attention; ground truth for tests.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]. Supports GQA (H_kv divides H).
+    """
+    q, k, v = _repeat_kv(q, k, v)
+    if scale is None:
+        scale = q.shape[-1]**-0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos + (sk - sq) >= k_pos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(p.dtype),
+                   preferred_element_type=jnp.float32)
+    return o.astype(q.dtype)
+
+
+def _repeat_kv(q, k, v):
+    h_q, h_kv = q.shape[2], k.shape[2]
+    if h_q != h_kv:
+        assert h_q % h_kv == 0, (h_q, h_kv)
+        rep = h_q // h_kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return q, k, v
+
+
+try:  # Pallas import kept optional so control-plane never pays for it.
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _use_pallas():
+    return _HAS_PALLAS and jax.default_backend() == 'tpu'
+
+
+def _causal_mask(s, q_start, k_start, bq, bk):
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+# --------------------------------------------------------- forward kernel
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, scale, causal, block_q, block_k,
+                num_k_blocks):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: K blocks strictly above the diagonal contribute nothing.
+    run = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            s = _causal_mask(s, iq * block_q, ik * block_k, block_q,
+                             block_k)
+        m_prev = m_scr[:, :1]                         # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                # [bq, 1]
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[...] + jnp.log(l_safe))
+
+
+def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k,
+                      interpret):
+    """q,k,v: [B,H,S,D] -> (o [B,H,S,D], lse [B,H,S,128] f32)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk)
+    nq, nk = sq // block_q, sk // block_k
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               num_k_blocks=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, h, iq, ik: (b, h, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# -------------------------------------------------------- backward kernels
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k,
+               num_k_blocks):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        o = o_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, iq * block_q, ik * block_k, block_q,
+                             block_k)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)  # [bq, 1]
+        ds = p * (dp - delta) * scale                 # [bq, bk]
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
+                block_k, num_q_blocks):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        do = do_ref[0, 0].astype(jnp.float32)         # [bq, d]
+        o = o_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, iq * block_q, ik * block_k, block_q,
+                             block_k)
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        ds = p * (dp - delta) * scale                 # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q,
+                      block_k, interpret):
+    """All [B,H,S,D] (lse [B,H,S,128]); returns (dq, dk, dv)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq, nk = sq // block_q, sk // block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda b, h, i, j: (b, h, i, 0))
+    lse_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                            lambda b, h, i, j: (b, h, i, 0))
+    k_inner = pl.BlockSpec((1, 1, block_k, d),
+                           lambda b, h, i, j: (b, h, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_k_blocks=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, k_inner, k_inner, q_spec, q_spec, lse_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, o, lse)
+
+    # dK/dV: grid over K blocks; Q is the inner accumulation axis.
+    k_outer = pl.BlockSpec((1, 1, block_k, d),
+                           lambda b, h, i, j: (b, h, i, 0))
+    q_inner = pl.BlockSpec((1, 1, block_q, d),
+                           lambda b, h, i, j: (b, h, j, 0))
+    lse_inner = pl.BlockSpec((1, 1, block_q, _LANES),
+                             lambda b, h, i, j: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_q_blocks=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[q_inner, k_outer, k_outer, q_inner, q_inner,
+                  lse_inner],
+        out_specs=[k_outer, k_outer],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, o, lse)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------- XLA fallback
+
+
+def _xla_fwd(qt, kt, vt, *, causal, scale):
+    """[B,H,S,D] reference forward returning (o, lse [B,H,S,128])."""
+    s = jnp.einsum('bhqd,bhkd->bhqk', qt.astype(jnp.float32),
+                   kt.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = qt.shape[2], kt.shape[2]
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos + (sk - sq) >= k_pos, s, _NEG_INF)
+    lse = jax.nn.logsumexp(s, axis=-1)                # [B,H,Sq]
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum('bhqk,bhkd->bhqd', p, vt.astype(jnp.float32))
+    lse128 = jnp.broadcast_to(lse[..., None],
+                              lse.shape + (_LANES,))
+    return o.astype(qt.dtype), lse128
+
+
+def _xla_bwd(qt, kt, vt, ot, lse, dot_, *, causal, scale):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (qt, kt, vt))
+    of, dof = ot.astype(jnp.float32), dot_.astype(jnp.float32)
+    s = jnp.einsum('bhqd,bhkd->bhqk', qf, kf) * scale
+    if causal:
+        sq, sk = qf.shape[2], kf.shape[2]
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos + (sk - sq) >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., :1])
+    dv = jnp.einsum('bhqk,bhqd->bhkd', p, dof)
+    dp = jnp.einsum('bhqd,bhkd->bhqk', dof, vf)
+    delta = jnp.sum(dof * of, axis=-1)                # [B,H,Sq]
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum('bhqk,bhkd->bhqd', ds, kf)
+    dk = jnp.einsum('bhqk,bhqd->bhkd', ds, qf)
+    return (dq.astype(qt.dtype), dk.astype(kt.dtype),
+            dv.astype(vt.dtype))
+
+
+# ------------------------------------------------------------ custom vjp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    """[B,S,H,D] in/out; residuals for backward."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if _use_pallas():
+        ot, lse = _flash_fwd_pallas(qt, kt, vt, causal=causal,
+                                    scale=scale, block_q=block_q,
+                                    block_k=block_k, interpret=False)
+    else:
+        ot, lse = _xla_fwd(qt, kt, vt, causal=causal, scale=scale)
+    return ot.transpose(0, 2, 1, 3), (q, k, v, ot, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, ot, lse = res
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot_ = do.transpose(0, 2, 1, 3)
+    if _use_pallas():
+        dq, dk, dv = _flash_bwd_pallas(qt, kt, vt, ot, lse, dot_,
+                                       causal=causal, scale=scale,
+                                       block_q=block_q,
+                                       block_k=block_k,
+                                       interpret=False)
+    else:
+        dq, dk, dv = _xla_bwd(qt, kt, vt, ot, lse, dot_,
+                              causal=causal, scale=scale)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    *,
+                    causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128,
+                    block_k: int = 128) -> jax.Array:
+    """Flash attention, [batch, seq, heads, head_dim] layout, GQA-aware.
+
+    Dispatches to the Pallas TPU kernels on TPU backends and to exact
+    XLA implementations elsewhere; differentiable either way (the
+    backward never materializes an S x S matrix on TPU).
+    """
+    q, k, v = _repeat_kv(q, k, v)
+    if scale is None:
+        scale = q.shape[-1]**-0.5
+    return _flash(q, k, v, causal, scale, block_q, block_k)

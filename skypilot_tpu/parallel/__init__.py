@@ -1,0 +1,26 @@
+"""TPU-native parallelism layer.
+
+The reference framework (SkyPilot) implements no parallelism math — it
+delegates distributed training to user commands via injected env vars
+(SURVEY.md §2.11; sky/skylet/constants.py:325-328). Our TPU-first build
+promotes the "recipe" layer to a first-class library: device-mesh
+construction over ICI/DCN, named-sharding rules for tp/fsdp/dp/sp,
+`jax.distributed` bootstrap from the gang env contract, and ring
+attention (sequence/context parallelism) over the ICI torus.
+"""
+from skypilot_tpu.parallel.distributed import initialize_from_env
+from skypilot_tpu.parallel.mesh import (MeshPlan, make_mesh, plan_mesh)
+from skypilot_tpu.parallel.ring_attention import ring_attention
+from skypilot_tpu.parallel.sharding import (batch_spec, logical_to_spec,
+                                            shard_pytree)
+
+__all__ = [
+    'initialize_from_env',
+    'MeshPlan',
+    'make_mesh',
+    'plan_mesh',
+    'ring_attention',
+    'batch_spec',
+    'logical_to_spec',
+    'shard_pytree',
+]

@@ -1,0 +1,46 @@
+"""Multi-host JAX bootstrap from the gang env contract.
+
+Replaces the reference's torchrun/NCCL rendezvous (SURVEY.md §2.11:
+`examples/resnet_distributed_torch.yaml` feeds SKYPILOT_NODE_RANK to
+torch DDP). Here every TPU host of a gang-provisioned slice calls
+:func:`initialize_from_env` once at process start; the coordinator is
+rank 0's IP from the stable sorted host list.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu.utils import env_contract
+
+_initialized = False
+
+
+def initialize_from_env(env: Optional[dict] = None) -> bool:
+    """Initialize jax.distributed from SKYTPU_* env vars.
+
+    Returns True if multi-process initialization happened, False for
+    single-process (no-op). Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    kw = env_contract.jax_distributed_kwargs(env)
+    if kw['num_processes'] <= 1:
+        return False
+    import jax  # deferred: control-plane code must not import jax
+    jax.distributed.initialize(**kw)
+    _initialized = True
+    return True
+
+
+def process_info() -> dict:
+    """Rank/world info without requiring jax (for logging/recipes)."""
+    e = os.environ
+    return {
+        'rank': int(e.get(env_contract.NODE_RANK, '0')),
+        'world': int(e.get(env_contract.NUM_NODES, '1')),
+        'coordinator': e.get(env_contract.COORDINATOR_ADDR, ''),
+        'topology': e.get(env_contract.TPU_TOPOLOGY, ''),
+        'accelerator': e.get(env_contract.ACCELERATOR_TYPE, ''),
+    }

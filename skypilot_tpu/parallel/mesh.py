@@ -1,0 +1,109 @@
+"""Device-mesh construction for TPU slices.
+
+Axes convention (outer → inner, DCN-slowest → ICI-fastest):
+
+  ``dp``    pure data parallelism (replicated params)
+  ``fsdp``  data parallelism with fully-sharded params (ZeRO-3 style)
+  ``sp``    sequence/context parallelism (ring attention over ICI)
+  ``tp``    tensor (Megatron) parallelism — innermost, so its
+            collectives ride the fastest ICI links
+
+The reference has no equivalent (it is an orchestrator; SURVEY.md §2.11)
+— this is the TPU-native layer its recipes would otherwise hand-roll.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Optional, Sequence, Tuple
+
+AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A concrete axis-size assignment for a device count."""
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.sp * self.tp
+
+    def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        return tuple((a, getattr(self, a)) for a in AXIS_ORDER)
+
+
+def plan_mesh(num_devices: int,
+              *,
+              tp: int = 1,
+              sp: int = 1,
+              dp: int = 1,
+              fsdp: int = -1) -> MeshPlan:
+    """Fill in one -1 axis so the product equals ``num_devices``.
+
+    Default: everything not explicitly assigned goes to fsdp — the
+    right default for LLM training on a v5e/v6e 2D torus, where
+    fully-sharded params + ICI all-gather is the bandwidth-optimal
+    layout (scaling-book recipe).
+    """
+    sizes = {'dp': dp, 'fsdp': fsdp, 'sp': sp, 'tp': tp}
+    free = [a for a, s in sizes.items() if s == -1]
+    if len(free) > 1:
+        raise ValueError(f'At most one axis may be -1, got {free}')
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if free:
+        if num_devices % fixed:
+            raise ValueError(
+                f'{num_devices} devices not divisible by fixed axes '
+                f'product {fixed} ({sizes})')
+        sizes[free[0]] = num_devices // fixed
+    elif fixed != num_devices:
+        raise ValueError(
+            f'Axis product {fixed} != device count {num_devices}')
+    return MeshPlan(**sizes)
+
+
+def make_mesh(plan: Optional[MeshPlan] = None,
+              *,
+              devices: Optional[Sequence] = None,
+              axis_names: Sequence[str] = AXIS_ORDER,
+              **axis_sizes: int):
+    """Build a jax.sharding.Mesh from a plan (or kwargs like tp=4).
+
+    Uses ``jax.experimental.mesh_utils.create_device_mesh`` so the
+    logical mesh is laid out along the physical ICI torus — adjacent
+    mesh coordinates are ICI neighbors, which is what makes ring
+    collectives (sp) and tp all-reduces ride ICI instead of DCN.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+
+    if devices is None:
+        devices = jax.devices()
+    if plan is None:
+        plan = plan_mesh(len(devices), **{'fsdp': -1, **axis_sizes})
+    if plan.num_devices != len(devices):
+        raise ValueError(
+            f'Plan wants {plan.num_devices} devices, have {len(devices)}')
+    shape = tuple(getattr(plan, a) for a in axis_names)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        # Non-torus device sets (CPU test meshes) — plain reshape.
+        import numpy as np
+        dev_array = np.asarray(devices).reshape(shape)
+    return jax.sharding.Mesh(dev_array, axis_names)
+
+
+def mesh_from_env(**axis_sizes: int):
+    """Mesh over all visible devices, sized from the env contract.
+
+    On a gang-launched pod slice every host sees its local chips;
+    jax.devices() after initialize_from_env() returns the global
+    device list, so the same call works single-host and multi-host.
+    """
+    import jax
+    return make_mesh(devices=jax.devices(), **axis_sizes)

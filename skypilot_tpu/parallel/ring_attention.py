@@ -1,0 +1,126 @@
+"""Ring attention: exact attention over a sequence-sharded axis.
+
+Long-context support the reference lacks entirely (SURVEY.md §2.11:
+grep for ring/ulysses/context-parallel over the reference returns
+nothing). Each device holds a contiguous sequence chunk of Q, K, V; K/V
+chunks rotate around the ICI ring via ``lax.ppermute`` while each
+device accumulates its Q-block's attention with a numerically-stable
+online softmax (the flash-attention recurrence). Communication is
+neighbor-to-neighbor only, so on a TPU torus it rides ICI at full
+bisection bandwidth and overlaps with the per-step matmuls.
+
+Usage (inside shard_map/pjit with a mesh axis 'sp'):
+
+    out = ring_attention(q, k, v, axis_name='sp', causal=True)
+
+Shapes are per-shard [batch, seq/n, heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_update(q, k, v, o, m, l, q_offset, kv_offset, scale, causal):
+    """One flash-attention accumulation step of Q-block vs K/V-block.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; o: [B, Sq, H, D] f32;
+    m, l: [B, Sq, H] f32 running max / normalizer.
+    """
+    sq = q.shape[1]
+    sk = k.shape[1]
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    m_blk = jnp.max(s, axis=-1)                       # [B, H, Sq]
+    m_new = jnp.maximum(m, m_blk.transpose(0, 2, 1))  # [B, Sq, H]
+    # exp with the new running max; fully-masked rows stay at 0.
+    p = jnp.exp(s - m_new.transpose(0, 2, 1)[..., None])  # [B,H,Sq,Sk]
+    corr = jnp.exp(m - m_new)                             # [B, Sq, H]
+    l_new = l * corr + jnp.sum(p, axis=-1).transpose(0, 2, 1)
+    pv = jnp.einsum('bhqk,bkhd->bqhd', p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    o_new = o * corr[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   *,
+                   axis_name: str,
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact (flash-equivalent) attention over a ring-sharded sequence.
+
+    Args:
+      q, k, v: per-shard [batch, local_seq, heads, head_dim]. For GQA,
+        repeat K/V heads to match Q before calling.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: apply a causal mask using *global* positions.
+      scale: softmax scale; default 1/sqrt(head_dim).
+
+    Returns per-shard [batch, local_seq, heads, head_dim], dtype of q.
+    """
+    if scale is None:
+        scale = q.shape[-1]**-0.5
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Derive the initial accumulators from q (not fresh jnp.zeros) so
+    # they carry shard_map's varying-manual-axes type for lax.scan.
+    qf = q.astype(jnp.float32)
+    o0 = jnp.zeros_like(qf)
+    m0 = jnp.full_like(qf[..., 0], _NEG_INF) + 0.0 * qf[..., 0]
+    l0 = jnp.zeros_like(qf[..., 0])
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # After i rotations device my_idx holds chunk (my_idx - i) mod n.
+        src = (my_idx - i) % n
+        o, m, l = _block_update(q, k_cur, v_cur, o, m, l,
+                                q_offset=my_idx * s_local,
+                                kv_offset=src * s_local,
+                                scale=scale, causal=causal)
+        # Rotate AFTER compute so XLA can overlap the ppermute DMA with
+        # the next step's matmuls (double-buffered on ICI).
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    # Guard against fully-masked rows (cannot happen for causal
+    # self-attention, but keeps the non-causal edge cases NaN-free).
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = 'sp',
+                           causal: bool = True):
+    """Convenience wrapper: shard_map ring_attention over ``mesh``.
+
+    q/k/v are global arrays [batch, seq, heads, head_dim]; sequence is
+    sharded over ``axis_name``, batch over the data axes.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    spec = P(('dp', 'fsdp'), axis_name, 'tp', None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

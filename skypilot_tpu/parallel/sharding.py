@@ -1,0 +1,55 @@
+"""Logical-axis → mesh-axis sharding rules.
+
+A thin, dependency-free take on flax's logical partitioning: model code
+annotates arrays with *logical* axis names ('batch', 'seq', 'embed',
+'heads', 'mlp', 'vocab'); a rule table maps those to mesh axes. The
+table below is the Megatron+FSDP layout from the scaling-book recipe:
+params shard over ('fsdp', 'tp'), activations over (('dp','fsdp'),
+'sp') — so the tp all-reduce and the sp ring ride ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+AxisName = Union[str, Tuple[str, ...], None]
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None=replicate)
+DEFAULT_RULES = {
+    'batch': ('dp', 'fsdp'),   # activations: batch over all data axes
+    'seq': 'sp',               # activations: sequence/context parallel
+    'embed': 'fsdp',           # params: ZeRO-3 shard of the d_model dim
+    'heads': 'tp',             # params+acts: attention heads tensor-par
+    'kv_heads': 'tp',
+    'mlp': 'tp',               # params: ffn hidden dim tensor-parallel
+    'vocab': 'tp',             # params: embedding/lm-head vocab dim
+    'head_dim': None,
+    'layers': None,
+    None: None,
+}
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]],
+                    rules: Optional[dict] = None):
+    """('batch','seq',None) -> PartitionSpec(('dp','fsdp'),'sp',None)."""
+    from jax.sharding import PartitionSpec
+    rules = DEFAULT_RULES if rules is None else rules
+    return PartitionSpec(*(rules.get(ax) for ax in logical_axes))
+
+
+def batch_spec():
+    """PartitionSpec for a [batch, seq, ...] activation."""
+    return logical_to_spec(('batch', 'seq'))
+
+
+def named_sharding(mesh, *logical_axes, rules: Optional[dict] = None):
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def shard_pytree(tree, spec_tree, mesh):
+    """Device-put a pytree of arrays with a matching pytree of specs."""
+    import jax
+    from jax.sharding import NamedSharding
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree,
+        spec_tree)

@@ -16,6 +16,7 @@ import shutil
 import time
 from typing import Dict, List, Optional
 
+from skypilot_tpu import exceptions
 from skypilot_tpu.provision import common
 
 
@@ -72,7 +73,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         resumed = [f'local-{name}-{i}' for i in range(meta['num_hosts'])]
     else:
         if meta.get('num_hosts') != num_hosts:
-            raise RuntimeError(
+            raise exceptions.ProvisionError(
                 f'Cluster {name} exists with {meta.get("num_hosts")} hosts; '
                 f'requested {num_hosts}.')
     _write_meta(name, meta)
@@ -97,7 +98,7 @@ def wait_instances(cluster_name_on_cloud: str, region: str,
     want = state or 'running'
     have = meta.get('status') if meta else 'terminated'
     if want != have:
-        raise RuntimeError(
+        raise exceptions.ProvisionError(
             f'Local cluster {cluster_name_on_cloud} is {have}, '
             f'expected {want}.')
 
@@ -121,7 +122,7 @@ def get_cluster_info(cluster_name_on_cloud: str, region: str,
                      zone: Optional[str]) -> common.ClusterInfo:
     meta = _read_meta(cluster_name_on_cloud)
     if meta is None or meta['status'] != 'running':
-        raise RuntimeError(
+        raise exceptions.ProvisionError(
             f'Local cluster {cluster_name_on_cloud} is not running.')
     instance_id = f'local-{cluster_name_on_cloud}'
     hosts = [

@@ -106,9 +106,16 @@ class LocalProcessRunner(CommandRunner):
         os.makedirs(self.host_dir, exist_ok=True)
 
     def translate(self, path: str) -> str:
-        """Map a remote-style path (~/...) into the sandbox."""
+        """Map a remote-style path into the sandbox.
+
+        Both ``~/...`` and absolute paths resolve under the host dir —
+        a simulated host must never write to the real filesystem root
+        (e.g. ``file_mounts: {/data: ./x}``).
+        """
         if path.startswith('~'):
             return os.path.join(self.host_dir, path.lstrip('~/'))
+        if os.path.isabs(path):
+            return os.path.join(self.host_dir, path.lstrip('/'))
         return path
 
     def run(self,
@@ -273,8 +280,11 @@ class SSHCommandRunner(CommandRunner):
             ['ssh'] + SSH_OPTIONS +
             ['-o', f'ControlPath={self._control_path}',
              '-i', self.ssh_private_key, '-p', str(self.port)])
+        # No --delete: merge semantics (matching LocalProcessRunner's
+        # copytree) so re-syncing a workdir never destroys artifacts a
+        # job already wrote on the remote side.
         rsync_cmd = [
-            'rsync', '-avz', '--delete-excluded', '--exclude', '.git',
+            'rsync', '-avz', '--exclude', '.git',
             '-e', ssh_cmd,
         ]
         if up:

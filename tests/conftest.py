@@ -8,12 +8,19 @@ JAX tests run on a virtual 8-device CPU mesh.
 import os
 import sys
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Tests always run on a virtual 8-device CPU mesh. The image's
+# sitecustomize imports jax and registers the real-TPU PJRT plugin at
+# interpreter start, so env vars are too late — force the platform via
+# jax.config before any backend is initialized.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
