@@ -1,0 +1,88 @@
+"""SSH keypair management for cluster access.
+
+Re-design of reference ``sky/authentication.py:1-514``: one framework
+keypair (generated lazily), injected into instances via cloud metadata
+(GCP 'ssh-keys' / TPU-VM metadata) so every provisioned host accepts
+the client's SSH connections as the framework user.
+"""
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_SSH_USER = 'skytpu'
+_KEY_DIR = '~/.skytpu/keys'
+PRIVATE_KEY_PATH = f'{_KEY_DIR}/skytpu.pem'
+PUBLIC_KEY_PATH = f'{_KEY_DIR}/skytpu.pem.pub'
+
+
+def _generate_with_cryptography(priv: str, pub: str) -> None:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+    key = ed25519.Ed25519PrivateKey.generate()
+    priv_bytes = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    pub_bytes = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(priv, 'wb') as f:
+        f.write(priv_bytes)
+    with open(pub, 'wb') as f:
+        f.write(pub_bytes + b'\n')
+
+
+def _derive_public_key(priv: str, pub: str) -> None:
+    """Recreate the .pub from an existing private key (never overwrite
+    the private key — it is already injected into running clusters)."""
+    try:
+        from cryptography.hazmat.primitives import serialization
+        with open(priv, 'rb') as f:
+            key = serialization.load_ssh_private_key(f.read(), None)
+        pub_bytes = key.public_key().public_bytes(
+            encoding=serialization.Encoding.OpenSSH,
+            format=serialization.PublicFormat.OpenSSH)
+        with open(pub, 'wb') as f:
+            f.write(pub_bytes + b'\n')
+    except ImportError:
+        with open(pub, 'w', encoding='utf-8') as f:
+            subprocess.run(['ssh-keygen', '-y', '-f', priv], check=True,
+                           stdout=f)
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), generating once."""
+    priv = os.path.expanduser(PRIVATE_KEY_PATH)
+    pub = os.path.expanduser(PUBLIC_KEY_PATH)
+    if os.path.exists(priv):
+        if not os.path.exists(pub):
+            _derive_public_key(priv, pub)
+        return priv, pub
+    os.makedirs(os.path.dirname(priv), exist_ok=True)
+    try:
+        _generate_with_cryptography(priv, pub)
+    except ImportError:
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', priv],
+            check=True)
+    os.chmod(priv, stat.S_IRUSR | stat.S_IWUSR)
+    logger.info('Generated SSH keypair at %s', priv)
+    return priv, pub
+
+
+def public_key_openssh() -> str:
+    _, pub = get_or_generate_keys()
+    with open(pub, 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def ssh_keys_metadata_value(user: str = DEFAULT_SSH_USER) -> str:
+    """GCE/TPU 'ssh-keys' metadata entry: '<user>:<openssh pubkey>'."""
+    return f'{user}:{public_key_openssh()}'

@@ -247,7 +247,16 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 region=cluster_info.region,
                 zone=cluster_info.zone,
             )
-            ssh_key = os.path.expanduser('~/.skytpu/keys/skytpu.pem')
+            # Generate the framework keypair only when a real (SSH)
+            # host is present; local simulated hosts need no key.
+            needs_ssh = any(
+                h.tags.get('host_dir') is None
+                for h in cluster_info.all_hosts())
+            if needs_ssh:
+                from skypilot_tpu import authentication
+                ssh_key, _ = authentication.get_or_generate_keys()
+            else:
+                ssh_key = None
             state_dir = provisioner.post_provision_runtime_setup(
                 cluster_info,
                 ssh_private_key=ssh_key,

@@ -48,20 +48,24 @@ def _state_specs(cfg: llama.LlamaConfig, optimizer, params_shape):
     pspecs = llama.param_specs(cfg)
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
 
-    # Adam moments mirror the param tree inside each optax state leaf;
-    # map specs onto them by matching array shapes, replicate scalars.
-    flat_params, _ = jax.tree_util.tree_flatten(params_shape)
-    flat_specs = jax.tree_util.tree_flatten(pspecs)[0]
-    shape_to_spec = {}
-    for p, s in zip(flat_params, flat_specs):
-        shape_to_spec.setdefault(p.shape, s)
+    # Optimizer moments mirror the param tree inside each optax state
+    # leaf-tree. Match by TREE PATH SUFFIX, not by array shape — e.g.
+    # wq and wo have identical shapes (dim == n_heads*head_dim) but
+    # transposed PartitionSpecs, so shape matching would mis-shard one
+    # of them and insert all-to-alls every step.
+    path_to_spec = {}
+    for path, spec in jax.tree_util.tree_flatten_with_path(pspecs)[0]:
+        path_to_spec[tuple(str(k) for k in path)] = spec
 
-    def match(x):
-        if hasattr(x, 'shape') and x.shape in shape_to_spec:
-            return shape_to_spec[x.shape]
+    def match(path, x):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):
+            spec = path_to_spec.get(keys[start:])
+            if spec is not None and hasattr(x, 'shape'):
+                return spec
         return P()
 
-    opt_specs = jax.tree.map(match, opt_shape)
+    opt_specs = jax.tree_util.tree_map_with_path(match, opt_shape)
     return TrainState(params=pspecs, opt_state=opt_specs,
                       step=P())
 

@@ -71,8 +71,12 @@ def _use_pallas():
     return _HAS_PALLAS and jax.default_backend() == 'tpu'
 
 
-def _causal_mask(s, q_start, k_start, bq, bk):
-    q_pos = q_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+def _causal_mask(s, q_start, k_start, bq, bk, offset):
+    """Bottom-right-aligned causal mask: q_pos + offset >= k_pos,
+    offset = Sk - Sq (matches reference_attention / _xla_fwd so TPU and
+    fallback agree when Sq != Sk, e.g. decode against a KV cache)."""
+    q_pos = q_start + offset + lax.broadcasted_iota(
+        jnp.int32, (bq, bk), 0)
     k_pos = k_start + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
@@ -82,7 +86,7 @@ def _causal_mask(s, q_start, k_start, bq, bk):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 acc_scr, *, scale, causal, block_q, block_k,
-                num_k_blocks):
+                num_k_blocks, mask_offset):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -93,7 +97,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # Causal: K blocks strictly above the diagonal contribute nothing.
-    run = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+    run = (((iq + 1) * block_q - 1 + mask_offset >= ik * block_k)
+           if causal else True)
 
     @pl.when(run)
     def _compute():
@@ -104,7 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         if causal:
             s = _causal_mask(s, iq * block_q, ik * block_k, block_q,
-                             block_k)
+                             block_k, mask_offset)
         m_prev = m_scr[:, :1]                         # [bq, 1]
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -138,7 +143,7 @@ def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k,
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               num_k_blocks=nk)
+                               num_k_blocks=nk, mask_offset=sk - sq)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -178,7 +183,7 @@ def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                dq_scr, *, scale, causal, block_q, block_k,
-               num_k_blocks):
+               num_k_blocks, mask_offset):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -186,7 +191,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    run = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+    run = (((iq + 1) * block_q - 1 + mask_offset >= ik * block_k)
+           if causal else True)
 
     @pl.when(run)
     def _compute():
@@ -201,7 +207,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, iq * block_q, ik * block_k, block_q,
-                             block_k)
+                             block_k, mask_offset)
         p = jnp.exp(s - lse)                          # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -219,7 +225,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
                 dv_ref, dk_scr, dv_scr, *, scale, causal, block_q,
-                block_k, num_q_blocks):
+                block_k, num_q_blocks, mask_offset):
     ik = pl.program_id(2)
     iq = pl.program_id(3)
 
@@ -228,7 +234,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = ((iq + 1) * block_q - 1 >= ik * block_k) if causal else True
+    run = (((iq + 1) * block_q - 1 + mask_offset >= ik * block_k)
+           if causal else True)
 
     @pl.when(run)
     def _compute():
@@ -243,7 +250,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, iq * block_q, ik * block_k, block_q,
-                             block_k)
+                             block_k, mask_offset)
         p = jnp.exp(s - lse)                          # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -282,7 +289,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q,
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          num_k_blocks=nk),
+                          num_k_blocks=nk, mask_offset=sk - sq),
         grid=(b, h, nq, nk),
         in_specs=[q_spec, k_inner, k_inner, q_spec, q_spec, lse_spec],
         out_specs=q_spec,
@@ -304,7 +311,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          num_q_blocks=nq),
+                          num_q_blocks=nq, mask_offset=sk - sq),
         grid=(b, h, nk, nq),
         in_specs=[q_inner, k_outer, k_outer, q_inner, q_inner,
                   lse_inner],

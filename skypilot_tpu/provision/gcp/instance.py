@@ -1,0 +1,406 @@
+"""GCP provision plugin: TPU pod slices (first-class) + plain GCE VMs.
+
+Re-design of reference ``sky/provision/gcp/instance.py`` +
+``instance_utils.py:1191`` (GCPTPUVMInstance): a TPU *node* is an
+atomic pod slice — one create call gang-provisions all hosts, and its
+``networkEndpoints`` ARE the gang rank order. GCE VMs serve CPU tasks
+and controllers. All ops are stateless module functions dispatched by
+``skypilot_tpu.provision`` (the ProvisionConfig/ClusterInfo contract).
+
+Naming: a cluster maps to TPU node id ``{cluster_name_on_cloud}`` (one
+slice per logical node; multi-slice clusters use ``-{i}`` suffixes) or
+GCE instances ``{cluster_name_on_cloud}-{i}``. Everything is labeled
+``skytpu-cluster={cluster_name_on_cloud}`` for reconciliation queries.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_LABEL = 'skytpu-cluster'
+
+# TPU node states (cloud.google.com/tpu/docs/reference/rest/v2).
+_TPU_RUNNING = ('READY',)
+_TPU_PENDING = ('CREATING', 'STARTING', 'REPAIRING', 'RESTARTING')
+_TPU_STOPPED = ('STOPPED', 'STOPPING', 'SUSPENDED')
+# GCE instance states.
+_GCE_RUNNING = ('RUNNING',)
+_GCE_PENDING = ('PROVISIONING', 'STAGING')
+_GCE_STOPPED = ('STOPPING', 'TERMINATED', 'SUSPENDED')
+
+_DEFAULT_IMAGE = ('projects/debian-cloud/global/images/family/'
+                  'debian-12')
+
+
+@functools.lru_cache()
+def _project() -> str:
+    import google.auth
+    _, project = google.auth.default()
+    if not project:
+        raise exceptions.ProvisionError(
+            'No default GCP project; run '
+            '`gcloud auth application-default login`.')
+    return project
+
+
+def _tpu() -> api.TpuClient:
+    return api.TpuClient(_project())
+
+
+def _gce() -> api.GceClient:
+    return api.GceClient(_project())
+
+
+def _slice_ids(name: str, count: int) -> List[str]:
+    """TPU node ids for `count` logical nodes (slices)."""
+    if count == 1:
+        return [name]
+    return [f'{name}-{i}' for i in range(count)]
+
+
+# ---------------------------------------------------------------- ops
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    """Nothing to pre-create: default VPC, metadata-injected SSH keys."""
+    return config
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    if config.node_config.get('tpu_vm'):
+        return _run_tpu_nodes(config)
+    return _run_gce_instances(config)
+
+
+def _tpu_create_body(config: common.ProvisionConfig) -> Dict[str, Any]:
+    nc = config.node_config
+    body: Dict[str, Any] = {
+        'acceleratorType': nc['tpu_type'],
+        'runtimeVersion': nc['runtime_version'],
+        'networkConfig': {
+            'enableExternalIps': True,
+        },
+        'labels': {
+            _LABEL: config.cluster_name_on_cloud,
+            **nc.get('labels', {}),
+        },
+        'metadata': {
+            'ssh-keys': authentication.ssh_keys_metadata_value(
+                config.ssh_user),
+        },
+    }
+    if nc.get('use_spot'):
+        body['schedulingConfig'] = {'preemptible': True}
+    if nc.get('network_tier') == 'best':
+        body['networkConfig']['networkTier'] = 'PREMIUM'
+    return body
+
+
+def _run_tpu_nodes(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    zone = config.zone
+    assert zone is not None, 'TPU provisioning requires a zone.'
+    tpu = _tpu()
+    created, resumed = [], []
+    pending_ops = []  # (op, what) — issued concurrently, awaited below
+    for node_id in _slice_ids(config.cluster_name_on_cloud, config.count):
+        try:
+            node = tpu.get_node(zone, node_id)
+        except exceptions.ClusterDoesNotExist:
+            node = None
+        if node is None:
+            logger.info('Creating TPU node %s (%s) in %s...', node_id,
+                        config.node_config['tpu_type'], zone)
+            pending_ops.append(
+                (tpu.create_node_async(zone, node_id,
+                                       _tpu_create_body(config)),
+                 f'create TPU {node_id}'))
+            created.append(node_id)
+        elif node.get('state') in _TPU_STOPPED:
+            logger.info('Starting stopped TPU node %s...', node_id)
+            pending_ops.append((tpu.start_node_async(zone, node_id),
+                                f'start TPU {node_id}'))
+            resumed.append(node_id)
+        elif node.get('state') in _TPU_RUNNING + _TPU_PENDING:
+            logger.info('Reusing TPU node %s (state %s).', node_id,
+                        node.get('state'))
+        else:
+            raise exceptions.ProvisionError(
+                f'TPU node {node_id} in unexpected state '
+                f'{node.get("state")}; delete it first.')
+    # All slices create in parallel; stockouts surface at wait time
+    # instead of serializing slice-by-slice.
+    for op, what in pending_ops:
+        tpu.wait_operation(op, what)
+    ids = _slice_ids(config.cluster_name_on_cloud, config.count)
+    return common.ProvisionRecord(
+        provider_name='gcp',
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        region=config.region,
+        zone=zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=ids[0],
+    )
+
+
+def _gce_create_body(config: common.ProvisionConfig,
+                     name: str) -> Dict[str, Any]:
+    nc = config.node_config
+    zone = config.zone
+    machine = nc['instance_type']
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': f'zones/{zone}/machineTypes/{machine}',
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': nc.get('image_id') or _DEFAULT_IMAGE,
+                'diskSizeGb': str(nc.get('disk_size', 256)),
+            },
+        }],
+        'networkInterfaces': [{
+            'network': 'global/networks/default',
+            'accessConfigs': [{
+                'name': 'External NAT',
+                'type': 'ONE_TO_ONE_NAT',
+            }],
+        }],
+        'labels': {
+            _LABEL: config.cluster_name_on_cloud,
+            **nc.get('labels', {}),
+        },
+        'metadata': {
+            'items': [{
+                'key': 'ssh-keys',
+                'value': authentication.ssh_keys_metadata_value(
+                    config.ssh_user),
+            }],
+        },
+    }
+    if nc.get('use_spot'):
+        body['scheduling'] = {
+            'provisioningModel': 'SPOT',
+            'instanceTerminationAction': 'TERMINATE',
+        }
+    return body
+
+
+def _run_gce_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    zone = config.zone
+    assert zone is not None, 'GCE provisioning requires a zone.'
+    gce = _gce()
+    existing = {
+        inst['name']: inst
+        for inst in gce.list_instances(
+            zone, f'labels.{_LABEL}={config.cluster_name_on_cloud}')
+    }
+    created, resumed = [], []
+    names = [
+        f'{config.cluster_name_on_cloud}-{i}' for i in range(config.count)
+    ]
+    for name in names:
+        inst = existing.get(name)
+        if inst is None:
+            logger.info('Creating VM %s in %s...', name, zone)
+            gce.insert_instance(zone, _gce_create_body(config, name))
+            created.append(name)
+        elif inst.get('status') in _GCE_STOPPED:
+            logger.info('Starting stopped VM %s...', name)
+            gce.start_instance(zone, name)
+            resumed.append(name)
+    return common.ProvisionRecord(
+        provider_name='gcp',
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        region=config.region,
+        zone=zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=names[0],
+    )
+
+
+def _find_cluster(cluster_name_on_cloud: str, zone: str):
+    """Returns ('tpu'|'gce'|None, [raw instance/node dicts])."""
+    tpu_nodes = [
+        n for n in _tpu().list_nodes(zone)
+        if n.get('labels', {}).get(_LABEL) == cluster_name_on_cloud
+    ]
+    if tpu_nodes:
+        return 'tpu', tpu_nodes
+    vms = _gce().list_instances(
+        zone, f'labels.{_LABEL}={cluster_name_on_cloud}')
+    if vms:
+        return 'gce', vms
+    return None, []
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    import time
+    assert zone is not None
+    want = state or 'running'
+    deadline = time.time() + 1200
+    while True:
+        statuses = query_instances(cluster_name_on_cloud, region, zone,
+                                   non_terminated_only=False)
+        if not statuses:
+            raise exceptions.ProvisionError(
+                f'No instances found for {cluster_name_on_cloud}.')
+        if all(s == want for s in statuses.values()):
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'{cluster_name_on_cloud}: instances stuck in '
+                f'{statuses}; wanted {want}.')
+        time.sleep(5)
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    """instance_id -> 'running'|'pending'|'stopped'|'terminated'."""
+    del region
+    assert zone is not None
+    kind, items = _find_cluster(cluster_name_on_cloud, zone)
+    out: Dict[str, Optional[str]] = {}
+    for item in items:
+        raw = item.get('state' if kind == 'tpu' else 'status', '')
+        if raw in (_TPU_RUNNING + _GCE_RUNNING):
+            status = 'running'
+        elif raw in (_TPU_PENDING + _GCE_PENDING):
+            status = 'pending'
+        elif raw in (_TPU_STOPPED + _GCE_STOPPED):
+            status = 'stopped'
+        else:
+            status = 'terminated'
+        if non_terminated_only and status == 'terminated':
+            continue
+        name = item['name'].split('/')[-1]
+        out[name] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    assert zone is not None
+    kind, items = _find_cluster(cluster_name_on_cloud, zone)
+    if kind is None:
+        raise exceptions.ProvisionError(
+            f'Cluster {cluster_name_on_cloud} not found in {zone}.')
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    provider_config: Dict[str, Any] = {}
+    if kind == 'tpu':
+        for node in sorted(items, key=lambda n: n['name']):
+            node_id = node['name'].split('/')[-1]
+            hosts = []
+            for i, ep in enumerate(node.get('networkEndpoints', [])):
+                ext = (ep.get('accessConfig') or {}).get('externalIp')
+                hosts.append(
+                    common.InstanceInfo(
+                        instance_id=node_id,
+                        internal_ip=ep.get('ipAddress', ''),
+                        external_ip=ext,
+                        host_index=i,
+                    ))
+            instances[node_id] = hosts
+        provider_config['tpu_topology'] = items[0].get(
+            'acceleratorConfig', {}).get('topology', '')
+    else:
+        for vm in sorted(items, key=lambda v: v['name']):
+            nic = (vm.get('networkInterfaces') or [{}])[0]
+            ext = None
+            for ac in nic.get('accessConfigs', []):
+                ext = ac.get('natIP') or ext
+            instances[vm['name']] = [
+                common.InstanceInfo(
+                    instance_id=vm['name'],
+                    internal_ip=nic.get('networkIP', ''),
+                    external_ip=ext,
+                )
+            ]
+    head = sorted(instances)[0]
+    return common.ClusterInfo(
+        provider_name='gcp',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=instances,
+        head_instance_id=head,
+        ssh_user=authentication.DEFAULT_SSH_USER,
+        provider_config=provider_config,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del region
+    assert zone is not None
+    kind, items = _find_cluster(cluster_name_on_cloud, zone)
+    if kind == 'tpu':
+        # Validate the whole cluster BEFORE stopping anything, so a
+        # pod-slice restriction never leaves it half-stopped.
+        for node in items:
+            if len(node.get('networkEndpoints', [])) > 1:
+                raise exceptions.NotSupportedError(
+                    'TPU pod slices cannot be stopped; use down.')
+        tpu = _tpu()
+        for node in items:
+            tpu.stop_node(zone, node['name'].split('/')[-1])
+    elif kind == 'gce':
+        gce = _gce()
+        for vm in items:
+            gce.stop_instance(zone, vm['name'])
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region
+    assert zone is not None
+    kind, items = _find_cluster(cluster_name_on_cloud, zone)
+    if kind == 'tpu':
+        tpu = _tpu()
+        for node in items:
+            tpu.delete_node(zone, node['name'].split('/')[-1])
+    elif kind == 'gce':
+        gce = _gce()
+        for vm in items:
+            gce.delete_instance(zone, vm['name'])
+        gce.delete_firewall(_firewall_name(cluster_name_on_cloud))
+
+
+def _firewall_name(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}-ports'
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str], region: str,
+               zone: Optional[str]) -> None:
+    del region, zone
+    allowed = [{
+        'IPProtocol': 'tcp',
+        'ports': [str(p) for p in ports],
+    }]
+    _gce().insert_firewall({
+        'name': _firewall_name(cluster_name_on_cloud),
+        'network': 'global/networks/default',
+        'direction': 'INGRESS',
+        'sourceRanges': ['0.0.0.0/0'],
+        'allowed': allowed,
+        'targetTags': [],
+    })
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    del region, zone
+    _gce().delete_firewall(_firewall_name(cluster_name_on_cloud))

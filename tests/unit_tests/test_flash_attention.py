@@ -91,3 +91,19 @@ def test_flash_grad_matches_reference():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-4, rtol=2e-4)
+
+
+def test_cross_length_causal_alignment():
+    """Sq != Sk causal (decode vs KV cache): kernel matches reference."""
+    b, sq, sk, h, d = 1, 128, 256, 2, 32
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(kq, (b, sq, h, d))
+    k = jax.random.normal(kk, (b, sk, h, d))
+    v = jax.random.normal(kv, (b, sk, h, d))
+    ref = reference_attention(q, k, v, causal=True)
+    o, _ = fa_mod._flash_fwd_pallas(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=True, scale=d**-0.5,
+        block_q=128, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(o.transpose(0, 2, 1, 3)),
+                               np.asarray(ref), atol=2e-5, rtol=2e-5)

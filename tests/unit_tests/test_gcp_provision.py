@@ -1,0 +1,299 @@
+"""GCP provision plugin tests with mocked HTTP (no cloud access).
+
+The fake session plays the role of tpu.googleapis.com / GCE REST:
+tests assert the full op contract (create/wait/query/info/terminate)
+and the error taxonomy (stockout vs quota) that failover keys on.
+"""
+import json
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import api
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+
+class FakeResp:
+
+    def __init__(self, status, body):
+        self.status_code = status
+        self._body = body
+        self.content = json.dumps(body).encode()
+        self.text = json.dumps(body)
+
+    def json(self):
+        return self._body
+
+
+class FakeSession:
+    """Routes requests through a test-provided handler."""
+
+    def __init__(self, handler):
+        self.handler = handler
+        self.calls = []
+
+    def request(self, method, url, json=None, params=None):
+        self.calls.append((method, url, json, params))
+        return FakeResp(*self.handler(method, url, json, params))
+
+
+@pytest.fixture
+def gcp_env(monkeypatch):
+    """Patch auth/project/poll-sleep; returns a session installer."""
+    monkeypatch.setattr(gcp_instance, '_project', lambda: 'proj')
+    monkeypatch.setattr(
+        'skypilot_tpu.authentication.public_key_openssh',
+        lambda: 'ssh-ed25519 AAAATEST test')
+    monkeypatch.setattr(api, '_OP_POLL_INTERVAL', 0.0)
+    monkeypatch.setattr('time.sleep', lambda s: None)
+
+    def install(handler):
+        session = FakeSession(handler)
+        monkeypatch.setattr(api, 'session_factory', lambda: session)
+        return session
+
+    return install
+
+
+def _tpu_config(count=1, accel='v5litepod-16'):
+    return common.ProvisionConfig(
+        provider_name='gcp',
+        cluster_name='c',
+        cluster_name_on_cloud='c-abc',
+        region='us-central2',
+        zone='us-central2-b',
+        node_config={
+            'tpu_vm': True,
+            'tpu_type': accel,
+            'runtime_version': 'v2-alpha-tpuv5-lite',
+            'use_spot': False,
+            'labels': {},
+        },
+        count=count,
+    )
+
+
+def _node(name, state='READY', n_hosts=4):
+    return {
+        'name': f'projects/proj/locations/us-central2-b/nodes/{name}',
+        'state': state,
+        'labels': {'skytpu-cluster': 'c-abc'},
+        'acceleratorConfig': {'topology': '4x4'},
+        'networkEndpoints': [{
+            'ipAddress': f'10.0.0.{i}',
+            'accessConfig': {'externalIp': f'34.1.2.{i}'},
+        } for i in range(n_hosts)],
+    }
+
+
+def test_tpu_create_and_info(gcp_env):
+    state = {'created': False}
+
+    def handler(method, url, body, params):
+        if method == 'POST' and url.endswith('/nodes'):
+            assert params['nodeId'] == 'c-abc'
+            assert body['acceleratorType'] == 'v5litepod-16'
+            assert 'ssh-keys' in body['metadata']
+            state['created'] = True
+            return 200, {'name': 'projects/proj/operations/op1',
+                         'done': False}
+        if '/operations/' in url or url.endswith('op1'):
+            return 200, {'name': 'projects/proj/operations/op1',
+                         'done': True, 'response': {}}
+        if url.endswith('/nodes/c-abc'):
+            if not state['created']:
+                return 404, {'error': {'message': 'not found'}}
+            return 200, _node('c-abc')
+        if url.endswith('/nodes'):
+            nodes = [_node('c-abc')] if state['created'] else []
+            return 200, {'nodes': nodes}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    record = gcp_instance.run_instances(_tpu_config())
+    assert record.created_instance_ids == ['c-abc']
+    assert record.head_instance_id == 'c-abc'
+
+    gcp_instance.wait_instances('c-abc', 'us-central2', 'us-central2-b',
+                                'running')
+    info = gcp_instance.get_cluster_info('c-abc', 'us-central2',
+                                         'us-central2-b')
+    hosts = info.all_hosts()
+    assert len(hosts) == 4
+    # Worker order == rank order; worker 0 is the head.
+    assert [h.internal_ip for h in hosts] == [
+        '10.0.0.0', '10.0.0.1', '10.0.0.2', '10.0.0.3'
+    ]
+    assert hosts[0].external_ip == '34.1.2.0'
+    assert info.provider_config['tpu_topology'] == '4x4'
+
+
+def test_tpu_reuse_running_node(gcp_env):
+
+    def handler(method, url, body, params):
+        if url.endswith('/nodes/c-abc'):
+            return 200, _node('c-abc')
+        raise AssertionError(f'unexpected {method} {url}')
+
+    session = gcp_env(handler)
+    record = gcp_instance.run_instances(_tpu_config())
+    assert record.created_instance_ids == []
+    assert all(c[0] == 'GET' for c in session.calls)
+
+
+def test_tpu_stockout_maps_to_stockout_error(gcp_env):
+
+    def handler(method, url, body, params):
+        if method == 'POST' and url.endswith('/nodes'):
+            return 429, {
+                'error': {
+                    'status': 'RESOURCE_EXHAUSTED',
+                    'message': 'There is no more capacity in the zone '
+                               '"us-central2-b"',
+                }
+            }
+        if url.endswith('/nodes/c-abc'):
+            return 404, {'error': {'message': 'nope'}}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    with pytest.raises(exceptions.StockoutError):
+        gcp_instance.run_instances(_tpu_config())
+
+
+def test_tpu_quota_maps_to_quota_error(gcp_env):
+
+    def handler(method, url, body, params):
+        if method == 'POST' and url.endswith('/nodes'):
+            return 403, {
+                'error': {
+                    'status': 'PERMISSION_DENIED',
+                    'message': 'Quota limit TPUV5sLitepodPerProjectPer'
+                               'ZoneForTPUAPI exceeded.',
+                }
+            }
+        if url.endswith('/nodes/c-abc'):
+            return 404, {'error': {'message': 'nope'}}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    with pytest.raises(exceptions.QuotaExceededError):
+        gcp_instance.run_instances(_tpu_config())
+
+
+def test_tpu_operation_error_is_translated(gcp_env):
+    """Errors surfaced via the long-running op (not HTTP status)."""
+
+    def handler(method, url, body, params):
+        if method == 'POST' and url.endswith('/nodes'):
+            return 200, {
+                'name': 'projects/proj/operations/op1',
+                'done': True,
+                'error': {
+                    'code': 8,
+                    'message': 'There is no more capacity in the zone',
+                },
+            }
+        if url.endswith('/nodes/c-abc'):
+            return 404, {'error': {'message': 'nope'}}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    with pytest.raises(exceptions.StockoutError):
+        gcp_instance.run_instances(_tpu_config())
+
+
+def test_pod_stop_not_supported(gcp_env):
+
+    def handler(method, url, body, params):
+        if url.endswith('/nodes'):
+            return 200, {'nodes': [_node('c-abc', n_hosts=4)]}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    with pytest.raises(exceptions.NotSupportedError):
+        gcp_instance.stop_instances('c-abc', 'us-central2',
+                                    'us-central2-b')
+
+
+def test_tpu_terminate(gcp_env):
+    deleted = []
+
+    def handler(method, url, body, params):
+        if method == 'GET' and url.endswith('/nodes'):
+            return 200, {'nodes': [_node('c-abc')]}
+        if method == 'DELETE' and url.endswith('/nodes/c-abc'):
+            deleted.append(url)
+            return 200, {'name': 'projects/proj/operations/op2',
+                         'done': True, 'response': {}}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    gcp_instance.terminate_instances('c-abc', 'us-central2',
+                                     'us-central2-b')
+    assert deleted
+
+
+def test_gce_create_and_info(gcp_env):
+    state = {'created': []}
+
+    def handler(method, url, body, params):
+        if method == 'POST' and url.endswith('/instances'):
+            state['created'].append(body['name'])
+            assert body['labels']['skytpu-cluster'] == 'g-abc'
+            return 200, {'name': 'op-gce-1'}
+        if '/operations/' in url:
+            return 200, {'name': 'op-gce-1', 'status': 'DONE'}
+        if method == 'GET' and url.endswith('/nodes'):
+            return 200, {'nodes': []}   # no TPU nodes for this cluster
+        if method == 'GET' and url.endswith('/instances'):
+            items = [{
+                'name': n,
+                'status': 'RUNNING',
+                'labels': {'skytpu-cluster': 'g-abc'},
+                'networkInterfaces': [{
+                    'networkIP': '10.0.1.5',
+                    'accessConfigs': [{'natIP': '34.9.9.9'}],
+                }],
+            } for n in state['created']]
+            return 200, {'items': items}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    config = common.ProvisionConfig(
+        provider_name='gcp',
+        cluster_name='g',
+        cluster_name_on_cloud='g-abc',
+        region='us-central1',
+        zone='us-central1-a',
+        node_config={
+            'tpu_vm': False,
+            'instance_type': 'n2-standard-8',
+            'disk_size': 100,
+            'labels': {},
+        },
+        count=1,
+    )
+    record = gcp_instance.run_instances(config)
+    assert record.created_instance_ids == ['g-abc-0']
+    info = gcp_instance.get_cluster_info('g-abc', 'us-central1',
+                                         'us-central1-a')
+    hosts = info.all_hosts()
+    assert len(hosts) == 1
+    assert hosts[0].external_ip == '34.9.9.9'
+
+
+def test_query_instances_status_mapping(gcp_env):
+
+    def handler(method, url, body, params):
+        if url.endswith('/nodes'):
+            return 200, {'nodes': [
+                _node('c-abc', state='READY'),
+            ]}
+        raise AssertionError(f'unexpected {method} {url}')
+
+    gcp_env(handler)
+    out = gcp_instance.query_instances('c-abc', 'us-central2',
+                                       'us-central2-b')
+    assert out == {'c-abc': 'running'}
