@@ -1,0 +1,19 @@
+"""Managed jobs: spot/preemption auto-recovery.
+
+Re-design of reference ``sky/jobs/`` (SURVEY.md §2.6): a controller
+process per job monitors cluster + job health, distinguishes
+preemption from user failure, and recovers by re-launching through the
+normal launch path with failover state. TPU twist: preemption of any
+host kills the whole pod slice, so recovery is always slice-granular
+relaunch (reference jobs/controller.py:119-300).
+
+Delta vs reference: the controller runs as a detached process on the
+*client* machine by default (`python -m skypilot_tpu.jobs.controller`)
+instead of on a dedicated controller VM — same process model, no
+bootstrap cluster needed. A remote controller cluster can host the
+same module unchanged.
+"""
+from skypilot_tpu.jobs.core import (cancel, launch, queue, tail_logs)
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['launch', 'queue', 'cancel', 'tail_logs', 'ManagedJobStatus']

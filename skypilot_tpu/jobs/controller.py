@@ -1,0 +1,178 @@
+"""Managed-jobs controller: one process per job.
+
+Re-design of reference ``sky/jobs/controller.py:53,119-300``: launch
+the task's cluster, then loop — poll the on-cluster job status and the
+cloud-truth cluster status, distinguish USER FAILURE (job reached a
+terminal failed state while the cluster is healthy) from PREEMPTION
+(cluster no longer UP / job vanished), and hand preemptions to the
+recovery strategy. On a TPU pod slice, losing any host kills the whole
+job, so recovery is always a full slice relaunch.
+
+Run: ``python -m skypilot_tpu.jobs.controller <managed_job_id>``.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+import traceback
+from typing import Optional
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import job_lib as agent_job_lib
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import status_lib
+
+logger = sky_logging.init_logger(__name__)
+
+JOB_STATUS_CHECK_GAP_SECONDS = 20
+_MAX_RECOVERIES = 16
+
+
+class JobsController:
+
+    def __init__(self, managed_job_id: int,
+                 check_gap: float = JOB_STATUS_CHECK_GAP_SECONDS) -> None:
+        record = state.get_job(managed_job_id)
+        assert record is not None, managed_job_id
+        self.job_id = managed_job_id
+        self.cluster_name = record['cluster_name']
+        self.task = task_lib.Task.from_yaml_config(record['dag'])
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            self.cluster_name, self.task)
+        self.check_gap = check_gap
+
+    # ------------------------------------------------------------------
+    def _cluster_status(self) -> Optional[status_lib.ClusterStatus]:
+        try:
+            record = backend_utils.refresh_cluster_record(
+                self.cluster_name, force_refresh=True)
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('Status refresh failed:\n%s',
+                           traceback.format_exc())
+            return None
+        return record['status'] if record else None
+
+    def _job_status(self,
+                    cluster_job_id: int
+                    ) -> Optional[agent_job_lib.JobStatus]:
+        try:
+            statuses = core.job_status(self.cluster_name,
+                                       [cluster_job_id])
+            return statuses.get(cluster_job_id)
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+    # ------------------------------------------------------------------
+    def _monitor_until_done(self, cluster_job_id: int) -> state.ManagedJobStatus:
+        """Returns the terminal managed status for one launched attempt,
+        or RECOVERING if the cluster was preempted."""
+        while True:
+            time.sleep(self.check_gap)
+            if state.cancel_requested(self.job_id):
+                return state.ManagedJobStatus.CANCELLING
+            job_status = self._job_status(cluster_job_id)
+            if job_status == agent_job_lib.JobStatus.SUCCEEDED:
+                return state.ManagedJobStatus.SUCCEEDED
+            if job_status == agent_job_lib.JobStatus.CANCELLED:
+                return state.ManagedJobStatus.CANCELLED
+            if job_status in (agent_job_lib.JobStatus.FAILED,
+                              agent_job_lib.JobStatus.FAILED_SETUP):
+                # Failed job on a healthy cluster = user failure; on a
+                # dead/degraded cluster = preemption casualty
+                # (reference jobs/controller.py:260-300).
+                cluster_status = self._cluster_status()
+                if cluster_status == status_lib.ClusterStatus.UP:
+                    return (state.ManagedJobStatus.FAILED_SETUP
+                            if job_status
+                            == agent_job_lib.JobStatus.FAILED_SETUP else
+                            state.ManagedJobStatus.FAILED)
+                logger.info('Job failed with unhealthy cluster (%s): '
+                            'treating as preemption.', cluster_status)
+                return state.ManagedJobStatus.RECOVERING
+            if job_status is None:
+                # Can't see the job at all: cluster gone or agent dead.
+                cluster_status = self._cluster_status()
+                if cluster_status != status_lib.ClusterStatus.UP:
+                    logger.info('Cluster %s is %s: preemption.',
+                                self.cluster_name, cluster_status)
+                    return state.ManagedJobStatus.RECOVERING
+            # else: INIT/PENDING/SETTING_UP/RUNNING — keep watching.
+            if job_status == agent_job_lib.JobStatus.RUNNING:
+                record = state.get_job(self.job_id)
+                if (record and record['status']
+                        != state.ManagedJobStatus.RUNNING):
+                    state.set_status(self.job_id,
+                                     state.ManagedJobStatus.RUNNING)
+
+    # ------------------------------------------------------------------
+    def run(self) -> state.ManagedJobStatus:
+        state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+        try:
+            cluster_job_id = self.strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                             failure_reason=str(e))
+            return state.ManagedJobStatus.FAILED_NO_RESOURCE
+        assert cluster_job_id is not None
+
+        while True:
+            result = self._monitor_until_done(cluster_job_id)
+            if result == state.ManagedJobStatus.CANCELLING:
+                logger.info('Cancel requested; terminating cluster.')
+                self.strategy.terminate_cluster()
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return state.ManagedJobStatus.CANCELLED
+            if result != state.ManagedJobStatus.RECOVERING:
+                self.strategy.terminate_cluster()
+                state.set_status(self.job_id, result)
+                return result
+            # Preemption: recover.
+            n = state.bump_recovery(self.job_id)
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.RECOVERING)
+            if n > _MAX_RECOVERIES:
+                state.set_status(
+                    self.job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
+                    failure_reason=f'exceeded {_MAX_RECOVERIES} '
+                    'recoveries')
+                return state.ManagedJobStatus.FAILED_CONTROLLER
+            logger.info('Recovery #%d for managed job %d.', n,
+                        self.job_id)
+            try:
+                cluster_job_id = self.strategy.recover()
+            except exceptions.ResourcesUnavailableError as e:
+                state.set_status(
+                    self.job_id,
+                    state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                    failure_reason=str(e))
+                return state.ManagedJobStatus.FAILED_NO_RESOURCE
+            state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('job_id', type=int)
+    parser.add_argument('--check-gap', type=float,
+                        default=JOB_STATUS_CHECK_GAP_SECONDS)
+    args = parser.parse_args()
+    import os
+    state.set_controller_pid(args.job_id, os.getpid())
+    try:
+        JobsController(args.job_id, check_gap=args.check_gap).run()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.error('Controller crashed:\n%s', traceback.format_exc())
+        state.set_status(args.job_id,
+                         state.ManagedJobStatus.FAILED_CONTROLLER,
+                         failure_reason=str(e))
+        raise
+
+
+if __name__ == '__main__':
+    main()

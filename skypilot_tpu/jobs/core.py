@@ -1,0 +1,149 @@
+"""Managed-jobs public API: launch/queue/cancel/tail_logs.
+
+Re-design of reference ``sky/jobs/server/core.py:48``: `launch`
+records the job, then spawns a detached controller process
+(`python -m skypilot_tpu.jobs.controller <id>`) that owns the whole
+lifecycle. The reference provisions a controller VM first; here the
+controller runs on the client machine (same module could be shipped to
+a controller cluster later — nothing in it assumes locality beyond the
+state DB path).
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+def _log_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_JOBS_LOG_DIR', '~/.skytpu/managed_jobs'))
+
+
+def _controller_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
+           name: Optional[str] = None,
+           *,
+           detach: bool = True,
+           controller_check_gap: Optional[float] = None) -> int:
+    """Submit a managed job; returns the managed job id."""
+    if isinstance(entrypoint, dag_lib.Dag):
+        assert len(entrypoint.tasks) == 1, (
+            'Managed jobs currently take a single task.')
+        task = entrypoint.tasks[0]
+    else:
+        task = entrypoint
+    job_name = name or task.name or 'managed'
+    cluster_name = (f'{job_name}-{common_utils.generate_run_id(4)}')
+    log_dir = _log_dir()
+    os.makedirs(log_dir, exist_ok=True)
+
+    job_id = state.add_job(
+        name=job_name,
+        task_yaml='',
+        cluster_name=cluster_name,
+        log_path='',  # filled below (needs the id)
+        dag_json=json.dumps(task.to_yaml_config()))
+    log_path = os.path.join(log_dir, f'{job_id}-{job_name}.log')
+    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+
+    cmd = [
+        sys.executable, '-u', '-m', 'skypilot_tpu.jobs.controller',
+        str(job_id)
+    ]
+    if controller_check_gap is not None:
+        cmd += ['--check-gap', str(controller_check_gap)]
+    env = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get('PYTHONPATH', '')
+    if repo_root not in existing.split(os.pathsep):
+        env['PYTHONPATH'] = repo_root + (os.pathsep + existing
+                                         if existing else '')
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(cmd,
+                                stdout=log_f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True,
+                                env=env)
+    state.set_controller_pid(job_id, proc.pid)
+    logger.info('Managed job %d submitted (controller pid %d); logs: %s',
+                job_id, proc.pid, log_path)
+    if not detach:
+        proc.wait()
+    return job_id
+
+
+def queue(refresh: bool = True) -> List[Dict[str, Any]]:
+    """All managed jobs; dead controllers are reconciled to failed."""
+    jobs = state.get_jobs()
+    if refresh:
+        for job in jobs:
+            if (not job['status'].is_terminal() and
+                    job['status'] != state.ManagedJobStatus.PENDING and
+                    not _controller_alive(job['controller_pid'])):
+                state.set_status(
+                    job['job_id'],
+                    state.ManagedJobStatus.FAILED_CONTROLLER,
+                    failure_reason='controller process died')
+                job['status'] = state.ManagedJobStatus.FAILED_CONTROLLER
+    return jobs
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Request cancellation; the controller tears down the cluster."""
+    if all_jobs:
+        job_ids = [
+            j['job_id'] for j in state.get_jobs()
+            if not j['status'].is_terminal()
+        ]
+    cancelled = []
+    for job_id in job_ids or []:
+        job = state.get_job(job_id)
+        if job is None or job['status'].is_terminal():
+            continue
+        state.request_cancel(job_id)
+        cancelled.append(job_id)
+    return cancelled
+
+
+def tail_logs(job_id: int, follow: bool = True) -> int:
+    """Stream the controller's log file (which includes launch logs)."""
+    job = state.get_job(job_id)
+    if job is None:
+        raise exceptions.JobNotFoundError(f'Managed job {job_id}')
+    path = os.path.join(_log_dir(), f'{job_id}-{job["name"]}.log')
+    if not os.path.exists(path):
+        logger.info('No logs yet for managed job %d.', job_id)
+        return 1
+    with open(path, 'r', encoding='utf-8', errors='replace') as f:
+        while True:
+            line = f.readline()
+            if line:
+                print(line, end='')
+                continue
+            job = state.get_job(job_id)
+            if not follow or job is None or job['status'].is_terminal():
+                return 0
+            time.sleep(0.5)

@@ -1,0 +1,146 @@
+"""Recovery strategies: how a preempted/failed cluster is relaunched.
+
+Re-design of reference ``sky/jobs/recovery_strategy.py:45,382,466``:
+a StrategyExecutor owns launch + recover for one task. FAILOVER first
+retries the cluster's current region, then lets the provisioner's
+blocked-set failover roam; EAGER_NEXT_REGION (default) blocks the
+preempted region immediately — on TPU spot, a preempted zone rarely
+has capacity seconds later, so moving on converges faster.
+"""
+from __future__ import annotations
+
+import time
+import typing
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+RECOVERY_STRATEGY_REGISTRY = registry.Registry('recovery strategy')
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
+
+_MAX_LAUNCH_ATTEMPTS = 3
+_LAUNCH_RETRY_GAP_SECONDS = 30
+
+
+class StrategyExecutor:
+    """Launch/recover one task's cluster through the normal stack."""
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 max_restarts_on_errors: int = 0) -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.max_restarts_on_errors = max_restarts_on_errors
+
+    @classmethod
+    def make(cls, cluster_name: str, task: 'task_lib.Task'
+             ) -> 'StrategyExecutor':
+        name = DEFAULT_RECOVERY_STRATEGY
+        recovery = None
+        for r in task.resources:
+            recovery = r.job_recovery or recovery
+        if recovery is not None:
+            name = str(recovery)
+        strategy_cls = RECOVERY_STRATEGY_REGISTRY.from_str(name)
+        return strategy_cls(cluster_name, task)
+
+    # ------------------------------------------------------------------
+    def _do_launch(self, *, blocked_regions=None) -> Optional[int]:
+        """One sky.launch of the task; returns job_id on the cluster."""
+        from skypilot_tpu import execution
+        task = self.task
+        if blocked_regions:
+            task = self._without_regions(task, blocked_regions)
+        job_id, _ = execution.launch(task,
+                                     cluster_name=self.cluster_name,
+                                     detach_run=True,
+                                     stream_logs=False)
+        return job_id
+
+    def _without_regions(self, task: 'task_lib.Task', regions):
+        """Copy of the task whose resources un-pin `regions`."""
+        from skypilot_tpu import task as task_lib
+        new = task_lib.Task.from_yaml_config(task.to_yaml_config())
+        new_resources = set()
+        for r in task.resources:
+            if r.region in regions:
+                new_resources.add(r.copy(region=None))
+            else:
+                new_resources.add(r)
+        new.set_resources(new_resources)
+        return new
+
+    def launch(self) -> Optional[int]:
+        """Initial launch with bounded retries on transient errors."""
+        last_exc: Optional[Exception] = None
+        for attempt in range(_MAX_LAUNCH_ATTEMPTS):
+            try:
+                return self._do_launch()
+            except exceptions.ResourcesUnavailableError as e:
+                raise  # permanent: no capacity anywhere
+            except Exception as e:  # pylint: disable=broad-except
+                last_exc = e
+                logger.warning('Launch attempt %d failed: %s',
+                               attempt + 1, e)
+                time.sleep(_LAUNCH_RETRY_GAP_SECONDS)
+        raise exceptions.ProvisionError(
+            f'Launch failed after {_MAX_LAUNCH_ATTEMPTS} attempts: '
+            f'{last_exc}')
+
+    def terminate_cluster(self) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(self.cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+    def recover(self) -> Optional[int]:
+        raise NotImplementedError
+
+
+@RECOVERY_STRATEGY_REGISTRY.register(name='FAILOVER')
+class FailoverStrategy(StrategyExecutor):
+    """Retry the same region first, then roam (reference :382)."""
+
+    def recover(self) -> Optional[int]:
+        # 1. Relaunch in place: the handle's region is retried first
+        #    because the task resources still pin it.
+        self.terminate_cluster()
+        try:
+            return self._do_launch()
+        except exceptions.ResourcesUnavailableError:
+            logger.info('Same-region recovery failed; roaming.')
+        # 2. Unpin the region and let provisioner failover roam.
+        self.terminate_cluster()
+        return self._do_launch(
+            blocked_regions={r.region for r in self.task.resources
+                             if r.region})
+
+
+@RECOVERY_STRATEGY_REGISTRY.register(name='EAGER_NEXT_REGION',
+                                     default=True)
+class EagerNextRegionStrategy(StrategyExecutor):
+    """Skip the preempted region immediately (reference :466)."""
+
+    def recover(self) -> Optional[int]:
+        from skypilot_tpu import global_user_state
+        record = global_user_state.get_cluster_from_name(
+            self.cluster_name)
+        preempted_region = None
+        if record is not None and record.get('handle') is not None:
+            preempted_region = record['handle'].launched_resources.region
+        self.terminate_cluster()
+        blocked = {preempted_region} if preempted_region else None
+        try:
+            return self._do_launch(blocked_regions=blocked)
+        except exceptions.ResourcesUnavailableError:
+            # Everything else is full: the preempted region is better
+            # than nothing — retry unrestricted.
+            logger.info('Other regions full; retrying all regions.')
+            return self._do_launch()

@@ -1,0 +1,175 @@
+"""Managed-jobs SQLite state.
+
+Re-design of reference ``sky/jobs/state.py:54,114`` (`spot` +
+`job_info` tables): one row per managed job task, with the
+RECOVERING-aware status machine documented in the reference's
+``sky/jobs/README.md:30-60``.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH_ENV = 'SKYTPU_JOBS_DB'
+_DEFAULT_DB = '~/.skytpu/managed_jobs.db'
+
+
+class ManagedJobStatus(enum.Enum):
+    """Lifecycle of a managed job (reference jobs/state.py:54)."""
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    # terminal
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def terminal_statuses(cls) -> List['ManagedJobStatus']:
+        return list(_TERMINAL)
+
+
+_TERMINAL = (
+    ManagedJobStatus.SUCCEEDED,
+    ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+    ManagedJobStatus.CANCELLED,
+)
+
+
+def _db_path() -> str:
+    return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
+
+
+def _conn() -> sqlite3.Connection:
+    path = _db_path()
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            task_yaml TEXT,
+            cluster_name TEXT,
+            status TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            recovery_count INTEGER DEFAULT 0,
+            failure_reason TEXT,
+            controller_pid INTEGER,
+            cancel_requested INTEGER DEFAULT 0,
+            log_path TEXT,
+            dag_json TEXT
+        )""")
+    return conn
+
+
+def add_job(name: Optional[str], task_yaml: str, cluster_name: str,
+            log_path: str, dag_json: str) -> int:
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, task_yaml, cluster_name, status, '
+            'submitted_at, log_path, dag_json) VALUES (?,?,?,?,?,?,?)',
+            (name, task_yaml, cluster_name,
+             ManagedJobStatus.PENDING.value, time.time(), log_path,
+             dag_json))
+        return cur.lastrowid
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    sets = ['status = ?']
+    args: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at = COALESCE(started_at, ?)')
+        args.append(time.time())
+    if status.is_terminal():
+        sets.append('ended_at = ?')
+        args.append(time.time())
+    if failure_reason is not None:
+        sets.append('failure_reason = ?')
+        args.append(failure_reason)
+    args.append(job_id)
+    with _conn() as conn:
+        conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
+                     args)
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE jobs SET controller_pid = ? WHERE job_id = ?',
+                     (pid, job_id))
+
+
+def bump_recovery(job_id: int) -> int:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE jobs SET recovery_count = recovery_count + 1 '
+            'WHERE job_id = ?', (job_id,))
+        row = conn.execute(
+            'SELECT recovery_count FROM jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
+        return row['recovery_count']
+
+
+def request_cancel(job_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE jobs SET cancel_requested = 1 WHERE job_id = ?',
+            (job_id,))
+
+
+def cancel_requested(job_id: int) -> bool:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT cancel_requested FROM jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
+        return bool(row and row['cancel_requested'])
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        return _to_dict(row) if row else None
+
+
+def get_jobs(
+        statuses: Optional[List[ManagedJobStatus]] = None
+) -> List[Dict[str, Any]]:
+    query = 'SELECT * FROM jobs'
+    args: List[Any] = []
+    if statuses:
+        marks = ','.join('?' for _ in statuses)
+        query += f' WHERE status IN ({marks})'
+        args = [s.value for s in statuses]
+    query += ' ORDER BY job_id'
+    with _conn() as conn:
+        return [_to_dict(r) for r in conn.execute(query, args)]
+
+
+def _to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ManagedJobStatus(d['status'])
+    if d.get('dag_json'):
+        d['dag'] = json.loads(d['dag_json'])
+    return d

@@ -135,9 +135,16 @@ class TpuClient(RestClient):
                             what=f'get TPU {node_id}')
 
     def list_nodes(self, zone: str) -> List[Dict[str, Any]]:
-        body = self.request('GET', f'{self._loc(zone)}/nodes',
-                            what='list TPUs')
-        return body.get('nodes', [])
+        nodes: List[Dict[str, Any]] = []
+        token = None
+        while True:
+            params = {'pageToken': token} if token else None
+            body = self.request('GET', f'{self._loc(zone)}/nodes',
+                                params=params, what='list TPUs')
+            nodes.extend(body.get('nodes', []))
+            token = body.get('nextPageToken')
+            if not token:
+                return nodes
 
     def delete_node(self, zone: str, node_id: str) -> None:
         try:
@@ -187,20 +194,32 @@ class GceClient(RestClient):
     def _zone(self, zone: str) -> str:
         return f'/projects/{self.project}/zones/{zone}'
 
+    def insert_instance_async(self, zone: str,
+                              body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.request('POST', f'{self._zone(zone)}/instances',
+                            json_body=body,
+                            what=f'create VM {body.get("name")}')
+
     def insert_instance(self, zone: str,
                         body: Dict[str, Any]) -> Dict[str, Any]:
-        op = self.request('POST', f'{self._zone(zone)}/instances',
-                          json_body=body,
-                          what=f'create VM {body.get("name")}')
+        op = self.insert_instance_async(zone, body)
         return self.wait_zone_operation(zone, op,
                                         f'create VM {body.get("name")}')
 
     def list_instances(self, zone: str,
                        label_filter: str) -> List[Dict[str, Any]]:
-        body = self.request('GET', f'{self._zone(zone)}/instances',
-                            params={'filter': label_filter},
-                            what='list VMs')
-        return body.get('items', [])
+        items: List[Dict[str, Any]] = []
+        token = None
+        while True:
+            params = {'filter': label_filter}
+            if token:
+                params['pageToken'] = token
+            body = self.request('GET', f'{self._zone(zone)}/instances',
+                                params=params, what='list VMs')
+            items.extend(body.get('items', []))
+            token = body.get('nextPageToken')
+            if not token:
+                return items
 
     def get_instance(self, zone: str, name: str) -> Dict[str, Any]:
         return self.request('GET',

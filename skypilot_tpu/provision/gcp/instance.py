@@ -29,12 +29,15 @@ _LABEL = 'skytpu-cluster'
 
 # TPU node states (cloud.google.com/tpu/docs/reference/rest/v2).
 _TPU_RUNNING = ('READY',)
-_TPU_PENDING = ('CREATING', 'STARTING', 'REPAIRING', 'RESTARTING')
-_TPU_STOPPED = ('STOPPED', 'STOPPING', 'SUSPENDED')
-# GCE instance states.
+_TPU_PENDING = ('CREATING', 'STARTING', 'REPAIRING', 'RESTARTING',
+                'REIMAGING', 'UNKNOWN', 'STATE_UNSPECIFIED')
+_TPU_STOPPED = ('STOPPED', 'STOPPING', 'SUSPENDED', 'SUSPENDING')
+_TPU_TERMINAL = ('DELETING', 'TERMINATED', 'PREEMPTED')
+# GCE instance states. Note GCE 'TERMINATED' means *stopped* (the VM
+# still exists and is restartable); deleted VMs vanish from list.
 _GCE_RUNNING = ('RUNNING',)
-_GCE_PENDING = ('PROVISIONING', 'STAGING')
-_GCE_STOPPED = ('STOPPING', 'TERMINATED', 'SUSPENDED')
+_GCE_PENDING = ('PROVISIONING', 'STAGING', 'REPAIRING')
+_GCE_STOPPED = ('STOPPING', 'TERMINATED', 'SUSPENDED', 'SUSPENDING')
 
 _DEFAULT_IMAGE = ('projects/debian-cloud/global/images/family/'
                   'debian-12')
@@ -57,6 +60,10 @@ def _tpu() -> api.TpuClient:
 
 def _gce() -> api.GceClient:
     return api.GceClient(_project())
+
+
+def _network_tag(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
 
 
 def _slice_ids(name: str, count: int) -> List[str]:
@@ -97,6 +104,7 @@ def _tpu_create_body(config: common.ProvisionConfig) -> Dict[str, Any]:
             'ssh-keys': authentication.ssh_keys_metadata_value(
                 config.ssh_user),
         },
+        'tags': [_network_tag(config.cluster_name_on_cloud)],
     }
     if nc.get('use_spot'):
         body['schedulingConfig'] = {'preemptible': True}
@@ -186,6 +194,7 @@ def _gce_create_body(config: common.ProvisionConfig,
                     config.ssh_user),
             }],
         },
+        'tags': {'items': [_network_tag(config.cluster_name_on_cloud)]},
     }
     if nc.get('use_spot'):
         body['scheduling'] = {
@@ -206,6 +215,7 @@ def _run_gce_instances(
             zone, f'labels.{_LABEL}={config.cluster_name_on_cloud}')
     }
     created, resumed = [], []
+    pending_ops = []
     names = [
         f'{config.cluster_name_on_cloud}-{i}' for i in range(config.count)
     ]
@@ -213,12 +223,17 @@ def _run_gce_instances(
         inst = existing.get(name)
         if inst is None:
             logger.info('Creating VM %s in %s...', name, zone)
-            gce.insert_instance(zone, _gce_create_body(config, name))
+            pending_ops.append(
+                (gce.insert_instance_async(zone,
+                                           _gce_create_body(config, name)),
+                 f'create VM {name}'))
             created.append(name)
         elif inst.get('status') in _GCE_STOPPED:
             logger.info('Starting stopped VM %s...', name)
             gce.start_instance(zone, name)
             resumed.append(name)
+    for op, what in pending_ops:
+        gce.wait_zone_operation(zone, op, what)
     return common.ProvisionRecord(
         provider_name='gcp',
         cluster_name_on_cloud=config.cluster_name_on_cloud,
@@ -278,12 +293,15 @@ def query_instances(
         raw = item.get('state' if kind == 'tpu' else 'status', '')
         if raw in (_TPU_RUNNING + _GCE_RUNNING):
             status = 'running'
-        elif raw in (_TPU_PENDING + _GCE_PENDING):
-            status = 'pending'
         elif raw in (_TPU_STOPPED + _GCE_STOPPED):
             status = 'stopped'
-        else:
+        elif raw in _TPU_TERMINAL:
             status = 'terminated'
+        else:
+            # Transients and future/unknown states stay visible as
+            # 'pending' — mapping them to 'terminated' would make
+            # reconciliation drop a billable instance from view.
+            status = 'pending'
         if non_terminated_only and status == 'terminated':
             continue
         name = item['name'].split('/')[-1]
@@ -376,7 +394,8 @@ def terminate_instances(cluster_name_on_cloud: str, region: str,
         gce = _gce()
         for vm in items:
             gce.delete_instance(zone, vm['name'])
-        gce.delete_firewall(_firewall_name(cluster_name_on_cloud))
+    # The cluster firewall (if any) must go regardless of kind.
+    _gce().delete_firewall(_firewall_name(cluster_name_on_cloud))
 
 
 def _firewall_name(cluster_name_on_cloud: str) -> str:
@@ -390,14 +409,20 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str], region: str,
         'IPProtocol': 'tcp',
         'ports': [str(p) for p in ports],
     }]
-    _gce().insert_firewall({
-        'name': _firewall_name(cluster_name_on_cloud),
-        'network': 'global/networks/default',
-        'direction': 'INGRESS',
-        'sourceRanges': ['0.0.0.0/0'],
-        'allowed': allowed,
-        'targetTags': [],
-    })
+    try:
+        _gce().insert_firewall({
+            'name': _firewall_name(cluster_name_on_cloud),
+            'network': 'global/networks/default',
+            'direction': 'INGRESS',
+            'sourceRanges': ['0.0.0.0/0'],
+            'allowed': allowed,
+            # Scoped to this cluster's instances only via network tag.
+            'targetTags': [_network_tag(cluster_name_on_cloud)],
+        })
+    except exceptions.ProvisionError as e:
+        # Re-launch of an existing cluster: the rule already exists.
+        if 'already exists' not in str(e).lower():
+            raise
 
 
 def cleanup_ports(cluster_name_on_cloud: str, region: str,

@@ -210,6 +210,8 @@ def cleanup_ports(cluster_name_on_cloud: str, region: str,
 # ----------------------------------------------------------------------
 # Fault injection (test-only API, mirrors a spot preemption).
 def preempt(cluster_name_on_cloud: str) -> None:
+    """Fault injection: spot reclaim — hosts die, jobs die with them."""
+    _kill_agentd(cluster_name_on_cloud)
     meta = _read_meta(cluster_name_on_cloud)
     if meta is not None:
         meta['status'] = 'terminated'

@@ -39,6 +39,8 @@ def isolated_state(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'nonexistent.yaml'))
     monkeypatch.setenv('SKYTPU_USER_HASH', 'testhash')
     monkeypatch.setenv('SKYTPU_DATA_DIR', str(tmp_path / 'skytpu_data'))
+    monkeypatch.setenv('SKYTPU_JOBS_DB', str(tmp_path / 'jobs.db'))
+    monkeypatch.setenv('SKYTPU_JOBS_LOG_DIR', str(tmp_path / 'jobs_logs'))
     from skypilot_tpu import skypilot_config
     skypilot_config.reload_config()
     yield tmp_path

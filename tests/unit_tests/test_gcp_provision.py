@@ -227,6 +227,8 @@ def test_tpu_terminate(gcp_env):
             deleted.append(url)
             return 200, {'name': 'projects/proj/operations/op2',
                          'done': True, 'response': {}}
+        if method == 'DELETE' and '/firewalls/' in url:
+            return 404, {'error': {'message': 'no firewall'}}
         raise AssertionError(f'unexpected {method} {url}')
 
     gcp_env(handler)

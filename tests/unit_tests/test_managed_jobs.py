@@ -1,0 +1,89 @@
+"""Managed jobs: end-to-end recovery on the local cloud.
+
+The hermetic fault-injection path the reference lacks (SURVEY.md §4
+lesson): the local provider's preempt() plays the spot reclaim, and
+the controller must detect it and relaunch the slice.
+"""
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state
+from skypilot_tpu.provision.local import instance as local_instance
+
+
+def _wait_status(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job and job['status'] in statuses:
+            return job
+        time.sleep(0.5)
+    raise TimeoutError(
+        f'job {job_id} stuck at {state.get_job(job_id)["status"]}, '
+        f'wanted {statuses}')
+
+
+def _cluster_name_on_cloud(cluster_name):
+    """Local provider truncates like the backend does."""
+    from skypilot_tpu.utils import common_utils
+    return common_utils.make_cluster_name_on_cloud(cluster_name)
+
+
+def test_managed_job_success(isolated_state):
+    task = task_lib.Task('okjob', run='echo done')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    job_id = jobs_core.launch(task, controller_check_gap=0.5)
+    job = _wait_status(job_id, state.ManagedJobStatus.terminal_statuses())
+    assert job['status'] == state.ManagedJobStatus.SUCCEEDED, job
+    # Queue shows it; the cluster has been torn down.
+    jobs = jobs_core.queue()
+    assert any(j['job_id'] == job_id for j in jobs)
+
+
+def test_managed_job_user_failure_not_recovered(isolated_state):
+    task = task_lib.Task('failjob', run='exit 3')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    job_id = jobs_core.launch(task, controller_check_gap=0.5)
+    job = _wait_status(job_id, state.ManagedJobStatus.terminal_statuses())
+    assert job['status'] == state.ManagedJobStatus.FAILED, job
+    assert job['recovery_count'] == 0
+
+
+def test_managed_job_preemption_recovery(isolated_state, tmp_path):
+    marker = tmp_path / 'second_attempt'
+    # First attempt blocks; after preemption+recovery the marker exists
+    # and the job exits 0 — proving a real relaunch happened.
+    task = task_lib.Task(
+        'spotjob',
+        run=f'if [ -f {marker} ]; then echo recovered; '
+        'else sleep 120; fi')
+    task.set_resources(
+        resources_lib.Resources(cloud='local', use_spot=True))
+    job_id = jobs_core.launch(task, controller_check_gap=0.5)
+
+    job = _wait_status(job_id, [state.ManagedJobStatus.RUNNING])
+    cluster = job['cluster_name']
+
+    marker.write_text('x')
+    local_instance.preempt(_cluster_name_on_cloud(cluster))
+
+    job = _wait_status(job_id,
+                       state.ManagedJobStatus.terminal_statuses(),
+                       timeout=120)
+    assert job['status'] == state.ManagedJobStatus.SUCCEEDED, job
+    assert job['recovery_count'] >= 1
+
+
+def test_managed_job_cancel(isolated_state):
+    task = task_lib.Task('canceljob', run='sleep 120')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    job_id = jobs_core.launch(task, controller_check_gap=0.5)
+    _wait_status(job_id, [state.ManagedJobStatus.RUNNING])
+    assert jobs_core.cancel([job_id]) == [job_id]
+    job = _wait_status(job_id, state.ManagedJobStatus.terminal_statuses())
+    assert job['status'] == state.ManagedJobStatus.CANCELLED, job
