@@ -39,9 +39,11 @@ class Backend(Generic[_HandleType]):
                   dryrun: bool,
                   stream_logs: bool,
                   cluster_name: str,
-                  retry_until_up: bool = False) -> Optional[_HandleType]:
+                  retry_until_up: bool = False,
+                  blocked_regions=None) -> Optional[_HandleType]:
         return self._provision(task, to_provision, dryrun, stream_logs,
-                               cluster_name, retry_until_up)
+                               cluster_name, retry_until_up,
+                               blocked_regions=blocked_regions)
 
     @timeline.event
     def sync_workdir(self, handle: _HandleType, workdir: str) -> None:
@@ -80,7 +82,7 @@ class Backend(Generic[_HandleType]):
 
     # --- Subclass API ---------------------------------------------------
     def _provision(self, task, to_provision, dryrun, stream_logs,
-                   cluster_name, retry_until_up):
+                   cluster_name, retry_until_up, blocked_regions=None):
         raise NotImplementedError
 
     def _sync_workdir(self, handle, workdir):

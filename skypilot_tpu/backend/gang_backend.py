@@ -115,12 +115,16 @@ class RetryingProvisioner:
     """Candidate iteration with blocked-resource failover."""
 
     def __init__(self, cluster_name: str, cluster_name_on_cloud: str,
-                 retry_until_up: bool) -> None:
+                 retry_until_up: bool,
+                 blocked_regions=None) -> None:
         self._cluster_name = cluster_name
         self._cluster_name_on_cloud = cluster_name_on_cloud
         self._retry_until_up = retry_until_up
-        # (region, zone) pairs proven unavailable this request.
-        self._blocked: set = set()
+        # (region, zone) pairs proven unavailable this request. Callers
+        # may seed whole regions (managed-jobs EAGER_NEXT_REGION blocks
+        # the just-preempted region).
+        self._blocked: set = {(r, None) for r in (blocked_regions or ())}
+        self._seed_blocked = frozenset(self._blocked)
 
     def _candidates(self, to_provision: 'resources_lib.Resources'):
         cloud = to_provision.cloud
@@ -192,7 +196,9 @@ class RetryingProvisioner:
                     failover_history=failover_history)
             sleep = backoff.current_backoff()
             logger.info('retry_until_up: retrying in %.0fs.', sleep)
-            self._blocked.clear()
+            # Keep caller-seeded blocks across rounds; clear only the
+            # blocks learned from this request's failures.
+            self._blocked = set(self._seed_blocked)
             time.sleep(sleep)
 
 
@@ -211,7 +217,8 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
     def _provision(self, task: 'task_lib.Task',
                    to_provision: Optional['resources_lib.Resources'],
                    dryrun: bool, stream_logs: bool, cluster_name: str,
-                   retry_until_up: bool = False
+                   retry_until_up: bool = False,
+                   blocked_regions=None
                    ) -> Optional[GangResourceHandle]:
         assert to_provision is not None
         to_provision.assert_launchable()
@@ -240,7 +247,8 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 cluster_name_on_cloud = handle.cluster_name_on_cloud
 
             prov = RetryingProvisioner(cluster_name, cluster_name_on_cloud,
-                                       retry_until_up)
+                                       retry_until_up,
+                                       blocked_regions=blocked_regions)
             cluster_info = prov.provision_with_retries(
                 to_provision, task.num_nodes)
             launched = to_provision.copy(

@@ -1,0 +1,1 @@
+"""Thin client: SDK + CLI over the API server."""

@@ -1,0 +1,294 @@
+"""The `skytpu` CLI — thin client over the SDK.
+
+Re-design of reference ``sky/cli.py`` (launch/exec/status/stop/down/
+autostop/queue/cancel/logs/jobs/serve/check/show-tpus click commands),
+kept thin: every command submits through the client SDK and streams or
+prints the result.
+
+Run: ``python -m skypilot_tpu.client.cli <command>``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import click
+import yaml
+
+from skypilot_tpu.client import sdk
+
+
+def _load_task(entrypoint: str, **overrides):
+    from skypilot_tpu import task as task_lib
+    if os.path.exists(entrypoint):
+        with open(entrypoint, 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f) or {}
+        task = task_lib.Task.from_yaml_config(config)
+    else:
+        # Bare command entrypoint: `skytpu launch -- echo hi`.
+        task = task_lib.Task(run=entrypoint)
+    if overrides.get('name'):
+        task.name = overrides['name']
+    return task
+
+
+def _echo_table(rows: List[dict], columns: List[str]) -> None:
+    if not rows:
+        click.echo('(none)')
+        return
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ''))) for r in rows))
+        for c in columns
+    }
+    click.echo('  '.join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        click.echo('  '.join(
+            str(r.get(c, '')).ljust(widths[c]) for c in columns))
+
+
+@click.group()
+def cli() -> None:
+    """skytpu: TPU-native cloud orchestrator."""
+
+
+# ------------------------------------------------------------- cluster
+
+
+@cli.command()
+@click.argument('entrypoint')
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--name', '-n', default=None, help='Task name.')
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Autodown after the job finishes idle budget.')
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+@click.option('--dryrun', is_flag=True, default=False)
+def launch(entrypoint: str, cluster: Optional[str], name: Optional[str],
+           detach_run: bool, idle_minutes_to_autostop: Optional[int],
+           down: bool, retry_until_up: bool, dryrun: bool) -> None:
+    """Launch a task YAML (provision + run)."""
+    task = _load_task(entrypoint, name=name)
+    request_id = sdk.launch(
+        task, cluster_name=cluster, dryrun=dryrun,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        retry_until_up=retry_until_up)
+    if detach_run:
+        click.echo(f'request: {request_id}')
+        return
+    result = sdk.stream_and_get(request_id)
+    if result and result.get('job_id') is not None:
+        click.echo(f'Job {result["job_id"]} on cluster '
+                   f'{result["cluster_name"]}.')
+
+
+@cli.command('exec')
+@click.argument('cluster')
+@click.argument('entrypoint')
+@click.option('--name', '-n', default=None)
+def exec_cmd(cluster: str, entrypoint: str, name: Optional[str]) -> None:
+    """Run a task on an existing cluster (skip provision/setup)."""
+    task = _load_task(entrypoint, name=name)
+    result = sdk.stream_and_get(sdk.exec_(task, cluster_name=cluster))
+    if result:
+        click.echo(f'Job {result["job_id"]} on {result["cluster_name"]}.')
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(refresh: bool) -> None:
+    """Show clusters."""
+    rows = sdk.get(sdk.status(refresh=refresh))
+    _echo_table(rows, ['name', 'status', 'resources', 'autostop'])
+
+
+@cli.command()
+@click.argument('cluster')
+def stop(cluster: str) -> None:
+    sdk.get(sdk.stop(cluster))
+    click.echo(f'Cluster {cluster} stopped.')
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster: str) -> None:
+    sdk.get(sdk.start(cluster))
+    click.echo(f'Cluster {cluster} started.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--purge', is_flag=True, default=False)
+def down(cluster: str, purge: bool) -> None:
+    sdk.get(sdk.down(cluster, purge=purge))
+    click.echo(f'Cluster {cluster} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='-1 cancels autostop.')
+@click.option('--down', 'down_', is_flag=True, default=False)
+def autostop(cluster: str, idle_minutes: int, down_: bool) -> None:
+    sdk.get(sdk.autostop(cluster, idle_minutes, down_))
+    click.echo(f'Autostop set on {cluster}.')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster: str) -> None:
+    """Show a cluster's job queue."""
+    rows = sdk.get(sdk.queue(cluster))
+    _echo_table(rows, ['job_id', 'name', 'status', 'submitted_at'])
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--job-ids', '-j', multiple=True, type=int)
+@click.option('--all', 'all_jobs', is_flag=True, default=False)
+def cancel(cluster: str, job_ids, all_jobs: bool) -> None:
+    cancelled = sdk.get(
+        sdk.cancel(cluster, list(job_ids) or None, all_jobs))
+    click.echo(f'Cancelled: {cancelled}')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--job-id', '-j', type=int, default=None)
+def logs(cluster: str, job_id: Optional[int]) -> None:
+    """Tail a job's logs (in-process; logs need the live stream)."""
+    from skypilot_tpu import core
+    core.tail_logs(cluster, job_id, follow=True)
+
+
+@cli.command()
+def check() -> None:
+    """Check cloud credentials."""
+    enabled = sdk.get(sdk.check())
+    click.echo('Enabled clouds: ' + ', '.join(enabled))
+
+
+@cli.command('show-tpus')
+@click.option('--name-filter', default=None)
+def show_tpus(name_filter: Optional[str]) -> None:
+    """List TPU accelerator offerings (name, chips, hosts, price)."""
+    from skypilot_tpu import catalog
+    rows = []
+    for name, offerings in sorted(
+            catalog.list_accelerators(name_filter=name_filter).items()):
+        for o in offerings:
+            rows.append({
+                'name': name,
+                'chips': o.num_chips,
+                'hosts': o.num_hosts,
+                'topology': o.topology,
+                'zone': o.zone,
+                'price_hr': round(o.hourly_price(False), 2),
+                'spot_hr': round(o.hourly_price(True), 2),
+            })
+    _echo_table(rows, ['name', 'chips', 'hosts', 'topology', 'zone',
+                       'price_hr', 'spot_hr'])
+
+
+# ------------------------------------------------------------- jobs
+
+
+@cli.group()
+def jobs() -> None:
+    """Managed jobs with auto-recovery."""
+
+
+@jobs.command('launch')
+@click.argument('entrypoint')
+@click.option('--name', '-n', default=None)
+def jobs_launch(entrypoint: str, name: Optional[str]) -> None:
+    task = _load_task(entrypoint, name=name)
+    result = sdk.get(sdk.jobs_launch(task, name=name))
+    click.echo(f'Managed job {result["managed_job_id"]} submitted.')
+
+
+@jobs.command('queue')
+def jobs_queue() -> None:
+    rows = sdk.get(sdk.jobs_queue())
+    _echo_table(rows, ['job_id', 'name', 'status', 'cluster_name',
+                       'recovery_count'])
+
+
+@jobs.command('cancel')
+@click.option('--job-ids', '-j', multiple=True, type=int)
+@click.option('--all', 'all_jobs', is_flag=True, default=False)
+def jobs_cancel(job_ids, all_jobs: bool) -> None:
+    result = sdk.get(sdk.jobs_cancel(list(job_ids) or None, all_jobs))
+    click.echo(f'Cancelled: {result["cancelled"]}')
+
+
+@jobs.command('logs')
+@click.argument('job_id', type=int)
+def jobs_logs(job_id: int) -> None:
+    from skypilot_tpu.jobs import core as jobs_core
+    jobs_core.tail_logs(job_id, follow=True)
+
+
+# ------------------------------------------------------------- serve
+
+
+@cli.group()
+def serve() -> None:
+    """Service serving with autoscaling."""
+
+
+@serve.command('up')
+@click.argument('entrypoint')
+@click.option('--service-name', '-n', default=None)
+def serve_up(entrypoint: str, service_name: Optional[str]) -> None:
+    task = _load_task(entrypoint)
+    result = sdk.get(sdk.serve_up(task, service_name))
+    click.echo(f'Service {result["name"]} at {result["endpoint"]}.')
+
+
+@serve.command('down')
+@click.argument('service_name')
+@click.option('--purge', is_flag=True, default=False)
+def serve_down(service_name: str, purge: bool) -> None:
+    sdk.get(sdk.serve_down(service_name, purge))
+    click.echo(f'Service {service_name} torn down.')
+
+
+@serve.command('status')
+@click.option('--service-name', '-n', default=None)
+def serve_status(service_name: Optional[str]) -> None:
+    for svc in sdk.get(sdk.serve_status(service_name)):
+        click.echo(f'{svc["name"]}: {svc["status"]} at '
+                   f'{svc["endpoint"]}')
+        _echo_table(svc['replicas'], ['replica_id', 'status', 'url'])
+
+
+# ------------------------------------------------------------- api
+
+
+@cli.group()
+def api() -> None:
+    """API-server requests admin."""
+
+
+@api.command('list')
+def api_list() -> None:
+    import requests as http
+    url = sdk.ensure_server()
+    rows = http.get(url + '/api/requests', timeout=10).json()
+    _echo_table(rows, ['request_id', 'name', 'status'])
+
+
+@api.command('cancel')
+@click.argument('request_id')
+def api_cancel(request_id: str) -> None:
+    click.echo(json.dumps({'cancelled': sdk.api_cancel(request_id)}))
+
+
+def main() -> None:
+    cli()
+
+
+if __name__ == '__main__':
+    main()

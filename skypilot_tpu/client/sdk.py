@@ -1,0 +1,209 @@
+"""Client SDK: every call POSTs to the API server, returns a request
+id; results come from get()/stream_and_get().
+
+Re-design of reference ``sky/client/sdk.py:289-307`` + autostart
+(``check_server_healthy_or_start``): if no server answers on the
+configured endpoint, a local one is started detached, so the thin
+client works out of the box.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import requests as http
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import skypilot_config
+from skypilot_tpu.server.server import DEFAULT_PORT
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_SERVER_START_TIMEOUT = 30.0
+
+
+def server_url() -> str:
+    env = os.environ.get('SKYTPU_API_SERVER_ENDPOINT')
+    if env:
+        return env.rstrip('/')
+    cfg = skypilot_config.get_nested(('api_server', 'endpoint'), None)
+    if cfg:
+        return str(cfg).rstrip('/')
+    return f'http://127.0.0.1:{DEFAULT_PORT}'
+
+
+def _healthy(url: str) -> bool:
+    try:
+        resp = http.get(url + '/api/health', timeout=2)
+        return resp.status_code == 200
+    except http.RequestException:
+        return False
+
+
+def ensure_server(url: Optional[str] = None) -> str:
+    """Health-check; autostart a local server if it's the default."""
+    url = url or server_url()
+    if _healthy(url):
+        return url
+    if '127.0.0.1' not in url and 'localhost' not in url:
+        raise exceptions.ApiServerConnectionError(url)
+    port = int(url.rsplit(':', 1)[1])
+    logger.info('Starting local API server on %s...', url)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get('PYTHONPATH', '')
+    if repo_root not in existing.split(os.pathsep):
+        env['PYTHONPATH'] = repo_root + (os.pathsep + existing
+                                         if existing else '')
+    log_path = os.path.expanduser('~/.skytpu/api_server.log')
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    with open(log_path, 'ab') as log_f:
+        subprocess.Popen(
+            [sys.executable, '-u', '-m', 'skypilot_tpu.server.server',
+             '--port', str(port)],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True, env=env)
+    deadline = time.time() + _SERVER_START_TIMEOUT
+    while time.time() < deadline:
+        if _healthy(url):
+            return url
+        time.sleep(0.3)
+    raise exceptions.ApiServerConnectionError(url)
+
+
+# ------------------------------------------------------------------ rpc
+
+
+def submit(op: str, body: Dict[str, Any]) -> str:
+    url = ensure_server()
+    resp = http.post(f'{url}/api/v1/{op.replace(".", "/")}', json=body,
+                     timeout=30)
+    resp.raise_for_status()
+    return resp.json()['request_id']
+
+
+def get(request_id: str, timeout: float = 3600) -> Any:
+    """Block for the result; raise on failed requests."""
+    url = ensure_server()
+    resp = http.get(f'{url}/api/get',
+                    params={'request_id': request_id,
+                            'timeout': timeout},
+                    timeout=timeout + 30)
+    resp.raise_for_status()
+    payload = resp.json()
+    if payload.get('status') == 'FAILED':
+        raise exceptions.SkyTpuError(
+            f'Request {request_id} failed: {payload.get("error")}')
+    if payload.get('status') == 'CANCELLED':
+        raise exceptions.RequestCancelled(request_id)
+    return payload.get('result')
+
+
+def stream_and_get(request_id: str) -> Any:
+    """Stream the request's log to stdout, then return its result."""
+    url = ensure_server()
+    with http.get(f'{url}/api/stream',
+                  params={'request_id': request_id},
+                  stream=True, timeout=None) as resp:
+        resp.raise_for_status()
+        for chunk in resp.iter_content(chunk_size=None):
+            sys.stdout.write(chunk.decode('utf-8', errors='replace'))
+            sys.stdout.flush()
+    return get(request_id)
+
+
+def api_cancel(request_id: str) -> bool:
+    url = ensure_server()
+    resp = http.post(f'{url}/api/cancel',
+                     json={'request_id': request_id}, timeout=30)
+    resp.raise_for_status()
+    return resp.json()['cancelled']
+
+
+# ------------------------------------------------------------ SDK calls
+
+
+def _task_body(task, **extra) -> Dict[str, Any]:
+    return {'task': task.to_yaml_config(), **extra}
+
+
+def launch(task, cluster_name: Optional[str] = None, **kwargs) -> str:
+    return submit('launch',
+                  _task_body(task, cluster_name=cluster_name, **kwargs))
+
+
+def exec_(task, cluster_name: str, **kwargs) -> str:
+    return submit('exec',
+                  _task_body(task, cluster_name=cluster_name, **kwargs))
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> str:
+    return submit('status', {'cluster_names': cluster_names,
+                             'refresh': refresh})
+
+
+def stop(cluster_name: str) -> str:
+    return submit('stop', {'cluster_name': cluster_name})
+
+
+def start(cluster_name: str) -> str:
+    return submit('start', {'cluster_name': cluster_name})
+
+
+def down(cluster_name: str, purge: bool = False) -> str:
+    return submit('down', {'cluster_name': cluster_name, 'purge': purge})
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_: bool = False) -> str:
+    return submit('autostop', {'cluster_name': cluster_name,
+                               'idle_minutes': idle_minutes,
+                               'down': down_})
+
+
+def queue(cluster_name: str) -> str:
+    return submit('queue', {'cluster_name': cluster_name})
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> str:
+    return submit('cancel', {'cluster_name': cluster_name,
+                             'job_ids': job_ids, 'all_jobs': all_jobs})
+
+
+def check() -> str:
+    return submit('check', {})
+
+
+def jobs_launch(task, name: Optional[str] = None) -> str:
+    return submit('jobs.launch', _task_body(task, name=name))
+
+
+def jobs_queue() -> str:
+    return submit('jobs.queue', {})
+
+
+def jobs_cancel(job_ids: Optional[List[int]] = None,
+                all_jobs: bool = False) -> str:
+    return submit('jobs.cancel', {'job_ids': job_ids, 'all': all_jobs})
+
+
+def serve_up(task, service_name: Optional[str] = None) -> str:
+    return submit('serve.up', _task_body(task,
+                                         service_name=service_name))
+
+
+def serve_down(service_name: str, purge: bool = False) -> str:
+    return submit('serve.down', {'service_name': service_name,
+                                 'purge': purge})
+
+
+def serve_status(service_name: Optional[str] = None) -> str:
+    return submit('serve.status', {'service_name': service_name})

@@ -63,6 +63,7 @@ def _execute(
     down: bool = False,
     retry_until_up: bool = False,
     no_setup: bool = False,
+    blocked_regions: Optional[List[str]] = None,
 ) -> Tuple[Optional[int], Optional[gang_backend.GangResourceHandle]]:
     """Returns (job_id, handle) of the last task executed."""
     dag = _to_dag(entrypoint)
@@ -98,6 +99,7 @@ def _execute(
                                        dryrun=dryrun,
                                        stream_logs=stream_logs,
                                        cluster_name=name,
+                                       blocked_regions=blocked_regions,
                                        retry_until_up=retry_until_up)
         else:
             handle = backend_utils.check_cluster_available(name)
@@ -141,6 +143,7 @@ def launch(
     down: bool = False,
     retry_until_up: bool = False,
     no_setup: bool = False,
+    blocked_regions: Optional[List[str]] = None,
 ) -> Tuple[Optional[int], Optional[gang_backend.GangResourceHandle]]:
     """Provision (or reuse) a cluster and run the task on it."""
     return _execute(
@@ -159,6 +162,7 @@ def launch(
         down=down,
         retry_until_up=retry_until_up,
         no_setup=no_setup,
+        blocked_regions=blocked_regions,
     )
 
 

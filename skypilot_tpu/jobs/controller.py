@@ -71,11 +71,14 @@ class JobsController:
     def _monitor_until_done(self, cluster_job_id: int) -> state.ManagedJobStatus:
         """Returns the terminal managed status for one launched attempt,
         or RECOVERING if the cluster was preempted."""
+        missing_streak = 0
         while True:
             time.sleep(self.check_gap)
             if state.cancel_requested(self.job_id):
                 return state.ManagedJobStatus.CANCELLING
             job_status = self._job_status(cluster_job_id)
+            if job_status is not None:
+                missing_streak = 0
             if job_status == agent_job_lib.JobStatus.SUCCEEDED:
                 return state.ManagedJobStatus.SUCCEEDED
             if job_status == agent_job_lib.JobStatus.CANCELLED:
@@ -100,6 +103,15 @@ class JobsController:
                 if cluster_status != status_lib.ClusterStatus.UP:
                     logger.info('Cluster %s is %s: preemption.',
                                 self.cluster_name, cluster_status)
+                    return state.ManagedJobStatus.RECOVERING
+                # Cluster claims UP but the job is invisible (agent
+                # dead / job table lost): bounded patience, then treat
+                # as preemption — a relaunch restores the agent too.
+                missing_streak += 1
+                if missing_streak >= 6:
+                    logger.warning(
+                        'Job invisible for %d checks with cluster UP; '
+                        'recovering.', missing_streak)
                     return state.ManagedJobStatus.RECOVERING
             # else: INIT/PENDING/SETTING_UP/RUNNING — keep watching.
             if job_status == agent_job_lib.JobStatus.RUNNING:

@@ -36,9 +36,17 @@ def _controller_alive(pid: Optional[int]) -> bool:
         return False
     try:
         os.kill(pid, 0)
-        return True
     except (OSError, ProcessLookupError):
         return False
+    # A zombie (un-reaped child of a long-lived launcher, e.g. the API
+    # server) still answers kill(0); check the process state.
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            # field 3 (after the parenthesized comm) is the state.
+            state_char = f.read().rsplit(')', 1)[1].split()[0]
+        return state_char != 'Z'
+    except (OSError, IndexError):
+        return True
 
 
 def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
@@ -62,9 +70,10 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
         name=job_name,
         task_yaml='',
         cluster_name=cluster_name,
-        log_path='',  # filled below (needs the id)
+        log_path='',  # id-dependent; recorded just below
         dag_json=json.dumps(task.to_yaml_config()))
     log_path = os.path.join(log_dir, f'{job_id}-{job_name}.log')
+    state.set_log_path(job_id, log_path)
     state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
 
     cmd = [
@@ -133,7 +142,8 @@ def tail_logs(job_id: int, follow: bool = True) -> int:
     job = state.get_job(job_id)
     if job is None:
         raise exceptions.JobNotFoundError(f'Managed job {job_id}')
-    path = os.path.join(_log_dir(), f'{job_id}-{job["name"]}.log')
+    path = job.get('log_path') or os.path.join(
+        _log_dir(), f'{job_id}-{job["name"]}.log')
     if not os.path.exists(path):
         logger.info('No logs yet for managed job %d.', job_id)
         return 1

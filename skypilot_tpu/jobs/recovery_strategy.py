@@ -37,6 +37,10 @@ class StrategyExecutor:
         self.cluster_name = cluster_name
         self.task = task
         self.max_restarts_on_errors = max_restarts_on_errors
+        # Region of the last successful launch — captured here because
+        # by the time recover() runs, the cluster record has usually
+        # been reaped by status refresh.
+        self.last_region: Optional[str] = None
 
     @classmethod
     def make(cls, cluster_name: str, task: 'task_lib.Task'
@@ -52,29 +56,22 @@ class StrategyExecutor:
 
     # ------------------------------------------------------------------
     def _do_launch(self, *, blocked_regions=None) -> Optional[int]:
-        """One sky.launch of the task; returns job_id on the cluster."""
-        from skypilot_tpu import execution
-        task = self.task
-        if blocked_regions:
-            task = self._without_regions(task, blocked_regions)
-        job_id, _ = execution.launch(task,
-                                     cluster_name=self.cluster_name,
-                                     detach_run=True,
-                                     stream_logs=False)
-        return job_id
+        """One sky.launch of the task; returns job_id on the cluster.
 
-    def _without_regions(self, task: 'task_lib.Task', regions):
-        """Copy of the task whose resources un-pin `regions`."""
-        from skypilot_tpu import task as task_lib
-        new = task_lib.Task.from_yaml_config(task.to_yaml_config())
-        new_resources = set()
-        for r in task.resources:
-            if r.region in regions:
-                new_resources.add(r.copy(region=None))
-            else:
-                new_resources.add(r)
-        new.set_resources(new_resources)
-        return new
+        blocked_regions seeds the provisioner's failover blocked-set,
+        so those regions are skipped at candidate granularity (a task
+        pinned to a blocked region raises ResourcesUnavailableError).
+        """
+        from skypilot_tpu import execution
+        job_id, handle = execution.launch(
+            self.task,
+            cluster_name=self.cluster_name,
+            detach_run=True,
+            stream_logs=False,
+            blocked_regions=list(blocked_regions or ()))
+        if handle is not None:
+            self.last_region = handle.launched_resources.region
+        return job_id
 
     def launch(self) -> Optional[int]:
         """Initial launch with bounded retries on transient errors."""
@@ -82,13 +79,14 @@ class StrategyExecutor:
         for attempt in range(_MAX_LAUNCH_ATTEMPTS):
             try:
                 return self._do_launch()
-            except exceptions.ResourcesUnavailableError as e:
+            except exceptions.ResourcesUnavailableError:
                 raise  # permanent: no capacity anywhere
             except Exception as e:  # pylint: disable=broad-except
                 last_exc = e
                 logger.warning('Launch attempt %d failed: %s',
                                attempt + 1, e)
-                time.sleep(_LAUNCH_RETRY_GAP_SECONDS)
+                if attempt + 1 < _MAX_LAUNCH_ATTEMPTS:
+                    time.sleep(_LAUNCH_RETRY_GAP_SECONDS)
         raise exceptions.ProvisionError(
             f'Launch failed after {_MAX_LAUNCH_ATTEMPTS} attempts: '
             f'{last_exc}')
@@ -116,11 +114,11 @@ class FailoverStrategy(StrategyExecutor):
             return self._do_launch()
         except exceptions.ResourcesUnavailableError:
             logger.info('Same-region recovery failed; roaming.')
-        # 2. Unpin the region and let provisioner failover roam.
+        # 2. Block the failed region and let provisioner failover roam.
         self.terminate_cluster()
         return self._do_launch(
-            blocked_regions={r.region for r in self.task.resources
-                             if r.region})
+            blocked_regions={self.last_region} if self.last_region
+            else None)
 
 
 @RECOVERY_STRATEGY_REGISTRY.register(name='EAGER_NEXT_REGION',
@@ -129,14 +127,10 @@ class EagerNextRegionStrategy(StrategyExecutor):
     """Skip the preempted region immediately (reference :466)."""
 
     def recover(self) -> Optional[int]:
-        from skypilot_tpu import global_user_state
-        record = global_user_state.get_cluster_from_name(
-            self.cluster_name)
-        preempted_region = None
-        if record is not None and record.get('handle') is not None:
-            preempted_region = record['handle'].launched_resources.region
+        # last_region was captured at launch time (the cluster record
+        # is usually already reaped by the preemption's status refresh).
         self.terminate_cluster()
-        blocked = {preempted_region} if preempted_region else None
+        blocked = {self.last_region} if self.last_region else None
         try:
             return self._do_launch(blocked_regions=blocked)
         except exceptions.ResourcesUnavailableError:

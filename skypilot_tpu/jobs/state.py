@@ -7,7 +7,6 @@ RECOVERING-aware status machine documented in the reference's
 """
 from __future__ import annotations
 
-import enum
 import json
 import os
 import pathlib
@@ -15,42 +14,10 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils.status_lib import ManagedJobStatus
+
 _DB_PATH_ENV = 'SKYTPU_JOBS_DB'
 _DEFAULT_DB = '~/.skytpu/managed_jobs.db'
-
-
-class ManagedJobStatus(enum.Enum):
-    """Lifecycle of a managed job (reference jobs/state.py:54)."""
-    PENDING = 'PENDING'
-    SUBMITTED = 'SUBMITTED'
-    STARTING = 'STARTING'
-    RUNNING = 'RUNNING'
-    RECOVERING = 'RECOVERING'
-    CANCELLING = 'CANCELLING'
-    # terminal
-    SUCCEEDED = 'SUCCEEDED'
-    FAILED = 'FAILED'
-    FAILED_SETUP = 'FAILED_SETUP'
-    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
-    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
-    CANCELLED = 'CANCELLED'
-
-    def is_terminal(self) -> bool:
-        return self in _TERMINAL
-
-    @classmethod
-    def terminal_statuses(cls) -> List['ManagedJobStatus']:
-        return list(_TERMINAL)
-
-
-_TERMINAL = (
-    ManagedJobStatus.SUCCEEDED,
-    ManagedJobStatus.FAILED,
-    ManagedJobStatus.FAILED_SETUP,
-    ManagedJobStatus.FAILED_NO_RESOURCE,
-    ManagedJobStatus.FAILED_CONTROLLER,
-    ManagedJobStatus.CANCELLED,
-)
 
 
 def _db_path() -> str:
@@ -112,6 +79,12 @@ def set_status(job_id: int, status: ManagedJobStatus,
     with _conn() as conn:
         conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
                      args)
+
+
+def set_log_path(job_id: int, log_path: str) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE jobs SET log_path = ? WHERE job_id = ?',
+                     (log_path, job_id))
 
 
 def set_controller_pid(job_id: int, pid: int) -> None:

@@ -254,6 +254,13 @@ class GceClient(RestClient):
                           what=f'firewall {body.get("name")}')
         self.wait_global_operation(op, f'firewall {body.get("name")}')
 
+    def patch_firewall(self, name: str, body: Dict[str, Any]) -> None:
+        op = self.request(
+            'PATCH',
+            f'/projects/{self.project}/global/firewalls/{name}',
+            json_body=body, what=f'patch firewall {name}')
+        self.wait_global_operation(op, f'patch firewall {name}')
+
     def delete_firewall(self, name: str) -> None:
         try:
             op = self.request(

@@ -409,20 +409,26 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str], region: str,
         'IPProtocol': 'tcp',
         'ports': [str(p) for p in ports],
     }]
+    rule = {
+        'name': _firewall_name(cluster_name_on_cloud),
+        'network': 'global/networks/default',
+        'direction': 'INGRESS',
+        'sourceRanges': ['0.0.0.0/0'],
+        'allowed': allowed,
+        # Scoped to this cluster's instances only via network tag.
+        'targetTags': [_network_tag(cluster_name_on_cloud)],
+    }
+    gce = _gce()
     try:
-        _gce().insert_firewall({
-            'name': _firewall_name(cluster_name_on_cloud),
-            'network': 'global/networks/default',
-            'direction': 'INGRESS',
-            'sourceRanges': ['0.0.0.0/0'],
-            'allowed': allowed,
-            # Scoped to this cluster's instances only via network tag.
-            'targetTags': [_network_tag(cluster_name_on_cloud)],
-        })
+        gce.insert_firewall(rule)
     except exceptions.ProvisionError as e:
-        # Re-launch of an existing cluster: the rule already exists.
         if 'already exists' not in str(e).lower():
             raise
+        # Re-launch with a (possibly changed) port list: update the
+        # existing rule rather than keeping the stale config.
+        gce.patch_firewall(rule['name'],
+                           {'allowed': allowed,
+                            'targetTags': rule['targetTags']})
 
 
 def cleanup_ports(cluster_name_on_cloud: str, region: str,

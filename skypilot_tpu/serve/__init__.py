@@ -1,0 +1,13 @@
+"""Serving subsystem: replica autoscaling behind a load balancer.
+
+Re-design of reference ``sky/serve/`` (SURVEY.md §2.7): a controller
+process per service runs (a) a replica manager that launches/terminates
+replica clusters through the normal launch path and probes their
+readiness endpoints, (b) a request-rate autoscaler with hysteresis,
+and (c) an HTTP load balancer (aiohttp) proxying to ready replicas.
+JetStream/MaxText replicas on TPU slices are the flagship workload.
+"""
+from skypilot_tpu.serve.core import down, status, up
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+__all__ = ['up', 'down', 'status', 'ServiceSpec']

@@ -1,0 +1,96 @@
+"""Request-rate autoscaler with hysteresis.
+
+Re-design of reference ``sky/serve/autoscalers.py:431``
+(RequestRateAutoscaler): target replica count = ceil(recent QPS /
+target_qps_per_replica), clamped to [min, max]; scale decisions only
+fire after the signal persists for the upscale/downscale delay —
+upscale reacts fast (minutes), downscale slowly (tens of minutes) so
+bursts don't thrash TPU slices that take minutes to provision.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import deque
+from typing import Deque, Optional
+
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+_QPS_WINDOW_SECONDS = 60.0
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    target_replicas: int
+
+
+class FixedReplicaAutoscaler:
+    """No target_qps: hold min_replicas."""
+
+    def __init__(self, spec: ServiceSpec) -> None:
+        self.spec = spec
+
+    def record_request(self, now: Optional[float] = None) -> None:
+        pass
+
+    def evaluate(self, current_replicas: int,
+                 now: Optional[float] = None) -> ScalingDecision:
+        return ScalingDecision(self.spec.min_replicas)
+
+
+class RequestRateAutoscaler:
+
+    def __init__(self, spec: ServiceSpec) -> None:
+        assert spec.target_qps_per_replica is not None
+        self.spec = spec
+        self._timestamps: Deque[float] = deque()
+        # When the raw desire first diverged in the current direction.
+        self._desire_since: Optional[float] = None
+        self._desired: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def record_request(self, now: Optional[float] = None) -> None:
+        self._timestamps.append(now if now is not None else time.time())
+
+    def current_qps(self, now: Optional[float] = None) -> float:
+        now = now if now is not None else time.time()
+        cutoff = now - _QPS_WINDOW_SECONDS
+        while self._timestamps and self._timestamps[0] < cutoff:
+            self._timestamps.popleft()
+        return len(self._timestamps) / _QPS_WINDOW_SECONDS
+
+    def _raw_target(self, now: float) -> int:
+        qps = self.current_qps(now)
+        target = math.ceil(qps / self.spec.target_qps_per_replica)
+        lo = self.spec.min_replicas
+        hi = self.spec.max_replicas
+        return max(lo, min(hi, target) if hi is not None else target)
+
+    def evaluate(self, current_replicas: int,
+                 now: Optional[float] = None) -> ScalingDecision:
+        """Hysteresis: act only after the desire persists its delay."""
+        now = now if now is not None else time.time()
+        raw = self._raw_target(now)
+        if raw == current_replicas:
+            self._desire_since = None
+            self._desired = None
+            return ScalingDecision(current_replicas)
+        if raw != self._desired:
+            self._desired = raw
+            self._desire_since = now
+            return ScalingDecision(current_replicas)
+        delay = (self.spec.upscale_delay_seconds
+                 if raw > current_replicas else
+                 self.spec.downscale_delay_seconds)
+        if now - self._desire_since >= delay:
+            self._desire_since = None
+            self._desired = None
+            return ScalingDecision(raw)
+        return ScalingDecision(current_replicas)
+
+
+def make_autoscaler(spec: ServiceSpec):
+    if spec.target_qps_per_replica is None:
+        return FixedReplicaAutoscaler(spec)
+    return RequestRateAutoscaler(spec)

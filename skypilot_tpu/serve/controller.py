@@ -1,0 +1,113 @@
+"""Service controller: autoscaler loop + LB + replica manager.
+
+Re-design of reference ``sky/serve/controller.py:36`` +
+``service.py:139``: one process per service
+(``python -m skypilot_tpu.serve.controller <name>``) running the load
+balancer (aiohttp, in-process) and a control loop that probes
+replicas, feeds LB request counts to the autoscaler, and reconciles
+replica count. The reference splits controller and LB into two
+processes; one asyncio process is equivalent here and halves the
+moving parts.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import traceback
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+CONTROL_LOOP_GAP_SECONDS = 10.0
+
+
+class ServeController:
+
+    def __init__(self, service_name: str,
+                 loop_gap: float = CONTROL_LOOP_GAP_SECONDS) -> None:
+        record = serve_state.get_service(service_name)
+        assert record is not None, service_name
+        self.name = service_name
+        self.spec = ServiceSpec.from_yaml_config(record['spec'])
+        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        self.replica_manager = ReplicaManager(service_name, self.spec,
+                                              record['task'])
+        self.load_balancer = LoadBalancer(
+            record['lb_port'],
+            policy=self.spec.load_balancing_policy,
+            on_request=self.autoscaler.record_request)
+        self.loop_gap = loop_gap
+        self._shutdown = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    async def _control_loop(self) -> None:
+        target = self.spec.min_replicas
+        self.replica_manager.reconcile(target)
+        serve_state.set_service_status(self.name,
+                                       ServiceStatus.REPLICA_INIT)
+        while not self._shutdown.is_set():
+            try:
+                await asyncio.to_thread(self.replica_manager.probe_all)
+                replicas = serve_state.get_replicas(self.name)
+                live = [
+                    r for r in replicas if r['status'] in
+                    (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                     ReplicaStatus.STARTING, ReplicaStatus.READY,
+                     ReplicaStatus.NOT_READY)
+                ]
+                decision = self.autoscaler.evaluate(len(live))
+                await asyncio.to_thread(self.replica_manager.reconcile,
+                                        decision.target_replicas)
+                urls = self.replica_manager.ready_urls()
+                self.load_balancer.set_replica_urls(urls)
+                serve_state.set_service_status(
+                    self.name, ServiceStatus.READY
+                    if urls else ServiceStatus.REPLICA_INIT)
+            except Exception:  # pylint: disable=broad-except
+                logger.error('Control loop error:\n%s',
+                             traceback.format_exc())
+            try:
+                await asyncio.wait_for(self._shutdown.wait(),
+                                       timeout=self.loop_gap)
+            except asyncio.TimeoutError:
+                pass
+
+    async def run(self) -> None:
+        await self.load_balancer.start()
+        try:
+            await self._control_loop()
+        finally:
+            await self.load_balancer.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('service_name')
+    parser.add_argument('--loop-gap', type=float,
+                        default=CONTROL_LOOP_GAP_SECONDS)
+    args = parser.parse_args()
+    serve_state.set_service_controller_pid(args.service_name,
+                                           os.getpid())
+    controller = ServeController(args.service_name,
+                                 loop_gap=args.loop_gap)
+    try:
+        asyncio.run(controller.run())
+    except Exception as e:  # pylint: disable=broad-except
+        logger.error('Serve controller crashed:\n%s',
+                     traceback.format_exc())
+        serve_state.set_service_status(args.service_name,
+                                       ServiceStatus.FAILED)
+        raise
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,131 @@
+"""Serve public API: up / down / status.
+
+Re-design of reference ``sky/serve/server/core.py``: `up` records the
+service and spawns the detached controller process that owns replicas
+and the load balancer.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _free_port(preferred: int = 0) -> int:
+    with socket.socket() as s:
+        try:
+            s.bind(('', preferred))
+        except OSError:
+            s.bind(('', 0))
+        return s.getsockname()[1]
+
+
+def _log_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_SERVE_LOG_DIR', '~/.skytpu/serve'))
+
+
+def up(task: task_lib.Task,
+       service_name: Optional[str] = None,
+       *,
+       lb_port: Optional[int] = None,
+       controller_loop_gap: Optional[float] = None) -> Dict[str, Any]:
+    """Start a service; returns {'name', 'endpoint'}."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task has no service: section.')
+    spec: ServiceSpec = task.service
+    name = service_name or task.name or 'service'
+    if serve_state.get_service(name) is not None:
+        raise exceptions.SkyTpuError(
+            f'Service {name!r} already exists. `down` it first.')
+    port = _free_port(lb_port or 0)
+    serve_state.add_service(
+        name,
+        spec_json=json.dumps(spec.to_yaml_config()),
+        task_json=json.dumps(task.to_yaml_config()),
+        lb_port=port)
+
+    log_dir = _log_dir()
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f'{name}.log')
+    cmd = [
+        sys.executable, '-u', '-m', 'skypilot_tpu.serve.controller', name
+    ]
+    if controller_loop_gap is not None:
+        cmd += ['--loop-gap', str(controller_loop_gap)]
+    env = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get('PYTHONPATH', '')
+    if repo_root not in existing.split(os.pathsep):
+        env['PYTHONPATH'] = repo_root + (os.pathsep + existing
+                                         if existing else '')
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(cmd, stdout=log_f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True, env=env)
+    serve_state.set_service_controller_pid(name, proc.pid)
+    endpoint = f'http://127.0.0.1:{port}'
+    logger.info('Service %s starting; endpoint %s (controller pid %d).',
+                name, endpoint, proc.pid)
+    return {'name': name, 'endpoint': endpoint}
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    record = serve_state.get_service(service_name)
+    if record is None:
+        if purge:
+            return
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} not found.')
+    serve_state.set_service_status(service_name,
+                                   ServiceStatus.SHUTTING_DOWN)
+    pid = record.get('controller_pid')
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            pass
+    # Tear down replicas from here (controller may already be dead).
+    spec = ServiceSpec.from_yaml_config(record['spec'])
+    manager = ReplicaManager(service_name, spec, record['task'])
+    manager.terminate_all()
+    serve_state.remove_service(service_name)
+    logger.info('Service %s torn down.', service_name)
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    records = ([serve_state.get_service(service_name)]
+               if service_name else serve_state.get_services())
+    out = []
+    for record in records:
+        if record is None:
+            continue
+        replicas = serve_state.get_replicas(record['name'])
+        out.append({
+            'name': record['name'],
+            'status': record['status'],
+            'endpoint': f'http://127.0.0.1:{record["lb_port"]}',
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'],
+                'url': r['url'],
+            } for r in replicas],
+        })
+    return out

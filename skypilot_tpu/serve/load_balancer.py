@@ -1,0 +1,157 @@
+"""HTTP load balancer: aiohttp reverse proxy over ready replicas.
+
+Re-design of reference ``sky/serve/load_balancer.py:22`` +
+``load_balancing_policies.py:89,115`` (RoundRobinPolicy /
+LeastLoadPolicy). Runs inside the service controller process; replica
+URLs are pushed in by the replica manager, and every proxied request
+is reported to the autoscaler as load signal.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
+    'upgrade', 'host',
+}
+
+
+class LoadBalancingPolicy:
+
+    def set_urls(self, urls: List[str]) -> None:
+        raise NotImplementedError
+
+    def pick(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def done(self, url: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self) -> None:
+        self._urls: List[str] = []
+        self._it = itertools.cycle([])
+
+    def set_urls(self, urls: List[str]) -> None:
+        if urls != self._urls:
+            self._urls = list(urls)
+            self._it = itertools.cycle(self._urls)
+
+    def pick(self) -> Optional[str]:
+        if not self._urls:
+            return None
+        return next(self._it)
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with the fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        self._load: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def set_urls(self, urls: List[str]) -> None:
+        with self._lock:
+            for url in urls:
+                self._load.setdefault(url, 0)
+            for url in list(self._load):
+                if url not in urls:
+                    del self._load[url]
+
+    def pick(self) -> Optional[str]:
+        with self._lock:
+            if not self._load:
+                return None
+            url = min(self._load, key=self._load.get)
+            self._load[url] += 1
+            return url
+
+    def done(self, url: str) -> None:
+        with self._lock:
+            if url in self._load:
+                self._load[url] = max(0, self._load[url] - 1)
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_load': LeastLoadPolicy,
+}
+
+
+class LoadBalancer:
+    """aiohttp app proxying every request to a picked replica."""
+
+    def __init__(self, port: int, policy: str = 'least_load',
+                 on_request: Optional[Callable[[], None]] = None) -> None:
+        self.port = port
+        self.policy: LoadBalancingPolicy = POLICIES[policy]()
+        self.on_request = on_request
+        self._runner: Optional[web.AppRunner] = None
+
+    def set_replica_urls(self, urls: List[str]) -> None:
+        self.policy.set_urls(urls)
+
+    # ------------------------------------------------------------------
+    async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        if self.on_request is not None:
+            self.on_request()
+        url = self.policy.pick()
+        if url is None:
+            return web.Response(status=503,
+                                text='No ready replicas.\n')
+        target = url.rstrip('/') + '/' + request.rel_url.path.lstrip('/')
+        if request.rel_url.query_string:
+            target += '?' + request.rel_url.query_string
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        body = await request.read()
+        try:
+            timeout = aiohttp.ClientTimeout(total=300)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                async with session.request(request.method, target,
+                                           headers=headers,
+                                           data=body) as resp:
+                    payload = await resp.read()
+                    out_headers = {
+                        k: v for k, v in resp.headers.items()
+                        if k.lower() not in _HOP_HEADERS and
+                        k.lower() != 'content-length'
+                    }
+                    return web.Response(status=resp.status,
+                                        body=payload,
+                                        headers=out_headers)
+        except aiohttp.ClientError as e:
+            logger.warning('Proxy to %s failed: %s', url, e)
+            return web.Response(status=502,
+                                text=f'Replica unreachable: {e}\n')
+        finally:
+            self.policy.done(url)
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_route('*', '/{tail:.*}', self._proxy)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, '0.0.0.0', self.port)
+        await site.start()
+        logger.info('Load balancer listening on :%d', self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

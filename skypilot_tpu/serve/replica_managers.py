@@ -1,0 +1,252 @@
+"""Replica manager: replica cluster lifecycle + readiness probing.
+
+Re-design of reference ``sky/serve/replica_managers.py:59,563,782,1026``:
+scale_up launches replica clusters (each a normal launch, possibly a
+TPU pod slice) in background threads; a probe pass drives the
+ReplicaStatus FSM from readiness-HTTP + cluster status, detecting
+preemptions (cluster gone → PREEMPTED → replaced) and failures.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import status_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Local-cloud replicas share 127.0.0.1; give each a distinct port via
+# this env var (recipes bind to it; real clouds also get it, set to
+# the spec's replica_port, so the same recipe works everywhere).
+SERVE_PORT_ENV = 'SKYTPU_SERVE_PORT'
+
+# After this many failed replica launches the reconciler stops
+# replacing (the task is broken, not the infra).
+_MAX_FAILED_REPLICAS = 3
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: ServiceSpec,
+                 task_config: dict) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task_config = task_config
+        self._launch_threads: Dict[int, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._failed_probes: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+    def _cluster_name(self, replica_id: int) -> str:
+        return f'{self.service_name}-replica-{replica_id}'
+
+    def _replica_port(self, replica_id: int) -> int:
+        # Distinct per replica so local (same-IP) replicas never clash;
+        # stable so recovery reuses the port.
+        return self.spec.replica_port + replica_id
+
+    def _make_task(self, replica_id: int) -> 'task_lib.Task':
+        # A replica is a plain task: strip the service: section.
+        config = {k: v for k, v in self.task_config.items()
+                  if k != 'service'}
+        task = task_lib.Task.from_yaml_config(config)
+        envs = dict(task.envs or {})
+        envs[SERVE_PORT_ENV] = str(self._replica_port(replica_id))
+        task.update_envs(envs)
+        return task
+
+    # ------------------------------------------------------------------
+    def scale_up(self, n: int = 1) -> None:
+        for _ in range(n):
+            replica_id = serve_state.next_replica_id(self.service_name)
+            cluster = self._cluster_name(replica_id)
+            serve_state.add_replica(self.service_name, replica_id,
+                                    cluster)
+            thread = threading.Thread(target=self._launch_replica,
+                                      args=(replica_id, cluster),
+                                      daemon=True)
+            self._launch_threads[replica_id] = thread
+            thread.start()
+
+    def _launch_replica(self, replica_id: int, cluster: str) -> None:
+        from skypilot_tpu import execution
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.PROVISIONING)
+        try:
+            task = self._make_task(replica_id)
+            execution.launch(task, cluster_name=cluster,
+                             detach_run=True, stream_logs=False)
+        except Exception:  # pylint: disable=broad-except
+            logger.error('Replica %d launch failed:\n%s', replica_id,
+                         traceback.format_exc())
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED)
+            return
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.STARTING)
+
+    # ------------------------------------------------------------------
+    def scale_down(self, replica_ids: List[int]) -> None:
+        for replica_id in replica_ids:
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.SHUTTING_DOWN)
+            thread = threading.Thread(target=self._terminate_replica,
+                                      args=(replica_id,), daemon=True)
+            thread.start()
+
+    def _terminate_replica(self, replica_id: int) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(self._cluster_name(replica_id))
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('Replica %d teardown error:\n%s', replica_id,
+                           traceback.format_exc())
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.SHUTDOWN)
+
+    def terminate_all(self) -> None:
+        replicas = serve_state.get_replicas(self.service_name)
+        ids = [
+            r['replica_id'] for r in replicas
+            if r['status'] not in (ReplicaStatus.SHUTDOWN,)
+        ]
+        threads = []
+        for replica_id in ids:
+            t = threading.Thread(target=self._terminate_replica,
+                                 args=(replica_id,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    # ------------------------------------------------------------------
+    def _replica_url(self, replica_id: int,
+                     cluster: str) -> Optional[str]:
+        record = backend_utils.refresh_cluster_record(cluster)
+        if record is None or record.get('handle') is None:
+            return None
+        handle = record['handle']
+        ips = handle.ip_list()
+        if not ips:
+            return None
+        return f'http://{ips[0]}:{self._replica_port(replica_id)}'
+
+    def _probe_ready(self, url: str) -> bool:
+        try:
+            resp = requests.get(
+                url.rstrip('/') + self.spec.readiness_path,
+                timeout=self.spec.readiness_timeout_seconds)
+            return resp.status_code < 500
+        except requests.RequestException:
+            return False
+
+    def probe_all(self) -> None:
+        """One probe pass: drive the FSM for every live replica."""
+        for replica in serve_state.get_replicas(self.service_name):
+            rid = replica['replica_id']
+            status = replica['status']
+            if status in (ReplicaStatus.PENDING,
+                          ReplicaStatus.PROVISIONING,
+                          ReplicaStatus.SHUTTING_DOWN,
+                          ReplicaStatus.SHUTDOWN, ReplicaStatus.FAILED):
+                continue
+            cluster = replica['cluster_name']
+            try:
+                record = backend_utils.refresh_cluster_record(
+                    cluster, force_refresh=True)
+            except Exception:  # pylint: disable=broad-except
+                record = None
+            if (record is None or
+                    record['status'] != status_lib.ClusterStatus.UP):
+                # Cluster died under us: preemption.
+                logger.info('Replica %d cluster %s gone: PREEMPTED.',
+                            rid, cluster)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.PREEMPTED)
+                self._terminate_replica(rid)  # cleanup leftovers
+                continue
+            url = self._replica_url(rid, cluster)
+            ready = url is not None and self._probe_ready(url)
+            if ready:
+                self._failed_probes[rid] = 0
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.READY,
+                                               url=url)
+            elif status == ReplicaStatus.READY:
+                self._failed_probes[rid] = (
+                    self._failed_probes.get(rid, 0) + 1)
+                # Transient blips tolerated; sustained failure demotes.
+                if self._failed_probes[rid] >= 3:
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.NOT_READY)
+            elif status == ReplicaStatus.STARTING:
+                launched_at = replica.get('launched_at') or 0
+                if (time.time() - launched_at >
+                        self.spec.initial_delay_seconds):
+                    logger.warning(
+                        'Replica %d never became ready within '
+                        'initial_delay_seconds: FAILED.', rid)
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.FAILED)
+                    self._terminate_replica(rid)
+
+    # ------------------------------------------------------------------
+    def reconcile(self, target: int) -> None:
+        """Converge live replica count toward `target`; replace
+        preempted replicas."""
+        replicas = serve_state.get_replicas(self.service_name)
+        live = [
+            r for r in replicas
+            if r['status'] in (ReplicaStatus.PENDING,
+                               ReplicaStatus.PROVISIONING,
+                               ReplicaStatus.STARTING,
+                               ReplicaStatus.READY,
+                               ReplicaStatus.NOT_READY)
+        ]
+        preempted = [
+            r for r in replicas
+            if r['status'] == ReplicaStatus.PREEMPTED
+        ]
+        for r in preempted:
+            serve_state.remove_replica(self.service_name,
+                                       r['replica_id'])
+        failed = sum(
+            1 for r in replicas if r['status'] == ReplicaStatus.FAILED)
+        if len(live) < target:
+            # Replace missing replicas, but a string of FAILED
+            # launches means the task itself is broken — stop burning
+            # clusters (reference replica_managers marks the service
+            # failed rather than relaunching forever).
+            if failed > _MAX_FAILED_REPLICAS:
+                logger.error(
+                    'Service %s: %d failed replicas; halting scale-up.',
+                    self.service_name, failed)
+                return
+            self.scale_up(target - len(live))
+        elif len(live) > target:
+            # Prefer shutting down not-ready, then newest.
+            order = sorted(
+                live,
+                key=lambda r: (r['status'] == ReplicaStatus.READY,
+                               -r['replica_id']))
+            doomed = order[:len(live) - target]
+            self.scale_down([r['replica_id'] for r in doomed])
+
+    def ready_urls(self) -> List[str]:
+        return [
+            r['url'] for r in serve_state.get_replicas(self.service_name)
+            if r['status'] == ReplicaStatus.READY and r['url']
+        ]

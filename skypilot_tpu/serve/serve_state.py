@@ -1,0 +1,162 @@
+"""Serve SQLite state: services + replicas.
+
+Re-design of reference ``sky/serve/serve_state.py:40-57``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils.status_lib import ReplicaStatus, ServiceStatus
+
+_DB_PATH_ENV = 'SKYTPU_SERVE_DB'
+_DEFAULT_DB = '~/.skytpu/serve.db'
+
+
+def _db_path() -> str:
+    return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
+
+
+def _conn() -> sqlite3.Connection:
+    path = _db_path()
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            status TEXT,
+            spec_json TEXT,
+            task_json TEXT,
+            controller_pid INTEGER,
+            lb_port INTEGER,
+            created_at REAL
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS replicas (
+            service_name TEXT,
+            replica_id INTEGER,
+            cluster_name TEXT,
+            status TEXT,
+            url TEXT,
+            launched_at REAL,
+            PRIMARY KEY (service_name, replica_id)
+        )""")
+    return conn
+
+
+# ------------------------------------------------------------- services
+
+
+def add_service(name: str, spec_json: str, task_json: str,
+                lb_port: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO services (name, status, spec_json, '
+            'task_json, lb_port, created_at) VALUES (?,?,?,?,?,?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
+             task_json, lb_port, time.time()))
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE services SET status = ? WHERE name = ?',
+                     (status.value, name))
+
+
+def set_service_controller_pid(name: str, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE services SET controller_pid = ? WHERE name = ?',
+            (pid, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute('SELECT * FROM services WHERE name = ?',
+                           (name,)).fetchone()
+    if row is None:
+        return None
+    d = dict(row)
+    d['status'] = ServiceStatus(d['status'])
+    d['spec'] = json.loads(d['spec_json'])
+    d['task'] = json.loads(d['task_json'])
+    return d
+
+
+def get_services() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        names = [
+            r['name']
+            for r in conn.execute('SELECT name FROM services ORDER BY name')
+        ]
+    return [get_service(n) for n in names]
+
+
+def remove_service(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM services WHERE name = ?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name = ?',
+                     (name,))
+
+
+# ------------------------------------------------------------- replicas
+
+
+def add_replica(service_name: str, replica_id: int,
+                cluster_name: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
+            'cluster_name, status, launched_at) VALUES (?,?,?,?,?)',
+            (service_name, replica_id, cluster_name,
+             ReplicaStatus.PENDING.value, time.time()))
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus,
+                       url: Optional[str] = None) -> None:
+    with _conn() as conn:
+        if url is not None:
+            conn.execute(
+                'UPDATE replicas SET status = ?, url = ? '
+                'WHERE service_name = ? AND replica_id = ?',
+                (status.value, url, service_name, replica_id))
+        else:
+            conn.execute(
+                'UPDATE replicas SET status = ? '
+                'WHERE service_name = ? AND replica_id = ?',
+                (status.value, service_name, replica_id))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service_name = ? '
+            'ORDER BY replica_id', (service_name,)).fetchall()
+    out = []
+    for row in rows:
+        d = dict(row)
+        d['status'] = ReplicaStatus(d['status'])
+        out.append(d)
+    return out
+
+
+def next_replica_id(service_name: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(replica_id) AS m FROM replicas '
+            'WHERE service_name = ?', (service_name,)).fetchone()
+    return (row['m'] or 0) + 1
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'DELETE FROM replicas WHERE service_name = ? AND '
+            'replica_id = ?', (service_name, replica_id))

@@ -1,0 +1,116 @@
+"""The ``service:`` section of a task YAML.
+
+Re-design of reference ``sky/serve/service_spec.py:1-385``.
+
+Example::
+
+    service:
+      readiness_probe:
+        path: /health
+        initial_delay_seconds: 60
+      replica_policy:
+        min_replicas: 1
+        max_replicas: 4
+        target_qps_per_replica: 2.5
+      replica_port: 8000
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    readiness_path: str = '/'
+    initial_delay_seconds: int = 600
+    readiness_timeout_seconds: int = 15
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    replica_port: int = 8080
+    # Hysteresis (reference autoscalers.py:431): consecutive decision
+    # intervals before acting.
+    upscale_delay_seconds: int = 300
+    downscale_delay_seconds: int = 1200
+    load_balancing_policy: str = 'least_load'
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'service: must be a mapping, got {config!r}')
+        probe = config.get('readiness_probe', {})
+        if isinstance(probe, str):
+            probe = {'path': probe}
+        policy = config.get('replica_policy', {})
+        if 'replicas' in config and policy:
+            raise exceptions.InvalidTaskError(
+                'Use either service.replicas or service.replica_policy, '
+                'not both.')
+        if 'replicas' in config:
+            policy = {
+                'min_replicas': config['replicas'],
+                'max_replicas': config['replicas'],
+            }
+        spec = cls(
+            readiness_path=probe.get('path', '/'),
+            initial_delay_seconds=int(
+                probe.get('initial_delay_seconds', 600)),
+            readiness_timeout_seconds=int(
+                probe.get('timeout_seconds', 15)),
+            min_replicas=int(policy.get('min_replicas', 1)),
+            max_replicas=(int(policy['max_replicas'])
+                          if policy.get('max_replicas') is not None else
+                          None),
+            target_qps_per_replica=(
+                float(policy['target_qps_per_replica'])
+                if policy.get('target_qps_per_replica') is not None else
+                None),
+            replica_port=int(config.get('replica_port', 8080)),
+            upscale_delay_seconds=int(
+                policy.get('upscale_delay_seconds', 300)),
+            downscale_delay_seconds=int(
+                policy.get('downscale_delay_seconds', 1200)),
+            load_balancing_policy=config.get('load_balancing_policy',
+                                             'least_load'),
+        )
+        spec.validate()
+        return spec
+
+    def validate(self) -> None:
+        if self.min_replicas < 0:
+            raise exceptions.InvalidTaskError('min_replicas must be >= 0')
+        if (self.max_replicas is not None and
+                self.max_replicas < self.min_replicas):
+            raise exceptions.InvalidTaskError(
+                'max_replicas must be >= min_replicas')
+        if (self.target_qps_per_replica is not None and
+                self.target_qps_per_replica <= 0):
+            raise exceptions.InvalidTaskError(
+                'target_qps_per_replica must be > 0')
+        if (self.target_qps_per_replica is not None and
+                self.max_replicas is None):
+            raise exceptions.InvalidTaskError(
+                'autoscaling (target_qps_per_replica) requires '
+                'max_replicas')
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        return {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.readiness_timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+                'target_qps_per_replica': self.target_qps_per_replica,
+                'upscale_delay_seconds': self.upscale_delay_seconds,
+                'downscale_delay_seconds': self.downscale_delay_seconds,
+            },
+            'replica_port': self.replica_port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }

@@ -1,0 +1,7 @@
+"""API server: HTTP front-end over the core API.
+
+Re-design of reference ``sky/server/`` (SURVEY.md §2.8): every SDK
+call becomes a POST that persists a request row, gets executed by a
+worker (detached process for long operations, thread for short ones),
+and is polled/streamed back by the client. aiohttp replaces FastAPI.
+"""

@@ -1,0 +1,181 @@
+"""Executable request bodies, shared by server routes and workers.
+
+Each op takes the JSON body and returns a JSON-safe result. LONG ops
+run in a worker process (skypilot_tpu.server.worker); SHORT ops run on
+the server's thread pool.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+from skypilot_tpu.server.requests import ScheduleType
+
+
+def _task_from_body(body: Dict[str, Any]):
+    from skypilot_tpu import task as task_lib
+    return task_lib.Task.from_yaml_config(body['task'])
+
+
+def _launch(body: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = _task_from_body(body)
+    job_id, handle = execution.launch(
+        task,
+        cluster_name=body.get('cluster_name'),
+        dryrun=body.get('dryrun', False),
+        stream_logs=False,
+        detach_run=True,
+        idle_minutes_to_autostop=body.get('idle_minutes_to_autostop'),
+        down=body.get('down', False),
+        retry_until_up=body.get('retry_until_up', False),
+    )
+    return {
+        'job_id': job_id,
+        'cluster_name': handle.cluster_name if handle else None,
+    }
+
+
+def _exec(body: Dict[str, Any]) -> Dict[str, Any]:
+    from skypilot_tpu import execution
+    task = _task_from_body(body)
+    job_id, handle = execution.exec_(task,
+                                     cluster_name=body['cluster_name'],
+                                     stream_logs=False,
+                                     detach_run=True)
+    return {
+        'job_id': job_id,
+        'cluster_name': handle.cluster_name if handle else None,
+    }
+
+
+def _status(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu import core
+    records = core.status(body.get('cluster_names'),
+                          refresh=body.get('refresh', False))
+    out = []
+    for r in records:
+        handle = r.get('handle')
+        out.append({
+            'name': r['name'],
+            'status': r['status'].value,
+            'resources': str(handle.launched_resources) if handle else '',
+            'launched_at': r.get('launched_at'),
+            'autostop': r.get('autostop'),
+        })
+    return out
+
+
+def _core_op(method: str) -> Callable[[Dict[str, Any]], Any]:
+
+    def run(body: Dict[str, Any]) -> Any:
+        from skypilot_tpu import core
+        return getattr(core, method)(**body)
+
+    return run
+
+
+def _queue(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu import core
+    jobs = core.queue(body['cluster_name'])
+    for j in jobs:
+        if hasattr(j.get('status'), 'value'):
+            j['status'] = j['status'].value
+    return jobs
+
+
+def _job_status(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu import core
+    statuses = core.job_status(body['cluster_name'],
+                               body.get('job_ids'))
+    return {
+        str(k): (v.value if v is not None else None)
+        for k, v in statuses.items()
+    }
+
+
+def _jobs_launch(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.jobs import core as jobs_core
+    job_id = jobs_core.launch(_task_from_body(body),
+                              name=body.get('name'))
+    return {'managed_job_id': job_id}
+
+
+def _jobs_queue(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.jobs import core as jobs_core
+    out = []
+    for j in jobs_core.queue():
+        out.append({
+            'job_id': j['job_id'],
+            'name': j['name'],
+            'status': j['status'].value,
+            'cluster_name': j['cluster_name'],
+            'recovery_count': j['recovery_count'],
+            'submitted_at': j['submitted_at'],
+        })
+    return out
+
+
+def _jobs_cancel(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.jobs import core as jobs_core
+    return {
+        'cancelled': jobs_core.cancel(body.get('job_ids'),
+                                      all_jobs=body.get('all', False))
+    }
+
+
+def _serve_up(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.up(_task_from_body(body),
+                         body.get('service_name'))
+
+
+def _serve_down(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(body['service_name'], purge=body.get('purge', False))
+    return {'ok': True}
+
+
+def _serve_status(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    out = []
+    for s in serve_core.status(body.get('service_name')):
+        out.append({
+            'name': s['name'],
+            'status': s['status'].value,
+            'endpoint': s['endpoint'],
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'].value,
+                'url': r['url'],
+            } for r in s['replicas']],
+        })
+    return out
+
+
+def _check(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check(quiet=True)
+    return [str(c) for c in enabled]
+
+
+# op name -> (callable, schedule type)
+OPS: Dict[str, Tuple[Callable[[Dict[str, Any]], Any], ScheduleType]] = {
+    'launch': (_launch, ScheduleType.LONG),
+    'exec': (_exec, ScheduleType.LONG),
+    'stop': (_core_op('stop'), ScheduleType.LONG),
+    'start': (_core_op('start'), ScheduleType.LONG),
+    'down': (_core_op('down'), ScheduleType.LONG),
+    'autostop': (_core_op('autostop'), ScheduleType.SHORT),
+    'cancel': (_core_op('cancel'), ScheduleType.SHORT),
+    'status': (_status, ScheduleType.SHORT),
+    'queue': (_queue, ScheduleType.SHORT),
+    'job_status': (_job_status, ScheduleType.SHORT),
+    'cost_report': (_core_op('cost_report'), ScheduleType.SHORT),
+    'check': (_check, ScheduleType.SHORT),
+    'jobs.launch': (_jobs_launch, ScheduleType.LONG),
+    'jobs.queue': (_jobs_queue, ScheduleType.SHORT),
+    'jobs.cancel': (_jobs_cancel, ScheduleType.SHORT),
+    'serve.up': (_serve_up, ScheduleType.LONG),
+    'serve.down': (_serve_down, ScheduleType.LONG),
+    'serve.status': (_serve_status, ScheduleType.SHORT),
+}

@@ -1,0 +1,217 @@
+"""Request DB + executor.
+
+Re-design of reference ``sky/server/requests/requests.py:398`` +
+``executor.py:282``: requests persist to SQLite; LONG requests
+(launch/exec/down/...) run in detached worker processes with output
+redirected to a per-request log file; SHORT requests (status/queue)
+run on a thread pool in the server process. Results are JSON.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pathlib
+import sqlite3
+import subprocess
+import sys
+import threading
+import time
+import traceback
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_DB_PATH_ENV = 'SKYTPU_REQUESTS_DB'
+_DEFAULT_DB = '~/.skytpu/api_requests.db'
+_LOG_DIR_ENV = 'SKYTPU_REQUESTS_LOG_DIR'
+_DEFAULT_LOG_DIR = '~/.skytpu/api_requests'
+
+_MAX_LONG_WORKERS = max(2, (os.cpu_count() or 4) // 2)
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+class ScheduleType(enum.Enum):
+    LONG = 'long'     # launch/exec/down/start/stop — own process
+    SHORT = 'short'   # status/queue/... — server thread pool
+
+
+def _db_path() -> str:
+    return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
+
+
+def log_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get(_LOG_DIR_ENV, _DEFAULT_LOG_DIR))
+
+
+def _conn() -> sqlite3.Connection:
+    path = _db_path()
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS requests (
+            request_id TEXT PRIMARY KEY,
+            name TEXT,
+            status TEXT,
+            schedule_type TEXT,
+            body_json TEXT,
+            result_json TEXT,
+            error TEXT,
+            pid INTEGER,
+            created_at REAL,
+            finished_at REAL
+        )""")
+    return conn
+
+
+def create(name: str, body: Dict[str, Any],
+           schedule_type: ScheduleType) -> str:
+    request_id = uuid.uuid4().hex[:16]
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, status, '
+            'schedule_type, body_json, created_at) VALUES (?,?,?,?,?,?)',
+            (request_id, name, RequestStatus.PENDING.value,
+             schedule_type.value, json.dumps(body), time.time()))
+    return request_id
+
+
+def set_running(request_id: str, pid: Optional[int] = None) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status = ?, pid = ? WHERE request_id = ?',
+            (RequestStatus.RUNNING.value, pid, request_id))
+
+
+def finish(request_id: str, *, result: Any = None,
+           error: Optional[str] = None,
+           cancelled: bool = False) -> None:
+    status = (RequestStatus.CANCELLED if cancelled else
+              RequestStatus.FAILED if error is not None else
+              RequestStatus.SUCCEEDED)
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status = ?, result_json = ?, error = ?, '
+            'finished_at = ? WHERE request_id = ?',
+            (status.value, json.dumps(result), error, time.time(),
+             request_id))
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT * FROM requests WHERE request_id = ?',
+            (request_id,)).fetchone()
+    if row is None:
+        return None
+    d = dict(row)
+    d['status'] = RequestStatus(d['status'])
+    if d.get('result_json'):
+        d['result'] = json.loads(d['result_json'])
+    return d
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT request_id, name, status, created_at, finished_at '
+            'FROM requests ORDER BY created_at DESC LIMIT ?',
+            (limit,)).fetchall()
+    return [dict(r) for r in rows]
+
+
+def request_log_path(request_id: str) -> str:
+    return os.path.join(log_dir(), f'{request_id}.log')
+
+
+def cancel(request_id: str) -> bool:
+    record = get(request_id)
+    if record is None or record['status'].is_terminal():
+        return False
+    pid = record.get('pid')
+    if pid:
+        import signal
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except (OSError, ProcessLookupError):
+                pass
+    finish(request_id, cancelled=True)
+    return True
+
+
+# ----------------------------------------------------------- executor
+
+
+_short_pool = ThreadPoolExecutor(max_workers=8,
+                                 thread_name_prefix='short-req')
+_long_slots = threading.Semaphore(_MAX_LONG_WORKERS)
+
+
+def run_short(request_id: str, fn: Callable[[], Any]) -> None:
+    """Execute in the server process (fast, non-blocking ops)."""
+
+    def work():
+        set_running(request_id)
+        try:
+            result = fn()
+            finish(request_id, result=result)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('Short request %s failed:\n%s', request_id,
+                           traceback.format_exc())
+            finish(request_id, error=f'{type(e).__name__}: {e}')
+
+    _short_pool.submit(work)
+
+
+def spawn_long(request_id: str) -> None:
+    """Execute in a detached worker process; output → request log."""
+
+    def work():
+        with _long_slots:
+            os.makedirs(log_dir(), exist_ok=True)
+            log_path = request_log_path(request_id)
+            env = dict(os.environ)
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            existing = env.get('PYTHONPATH', '')
+            if repo_root not in existing.split(os.pathsep):
+                env['PYTHONPATH'] = repo_root + (
+                    os.pathsep + existing if existing else '')
+            with open(log_path, 'ab') as log_f:
+                proc = subprocess.Popen(
+                    [sys.executable, '-u', '-m',
+                     'skypilot_tpu.server.worker', request_id],
+                    stdout=log_f, stderr=subprocess.STDOUT,
+                    start_new_session=True, env=env)
+            set_running(request_id, pid=proc.pid)
+            proc.wait()
+            # The worker writes the result row itself; if it died
+            # without doing so, record the crash.
+            record = get(request_id)
+            if record is not None and not record['status'].is_terminal():
+                finish(request_id,
+                       error=f'worker exited with {proc.returncode} '
+                       'before recording a result')
+
+    threading.Thread(target=work, daemon=True).start()

@@ -1,0 +1,164 @@
+"""The API server: aiohttp app, one route per SDK call.
+
+Re-design of reference ``sky/server/server.py:168-1092``: POST
+/api/v1/<op> persists a request and schedules it (LONG → worker
+process, SHORT → thread pool), returning {request_id}. GET /api/get
+polls to completion; GET /api/stream streams the request's log file
+(the reference's SSE path); POST /api/cancel kills it. /api/health
+serves the liveness/version check used by client autostart.
+
+Run: ``python -m skypilot_tpu.server.server --port 46580``.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from skypilot_tpu.server import ops
+from skypilot_tpu.server import requests as requests_db
+from skypilot_tpu.server.requests import RequestStatus, ScheduleType
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 46580
+API_VERSION = 1
+
+
+async def handle_op(request: web.Request) -> web.Response:
+    op_name = request.match_info['op'].replace('/', '.')
+    if op_name not in ops.OPS:
+        return web.json_response(
+            {'error': f'unknown operation {op_name!r}'}, status=404)
+    body = await request.json() if request.can_read_body else {}
+    fn, schedule_type = ops.OPS[op_name]
+    request_id = requests_db.create(op_name, body, schedule_type)
+    if schedule_type == ScheduleType.SHORT:
+        requests_db.run_short(request_id, lambda: fn(body))
+    else:
+        requests_db.spawn_long(request_id)
+    return web.json_response({'request_id': request_id})
+
+
+async def handle_get(request: web.Request) -> web.Response:
+    """Block until the request is terminal; return its result."""
+    request_id = request.query['request_id']
+    timeout = float(request.query.get('timeout', 3600))
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        record = requests_db.get(request_id)
+        if record is None:
+            return web.json_response({'error': 'not found'}, status=404)
+        if record['status'].is_terminal():
+            return web.json_response({
+                'request_id': request_id,
+                'status': record['status'].value,
+                'result': record.get('result'),
+                'error': record.get('error'),
+            })
+        if asyncio.get_event_loop().time() > deadline:
+            return web.json_response({
+                'request_id': request_id,
+                'status': record['status'].value,
+            })
+        await asyncio.sleep(0.2)
+
+
+async def handle_status_poll(request: web.Request) -> web.Response:
+    """Non-blocking single status read."""
+    request_id = request.query['request_id']
+    record = requests_db.get(request_id)
+    if record is None:
+        return web.json_response({'error': 'not found'}, status=404)
+    return web.json_response({
+        'request_id': request_id,
+        'status': record['status'].value,
+        'result': record.get('result'),
+        'error': record.get('error'),
+    })
+
+
+async def handle_stream(request: web.Request) -> web.StreamResponse:
+    """Follow a request's log file until the request is terminal."""
+    request_id = request.query['request_id']
+    record = requests_db.get(request_id)
+    if record is None:
+        return web.json_response({'error': 'not found'}, status=404)
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+    path = requests_db.request_log_path(request_id)
+    pos = 0
+    while True:
+        if os.path.exists(path):
+            with open(path, 'rb') as f:
+                f.seek(pos)
+                chunk = f.read()
+            if chunk:
+                pos += len(chunk)
+                await resp.write(chunk)
+        record = requests_db.get(request_id)
+        if record is None or record['status'].is_terminal():
+            break
+        await asyncio.sleep(0.3)
+    # Drain any tail written between the last read and terminal state.
+    if os.path.exists(path):
+        with open(path, 'rb') as f:
+            f.seek(pos)
+            chunk = f.read()
+        if chunk:
+            await resp.write(chunk)
+    await resp.write_eof()
+    return resp
+
+
+async def handle_cancel(request: web.Request) -> web.Response:
+    body = await request.json()
+    ok = requests_db.cancel(body['request_id'])
+    return web.json_response({'cancelled': ok})
+
+
+async def handle_list(request: web.Request) -> web.Response:
+    return web.json_response(requests_db.list_requests())
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    return web.json_response({
+        'status': 'healthy',
+        'api_version': API_VERSION,
+    })
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get('/api/health', handle_health)
+    app.router.add_get('/api/get', handle_get)
+    app.router.add_get('/api/status', handle_status_poll)
+    app.router.add_get('/api/stream', handle_stream)
+    app.router.add_post('/api/cancel', handle_cancel)
+    app.router.add_get('/api/requests', handle_list)
+    app.router.add_post('/api/v1/{op:.+}', handle_op)
+    return app
+
+
+def run(host: str = '127.0.0.1',
+        port: int = DEFAULT_PORT) -> None:  # pragma: no cover
+    web.run_app(make_app(), host=host, port=port, print=None)
+
+
+def main() -> None:  # pragma: no cover
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    logger.info('API server on %s:%d', args.host, args.port)
+    run(args.host, args.port)
+
+
+if __name__ == '__main__':  # pragma: no cover
+    main()

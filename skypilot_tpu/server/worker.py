@@ -1,0 +1,37 @@
+"""Long-request worker process: execute one request row and record it.
+
+Re-design of reference ``sky/server/requests/executor.py:171-224``
+(`_request_execution_wrapper`): stdout/stderr are already redirected to
+the per-request log by the spawner; this module loads the body, runs
+the op, and writes the result/error back to the request DB.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import traceback
+
+from skypilot_tpu.server import ops
+from skypilot_tpu.server import requests as requests_db
+
+
+def main() -> None:
+    request_id = sys.argv[1]
+    record = requests_db.get(request_id)
+    if record is None:
+        print(f'request {request_id} not found', file=sys.stderr)
+        sys.exit(2)
+    body = json.loads(record['body_json'])
+    fn, _ = ops.OPS[record['name']]
+    try:
+        result = fn(body)
+    except Exception as e:  # pylint: disable=broad-except
+        traceback.print_exc()
+        requests_db.finish(request_id,
+                           error=f'{type(e).__name__}: {e}')
+        sys.exit(1)
+    requests_db.finish(request_id, result=result)
+
+
+if __name__ == '__main__':
+    main()

@@ -50,7 +50,6 @@ _RESOURCES_SCHEMA = {
 _SERVICE_SCHEMA = {
     'type': 'object',
     'additionalProperties': False,
-    'required': ['readiness_probe'],
     'properties': {
         'readiness_probe': {
             'anyOf': [
@@ -82,6 +81,7 @@ _SERVICE_SCHEMA = {
             },
         },
         'replicas': {'type': 'integer'},
+        'replica_port': {'type': 'integer'},
         'load_balancing_policy': {'type': 'string'},
     },
 }

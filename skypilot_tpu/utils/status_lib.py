@@ -85,6 +85,10 @@ class ManagedJobStatus(enum.Enum):
             ManagedJobStatus.FAILED_CONTROLLER,
         }
 
+    @classmethod
+    def terminal_statuses(cls):
+        return list(_TERMINAL_MANAGED_STATUSES)
+
 
 _TERMINAL_MANAGED_STATUSES = frozenset({
     ManagedJobStatus.SUCCEEDED,
@@ -105,11 +109,15 @@ class ReplicaStatus(enum.Enum):
     READY = 'READY'
     NOT_READY = 'NOT_READY'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
+    SHUTDOWN = 'SHUTDOWN'
     FAILED = 'FAILED'
     FAILED_INITIAL_DELAY = 'FAILED_INITIAL_DELAY'
     FAILED_PROBING = 'FAILED_PROBING'
     FAILED_PROVISION = 'FAILED_PROVISION'
     PREEMPTED = 'PREEMPTED'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED, ReplicaStatus.SHUTDOWN)
 
     def is_failed(self) -> bool:
         return self.value.startswith('FAILED')

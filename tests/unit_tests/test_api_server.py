@@ -1,0 +1,140 @@
+"""Client-server path: requests DB, executor, routes, SDK, CLI.
+
+Mirrors the reference's API-server-in-process strategy
+(tests/common_test_fixtures.py:45-100): the aiohttp app runs in this
+process on a real socket; long requests spawn real worker processes
+that execute against the local cloud.
+"""
+import json
+import threading
+import time
+
+import pytest
+import requests as http
+
+from skypilot_tpu.server import requests as requests_db
+from skypilot_tpu.server.requests import RequestStatus, ScheduleType
+
+
+@pytest.fixture
+def api_env(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYTPU_REQUESTS_DB',
+                       str(isolated_state / 'requests.db'))
+    monkeypatch.setenv('SKYTPU_REQUESTS_LOG_DIR',
+                       str(isolated_state / 'req_logs'))
+    yield isolated_state
+
+
+@pytest.fixture
+def live_server(api_env, monkeypatch):
+    """Run the aiohttp app on a free port in a thread."""
+    import asyncio
+
+    from aiohttp import web
+
+    from skypilot_tpu.server.server import make_app
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', 0)
+        loop.run_until_complete(site.start())
+        port_holder['port'] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    url = f'http://127.0.0.1:{port_holder["port"]}'
+    monkeypatch.setenv('SKYTPU_API_SERVER_ENDPOINT', url)
+    yield url
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def test_requests_db_lifecycle(api_env):
+    rid = requests_db.create('status', {}, ScheduleType.SHORT)
+    assert requests_db.get(rid)['status'] == RequestStatus.PENDING
+    requests_db.set_running(rid)
+    requests_db.finish(rid, result=[1, 2])
+    record = requests_db.get(rid)
+    assert record['status'] == RequestStatus.SUCCEEDED
+    assert record['result'] == [1, 2]
+
+
+def test_health_and_unknown_op(live_server):
+    assert http.get(live_server + '/api/health',
+                    timeout=5).json()['status'] == 'healthy'
+    resp = http.post(live_server + '/api/v1/nope', json={}, timeout=5)
+    assert resp.status_code == 404
+
+
+def test_short_request_status(live_server):
+    resp = http.post(live_server + '/api/v1/status',
+                     json={'cluster_names': None, 'refresh': False},
+                     timeout=10)
+    rid = resp.json()['request_id']
+    payload = http.get(live_server + '/api/get',
+                       params={'request_id': rid}, timeout=30).json()
+    assert payload['status'] == 'SUCCEEDED'
+    assert payload['result'] == []
+
+
+def test_sdk_launch_e2e_and_cli(live_server, tmp_path):
+    """launch → worker process → local cluster → status → down."""
+    from skypilot_tpu.client import sdk
+
+    task_yaml = tmp_path / 'task.yaml'
+    task_yaml.write_text(
+        'name: apitask\n'
+        'run: echo api-ok\n'
+        'resources:\n  cloud: local\n')
+
+    from skypilot_tpu import task as task_lib
+    task = task_lib.Task.from_yaml_config(
+        {'name': 'apitask', 'run': 'echo api-ok',
+         'resources': {'cloud': 'local'}})
+    result = sdk.get(sdk.launch(task, cluster_name='apic'), timeout=180)
+    assert result['cluster_name'] == 'apic'
+    assert result['job_id'] == 1
+
+    rows = sdk.get(sdk.status())
+    assert [r['name'] for r in rows] == ['apic']
+
+    # CLI against the same server.
+    from click.testing import CliRunner
+
+    from skypilot_tpu.client import cli as cli_mod
+    runner = CliRunner()
+    out = runner.invoke(cli_mod.cli, ['status'])
+    assert out.exit_code == 0, out.output
+    assert 'apic' in out.output
+    out = runner.invoke(cli_mod.cli, ['queue', 'apic'])
+    assert out.exit_code == 0, out.output
+
+    sdk.get(sdk.down('apic'), timeout=120)
+    assert sdk.get(sdk.status()) == []
+
+
+def test_request_cancel(live_server):
+    rid = requests_db.create('launch', {}, ScheduleType.LONG)
+    assert requests_db.cancel(rid)
+    assert requests_db.get(rid)['status'] == RequestStatus.CANCELLED
+    # terminal requests can't be re-cancelled
+    assert not requests_db.cancel(rid)
+
+
+def test_cli_show_tpus():
+    from click.testing import CliRunner
+
+    from skypilot_tpu.client import cli as cli_mod
+    out = CliRunner().invoke(cli_mod.cli,
+                             ['show-tpus', '--name-filter', 'v5e'])
+    assert out.exit_code == 0, out.output
+    assert 'tpu-v5e-16' in out.output

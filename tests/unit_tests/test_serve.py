@@ -1,0 +1,143 @@
+"""Serve subsystem: spec parsing, autoscaler hysteresis, LB policies,
+and a hermetic end-to-end service on the local cloud."""
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.load_balancer import (LeastLoadPolicy,
+                                              RoundRobinPolicy)
+from skypilot_tpu.serve.service_spec import ServiceSpec
+
+
+# ------------------------------------------------------------- spec
+
+def test_service_spec_parsing():
+    spec = ServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 30},
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 4,
+                           'target_qps_per_replica': 2.0},
+        'replica_port': 9000,
+    })
+    assert spec.readiness_path == '/health'
+    assert spec.max_replicas == 4
+    round_trip = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert round_trip == spec
+
+
+def test_service_spec_fixed_replicas():
+    spec = ServiceSpec.from_yaml_config({'replicas': 2})
+    assert spec.min_replicas == 2 and spec.max_replicas == 2
+
+
+def test_service_spec_autoscale_requires_max():
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config(
+            {'replica_policy': {'target_qps_per_replica': 1.0}})
+
+
+# -------------------------------------------------------- autoscaler
+
+def test_autoscaler_hysteresis():
+    spec = ServiceSpec(min_replicas=1, max_replicas=10,
+                       target_qps_per_replica=1.0,
+                       upscale_delay_seconds=10,
+                       downscale_delay_seconds=100)
+    scaler = autoscalers.RequestRateAutoscaler(spec)
+    t0 = 1000.0
+    # 5 qps sustained -> raw target 5, but only after 10s persistence.
+    for i in range(300):
+        scaler.record_request(t0 + i * 0.2)
+    now = t0 + 60
+    assert scaler.evaluate(1, now).target_replicas == 1      # starts clock
+    assert scaler.evaluate(1, now + 5).target_replicas == 1  # too soon
+    assert scaler.evaluate(1, now + 11).target_replicas == 5  # fires
+
+    # Traffic stops: downscale only after the (longer) delay.
+    later = now + 200
+    assert scaler.evaluate(5, later).target_replicas == 5
+    assert scaler.evaluate(5, later + 50).target_replicas == 5
+    assert scaler.evaluate(5, later + 101).target_replicas == 1
+
+
+def test_autoscaler_respects_bounds():
+    spec = ServiceSpec(min_replicas=2, max_replicas=3,
+                       target_qps_per_replica=1.0,
+                       upscale_delay_seconds=0,
+                       downscale_delay_seconds=0)
+    scaler = autoscalers.RequestRateAutoscaler(spec)
+    t0 = 2000.0
+    for i in range(600):
+        scaler.record_request(t0 + i * 0.1)  # 10 qps -> raw 10
+    scaler.evaluate(2, t0 + 60)
+    assert scaler.evaluate(2, t0 + 61).target_replicas == 3  # capped
+    scaler2 = autoscalers.RequestRateAutoscaler(spec)
+    scaler2.evaluate(3, t0)
+    assert scaler2.evaluate(3, t0 + 1).target_replicas == 2  # floor
+
+
+# ------------------------------------------------------------ LB
+
+def test_round_robin_policy():
+    p = RoundRobinPolicy()
+    p.set_urls(['a', 'b'])
+    assert [p.pick() for _ in range(4)] == ['a', 'b', 'a', 'b']
+
+
+def test_least_load_policy():
+    p = LeastLoadPolicy()
+    p.set_urls(['a', 'b'])
+    u1 = p.pick()
+    u2 = p.pick()
+    assert {u1, u2} == {'a', 'b'}  # spreads in-flight load
+    p.done(u1)
+    assert p.pick() == u1          # the drained one wins
+
+
+# ------------------------------------------------------- end-to-end
+
+@pytest.mark.slow
+def test_serve_up_probe_and_proxy(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_LOG_DIR',
+                       str(isolated_state / 'serve_logs'))
+    task = task_lib.Task(
+        'svc',
+        run='python -c "'
+        'import http.server, os, functools; '
+        'http.server.HTTPServer((\'127.0.0.1\', '
+        'int(os.environ[\'SKYTPU_SERVE_PORT\'])), '
+        'http.server.SimpleHTTPRequestHandler).serve_forever()"')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    task.service = ServiceSpec(min_replicas=1, replica_port=18080,
+                               initial_delay_seconds=60,
+                               readiness_timeout_seconds=3)
+    result = serve_core.up(task, 'svc', controller_loop_gap=1.0)
+    endpoint = result['endpoint']
+    try:
+        deadline = time.time() + 90
+        ready = False
+        while time.time() < deadline:
+            st = serve_core.status('svc')
+            if st and any(
+                    r['status'] == serve_state.ReplicaStatus.READY
+                    for r in st[0]['replicas']):
+                ready = True
+                break
+            time.sleep(1)
+        assert ready, serve_core.status('svc')
+        resp = requests.get(endpoint + '/', timeout=10)
+        assert resp.status_code == 200
+    finally:
+        serve_core.down('svc')
+    assert serve_core.status('svc') == []
