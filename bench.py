@@ -53,14 +53,19 @@ def main():
     on_tpu = jax.default_backend() not in ('cpu',)
 
     seq = int(os.environ.get('BENCH_SEQ', '8192'))
-    batch = int(os.environ.get('BENCH_BATCH', '2'))
+    batch = int(os.environ.get('BENCH_BATCH', '4'))
     steps = int(os.environ.get('BENCH_STEPS', '10'))
     if not on_tpu:
         # CPU smoke fallback so the bench never hard-fails.
         seq, batch, steps = 256, 2, 2
         cfg = models.LlamaConfig.tiny(max_seq=seq)
     else:
-        cfg = models.LlamaConfig.tpu_1b(max_seq=seq)
+        # bf16 params match the reference recipe (--torch_dtype
+        # bfloat16, examples/tpu/v6e/train-llama3-8b.yaml).
+        dtype = {'float32': jnp.float32,
+                 'bfloat16': jnp.bfloat16}[os.environ.get(
+                     'BENCH_PARAM_DTYPE', 'bfloat16')]
+        cfg = models.LlamaConfig.tpu_1b(max_seq=seq, param_dtype=dtype)
 
     from skypilot_tpu.models.llama import num_params
     n_params = num_params(cfg)

@@ -1,0 +1,56 @@
+"""ICI/DCN all-reduce bandwidth bench — the `lax.psum` replacement for
+the reference's NCCL test (examples/nccl_test.yaml: all_reduce_perf).
+
+Runs on every host of a TPU pod slice via the gang env contract;
+reports per-size algorithmic bandwidth like nccl-tests. Bus bandwidth
+for a psum over n chips is algbw * 2*(n-1)/n.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.parallel import initialize_from_env
+
+initialize_from_env()
+
+n_dev = jax.device_count()
+mesh = jax.sharding.Mesh(jax.devices(), ('x',))
+
+
+@jax.jit
+def allreduce(x):
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    return shard_map(
+        lambda v: jax.lax.psum(v, 'x'),
+        mesh=mesh,
+        in_specs=jax.sharding.PartitionSpec('x'),
+        out_specs=jax.sharding.PartitionSpec())(x)
+
+
+if jax.process_index() == 0:
+    print(f'# allreduce bench: {n_dev} chips, '
+          f'{jax.process_count()} hosts')
+    print(f'# {"bytes":>14} {"time(ms)":>10} {"algbw(GB/s)":>12} '
+          f'{"busbw(GB/s)":>12}')
+
+for size_mb in (1, 4, 16, 64, 256, 1024):
+    n_elems = size_mb * 1024 * 1024 // 4 * n_dev
+    x = jnp.ones((n_elems,), jnp.float32)
+    out = allreduce(x)
+    jax.block_until_ready(out)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(x + out * 0)  # data-dependent: no elision
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    nbytes = size_mb * 1024 * 1024
+    algbw = nbytes / dt / 1e9
+    busbw = algbw * 2 * (n_dev - 1) / n_dev
+    if jax.process_index() == 0:
+        print(f'  {nbytes:>14} {dt*1e3:>10.3f} {algbw:>12.2f} '
+              f'{busbw:>12.2f}')

@@ -1,0 +1,71 @@
+"""Minimal TPU text-generation HTTP server — the serving recipe shape
+of the reference's examples/tpu/v6e/serve-llama2-7b.yaml (JetStream),
+self-contained: greedy decode over a randomly-initialized Llama so it
+runs with zero egress. Swap init_params for a real checkpoint loader
+to serve a trained model.
+
+Serves on $SKYTPU_SERVE_PORT (set per replica by the serve subsystem).
+GET  /health            -> readiness probe
+POST /generate {"tokens": [...], "max_new": 16} -> {"tokens": [...]}
+"""
+import json
+import os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu import models
+
+CFG = models.LlamaConfig.tiny(max_seq=256)
+PARAMS = models.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@jax.jit
+def next_token(tokens):
+    logits = models.forward(PARAMS, tokens, CFG)
+    return jnp.argmax(logits[:, -1], axis=-1)
+
+
+def generate(tokens, max_new):
+    toks = jnp.asarray([tokens], jnp.int32)
+    for _ in range(max_new):
+        nxt = next_token(toks[:, -CFG.max_seq:])
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return toks[0].tolist()
+
+
+class Handler(BaseHTTPRequestHandler):
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == '/health':
+            self._reply(200, {'status': 'ok'})
+        else:
+            self._reply(404, {'error': 'use POST /generate'})
+
+    def do_POST(self):
+        if self.path != '/generate':
+            self._reply(404, {'error': 'use POST /generate'})
+            return
+        length = int(self.headers.get('Content-Length', 0))
+        req = json.loads(self.rfile.read(length) or '{}')
+        tokens = req.get('tokens', [1])
+        max_new = min(int(req.get('max_new', 16)), 128)
+        self._reply(200, {'tokens': generate(tokens, max_new)})
+
+    def log_message(self, *args):
+        pass
+
+
+if __name__ == '__main__':
+    port = int(os.environ.get('SKYTPU_SERVE_PORT', '8080'))
+    print(f'serving on :{port} ({jax.default_backend()})')
+    HTTPServer(('0.0.0.0', port), Handler).serve_forever()

@@ -18,6 +18,7 @@ Layout convention at the public API: [batch, seq, heads, head_dim]
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -418,8 +419,8 @@ def flash_attention(q: jax.Array,
                     *,
                     causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 128,
-                    block_k: int = 128) -> jax.Array:
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None) -> jax.Array:
     """Flash attention, [batch, seq, heads, head_dim] layout, GQA-aware.
 
     Dispatches to the Pallas TPU kernels on TPU backends and to exact
@@ -429,4 +430,8 @@ def flash_attention(q: jax.Array,
     q, k, v = _repeat_kv(q, k, v)
     if scale is None:
         scale = q.shape[-1]**-0.5
+    if block_q is None:
+        block_q = int(os.environ.get('SKYTPU_FLASH_BLOCK_Q', '1024'))
+    if block_k is None:
+        block_k = int(os.environ.get('SKYTPU_FLASH_BLOCK_K', '1024'))
     return _flash(q, k, v, causal, scale, block_q, block_k)
