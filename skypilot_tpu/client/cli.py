@@ -244,7 +244,12 @@ def serve() -> None:
 def serve_up(entrypoint: str, service_name: Optional[str]) -> None:
     task = _load_task(entrypoint)
     result = sdk.get(sdk.serve_up(task, service_name))
-    click.echo(f'Service {result["name"]} at {result["endpoint"]}.')
+    endpoint = result.get('endpoint')
+    if endpoint:
+        click.echo(f'Service {result["name"]} at {endpoint}.')
+    else:
+        click.echo(f'Service {result["name"]} starting; endpoint not '
+                   'yet bound (check `serve status`).')
 
 
 @serve.command('down')
@@ -260,7 +265,7 @@ def serve_down(service_name: str, purge: bool) -> None:
 def serve_status(service_name: Optional[str]) -> None:
     for svc in sdk.get(sdk.serve_status(service_name)):
         click.echo(f'{svc["name"]}: {svc["status"]} at '
-                   f'{svc["endpoint"]}')
+                   f'{svc["endpoint"] or "(endpoint not yet bound)"}')
         _echo_table(svc['replicas'], ['replica_id', 'status', 'url'])
 
 

@@ -33,7 +33,8 @@ CONTROL_LOOP_GAP_SECONDS = 10.0
 class ServeController:
 
     def __init__(self, service_name: str,
-                 loop_gap: float = CONTROL_LOOP_GAP_SECONDS) -> None:
+                 loop_gap: float = CONTROL_LOOP_GAP_SECONDS,
+                 lb_port: int = 0) -> None:
         record = serve_state.get_service(service_name)
         assert record is not None, service_name
         self.name = service_name
@@ -42,7 +43,7 @@ class ServeController:
         self.replica_manager = ReplicaManager(service_name, self.spec,
                                               record['task'])
         self.load_balancer = LoadBalancer(
-            record['lb_port'],
+            lb_port,
             policy=self.spec.load_balancing_policy,
             on_request=self.autoscaler.record_request)
         self.loop_gap = loop_gap
@@ -83,6 +84,10 @@ class ServeController:
 
     async def run(self) -> None:
         await self.load_balancer.start()
+        # Publish the actually-bound port (the row holds the preferred
+        # port, possibly 0 = auto; `up` polls for the real one).
+        serve_state.set_service_lb_port(self.name,
+                                        self.load_balancer.bound_port)
         try:
             await self._control_loop()
         finally:
@@ -94,11 +99,15 @@ def main() -> None:
     parser.add_argument('service_name')
     parser.add_argument('--loop-gap', type=float,
                         default=CONTROL_LOOP_GAP_SECONDS)
+    parser.add_argument('--lb-port', type=int, default=0,
+                        help='Preferred LB port; 0 = OS-assigned. The '
+                        'bound port is written back to serve_state.')
     args = parser.parse_args()
     serve_state.set_service_controller_pid(args.service_name,
                                            os.getpid())
     controller = ServeController(args.service_name,
-                                 loop_gap=args.loop_gap)
+                                 loop_gap=args.loop_gap,
+                                 lb_port=args.lb_port)
     try:
         asyncio.run(controller.run())
     except Exception as e:  # pylint: disable=broad-except

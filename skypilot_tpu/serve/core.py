@@ -26,13 +26,15 @@ from skypilot_tpu.utils import log as sky_logging
 logger = sky_logging.init_logger(__name__)
 
 
-def _free_port(preferred: int = 0) -> int:
-    with socket.socket() as s:
-        try:
-            s.bind(('', preferred))
-        except OSError:
-            s.bind(('', 0))
-        return s.getsockname()[1]
+_CONTROLLER_START_TIMEOUT = 40.0
+
+
+def _lb_reachable(port: int) -> bool:
+    try:
+        with socket.create_connection(('127.0.0.1', port), timeout=1):
+            return True
+    except OSError:
+        return False
 
 
 def _log_dir() -> str:
@@ -54,12 +56,16 @@ def up(task: task_lib.Task,
     if serve_state.get_service(name) is not None:
         raise exceptions.SkyTpuError(
             f'Service {name!r} already exists. `down` it first.')
-    port = _free_port(lb_port or 0)
+    # The controller process binds the LB port itself (preferred port
+    # via --lb-port, or OS-assigned) and writes the BOUND port back to
+    # serve_state — the row stays 0 until then, so the poll below can
+    # only ever see a controller-written port (no bind-probe-release
+    # TOCTOU, and no mistaking a foreign listener for our LB).
     serve_state.add_service(
         name,
         spec_json=json.dumps(spec.to_yaml_config()),
         task_json=json.dumps(task.to_yaml_config()),
-        lb_port=port)
+        lb_port=0)
 
     log_dir = _log_dir()
     os.makedirs(log_dir, exist_ok=True)
@@ -67,6 +73,8 @@ def up(task: task_lib.Task,
     cmd = [
         sys.executable, '-u', '-m', 'skypilot_tpu.serve.controller', name
     ]
+    if lb_port:
+        cmd += ['--lb-port', str(lb_port)]
     if controller_loop_gap is not None:
         cmd += ['--loop-gap', str(controller_loop_gap)]
     env = dict(os.environ)
@@ -81,7 +89,34 @@ def up(task: task_lib.Task,
                                 stderr=subprocess.STDOUT,
                                 start_new_session=True, env=env)
     serve_state.set_service_controller_pid(name, proc.pid)
-    endpoint = f'http://127.0.0.1:{port}'
+    # Wait for the controller's LB to actually listen; surface startup
+    # crashes here instead of handing back a dead endpoint.
+    deadline = time.time() + _CONTROLLER_START_TIMEOUT
+    port = 0
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            tail = ''
+            try:
+                with open(log_path, 'r', encoding='utf-8',
+                          errors='replace') as f:
+                    tail = ''.join(f.readlines()[-20:])
+            except OSError:
+                pass
+            serve_state.remove_service(name)
+            raise exceptions.SkyTpuError(
+                f'Serve controller for {name!r} exited at startup '
+                f'(code {proc.returncode}). Log tail:\n{tail}')
+        record = serve_state.get_service(name)
+        port = (record or {}).get('lb_port') or 0
+        if port and _lb_reachable(port):
+            break
+        time.sleep(0.2)
+    else:
+        logger.warning(
+            'Load balancer for %s not reachable after %.0fs; '
+            'returning anyway (check `serve status`).', name,
+            _CONTROLLER_START_TIMEOUT)
+    endpoint = f'http://127.0.0.1:{port}' if port else None
     logger.info('Service %s starting; endpoint %s (controller pid %d).',
                 name, endpoint, proc.pid)
     return {'name': name, 'endpoint': endpoint}
@@ -121,7 +156,8 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
         out.append({
             'name': record['name'],
             'status': record['status'],
-            'endpoint': f'http://127.0.0.1:{record["lb_port"]}',
+            'endpoint': (f'http://127.0.0.1:{record["lb_port"]}'
+                         if record['lb_port'] else None),
             'replicas': [{
                 'replica_id': r['replica_id'],
                 'status': r['status'],

@@ -96,7 +96,10 @@ class LoadBalancer:
 
     def __init__(self, port: int, policy: str = 'least_load',
                  on_request: Optional[Callable[[], None]] = None) -> None:
+        # port 0 = let the OS pick; the actual port is in `bound_port`
+        # after start() (avoids probe-then-rebind TOCTOU races).
         self.port = port
+        self.bound_port: Optional[int] = None
         self.policy: LoadBalancingPolicy = POLICIES[policy]()
         self.on_request = on_request
         self._runner: Optional[web.AppRunner] = None
@@ -150,7 +153,9 @@ class LoadBalancer:
         await self._runner.setup()
         site = web.TCPSite(self._runner, '0.0.0.0', self.port)
         await site.start()
-        logger.info('Load balancer listening on :%d', self.port)
+        sockets = site._server.sockets  # pylint: disable=protected-access
+        self.bound_port = sockets[0].getsockname()[1]
+        logger.info('Load balancer listening on :%d', self.bound_port)
 
     async def stop(self) -> None:
         if self._runner is not None:

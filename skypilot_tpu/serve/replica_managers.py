@@ -35,6 +35,19 @@ SERVE_PORT_ENV = 'SKYTPU_SERVE_PORT'
 # replacing (the task is broken, not the infra).
 _MAX_FAILED_REPLICAS = 3
 
+# A READY replica whose app dies (cluster still UP) is demoted to
+# NOT_READY after this many consecutive failed probes...
+_NOT_READY_THRESHOLD = 3
+# ...and torn down + replaced once the streak reaches this (reference
+# replica_managers.py _CONSECUTIVE_FAILURE_THRESHOLD_TIMEOUT).
+_PROBE_FAILURE_TERMINATE_THRESHOLD = 10
+
+# FAILED_* rows only count against the replacement cap while fresh; a
+# crash-loop trips the cap within the window, but isolated failures
+# spread over a long-lived service must not brick it. Old failed rows
+# are garbage-collected.
+_FAILED_ROW_TTL_SECONDS = 1800.0
+
 
 class ReplicaManager:
 
@@ -46,6 +59,10 @@ class ReplicaManager:
         self._launch_threads: Dict[int, threading.Thread] = {}
         self._lock = threading.Lock()
         self._failed_probes: Dict[int, int] = {}
+        # Replica ids with a termination thread in flight (guards the
+        # reconcile sweep from double-terminating what probe_all
+        # already handed to a background thread).
+        self._terminating: set = set()
 
     # ------------------------------------------------------------------
     def _cluster_name(self, replica_id: int) -> str:
@@ -90,8 +107,9 @@ class ReplicaManager:
         except Exception:  # pylint: disable=broad-except
             logger.error('Replica %d launch failed:\n%s', replica_id,
                          traceback.format_exc())
-            serve_state.set_replica_status(self.service_name, replica_id,
-                                           ReplicaStatus.FAILED)
+            serve_state.set_replica_status(
+                self.service_name, replica_id,
+                ReplicaStatus.FAILED_PROVISION)
             return
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.STARTING)
@@ -105,7 +123,10 @@ class ReplicaManager:
                                       args=(replica_id,), daemon=True)
             thread.start()
 
-    def _terminate_replica(self, replica_id: int) -> None:
+    def _terminate_replica(
+            self, replica_id: int,
+            final_status: Optional[ReplicaStatus] = ReplicaStatus.SHUTDOWN,
+            remove: bool = False) -> None:
         from skypilot_tpu import core
         try:
             core.down(self._cluster_name(replica_id))
@@ -114,8 +135,33 @@ class ReplicaManager:
         except Exception:  # pylint: disable=broad-except
             logger.warning('Replica %d teardown error:\n%s', replica_id,
                            traceback.format_exc())
-        serve_state.set_replica_status(self.service_name, replica_id,
-                                       ReplicaStatus.SHUTDOWN)
+        if remove:
+            serve_state.remove_replica(self.service_name, replica_id)
+        elif final_status is not None:
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           final_status)
+
+    def _terminate_in_background(
+            self, replica_id: int,
+            final_status: Optional[ReplicaStatus] = ReplicaStatus.SHUTDOWN,
+            remove: bool = False) -> None:
+        """Cluster teardown takes seconds-to-minutes; never block the
+        probe loop on it (advisor finding: the synchronous PREEMPTED
+        path stalled probing for the whole teardown)."""
+        with self._lock:
+            if replica_id in self._terminating:
+                return
+            self._terminating.add(replica_id)
+        self._failed_probes.pop(replica_id, None)
+
+        def work() -> None:
+            try:
+                self._terminate_replica(replica_id, final_status, remove)
+            finally:
+                with self._lock:
+                    self._terminating.discard(replica_id)
+
+        threading.Thread(target=work, daemon=True).start()
 
     def terminate_all(self) -> None:
         replicas = serve_state.get_replicas(self.service_name)
@@ -158,10 +204,9 @@ class ReplicaManager:
         for replica in serve_state.get_replicas(self.service_name):
             rid = replica['replica_id']
             status = replica['status']
-            if status in (ReplicaStatus.PENDING,
-                          ReplicaStatus.PROVISIONING,
-                          ReplicaStatus.SHUTTING_DOWN,
-                          ReplicaStatus.SHUTDOWN, ReplicaStatus.FAILED):
+            if status not in (ReplicaStatus.STARTING,
+                              ReplicaStatus.READY,
+                              ReplicaStatus.NOT_READY):
                 continue
             cluster = replica['cluster_name']
             try:
@@ -171,12 +216,15 @@ class ReplicaManager:
                 record = None
             if (record is None or
                     record['status'] != status_lib.ClusterStatus.UP):
-                # Cluster died under us: preemption.
+                # Cluster died under us: preemption. Mark it (so
+                # reconcile immediately launches a replacement) and
+                # clean leftovers in the background; the cleanup
+                # removes the row once the cluster is gone.
                 logger.info('Replica %d cluster %s gone: PREEMPTED.',
                             rid, cluster)
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.PREEMPTED)
-                self._terminate_replica(rid)  # cleanup leftovers
+                self._terminate_in_background(rid, remove=True)
                 continue
             url = self._replica_url(rid, cluster)
             ready = url is not None and self._probe_ready(url)
@@ -185,23 +233,46 @@ class ReplicaManager:
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.READY,
                                                url=url)
-            elif status == ReplicaStatus.READY:
+            elif status in (ReplicaStatus.READY,
+                            ReplicaStatus.NOT_READY):
                 self._failed_probes[rid] = (
                     self._failed_probes.get(rid, 0) + 1)
-                # Transient blips tolerated; sustained failure demotes.
-                if self._failed_probes[rid] >= 3:
+                streak = self._failed_probes[rid]
+                if streak >= _PROBE_FAILURE_TERMINATE_THRESHOLD:
+                    # App is dead though the cluster is UP: tear the
+                    # replica down so reconcile replaces it, instead
+                    # of letting a broken replica hold a slot forever.
+                    logger.warning(
+                        'Replica %d failed %d consecutive probes: '
+                        'terminating for replacement.', rid, streak)
+                    serve_state.set_replica_status(
+                        self.service_name, rid,
+                        ReplicaStatus.FAILED_PROBING)
+                    # Keep the row (counts toward the failure cap so a
+                    # crash-looping app can't relaunch forever).
+                    self._terminate_in_background(
+                        rid, ReplicaStatus.FAILED_PROBING)
+                elif streak >= _NOT_READY_THRESHOLD:
+                    # Transient blips tolerated; sustained demotes (LB
+                    # stops routing to it).
                     serve_state.set_replica_status(
                         self.service_name, rid, ReplicaStatus.NOT_READY)
             elif status == ReplicaStatus.STARTING:
-                launched_at = replica.get('launched_at') or 0
-                if (time.time() - launched_at >
+                # Budget counted from the STARTING transition
+                # (post-provision), not submission: provisioning time
+                # must not eat the app's warm-up allowance.
+                starting_at = (replica.get('starting_at') or
+                               replica.get('launched_at') or 0)
+                if (time.time() - starting_at >
                         self.spec.initial_delay_seconds):
                     logger.warning(
                         'Replica %d never became ready within '
                         'initial_delay_seconds: FAILED.', rid)
                     serve_state.set_replica_status(
-                        self.service_name, rid, ReplicaStatus.FAILED)
-                    self._terminate_replica(rid)
+                        self.service_name, rid,
+                        ReplicaStatus.FAILED_INITIAL_DELAY)
+                    self._terminate_in_background(
+                        rid, ReplicaStatus.FAILED_INITIAL_DELAY)
 
     # ------------------------------------------------------------------
     def reconcile(self, target: int) -> None:
@@ -216,15 +287,27 @@ class ReplicaManager:
                                ReplicaStatus.READY,
                                ReplicaStatus.NOT_READY)
         ]
-        preempted = [
-            r for r in replicas
-            if r['status'] == ReplicaStatus.PREEMPTED
-        ]
-        for r in preempted:
-            serve_state.remove_replica(self.service_name,
-                                       r['replica_id'])
+        # Fully-shutdown rows are done — garbage-collect them (replica
+        # ids are a monotonic counter, so removal cannot cause a
+        # cluster-name collision). PREEMPTED rows normally have a
+        # cleanup thread in flight from probe_all; re-arm it here in
+        # case a controller restart orphaned the row (the _terminating
+        # guard makes this a no-op when one is already running).
+        now = time.time()
+        for r in replicas:
+            if r['status'] is ReplicaStatus.SHUTDOWN:
+                serve_state.remove_replica(self.service_name,
+                                           r['replica_id'])
+            elif r['status'] is ReplicaStatus.PREEMPTED:
+                self._terminate_in_background(r['replica_id'],
+                                              remove=True)
+            elif (r['status'].is_failed() and
+                  now - (r['launched_at'] or 0) > _FAILED_ROW_TTL_SECONDS):
+                serve_state.remove_replica(self.service_name,
+                                           r['replica_id'])
         failed = sum(
-            1 for r in replicas if r['status'] == ReplicaStatus.FAILED)
+            1 for r in replicas if r['status'].is_failed() and
+            now - (r['launched_at'] or 0) <= _FAILED_ROW_TTL_SECONDS)
         if len(live) < target:
             # Replace missing replicas, but a string of FAILED
             # launches means the task itself is broken — stop burning

@@ -21,11 +21,18 @@ def _db_path() -> str:
     return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
 
 
+# DB paths already created+migrated by this process (avoids re-running
+# DDL on every connection).
+_initialized_paths: set = set()
+
+
 def _conn() -> sqlite3.Connection:
     path = _db_path()
     pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
     conn = sqlite3.connect(path, timeout=10)
     conn.row_factory = sqlite3.Row
+    if path in _initialized_paths:
+        return conn
     conn.execute('PRAGMA journal_mode=WAL')
     conn.execute("""
         CREATE TABLE IF NOT EXISTS services (
@@ -35,7 +42,8 @@ def _conn() -> sqlite3.Connection:
             task_json TEXT,
             controller_pid INTEGER,
             lb_port INTEGER,
-            created_at REAL
+            created_at REAL,
+            next_replica_id INTEGER DEFAULT 0
         )""")
     conn.execute("""
         CREATE TABLE IF NOT EXISTS replicas (
@@ -45,8 +53,32 @@ def _conn() -> sqlite3.Connection:
             status TEXT,
             url TEXT,
             launched_at REAL,
+            starting_at REAL,
+            version INTEGER DEFAULT 1,
+            is_spot INTEGER DEFAULT 0,
             PRIMARY KEY (service_name, replica_id)
         )""")
+    # Migrate DBs created before these columns existed (CREATE TABLE IF
+    # NOT EXISTS is a no-op on an old schema).
+    for table, column, decl in (
+        ('services', 'next_replica_id', 'INTEGER DEFAULT 0'),
+        ('replicas', 'starting_at', 'REAL'),
+        ('replicas', 'version', 'INTEGER DEFAULT 1'),
+        ('replicas', 'is_spot', 'INTEGER DEFAULT 0'),
+    ):
+        try:
+            conn.execute(
+                f'ALTER TABLE {table} ADD COLUMN {column} {decl}')
+            if column == 'next_replica_id':
+                # Seed the counter past any pre-migration replica ids.
+                conn.execute("""
+                    UPDATE services SET next_replica_id = COALESCE(
+                        (SELECT MAX(replica_id) FROM replicas
+                         WHERE replicas.service_name = services.name), 0)
+                """)
+        except sqlite3.OperationalError:
+            pass  # already present
+    _initialized_paths.add(path)
     return conn
 
 
@@ -74,6 +106,15 @@ def set_service_controller_pid(name: str, pid: int) -> None:
         conn.execute(
             'UPDATE services SET controller_pid = ? WHERE name = ?',
             (pid, name))
+
+
+def set_service_lb_port(name: str, port: int) -> None:
+    """The controller binds the LB port itself (port 0 = pick free) and
+    records the bound port here; `up` polls for it (no bind-ahead
+    TOCTOU)."""
+    with _conn() as conn:
+        conn.execute('UPDATE services SET lb_port = ? WHERE name = ?',
+                     (port, name))
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
@@ -108,30 +149,39 @@ def remove_service(name: str) -> None:
 # ------------------------------------------------------------- replicas
 
 
-def add_replica(service_name: str, replica_id: int,
-                cluster_name: str) -> None:
+def add_replica(service_name: str, replica_id: int, cluster_name: str,
+                version: int = 1, is_spot: bool = False) -> None:
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
-            'cluster_name, status, launched_at) VALUES (?,?,?,?,?)',
+            'cluster_name, status, launched_at, version, is_spot) '
+            'VALUES (?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
-             ReplicaStatus.PENDING.value, time.time()))
+             ReplicaStatus.PENDING.value, time.time(), version,
+             int(is_spot)))
 
 
 def set_replica_status(service_name: str, replica_id: int,
                        status: ReplicaStatus,
                        url: Optional[str] = None) -> None:
+    # The readiness budget (initial_delay_seconds) is measured from the
+    # STARTING transition — i.e. after provisioning — not from
+    # submission (reference replica_managers.py:1105 counts from the
+    # first probe after provision; cluster spin-up must not consume the
+    # app's warm-up budget).
+    sets = ['status = ?']
+    args: list = [status.value]
+    if status is ReplicaStatus.STARTING:
+        sets.append('starting_at = ?')
+        args.append(time.time())
+    if url is not None:
+        sets.append('url = ?')
+        args.append(url)
+    args += [service_name, replica_id]
     with _conn() as conn:
-        if url is not None:
-            conn.execute(
-                'UPDATE replicas SET status = ?, url = ? '
-                'WHERE service_name = ? AND replica_id = ?',
-                (status.value, url, service_name, replica_id))
-        else:
-            conn.execute(
-                'UPDATE replicas SET status = ? '
-                'WHERE service_name = ? AND replica_id = ?',
-                (status.value, service_name, replica_id))
+        conn.execute(
+            f'UPDATE replicas SET {", ".join(sets)} '
+            'WHERE service_name = ? AND replica_id = ?', args)
 
 
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:
@@ -148,11 +198,17 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
 
 
 def next_replica_id(service_name: str) -> int:
+    # Monotonic counter in the service row (NOT max(replica_id):
+    # terminated rows are garbage-collected, and a reused id would
+    # collide with a cluster still being torn down asynchronously).
     with _conn() as conn:
+        conn.execute(
+            'UPDATE services SET next_replica_id = next_replica_id + 1 '
+            'WHERE name = ?', (service_name,))
         row = conn.execute(
-            'SELECT MAX(replica_id) AS m FROM replicas '
-            'WHERE service_name = ?', (service_name,)).fetchone()
-    return (row['m'] or 0) + 1
+            'SELECT next_replica_id FROM services WHERE name = ?',
+            (service_name,)).fetchone()
+    return int(row['next_replica_id']) if row else 1
 
 
 def remove_replica(service_name: str, replica_id: int) -> None:
