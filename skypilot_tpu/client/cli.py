@@ -252,6 +252,16 @@ def serve_up(entrypoint: str, service_name: Optional[str]) -> None:
                    'yet bound (check `serve status`).')
 
 
+@serve.command('update')
+@click.argument('service_name')
+@click.argument('entrypoint')
+def serve_update(service_name: str, entrypoint: str) -> None:
+    task = _load_task(entrypoint)
+    result = sdk.get(sdk.serve_update(task, service_name))
+    click.echo(f'Service {result["name"]} rolling to version '
+               f'{result["version"]}.')
+
+
 @serve.command('down')
 @click.argument('service_name')
 @click.option('--purge', is_flag=True, default=False)

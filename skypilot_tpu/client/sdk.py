@@ -200,6 +200,11 @@ def serve_up(task, service_name: Optional[str] = None) -> str:
                                          service_name=service_name))
 
 
+def serve_update(task, service_name: str) -> str:
+    return submit('serve.update', _task_body(task,
+                                             service_name=service_name))
+
+
 def serve_down(service_name: str, purge: bool = False) -> str:
     return submit('serve.down', {'service_name': service_name,
                                  'purge': purge})

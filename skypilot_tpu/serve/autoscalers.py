@@ -23,6 +23,10 @@ _QPS_WINDOW_SECONDS = 60.0
 @dataclasses.dataclass
 class ScalingDecision:
     target_replicas: int
+    # Spot/on-demand split of the target. None = no split: every
+    # replica uses the task's own resources as written.
+    num_spot: Optional[int] = None
+    num_ondemand: Optional[int] = None
 
 
 class FixedReplicaAutoscaler:
@@ -34,9 +38,44 @@ class FixedReplicaAutoscaler:
     def record_request(self, now: Optional[float] = None) -> None:
         pass
 
+    def initial(self) -> ScalingDecision:
+        return initial_decision(self.spec)
+
     def evaluate(self, current_replicas: int,
-                 now: Optional[float] = None) -> ScalingDecision:
-        return ScalingDecision(self.spec.min_replicas)
+                 now: Optional[float] = None,
+                 num_ready_spot: int = 0) -> ScalingDecision:
+        return _with_spot_split(self.spec,
+                                ScalingDecision(self.spec.min_replicas),
+                                num_ready_spot)
+
+
+def initial_decision(spec: ServiceSpec) -> ScalingDecision:
+    """First scale-out at service start: min_replicas, spot split
+    applied, no hysteresis."""
+    return _with_spot_split(spec, ScalingDecision(spec.min_replicas),
+                            num_ready_spot=0)
+
+
+def _with_spot_split(spec: ServiceSpec, decision: ScalingDecision,
+                     num_ready_spot: int) -> ScalingDecision:
+    """Split a target into (spot, on-demand) per the spec's spot policy.
+
+    Mirrors reference ``FallbackRequestRateAutoscaler``
+    (sky/serve/autoscalers.py:546): the QPS-derived target is served by
+    spot replicas; `base_ondemand_fallback_replicas` on-demand replicas
+    are always on; with `dynamic_ondemand_fallback`, extra on-demand
+    replicas cover whatever part of the spot target is not READY yet
+    (spot stockout / preemption storm), draining again as spot
+    recovers.
+    """
+    if not spec.use_spot:
+        return decision
+    target = decision.target_replicas
+    ondemand = spec.base_ondemand_fallback_replicas
+    if spec.dynamic_ondemand_fallback:
+        ondemand += max(0, target - num_ready_spot)
+    return ScalingDecision(target_replicas=target + ondemand,
+                           num_spot=target, num_ondemand=ondemand)
 
 
 class RequestRateAutoscaler:
@@ -45,9 +84,18 @@ class RequestRateAutoscaler:
         assert spec.target_qps_per_replica is not None
         self.spec = spec
         self._timestamps: Deque[float] = deque()
+        # The autoscaler owns its target (reference autoscalers.py
+        # target_num_replicas): the target is what capacity SHOULD be,
+        # so a preemption that shrinks the live pool does not lower
+        # the target — reconcile relaunches the lost replicas
+        # immediately instead of waiting out upscale_delay.
+        self._target = spec.min_replicas
         # When the raw desire first diverged in the current direction.
         self._desire_since: Optional[float] = None
         self._desired: Optional[int] = None
+
+    def initial(self) -> ScalingDecision:
+        return initial_decision(self.spec)
 
     # ------------------------------------------------------------------
     def record_request(self, now: Optional[float] = None) -> None:
@@ -67,30 +115,50 @@ class RequestRateAutoscaler:
         hi = self.spec.max_replicas
         return max(lo, min(hi, target) if hi is not None else target)
 
-    def evaluate(self, current_replicas: int,
-                 now: Optional[float] = None) -> ScalingDecision:
-        """Hysteresis: act only after the desire persists its delay."""
+    def evaluate(self, current_replicas: Optional[int] = None,
+                 now: Optional[float] = None,
+                 num_ready_spot: int = 0) -> ScalingDecision:
+        """Hysteresis: move the owned target only after the QPS-derived
+        desire persists its up/downscale delay. `current_replicas` is
+        accepted for signature compatibility but deliberately unused —
+        targets track demand, not the (possibly preemption-shrunken)
+        live pool.
+        """
         now = now if now is not None else time.time()
         raw = self._raw_target(now)
-        if raw == current_replicas:
+        if raw == self._target:
             self._desire_since = None
             self._desired = None
-            return ScalingDecision(current_replicas)
-        if raw != self._desired:
-            self._desired = raw
-            self._desire_since = now
-            return ScalingDecision(current_replicas)
-        delay = (self.spec.upscale_delay_seconds
-                 if raw > current_replicas else
-                 self.spec.downscale_delay_seconds)
-        if now - self._desire_since >= delay:
-            self._desire_since = None
-            self._desired = None
-            return ScalingDecision(raw)
-        return ScalingDecision(current_replicas)
+        else:
+            if raw != self._desired:
+                self._desired = raw
+                self._desire_since = now
+            delay = (self.spec.upscale_delay_seconds
+                     if raw > self._target else
+                     self.spec.downscale_delay_seconds)
+            if now - self._desire_since >= delay:
+                self._desire_since = None
+                self._desired = None
+                self._target = raw
+        return ScalingDecision(self._target)
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """QPS autoscaling on spot capacity with an on-demand safety net
+    (reference sky/serve/autoscalers.py:546): the base target is
+    served by spot replicas; on-demand covers the configured base plus
+    (dynamically) whatever spot capacity is not READY."""
+
+    def evaluate(self, current_replicas: Optional[int] = None,
+                 now: Optional[float] = None,
+                 num_ready_spot: int = 0) -> ScalingDecision:
+        decision = super().evaluate(current_replicas, now)
+        return _with_spot_split(self.spec, decision, num_ready_spot)
 
 
 def make_autoscaler(spec: ServiceSpec):
     if spec.target_qps_per_replica is None:
         return FixedReplicaAutoscaler(spec)
+    if spec.use_spot:
+        return FallbackRequestRateAutoscaler(spec)
     return RequestRateAutoscaler(spec)

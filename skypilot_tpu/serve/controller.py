@@ -38,6 +38,7 @@ class ServeController:
         record = serve_state.get_service(service_name)
         assert record is not None, service_name
         self.name = service_name
+        self._version = serve_state.get_current_version(service_name)
         self.spec = ServiceSpec.from_yaml_config(record['spec'])
         self.autoscaler = autoscalers.make_autoscaler(self.spec)
         self.replica_manager = ReplicaManager(service_name, self.spec,
@@ -49,14 +50,33 @@ class ServeController:
         self.loop_gap = loop_gap
         self._shutdown = asyncio.Event()
 
+    def _refresh_version(self) -> None:
+        """Pick up a rolling update: when current_version moves, reload
+        the spec and rebuild the autoscaler so scaling decisions follow
+        the NEW version's policy while old replicas drain."""
+        version = serve_state.get_current_version(self.name)
+        if version == self._version:
+            return
+        record = serve_state.get_version_spec(self.name, version)
+        if record is None:
+            return
+        logger.info('Service %s: rolling to version %d.', self.name,
+                    version)
+        self._version = version
+        self.spec = ServiceSpec.from_yaml_config(record['spec'])
+        self.replica_manager.spec = self.spec
+        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        self.load_balancer.on_request = self.autoscaler.record_request
+
     # ------------------------------------------------------------------
     async def _control_loop(self) -> None:
-        target = self.spec.min_replicas
-        self.replica_manager.reconcile(target)
+        # Initial scale-out honors the spot split from the start.
+        self.replica_manager.reconcile(self.autoscaler.initial())
         serve_state.set_service_status(self.name,
                                        ServiceStatus.REPLICA_INIT)
         while not self._shutdown.is_set():
             try:
+                self._refresh_version()
                 await asyncio.to_thread(self.replica_manager.probe_all)
                 replicas = serve_state.get_replicas(self.name)
                 live = [
@@ -65,9 +85,23 @@ class ServeController:
                      ReplicaStatus.STARTING, ReplicaStatus.READY,
                      ReplicaStatus.NOT_READY)
                 ]
-                decision = self.autoscaler.evaluate(len(live))
+                latest = [r for r in live
+                          if (r.get('version') or 1) == self._version]
+                # The autoscaler scales the QPS-serving pool: for a
+                # spot service that is the latest-version spot
+                # replicas (the on-demand fallback is derived from
+                # the same decision), otherwise all latest replicas.
+                if self.spec.use_spot:
+                    pool = [r for r in latest if r.get('is_spot')]
+                else:
+                    pool = latest
+                num_ready_spot = sum(
+                    1 for r in latest if r.get('is_spot') and
+                    r['status'] is ReplicaStatus.READY)
+                decision = self.autoscaler.evaluate(
+                    len(pool), num_ready_spot=num_ready_spot)
                 await asyncio.to_thread(self.replica_manager.reconcile,
-                                        decision.target_replicas)
+                                        decision)
                 urls = self.replica_manager.ready_urls()
                 self.load_balancer.set_replica_urls(urls)
                 serve_state.set_service_status(

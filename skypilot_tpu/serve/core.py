@@ -102,6 +102,10 @@ def up(task: task_lib.Task,
                     tail = ''.join(f.readlines()[-20:])
             except OSError:
                 pass
+            # The controller may have launched replicas before dying —
+            # tear them down, or they leak untracked clusters.
+            ReplicaManager(name, spec,
+                           task.to_yaml_config()).terminate_all()
             serve_state.remove_service(name)
             raise exceptions.SkyTpuError(
                 f'Serve controller for {name!r} exited at startup '
@@ -120,6 +124,31 @@ def up(task: task_lib.Task,
     logger.info('Service %s starting; endpoint %s (controller pid %d).',
                 name, endpoint, proc.pid)
     return {'name': name, 'endpoint': endpoint}
+
+
+def update(task: task_lib.Task, service_name: str) -> Dict[str, Any]:
+    """Rolling update: register a new service version.
+
+    The running controller notices the version bump on its next loop,
+    launches new-version replicas, and drains old ones only after the
+    new version's full target is READY (see ReplicaManager.reconcile).
+    Returns {'name', 'version'}.
+    """
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} not found; use `up` first.')
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task has no service: section.')
+    spec: ServiceSpec = task.service
+    version = serve_state.add_version(
+        service_name,
+        spec_json=json.dumps(spec.to_yaml_config()),
+        task_json=json.dumps(task.to_yaml_config()))
+    logger.info('Service %s updated to version %d.', service_name,
+                version)
+    return {'name': service_name, 'version': version}
 
 
 def down(service_name: str, purge: bool = False) -> None:
@@ -158,10 +187,13 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
             'status': record['status'],
             'endpoint': (f'http://127.0.0.1:{record["lb_port"]}'
                          if record['lb_port'] else None),
+            'version': record.get('current_version') or 1,
             'replicas': [{
                 'replica_id': r['replica_id'],
                 'status': r['status'],
                 'url': r['url'],
+                'version': r.get('version') or 1,
+                'is_spot': bool(r.get('is_spot')),
             } for r in replicas],
         })
     return out

@@ -68,40 +68,73 @@ class ReplicaManager:
     def _cluster_name(self, replica_id: int) -> str:
         return f'{self.service_name}-replica-{replica_id}'
 
-    def _replica_port(self, replica_id: int) -> int:
+    def _replica_port(self, replica_id: int,
+                      spec: Optional[ServiceSpec] = None) -> int:
         # Distinct per replica so local (same-IP) replicas never clash;
         # stable so recovery reuses the port.
-        return self.spec.replica_port + replica_id
+        return (spec or self.spec).replica_port + replica_id
 
-    def _make_task(self, replica_id: int) -> 'task_lib.Task':
+    def _version_config(self, version: int) -> dict:
+        record = serve_state.get_version_spec(self.service_name, version)
+        if record is not None:
+            return record['task']
+        return self.task_config
+
+    def _version_spec(self, version: int) -> ServiceSpec:
+        record = serve_state.get_version_spec(self.service_name, version)
+        if record is not None:
+            try:
+                return ServiceSpec.from_yaml_config(record['spec'])
+            except Exception:  # pylint: disable=broad-except
+                pass
+        return self.spec
+
+    def _make_task(self, replica_id: int, version: int,
+                   is_spot: Optional[bool]) -> 'task_lib.Task':
         # A replica is a plain task: strip the service: section.
-        config = {k: v for k, v in self.task_config.items()
-                  if k != 'service'}
+        config = {
+            k: v for k, v in self._version_config(version).items()
+            if k != 'service'
+        }
+        if is_spot is not None:
+            # Spot policy overrides the task's own resources: the
+            # autoscaler decides per replica which tier it runs on.
+            resources = dict(config.get('resources') or {})
+            resources['use_spot'] = bool(is_spot)
+            config['resources'] = resources
         task = task_lib.Task.from_yaml_config(config)
         envs = dict(task.envs or {})
-        envs[SERVE_PORT_ENV] = str(self._replica_port(replica_id))
+        envs[SERVE_PORT_ENV] = str(
+            self._replica_port(replica_id, self._version_spec(version)))
         task.update_envs(envs)
         return task
 
     # ------------------------------------------------------------------
-    def scale_up(self, n: int = 1) -> None:
+    def scale_up(self, n: int = 1, version: Optional[int] = None,
+                 is_spot: Optional[bool] = None) -> None:
+        if version is None:
+            version = serve_state.get_current_version(self.service_name)
         for _ in range(n):
             replica_id = serve_state.next_replica_id(self.service_name)
             cluster = self._cluster_name(replica_id)
             serve_state.add_replica(self.service_name, replica_id,
-                                    cluster)
-            thread = threading.Thread(target=self._launch_replica,
-                                      args=(replica_id, cluster),
-                                      daemon=True)
+                                    cluster, version=version,
+                                    is_spot=bool(is_spot))
+            thread = threading.Thread(
+                target=self._launch_replica,
+                args=(replica_id, cluster, version, is_spot),
+                daemon=True)
             self._launch_threads[replica_id] = thread
             thread.start()
 
-    def _launch_replica(self, replica_id: int, cluster: str) -> None:
+    def _launch_replica(self, replica_id: int, cluster: str,
+                        version: int,
+                        is_spot: Optional[bool]) -> None:
         from skypilot_tpu import execution
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.PROVISIONING)
         try:
-            task = self._make_task(replica_id)
+            task = self._make_task(replica_id, version, is_spot)
             execution.launch(task, cluster_name=cluster,
                              detach_run=True, stream_logs=False)
         except Exception:  # pylint: disable=broad-except
@@ -179,8 +212,8 @@ class ReplicaManager:
             t.join()
 
     # ------------------------------------------------------------------
-    def _replica_url(self, replica_id: int,
-                     cluster: str) -> Optional[str]:
+    def _replica_url(self, replica_id: int, cluster: str,
+                     spec: Optional[ServiceSpec] = None) -> Optional[str]:
         record = backend_utils.refresh_cluster_record(cluster)
         if record is None or record.get('handle') is None:
             return None
@@ -188,22 +221,27 @@ class ReplicaManager:
         ips = handle.ip_list()
         if not ips:
             return None
-        return f'http://{ips[0]}:{self._replica_port(replica_id)}'
+        return f'http://{ips[0]}:{self._replica_port(replica_id, spec)}'
 
-    def _probe_ready(self, url: str) -> bool:
+    def _probe_ready(self, url: str, spec: ServiceSpec) -> bool:
         try:
             resp = requests.get(
-                url.rstrip('/') + self.spec.readiness_path,
-                timeout=self.spec.readiness_timeout_seconds)
+                url.rstrip('/') + spec.readiness_path,
+                timeout=spec.readiness_timeout_seconds)
             return resp.status_code < 500
         except requests.RequestException:
             return False
 
     def probe_all(self) -> None:
         """One probe pass: drive the FSM for every live replica."""
+        spec_cache: Dict[int, ServiceSpec] = {}
         for replica in serve_state.get_replicas(self.service_name):
             rid = replica['replica_id']
             status = replica['status']
+            version = replica.get('version') or 1
+            if version not in spec_cache:
+                spec_cache[version] = self._version_spec(version)
+            spec = spec_cache[version]
             if status not in (ReplicaStatus.STARTING,
                               ReplicaStatus.READY,
                               ReplicaStatus.NOT_READY):
@@ -226,8 +264,8 @@ class ReplicaManager:
                                                ReplicaStatus.PREEMPTED)
                 self._terminate_in_background(rid, remove=True)
                 continue
-            url = self._replica_url(rid, cluster)
-            ready = url is not None and self._probe_ready(url)
+            url = self._replica_url(rid, cluster, spec)
+            ready = url is not None and self._probe_ready(url, spec)
             if ready:
                 self._failed_probes[rid] = 0
                 serve_state.set_replica_status(self.service_name, rid,
@@ -264,7 +302,7 @@ class ReplicaManager:
                 starting_at = (replica.get('starting_at') or
                                replica.get('launched_at') or 0)
                 if (time.time() - starting_at >
-                        self.spec.initial_delay_seconds):
+                        spec.initial_delay_seconds):
                     logger.warning(
                         'Replica %d never became ready within '
                         'initial_delay_seconds: FAILED.', rid)
@@ -275,18 +313,43 @@ class ReplicaManager:
                         rid, ReplicaStatus.FAILED_INITIAL_DELAY)
 
     # ------------------------------------------------------------------
-    def reconcile(self, target: int) -> None:
-        """Converge live replica count toward `target`; replace
-        preempted replicas."""
+    _LIVE_STATUSES = (ReplicaStatus.PENDING, ReplicaStatus.PROVISIONING,
+                      ReplicaStatus.STARTING, ReplicaStatus.READY,
+                      ReplicaStatus.NOT_READY)
+
+    def _scale_pool_to(self, pool: List[dict], want: int, version: int,
+                       is_spot: Optional[bool]) -> None:
+        if len(pool) < want:
+            self.scale_up(want - len(pool), version=version,
+                          is_spot=is_spot)
+        elif len(pool) > want:
+            # Prefer shutting down not-ready, then newest.
+            order = sorted(
+                pool,
+                key=lambda r: (r['status'] == ReplicaStatus.READY,
+                               -r['replica_id']))
+            doomed = order[:len(pool) - want]
+            self.scale_down([r['replica_id'] for r in doomed])
+
+    def reconcile(self, decision) -> None:
+        """Converge replicas toward the scaling decision: replace
+        preempted replicas, roll old versions forward, and keep the
+        spot/on-demand mix.
+
+        Rolling update (reference sky/serve/autoscalers.py:215): new
+        replicas always launch at current_version; old-version replicas
+        keep serving until the new version's full target is READY, then
+        drain all at once — an update that cannot come up never takes
+        the service down.
+        """
+        from skypilot_tpu.serve import autoscalers
+        if isinstance(decision, int):  # convenience for tests/callers
+            decision = autoscalers.ScalingDecision(decision)
+        target = decision.target_replicas
+        current_version = serve_state.get_current_version(
+            self.service_name)
         replicas = serve_state.get_replicas(self.service_name)
-        live = [
-            r for r in replicas
-            if r['status'] in (ReplicaStatus.PENDING,
-                               ReplicaStatus.PROVISIONING,
-                               ReplicaStatus.STARTING,
-                               ReplicaStatus.READY,
-                               ReplicaStatus.NOT_READY)
-        ]
+        live = [r for r in replicas if r['status'] in self._LIVE_STATUSES]
         # Fully-shutdown rows are done — garbage-collect them (replica
         # ids are a monotonic counter, so removal cannot cause a
         # cluster-name collision). PREEMPTED rows normally have a
@@ -302,31 +365,52 @@ class ReplicaManager:
                 self._terminate_in_background(r['replica_id'],
                                               remove=True)
             elif (r['status'].is_failed() and
-                  now - (r['launched_at'] or 0) > _FAILED_ROW_TTL_SECONDS):
+                  now - (r.get('failed_at') or r['launched_at'] or 0)
+                  > _FAILED_ROW_TTL_SECONDS):
                 serve_state.remove_replica(self.service_name,
                                            r['replica_id'])
+        # A string of FAILED launches means the task itself is broken —
+        # stop burning clusters (reference replica_managers marks the
+        # service failed rather than relaunching forever). Only recent
+        # failures count, so isolated crashes over a long-lived service
+        # cannot brick it.
         failed = sum(
             1 for r in replicas if r['status'].is_failed() and
-            now - (r['launched_at'] or 0) <= _FAILED_ROW_TTL_SECONDS)
-        if len(live) < target:
-            # Replace missing replicas, but a string of FAILED
-            # launches means the task itself is broken — stop burning
-            # clusters (reference replica_managers marks the service
-            # failed rather than relaunching forever).
-            if failed > _MAX_FAILED_REPLICAS:
-                logger.error(
-                    'Service %s: %d failed replicas; halting scale-up.',
-                    self.service_name, failed)
-                return
-            self.scale_up(target - len(live))
-        elif len(live) > target:
-            # Prefer shutting down not-ready, then newest.
-            order = sorted(
-                live,
-                key=lambda r: (r['status'] == ReplicaStatus.READY,
-                               -r['replica_id']))
-            doomed = order[:len(live) - target]
-            self.scale_down([r['replica_id'] for r in doomed])
+            now - (r.get('failed_at') or r['launched_at'] or 0)
+            <= _FAILED_ROW_TTL_SECONDS)
+        halted = failed > _MAX_FAILED_REPLICAS
+        if halted:
+            logger.error(
+                'Service %s: %d recently-failed replicas; halting '
+                'scale-up.', self.service_name, failed)
+
+        latest = [r for r in live
+                  if (r.get('version') or 1) == current_version]
+        old = [r for r in live
+               if (r.get('version') or 1) != current_version]
+
+        if not halted:
+            if decision.num_spot is None:
+                self._scale_pool_to(latest, target, current_version,
+                                    is_spot=None)
+            else:
+                spot_pool = [r for r in latest if r.get('is_spot')]
+                od_pool = [r for r in latest if not r.get('is_spot')]
+                self._scale_pool_to(spot_pool, decision.num_spot,
+                                    current_version, is_spot=True)
+                self._scale_pool_to(od_pool, decision.num_ondemand,
+                                    current_version, is_spot=False)
+
+        if old:
+            ready_latest = sum(1 for r in latest
+                               if r['status'] is ReplicaStatus.READY)
+            if ready_latest >= target:
+                logger.info(
+                    'Service %s: version %d fully READY (%d/%d); '
+                    'draining %d old-version replicas.',
+                    self.service_name, current_version, ready_latest,
+                    target, len(old))
+                self.scale_down([r['replica_id'] for r in old])
 
     def ready_urls(self) -> List[str]:
         return [

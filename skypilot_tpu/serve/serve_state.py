@@ -43,7 +43,20 @@ def _conn() -> sqlite3.Connection:
             controller_pid INTEGER,
             lb_port INTEGER,
             created_at REAL,
-            next_replica_id INTEGER DEFAULT 0
+            next_replica_id INTEGER DEFAULT 0,
+            current_version INTEGER DEFAULT 1
+        )""")
+    # Per-version task+spec so rolling updates can launch new-version
+    # replicas while old-version replicas drain (reference
+    # sky/serve/serve_state.py:40-57 version_specs).
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS version_specs (
+            service_name TEXT,
+            version INTEGER,
+            spec_json TEXT,
+            task_json TEXT,
+            created_at REAL,
+            PRIMARY KEY (service_name, version)
         )""")
     conn.execute("""
         CREATE TABLE IF NOT EXISTS replicas (
@@ -54,6 +67,7 @@ def _conn() -> sqlite3.Connection:
             url TEXT,
             launched_at REAL,
             starting_at REAL,
+            failed_at REAL,
             version INTEGER DEFAULT 1,
             is_spot INTEGER DEFAULT 0,
             PRIMARY KEY (service_name, replica_id)
@@ -62,7 +76,9 @@ def _conn() -> sqlite3.Connection:
     # NOT EXISTS is a no-op on an old schema).
     for table, column, decl in (
         ('services', 'next_replica_id', 'INTEGER DEFAULT 0'),
+        ('services', 'current_version', 'INTEGER DEFAULT 1'),
         ('replicas', 'starting_at', 'REAL'),
+        ('replicas', 'failed_at', 'REAL'),
         ('replicas', 'version', 'INTEGER DEFAULT 1'),
         ('replicas', 'is_spot', 'INTEGER DEFAULT 0'),
     ):
@@ -90,9 +106,60 @@ def add_service(name: str, spec_json: str, task_json: str,
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO services (name, status, spec_json, '
-            'task_json, lb_port, created_at) VALUES (?,?,?,?,?,?)',
+            'task_json, lb_port, created_at, current_version) '
+            'VALUES (?,?,?,?,?,?,1)',
             (name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
              task_json, lb_port, time.time()))
+        conn.execute(
+            'INSERT OR REPLACE INTO version_specs (service_name, '
+            'version, spec_json, task_json, created_at) '
+            'VALUES (?,1,?,?,?)', (name, spec_json, task_json,
+                                   time.time()))
+
+
+def add_version(name: str, spec_json: str, task_json: str) -> int:
+    """Record a new service version; returns the new version number.
+
+    The controller notices current_version changed on its next loop and
+    rolls replicas forward (launch new, drain old once new are READY).
+    """
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(version) AS v FROM version_specs '
+            'WHERE service_name = ?', (name,)).fetchone()
+        version = int(row['v'] or 0) + 1
+        conn.execute(
+            'INSERT INTO version_specs (service_name, version, '
+            'spec_json, task_json, created_at) VALUES (?,?,?,?,?)',
+            (name, version, spec_json, task_json, time.time()))
+        # Keep the service row's spec/task mirroring the latest
+        # version (what status/up readers see).
+        conn.execute(
+            'UPDATE services SET current_version = ?, spec_json = ?, '
+            'task_json = ? WHERE name = ?',
+            (version, spec_json, task_json, name))
+    return version
+
+
+def get_current_version(name: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT current_version FROM services WHERE name = ?',
+            (name,)).fetchone()
+    return int(row['current_version']) if row else 1
+
+
+def get_version_spec(name: str, version: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT * FROM version_specs WHERE service_name = ? AND '
+            'version = ?', (name, version)).fetchone()
+    if row is None:
+        return None
+    d = dict(row)
+    d['spec'] = json.loads(d['spec_json'])
+    d['task'] = json.loads(d['task_json'])
+    return d
 
 
 def set_service_status(name: str, status: ServiceStatus) -> None:
@@ -144,6 +211,8 @@ def remove_service(name: str) -> None:
         conn.execute('DELETE FROM services WHERE name = ?', (name,))
         conn.execute('DELETE FROM replicas WHERE service_name = ?',
                      (name,))
+        conn.execute('DELETE FROM version_specs WHERE service_name = ?',
+                     (name,))
 
 
 # ------------------------------------------------------------- replicas
@@ -173,6 +242,12 @@ def set_replica_status(service_name: str, replica_id: int,
     args: list = [status.value]
     if status is ReplicaStatus.STARTING:
         sets.append('starting_at = ?')
+        args.append(time.time())
+    if status.is_failed():
+        # The replacement cap counts failures by WHEN they failed, not
+        # when the replica launched (a replica dying after an hour of
+        # service is a fresh failure).
+        sets.append('failed_at = ?')
         args.append(time.time())
     if url is not None:
         sets.append('url = ?')

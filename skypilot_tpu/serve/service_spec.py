@@ -36,6 +36,14 @@ class ServiceSpec:
     upscale_delay_seconds: int = 300
     downscale_delay_seconds: int = 1200
     load_balancing_policy: str = 'least_load'
+    # Spot policy (reference FallbackRequestRateAutoscaler,
+    # autoscalers.py:546): serve from cheap spot replicas, with
+    # `base_ondemand_fallback_replicas` always-on on-demand replicas,
+    # and (if dynamic_ondemand_fallback) extra on-demand replicas
+    # covering preempted spot capacity until spot recovers.
+    use_spot: bool = False
+    base_ondemand_fallback_replicas: int = 0
+    dynamic_ondemand_fallback: bool = False
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -76,6 +84,11 @@ class ServiceSpec:
                 policy.get('downscale_delay_seconds', 1200)),
             load_balancing_policy=config.get('load_balancing_policy',
                                              'least_load'),
+            use_spot=bool(policy.get('use_spot', False)),
+            base_ondemand_fallback_replicas=int(
+                policy.get('base_ondemand_fallback_replicas', 0)),
+            dynamic_ondemand_fallback=bool(
+                policy.get('dynamic_ondemand_fallback', False)),
         )
         spec.validate()
         return spec
@@ -96,6 +109,15 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 'autoscaling (target_qps_per_replica) requires '
                 'max_replicas')
+        if self.base_ondemand_fallback_replicas < 0:
+            raise exceptions.InvalidTaskError(
+                'base_ondemand_fallback_replicas must be >= 0')
+        if ((self.base_ondemand_fallback_replicas > 0 or
+             self.dynamic_ondemand_fallback) and not self.use_spot):
+            raise exceptions.InvalidTaskError(
+                'on-demand fallback requires use_spot: true '
+                '(fallback is the on-demand safety net under spot '
+                'replicas)')
 
     def to_yaml_config(self) -> Dict[str, Any]:
         return {
@@ -110,6 +132,11 @@ class ServiceSpec:
                 'target_qps_per_replica': self.target_qps_per_replica,
                 'upscale_delay_seconds': self.upscale_delay_seconds,
                 'downscale_delay_seconds': self.downscale_delay_seconds,
+                'use_spot': self.use_spot,
+                'base_ondemand_fallback_replicas':
+                    self.base_ondemand_fallback_replicas,
+                'dynamic_ondemand_fallback':
+                    self.dynamic_ondemand_fallback,
             },
             'replica_port': self.replica_port,
             'load_balancing_policy': self.load_balancing_policy,

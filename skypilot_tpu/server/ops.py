@@ -129,6 +129,12 @@ def _serve_up(body: Dict[str, Any]) -> Any:
                          body.get('service_name'))
 
 
+def _serve_update(body: Dict[str, Any]) -> Any:
+    from skypilot_tpu.serve import core as serve_core
+    return serve_core.update(_task_from_body(body),
+                             body['service_name'])
+
+
 def _serve_down(body: Dict[str, Any]) -> Any:
     from skypilot_tpu.serve import core as serve_core
     serve_core.down(body['service_name'], purge=body.get('purge', False))
@@ -143,10 +149,13 @@ def _serve_status(body: Dict[str, Any]) -> Any:
             'name': s['name'],
             'status': s['status'].value,
             'endpoint': s['endpoint'],
+            'version': s['version'],
             'replicas': [{
                 'replica_id': r['replica_id'],
                 'status': r['status'].value,
                 'url': r['url'],
+                'version': r['version'],
+                'is_spot': r['is_spot'],
             } for r in s['replicas']],
         })
     return out
@@ -176,6 +185,7 @@ OPS: Dict[str, Tuple[Callable[[Dict[str, Any]], Any], ScheduleType]] = {
     'jobs.queue': (_jobs_queue, ScheduleType.SHORT),
     'jobs.cancel': (_jobs_cancel, ScheduleType.SHORT),
     'serve.up': (_serve_up, ScheduleType.LONG),
+    'serve.update': (_serve_update, ScheduleType.SHORT),
     'serve.down': (_serve_down, ScheduleType.LONG),
     'serve.status': (_serve_status, ScheduleType.SHORT),
 }

@@ -85,6 +85,54 @@ def test_autoscaler_respects_bounds():
     assert scaler2.evaluate(3, t0 + 1).target_replicas == 2  # floor
 
 
+def test_fallback_autoscaler_spot_mix():
+    """Spot base + on-demand fallback (reference autoscalers.py:546):
+    QPS target is served by spot; base on-demand is always on; dynamic
+    fallback covers the not-yet-ready part of the spot target."""
+    spec = ServiceSpec(min_replicas=1, max_replicas=10,
+                       target_qps_per_replica=1.0,
+                       upscale_delay_seconds=0,
+                       downscale_delay_seconds=0,
+                       use_spot=True,
+                       base_ondemand_fallback_replicas=1,
+                       dynamic_ondemand_fallback=True)
+    scaler = autoscalers.make_autoscaler(spec)
+    assert isinstance(scaler, autoscalers.FallbackRequestRateAutoscaler)
+    t0 = 3000.0
+    for i in range(180):
+        scaler.record_request(t0 + i / 3.0)  # 3 qps -> target 3 spot
+    scaler.evaluate(3, t0 + 60, num_ready_spot=3)
+    d = scaler.evaluate(3, t0 + 61, num_ready_spot=3)
+    # All spot ready: 3 spot + 1 base on-demand.
+    assert (d.num_spot, d.num_ondemand) == (3, 1)
+    assert d.target_replicas == 4
+    # A preemption storm takes 2 spot replicas out: dynamic fallback
+    # covers the gap with on-demand until spot recovers.
+    d = scaler.evaluate(3, t0 + 62, num_ready_spot=1)
+    assert (d.num_spot, d.num_ondemand) == (3, 1 + 2)
+
+
+def test_fixed_autoscaler_spot_split():
+    spec = ServiceSpec(min_replicas=2, use_spot=True,
+                       base_ondemand_fallback_replicas=1)
+    d = autoscalers.make_autoscaler(spec).initial()
+    assert (d.target_replicas, d.num_spot, d.num_ondemand) == (3, 2, 1)
+
+
+def test_spec_spot_policy_roundtrip_and_validation():
+    spec = ServiceSpec.from_yaml_config({
+        'replica_policy': {'min_replicas': 1, 'use_spot': True,
+                           'base_ondemand_fallback_replicas': 1,
+                           'dynamic_ondemand_fallback': True},
+    })
+    assert spec.use_spot and spec.dynamic_ondemand_fallback
+    assert ServiceSpec.from_yaml_config(spec.to_yaml_config()) == spec
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'replica_policy': {'base_ondemand_fallback_replicas': 1},
+        })  # fallback without use_spot
+
+
 # ------------------------------------------------------------ LB
 
 def test_round_robin_policy():
@@ -141,3 +189,110 @@ def test_serve_up_probe_and_proxy(isolated_state, monkeypatch):
     finally:
         serve_core.down('svc')
     assert serve_core.status('svc') == []
+
+
+_TAG_SERVER = (
+    'python -c "'
+    'import http.server, os\n'
+    'class H(http.server.BaseHTTPRequestHandler):\n'
+    '    def do_GET(self):\n'
+    "        body = os.environ.get('SKYTPU_TEST_TAG', '?').encode()\n"
+    '        self.send_response(200)\n'
+    "        self.send_header('Content-Length', str(len(body)))\n"
+    '        self.end_headers()\n'
+    '        self.wfile.write(body)\n'
+    '    def log_message(self, *a):\n'
+    '        pass\n'
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYTPU_SERVE_PORT'])), H).serve_forever()\n"
+    '"')
+
+
+def _tag_task(tag: str, spec: ServiceSpec) -> task_lib.Task:
+    task = task_lib.Task('svc', run=_TAG_SERVER,
+                         envs={'SKYTPU_TEST_TAG': tag})
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    task.service = spec
+    return task
+
+
+@pytest.mark.slow
+def test_serve_rolling_update(isolated_state, monkeypatch):
+    """v1 serves until v2 is fully READY, then drains; the endpoint
+    flips from v1 to v2 with no downtime."""
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_LOG_DIR',
+                       str(isolated_state / 'serve_logs'))
+    spec = ServiceSpec(min_replicas=1, replica_port=18180,
+                       initial_delay_seconds=60,
+                       readiness_timeout_seconds=3)
+    result = serve_core.up(_tag_task('v1', spec), 'svc',
+                           controller_loop_gap=1.0)
+    endpoint = result['endpoint']
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            st = serve_core.status('svc')
+            if st and any(
+                    r['status'] == serve_state.ReplicaStatus.READY
+                    for r in st[0]['replicas']):
+                break
+            time.sleep(1)
+        assert requests.get(endpoint, timeout=10).text == 'v1'
+
+        update = serve_core.update(_tag_task('v2', spec), 'svc')
+        assert update['version'] == 2
+        deadline = time.time() + 120
+        rolled = False
+        while time.time() < deadline:
+            st = serve_core.status('svc')[0]
+            live = [r for r in st['replicas']
+                    if r['status'] not in
+                    (serve_state.ReplicaStatus.SHUTDOWN,)]
+            # The service must never drop to zero READY replicas.
+            if (live and all(r['version'] == 2 for r in live) and
+                    any(r['status'] == serve_state.ReplicaStatus.READY
+                        for r in live)):
+                rolled = True
+                break
+            time.sleep(1)
+        assert rolled, serve_core.status('svc')
+        assert requests.get(endpoint, timeout=10).text == 'v2'
+    finally:
+        serve_core.down('svc')
+
+
+@pytest.mark.slow
+def test_serve_spot_mix(isolated_state, monkeypatch):
+    """use_spot + base_ondemand_fallback_replicas=1 yields one spot
+    and one on-demand replica on the hermetic local cloud."""
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_LOG_DIR',
+                       str(isolated_state / 'serve_logs'))
+    spec = ServiceSpec(min_replicas=1, replica_port=18280,
+                       initial_delay_seconds=60,
+                       readiness_timeout_seconds=3,
+                       use_spot=True,
+                       base_ondemand_fallback_replicas=1)
+    serve_core.up(_tag_task('spot', spec), 'svc',
+                  controller_loop_gap=1.0)
+    try:
+        deadline = time.time() + 90
+        ok = False
+        while time.time() < deadline:
+            st = serve_core.status('svc')
+            if st:
+                ready = [r for r in st[0]['replicas']
+                         if r['status'] ==
+                         serve_state.ReplicaStatus.READY]
+                if len(ready) >= 2:
+                    assert sorted(r['is_spot'] for r in ready) == [
+                        False, True]
+                    ok = True
+                    break
+            time.sleep(1)
+        assert ok, serve_core.status('svc')
+    finally:
+        serve_core.down('svc')
