@@ -13,6 +13,14 @@ compute, Pallas flash attention, remat) on whatever single chip is
 visible and report steady-state MFU; ``vs_baseline`` is the MFU ratio.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``python bench.py decode`` (or BENCH_MODE=decode) instead benchmarks
+the KV-cache decode path (models/inference.py) and reports batch
+decode tokens/s against the reference's JetStream serving baseline
+(examples/tpu/v6e/README.md:95-120: 18,803 generated tokens in 8.75 s
+= 2,149 output tok/s for Llama-2-7B on v6e). vs_baseline is the
+decode-MFU ratio (throughput x 2N flops/token, normalized by chip
+peak) so model size and chip generation cancel.
 """
 import json
 import os
@@ -84,14 +92,15 @@ def main():
                                 (batch, seq + 1), 0, cfg.vocab_size)
     batch_d = {'tokens': tokens}
 
-    # Warmup: compile + 1 step.
+    # Warmup: compile + 1 step. Sync via scalar fetch (on tunneled
+    # backends block_until_ready can be a no-op).
     state, m = step_fn(state, batch_d)
-    jax.block_until_ready(m['loss'])
+    _ = float(m['loss'])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = step_fn(state, batch_d)
-    jax.block_until_ready(m['loss'])
+    _ = float(m['loss'])
     dt = (time.perf_counter() - t0) / steps
 
     tokens_per_sec = batch * seq / dt
@@ -112,5 +121,88 @@ def main():
     print(json.dumps(result))
 
 
+def decode_bench():
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models import inference
+
+    dev = jax.devices()[0]
+    gen = _detect_generation(dev)
+    peak = _PEAK_TFLOPS[gen] * 1e12
+    on_tpu = jax.default_backend() not in ('cpu',)
+
+    batch = int(os.environ.get('BENCH_DECODE_BATCH', '32'))
+    context = int(os.environ.get('BENCH_DECODE_CONTEXT', '1024'))
+    steps = int(os.environ.get('BENCH_DECODE_STEPS', '64'))
+    if not on_tpu:
+        batch, context, steps = 4, 64, 8
+        cfg = models.LlamaConfig.tiny(max_seq=256)
+    else:
+        cfg = models.LlamaConfig.tpu_1b(max_seq=2048,
+                                        param_dtype=jnp.bfloat16)
+    from skypilot_tpu.models.llama import num_params
+    n_params = num_params(cfg)
+
+    prompt = jax.random.randint(jax.random.PRNGKey(0),
+                                (batch, context), 0, cfg.vocab_size)
+    lengths = jnp.full((batch,), context, jnp.int32)
+    params = models.init_params(cfg, jax.random.PRNGKey(1))
+    _, cache = jax.jit(
+        lambda p, t, n: inference.prefill(p, t, n, cfg),
+    )(params, prompt, lengths)
+
+    # The whole decode loop lives inside one jit (lax.scan), exactly
+    # like models.generate — so we time device throughput, not
+    # per-step host dispatch.
+    from jax import lax
+
+    def run(params, cache, tok):
+        def body(carry, _):
+            cache, tok = carry
+            logits, cache = inference.decode_step(params, cache, tok,
+                                                  cfg)
+            return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), None
+        (cache, tok), _ = lax.scan(body, (cache, tok), None,
+                                   length=steps)
+        return cache, tok
+
+    run = jax.jit(run, donate_argnums=(1,))
+    tok = jnp.ones((batch,), jnp.int32)
+    # Warmup (compile). Sync via a scalar fetch: on tunneled backends
+    # block_until_ready can be a no-op, only a device->host read
+    # truly drains the queue.
+    cache, tok = run(params, cache, tok)
+    _ = int(tok[0])
+
+    t0 = time.perf_counter()
+    cache, tok = run(params, cache, tok)
+    _ = int(tok[0])
+    dt = (time.perf_counter() - t0) / steps
+
+    tok_s = batch / dt
+    decode_mfu = tok_s * 2 * n_params / peak
+    # JetStream baseline: 2,149 output tok/s, Llama-2-7B, v6e.
+    base_mfu = 2149.0 * 2 * 6.74e9 / 918e12
+    result = {
+        'metric': 'llama_decode_tok_s',
+        'value': round(tok_s, 1),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(decode_mfu / base_mfu, 2),
+        'detail': {
+            'step_time_ms': round(dt * 1000, 3),
+            'batch': batch, 'context': context,
+            'n_params': n_params, 'chip': gen,
+            'backend': jax.default_backend(),
+            'decode_mfu_pct': round(decode_mfu * 100, 2),
+            'baseline_decode_mfu_pct': round(base_mfu * 100, 2),
+        },
+    }
+    print(json.dumps(result))
+
+
 if __name__ == '__main__':
-    sys.exit(main())
+    mode = (sys.argv[1] if len(sys.argv) > 1 else
+            os.environ.get('BENCH_MODE', 'train'))
+    sys.exit(decode_bench() if mode == 'decode' else main())

@@ -1,0 +1,352 @@
+"""KV-cache inference for the Llama decoder: prefill + decode.
+
+The serving counterpart of ``models.llama`` — the reference serves
+Llama through JetStream (examples/tpu/v6e/serve-llama2-7b.yaml,
+README.md:95-120: 11.42 req/s, ~2500 input tok/s on v6e); this module
+is the TPU-native engine that plays that role here.
+
+Design (TPU-first, not a torch translation):
+
+- **Prefill / decode split.** ``prefill`` runs the full-sequence
+  forward once (MXU-bound, flash attention) and writes K/V for every
+  prompt position into a preallocated cache; ``decode_step`` then
+  advances one token per call (HBM-bandwidth-bound: one pass over the
+  cache per layer). Both are single traced programs — the layer loop
+  is ``lax.scan`` over stacked per-layer params *and* the stacked
+  cache, so cache updates are part of the scan's carry-free ys and XLA
+  aliases the buffers in place under ``donate_argnums``.
+- **GQA-native cache.** K/V are stored at ``n_kv_heads`` — never
+  repeated to ``n_heads`` (an 8:1-GQA Llama-8B cache stays 4x smaller
+  in HBM and on ICI than the repeat-then-attend layout). Query heads
+  are folded as ``[B, n_kv, rep, hd]`` and contracted against the
+  shared K/V with einsums XLA maps onto the MXU.
+- **Ragged batches.** Each sequence carries its own length; cache
+  writes use per-row scatter and attention masks positions ``>=
+  length``, so one batch mixes prompt lengths freely (continuous
+  batching shape, as JetStream does).
+- **Sharding.** The cache is a pytree with PartitionSpecs: kv-heads on
+  'tp', batch on ('dp','fsdp') — decode scales over a mesh with the
+  same ``param_specs`` used for training.
+
+Static shapes throughout (cache is [L, B, max_seq, n_kv, hd]); the
+token index is data, not shape, so decode never recompiles.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models.llama import (LlamaConfig, _attention,
+                                       _rmsnorm, _rope, forward_hidden)
+
+# Cache layout: [n_layers, B, max_seq, n_kv_heads, head_dim].
+CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
+
+
+def cache_specs() -> Dict:
+    return {'k': CACHE_SPEC, 'v': CACHE_SPEC,
+            'length': P(('dp', 'fsdp')), 'base': P(), 'steps': P()}
+
+
+def init_cache(cfg: LlamaConfig, batch: int,
+               max_seq: Optional[int] = None) -> Dict:
+    """Preallocated KV cache for ``batch`` sequences.
+
+    Slot layout (the key to fast TPU decode): prompts occupy slots
+    ``0..base-1`` (``base`` = padded prompt length; rows shorter than
+    ``base`` leave garbage in their tail slots, masked at read), and
+    decode step ``i`` writes slot ``base + i`` for EVERY row. The
+    write index is therefore a traced *scalar*, so the cache update
+    is a ``dynamic_update_slice`` XLA performs in place on the loop
+    carry — no scatter, no full-cache rewrite. Per-row raggedness
+    lives entirely in the validity mask and the RoPE positions.
+    """
+    s = max_seq or cfg.max_seq
+    shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        'k': jnp.zeros(shape, cfg.compute_dtype),
+        'v': jnp.zeros(shape, cfg.compute_dtype),
+        'length': jnp.zeros((batch,), jnp.int32),
+        'base': jnp.zeros((), jnp.int32),
+        'steps': jnp.zeros((), jnp.int32),
+    }
+
+
+def _constrain(x, spec, mesh):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def _gqa_decode_attention(q, kc, vc, valid, k_self=None, v_self=None):
+    """One-position GQA attention against the cache (+ self).
+
+    q: [B, n_heads, hd]; kc/vc: [B, S, n_kv, hd]; valid: [B, S] bool;
+    k_self/v_self: [B, n_kv, hd] — the incoming token's own K/V,
+    attended without being read back from the cache. Returns
+    [B, n_heads * hd]. K/V stay at n_kv_heads — query heads fold into
+    [B, n_kv, rep, hd] instead (GQA-native, no repeat).
+    """
+    b, s, n_kv, hd = kc.shape
+    rep = q.shape[1] // n_kv
+    # bf16 operands, f32 accumulation: the cache is never upcast in
+    # HBM (decode is cache-bandwidth-bound; a materialized f32 copy
+    # would double the traffic).
+    qf = q.reshape(b, n_kv, rep, hd)
+    scores = jnp.einsum(
+        'bkrh,bskh->bkrs', qf, kc,
+        preferred_element_type=jnp.float32) * hd**-0.5
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    if k_self is not None:
+        s_self = jnp.einsum('bkrh,bkh->bkr', qf, k_self,
+                            preferred_element_type=jnp.float32
+                            )[..., None] * hd**-0.5
+        scores = jnp.concatenate([scores, s_self], axis=-1)
+    # Stable softmax across cache + self scores.
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    probs = e / denom
+    if k_self is not None:
+        probs, p_self = probs[..., :-1], probs[..., -1]
+    out = jnp.einsum('bkrs,bskh->bkrh', probs.astype(kc.dtype), vc,
+                     preferred_element_type=jnp.float32)
+    if v_self is not None:
+        out = out + (p_self[..., None] *
+                     v_self[:, :, None].astype(jnp.float32))
+    return out.reshape(b, n_kv * rep * hd).astype(q.dtype)
+
+
+def prefill(params: Dict,
+            tokens: jax.Array,
+            lengths: jax.Array,
+            cfg: LlamaConfig,
+            mesh=None,
+            max_seq: Optional[int] = None) -> Tuple[jax.Array, Dict]:
+    """Process prompts and build the cache.
+
+    tokens: [B, S] right-padded prompts; lengths: [B] true lengths.
+    Returns (next-token logits [B, vocab] f32 at each prompt's last
+    position, cache). Padded positions write garbage K/V but decode
+    masks everything >= length, so they are never read.
+    """
+    cdt = cfg.compute_dtype
+    b, s = tokens.shape
+    s_max = max_seq or cfg.max_seq
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = params['tok_emb'].astype(cdt)[tokens]
+    x = _constrain(x, P(('dp', 'fsdp'), None, None), mesh)
+
+    def layer(x, lp):
+        h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
+        q = (h @ lp['wq'].astype(cdt)).reshape(b, s, cfg.n_heads,
+                                               cfg.head_dim)
+        k = (h @ lp['wk'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        v = (h @ lp['wv'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        # Same attention dispatch as training (Pallas flash kernel on
+        # TPU, XLA fallback elsewhere) — prefill never materializes
+        # the [S, S] score matrix.
+        o = _attention(q, k, v, cfg, mesh)
+        o = o.reshape(b, s, cfg.n_heads * cfg.head_dim).astype(cdt)
+        x = x + o @ lp['wo'].astype(cdt)
+
+        h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp['w_gate'].astype(cdt))
+        up = h @ lp['w_up'].astype(cdt)
+        x = x + (gate * up) @ lp['w_down'].astype(cdt)
+        # Pad this layer's K/V out to the cache length.
+        pad = [(0, 0), (0, s_max - s), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (ks, vs) = lax.scan(layer, x, params['layers'])
+    x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
+
+    # Hidden state at each prompt's final position -> logits.
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = jnp.einsum('bd,dv->bv', last,
+                        params['lm_head'].astype(cdt),
+                        preferred_element_type=jnp.float32)
+
+    cache = {'k': _constrain(ks, CACHE_SPEC, mesh),
+             'v': _constrain(vs, CACHE_SPEC, mesh),
+             'length': lengths.astype(jnp.int32),
+             'base': jnp.asarray(s, jnp.int32),
+             'steps': jnp.zeros((), jnp.int32)}
+    return logits, cache
+
+
+def decode_step(params: Dict,
+                cache: Dict,
+                tokens: jax.Array,
+                cfg: LlamaConfig,
+                mesh=None) -> Tuple[jax.Array, Dict]:
+    """Advance every sequence by one token.
+
+    tokens: [B] int32 (the tokens being fed in, whose K/V are appended
+    at slot ``base + steps``). Returns (logits [B, vocab] f32 for the
+    *next* token, updated cache).
+
+    Structure (why this is fast on TPU): the layer loop is a
+    ``lax.scan`` whose *carry* holds the full stacked cache; each
+    layer (a) dynamic-slices its [B, S, kv, hd] page for attention
+    reads and (b) dynamic-update-slices the new K/V at scalar indices
+    (layer, slot) — an in-place write of a [B, 1, kv, hd] sliver on
+    the loop-carried buffer. The incoming token attends to the cached
+    slots plus itself, so the updated page never needs materializing.
+    Per-step HBM traffic = params + one cache read + O(B*kv*hd)
+    writes. Alternatives measured on v5e (1B model, batch 32, ctx
+    1024): per-row scatter ~52 ms/step, select-rewrite ~37 ms/step,
+    this layout is bandwidth-bound.
+    """
+    cdt = cfg.compute_dtype
+    b = tokens.shape[0]
+    s_max = cache['k'].shape[2]
+    pos = cache['length']                       # [B] logical position
+    base, steps = cache['base'], cache['steps']
+    slot = base + steps                         # scalar write slot
+    slots = jnp.arange(s_max)
+    # Readable slots: each row's own prompt (its true prompt length
+    # is pos - steps; slots beyond it up to base are padding garbage)
+    # plus every already-written decode slot (base..slot-1, uniform
+    # across rows). The incoming token is handled by the explicit
+    # self term, so ``slot`` itself is not read from the cache.
+    prompt_len = pos - steps
+    valid = ((slots[None, :] < prompt_len[:, None]) |
+             ((slots >= base) & (slots < slot))[None, :])
+
+    x = params['tok_emb'].astype(cdt)[tokens]   # [B, D]
+    x = _constrain(x, P(('dp', 'fsdp'), None), mesh)
+
+    def layer(carry, inp):
+        x, kc, vc = carry                   # kc/vc [L, B, S, kv, hd]
+        lp, li = inp
+        h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
+        q = (h @ lp['wq'].astype(cdt)).reshape(b, cfg.n_heads,
+                                               cfg.head_dim)
+        k = (h @ lp['wk'].astype(cdt)).reshape(b, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        v = (h @ lp['wv'].astype(cdt)).reshape(b, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        q = _rope(q[:, None], pos[:, None], cfg.rope_theta)[:, 0]
+        k = _rope(k[:, None], pos[:, None], cfg.rope_theta)[:, 0]
+        page_k = lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+        page_v = lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+        o = _gqa_decode_attention(q, page_k, page_v, valid,
+                                  k_self=k, v_self=v)
+        x = x + o @ lp['wo'].astype(cdt)
+
+        h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp['w_gate'].astype(cdt))
+        up = h @ lp['w_up'].astype(cdt)
+        x = x + (gate * up) @ lp['w_down'].astype(cdt)
+
+        # In-place sliver write at scalar (layer, slot).
+        kc = lax.dynamic_update_slice(
+            kc, k[None, :, None].astype(kc.dtype), (li, 0, slot, 0, 0))
+        vc = lax.dynamic_update_slice(
+            vc, v[None, :, None].astype(vc.dtype), (li, 0, slot, 0, 0))
+        return (x, kc, vc), None
+
+    (x, ks, vs), _ = lax.scan(
+        layer, (x, cache['k'], cache['v']),
+        (params['layers'], jnp.arange(cfg.n_layers)))
+    x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
+    logits = jnp.einsum('bd,dv->bv', x, params['lm_head'].astype(cdt),
+                        preferred_element_type=jnp.float32)
+    new_cache = {'k': _constrain(ks, CACHE_SPEC, mesh),
+                 'v': _constrain(vs, CACHE_SPEC, mesh),
+                 'length': pos + 1, 'base': base, 'steps': steps + 1}
+    return logits, new_cache
+
+
+def _sample(logits, key, temperature, top_k: int):
+    """temperature is a *traced* value (<= 0 means greedy), so a
+    server can vary it per request without recompiling; top_k is
+    static (it shapes the threshold computation)."""
+    if top_k > 0 and top_k < logits.shape[-1]:
+        thresh = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    sampled = jax.random.categorical(
+        key, logits / t, axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.asarray(temperature) <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    'cfg', 'max_new', 'top_k', 'max_seq'))
+def generate(params: Dict,
+             tokens: jax.Array,
+             lengths: jax.Array,
+             cfg: LlamaConfig,
+             max_new: int,
+             temperature: float = 0.0,
+             top_k: int = 0,
+             key: Optional[jax.Array] = None,
+             max_seq: Optional[int] = None) -> jax.Array:
+    """Prefill + autoregressive decode, one traced program.
+
+    tokens: [B, S] right-padded prompts; lengths: [B]. Returns
+    generated tokens [B, max_new] (greedy when temperature <= 0;
+    temperature is traced, so varying it does not recompile).
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    s_max = max_seq or cfg.max_seq
+    if tokens.shape[1] + max_new > s_max:
+        # Decode slots are prompt_pad + step; past the cache end the
+        # write would silently clamp and corrupt the newest tokens.
+        raise ValueError(
+            f'prompt ({tokens.shape[1]}) + max_new ({max_new}) '
+            f'exceeds the cache ({s_max} slots); raise max_seq or '
+            'trim the prompt.')
+    logits, cache = prefill(params, tokens, lengths, cfg,
+                            max_seq=max_seq)
+    first = _sample(logits, key, temperature, top_k)
+
+    def step(carry, _):
+        cache, tok, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(params, cache, tok, cfg)
+        nxt = _sample(logits, sub, temperature, top_k)
+        return (cache, nxt, key), tok
+
+    (_, last, _), toks = lax.scan(
+        step, (cache, first, key), None, length=max_new - 1)
+    toks = jnp.moveaxis(toks, 0, 1)             # [B, max_new-1]
+    return jnp.concatenate([toks, last[:, None]], axis=1)
+
+
+def reference_generate(params: Dict, tokens: jax.Array,
+                       lengths: jax.Array, cfg: LlamaConfig,
+                       max_new: int) -> jax.Array:
+    """Cache-free greedy generation (full forward per token) — the
+    correctness oracle for the KV-cache path in tests."""
+    b, s = tokens.shape
+    buf = jnp.concatenate(
+        [tokens, jnp.zeros((b, max_new), jnp.int32)], axis=1)
+    cur = lengths.astype(jnp.int32)
+    full = jax.jit(lambda p, t: forward_hidden(p, t, cfg) @
+                   p['lm_head'].astype(cfg.compute_dtype))
+    out = []
+    for _ in range(max_new):
+        logits = full(params, buf)
+        last = jnp.take_along_axis(
+            logits, (cur - 1)[:, None, None], axis=1)[:, 0]
+        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        buf = buf.at[jnp.arange(b), cur].set(nxt)
+        cur = cur + 1
+        out.append(nxt)
+    return jnp.stack(out, axis=1)

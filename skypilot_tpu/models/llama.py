@@ -214,7 +214,15 @@ def forward_hidden(params: Dict,
         return lax.with_sharding_constraint(
             x, jax.sharding.NamedSharding(mesh, spec))
 
-    x = params['tok_emb'].astype(cdt)[tokens]        # [B, S, D]
+    # Embedding lookup. The table lives sharded P('tp','fsdp') (ZeRO-3
+    # style); gathering rows straight out of a 2-axis-sharded table
+    # hits XLA SPMD's "involuntary full rematerialization" path (it
+    # replicates the table implicitly, with a warning). Make the
+    # FSDP-style all-gather-at-use explicit instead: same bytes on the
+    # wire, but planned — and the backward becomes a clean
+    # reduce-scatter of the table gradient.
+    emb = constrain(params['tok_emb'], P(None, None))
+    x = emb.astype(cdt)[tokens]                      # [B, S, D]
     x = constrain(x, ACT_SPEC)
 
     def layer(x, lp):
