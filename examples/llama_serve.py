@@ -61,7 +61,10 @@ def generate(tokens, max_new, temperature=0.0):
                           temperature=float(temperature))
     out = out[0, :max_new].tolist()   # fetch also syncs the device
     dt = time.perf_counter() - t0
-    return out, max_new / dt
+    # Rate over the tokens the device actually generated (new_b, not
+    # the truncated max_new), timed over prefill+decode — an honest
+    # end-to-end request rate, not a pure-decode number.
+    return out, new_b / dt
 
 
 class Handler(BaseHTTPRequestHandler):

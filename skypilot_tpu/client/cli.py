@@ -202,9 +202,14 @@ def jobs() -> None:
 @jobs.command('launch')
 @click.argument('entrypoint')
 @click.option('--name', '-n', default=None)
-def jobs_launch(entrypoint: str, name: Optional[str]) -> None:
+@click.option('--on-controller/--no-on-controller', default=None,
+              help='Run the controller on the jobs controller cluster '
+              '(survives this machine) instead of a local process.')
+def jobs_launch(entrypoint: str, name: Optional[str],
+                on_controller: Optional[bool]) -> None:
     task = _load_task(entrypoint, name=name)
-    result = sdk.get(sdk.jobs_launch(task, name=name))
+    result = sdk.get(sdk.jobs_launch(task, name=name,
+                                     on_controller=on_controller))
     click.echo(f'Managed job {result["managed_job_id"]} submitted.')
 
 

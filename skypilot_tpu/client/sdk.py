@@ -182,8 +182,12 @@ def check() -> str:
     return submit('check', {})
 
 
-def jobs_launch(task, name: Optional[str] = None) -> str:
-    return submit('jobs.launch', _task_body(task, name=name))
+def jobs_launch(task, name: Optional[str] = None,
+                on_controller: Optional[bool] = None) -> str:
+    body = _task_body(task, name=name)
+    if on_controller is not None:
+        body['on_controller'] = on_controller
+    return submit('jobs.launch', body)
 
 
 def jobs_queue() -> str:

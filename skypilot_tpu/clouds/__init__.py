@@ -3,6 +3,7 @@ from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
@@ -10,5 +11,6 @@ __all__ = [
     'CloudImplementationFeatures',
     'Region',
     'GCP',
+    'Kubernetes',
     'Local',
 ]

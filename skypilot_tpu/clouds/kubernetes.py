@@ -1,0 +1,156 @@
+"""Kubernetes — GKE TPU slices and generic pods behind kubeconfig.
+
+Re-design of reference ``sky/clouds/kubernetes.py:796``: a kubeconfig
+context is the unit of placement (modeled as the single "region");
+TPU slices map onto GKE TPU podslice node pools via node selectors
+(``cloud.google.com/gke-tpu-accelerator``/``-topology``), plain tasks
+onto CPU pods. Kubernetes reports zero hourly cost (the cluster is
+already paid for), so when enabled it wins cost optimization — same
+behavior as the reference.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_CREDENTIAL_HINT = (
+    'No usable kubeconfig. Point KUBECONFIG at (or create) a config '
+    'with a current-context for your cluster.')
+
+
+@registry.CLOUD_REGISTRY.register(name='kubernetes')
+class Kubernetes(cloud_lib.Cloud):
+    """Kubernetes (incl. GKE TPU podslice node pools)."""
+
+    _REPR = 'Kubernetes'
+    # DNS-1123 subdomain limit for pod names, minus our suffixes.
+    MAX_CLUSTER_NAME_LEN_LIMIT = 40
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud_lib.CloudImplementationFeatures.STOP:
+                'Pods cannot be stopped, only terminated.',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'Use autodown: pods terminate, they do not stop.',
+            cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Spot is a node-pool property in Kubernetes, not a '
+                'per-pod request.',
+        }
+
+    # ------------------------------------------------------------------
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        del resources
+        context = self._current_context()
+        if context is None:
+            return []
+        # One "region" per kubeconfig context; placement within the
+        # cluster is the scheduler's job (no zones).
+        return [cloud_lib.Region(context)]
+
+    def zones_provision_loop(self, resources: 'Resources',
+                             region: Optional[str] = None):
+        # Contexts have no zones — even for TPUs (the base class
+        # iterates per-zone for TPU capacity; in-cluster placement is
+        # the scheduler's job).
+        for r in self.regions_with_offering(resources):
+            if region is not None and r.name != region:
+                continue
+            yield (r.name, None)
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        if resources.cloud is not None and not self.is_same_cloud(
+                resources.cloud):
+            return []
+        if resources.is_tpu:
+            from skypilot_tpu.provision.kubernetes import instance
+            gen = resources.tpu.generation
+            if gen not in instance.GKE_TPU_ACCELERATORS:
+                return []  # GKE has no podslice pools for this gen
+        if resources.use_spot:
+            return []
+        return [resources.copy(cloud=self)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        # The cluster is sunk cost (reference kubernetes.py prices
+        # pods at 0) — enabling kubernetes makes the optimizer prefer
+        # it over metered clouds.
+        del resources
+        return 0.0
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError('Kubernetes has contexts, not zones.')
+        return region, zone
+
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        from skypilot_tpu.provision.kubernetes import instance
+        vars_: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'context': region,
+            'region': region,
+            'zone': None,
+            'image_id': resources.image_id,
+            'cpus': resources.cpus,
+            'memory': resources.memory,
+            'labels': resources.labels or {},
+        }
+        if resources.is_tpu:
+            tpu = resources.tpu
+            vars_.update({
+                'tpu_vm': True,
+                'gke_accelerator':
+                    instance.GKE_TPU_ACCELERATORS[tpu.generation],
+                'tpu_topology': tpu.topology,
+                'chips_per_host': tpu.chips_per_host,
+                'num_hosts': tpu.num_hosts,
+            })
+        else:
+            vars_.update({'tpu_vm': False, 'num_hosts': 1})
+        return vars_
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _current_context() -> Optional[str]:
+        try:
+            from skypilot_tpu.provision.kubernetes import api
+            return api.load_kubeconfig().context_name
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.kubernetes import api
+        try:
+            ctx = api.load_kubeconfig()
+        except Exception as e:  # pylint: disable=broad-except
+            return False, f'{e} {_CREDENTIAL_HINT}'
+        if not ctx.server:
+            return False, ('kubeconfig context has no cluster server. '
+                           + _CREDENTIAL_HINT)
+        return True, None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.kubernetes import api
+        path = api.kubeconfig_path()
+        if os.path.exists(path):
+            return {'~/.kube/config': path}
+        return {}
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        context = self._current_context()
+        return [[context]] if context else None

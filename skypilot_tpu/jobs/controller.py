@@ -23,6 +23,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as agent_job_lib
 from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import status_lib
@@ -124,6 +125,10 @@ class JobsController:
     # ------------------------------------------------------------------
     def run(self) -> state.ManagedJobStatus:
         state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+        # Launches are slot-limited (jobs/scheduler.py): a burst of
+        # submissions provisions at most launch_parallelism() clusters
+        # at once; the rest queue in WAITING.
+        scheduler.wait_for_launch_slot(self.job_id)
         try:
             cluster_job_id = self.strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
@@ -131,6 +136,8 @@ class JobsController:
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,
                              failure_reason=str(e))
             return state.ManagedJobStatus.FAILED_NO_RESOURCE
+        finally:
+            scheduler.finish_launch(self.job_id)
         assert cluster_job_id is not None
 
         while True:
@@ -157,6 +164,8 @@ class JobsController:
                 return state.ManagedJobStatus.FAILED_CONTROLLER
             logger.info('Recovery #%d for managed job %d.', n,
                         self.job_id)
+            # Recovery relaunches a cluster — same slot discipline.
+            scheduler.wait_for_launch_slot(self.job_id)
             try:
                 cluster_job_id = self.strategy.recover()
             except exceptions.ResourcesUnavailableError as e:
@@ -165,6 +174,8 @@ class JobsController:
                     state.ManagedJobStatus.FAILED_NO_RESOURCE,
                     failure_reason=str(e))
                 return state.ManagedJobStatus.FAILED_NO_RESOURCE
+            finally:
+                scheduler.finish_launch(self.job_id)
             state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
 
 
@@ -184,6 +195,8 @@ def main() -> None:
                          state.ManagedJobStatus.FAILED_CONTROLLER,
                          failure_reason=str(e))
         raise
+    finally:
+        scheduler.job_done(args.job_id)
 
 
 if __name__ == '__main__':

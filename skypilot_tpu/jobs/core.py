@@ -1,12 +1,17 @@
 """Managed-jobs public API: launch/queue/cancel/tail_logs.
 
 Re-design of reference ``sky/jobs/server/core.py:48``: `launch`
-records the job, then spawns a detached controller process
+records the job, then starts a controller
 (`python -m skypilot_tpu.jobs.controller <id>`) that owns the whole
-lifecycle. The reference provisions a controller VM first; here the
-controller runs on the client machine (same module could be shipped to
-a controller cluster later — nothing in it assumes locality beyond the
-state DB path).
+lifecycle. Two placements:
+
+- default: a detached local process (fast path for a workstation);
+- ``on_controller=True`` (or config ``jobs.controller.enabled``):
+  the controller runs as a job on a dedicated *controller cluster*
+  (reference ``sky/templates/jobs-controller.yaml.j2``), provisioned
+  on demand and reused across jobs — the controller survives the
+  client machine, and its launches are bounded by the jobs scheduler
+  (jobs/scheduler.py).
 """
 from __future__ import annotations
 
@@ -49,10 +54,93 @@ def _controller_alive(pid: Optional[int]) -> bool:
         return True
 
 
+CONTROLLER_CLUSTER_NAME = 'skytpu-jobs-controller'
+
+# Env vars the controller needs to share the submitting user's state
+# (jobs DB, cluster DB, launch-parallelism override). On a local-cloud
+# controller cluster these point at the same filesystem; a cloud
+# controller VM keeps its own copies rsynced at submission.
+_CONTROLLER_ENV_PASSTHROUGH = (
+    'SKYTPU_JOBS_DB', 'SKYTPU_STATE_DB', 'SKYTPU_DATA_DIR',
+    'SKYTPU_JOBS_LOG_DIR', 'SKYTPU_CONFIG', 'SKYTPU_USER_HASH',
+    'SKYTPU_JOBS_LAUNCH_PARALLELISM',
+)
+
+
+def _controller_resources() -> 'task_lib.Task':
+    """The controller cluster's own (cheap) task, from config
+    ``jobs.controller.resources`` (reference
+    jobs-controller.yaml.j2's resources block)."""
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import skypilot_config
+    cfg = dict(
+        skypilot_config.get_nested(('jobs', 'controller', 'resources'),
+                                   default_value={}) or {})
+    if 'cloud' not in cfg:
+        cfg['cloud'] = 'local'
+    if cfg['cloud'] != 'local':
+        # The controller shares the submitting user's jobs/cluster DBs
+        # through the filesystem (env passthrough below). On a cloud
+        # VM those paths don't exist — a remote controller needs its
+        # own state DB plus a remote queue/cancel path (reference
+        # jobs-controller.yaml.j2 + JobLibCodeGen), which is not built
+        # yet. Fail loudly instead of submitting a controller that
+        # dies on startup.
+        raise exceptions.NotSupportedError(
+            'jobs.controller.resources.cloud must be "local" for now: '
+            'cloud-VM controller state sharing is not implemented.')
+    holder = task_lib.Task('jobs-controller', run='true')
+    holder.set_resources(resources_lib.Resources.from_yaml_config(cfg))
+    return holder
+
+
+def ensure_controller_cluster() -> None:
+    """Provision (or reuse) the controller cluster."""
+    from skypilot_tpu import execution
+    from skypilot_tpu.backend import backend_utils
+    from skypilot_tpu.utils import status_lib
+    record = backend_utils.refresh_cluster_record(
+        CONTROLLER_CLUSTER_NAME)
+    if record is not None and record[
+            'status'] == status_lib.ClusterStatus.UP:
+        return
+    logger.info('Provisioning jobs controller cluster %s.',
+                CONTROLLER_CLUSTER_NAME)
+    execution.launch(_controller_resources(),
+                     cluster_name=CONTROLLER_CLUSTER_NAME,
+                     stream_logs=False)
+
+
+def _submit_to_controller_cluster(job_id: int,
+                                  check_gap: Optional[float]) -> None:
+    from skypilot_tpu import execution
+    ensure_controller_cluster()
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    cmd = (f'python -u -m skypilot_tpu.jobs.controller {job_id}')
+    if check_gap is not None:
+        cmd += f' --check-gap {check_gap}'
+    envs = {'PYTHONPATH': repo_root}
+    for key in _CONTROLLER_ENV_PASSTHROUGH:
+        if os.environ.get(key):
+            envs[key] = os.environ[key]
+    controller_task = task_lib.Task(f'jobs-ctl-{job_id}', run=cmd,
+                                    envs=envs)
+    cluster_job_id, _ = execution.exec_(controller_task,
+                                        CONTROLLER_CLUSTER_NAME,
+                                        detach_run=True)
+    state.set_controller_job(job_id, cluster_job_id)
+    logger.info(
+        'Managed job %d controller submitted to cluster %s (job %s).',
+        job_id, CONTROLLER_CLUSTER_NAME, cluster_job_id)
+
+
 def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
            name: Optional[str] = None,
            *,
            detach: bool = True,
+           on_controller: Optional[bool] = None,
            controller_check_gap: Optional[float] = None) -> int:
     """Submit a managed job; returns the managed job id."""
     if isinstance(entrypoint, dag_lib.Dag):
@@ -75,6 +163,15 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
     log_path = os.path.join(log_dir, f'{job_id}-{job_name}.log')
     state.set_log_path(job_id, log_path)
     state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+
+    if on_controller is None:
+        from skypilot_tpu import skypilot_config
+        on_controller = bool(
+            skypilot_config.get_nested(('jobs', 'controller', 'enabled'),
+                                       default_value=False))
+    if on_controller:
+        _submit_to_controller_cluster(job_id, controller_check_gap)
+        return job_id
 
     cmd = [
         sys.executable, '-u', '-m', 'skypilot_tpu.jobs.controller',
@@ -108,15 +205,43 @@ def queue(refresh: bool = True) -> List[Dict[str, Any]]:
     jobs = state.get_jobs()
     if refresh:
         for job in jobs:
-            if (not job['status'].is_terminal() and
-                    job['status'] != state.ManagedJobStatus.PENDING and
-                    not _controller_alive(job['controller_pid'])):
-                state.set_status(
-                    job['job_id'],
-                    state.ManagedJobStatus.FAILED_CONTROLLER,
-                    failure_reason='controller process died')
-                job['status'] = state.ManagedJobStatus.FAILED_CONTROLLER
+            if job['status'].is_terminal() or (
+                    job['status'] == state.ManagedJobStatus.PENDING):
+                continue
+            if (job.get('controller_job_id') is not None and
+                    not job['controller_pid']):
+                # Controller-cluster placement, controller pid not
+                # recorded yet. Not necessarily alive: ask the agent
+                # whether the controller's own job already died (e.g.
+                # startup crash before set_controller_pid).
+                if _controller_cluster_job_dead(
+                        job['controller_job_id']):
+                    _mark_controller_dead(job)
+                continue
+            if not _controller_alive(job['controller_pid']):
+                _mark_controller_dead(job)
     return jobs
+
+
+def _mark_controller_dead(job: Dict[str, Any]) -> None:
+    state.set_status(job['job_id'],
+                     state.ManagedJobStatus.FAILED_CONTROLLER,
+                     failure_reason='controller process died')
+    # Release any leaked launch slot so the scheduler can't deadlock
+    # on rows whose controller will never call finish_launch.
+    state.set_schedule_state(job['job_id'], 'DONE')
+    job['status'] = state.ManagedJobStatus.FAILED_CONTROLLER
+
+
+def _controller_cluster_job_dead(controller_job_id: int) -> bool:
+    from skypilot_tpu import core as sky_core
+    try:
+        statuses = sky_core.job_status(CONTROLLER_CLUSTER_NAME,
+                                       [controller_job_id])
+        status = statuses.get(controller_job_id)
+    except Exception:  # pylint: disable=broad-except
+        return False  # can't tell; don't false-positive
+    return status is not None and status.is_terminal()
 
 
 def cancel(job_ids: Optional[List[int]] = None,

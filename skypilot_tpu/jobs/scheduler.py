@@ -1,0 +1,90 @@
+"""Managed-jobs launch scheduler: bound concurrent provisioning.
+
+Re-design of reference ``sky/jobs/scheduler.py:80-277``
+(maybe_schedule_next_jobs / submit_job / _get_launch_parallelism):
+every controller launch or recovery must hold a *launch slot* before
+calling ``execution.launch``. Slots bound how many provisioning
+attempts run at once on the controller machine — each one spawns SSH
+fan-outs and cloud API polling, so an unbounded burst of submissions
+would thrash the controller. Monitoring (the ALIVE phase) is cheap
+and unbounded.
+
+The slot ledger is the jobs DB itself (``schedule_state`` column,
+claimed with one BEGIN IMMEDIATE transaction), so it works no matter
+which process each controller runs in — the same property the
+reference gets from its file lock + state table.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_PARALLELISM_ENV = 'SKYTPU_JOBS_LAUNCH_PARALLELISM'
+
+# States: INACTIVE -> WAITING -> LAUNCHING -> ALIVE -> DONE.
+WAITING = 'WAITING'
+LAUNCHING = 'LAUNCHING'
+ALIVE = 'ALIVE'
+DONE = 'DONE'
+
+
+def launch_parallelism() -> int:
+    """Max concurrent launches (reference _get_launch_parallelism
+    :277 uses a CPU heuristic: each in-flight launch budgets ~4 CPUs;
+    we floor at 4 so small controllers still make progress)."""
+    override = os.environ.get(_PARALLELISM_ENV)
+    if override:
+        return max(1, int(override))
+    return max(4, (os.cpu_count() or 4))
+
+
+def _sweep_dead_launchers() -> None:
+    """Release slots held by controllers that died mid-launch (SIGKILL
+    / OOM / reboot skip the releasing ``finally``); without this, dead
+    LAUNCHING rows would count against the limit forever and
+    eventually deadlock all launches."""
+    for job in state.get_jobs():
+        if job.get('schedule_state') != LAUNCHING:
+            continue
+        pid = job.get('controller_pid')
+        if not pid:
+            continue
+        try:
+            os.kill(pid, 0)
+        except (OSError, ProcessLookupError):
+            logger.warning(
+                'Managed job %d: controller %d died holding a launch '
+                'slot; releasing.', job['job_id'], pid)
+            state.set_schedule_state(job['job_id'], DONE)
+
+
+def wait_for_launch_slot(job_id: int,
+                         poll_seconds: float = 0.5,
+                         timeout: Optional[float] = None) -> None:
+    """Block until this job holds a launch slot."""
+    state.set_schedule_state(job_id, WAITING)
+    limit = launch_parallelism()
+    deadline = None if timeout is None else time.time() + timeout
+    while not state.try_acquire_launch_slot(job_id, limit):
+        _sweep_dead_launchers()
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f'Managed job {job_id} waited {timeout}s for a launch '
+                f'slot ({limit} parallel launches).')
+        time.sleep(poll_seconds)
+
+
+def finish_launch(job_id: int) -> None:
+    """Launch done (success or failure): release the slot, keep the
+    job accounted as ALIVE until the controller exits."""
+    state.set_schedule_state(job_id, ALIVE)
+
+
+def job_done(job_id: int) -> None:
+    state.set_schedule_state(job_id, DONE)

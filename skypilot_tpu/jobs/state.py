@@ -45,8 +45,15 @@ def _conn() -> sqlite3.Connection:
             controller_pid INTEGER,
             cancel_requested INTEGER DEFAULT 0,
             log_path TEXT,
-            dag_json TEXT
+            dag_json TEXT,
+            schedule_state TEXT DEFAULT 'INACTIVE'
         )""")
+    for decl in ("schedule_state TEXT DEFAULT 'INACTIVE'",
+                 'controller_job_id INTEGER'):
+        try:
+            conn.execute(f'ALTER TABLE jobs ADD COLUMN {decl}')
+        except sqlite3.OperationalError:
+            pass  # already present
     return conn
 
 
@@ -81,10 +88,58 @@ def set_status(job_id: int, status: ManagedJobStatus,
                      args)
 
 
+def set_schedule_state(job_id: int, schedule_state: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE jobs SET schedule_state = ? WHERE job_id = ?',
+            (schedule_state, job_id))
+
+
+def try_acquire_launch_slot(job_id: int, limit: int) -> bool:
+    """Atomically move this job to LAUNCHING iff fewer than ``limit``
+    jobs are launching (the scheduler's one transactional primitive —
+    reference sky/jobs/scheduler.py:80 does the equivalent count under
+    a file lock)."""
+    conn = _conn()
+    try:
+        conn.execute('BEGIN IMMEDIATE')
+        row = conn.execute(
+            "SELECT COUNT(*) AS n FROM jobs "
+            "WHERE schedule_state = 'LAUNCHING'").fetchone()
+        if row['n'] >= limit:
+            conn.rollback()
+            return False
+        conn.execute(
+            "UPDATE jobs SET schedule_state = 'LAUNCHING' "
+            'WHERE job_id = ?', (job_id,))
+        conn.commit()
+        return True
+    finally:
+        conn.close()
+
+
+def count_schedule_state(schedule_state: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT COUNT(*) AS n FROM jobs WHERE schedule_state = ?',
+            (schedule_state,)).fetchone()
+        return int(row['n'])
+
+
 def set_log_path(job_id: int, log_path: str) -> None:
     with _conn() as conn:
         conn.execute('UPDATE jobs SET log_path = ? WHERE job_id = ?',
                      (log_path, job_id))
+
+
+def set_controller_job(job_id: int,
+                       cluster_job_id: Optional[int]) -> None:
+    """Agent-job id of the controller on the controller cluster
+    (controller-cluster placement only)."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE jobs SET controller_job_id = ? WHERE job_id = ?',
+            (cluster_job_id, job_id))
 
 
 def set_controller_pid(job_id: int, pid: int) -> None:

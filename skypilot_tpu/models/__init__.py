@@ -7,8 +7,8 @@ Llama implementation whose forward/train step is pjit-shardable over a
 (dp, fsdp, sp, tp) mesh, using the Pallas flash-attention kernel on TPU
 and ring attention for long-context sequence parallelism.
 """
-from skypilot_tpu.models.inference import (decode_step, generate,
-                                           init_cache, prefill)
+from skypilot_tpu.models.inference import (cache_specs, decode_step,
+                                           generate, prefill)
 from skypilot_tpu.models.llama import (LlamaConfig, forward, init_params,
                                        loss_fn, param_specs)
 from skypilot_tpu.models.train import (TrainState, init_train_state,
@@ -19,5 +19,5 @@ __all__ = [
     'LlamaConfig', 'forward', 'init_params', 'loss_fn', 'param_specs',
     'TrainState', 'init_train_state', 'make_eval_step', 'make_optimizer',
     'make_train_step', 'shard_batch',
-    'decode_step', 'generate', 'init_cache', 'prefill',
+    'cache_specs', 'decode_step', 'generate', 'prefill',
 ]

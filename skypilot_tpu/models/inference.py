@@ -53,28 +53,15 @@ def cache_specs() -> Dict:
             'length': P(('dp', 'fsdp')), 'base': P(), 'steps': P()}
 
 
-def init_cache(cfg: LlamaConfig, batch: int,
-               max_seq: Optional[int] = None) -> Dict:
-    """Preallocated KV cache for ``batch`` sequences.
-
-    Slot layout (the key to fast TPU decode): prompts occupy slots
-    ``0..base-1`` (``base`` = padded prompt length; rows shorter than
-    ``base`` leave garbage in their tail slots, masked at read), and
-    decode step ``i`` writes slot ``base + i`` for EVERY row. The
-    write index is therefore a traced *scalar*, so the cache update
-    is a ``dynamic_update_slice`` XLA performs in place on the loop
-    carry — no scatter, no full-cache rewrite. Per-row raggedness
-    lives entirely in the validity mask and the RoPE positions.
-    """
-    s = max_seq or cfg.max_seq
-    shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
-    return {
-        'k': jnp.zeros(shape, cfg.compute_dtype),
-        'v': jnp.zeros(shape, cfg.compute_dtype),
-        'length': jnp.zeros((batch,), jnp.int32),
-        'base': jnp.zeros((), jnp.int32),
-        'steps': jnp.zeros((), jnp.int32),
-    }
+# Cache slot layout (the key to fast TPU decode): prompts occupy
+# slots 0..base-1 (base = padded prompt length; rows shorter than
+# base leave garbage in their tail slots, masked at read), and decode
+# step i writes slot base+i for EVERY row. The write index is
+# therefore a traced *scalar*, so the cache update is a
+# dynamic_update_slice XLA performs in place on the loop carry — no
+# scatter, no full-cache rewrite. Per-row raggedness lives entirely
+# in the validity mask and the RoPE positions. ``prefill`` is the
+# only constructor of this pytree.
 
 
 def _constrain(x, spec, mesh):

@@ -170,7 +170,9 @@ def _expand_region_zones(
     """
     out = []
     for region in cloud.regions_with_offering(launchable):
-        if launchable.is_tpu or launchable.use_spot:
+        if (launchable.is_tpu or launchable.use_spot) and region.zones:
+            # Zoneless regions (e.g. a Kubernetes context) fall through
+            # to region-level candidates even for TPUs.
             for zone in region.zones:
                 out.append(launchable.copy(region=region.name, zone=zone))
         else:

@@ -1,0 +1,1 @@
+"""Kubernetes provision plugin (pods-as-hosts, GKE TPU slices)."""

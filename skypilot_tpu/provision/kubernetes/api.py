@@ -1,0 +1,218 @@
+"""Minimal Kubernetes REST client driven from kubeconfig.
+
+Re-design of the reference's Kubernetes access
+(``sky/adaptors/kubernetes.py`` + ``sky/provision/kubernetes/``): the
+reference lazy-imports the official ``kubernetes`` client library;
+here the API surface we need (pods + nodes in one namespace) is small
+enough to drive with plain ``requests`` against the API server from a
+parsed kubeconfig — no client library, and the same fake-session test
+seam as the GCP plugin (``provision/gcp/api.py``).
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_NAMESPACE = 'default'
+
+
+@dataclasses.dataclass
+class KubeContext:
+    """Connection info resolved from one kubeconfig context."""
+    context_name: str
+    server: str
+    namespace: str = DEFAULT_NAMESPACE
+    token: Optional[str] = None
+    # Paths (possibly materialized from inline base64 data).
+    ca_cert: Optional[str] = None
+    client_cert: Optional[str] = None
+    client_key: Optional[str] = None
+    insecure: bool = False
+
+
+def kubeconfig_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('KUBECONFIG', '~/.kube/config'))
+
+
+def _materialize(data_b64: Optional[str],
+                 path: Optional[str]) -> Optional[str]:
+    """kubeconfig allows certs inline (-data) or as file paths."""
+    if path:
+        return os.path.expanduser(path)
+    if data_b64:
+        f = tempfile.NamedTemporaryFile(delete=False, suffix='.pem')
+        f.write(base64.b64decode(data_b64))
+        f.close()
+        return f.name
+    return None
+
+
+def load_kubeconfig(context: Optional[str] = None) -> KubeContext:
+    """Parse kubeconfig and resolve one context to connection info."""
+    import yaml
+    path = kubeconfig_path()
+    if not os.path.exists(path):
+        raise exceptions.ProvisionError(
+            f'No kubeconfig at {path}; set KUBECONFIG or create '
+            '~/.kube/config.')
+    with open(path, encoding='utf-8') as f:
+        cfg = yaml.safe_load(f) or {}
+    ctx_name = context or cfg.get('current-context')
+    if not ctx_name:
+        raise exceptions.ProvisionError(
+            f'kubeconfig {path} has no current-context.')
+    by_name = lambda items: {i['name']: i for i in (items or [])}
+    contexts = by_name(cfg.get('contexts'))
+    clusters = by_name(cfg.get('clusters'))
+    users = by_name(cfg.get('users'))
+    if ctx_name not in contexts:
+        raise exceptions.ProvisionError(
+            f'Context {ctx_name!r} not in kubeconfig {path}.')
+    ctx = contexts[ctx_name]['context']
+    cluster = clusters.get(ctx.get('cluster'), {}).get('cluster', {})
+    user = users.get(ctx.get('user'), {}).get('user', {})
+    token = user.get('token')
+    return KubeContext(
+        context_name=ctx_name,
+        server=cluster.get('server', ''),
+        namespace=ctx.get('namespace') or DEFAULT_NAMESPACE,
+        token=token,
+        ca_cert=_materialize(cluster.get('certificate-authority-data'),
+                             cluster.get('certificate-authority')),
+        client_cert=_materialize(user.get('client-certificate-data'),
+                                 user.get('client-certificate')),
+        client_key=_materialize(user.get('client-key-data'),
+                                user.get('client-key')),
+        insecure=bool(cluster.get('insecure-skip-tls-verify')),
+    )
+
+
+def _session_factory(ctx: KubeContext):
+    import requests
+    session = requests.Session()
+    if ctx.token:
+        session.headers['Authorization'] = f'Bearer {ctx.token}'
+    if ctx.client_cert and ctx.client_key:
+        session.cert = (ctx.client_cert, ctx.client_key)
+    if ctx.insecure:
+        session.verify = False
+    elif ctx.ca_cert:
+        session.verify = ctx.ca_cert
+    return session
+
+
+# Test seam: tests replace this with a fake session maker.
+session_factory: Callable = _session_factory
+
+
+def translate_error(status_code: int, body: Dict[str, Any],
+                    what: str) -> exceptions.ProvisionError:
+    """Map a Kubernetes Status error onto typed provision errors.
+
+    Unschedulable / exhausted-quota surface as stockout/quota so the
+    failover provisioner blocks the right granularity (same taxonomy
+    as provision/gcp/api.py translate_error).
+    """
+    message = str(body.get('message', body)) if isinstance(
+        body, dict) else str(body)
+    low = message.lower()
+    if status_code == 403 and 'exceeded quota' in low:
+        return exceptions.QuotaExceededError(f'{what}: {message}')
+    if 'insufficient' in low or 'unschedulable' in low:
+        return exceptions.StockoutError(f'{what}: {message}')
+    return exceptions.ProvisionError(
+        f'{what}: HTTP {status_code}: {message}')
+
+
+class KubeClient:
+    """Pods/nodes operations in one namespace."""
+
+    def __init__(self, context: Optional[str] = None) -> None:
+        self.ctx = load_kubeconfig(context)
+        self._session = None
+
+    @property
+    def session(self):
+        if self._session is None:
+            self._session = session_factory(self.ctx)
+        return self._session
+
+    @property
+    def namespace(self) -> str:
+        return self.ctx.namespace
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict] = None,
+                 params: Optional[Dict] = None,
+                 what: str = 'kubernetes api'
+                 ) -> Tuple[int, Dict[str, Any]]:
+        url = self.ctx.server.rstrip('/') + path
+        resp = self.session.request(method, url, json=body,
+                                    params=params)
+        try:
+            payload = resp.json()
+        except (ValueError, json.JSONDecodeError):
+            payload = {'message': resp.text}
+        return resp.status_code, payload
+
+    def _check(self, status: int, body: Dict[str, Any],
+               what: str, ok_missing: bool = False) -> Dict[str, Any]:
+        if status == 404 and ok_missing:
+            return {}
+        if status >= 400:
+            raise translate_error(status, body, what)
+        return body
+
+    # ------------------------------------------------------------ pods
+    def _pods_path(self, name: str = '') -> str:
+        base = f'/api/v1/namespaces/{self.namespace}/pods'
+        return f'{base}/{name}' if name else base
+
+    def create_pod(self, manifest: Dict[str, Any]) -> Dict[str, Any]:
+        status, body = self._request('POST', self._pods_path(),
+                                     body=manifest)
+        if status == 409:  # already exists — idempotent create
+            return self.get_pod(manifest['metadata']['name'])
+        return self._check(status, body,
+                           f"create pod {manifest['metadata']['name']}")
+
+    def get_pod(self, name: str) -> Optional[Dict[str, Any]]:
+        status, body = self._request('GET', self._pods_path(name))
+        if status == 404:
+            return None
+        return self._check(status, body, f'get pod {name}')
+
+    def list_pods(self, label_selector: str) -> List[Dict[str, Any]]:
+        status, body = self._request(
+            'GET', self._pods_path(),
+            params={'labelSelector': label_selector})
+        body = self._check(status, body, 'list pods')
+        return body.get('items', [])
+
+    def delete_pod(self, name: str) -> None:
+        status, body = self._request('DELETE', self._pods_path(name))
+        self._check(status, body, f'delete pod {name}',
+                    ok_missing=True)
+
+    # ----------------------------------------------------------- nodes
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        status, body = self._request('GET', '/api/v1/nodes')
+        body = self._check(status, body, 'list nodes')
+        return body.get('items', [])
+
+    def healthz(self) -> bool:
+        try:
+            status, _ = self._request('GET', '/readyz')
+            return status < 400
+        except Exception:  # pylint: disable=broad-except
+            return False

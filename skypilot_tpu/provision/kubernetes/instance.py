@@ -1,0 +1,270 @@
+"""Kubernetes provision ops: pods-as-hosts, GKE TPU slices.
+
+Re-design of reference ``sky/provision/kubernetes/instance.py`` (pods
+as nodes) + GKE TPU label handling from
+``sky/provision/kubernetes/utils.py`` (GKELabelFormatter): every host
+of a cluster is a pod labeled with the cluster name and host index;
+TPU slice hosts add GKE's node selectors
+(``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``) and
+request ``google.com/tpu`` chips. Ops are stateless: the label
+selector against the API server is the source of truth.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_LABEL = 'skypilot-tpu/cluster'
+_ROLE_LABEL = 'skypilot-tpu/role'
+_HOST_INDEX_LABEL = 'skypilot-tpu/host-index'
+
+# GKE node selectors for TPU slices (reference
+# sky/provision/kubernetes/utils.py GKELabelFormatter).
+GKE_TPU_ACCEL_LABEL = 'cloud.google.com/gke-tpu-accelerator'
+GKE_TPU_TOPO_LABEL = 'cloud.google.com/gke-tpu-topology'
+TPU_RESOURCE = 'google.com/tpu'
+
+# generation -> GKE accelerator label value (GKE docs; reference
+# utils.py GKE_TPU_ACCELERATOR_TO_GENERATION inverse).
+GKE_TPU_ACCELERATORS = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+DEFAULT_IMAGE = 'python:3.11-slim'
+
+_WAIT_TIMEOUT = 1200.0
+_POLL_INTERVAL = 5.0
+
+
+def _client(context: Optional[str] = None) -> api.KubeClient:
+    return api.KubeClient(context)
+
+
+def _pod_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}' if idx else f'{cluster}-head'
+
+
+def _selector(cluster: str) -> str:
+    return f'{_CLUSTER_LABEL}={cluster}'
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    """No networks/firewalls to set up: pod-to-pod traffic is open
+    inside a cluster; ports_to_open is a no-op (reference exposes
+    services via ingress, out of scope for the compute path)."""
+    return config
+
+
+def _pod_manifest(config: common.ProvisionConfig, name: str,
+                  idx: int) -> Dict[str, Any]:
+    node = config.node_config
+    labels = {
+        _CLUSTER_LABEL: config.cluster_name_on_cloud,
+        _ROLE_LABEL: 'head' if idx == 0 else 'worker',
+        _HOST_INDEX_LABEL: str(idx),
+    }
+    labels.update(node.get('labels') or {})
+    resources: Dict[str, Any] = {}
+    if node.get('cpus'):
+        resources['cpu'] = str(node['cpus'])
+    if node.get('memory'):
+        resources['memory'] = f"{node['memory']}Gi"
+    container: Dict[str, Any] = {
+        'name': 'skytpu',
+        'image': node.get('image_id') or DEFAULT_IMAGE,
+        'command': ['/bin/sh', '-c', 'sleep infinity'],
+    }
+    spec: Dict[str, Any] = {
+        'restartPolicy': 'Never',
+        'containers': [container],
+    }
+    if node.get('tpu_vm'):
+        # GKE TPU slice: schedule onto the right podslice node pool
+        # and claim this host's chips. GKE's device plugin wires the
+        # slice topology env (TPU_WORKER_ID etc.) from these.
+        spec['nodeSelector'] = {
+            GKE_TPU_ACCEL_LABEL: node['gke_accelerator'],
+            GKE_TPU_TOPO_LABEL: node['tpu_topology'],
+        }
+        resources[TPU_RESOURCE] = str(node['chips_per_host'])
+        container['env'] = [
+            {'name': 'TPU_WORKER_ID', 'value': str(idx)},
+        ]
+    if resources:
+        container['resources'] = {'requests': dict(resources),
+                                  'limits': dict(resources)}
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {'name': name, 'labels': labels},
+        'spec': spec,
+    }
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create missing pods up to count*num_hosts. Idempotent."""
+    client = _client(config.node_config.get('context'))
+    cluster = config.cluster_name_on_cloud
+    num_hosts = int(config.node_config.get('num_hosts') or 1)
+    want = config.count * num_hosts
+    existing = {
+        p['metadata']['name']: p
+        for p in client.list_pods(_selector(cluster))
+        if p.get('metadata', {}).get('deletionTimestamp') is None
+    }
+    created: List[str] = []
+    for idx in range(want):
+        name = _pod_name(cluster, idx)
+        if name in existing:
+            phase = existing[name].get('status', {}).get('phase')
+            if phase in ('Succeeded', 'Failed'):
+                client.delete_pod(name)
+            else:
+                continue
+        client.create_pod(_pod_manifest(config, name, idx))
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='kubernetes',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        head_instance_id=_pod_name(cluster, 0),
+    )
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    client = _client()
+    deadline = time.time() + _WAIT_TIMEOUT
+    want_gone = state in (None, 'terminated')
+    while time.time() < deadline:
+        pods = client.list_pods(_selector(cluster_name_on_cloud))
+        if state == 'running':
+            bad = [
+                p for p in pods
+                if p.get('status', {}).get('phase') != 'Running'
+            ]
+            if pods and not bad:
+                return
+            # A pod the scheduler cannot place is a capacity signal —
+            # surface it as stockout for the failover provisioner.
+            for p in bad:
+                for cond in p.get('status', {}).get('conditions', []):
+                    if (cond.get('reason') == 'Unschedulable' and
+                            'Insufficient' in str(cond.get('message'))):
+                        raise exceptions.StockoutError(
+                            f"pod {p['metadata']['name']}: "
+                            f"{cond.get('message')}")
+        elif want_gone and not pods:
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} pods to reach '
+        f'{state!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    """pod name -> 'running'|'pending'|'terminated' (pods never
+    'stop': no STOP support on kubernetes)."""
+    del region, zone
+    client = _client()
+    out: Dict[str, Optional[str]] = {}
+    for pod in client.list_pods(_selector(cluster_name_on_cloud)):
+        phase = pod.get('status', {}).get('phase', '')
+        if pod.get('metadata', {}).get('deletionTimestamp') is not None:
+            status = 'terminated'
+        elif phase == 'Running':
+            status = 'running'
+        elif phase == 'Pending':
+            status = 'pending'
+        else:  # Succeeded / Failed / Unknown
+            status = 'terminated'
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[pod['metadata']['name']] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    client = _client()
+    pods = client.list_pods(_selector(cluster_name_on_cloud))
+    instances: Dict[str, List[common.InstanceInfo]] = {}
+    head_id = None
+    for pod in sorted(
+            pods,
+            key=lambda p: int(p['metadata'].get('labels', {}).get(
+                _HOST_INDEX_LABEL, 0))):
+        meta = pod['metadata']
+        name = meta['name']
+        if meta.get('labels', {}).get(_ROLE_LABEL) == 'head':
+            head_id = name
+        instances[name] = [
+            common.InstanceInfo(
+                instance_id=name,
+                internal_ip=pod.get('status', {}).get('podIP', ''),
+                external_ip=None,
+                host_index=0,
+                tags={
+                    # Host-entry routing: command runner goes through
+                    # kubectl exec, not ssh (no sshd in the pods).
+                    'k8s_pod': name,
+                    'k8s_namespace': client.namespace,
+                    'k8s_context': client.ctx.context_name,
+                },
+            )
+        ]
+    return common.ClusterInfo(
+        provider_name='kubernetes',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=instances,
+        head_instance_id=head_id,
+        ssh_user='root',
+        provider_config={'namespace': client.namespace},
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    raise exceptions.NotSupportedError(
+        'Kubernetes pods cannot be stopped, only terminated '
+        '(the cloud layer declares STOP unsupported).')
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    client = _client()
+    for pod in client.list_pods(_selector(cluster_name_on_cloud)):
+        client.delete_pod(pod['metadata']['name'])
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    """Pod-to-pod traffic is open in-cluster; external exposure would
+    be a Service/Ingress (reference parity gap, tracked)."""
+    logger.info('kubernetes: open_ports(%s) is a no-op in-cluster.',
+                ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

@@ -56,6 +56,15 @@ def host_entries(cluster_info: common.ClusterInfo,
                 'ip': host.get_feasible_ip(),
                 'host_dir': host_dir,
             })
+        elif host.tags.get('k8s_pod') is not None:
+            entries.append({
+                'kind': 'k8s',
+                'host_id': f'{host.instance_id}-h{host.host_index}',
+                'ip': host.get_feasible_ip(),
+                'pod': host.tags['k8s_pod'],
+                'namespace': host.tags.get('k8s_namespace', 'default'),
+                'context': host.tags.get('k8s_context'),
+            })
         else:
             entries.append({
                 'kind': 'ssh',

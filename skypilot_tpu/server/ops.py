@@ -96,7 +96,8 @@ def _job_status(body: Dict[str, Any]) -> Any:
 def _jobs_launch(body: Dict[str, Any]) -> Any:
     from skypilot_tpu.jobs import core as jobs_core
     job_id = jobs_core.launch(_task_from_body(body),
-                              name=body.get('name'))
+                              name=body.get('name'),
+                              on_controller=body.get('on_controller'))
     return {'managed_job_id': job_id}
 
 

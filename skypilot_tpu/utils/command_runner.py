@@ -197,6 +197,12 @@ def runner_from_host_entry(entry: Dict) -> CommandRunner:
     kind = entry.get('kind', 'ssh')
     if kind == 'local':
         return LocalProcessRunner(entry['host_id'], entry['host_dir'])
+    if kind == 'k8s':
+        return KubernetesCommandRunner(
+            namespace=entry['namespace'],
+            pod=entry['pod'],
+            context=entry.get('context'),
+        )
     return SSHCommandRunner(
         ip=entry['ip'],
         ssh_user=entry['user'],
@@ -295,3 +301,116 @@ class SSHCommandRunner(CommandRunner):
         if rc != 0:
             raise exceptions.CommandError(
                 rc, ' '.join(rsync_cmd), f'rsync failed; see {log_path}')
+
+
+class KubernetesCommandRunner(CommandRunner):
+    """kubectl-exec against a pod (reference
+    sky/utils/command_runner.py:711 KubernetesCommandRunner): pods run
+    no sshd, so commands go through the API server's exec channel and
+    file sync through a tar pipe."""
+
+    def __init__(self, namespace: str, pod: str,
+                 context: Optional[str] = None,
+                 container: str = 'skytpu') -> None:
+        super().__init__(f'{namespace}/{pod}', pod)
+        self.namespace = namespace
+        self.pod = pod
+        self.context = context
+        self.container = container
+
+    def _kubectl(self, *args: str, stdin_flag: bool = False) -> List[str]:
+        cmd = ['kubectl']
+        if self.context:
+            cmd += ['--context', self.context]
+        cmd += ['-n', self.namespace, 'exec']
+        if stdin_flag:
+            cmd += ['-i']
+        cmd += [self.pod, '-c', self.container, '--']
+        cmd += list(args)
+        return cmd
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env: Optional[Dict[str, str]] = None,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            cwd: Optional[str] = None,
+            check: bool = False,
+            line_processor=None) -> Union[int, Tuple[int, str, str]]:
+        script = _as_script(cmd)
+        if env:
+            exports = '; '.join(
+                f'export {k}={shlex.quote(v)}' for k, v in env.items())
+            script = f'{exports}; {script}'
+        if cwd:
+            script = f'cd {shell_path(cwd)} && {script}'
+        full_cmd = self._kubectl('/bin/sh', '-c', script)
+        if require_outputs:
+            proc = subprocess.run(full_cmd, capture_output=True,
+                                  text=True, check=False)
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+            self._maybe_raise(check, proc.returncode, script, proc.stderr)
+            return proc.returncode, proc.stdout, proc.stderr
+        rc = subprocess_utils.run_with_log(full_cmd, log_path,
+                                           stream_logs=stream_logs,
+                                           shell=False,
+                                           line_processor=line_processor)
+        self._maybe_raise(check, rc, script)
+        return rc
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        """tar-over-exec (no rsync binary needed in the image)."""
+        if up:
+            src_dir = os.path.dirname(os.path.abspath(source)) or '/'
+            base = os.path.basename(source.rstrip('/'))
+            if source.endswith('/'):
+                # contents-into-target semantics
+                src_dir, base = os.path.abspath(source), '.'
+            pack = subprocess.Popen(
+                ['tar', 'cf', '-', '--exclude', '.git', '-C', src_dir,
+                 base],
+                stdout=subprocess.PIPE)
+            unpack = self._kubectl(
+                '/bin/sh', '-c',
+                f'mkdir -p {shell_path(target)} && '
+                f'tar xf - -C {shell_path(target)}',
+                stdin_flag=True)
+            proc = subprocess.run(unpack, stdin=pack.stdout,
+                                  capture_output=True, check=False)
+            pack.stdout.close()
+            pack.wait()
+            rc = proc.returncode or pack.returncode
+        else:
+            src_dir = os.path.dirname(source.rstrip('/')) or '/'
+            base = os.path.basename(source.rstrip('/'))
+            pack = self._kubectl(
+                '/bin/sh', '-c',
+                f'tar cf - -C {shell_path(src_dir)} {shell_path(base)}')
+            os.makedirs(os.path.expanduser(target), exist_ok=True)
+            p1 = subprocess.Popen(pack, stdout=subprocess.PIPE)
+            proc = subprocess.run(
+                ['tar', 'xf', '-', '-C', os.path.expanduser(target)],
+                stdin=p1.stdout, capture_output=True, check=False)
+            p1.stdout.close()
+            p1.wait()
+            rc = proc.returncode or p1.returncode
+        if rc != 0:
+            stderr = (proc.stderr or b'').decode(errors='replace')
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                f.write(stderr)
+            raise exceptions.CommandError(
+                rc, f'k8s rsync {source} -> {target}',
+                f'tar-over-exec failed: {stderr[-500:]}')
+
+    def check_connection(self) -> bool:
+        try:
+            return self.run('true') == 0
+        except Exception:  # pylint: disable=broad-except
+            return False

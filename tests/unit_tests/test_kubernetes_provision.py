@@ -1,0 +1,323 @@
+"""Kubernetes provider tests with mocked HTTP (no cluster access).
+
+Mirrors tests/unit_tests/test_gcp_provision.py: a fake session plays
+the API server; tests cover the pod lifecycle contract, GKE TPU slice
+labels, host-entry routing to kubectl-exec runners, the error
+taxonomy, and the cloud layer (credentials, feasibility, optimizer
+choosing kubernetes when it is the only enabled cloud).
+"""
+import json
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.provision.kubernetes import api
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+
+KUBECONFIG = """
+apiVersion: v1
+kind: Config
+current-context: gke_test
+contexts:
+- name: gke_test
+  context:
+    cluster: gke-cluster
+    user: gke-user
+    namespace: mlteam
+clusters:
+- name: gke-cluster
+  cluster:
+    server: https://kube.test:6443
+    insecure-skip-tls-verify: true
+users:
+- name: gke-user
+  user:
+    token: test-token
+"""
+
+
+class FakeResp:
+
+    def __init__(self, status, body):
+        self.status_code = status
+        self._body = body
+        self.text = json.dumps(body)
+
+    def json(self):
+        return self._body
+
+
+class FakeSession:
+
+    def __init__(self, handler):
+        self.handler = handler
+        self.calls = []
+
+    def request(self, method, url, json=None, params=None):
+        self.calls.append((method, url, json, params))
+        return FakeResp(*self.handler(method, url, json, params))
+
+
+@pytest.fixture
+def k8s_env(tmp_path, monkeypatch):
+    cfg = tmp_path / 'kubeconfig'
+    cfg.write_text(KUBECONFIG)
+    monkeypatch.setenv('KUBECONFIG', str(cfg))
+    monkeypatch.setattr(api, '_session_factory',
+                        lambda ctx: (_ for _ in ()).throw(
+                            AssertionError('install a fake session')))
+    monkeypatch.setattr(k8s_instance, '_POLL_INTERVAL', 0.0)
+    monkeypatch.setattr('time.sleep', lambda s: None)
+
+    def install(handler):
+        session = FakeSession(handler)
+        monkeypatch.setattr(api, 'session_factory',
+                            lambda ctx: session)
+        return session
+
+    return install
+
+
+def _pod(name, phase='Running', ip='10.0.0.1', labels=None,
+         conditions=None, deleting=False):
+    meta = {'name': name, 'labels': labels or {}}
+    if deleting:
+        meta['deletionTimestamp'] = '2026-01-01T00:00:00Z'
+    status = {'phase': phase, 'podIP': ip}
+    if conditions:
+        status['conditions'] = conditions
+    return {'metadata': meta, 'status': status}
+
+
+def _tpu_config(count=1, accel='tpu-v5e-16'):
+    from skypilot_tpu.clouds import Kubernetes
+    from skypilot_tpu.resources import Resources
+    res = Resources(cloud='kubernetes', accelerators=accel)
+    node_config = Kubernetes().make_deploy_resources_variables(
+        res, 'svc-a', 'gke_test', None)
+    return common.ProvisionConfig(
+        provider_name='kubernetes',
+        cluster_name='svc-a',
+        cluster_name_on_cloud='svc-a',
+        region='gke_test',
+        zone=None,
+        node_config=node_config,
+        count=count,
+    )
+
+
+# ---------------------------------------------------------------- api
+
+
+def test_kubeconfig_parsing(k8s_env):
+    ctx = api.load_kubeconfig()
+    assert ctx.context_name == 'gke_test'
+    assert ctx.server == 'https://kube.test:6443'
+    assert ctx.namespace == 'mlteam'
+    assert ctx.token == 'test-token'
+    assert ctx.insecure
+
+
+def test_error_taxonomy():
+    err = api.translate_error(
+        403, {'message': 'pods "x" is forbidden: exceeded quota'},
+        'create')
+    assert isinstance(err, exceptions.QuotaExceededError)
+    err = api.translate_error(
+        500, {'message': '0/3 nodes available: Insufficient '
+              'google.com/tpu — unschedulable'}, 'wait')
+    assert isinstance(err, exceptions.StockoutError)
+    err = api.translate_error(404, {'message': 'nope'}, 'get')
+    assert isinstance(err, exceptions.ProvisionError)
+
+
+# ----------------------------------------------------------- lifecycle
+
+
+def test_run_instances_creates_gke_tpu_pods(k8s_env):
+    created = []
+
+    def handler(method, url, body, params):
+        if method == 'GET' and url.endswith('/pods'):
+            return 200, {'items': []}
+        if method == 'POST' and url.endswith('/pods'):
+            created.append(body)
+            return 201, body
+        raise AssertionError((method, url))
+
+    session = k8s_env(handler)
+    record = k8s_instance.run_instances(_tpu_config())
+    # tpu-v5e-16 = 4 hosts -> 4 pods, head first.
+    assert len(created) == 4
+    assert record.head_instance_id == 'svc-a-head'
+    names = [p['metadata']['name'] for p in created]
+    assert names == ['svc-a-head', 'svc-a-1', 'svc-a-2', 'svc-a-3']
+    head = created[0]
+    sel = head['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == (
+        'tpu-v5-lite-podslice')
+    assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+    req = head['spec']['containers'][0]['resources']['requests']
+    assert req['google.com/tpu'] == '4'
+    assert head['metadata']['labels']['skypilot-tpu/role'] == 'head'
+    # Namespace comes from the kubeconfig context.
+    assert all('/namespaces/mlteam/pods' in url
+               for _, url, _, _ in session.calls)
+
+
+def test_run_instances_idempotent(k8s_env):
+    existing = [
+        _pod('svc-a-head', labels={'skypilot-tpu/host-index': '0',
+                                   'skypilot-tpu/role': 'head'}),
+    ]
+    created = []
+
+    def handler(method, url, body, params):
+        if method == 'GET' and url.endswith('/pods'):
+            return 200, {'items': existing}
+        if method == 'POST':
+            created.append(body['metadata']['name'])
+            return 201, body
+        raise AssertionError((method, url))
+
+    k8s_env(handler)
+    cfg = _tpu_config(accel='tpu-v5e-8')   # single host
+    cfg.count = 2                          # two slices -> 2 pods
+    k8s_instance.run_instances(cfg)
+    assert created == ['svc-a-1']          # head already exists
+
+
+def test_wait_instances_stockout(k8s_env):
+    pods = [
+        _pod('svc-a-head', phase='Pending', conditions=[{
+            'type': 'PodScheduled', 'status': 'False',
+            'reason': 'Unschedulable',
+            'message': '0/3 nodes: Insufficient google.com/tpu',
+        }])
+    ]
+
+    def handler(method, url, body, params):
+        return 200, {'items': pods}
+
+    k8s_env(handler)
+    with pytest.raises(exceptions.StockoutError):
+        k8s_instance.wait_instances('svc-a', 'gke_test', None,
+                                    state='running')
+
+
+def test_query_and_cluster_info_and_host_entries(k8s_env):
+    pods = [
+        _pod('svc-a-1', ip='10.0.0.2',
+             labels={'skypilot-tpu/host-index': '1',
+                     'skypilot-tpu/role': 'worker'}),
+        _pod('svc-a-head', ip='10.0.0.1',
+             labels={'skypilot-tpu/host-index': '0',
+                     'skypilot-tpu/role': 'head'}),
+        _pod('svc-a-2', phase='Failed',
+             labels={'skypilot-tpu/host-index': '2',
+                     'skypilot-tpu/role': 'worker'}),
+    ]
+
+    def handler(method, url, body, params):
+        assert params['labelSelector'] == 'skypilot-tpu/cluster=svc-a'
+        return 200, {'items': pods}
+
+    k8s_env(handler)
+    statuses = k8s_instance.query_instances('svc-a', 'gke_test', None,
+                                            non_terminated_only=False)
+    assert statuses == {'svc-a-1': 'running', 'svc-a-head': 'running',
+                        'svc-a-2': 'terminated'}
+
+    info = k8s_instance.get_cluster_info('svc-a', 'gke_test', None)
+    assert info.head_instance_id == 'svc-a-head'
+    hosts = info.all_hosts()
+    assert hosts[0].instance_id == 'svc-a-head'   # rank 0 = head
+    entries = provisioner.host_entries(info, ssh_private_key=None)
+    assert entries[0]['kind'] == 'k8s'
+    assert entries[0]['pod'] == 'svc-a-head'
+    assert entries[0]['namespace'] == 'mlteam'
+    assert entries[0]['context'] == 'gke_test'
+
+    from skypilot_tpu.utils import command_runner
+    runner = command_runner.runner_from_host_entry(entries[0])
+    assert isinstance(runner, command_runner.KubernetesCommandRunner)
+    kubectl = runner._kubectl('true')
+    assert kubectl[:3] == ['kubectl', '--context', 'gke_test']
+    assert '-n' in kubectl and 'mlteam' in kubectl
+
+
+def test_terminate_deletes_all_pods(k8s_env):
+    deleted = []
+    pods = [_pod('svc-a-head'), _pod('svc-a-1')]
+
+    def handler(method, url, body, params):
+        if method == 'GET':
+            return 200, {'items': pods}
+        if method == 'DELETE':
+            deleted.append(url.rsplit('/', 1)[-1])
+            return 200, {}
+        raise AssertionError((method, url))
+
+    k8s_env(handler)
+    k8s_instance.terminate_instances('svc-a', 'gke_test', None)
+    assert sorted(deleted) == ['svc-a-1', 'svc-a-head']
+
+
+def test_stop_unsupported(k8s_env):
+    with pytest.raises(exceptions.NotSupportedError):
+        k8s_instance.stop_instances('svc-a', 'gke_test', None)
+
+
+# -------------------------------------------------------------- cloud
+
+
+def test_cloud_credentials_and_regions(k8s_env, monkeypatch):
+    from skypilot_tpu.clouds import Kubernetes
+    ok, _ = Kubernetes().check_credentials()
+    assert ok
+    from skypilot_tpu.resources import Resources
+    regions = Kubernetes().regions_with_offering(
+        Resources(accelerators='tpu-v5e-16'))
+    assert [r.name for r in regions] == ['gke_test']
+
+    monkeypatch.setenv('KUBECONFIG', '/nonexistent/kubeconfig')
+    ok, msg = Kubernetes().check_credentials()
+    assert not ok and 'kubeconfig' in msg.lower()
+
+
+def test_cloud_feasibility_and_features(k8s_env):
+    from skypilot_tpu.clouds import Kubernetes
+    from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
+    from skypilot_tpu.resources import Resources
+    k8s = Kubernetes()
+    feasible = k8s.get_feasible_launchable_resources(
+        Resources(accelerators='tpu-v6e-8'))
+    assert len(feasible) == 1 and feasible[0].cloud == k8s
+    # v3 has no GKE podslice pools.
+    assert k8s.get_feasible_launchable_resources(
+        Resources(accelerators='tpu-v3-8')) == []
+    assert CloudImplementationFeatures.STOP in (
+        k8s.unsupported_features_for_resources(
+            Resources(accelerators='tpu-v5e-8')))
+    assert k8s.hourly_price(Resources(accelerators='tpu-v5e-8')) == 0.0
+
+
+def test_optimizer_picks_kubernetes_when_only_cloud(
+        k8s_env, monkeypatch, isolated_state):
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.clouds import Kubernetes
+    from skypilot_tpu.resources import Resources
+    monkeypatch.setattr(check_lib, 'get_cached_enabled_clouds',
+                        lambda *a, **k: [Kubernetes()])
+    with dag_lib.Dag() as dag:
+        t = task_lib.Task('train', run='python train.py')
+        t.set_resources(Resources(accelerators='tpu-v5e-16'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+    chosen = dag.tasks[0].best_resources
+    assert isinstance(chosen.cloud, Kubernetes)
+    assert chosen.region == 'gke_test'
