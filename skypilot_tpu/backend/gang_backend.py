@@ -234,6 +234,7 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
         with backend_utils.cluster_file_lock(self._lock_name(cluster_name)):
             record = backend_utils.refresh_cluster_record(
                 cluster_name, force_refresh=True, acquire_lock=False)
+            is_restart = False
             if record is not None:
                 handle = record['handle']
                 if record['status'] == status_lib.ClusterStatus.UP:
@@ -245,12 +246,53 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 # (run_instances resumes stopped instances).
                 to_provision = handle.launched_resources
                 cluster_name_on_cloud = handle.cluster_name_on_cloud
+                is_restart = True
 
-            prov = RetryingProvisioner(cluster_name, cluster_name_on_cloud,
-                                       retry_until_up,
-                                       blocked_regions=blocked_regions)
-            cluster_info = prov.provision_with_retries(
-                to_provision, task.num_nodes)
+            # Cross-candidate failover (reference provision_with_retries
+            # iterates clouds and regions): when the best candidate
+            # exhausts its zones, move down the optimizer's
+            # cheapest-first candidate list — next region, and
+            # eventually the next cloud — before giving up.
+            candidates = [to_provision]
+            if not is_restart:
+                # Restarts must stay on the recorded cloud/region:
+                # failing over elsewhere would abandon the stopped
+                # instances (still billed for disks) under a handle
+                # that no longer points at them.
+                for cand in (getattr(task, '_optimizer_candidates',
+                                     None) or []):
+                    if cand != to_provision:
+                        candidates.append(cand)
+            backoff = common_utils.Backoff(_PROVISION_BACKOFF_INITIAL)
+            while True:
+                last_error: Optional[Exception] = None
+                cluster_info = None
+                for cand in candidates:
+                    prov = RetryingProvisioner(
+                        cluster_name, cluster_name_on_cloud,
+                        retry_until_up=False,
+                        blocked_regions=blocked_regions)
+                    try:
+                        cluster_info = prov.provision_with_retries(
+                            cand, task.num_nodes)
+                        to_provision = cand
+                        break
+                    except exceptions.ResourcesUnavailableError as e:
+                        logger.warning(
+                            'All candidates on %s failed; %s', cand.cloud,
+                            'trying next cloud.'
+                            if cand is not candidates[-1] else
+                            'no more clouds.')
+                        last_error = e
+                if cluster_info is not None:
+                    break
+                if not retry_until_up:
+                    assert last_error is not None
+                    raise last_error
+                sleep = backoff.current_backoff()
+                logger.info('retry_until_up: retrying all clouds in '
+                            '%.0fs.', sleep)
+                time.sleep(sleep)
             launched = to_provision.copy(
                 region=cluster_info.region,
                 zone=cluster_info.zone,
@@ -316,18 +358,25 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                           all_file_mounts: Optional[Dict[str, str]],
                           storage_mounts: Optional[Dict[str, Any]]) -> None:
         if all_file_mounts:
+            from skypilot_tpu.data import cloud_stores
             runners = handle.runners()
+            log_path = os.path.join(self.log_dir, 'file_mounts.log')
 
             def sync_mounts(runner: runner_lib.CommandRunner) -> None:
                 for dst, src in all_file_mounts.items():
+                    if cloud_stores.is_cloud_url(src):
+                        # Bucket-URL source: the host fetches it
+                        # itself (reference sky/cloud_stores.py).
+                        runner.run(
+                            cloud_stores.download_command(src, dst),
+                            log_path=log_path, check=True)
+                        continue
                     src = os.path.expanduser(src)
                     if os.path.isdir(src):
                         # file_mounts semantics: the source dir's
                         # contents appear AT dst (not nested under it).
                         src = src.rstrip('/') + '/'
-                    runner.rsync(src, dst, up=True,
-                                 log_path=os.path.join(
-                                     self.log_dir, 'file_mounts.log'))
+                    runner.rsync(src, dst, up=True, log_path=log_path)
 
             subprocess_utils.run_in_parallel(sync_mounts, runners)
         if storage_mounts:
@@ -429,6 +478,38 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
         runner = handle.head_runner()
         return runner.run(cmd, stream_logs=True,
                           log_path=os.path.join(self.log_dir, 'tail.log'))
+
+    def sync_down_logs(self, handle: GangResourceHandle,
+                       job_id: Optional[int], local_dir: str) -> str:
+        """Pull one job's log tree (driver + per-rank logs) off the
+        head host (reference sync_down_logs,
+        cloud_vm_ray_backend.py:3705)."""
+        if job_id is None:
+            jobs = self.get_job_queue(handle)
+            if not jobs:
+                raise exceptions.JobNotFoundError(
+                    f'No jobs on {handle.cluster_name}.')
+            job_id = max(j['job_id'] for j in jobs)
+        src = agent_constants.job_dir(handle.state_dir, job_id)
+        local_dir = os.path.expanduser(local_dir)
+        dst = os.path.join(local_dir,
+                           f'{handle.cluster_name}-job-{job_id}')
+        os.makedirs(dst, exist_ok=True)
+        head = handle.head_runner()
+        if isinstance(head, runner_lib.LocalProcessRunner):
+            # Local clusters share the filesystem, and the agent state
+            # dir lives OUTSIDE the host sandbox the runner translates
+            # paths into — copy straight from it.
+            import shutil
+            shutil.copytree(os.path.expanduser(src), dst,
+                            dirs_exist_ok=True)
+        else:
+            head.rsync(
+                src + '/', dst, up=False,
+                log_path=os.path.join(self.log_dir,
+                                      'sync_down_logs.log'))
+        logger.info('Synced job %d logs to %s.', job_id, dst)
+        return dst
 
     def cancel_jobs(self, handle: GangResourceHandle,
                     job_ids: Optional[List[int]]) -> List[int]:

@@ -156,9 +156,18 @@ def cancel(cluster: str, job_ids, all_jobs: bool) -> None:
 @cli.command()
 @click.argument('cluster')
 @click.option('--job-id', '-j', type=int, default=None)
-def logs(cluster: str, job_id: Optional[int]) -> None:
+@click.option('--sync-down', is_flag=True, default=False,
+              help='Download the job log tree instead of tailing.')
+@click.option('--local-dir', default='~/skytpu_logs',
+              help='Destination for --sync-down.')
+def logs(cluster: str, job_id: Optional[int], sync_down: bool,
+         local_dir: str) -> None:
     """Tail a job's logs (in-process; logs need the live stream)."""
     from skypilot_tpu import core
+    if sync_down:
+        dst = core.sync_down_logs(cluster, job_id, local_dir)
+        click.echo(dst)
+        return
     core.tail_logs(cluster, job_id, follow=True)
 
 
@@ -236,6 +245,41 @@ def jobs_logs(job_id: int) -> None:
 
 
 # ------------------------------------------------------------- serve
+
+
+@cli.group()
+def storage() -> None:
+    """Named storage buckets (reference `sky storage`)."""
+
+
+@storage.command('ls')
+def storage_ls() -> None:
+    from skypilot_tpu import global_user_state
+    rows = global_user_state.get_storage()
+    _echo_table([{
+        'name': r['name'],
+        'store': getattr(r.get('handle'), 'stores', None) and ','.join(
+            s.value for s in r['handle'].stores) or '-',
+        'status': r.get('status', '-'),
+    } for r in rows], ['name', 'store', 'status'])
+
+
+@storage.command('delete')
+@click.argument('names', nargs=-1, required=True)
+def storage_delete(names) -> None:
+    from skypilot_tpu import global_user_state
+    from skypilot_tpu.data import storage as storage_lib
+    for name in names:
+        record = global_user_state.get_storage_from_name(name)
+        if record is None:
+            click.echo(f'No storage named {name!r}.')
+            continue
+        handle = record.get('handle')
+        if isinstance(handle, storage_lib.Storage):
+            handle.delete()
+        else:
+            global_user_state.remove_storage(name)
+        click.echo(f'Deleted storage {name!r}.')
 
 
 @cli.group()

@@ -128,8 +128,33 @@ def api_cancel(request_id: str) -> bool:
 # ------------------------------------------------------------ SDK calls
 
 
+def upload_workdir(workdir: str) -> str:
+    """Zip + upload a workdir; returns the server-side path
+    (reference chunked upload, sky/server/server.py:312)."""
+    import io
+    import zipfile
+    url = ensure_server()
+    src = os.path.abspath(os.path.expanduser(workdir))
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, 'w', zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(src):
+            dirs[:] = [d for d in dirs if d != '.git']
+            for fname in files:
+                full = os.path.join(root, fname)
+                zf.write(full, os.path.relpath(full, src))
+    resp = http.post(f'{url}/api/upload', data=buf.getvalue(),
+                     timeout=600)
+    resp.raise_for_status()
+    return resp.json()['path']
+
+
 def _task_body(task, **extra) -> Dict[str, Any]:
-    return {'task': task.to_yaml_config(), **extra}
+    config = task.to_yaml_config()
+    # The server may run on another machine (team deployment): ship
+    # the workdir through it rather than assuming a shared filesystem.
+    if config.get('workdir'):
+        config['workdir'] = upload_workdir(config['workdir'])
+    return {'task': config, **extra}
 
 
 def launch(task, cluster_name: Optional[str] = None, **kwargs) -> str:

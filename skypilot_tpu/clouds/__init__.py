@@ -2,11 +2,13 @@
 from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
+from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
+    'AWS',
     'Cloud',
     'CloudImplementationFeatures',
     'Region',

@@ -1,0 +1,134 @@
+"""AWS — the second metered VM cloud (controllers, CPU tasks,
+cross-cloud arbitrage).
+
+Re-design of reference ``sky/clouds/aws.py`` (1,181 LoC) scoped to
+what a TPU-first framework needs from AWS: catalog-backed EC2
+feasibility/pricing so the optimizer genuinely arbitrates clouds, and
+an EC2 provision plugin behind the standard seam. No TPUs here — TPU
+requests are never feasible on AWS — and no GPU catalog (out of
+scope for this framework).
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_CREDENTIAL_HINT = (
+    'Install boto3 and configure credentials (`aws configure`, or '
+    'AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY, or an instance role).')
+
+DEFAULT_AMI_NAME = 'ubuntu-22.04'
+
+
+@registry.CLOUD_REGISTRY.register(name='aws')
+class AWS(cloud_lib.Cloud):
+    """Amazon Web Services (EC2)."""
+
+    _REPR = 'AWS'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    # ------------------------------------------------------------------
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        if resources.is_tpu:
+            return []
+        instance_type = (resources.instance_type or
+                         catalog.get_default_instance_type(
+                             resources.cpus, resources.memory,
+                             cloud='aws'))
+        if instance_type is None:
+            return []
+        regions: Dict[str, List[str]] = {}
+        for o in catalog.get_instance_offerings(
+                instance_type, resources.region, resources.zone,
+                cloud='aws'):
+            regions.setdefault(o.region, []).append(o.zone)
+        return [
+            cloud_lib.Region(name, sorted(set(zones)))
+            for name, zones in sorted(regions.items())
+        ]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        if resources.cloud is not None and not self.is_same_cloud(
+                resources.cloud):
+            return []
+        if resources.is_tpu:
+            return []  # no TPUs on AWS
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = catalog.get_default_instance_type(
+                resources.cpus, resources.memory, cloud='aws')
+            if instance_type is None:
+                return []
+        if not catalog.get_instance_offerings(
+                instance_type, resources.region, resources.zone,
+                cloud='aws'):
+            return []
+        return [resources.copy(cloud=self, instance_type=instance_type)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        assert resources.instance_type is not None, resources
+        return catalog.get_hourly_cost(resources.instance_type,
+                                       resources.use_spot,
+                                       resources.region, resources.zone,
+                                       cloud='aws')
+
+    def validate_region_zone(self, region, zone):
+        return catalog.validate_region_zone(region, zone)
+
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': zone,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'image_id': resources.image_id,  # AMI id; None = default
+            'labels': resources.labels or {},
+            'ports': resources.ports or [],
+            'num_hosts': 1,
+        }
+
+    # ------------------------------------------------------------------
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        try:
+            import boto3  # pylint: disable=import-outside-toplevel
+        except ImportError:
+            return False, 'boto3 is not installed. ' + _CREDENTIAL_HINT
+        try:
+            session = boto3.session.Session()
+            if session.get_credentials() is None:
+                return False, ('No AWS credentials found. ' +
+                               _CREDENTIAL_HINT)
+            return True, None
+        except Exception as e:  # pylint: disable=broad-except
+            return False, f'{e}. {_CREDENTIAL_HINT}'
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        out = {}
+        for name in ('credentials', 'config'):
+            path = os.path.expanduser(f'~/.aws/{name}')
+            if os.path.exists(path):
+                out[f'~/.aws/{name}'] = path
+        return out
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        try:
+            import boto3  # pylint: disable=import-outside-toplevel
+            ident = boto3.client('sts').get_caller_identity()
+            return [[ident['Arn']]]
+        except Exception:  # pylint: disable=broad-except
+            return None

@@ -74,14 +74,26 @@ class Kubernetes(cloud_lib.Cloud):
         if resources.cloud is not None and not self.is_same_cloud(
                 resources.cloud):
             return []
+        if resources.use_spot:
+            return []
         if resources.is_tpu:
             from skypilot_tpu.provision.kubernetes import instance
             gen = resources.tpu.generation
             if gen not in instance.GKE_TPU_ACCELERATORS:
                 return []  # GKE has no podslice pools for this gen
-        if resources.use_spot:
-            return []
-        return [resources.copy(cloud=self)]
+            return [resources.copy(cloud=self)]
+        # CPU pods: synthesize a launchable "<n>CPU--<m>GB" instance
+        # type from the requested cpus/memory (reference
+        # kubernetes_utils.KubernetesInstanceType) — candidates must
+        # be launchable for the optimizer's cost sort.
+        instance_type = resources.instance_type
+        if instance_type is None:
+            cpus = str(resources.cpus or '4+').rstrip('+')
+            mem = str(resources.memory or
+                      float(cpus) * 4).rstrip('+')
+            instance_type = f'{cpus}CPU--{mem}GB'
+        return [resources.copy(cloud=self,
+                               instance_type=instance_type)]
 
     def hourly_price(self, resources: 'Resources') -> float:
         # The cluster is sunk cost (reference kubernetes.py prices
@@ -121,7 +133,12 @@ class Kubernetes(cloud_lib.Cloud):
                 'num_hosts': tpu.num_hosts,
             })
         else:
-            vars_.update({'tpu_vm': False, 'num_hosts': 1})
+            cpus, memory = None, None
+            itype = resources.instance_type or ''
+            if itype.endswith('GB') and 'CPU--' in itype:
+                cpus, memory = itype[:-2].split('CPU--')
+            vars_.update({'tpu_vm': False, 'num_hosts': 1,
+                          'cpus': cpus, 'memory': memory})
         return vars_
 
     # ------------------------------------------------------------------

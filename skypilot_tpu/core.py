@@ -133,6 +133,17 @@ def tail_logs(cluster_name: str,
     return backend.tail_logs(handle, job_id, follow=follow)
 
 
+def sync_down_logs(cluster_name: str,
+                   job_id: Optional[int] = None,
+                   local_dir: str = '~/skytpu_logs') -> str:
+    """Download a job's log tree from the cluster head to this machine
+    (reference sync_down_logs, sky/backends/
+    cloud_vm_ray_backend.py:3705). Returns the local directory."""
+    handle = backend_utils.check_cluster_available(cluster_name)
+    backend = gang_backend.GangBackend()
+    return backend.sync_down_logs(handle, job_id, local_dir)
+
+
 def cost_report() -> List[Dict[str, Any]]:
     """Accumulated cost per cluster from usage intervals (reference
     sky/core.py cost_report)."""

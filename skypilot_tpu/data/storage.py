@@ -31,6 +31,7 @@ logger = sky_logging.init_logger(__name__)
 
 class StoreType(enum.Enum):
     GCS = 'GCS'
+    S3 = 'S3'
     LOCAL = 'LOCAL'
 
 
@@ -39,8 +40,20 @@ class StorageMode(enum.Enum):
     COPY = 'COPY'
 
 
+def run_storage_command(cmd: str) -> None:
+    """Run a storage CLI command; raise StorageError on failure (the
+    one subprocess helper shared by all stores and data_transfer)."""
+    proc = subprocess.run(cmd, shell=True, capture_output=True,
+                          text=True, check=False)
+    if proc.returncode != 0:
+        raise exceptions.StorageError(
+            f'Storage command failed ({cmd}): {proc.stderr}')
+
+
 class AbstractStore:
     """One physical bucket in one store type."""
+
+    _run = staticmethod(run_storage_command)
 
     def __init__(self, name: str, source: Optional[str] = None) -> None:
         self.name = name
@@ -100,13 +113,45 @@ class GcsStore(AbstractStore):
     def delete(self) -> None:
         self._run(f'gsutil -m rm -r {self.url()} || true')
 
-    @staticmethod
-    def _run(cmd: str) -> None:
-        proc = subprocess.run(cmd, shell=True, capture_output=True,
-                              text=True, check=False)
-        if proc.returncode != 0:
-            raise exceptions.StorageError(
-                f'Storage command failed ({cmd}): {proc.stderr}')
+
+class S3Store(AbstractStore):
+    """Amazon S3 bucket via the aws CLI; MOUNT via goofys.
+
+    Re-design of reference ``sky/data/storage.py:1300`` (S3Store) with
+    the same CLI-not-SDK stance as GcsStore.
+    """
+
+    def url(self) -> str:
+        return f's3://{self.name}'
+
+    def upload(self) -> None:
+        if self.source is None:
+            return
+        src = os.path.abspath(os.path.expanduser(self.source))
+        self._run(f'aws s3 mb {self.url()} || true')
+        if os.path.isdir(src):
+            self._run(f'aws s3 sync --exclude ".git/*" {src} '
+                      f'{self.url()}')
+        else:
+            self._run(f'aws s3 cp {src} {self.url()}/')
+
+    def download_command(self, dst: str) -> str:
+        return f'mkdir -p {dst} && aws s3 sync {self.url()} {dst}'
+
+    def mount_command(self, mount_path: str) -> str:
+        # goofys, as the reference's S3 MOUNT adapter
+        # (sky/data/mounting_utils.py:25: goofys for S3).
+        install = (
+            'which goofys >/dev/null 2>&1 || '
+            '(sudo curl -sSL https://github.com/kahing/goofys/releases/'
+            'latest/download/goofys -o /usr/local/bin/goofys && '
+            'sudo chmod +x /usr/local/bin/goofys)')
+        return (f'{install}; mkdir -p {mount_path} && '
+                f'(mountpoint -q {mount_path} || '
+                f'goofys {self.name} {mount_path})')
+
+    def delete(self) -> None:
+        self._run(f'aws s3 rb --force {self.url()} || true')
 
 
 class LocalStore(AbstractStore):
@@ -154,6 +199,7 @@ class LocalStore(AbstractStore):
 
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
+    StoreType.S3: S3Store,
     StoreType.LOCAL: LocalStore,
 }
 

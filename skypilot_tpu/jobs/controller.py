@@ -127,8 +127,12 @@ class JobsController:
         state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
         # Launches are slot-limited (jobs/scheduler.py): a burst of
         # submissions provisions at most launch_parallelism() clusters
-        # at once; the rest queue in WAITING.
-        scheduler.wait_for_launch_slot(self.job_id)
+        # at once; the rest queue in WAITING. A cancel raised while
+        # queued aborts before any cluster exists.
+        if not scheduler.wait_for_launch_slot(self.job_id):
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.CANCELLED)
+            return state.ManagedJobStatus.CANCELLED
         try:
             cluster_job_id = self.strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
@@ -165,7 +169,11 @@ class JobsController:
             logger.info('Recovery #%d for managed job %d.', n,
                         self.job_id)
             # Recovery relaunches a cluster — same slot discipline.
-            scheduler.wait_for_launch_slot(self.job_id)
+            if not scheduler.wait_for_launch_slot(self.job_id):
+                self.strategy.terminate_cluster()
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return state.ManagedJobStatus.CANCELLED
             try:
                 cluster_job_id = self.strategy.recover()
             except exceptions.ResourcesUnavailableError as e:

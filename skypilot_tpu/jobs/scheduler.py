@@ -66,18 +66,27 @@ def _sweep_dead_launchers() -> None:
 
 def wait_for_launch_slot(job_id: int,
                          poll_seconds: float = 0.5,
-                         timeout: Optional[float] = None) -> None:
-    """Block until this job holds a launch slot."""
+                         timeout: Optional[float] = None) -> bool:
+    """Block until this job holds a launch slot.
+
+    Returns False (without a slot) if the job's cancel flag is raised
+    while queued — a cancelled job must not go on to provision an
+    entire cluster just to tear it down.
+    """
     state.set_schedule_state(job_id, WAITING)
     limit = launch_parallelism()
     deadline = None if timeout is None else time.time() + timeout
     while not state.try_acquire_launch_slot(job_id, limit):
+        if state.cancel_requested(job_id):
+            state.set_schedule_state(job_id, DONE)
+            return False
         _sweep_dead_launchers()
         if deadline is not None and time.time() > deadline:
             raise TimeoutError(
                 f'Managed job {job_id} waited {timeout}s for a launch '
                 f'slot ({limit} parallel launches).')
         time.sleep(poll_seconds)
+    return True
 
 
 def finish_launch(job_id: int) -> None:

@@ -24,6 +24,10 @@ def _db_path() -> str:
     return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
 
 
+# DB paths already migrated by this process.
+_migrated_paths: set = set()
+
+
 def _conn() -> sqlite3.Connection:
     path = _db_path()
     pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
@@ -48,12 +52,16 @@ def _conn() -> sqlite3.Connection:
             dag_json TEXT,
             schedule_state TEXT DEFAULT 'INACTIVE'
         )""")
-    for decl in ("schedule_state TEXT DEFAULT 'INACTIVE'",
-                 'controller_job_id INTEGER'):
-        try:
-            conn.execute(f'ALTER TABLE jobs ADD COLUMN {decl}')
-        except sqlite3.OperationalError:
-            pass  # already present
+    if path not in _migrated_paths:
+        # Migrate pre-schema DBs once per process, not on every
+        # connection (the scheduler polls this DB twice a second).
+        for decl in ("schedule_state TEXT DEFAULT 'INACTIVE'",
+                     'controller_job_id INTEGER'):
+            try:
+                conn.execute(f'ALTER TABLE jobs ADD COLUMN {decl}')
+            except sqlite3.OperationalError:
+                pass  # already present
+        _migrated_paths.add(path)
     return conn
 
 

@@ -1,0 +1,273 @@
+"""EC2 provision ops.
+
+Re-design of reference ``sky/provision/aws/instance.py`` (boto3 fleet
+launch): instances are tagged with the cluster name, created
+idempotently (existing non-terminated instances are reused, stopped
+ones restarted), and errors translate into the stockout/quota
+taxonomy the failover provisioner keys on
+(InsufficientInstanceCapacity -> StockoutError, *LimitExceeded ->
+QuotaExceededError — the same signals reference
+FailoverCloudErrorHandlerV2's AWS handler decodes).
+
+boto3 is reached only through ``client_factory`` so tests (and images
+without boto3) drive the full lifecycle against a fake EC2.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_TAG = 'skypilot-tpu-cluster'
+_ROLE_TAG = 'skypilot-tpu-role'
+
+_WAIT_TIMEOUT = 1200.0
+_POLL_INTERVAL = 5.0
+
+# Default Ubuntu 22.04 AMIs would normally come from an SSM lookup;
+# kept as a parameter (node_config['image_id']) with SSM alias default.
+_DEFAULT_AMI_SSM = ('/aws/service/canonical/ubuntu/server/22.04/'
+                    'stable/current/amd64/hvm/ebs-gp2/ami-id')
+
+
+def _ec2_factory(region: str):
+    import boto3
+    return boto3.client('ec2', region_name=region)
+
+
+# Test seam: replaced with a fake EC2 client maker in tests.
+client_factory: Callable = _ec2_factory
+
+
+def translate_error(exc: Exception, what: str) -> exceptions.ProvisionError:
+    """Map a botocore ClientError(-shaped) exception onto typed errors."""
+    code = ''
+    resp = getattr(exc, 'response', None)
+    if isinstance(resp, dict):
+        code = str(resp.get('Error', {}).get('Code', ''))
+    blob = f'{code} {exc}'.lower()
+    # Quota first: AWS quota messages mention "vCPU capacity ...
+    # limit", which would false-match a bare "capacity" stockout test.
+    if 'limitexceeded' in blob or 'quota' in blob:
+        return exceptions.QuotaExceededError(f'{what}: {exc}')
+    if ('insufficientinstancecapacity' in blob or
+            'insufficient capacity' in blob or
+            'insufficient' in blob and 'capacity' in blob):
+        return exceptions.StockoutError(f'{what}: {exc}')
+    return exceptions.ProvisionError(f'{what}: {exc}')
+
+
+def _tag_filters(cluster_name_on_cloud: str) -> List[Dict[str, Any]]:
+    return [
+        {'Name': f'tag:{_CLUSTER_TAG}',
+         'Values': [cluster_name_on_cloud]},
+        {'Name': 'instance-state-name',
+         'Values': ['pending', 'running', 'stopping', 'stopped']},
+    ]
+
+
+def _list_instances(ec2, cluster_name_on_cloud: str) -> List[Dict]:
+    out = []
+    resp = ec2.describe_instances(
+        Filters=_tag_filters(cluster_name_on_cloud))
+    for reservation in resp.get('Reservations', []):
+        out.extend(reservation.get('Instances', []))
+    return out
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    """Security groups / VPC discovery would go here; the default VPC
+    with its default security group is assumed (reference
+    sky/provision/aws/config.py does full discovery)."""
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    ec2 = client_factory(config.region)
+    existing = _list_instances(ec2, config.cluster_name_on_cloud)
+    alive = [i for i in existing
+             if i['State']['Name'] in ('pending', 'running')]
+    stopped = [i for i in existing if i['State']['Name'] in
+               ('stopping', 'stopped')]
+    created, resumed = [], []
+
+    if stopped:
+        ids = [i['InstanceId'] for i in stopped]
+        try:
+            ec2.start_instances(InstanceIds=ids)
+        except Exception as e:  # pylint: disable=broad-except
+            raise translate_error(e, 'start_instances') from e
+        resumed = ids
+        alive += stopped
+
+    missing = config.count - len(alive)
+    if missing > 0:
+        placement: Dict[str, Any] = {}
+        if config.zone:
+            placement['AvailabilityZone'] = config.zone
+        market: Dict[str, Any] = {}
+        if node.get('use_spot'):
+            market = {'MarketType': 'spot',
+                      'SpotOptions': {
+                          'InstanceInterruptionBehavior': 'terminate'}}
+        tags = [{'Key': _CLUSTER_TAG,
+                 'Value': config.cluster_name_on_cloud},
+                {'Key': 'Name',
+                 'Value': config.cluster_name_on_cloud}]
+        for k, v in (node.get('labels') or {}).items():
+            tags.append({'Key': k, 'Value': v})
+        kwargs: Dict[str, Any] = dict(
+            ImageId=node.get('image_id') or f'resolve:ssm:{_DEFAULT_AMI_SSM}',
+            InstanceType=node['instance_type'],
+            MinCount=missing,
+            MaxCount=missing,
+            TagSpecifications=[{'ResourceType': 'instance',
+                                'Tags': tags}],
+            BlockDeviceMappings=[{
+                'DeviceName': '/dev/sda1',
+                'Ebs': {'VolumeSize': node.get('disk_size') or 256,
+                        'VolumeType': 'gp3'},
+            }],
+        )
+        if placement:
+            kwargs['Placement'] = placement
+        if market:
+            kwargs['InstanceMarketOptions'] = market
+        try:
+            resp = ec2.run_instances(**kwargs)
+        except Exception as e:  # pylint: disable=broad-except
+            raise translate_error(e, 'run_instances') from e
+        created = [i['InstanceId'] for i in resp['Instances']]
+
+    all_ids = sorted([i['InstanceId'] for i in alive
+                      if i['InstanceId'] not in resumed] +
+                     resumed + created)
+    if not all_ids:
+        raise exceptions.ProvisionError('run_instances created nothing')
+    # Stable head: lexicographically-first instance id (tags would race
+    # on concurrent creates; id order is what rank order uses too).
+    return common.ProvisionRecord(
+        provider_name='aws',
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=all_ids[0],
+    )
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del zone
+    ec2 = client_factory(region)
+    want = {'running': ('running',),
+            'stopped': ('stopped',)}.get(state or 'running',
+                                         ('running',))
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        instances = _list_instances(ec2, cluster_name_on_cloud)
+        if instances and all(
+                i['State']['Name'] in want for i in instances):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} to reach '
+        f'{state!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del zone
+    ec2 = client_factory(region)
+    resp = ec2.describe_instances(Filters=[
+        {'Name': f'tag:{_CLUSTER_TAG}',
+         'Values': [cluster_name_on_cloud]},
+    ])
+    out: Dict[str, Optional[str]] = {}
+    for reservation in resp.get('Reservations', []):
+        for inst in reservation.get('Instances', []):
+            aws_state = inst['State']['Name']
+            status = {
+                'pending': 'pending',
+                'running': 'running',
+                'stopping': 'stopped',
+                'stopped': 'stopped',
+                'shutting-down': 'terminated',
+                'terminated': 'terminated',
+            }.get(aws_state, 'pending')
+            if non_terminated_only and status == 'terminated':
+                continue
+            out[inst['InstanceId']] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    ec2 = client_factory(region)
+    instances = _list_instances(ec2, cluster_name_on_cloud)
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for inst in sorted(instances, key=lambda i: i['InstanceId']):
+        infos[inst['InstanceId']] = [
+            common.InstanceInfo(
+                instance_id=inst['InstanceId'],
+                internal_ip=inst.get('PrivateIpAddress', ''),
+                external_ip=inst.get('PublicIpAddress'),
+                host_index=0,
+                tags={t['Key']: t['Value']
+                      for t in inst.get('Tags', [])},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='aws',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user='ubuntu',
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del zone
+    ec2 = client_factory(region)
+    ids = [i['InstanceId']
+           for i in _list_instances(ec2, cluster_name_on_cloud)
+           if i['State']['Name'] in ('pending', 'running')]
+    if ids:
+        ec2.stop_instances(InstanceIds=ids)
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del zone
+    ec2 = client_factory(region)
+    ids = [i['InstanceId']
+           for i in _list_instances(ec2, cluster_name_on_cloud)]
+    if ids:
+        ec2.terminate_instances(InstanceIds=ids)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    """Security-group ingress rules (reference aws/instance.py
+    open_ports). Scoped out with the default-SG assumption above."""
+    logger.info('aws: open_ports(%s) not implemented for the default '
+                'security group; open them in the console/SG.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

@@ -77,9 +77,10 @@ def _pod_manifest(config: common.ProvisionConfig, name: str,
     labels.update(node.get('labels') or {})
     resources: Dict[str, Any] = {}
     if node.get('cpus'):
-        resources['cpu'] = str(node['cpus'])
+        # '8+' style requests become the lower bound as a k8s quantity.
+        resources['cpu'] = str(node['cpus']).rstrip('+')
     if node.get('memory'):
-        resources['memory'] = f"{node['memory']}Gi"
+        resources['memory'] = f"{str(node['memory']).rstrip('+')}Gi"
     container: Dict[str, Any] = {
         'name': 'skytpu',
         'image': node.get('image_id') or DEFAULT_IMAGE,
@@ -131,6 +132,14 @@ def run_instances(
             phase = existing[name].get('status', {}).get('phase')
             if phase in ('Succeeded', 'Failed'):
                 client.delete_pod(name)
+                # Deletion is asynchronous (grace period); creating
+                # the same name while the old pod is Terminating 409s
+                # into create_pod's idempotent path and returns the
+                # DYING pod. Wait for the name to free first.
+                deadline = time.time() + 120
+                while (client.get_pod(name) is not None and
+                       time.time() < deadline):
+                    time.sleep(_POLL_INTERVAL)
             else:
                 continue
         client.create_pod(_pod_manifest(config, name, idx))
@@ -147,8 +156,8 @@ def run_instances(
 
 def wait_instances(cluster_name_on_cloud: str, region: str,
                    zone: Optional[str], state: Optional[str]) -> None:
-    del region, zone
-    client = _client()
+    del zone
+    client = _client(region)
     deadline = time.time() + _WAIT_TIMEOUT
     want_gone = state in (None, 'terminated')
     while time.time() < deadline:
@@ -182,8 +191,8 @@ def query_instances(
         non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
     """pod name -> 'running'|'pending'|'terminated' (pods never
     'stop': no STOP support on kubernetes)."""
-    del region, zone
-    client = _client()
+    del zone
+    client = _client(region)
     out: Dict[str, Optional[str]] = {}
     for pod in client.list_pods(_selector(cluster_name_on_cloud)):
         phase = pod.get('status', {}).get('phase', '')
@@ -203,7 +212,7 @@ def query_instances(
 
 def get_cluster_info(cluster_name_on_cloud: str, region: str,
                      zone: Optional[str]) -> common.ClusterInfo:
-    client = _client()
+    client = _client(region)
     pods = client.list_pods(_selector(cluster_name_on_cloud))
     instances: Dict[str, List[common.InstanceInfo]] = {}
     head_id = None
@@ -251,8 +260,8 @@ def stop_instances(cluster_name_on_cloud: str, region: str,
 
 def terminate_instances(cluster_name_on_cloud: str, region: str,
                         zone: Optional[str]) -> None:
-    del region, zone
-    client = _client()
+    del zone
+    client = _client(region)
     for pod in client.list_pods(_selector(cluster_name_on_cloud)):
         client.delete_pod(pod['metadata']['name'])
 

@@ -45,6 +45,47 @@ async def handle_op(request: web.Request) -> web.Response:
     return web.json_response({'request_id': request_id})
 
 
+async def handle_upload(request: web.Request) -> web.Response:
+    """Chunked workdir upload (reference sky/server/server.py:312):
+    the client streams a zip of its workdir; the server extracts it
+    into a content-addressed directory and returns the server-side
+    path, which the client substitutes into the task before /launch.
+    This is what lets a *remote* (team) API server receive a workdir
+    the client and server filesystems don't share."""
+    import hashlib
+    import io
+    import zipfile
+    data = await request.read()
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    root = os.path.join(
+        os.path.expanduser(os.environ.get('SKYTPU_DATA_DIR',
+                                          '~/.skytpu')),
+        'api_server', 'uploads')
+    dst = os.path.join(root, digest)
+    if not os.path.isdir(dst):
+        os.makedirs(dst + '.tmp', exist_ok=True)
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                # Reject entries escaping the extraction root.
+                for name in zf.namelist():
+                    target = os.path.realpath(
+                        os.path.join(dst + '.tmp', name))
+                    if not target.startswith(
+                            os.path.realpath(dst + '.tmp')):
+                        raise web.HTTPBadRequest(
+                            text=f'unsafe zip entry {name!r}')
+                zf.extractall(dst + '.tmp')
+        except zipfile.BadZipFile:
+            return web.json_response({'error': 'not a zip file'},
+                                     status=400)
+        try:
+            os.replace(dst + '.tmp', dst)
+        except OSError:
+            if not os.path.isdir(dst):  # lost a same-digest race: fine
+                raise
+    return web.json_response({'path': dst})
+
+
 async def handle_get(request: web.Request) -> web.Response:
     """Block until the request is terminal; return its result."""
     request_id = request.query['request_id']
@@ -141,6 +182,7 @@ def make_app() -> web.Application:
     app.router.add_get('/api/status', handle_status_poll)
     app.router.add_get('/api/stream', handle_stream)
     app.router.add_post('/api/cancel', handle_cancel)
+    app.router.add_post('/api/upload', handle_upload)
     app.router.add_get('/api/requests', handle_list)
     app.router.add_post('/api/v1/{op:.+}', handle_op)
     return app

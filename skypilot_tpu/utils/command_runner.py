@@ -387,8 +387,13 @@ class KubernetesCommandRunner(CommandRunner):
             pack.wait()
             rc = proc.returncode or pack.returncode
         else:
-            src_dir = os.path.dirname(source.rstrip('/')) or '/'
-            base = os.path.basename(source.rstrip('/'))
+            if source.endswith('/'):
+                # rsync contents semantics: extract the dir's entries
+                # directly under target (matches the SSH runner).
+                src_dir, base = source.rstrip('/'), '.'
+            else:
+                src_dir = os.path.dirname(source.rstrip('/')) or '/'
+                base = os.path.basename(source.rstrip('/'))
             pack = self._kubectl(
                 '/bin/sh', '-c',
                 f'tar cf - -C {shell_path(src_dir)} {shell_path(base)}')

@@ -138,3 +138,80 @@ def test_cli_show_tpus():
                              ['show-tpus', '--name-filter', 'v5e'])
     assert out.exit_code == 0, out.output
     assert 'tpu-v5e-16' in out.output
+
+
+def test_remote_server_workdir_upload_and_log_download(
+        isolated_state, monkeypatch, tmp_path):
+    """SDK against a server in ANOTHER PROCESS with a different
+    working directory: the workdir travels through /api/upload
+    (reference chunked upload, sky/server/server.py:312), and the job
+    logs come back via sync_down_logs."""
+    import os
+    import subprocess
+    import sys
+
+    from skypilot_tpu import core
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.client import sdk
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = repo
+    port = sdk._free_port() if hasattr(sdk, '_free_port') else 47123
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        cwd='/',                      # NOT the client cwd
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    url = f'http://127.0.0.1:{port}'
+    monkeypatch.setenv('SKYTPU_API_SERVER_ENDPOINT', url)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if http.get(f'{url}/api/health', timeout=2).ok:
+                    break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            raise TimeoutError('server did not come up')
+
+        workdir = tmp_path / 'wd'
+        workdir.mkdir()
+        (workdir / 'payload.txt').write_text('through-the-server')
+        task = task_lib.Task('remote-wd', run='cat payload.txt',
+                             workdir=str(workdir))
+        task.set_resources(resources_lib.Resources(cloud='local'))
+        body = sdk._task_body(task, cluster_name='rwd-c')
+        # The workdir was rewritten to a server-side upload dir.
+        assert body['task']['workdir'] != str(workdir)
+        assert os.path.isfile(
+            os.path.join(body['task']['workdir'], 'payload.txt'))
+        request_id = sdk.submit('launch', body)
+        result = sdk.get(request_id)
+        assert result['job_id'] is not None
+
+        # Job ran with the uploaded workdir contents.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = core.job_status('rwd-c', [result['job_id']])[
+                result['job_id']]
+            if st is not None and st.is_terminal():
+                break
+            time.sleep(0.5)
+        assert str(st) == 'JobStatus.SUCCEEDED', st
+
+        # Log download (reference sync_down_logs).
+        dst = core.sync_down_logs('rwd-c', result['job_id'],
+                                  str(tmp_path / 'logs'))
+        import glob
+        logs = ''.join(
+            open(p, encoding='utf-8', errors='replace').read()
+            for p in glob.glob(os.path.join(dst, '*.log')))
+        assert 'through-the-server' in logs
+        core.down('rwd-c')
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

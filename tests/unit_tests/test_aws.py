@@ -1,0 +1,287 @@
+"""AWS cloud + EC2 provision plugin (fake boto3 seam), cross-cloud
+optimization and failover.
+
+The fake EC2 client plays boto3: lifecycle tests cover the tag-based
+idempotent create/reuse/restart contract and the error taxonomy;
+optimizer tests prove genuine AWS-vs-GCP price arbitration; the
+failover test blocks every GCP zone via injected stockouts and
+asserts the launch lands on AWS (reference provision_with_retries
+iterates clouds, sky/backends/cloud_vm_ray_backend.py:1953).
+"""
+import itertools
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import instance as aws_instance
+
+
+class FakeEC2:
+    """In-memory EC2: enough surface for the plugin."""
+
+    def __init__(self):
+        self.instances = {}          # id -> dict
+        self._ids = itertools.count(1)
+        self.run_error = None        # exception to raise on create
+
+    def _new_id(self):
+        return f'i-{next(self._ids):017x}'
+
+    def describe_instances(self, Filters=None):
+        out = []
+        want_states = None
+        want_cluster = None
+        for f in Filters or []:
+            if f['Name'] == 'instance-state-name':
+                want_states = set(f['Values'])
+            if f['Name'].startswith('tag:'):
+                key = f['Name'][4:]
+                want_cluster = (key, set(f['Values']))
+        for inst in self.instances.values():
+            if want_states and inst['State']['Name'] not in want_states:
+                continue
+            if want_cluster:
+                key, values = want_cluster
+                tags = {t['Key']: t['Value'] for t in inst['Tags']}
+                if tags.get(key) not in values:
+                    continue
+            out.append(dict(inst))
+        return {'Reservations': [{'Instances': out}]}
+
+    def run_instances(self, **kwargs):
+        if self.run_error is not None:
+            raise self.run_error
+        created = []
+        for _ in range(kwargs['MinCount']):
+            iid = self._new_id()
+            inst = {
+                'InstanceId': iid,
+                'State': {'Name': 'running'},
+                'InstanceType': kwargs['InstanceType'],
+                'PrivateIpAddress': f'172.31.0.{len(self.instances) + 1}',
+                'PublicIpAddress': f'54.0.0.{len(self.instances) + 1}',
+                'Tags': kwargs['TagSpecifications'][0]['Tags'],
+            }
+            self.instances[iid] = inst
+            created.append(dict(inst))
+        return {'Instances': created}
+
+    def start_instances(self, InstanceIds):
+        for iid in InstanceIds:
+            self.instances[iid]['State']['Name'] = 'running'
+
+    def stop_instances(self, InstanceIds):
+        for iid in InstanceIds:
+            self.instances[iid]['State']['Name'] = 'stopped'
+
+    def terminate_instances(self, InstanceIds):
+        for iid in InstanceIds:
+            self.instances[iid]['State']['Name'] = 'terminated'
+
+
+class FakeClientError(Exception):
+
+    def __init__(self, code, message):
+        super().__init__(message)
+        self.response = {'Error': {'Code': code, 'Message': message}}
+
+
+@pytest.fixture
+def ec2(monkeypatch):
+    fake = FakeEC2()
+    monkeypatch.setattr(aws_instance, 'client_factory',
+                        lambda region: fake)
+    monkeypatch.setattr(aws_instance, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _config(count=1, use_spot=False):
+    return common.ProvisionConfig(
+        provider_name='aws',
+        cluster_name='aws-c',
+        cluster_name_on_cloud='aws-c',
+        region='us-east-1',
+        zone='us-east-1a',
+        node_config={'instance_type': 'm6i.xlarge',
+                     'use_spot': use_spot, 'labels': {},
+                     'disk_size': 128, 'image_id': None},
+        count=count,
+    )
+
+
+# ----------------------------------------------------------- lifecycle
+
+
+def test_run_wait_query_info_terminate(ec2):
+    record = aws_instance.run_instances(_config(count=2))
+    assert len(record.created_instance_ids) == 2
+    assert record.head_instance_id == min(record.created_instance_ids)
+    aws_instance.wait_instances('aws-c', 'us-east-1', None, 'running')
+
+    statuses = aws_instance.query_instances('aws-c', 'us-east-1', None)
+    assert sorted(statuses.values()) == ['running', 'running']
+
+    info = aws_instance.get_cluster_info('aws-c', 'us-east-1', None)
+    assert info.num_hosts() == 2
+    assert info.ssh_user == 'ubuntu'
+    hosts = info.all_hosts()
+    assert hosts[0].instance_id == info.head_instance_id
+    assert hosts[0].external_ip.startswith('54.')
+
+    # Idempotent: re-running creates nothing new.
+    record2 = aws_instance.run_instances(_config(count=2))
+    assert record2.created_instance_ids == []
+
+    aws_instance.terminate_instances('aws-c', 'us-east-1', None)
+    assert aws_instance.query_instances('aws-c', 'us-east-1', None) == {}
+
+
+def test_stop_and_restart(ec2):
+    aws_instance.run_instances(_config(count=1))
+    aws_instance.stop_instances('aws-c', 'us-east-1', None)
+    statuses = aws_instance.query_instances('aws-c', 'us-east-1', None,
+                                            non_terminated_only=False)
+    assert list(statuses.values()) == ['stopped']
+    record = aws_instance.run_instances(_config(count=1))
+    assert record.resumed_instance_ids and not record.created_instance_ids
+    statuses = aws_instance.query_instances('aws-c', 'us-east-1', None)
+    assert list(statuses.values()) == ['running']
+
+
+def test_error_taxonomy(ec2):
+    ec2.run_error = FakeClientError(
+        'InsufficientInstanceCapacity',
+        'We currently do not have sufficient m6i.xlarge capacity')
+    with pytest.raises(exceptions.StockoutError):
+        aws_instance.run_instances(_config())
+    ec2.run_error = FakeClientError(
+        'VcpuLimitExceeded', 'You have requested more vCPU capacity '
+        'than your current limit')
+    with pytest.raises(exceptions.QuotaExceededError):
+        aws_instance.run_instances(_config())
+
+
+def test_spot_market_options(ec2):
+    calls = {}
+    orig = ec2.run_instances
+
+    def spy(**kwargs):
+        calls.update(kwargs)
+        return orig(**kwargs)
+
+    ec2.run_instances = spy
+    aws_instance.run_instances(_config(use_spot=True))
+    assert calls['InstanceMarketOptions']['MarketType'] == 'spot'
+
+
+# ------------------------------------------------------- optimization
+
+
+@pytest.fixture
+def both_clouds(monkeypatch):
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu.clouds import AWS, GCP
+    monkeypatch.setattr(check_lib, 'get_cached_enabled_clouds',
+                        lambda *a, **k: [GCP(), AWS()])
+    yield
+
+
+def test_optimizer_arbitrates_aws_vs_gcp(both_clouds, isolated_state):
+    from skypilot_tpu import catalog
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.resources import Resources
+
+    gcp_type = catalog.get_default_instance_type('8+', cloud='gcp')
+    aws_type = catalog.get_default_instance_type('8+', cloud='aws')
+    gcp_price = catalog.get_hourly_cost(gcp_type, cloud='gcp')
+    aws_price = catalog.get_hourly_cost(aws_type, cloud='aws')
+    cheaper = 'gcp' if gcp_price <= aws_price else 'aws'
+
+    with dag_lib.Dag() as dag:
+        t = task_lib.Task('cpu', run='echo hi')
+        t.set_resources(Resources(cpus='8+'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud.canonical_name() == cheaper
+    # Pinning the pricier cloud still works (explicit wins).
+    pricier = 'aws' if cheaper == 'gcp' else 'gcp'
+    with dag_lib.Dag() as dag:
+        t = task_lib.Task('cpu', run='echo hi')
+        t.set_resources(Resources(cloud=pricier, cpus='8+'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud.canonical_name() == pricier
+
+
+def test_failover_all_gcp_blocked_lands_on_aws(both_clouds,
+                                               isolated_state,
+                                               monkeypatch, tmp_path):
+    """Every GCP attempt stockouts; the backend moves to the AWS
+    candidate and provisions there."""
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backend import gang_backend
+    from skypilot_tpu.dag import Dag
+    from skypilot_tpu.provision import provisioner as provisioner_mod
+    from skypilot_tpu.resources import Resources
+
+    host_dir = tmp_path / 'host0'
+    host_dir.mkdir()
+    attempts = []
+
+    def fake_bulk(config):
+        attempts.append((config.provider_name, config.region))
+        if config.provider_name == 'gcp':
+            raise exceptions.StockoutError('zone out of capacity')
+        return common.ProvisionRecord(
+            provider_name=config.provider_name,
+            cluster_name_on_cloud=config.cluster_name_on_cloud,
+            region=config.region,
+            zone=config.zone,
+            created_instance_ids=['i-1'],
+            head_instance_id='i-1',
+        )
+
+    def fake_info(provider, name, region, zone):
+        return common.ClusterInfo(
+            provider_name=provider,
+            cluster_name_on_cloud=name,
+            region=region,
+            zone=zone,
+            instances={'i-1': [common.InstanceInfo(
+                instance_id='i-1', internal_ip='127.0.0.1',
+                external_ip=None,
+                tags={'host_dir': str(host_dir)})]},
+            head_instance_id='i-1',
+            provider_config={'cluster_dir': str(tmp_path)},
+        )
+
+    monkeypatch.setattr(provisioner_mod, 'bulk_provision', fake_bulk)
+    monkeypatch.setattr(gang_backend.provisioner, 'bulk_provision',
+                        fake_bulk)
+    monkeypatch.setattr(gang_backend.provision, 'get_cluster_info',
+                        fake_info)
+    monkeypatch.setattr(gang_backend.provision, 'terminate_instances',
+                        lambda *a, **k: None)
+    monkeypatch.setattr(gang_backend.provisioner,
+                        'post_provision_runtime_setup',
+                        lambda *a, **k: str(tmp_path / 'agent'))
+
+    with Dag() as dag:
+        t = task_lib.Task('cpu', run='echo hi')
+        t.set_resources(Resources(cpus='8+'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+
+    backend = gang_backend.GangBackend()
+    handle = backend._provision(t, t.best_resources, dryrun=False,
+                                stream_logs=False,
+                                cluster_name='xcloud')
+    assert handle is not None
+    assert handle.launched_resources.cloud.canonical_name() == 'aws'
+    gcp_attempts = [a for a in attempts if a[0] == 'gcp']
+    aws_attempts = [a for a in attempts if a[0] == 'aws']
+    assert gcp_attempts, 'GCP should have been tried first (cheaper)'
+    assert len(aws_attempts) == 1
+    # GCP was exhausted across multiple regions before the switch.
+    assert len({r for _, r in gcp_attempts}) > 1

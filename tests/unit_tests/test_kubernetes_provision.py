@@ -321,3 +321,32 @@ def test_optimizer_picks_kubernetes_when_only_cloud(
     chosen = dag.tasks[0].best_resources
     assert isinstance(chosen.cloud, Kubernetes)
     assert chosen.region == 'gke_test'
+
+
+def test_cpu_task_candidates_are_launchable(k8s_env, monkeypatch,
+                                            isolated_state):
+    """CPU-only tasks get a synthesized '<n>CPU--<m>GB' instance type
+    so optimizer cost sorting (which calls hourly_price ->
+    assert_launchable) cannot crash."""
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.clouds import Kubernetes
+    from skypilot_tpu.resources import Resources
+    k8s = Kubernetes()
+    feasible = k8s.get_feasible_launchable_resources(
+        Resources(cpus='8+'))
+    assert feasible and feasible[0].is_launchable()
+    assert feasible[0].instance_type == '8CPU--32.0GB'
+    assert feasible[0].hourly_price() == 0.0
+
+    monkeypatch.setattr(check_lib, 'get_cached_enabled_clouds',
+                        lambda *a, **k: [Kubernetes()])
+    with dag_lib.Dag() as dag:
+        t = task_lib.Task('cpu', run='echo hi')
+        t.set_resources(Resources(cpus='8+'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+    vars_ = Kubernetes().make_deploy_resources_variables(
+        t.best_resources, 'c', 'gke_test', None)
+    assert vars_['cpus'] == '8' and vars_['memory'] == '32.0'

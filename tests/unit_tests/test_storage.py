@@ -95,3 +95,66 @@ def test_file_mount_dir_lands_at_dst(tmp_path):
             status_lib.JobStatus.SUCCEEDED)
     finally:
         core.down('fmtest')
+
+
+def test_s3_store_commands():
+    from skypilot_tpu.data.storage import S3Store
+    s = S3Store('mybkt')
+    assert s.url() == 's3://mybkt'
+    assert 'aws s3 sync s3://mybkt /dst' in s.download_command('/dst')
+    m = s.mount_command('/mnt/data')
+    assert 'goofys' in m and 'mybkt /mnt/data' in m
+
+
+def test_cloud_stores_download_commands():
+    from skypilot_tpu.data import cloud_stores
+    assert cloud_stores.is_cloud_url('gs://b/k')
+    assert cloud_stores.is_cloud_url('s3://b/k')
+    assert cloud_stores.is_cloud_url('local://b/k')
+    assert not cloud_stores.is_cloud_url('/tmp/x')
+    assert not cloud_stores.is_cloud_url('./rel')
+
+    cmd = cloud_stores.download_command('gs://bkt/prefix/', '/data')
+    assert 'gsutil -m rsync -r gs://bkt/prefix /data' in cmd
+    cmd = cloud_stores.download_command('gs://bkt/file.txt', '/d/f.txt')
+    assert 'gsutil cp gs://bkt/file.txt /d/f.txt' in cmd
+    cmd = cloud_stores.download_command('s3://bkt/prefix/', '/data')
+    assert 'aws s3 sync s3://bkt/prefix /data' in cmd
+    with pytest.raises(Exception):
+        cloud_stores.download_command('gs://', '/data')
+
+
+def test_file_mounts_from_bucket_url_end_to_end(isolated_state):
+    """A local:// bucket URL in file_mounts lands on the cluster host
+    (the hermetic stand-in for gs://-sourced file_mounts)."""
+    import subprocess
+
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.data.storage import LocalStore
+
+    bucket_dir = os.path.join(LocalStore.bucket_root(), 'cfgbkt', 'sub')
+    os.makedirs(bucket_dir, exist_ok=True)
+    with open(os.path.join(bucket_dir, 'cfg.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('from-bucket')
+
+    task = task_lib.Task(
+        'bucketmount',
+        run='cat mounted/cfg.txt',
+        file_mounts={'mounted/': 'local://cfgbkt/sub/'})
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    job_id, handle = execution.launch(task, cluster_name='bkt-c',
+                                      stream_logs=False)
+    st = _wait_job('bkt-c', job_id, timeout=60)
+    assert st == status_lib.JobStatus.SUCCEEDED, st
+    # The job read the bucket-sourced file.
+    import glob
+    root = os.path.expanduser(handle.state_dir)
+    paths = glob.glob(os.path.join(root, 'jobs', str(job_id), '*.log'))
+    out = ''.join(
+        open(p, encoding='utf-8', errors='replace').read()
+        for p in paths)
+    assert 'from-bucket' in out
+    core.down('bkt-c')
