@@ -1,0 +1,27 @@
+# Team API server image (reference Dockerfile_k8s + charts/skypilot):
+# a shared skytpu API server that many clients point
+# SKYTPU_API_SERVER_ENDPOINT at. Cluster SSH keys and cloud
+# credentials are mounted, not baked.
+#
+#   docker build -t skytpu-api-server .
+#   docker run -p 46580:46580 \
+#     -v ~/.config/gcloud:/root/.config/gcloud:ro \
+#     -v skytpu-state:/root/.skytpu skytpu-api-server
+FROM python:3.12-slim
+
+RUN apt-get update && \
+    apt-get install -y --no-install-recommends \
+        openssh-client rsync curl && \
+    rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+    aiohttp requests filelock click pyyaml jsonschema numpy scipy \
+    psutil
+
+WORKDIR /app
+COPY skypilot_tpu /app/skypilot_tpu
+ENV PYTHONPATH=/app
+
+EXPOSE 46580
+CMD ["python", "-m", "skypilot_tpu.server.server", \
+     "--host", "0.0.0.0", "--port", "46580"]

@@ -68,7 +68,11 @@ def main():
             jax.random.fold_in(key, i), (global_batch, args.seq + 1), 0,
             cfg.vocab_size)
         batch = models.shard_batch({'tokens': tokens}, mesh)
-        state, metrics = step_fn(state, batch)
+        # One-shot XLA trace when SKYTPU_PROFILE_DIR is set (captured
+        # at step 2 so compile noise is excluded).
+        from skypilot_tpu.utils import profiling
+        with profiling.maybe_trace(step=i):
+            state, metrics = step_fn(state, batch)
         if i % 10 == 0 and jax.process_index() == 0:
             print(f'step {i} loss {float(metrics["loss"]):.4f}')
         if mngr is not None and (i + 1) % args.ckpt_every == 0:

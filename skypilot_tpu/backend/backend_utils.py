@@ -57,12 +57,59 @@ def _query_cloud_status(
     if values == {'running'}:
         return status_lib.ClusterStatus.UP
     if 'terminated' in values or None in values:
-        # Partial termination (e.g. one TPU host preempted) downs the
-        # whole slice from the scheduler's perspective.
-        return None
+        if values <= {'terminated', None}:
+            return None  # everything gone
+        # Partial termination (e.g. one TPU host preempted): the job
+        # is dead, but surviving instances still bill — DEGRADED, not
+        # gone (removing the record here would orphan them; reference
+        # _update_cluster_status keeps such clusters visible as INIT).
+        return status_lib.ClusterStatus.DEGRADED
     if values == {'stopped'}:
         return status_lib.ClusterStatus.STOPPED
     return status_lib.ClusterStatus.INIT
+
+
+def _agent_alive(handle: 'gang_backend.GangResourceHandle') -> bool:
+    """Is agentd running on the head host? (the 'ray status' health
+    probe of the reference, backend_utils.py:900)."""
+    try:
+        from skypilot_tpu.agent import constants as agent_constants
+        from skypilot_tpu.utils import command_runner as runner_lib
+        pid_file = runner_lib.shell_path(os.path.join(
+            handle.state_dir, agent_constants.AGENT_PID_FILE))
+        rc = handle.head_runner().run(
+            f'kill -0 $(cat {pid_file}) 2>/dev/null')
+        return rc == 0
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def _check_owner_identity(
+        rec: Dict[str, Any],
+        handle: 'gang_backend.GangResourceHandle') -> None:
+    """Refuse to reconcile a cluster launched under another cloud
+    identity (reference _update_cluster_status's multi-identity
+    safety, sky/backends/backend_utils.py:1757): operating on it with
+    different credentials would tear down / bill someone else's
+    resources."""
+    owner = rec.get('owner')
+    if not owner:
+        return
+    try:
+        cloud = handle.launched_resources.cloud
+        current = cloud.get_user_identities()
+    except Exception:  # pylint: disable=broad-except
+        return
+    if not current:
+        return
+    flat_current = [i for ids in current for i in ids]
+    flat_owner = owner.split(',')
+    if not set(flat_owner) & set(flat_current):
+        raise exceptions.ClusterOwnerIdentityMismatchError(
+            f'Cluster {rec["name"]!r} was launched by identity '
+            f'{owner!r}; current cloud identity is {flat_current!r}. '
+            'Switch back to the owning account (or remove the record '
+            'with `skytpu down --purge`).')
 
 
 def refresh_cluster_record(
@@ -85,6 +132,7 @@ def refresh_cluster_record(
         if rec is None:
             return None
         handle = rec['handle']
+        _check_owner_identity(rec, handle)
         try:
             cloud_status = _query_cloud_status(handle)
         except Exception as e:  # pylint: disable=broad-except
@@ -96,6 +144,48 @@ def refresh_cluster_record(
                         'removing record.', cluster_name)
             global_user_state.remove_cluster(cluster_name, terminate=True)
             return None
+        if (cloud_status == status_lib.ClusterStatus.STOPPED and
+                rec.get('to_down') and rec.get('autostop', -1) >= 0):
+            # Autodown on refresh: the user asked for DOWN, but the
+            # agent only got as far as stopping (or died after the
+            # stop) — finish the teardown now (reference autodown
+            # handling in _update_cluster_status).
+            logger.info('Cluster %s is STOPPED with autodown set; '
+                        'terminating it now.', cluster_name)
+            try:
+                provision.terminate_instances(
+                    handle.provider_name, handle.cluster_name_on_cloud,
+                    handle.region, handle.zone)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('Autodown-on-refresh failed for %s: %r',
+                               cluster_name, e)
+                return rec
+            global_user_state.remove_cluster(cluster_name,
+                                             terminate=True)
+            return None
+        if (cloud_status == status_lib.ClusterStatus.UP and
+                rec['status'] == status_lib.ClusterStatus.INIT):
+            # INIT-stuck handling: instances run but the record never
+            # left INIT (the provisioning process died mid-flight, or
+            # a crash raced the DB write). If no provisioning is in
+            # flight (lock free) the truth is the agent: alive -> the
+            # cluster is genuinely usable, promote to UP; dead -> stay
+            # INIT so `start` re-runs runtime setup.
+            from skypilot_tpu.backend import gang_backend as gb
+            lock = cluster_file_lock(
+                gb.GangBackend._lock_name(cluster_name))
+            provisioning_in_flight = True
+            try:
+                with lock.acquire(timeout=0):
+                    provisioning_in_flight = False
+                    if _agent_alive(handle):
+                        cloud_status = status_lib.ClusterStatus.UP
+                    else:
+                        cloud_status = status_lib.ClusterStatus.INIT
+            except filelock.Timeout:
+                pass
+            if provisioning_in_flight:
+                cloud_status = status_lib.ClusterStatus.INIT
         if cloud_status != rec['status']:
             global_user_state.update_cluster_status(cluster_name,
                                                     cloud_status)

@@ -268,6 +268,13 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 last_error: Optional[Exception] = None
                 cluster_info = None
                 for cand in candidates:
+                    # Name-length limits are per cloud: recompute for
+                    # the candidate actually being tried (a name legal
+                    # on AWS (50) can violate GCP's 35-char cap).
+                    cand_max = cand.cloud.MAX_CLUSTER_NAME_LEN_LIMIT or 64
+                    cluster_name_on_cloud = (
+                        common_utils.make_cluster_name_on_cloud(
+                            cluster_name, cand_max))
                     prov = RetryingProvisioner(
                         cluster_name, cluster_name_on_cloud,
                         retry_until_up=False,
@@ -323,6 +330,14 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
             global_user_state.add_or_update_cluster(
                 cluster_name, handle, requested_resources=set(task.resources),
                 ready=True)
+            try:
+                identities = to_provision.cloud.get_user_identities()
+                if identities:
+                    global_user_state.set_cluster_owner(
+                        cluster_name,
+                        ','.join(identities[0]))
+            except Exception:  # pylint: disable=broad-except
+                pass  # identity is best-effort safety metadata
             return handle
 
     @staticmethod

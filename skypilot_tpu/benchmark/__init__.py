@@ -1,0 +1,10 @@
+"""`skytpu bench` — run one task on N candidate TPU types, rank by
+$/step (reference ``sky/benchmark/benchmark_utils.py`` +
+``benchmark_state.py``)."""
+from skypilot_tpu.benchmark.benchmark_utils import (collect_results,
+                                                    down_benchmark,
+                                                    launch_benchmark,
+                                                    report)
+
+__all__ = ['launch_benchmark', 'collect_results', 'report',
+           'down_benchmark']

@@ -1,0 +1,91 @@
+"""Benchmark SQLite state: benchmarks + per-candidate results
+(reference ``sky/benchmark/benchmark_state.py``)."""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH_ENV = 'SKYTPU_BENCHMARK_DB'
+_DEFAULT_DB = '~/.skytpu/benchmark.db'
+
+
+def _conn() -> sqlite3.Connection:
+    path = os.path.expanduser(
+        os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(path, timeout=10)
+    conn.row_factory = sqlite3.Row
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS benchmarks (
+            name TEXT PRIMARY KEY,
+            task_json TEXT,
+            created_at REAL
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS candidates (
+            benchmark TEXT,
+            cluster_name TEXT,
+            resources_repr TEXT,
+            hourly_price REAL,
+            job_id INTEGER,
+            num_steps INTEGER,
+            seconds_per_step REAL,
+            cost_per_step REAL,
+            status TEXT DEFAULT 'RUNNING',
+            PRIMARY KEY (benchmark, cluster_name)
+        )""")
+    return conn
+
+
+def add_benchmark(name: str, task_json: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmarks (name, task_json, '
+            'created_at) VALUES (?,?,?)',
+            (name, task_json, time.time()))
+
+
+def add_candidate(benchmark: str, cluster_name: str,
+                  resources_repr: str, hourly_price: float,
+                  job_id: Optional[int]) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO candidates (benchmark, '
+            'cluster_name, resources_repr, hourly_price, job_id) '
+            'VALUES (?,?,?,?,?)',
+            (benchmark, cluster_name, resources_repr, hourly_price,
+             job_id))
+
+
+def update_candidate(benchmark: str, cluster_name: str,
+                     **fields: Any) -> None:
+    sets = ', '.join(f'{k} = ?' for k in fields)
+    with _conn() as conn:
+        conn.execute(
+            f'UPDATE candidates SET {sets} WHERE benchmark = ? AND '
+            'cluster_name = ?',
+            list(fields.values()) + [benchmark, cluster_name])
+
+
+def get_candidates(benchmark: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        return [dict(r) for r in conn.execute(
+            'SELECT * FROM candidates WHERE benchmark = ? '
+            'ORDER BY cluster_name', (benchmark,))]
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        return [dict(r) for r in conn.execute(
+            'SELECT * FROM benchmarks ORDER BY name')]
+
+
+def remove_benchmark(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM benchmarks WHERE name = ?', (name,))
+        conn.execute('DELETE FROM candidates WHERE benchmark = ?',
+                     (name,))

@@ -1,0 +1,128 @@
+"""Benchmark harness: launch one task on N candidate resources,
+collect per-step timings from the in-task callback, rank by $/step.
+
+Re-design of reference ``sky/benchmark/benchmark_utils.py``: the
+reference pulls ``sky-callback`` summaries out of a shared bucket;
+here the harness reads each candidate's ``summary.json`` straight off
+the cluster head through its command runner — no bucket dependency,
+and the whole loop runs hermetically on the local cloud. The natural
+TPU use: `skytpu bench` one finetune recipe across v5e/v5p/v6e and
+read off $/step before committing to a long run.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import callbacks
+from skypilot_tpu import execution
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_REMOTE_BENCH_DIR = '~/skytpu_bench'
+
+
+def _cluster_name(benchmark: str, idx: int) -> str:
+    return f'skytpu-bench-{benchmark}-{idx}'
+
+
+def launch_benchmark(task: 'task_lib.Task',
+                     candidates: List,
+                     benchmark: str) -> List[str]:
+    """Launch ``task`` once per candidate Resources. Returns cluster
+    names (one per candidate, named skytpu-bench-<name>-<i>)."""
+    import copy
+    benchmark_state.add_benchmark(
+        benchmark, json.dumps(task.to_yaml_config()))
+    clusters = []
+    for idx, resources in enumerate(candidates):
+        cluster = _cluster_name(benchmark, idx)
+        cand_task = copy.deepcopy(task)
+        cand_task.set_resources(resources)
+        envs = dict(cand_task.envs or {})
+        envs[callbacks.ENV_DIR] = _REMOTE_BENCH_DIR
+        cand_task.update_envs(envs)
+        job_id, _ = execution.launch(cand_task, cluster_name=cluster,
+                                     detach_run=True,
+                                     stream_logs=False)
+        try:
+            price = resources.hourly_price()
+        except Exception:  # pylint: disable=broad-except
+            price = 0.0
+        benchmark_state.add_candidate(benchmark, cluster,
+                                      repr(resources), price, job_id)
+        clusters.append(cluster)
+        logger.info('Benchmark %s: candidate %d (%r) -> %s.',
+                    benchmark, idx, resources, cluster)
+    return clusters
+
+
+def _read_summary(cluster: str) -> Optional[Dict[str, Any]]:
+    from skypilot_tpu.backend import backend_utils
+    from skypilot_tpu.utils import command_runner as runner_lib
+    try:
+        handle = backend_utils.check_cluster_available(cluster)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    runner = handle.head_runner()
+    path = runner_lib.shell_path(
+        f'{_REMOTE_BENCH_DIR}/{callbacks.SUMMARY}')
+    rc, out, _ = runner.run(f'cat {path}', require_outputs=True)
+    if rc != 0:
+        return None
+    try:
+        return json.loads(out)
+    except json.JSONDecodeError:
+        return None
+
+
+def collect_results(benchmark: str) -> List[Dict[str, Any]]:
+    """Pull summaries off every candidate cluster and update state."""
+    from skypilot_tpu import core
+    rows = benchmark_state.get_candidates(benchmark)
+    for row in rows:
+        cluster = row['cluster_name']
+        summary = _read_summary(cluster)
+        if summary is None or summary.get('num_steps', 0) < 2:
+            continue
+        steps = summary['num_steps']
+        span = summary['last_step'] - summary['first_step']
+        sec_per_step = span / max(1, steps - 1)
+        cost_per_step = row['hourly_price'] * sec_per_step / 3600.0
+        status = 'RUNNING'
+        try:
+            job_status = core.job_status(
+                cluster, [row['job_id']])[row['job_id']]
+            if job_status is not None and job_status.is_terminal():
+                status = str(job_status.value)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        benchmark_state.update_candidate(
+            benchmark, cluster, num_steps=steps,
+            seconds_per_step=sec_per_step,
+            cost_per_step=cost_per_step, status=status)
+    return benchmark_state.get_candidates(benchmark)
+
+
+def report(benchmark: str) -> List[Dict[str, Any]]:
+    """Ranked candidates: cheapest $/step first (ties: fastest)."""
+    rows = collect_results(benchmark)
+    measured = [r for r in rows if r['seconds_per_step'] is not None]
+    unmeasured = [r for r in rows if r['seconds_per_step'] is None]
+    measured.sort(key=lambda r: (r['cost_per_step'],
+                                 r['seconds_per_step']))
+    return measured + unmeasured
+
+
+def down_benchmark(benchmark: str) -> None:
+    """Tear down every candidate cluster and forget the benchmark."""
+    from skypilot_tpu import core
+    for row in benchmark_state.get_candidates(benchmark):
+        try:
+            core.down(row['cluster_name'])
+        except Exception:  # pylint: disable=broad-except
+            pass
+    benchmark_state.remove_benchmark(benchmark)

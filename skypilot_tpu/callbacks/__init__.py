@@ -1,0 +1,74 @@
+"""In-training callback emitting per-step timing for `skytpu bench`.
+
+Re-design of the reference's ``sky-callback`` package
+(``sky/callbacks/sky_callback/base.py:21``): training code calls
+``step()`` (or wraps its loop in ``step_iterator``), and a
+``summary.json`` lands in ``$SKYTPU_BENCHMARK_DIR`` after every step;
+the benchmark harness syncs these summaries down and ranks candidate
+TPU types by $/step and time/step.
+
+Usage::
+
+    from skypilot_tpu import callbacks
+    cb = callbacks.BenchmarkCallback(total_steps=1000)
+    for batch in data:
+        ...train...
+        cb.step()
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Iterable, Iterator, Optional
+
+ENV_DIR = 'SKYTPU_BENCHMARK_DIR'
+SUMMARY = 'summary.json'
+
+
+class BenchmarkCallback:
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 total_steps: Optional[int] = None) -> None:
+        self.log_dir = os.path.expanduser(
+            log_dir or os.environ.get(ENV_DIR, '~/skytpu_bench'))
+        os.makedirs(self.log_dir, exist_ok=True)
+        self.total_steps = total_steps
+        self.created = time.time()
+        self.num_steps = 0
+        self.first_step: Optional[float] = None
+        self.last_step: Optional[float] = None
+
+    def step(self) -> None:
+        now = time.time()
+        self.num_steps += 1
+        if self.first_step is None:
+            self.first_step = now
+        self.last_step = now
+        self._write()
+
+    # Alias matching the reference's callback API surface.
+    on_step_end = step
+
+    def _write(self) -> None:
+        path = os.path.join(self.log_dir, SUMMARY)
+        payload = {
+            'created': self.created,
+            'num_steps': self.num_steps,
+            'first_step': self.first_step,
+            'last_step': self.last_step,
+            'total_steps': self.total_steps,
+        }
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+
+def step_iterator(iterable: Iterable,
+                  total_steps: Optional[int] = None) -> Iterator:
+    """Wrap a training loop: ``for batch in step_iterator(data): ...``"""
+    cb = BenchmarkCallback(total_steps=total_steps)
+    for item in iterable:
+        yield item
+        cb.step()

@@ -237,6 +237,17 @@ def jobs_cancel(job_ids, all_jobs: bool) -> None:
     click.echo(f'Cancelled: {result["cancelled"]}')
 
 
+@jobs.command('dashboard')
+@click.option('--port', type=int, default=46581)
+def jobs_dashboard(port: int) -> None:
+    """Serve the managed-jobs dashboard (reference sky/jobs/dashboard)."""
+    click.echo(f'Dashboard on http://127.0.0.1:{port}')
+    import subprocess
+    import sys
+    subprocess.run([sys.executable, '-m', 'skypilot_tpu.jobs.dashboard',
+                    '--port', str(port)], check=False)
+
+
 @jobs.command('logs')
 @click.argument('job_id', type=int)
 def jobs_logs(job_id: int) -> None:
@@ -245,6 +256,62 @@ def jobs_logs(job_id: int) -> None:
 
 
 # ------------------------------------------------------------- serve
+
+
+@cli.group()
+def bench() -> None:
+    """Benchmark a task across candidate TPU types (reference
+    `sky bench`)."""
+
+
+@bench.command('launch')
+@click.argument('entrypoint')
+@click.option('--benchmark', '-b', required=True,
+              help='Benchmark name.')
+@click.option('--candidates', required=True,
+              help='Comma-separated accelerator list, e.g. '
+              '"tpu-v5e-8,tpu-v6e-8"; or "cloud:local" entries.')
+def bench_launch(entrypoint: str, benchmark: str,
+                 candidates: str) -> None:
+    from skypilot_tpu import benchmark as bench_lib
+    from skypilot_tpu import resources as resources_lib
+    task = _load_task(entrypoint)
+    res = []
+    for cand in candidates.split(','):
+        cand = cand.strip()
+        if cand.startswith('cloud:'):
+            res.append(resources_lib.Resources(cloud=cand[6:]))
+        else:
+            res.append(resources_lib.Resources(accelerators=cand))
+    clusters = bench_lib.launch_benchmark(task, res, benchmark)
+    click.echo(f'Benchmark {benchmark}: {len(clusters)} candidates '
+               f'launched: {", ".join(clusters)}')
+
+
+@bench.command('show')
+@click.argument('benchmark')
+def bench_show(benchmark: str) -> None:
+    from skypilot_tpu import benchmark as bench_lib
+    rows = bench_lib.report(benchmark)
+    _echo_table([{
+        'cluster': r['cluster_name'],
+        'resources': r['resources_repr'],
+        'steps': r['num_steps'] or '-',
+        's/step': (round(r['seconds_per_step'], 4)
+                   if r['seconds_per_step'] else '-'),
+        '$/step': (round(r['cost_per_step'], 6)
+                   if r['cost_per_step'] is not None else '-'),
+        'status': r['status'],
+    } for r in rows], ['cluster', 'resources', 'steps', 's/step',
+                       '$/step', 'status'])
+
+
+@bench.command('down')
+@click.argument('benchmark')
+def bench_down(benchmark: str) -> None:
+    from skypilot_tpu import benchmark as bench_lib
+    bench_lib.down_benchmark(benchmark)
+    click.echo(f'Benchmark {benchmark} torn down.')
 
 
 @cli.group()

@@ -128,31 +128,41 @@ def api_cancel(request_id: str) -> bool:
 # ------------------------------------------------------------ SDK calls
 
 
+def _server_is_local() -> bool:
+    """True when the API server shares this machine's filesystem (the
+    default autostarted loopback server)."""
+    from urllib.parse import urlparse
+    host = urlparse(ensure_server()).hostname or ''
+    return host in ('127.0.0.1', 'localhost', '::1')
+
+
 def upload_workdir(workdir: str) -> str:
     """Zip + upload a workdir; returns the server-side path
-    (reference chunked upload, sky/server/server.py:312)."""
-    import io
+    (reference chunked upload, sky/server/server.py:312). The zip is
+    spooled to disk past 32 MiB so huge workdirs don't live in RAM."""
+    import tempfile
     import zipfile
     url = ensure_server()
     src = os.path.abspath(os.path.expanduser(workdir))
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, 'w', zipfile.ZIP_DEFLATED) as zf:
-        for root, dirs, files in os.walk(src):
-            dirs[:] = [d for d in dirs if d != '.git']
-            for fname in files:
-                full = os.path.join(root, fname)
-                zf.write(full, os.path.relpath(full, src))
-    resp = http.post(f'{url}/api/upload', data=buf.getvalue(),
-                     timeout=600)
+    with tempfile.SpooledTemporaryFile(
+            max_size=32 * 1024 * 1024) as buf:
+        with zipfile.ZipFile(buf, 'w', zipfile.ZIP_DEFLATED) as zf:
+            for root, dirs, files in os.walk(src):
+                dirs[:] = [d for d in dirs if d != '.git']
+                for fname in files:
+                    full = os.path.join(root, fname)
+                    zf.write(full, os.path.relpath(full, src))
+        buf.seek(0)
+        resp = http.post(f'{url}/api/upload', data=buf, timeout=600)
     resp.raise_for_status()
     return resp.json()['path']
 
 
 def _task_body(task, **extra) -> Dict[str, Any]:
     config = task.to_yaml_config()
-    # The server may run on another machine (team deployment): ship
-    # the workdir through it rather than assuming a shared filesystem.
-    if config.get('workdir'):
+    # A remote (team) server has no shared filesystem: ship the
+    # workdir through it. A loopback server reads the path directly.
+    if config.get('workdir') and not _server_is_local():
         config['workdir'] = upload_workdir(config['workdir'])
     return {'task': config, **extra}
 

@@ -44,18 +44,18 @@ def download_command(url: str, dst: str,
         is_dir = url.endswith('/') or not posixpath.splitext(key)[1]
     src = url.rstrip('/')
     q_dst = shlex.quote(dst)
-    if scheme == 'gs':
+    if scheme in ('gs', 's3'):
+        # Directory fetches reuse the Store classes' own download
+        # commands (one place owns the gsutil/aws CLI invocations);
+        # only the single-object copy is specific to this module.
+        cls = (storage_lib.GcsStore if scheme == 'gs'
+               else storage_lib.S3Store)
+        store = cls(f'{bucket}/{key}'.rstrip('/') if key else bucket)
         if is_dir:
-            return (f'mkdir -p {q_dst} && '
-                    f'gsutil -m rsync -r {shlex.quote(src)} {q_dst}')
+            return store.download_command(dst)
+        tool = ('gsutil cp' if scheme == 'gs' else 'aws s3 cp')
         return (f'mkdir -p $(dirname {q_dst}) && '
-                f'gsutil cp {shlex.quote(src)} {q_dst}')
-    if scheme == 's3':
-        if is_dir:
-            return (f'mkdir -p {q_dst} && '
-                    f'aws s3 sync {shlex.quote(src)} {q_dst}')
-        return (f'mkdir -p $(dirname {q_dst}) && '
-                f'aws s3 cp {shlex.quote(src)} {q_dst}')
+                f'{tool} {shlex.quote(src)} {q_dst}')
     # local:// — hermetic bucket directory.
     root = storage_lib.LocalStore.bucket_root()
     path = shlex.quote(f'{root}/{bucket}/{key}'.rstrip('/'))

@@ -152,6 +152,16 @@ def update_cluster_status(cluster_name: str,
         conn.commit()
 
 
+def set_cluster_owner(cluster_name: str, owner: str) -> None:
+    """Record the cloud identity that launched the cluster (comma-
+    joined; compared on every refresh for multi-identity safety)."""
+    with _lock:
+        conn = _conn()
+        conn.execute('UPDATE clusters SET owner=? WHERE name=?',
+                     (owner, cluster_name))
+        conn.commit()
+
+
 def update_cluster_handle(cluster_name: str, cluster_handle: Any) -> None:
     with _lock:
         conn = _conn()

@@ -1,0 +1,101 @@
+"""Managed-jobs dashboard: one self-refreshing HTML page + JSON API.
+
+Re-design of reference ``sky/jobs/dashboard/`` (a Flask app templated
+over the jobs table) on aiohttp (already a dependency via the API
+server) with zero static assets.
+
+Run: ``python -m skypilot_tpu.jobs.dashboard --port 46581``
+then open http://localhost:46581.
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import time
+
+from aiohttp import web
+
+from skypilot_tpu.jobs import core as jobs_core
+
+_PAGE = """<!doctype html>
+<html><head><title>skytpu jobs</title>
+<meta http-equiv="refresh" content="10">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ text-align: left; padding: .4rem .8rem;
+           border-bottom: 1px solid #ddd; }}
+ th {{ background: #f5f5f5; }}
+ .RUNNING {{ color: #0a7d32; font-weight: 600; }}
+ .RECOVERING {{ color: #b58900; font-weight: 600; }}
+ .SUCCEEDED {{ color: #555; }}
+ .FAILED, .FAILED_SETUP, .FAILED_CONTROLLER, .FAILED_NO_RESOURCE
+   {{ color: #c0392b; font-weight: 600; }}
+</style></head>
+<body><h2>Managed jobs</h2>
+<p>{now} &middot; {n} job(s) &middot; auto-refreshes every 10s
+&middot; <a href="/api/jobs">JSON</a></p>
+<table><tr><th>ID</th><th>Name</th><th>Status</th><th>Cluster</th>
+<th>Recoveries</th><th>Submitted</th><th>Failure</th></tr>
+{rows}</table></body></html>"""
+
+
+def _fmt_ts(ts) -> str:
+    if not ts:
+        return '-'
+    return time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(ts))
+
+
+def _rows() -> list:
+    return jobs_core.queue(refresh=True)
+
+
+async def handle_index(request: web.Request) -> web.Response:
+    rows = []
+    jobs = _rows()
+    for j in jobs:
+        status = j['status'].value
+        rows.append(
+            f'<tr><td>{j["job_id"]}</td>'
+            f'<td>{html.escape(str(j["name"]))}</td>'
+            f'<td class="{status}">{status}</td>'
+            f'<td>{html.escape(str(j["cluster_name"]))}</td>'
+            f'<td>{j["recovery_count"]}</td>'
+            f'<td>{_fmt_ts(j["submitted_at"])}</td>'
+            f'<td>{html.escape(str(j.get("failure_reason") or ""))}'
+            '</td></tr>')
+    page = _PAGE.format(now=_fmt_ts(time.time()), n=len(jobs),
+                        rows='\n'.join(rows))
+    return web.Response(text=page, content_type='text/html')
+
+
+async def handle_jobs_json(request: web.Request) -> web.Response:
+    jobs = []
+    for j in _rows():
+        j = dict(j)
+        j['status'] = j['status'].value
+        j.pop('dag', None)
+        jobs.append(j)
+    return web.json_response(jobs, dumps=lambda o: json.dumps(
+        o, default=str))
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get('/', handle_index)
+    app.router.add_get('/api/jobs', handle_jobs_json)
+    return app
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=46581)
+    args = parser.parse_args()
+    web.run_app(make_app(), host=args.host, port=args.port,
+                print=lambda *a: None)
+
+
+if __name__ == '__main__':
+    main()

@@ -50,7 +50,8 @@ def _conn() -> sqlite3.Connection:
             cancel_requested INTEGER DEFAULT 0,
             log_path TEXT,
             dag_json TEXT,
-            schedule_state TEXT DEFAULT 'INACTIVE'
+            schedule_state TEXT DEFAULT 'INACTIVE',
+            controller_job_id INTEGER
         )""")
     if path not in _migrated_paths:
         # Migrate pre-schema DBs once per process, not on every

@@ -184,10 +184,10 @@ def _attention(q, k, v, cfg: LlamaConfig, mesh):
         else:
             impl = 'xla'
     if impl == 'ring':
+        # GQA-native: K/V stay at n_kv_heads through the ring (a
+        # pre-repeat would multiply K/V HBM and per-hop ICI traffic
+        # by n_heads/n_kv_heads — 4x for Llama-8B's 8:1 GQA).
         assert mesh is not None, 'ring attention needs a mesh'
-        rep = cfg.n_heads // cfg.n_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
         return ring_attention_sharded(q, k, v, mesh, causal=True)
     if impl == 'flash':
         return flash_attention(q, k, v, causal=True)

@@ -278,6 +278,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q,
     sk = k.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    # Same divisibility contract as the forward: a silent floor-div
+    # here would skip the tail blocks and return wrong gradients.
+    assert sq % block_q == 0, (sq, block_q)
+    assert sk % block_k == 0, (sk, block_k)
     nq, nk = sq // block_q, sk // block_k
 
     q_spec = pl.BlockSpec((1, 1, block_q, d),

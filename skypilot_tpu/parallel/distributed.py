@@ -25,6 +25,11 @@ def initialize_from_env(env: Optional[dict] = None) -> bool:
     global _initialized
     if _initialized:
         return True
+    # Every worker exposes a profiler endpoint when asked — the
+    # capture hook of SURVEY.md §5 (TensorBoard attaches to
+    # <worker_ip>:$SKYTPU_PROFILER_PORT on a live job).
+    from skypilot_tpu.utils import profiling
+    profiling.maybe_start_profiler_server()
     kw = env_contract.jax_distributed_kwargs(env)
     if kw['num_processes'] <= 1:
         return False

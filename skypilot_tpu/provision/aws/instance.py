@@ -100,6 +100,15 @@ def run_instances(
     created, resumed = [], []
 
     if stopped:
+        # 'stopping' instances cannot be started yet — wait for them
+        # to settle (EC2 raises IncorrectInstanceState otherwise).
+        deadline = time.time() + 300
+        while (any(i['State']['Name'] == 'stopping' for i in stopped)
+               and time.time() < deadline):
+            time.sleep(_POLL_INTERVAL)
+            stopped = [i for i in
+                       _list_instances(ec2, config.cluster_name_on_cloud)
+                       if i['State']['Name'] in ('stopping', 'stopped')]
         ids = [i['InstanceId'] for i in stopped]
         try:
             ec2.start_instances(InstanceIds=ids)

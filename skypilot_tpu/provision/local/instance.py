@@ -119,10 +119,14 @@ def query_instances(
     status = meta['status']
     if non_terminated_only and status == 'terminated':
         return {}
-    return {
-        f'local-{cluster_name_on_cloud}-{i}': status
-        for i in range(meta['num_hosts'])
-    }
+    dead = set(meta.get('dead_hosts') or [])
+    out = {}
+    for i in range(meta['num_hosts']):
+        host_status = 'terminated' if i in dead else status
+        if non_terminated_only and host_status == 'terminated':
+            continue
+        out[f'local-{cluster_name_on_cloud}-{i}'] = host_status
+    return out
 
 
 def get_cluster_info(cluster_name_on_cloud: str, region: str,
@@ -263,6 +267,20 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str], region: str,
 def cleanup_ports(cluster_name_on_cloud: str, region: str,
                   zone: Optional[str]) -> None:
     pass
+
+
+def preempt_host(cluster_name_on_cloud: str, host_index: int) -> None:
+    """Fault injection: kill ONE host of a slice (partial loss). The
+    cluster degrades — cloud truth shows a mixed
+    running/terminated host set, which status reconciliation must
+    surface as DEGRADED, not as a vanished cluster."""
+    meta = _read_meta(cluster_name_on_cloud)
+    if meta is None:
+        return
+    dead = set(meta.get('dead_hosts') or [])
+    dead.add(host_index)
+    meta['dead_hosts'] = sorted(dead)
+    _write_meta(cluster_name_on_cloud, meta)
 
 
 # ----------------------------------------------------------------------

@@ -176,7 +176,9 @@ async def handle_health(request: web.Request) -> web.Response:
 
 
 def make_app() -> web.Application:
-    app = web.Application()
+    # Workdir zips route through /api/upload — aiohttp's default
+    # 1 MiB body cap would reject any real project.
+    app = web.Application(client_max_size=4 * 1024**3)
     app.router.add_get('/api/health', handle_health)
     app.router.add_get('/api/get', handle_get)
     app.router.add_get('/api/status', handle_status_poll)

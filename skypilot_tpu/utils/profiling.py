@@ -1,0 +1,62 @@
+"""jax.profiler hooks for pod workers (SURVEY.md §5 tracing).
+
+Two knobs, both env-driven so recipes need no code changes:
+
+- ``SKYTPU_PROFILER_PORT``: start jax.profiler's gRPC server on every
+  worker at init (``initialize_from_env`` calls
+  ``maybe_start_profiler_server``); attach TensorBoard's profile
+  capture to ``<worker_ip>:<port>`` for on-demand traces of a live
+  job — the TPU counterpart of the reference's timeline tracing
+  (sky/utils/timeline.py), but at the XLA/HLO level.
+- ``SKYTPU_PROFILE_DIR``: bounded automatic capture — ``maybe_trace``
+  wraps a region (e.g. one train step) in ``jax.profiler.trace``
+  writing a TensorBoard-loadable trace there, once.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+PROFILER_PORT_ENV = 'SKYTPU_PROFILER_PORT'
+PROFILE_DIR_ENV = 'SKYTPU_PROFILE_DIR'
+
+_server_started = False
+_traced_once = False
+
+
+def maybe_start_profiler_server() -> Optional[int]:
+    """Start jax.profiler's server if SKYTPU_PROFILER_PORT is set."""
+    global _server_started
+    port = os.environ.get(PROFILER_PORT_ENV)
+    if not port or _server_started:
+        return None
+    import jax
+    jax.profiler.start_server(int(port))
+    _server_started = True
+    logger.info('jax.profiler server listening on :%s.', port)
+    return int(port)
+
+
+@contextlib.contextmanager
+def maybe_trace(step: Optional[int] = None,
+                capture_step: int = 2) -> Iterator[None]:
+    """Trace this region to $SKYTPU_PROFILE_DIR (once, at
+    ``capture_step`` so compilation noise from step 0/1 is skipped)."""
+    global _traced_once
+    log_dir = os.environ.get(PROFILE_DIR_ENV)
+    should = (log_dir and not _traced_once and
+              (step is None or step == capture_step))
+    if not should:
+        yield
+        return
+    import jax
+    _traced_once = True
+    os.makedirs(os.path.expanduser(log_dir), exist_ok=True)
+    logger.info('Capturing jax.profiler trace to %s.', log_dir)
+    with jax.profiler.trace(os.path.expanduser(log_dir)):
+        yield

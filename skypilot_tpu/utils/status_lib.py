@@ -12,13 +12,18 @@ import enum
 
 
 class ClusterStatus(enum.Enum):
-    """Cluster lifecycle: INIT -> UP -> STOPPED -> (terminated: row removed)."""
+    """Cluster lifecycle: INIT -> UP -> STOPPED -> (terminated: row
+    removed). DEGRADED = some (not all) hosts gone — on a TPU slice
+    the job is dead, but billable instances remain, so the record must
+    survive until teardown."""
     INIT = 'INIT'
     UP = 'UP'
     STOPPED = 'STOPPED'
+    DEGRADED = 'DEGRADED'
 
     def colored_str(self) -> str:
-        color = {'INIT': 'yellow', 'UP': 'green', 'STOPPED': 'cyan'}[self.value]
+        color = {'INIT': 'yellow', 'UP': 'green', 'STOPPED': 'cyan',
+                 'DEGRADED': 'red'}[self.value]
         return f'[{color}]{self.value}[/{color}]'
 
 

@@ -167,6 +167,9 @@ def test_remote_server_workdir_upload_and_log_download(
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     url = f'http://127.0.0.1:{port}'
     monkeypatch.setenv('SKYTPU_API_SERVER_ENDPOINT', url)
+    # Loopback servers share the filesystem, so the SDK would skip the
+    # upload; pretend the server is remote to exercise the full path.
+    monkeypatch.setattr(sdk, '_server_is_local', lambda: False)
     try:
         deadline = time.time() + 30
         while time.time() < deadline:

@@ -1,0 +1,131 @@
+"""Status reconciliation drift scenarios (reference
+_update_cluster_status, sky/backends/backend_utils.py:1757), driven
+through the Local provider's fault injection: partial slice loss ->
+DEGRADED, full loss -> record removed, autodown-on-refresh,
+INIT-stuck promotion/demotion, and owner-identity safety."""
+import os
+import time
+
+import pytest
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import backend_utils
+from skypilot_tpu.provision.local import instance as local_instance
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import status_lib
+
+
+def _launch(name, accel='tpu-v5e-16', run='sleep 60'):
+    task = task_lib.Task(name, run=run)
+    task.set_resources(
+        resources_lib.Resources(cloud='local', accelerators=accel))
+    execution.launch(task, cluster_name=name, stream_logs=False)
+    return common_utils.make_cluster_name_on_cloud(name)
+
+
+def test_partial_slice_loss_is_degraded(isolated_state):
+    on_cloud = _launch('drift-a')
+    record = backend_utils.refresh_cluster_record('drift-a',
+                                                  force_refresh=True)
+    assert record['status'] == status_lib.ClusterStatus.UP
+
+    # One of the 4 simulated slice hosts dies.
+    local_instance.preempt_host(on_cloud, 2)
+    record = backend_utils.refresh_cluster_record('drift-a',
+                                                  force_refresh=True)
+    assert record is not None, 'record must survive partial loss'
+    assert record['status'] == status_lib.ClusterStatus.DEGRADED
+
+    # check_cluster_available refuses a degraded cluster.
+    with pytest.raises(exceptions.ClusterNotUpError):
+        backend_utils.check_cluster_available('drift-a')
+
+    # All hosts gone -> record removed.
+    for i in range(4):
+        local_instance.preempt_host(on_cloud, i)
+    record = backend_utils.refresh_cluster_record('drift-a',
+                                                  force_refresh=True)
+    assert record is None
+    core.down('drift-a', purge=True) if global_user_state \
+        .get_cluster_from_name('drift-a') else None
+
+
+def test_autodown_on_refresh_finishes_teardown(isolated_state):
+    on_cloud = _launch('drift-b', accel=None, run='echo hi')
+    core.autostop('drift-b', idle_minutes=0, down=True)
+    # Simulate: the agent stopped the cluster but died before the
+    # terminate (or only stop is supported mid-path).
+    meta = local_instance._read_meta(on_cloud)
+    meta['status'] = 'stopped'
+    local_instance._write_meta(on_cloud, meta)
+
+    record = backend_utils.refresh_cluster_record('drift-b',
+                                                  force_refresh=True)
+    assert record is None, 'autodown cluster must be terminated'
+    meta = local_instance._read_meta(on_cloud)
+    assert meta is None or meta['status'] == 'terminated'
+
+
+def test_autostop_without_down_stays_stopped(isolated_state):
+    on_cloud = _launch('drift-c', accel=None, run='echo hi')
+    core.autostop('drift-c', idle_minutes=0, down=False)
+    meta = local_instance._read_meta(on_cloud)
+    meta['status'] = 'stopped'
+    local_instance._write_meta(on_cloud, meta)
+    record = backend_utils.refresh_cluster_record('drift-c',
+                                                  force_refresh=True)
+    assert record['status'] == status_lib.ClusterStatus.STOPPED
+    core.down('drift-c')
+
+
+def test_init_stuck_promoted_when_agent_alive(isolated_state):
+    _launch('drift-d', accel=None, run='echo hi')
+    # Simulate a client that crashed after provisioning, before the
+    # DB write: force the record back to INIT.
+    global_user_state.update_cluster_status(
+        'drift-d', status_lib.ClusterStatus.INIT)
+    record = backend_utils.refresh_cluster_record('drift-d',
+                                                  force_refresh=True)
+    # Agent is alive (real agentd from the launch) -> promoted.
+    assert record['status'] == status_lib.ClusterStatus.UP
+    core.down('drift-d')
+
+
+def test_init_stuck_stays_init_when_agent_dead(isolated_state):
+    on_cloud = _launch('drift-e', accel=None, run='echo hi')
+    global_user_state.update_cluster_status(
+        'drift-e', status_lib.ClusterStatus.INIT)
+    # Kill the agent but keep the "instances" running.
+    local_instance._kill_pids(
+        local_instance._collect_agentd_pids(on_cloud))
+    deadline = time.time() + 5
+    while time.time() < deadline and local_instance \
+            ._collect_agentd_pids(on_cloud):
+        time.sleep(0.1)
+    record = backend_utils.refresh_cluster_record('drift-e',
+                                                  force_refresh=True)
+    assert record['status'] == status_lib.ClusterStatus.INIT
+    core.down('drift-e')
+
+
+def test_owner_identity_mismatch_refuses(isolated_state, monkeypatch):
+    _launch('drift-f', accel=None, run='echo hi')
+    global_user_state.set_cluster_owner('drift-f', 'alice@corp')
+    from skypilot_tpu.clouds import Local
+    monkeypatch.setattr(Local, 'get_user_identities',
+                        lambda self: [['mallory@corp']], raising=False)
+    with pytest.raises(exceptions.ClusterOwnerIdentityMismatchError):
+        backend_utils.refresh_cluster_record('drift-f',
+                                             force_refresh=True)
+    # Same identity (or any overlap) passes.
+    monkeypatch.setattr(Local, 'get_user_identities',
+                        lambda self: [['alice@corp']], raising=False)
+    record = backend_utils.refresh_cluster_record('drift-f',
+                                                  force_refresh=True)
+    assert record is not None
+    core.down('drift-f')

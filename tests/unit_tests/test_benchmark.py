@@ -1,0 +1,59 @@
+"""`skytpu bench` harness: two local candidates, callback summaries
+collected off the clusters, ranked report (reference
+sky/benchmark/benchmark_utils.py driven hermetically)."""
+import time
+
+import pytest
+
+from skypilot_tpu import benchmark as bench_lib
+from skypilot_tpu import core
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.benchmark import benchmark_state
+
+
+# The benchmarked "training" writes steps through the real callback.
+_TRAIN = ("python -c \"import time; from skypilot_tpu import callbacks; "
+          "cb = callbacks.BenchmarkCallback(total_steps=5); "
+          "[ (time.sleep(0.05), cb.step()) for _ in range(5) ]\"")
+
+
+@pytest.fixture
+def bench_env(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYTPU_BENCHMARK_DB',
+                       str(isolated_state / 'bench.db'))
+    yield
+
+
+def test_benchmark_two_local_candidates(bench_env):
+    task = task_lib.Task('benchtask', run=_TRAIN)
+    candidates = [
+        resources_lib.Resources(cloud='local'),
+        resources_lib.Resources(cloud='local',
+                                accelerators='tpu-v5e-8'),
+    ]
+    clusters = bench_lib.launch_benchmark(task, candidates, 'b1')
+    assert len(clusters) == 2
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rows = bench_lib.collect_results('b1')
+        done = [r for r in rows if r['num_steps'] == 5 and
+                r['status'] not in (None, 'RUNNING')]
+        if len(done) == 2:
+            break
+        time.sleep(1)
+    rows = bench_lib.report('b1')
+    assert len(rows) == 2
+    for r in rows:
+        assert r['num_steps'] == 5
+        assert r['seconds_per_step'] == pytest.approx(0.05, rel=1.0)
+        assert r['cost_per_step'] is not None
+    # Ranked: cheapest first (stable even with equal local prices).
+    assert rows[0]['cost_per_step'] <= rows[1]['cost_per_step']
+
+    bench_lib.down_benchmark('b1')
+    assert benchmark_state.get_candidates('b1') == []
+    for cluster in clusters:
+        with pytest.raises(Exception):
+            core.queue(cluster)

@@ -1,0 +1,142 @@
+"""CLI coverage (reference tests/test_cli.py dry-runs the CLI
+offline): commands drive the real SDK against an in-process API
+server on the local cloud; no cloud credentials involved."""
+import json
+import os
+import time
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from skypilot_tpu.client import cli as cli_mod
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+@pytest.fixture
+def server_env(isolated_state, monkeypatch):
+    """Reuse the live aiohttp server fixture machinery from the API
+    server tests."""
+    monkeypatch.setenv('SKYTPU_REQUESTS_DB',
+                       str(isolated_state / 'requests.db'))
+    monkeypatch.setenv('SKYTPU_REQUESTS_LOG_DIR',
+                       str(isolated_state / 'req_logs'))
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    from skypilot_tpu.server.server import make_app
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        app_runner = web.AppRunner(make_app())
+        loop.run_until_complete(app_runner.setup())
+        site = web.TCPSite(app_runner, '127.0.0.1', 0)
+        loop.run_until_complete(site.start())
+        holder['port'] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    monkeypatch.setenv('SKYTPU_API_SERVER_ENDPOINT',
+                       f'http://127.0.0.1:{holder["port"]}')
+    yield isolated_state
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def _task_yaml(tmp_path, run='echo cli-ok', **extra):
+    config = {'name': 'clitask',
+              'resources': {'cloud': 'local'},
+              'run': run, **extra}
+    path = tmp_path / 'task.yaml'
+    path.write_text(yaml.safe_dump(config))
+    return str(path)
+
+
+def test_cli_help_lists_all_groups(runner):
+    result = runner.invoke(cli_mod.cli, ['--help'])
+    assert result.exit_code == 0
+    for cmd in ('launch', 'exec', 'status', 'stop', 'start', 'down',
+                'autostop', 'queue', 'cancel', 'logs', 'check',
+                'show-tpus', 'jobs', 'serve', 'storage', 'bench'):
+        assert cmd in result.output, cmd
+
+
+def test_cli_launch_dryrun(runner, server_env, tmp_path):
+    result = runner.invoke(
+        cli_mod.cli,
+        ['launch', _task_yaml(tmp_path), '-c', 'clidry', '--dryrun'])
+    assert result.exit_code == 0, result.output
+
+
+def test_cli_launch_status_queue_logs_down(runner, server_env,
+                                           tmp_path):
+    result = runner.invoke(
+        cli_mod.cli,
+        ['launch', _task_yaml(tmp_path), '-c', 'clic'])
+    assert result.exit_code == 0, result.output
+
+    result = runner.invoke(cli_mod.cli, ['status'])
+    assert result.exit_code == 0
+    assert 'clic' in result.output
+
+    result = runner.invoke(cli_mod.cli, ['queue', 'clic'])
+    assert result.exit_code == 0
+    assert 'clitask' in result.output
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        out = runner.invoke(cli_mod.cli, ['queue', 'clic']).output
+        if 'SUCCEEDED' in out or 'FAILED' in out:
+            break
+        time.sleep(0.5)
+    assert 'SUCCEEDED' in out
+
+    result = runner.invoke(
+        cli_mod.cli, ['logs', 'clic', '--sync-down',
+                      '--local-dir', str(tmp_path / 'pulled')])
+    assert result.exit_code == 0, result.output
+    pulled = result.output.strip().splitlines()[-1]
+    assert os.path.isdir(pulled)
+
+    result = runner.invoke(cli_mod.cli, ['down', 'clic'])
+    assert result.exit_code == 0
+    result = runner.invoke(cli_mod.cli, ['status'])
+    assert 'clic' not in result.output
+
+
+def test_cli_check_and_show_tpus(runner, server_env):
+    result = runner.invoke(cli_mod.cli, ['check'])
+    assert result.exit_code == 0
+    assert 'local' in result.output
+
+    result = runner.invoke(cli_mod.cli,
+                           ['show-tpus', '--name-filter', 'v5e'])
+    assert result.exit_code == 0
+    assert 'tpu-v5e-16' in result.output
+    assert 'PRICE_HR' in result.output
+
+
+def test_cli_storage_and_bench_groups(runner, server_env):
+    result = runner.invoke(cli_mod.cli, ['storage', 'ls'])
+    assert result.exit_code == 0
+    result = runner.invoke(cli_mod.cli, ['bench', '--help'])
+    assert result.exit_code == 0
+    assert 'launch' in result.output and 'show' in result.output
+
+
+def test_cli_exec_on_missing_cluster_errors(runner, server_env,
+                                            tmp_path):
+    result = runner.invoke(
+        cli_mod.cli, ['exec', 'nosuch', _task_yaml(tmp_path)])
+    assert result.exit_code != 0

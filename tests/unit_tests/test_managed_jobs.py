@@ -150,3 +150,29 @@ def test_managed_job_on_controller_cluster(isolated_state, tmp_path):
                        timeout=120)
     assert job['status'] == state.ManagedJobStatus.SUCCEEDED, job
     assert job['recovery_count'] >= 1
+
+
+def test_jobs_dashboard_renders(isolated_state):
+    """Dashboard page + JSON endpoint over the real jobs DB."""
+    import asyncio
+
+    from skypilot_tpu.jobs import dashboard
+
+    task = task_lib.Task('dashjob', run='echo hi')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    job_id = jobs_core.launch(task, controller_check_gap=0.5)
+    _wait_status(job_id, state.ManagedJobStatus.terminal_statuses())
+
+    async def drive():
+        from aiohttp.test_utils import TestClient, TestServer
+        app = dashboard.make_app()
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get('/')
+            assert resp.status == 200
+            text = await resp.text()
+            assert 'dashjob' in text and 'SUCCEEDED' in text
+            resp = await client.get('/api/jobs')
+            jobs = await resp.json()
+            assert any(j['job_id'] == job_id for j in jobs)
+
+    asyncio.run(drive())

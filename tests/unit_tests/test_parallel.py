@@ -53,3 +53,56 @@ def test_ring_attention_grad_finite():
 
     g = jax.grad(loss)(q)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_ring_attention_gqa_native():
+    """K/V enter the ring at n_kv_heads (no repeat) and still match
+    the reference's GQA attention."""
+    b, s, h, kv, d = 2, 64, 8, 2, 16
+    kq, kk, kvk = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(kvk, (b, s, kv, d), jnp.float32)
+    expected = reference_attention(q, k, v, causal=True)
+
+    mesh = make_mesh(sp=8, fsdp=1)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    assert out.shape == (b, s, h, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_zigzag_layout():
+    """The zig-zag permutation (causal load balancing: shard i holds
+    chunks (i, 2n-1-i)) computes the same attention as contiguous
+    sharding, once positions ride along."""
+    from skypilot_tpu.parallel.ring_attention import zigzag_indices
+    b, s, h, d = 1, 64, 4, 8
+    n = 8
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+    expected = reference_attention(q, k, v, causal=True)
+
+    perm = zigzag_indices(s, n)
+    # Shard i ends up with tokens perm[i::...] contiguous-sharded.
+    qz, kz, vz = q[:, perm], k[:, perm], v[:, perm]
+    positions = jnp.asarray(perm, jnp.int32)
+
+    mesh = make_mesh(sp=8, fsdp=1)
+    out_z = ring_attention_sharded(qz, kz, vz, mesh, causal=True,
+                                   positions=positions)
+    # Un-permute the outputs back to natural order.
+    inv = np.argsort(perm)
+    out = np.asarray(out_z)[:, inv]
+    np.testing.assert_allclose(out, np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+    # Sanity on the layout itself: each shard's 8 tokens are chunks
+    # (i, 15-i) of the 16 global chunks.
+    chunk = s // (2 * n)
+    shard0 = perm[:s // n]
+    assert list(shard0[:chunk]) == list(range(0, chunk))
+    assert list(shard0[chunk:]) == list(
+        range(s - chunk, s))

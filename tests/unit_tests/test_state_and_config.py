@@ -66,3 +66,24 @@ def test_config_nested_and_override(tmp_path, monkeypatch):
     with skypilot_config.override_config({'gcp': {'project_id': 'proj-2'}}):
         assert skypilot_config.get_nested(('gcp', 'project_id')) == 'proj-2'
     assert skypilot_config.get_nested(('gcp', 'project_id')) == 'proj-1'
+
+
+def test_profiler_trace_hook(tmp_path, monkeypatch):
+    """SKYTPU_PROFILE_DIR triggers exactly one jax.profiler trace."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.utils import profiling
+    monkeypatch.setenv(profiling.PROFILE_DIR_ENV, str(tmp_path / 'prof'))
+    monkeypatch.setattr(profiling, '_traced_once', False)
+    f = jax.jit(lambda x: x * 2 + 1)
+    for step in range(4):
+        with profiling.maybe_trace(step=step):
+            f(jnp.ones((8,))).block_until_ready()
+    traces = glob.glob(str(tmp_path / 'prof' / '**' / '*.xplane.pb'),
+                       recursive=True)
+    assert traces, 'no trace captured'
+    # Only one capture: flag latched.
+    assert profiling._traced_once
