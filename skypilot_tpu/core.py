@@ -29,8 +29,16 @@ def status(cluster_names: Optional[Union[str, List[str]]] = None,
     if refresh:
         refreshed = []
         for r in records:
-            rec = backend_utils.refresh_cluster_record(r['name'],
-                                                       force_refresh=True)
+            try:
+                rec = backend_utils.refresh_cluster_record(
+                    r['name'], force_refresh=True)
+            except exceptions.ClusterOwnerIdentityMismatchError as e:
+                # One foreign-identity cluster must not blank the
+                # whole listing — show the stale record, tagged.
+                logger.warning(str(e))
+                r = dict(r)
+                r['identity_mismatch'] = True
+                rec = r
             if rec is not None:
                 refreshed.append(rec)
         records = refreshed

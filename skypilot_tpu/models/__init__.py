@@ -11,12 +11,14 @@ from skypilot_tpu.models.inference import (cache_specs, decode_step,
                                            generate, prefill)
 from skypilot_tpu.models.llama import (LlamaConfig, forward, init_params,
                                        loss_fn, param_specs)
+from skypilot_tpu.models.moe import MoEConfig
 from skypilot_tpu.models.train import (TrainState, init_train_state,
                                        make_eval_step, make_optimizer,
                                        make_train_step, shard_batch)
 
 __all__ = [
-    'LlamaConfig', 'forward', 'init_params', 'loss_fn', 'param_specs',
+    'LlamaConfig', 'MoEConfig', 'forward', 'init_params', 'loss_fn',
+    'param_specs',
     'TrainState', 'init_train_state', 'make_eval_step', 'make_optimizer',
     'make_train_step', 'shard_batch',
     'cache_specs', 'decode_step', 'generate', 'prefill',

@@ -53,6 +53,17 @@ def cache_specs() -> Dict:
             'length': P(('dp', 'fsdp')), 'base': P(), 'steps': P()}
 
 
+def _reject_unsupported_family(cfg: LlamaConfig) -> None:
+    """This engine walks the dense Llama param tree; an MoE config
+    would KeyError deep inside the scan — fail with intent instead."""
+    from skypilot_tpu.models import moe
+    if isinstance(cfg, moe.MoEConfig):
+        raise NotImplementedError(
+            'KV-cache inference for MoE models is not implemented '
+            'yet; serve dense (LlamaConfig) models, or train MoE and '
+            'distill/serve dense.')
+
+
 # Cache slot layout (the key to fast TPU decode): prompts occupy
 # slots 0..base-1 (base = padded prompt length; rows shorter than
 # base leave garbage in their tail slots, masked at read), and decode
@@ -123,6 +134,7 @@ def prefill(params: Dict,
     position, cache). Padded positions write garbage K/V but decode
     masks everything >= length, so they are never read.
     """
+    _reject_unsupported_family(cfg)
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     s_max = max_seq or cfg.max_seq

@@ -1,0 +1,256 @@
+"""Mixture-of-Experts decoder (Mixtral-style) with expert parallelism.
+
+The reference ships MoE models only as serve recipes (llm/mixtral/,
+llm/dbrx/ — YAML invoking vLLM; SURVEY.md §2.11 lists expert
+parallelism as recipe-level). Here MoE is a first-class model family:
+the Llama block's dense SwiGLU is replaced by a top-k routed expert
+layer, built the TPU way —
+
+- **Dense dispatch, static shapes** (Switch-Transformer style): a
+  [tokens, experts, capacity] combine tensor turns routing into three
+  einsums XLA maps straight onto the MXU. No ragged gather/scatter,
+  no recompilation; over-capacity tokens drop (standard capacity-
+  factor semantics).
+- **Expert parallelism over the 'tp' mesh axis**: expert weights are
+  sharded one-expert-group-per-device (P on the E dim), so the
+  dispatch einsum becomes XLA's all-to-all — the EP layout — while
+  attention stays Megatron-sharded exactly as in the dense model.
+- **Load-balancing aux loss** (router z-loss omitted for brevity):
+  mean(expert fraction * router probability) * n_experts, added to
+  the LM loss with ``router_aux_coef``.
+
+API mirrors models.llama (init_params / param_specs / forward /
+loss_fn), so the same train step and checkpointing drive both
+families. (KV-cache serving is dense-only for now; models/inference
+rejects MoE configs explicitly.)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models.llama import (ACT_SPEC, LlamaConfig,
+                                       _attention, _rmsnorm, _rope)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+    # ---- presets -------------------------------------------------
+    @classmethod
+    def tiny_moe(cls, **kw) -> 'MoEConfig':
+        d = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, ffn_dim=128, max_seq=128,
+                 n_experts=4, top_k=2,
+                 param_dtype=jnp.float32, compute_dtype=jnp.float32)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> 'MoEConfig':
+        """Mixtral-8x7B shape (public): the MoE flagship."""
+        d = dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+                 n_kv_heads=8, ffn_dim=14336, max_seq=8192,
+                 n_experts=8, top_k=2, rope_theta=1e6)
+        d.update(kw)
+        return cls(**d)
+
+
+def init_params(cfg: MoEConfig, key: jax.Array) -> Dict:
+    """Stacked-layer param pytree; experts carry a leading E dim."""
+    k_emb, k_layers, k_head = jax.random.split(key, 3)
+    hd, nl, ne = cfg.head_dim, cfg.n_layers, cfg.n_experts
+    dt = cfg.param_dtype
+
+    def dense_init(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) *
+                fan_in**-0.5).astype(dt)
+
+    ks = jax.random.split(k_layers, 8)
+    return {
+        'tok_emb': dense_init(k_emb, cfg.vocab_size, cfg.dim,
+                              fan_in=cfg.dim),
+        'layers': {
+            'attn_norm': jnp.ones((nl, cfg.dim), dt),
+            'wq': dense_init(ks[0], nl, cfg.dim, cfg.n_heads * hd,
+                             fan_in=cfg.dim),
+            'wk': dense_init(ks[1], nl, cfg.dim, cfg.n_kv_heads * hd,
+                             fan_in=cfg.dim),
+            'wv': dense_init(ks[2], nl, cfg.dim, cfg.n_kv_heads * hd,
+                             fan_in=cfg.dim),
+            'wo': dense_init(ks[3], nl, cfg.n_heads * hd, cfg.dim,
+                             fan_in=cfg.n_heads * hd),
+            'mlp_norm': jnp.ones((nl, cfg.dim), dt),
+            'router': dense_init(ks[4], nl, cfg.dim, ne,
+                                 fan_in=cfg.dim),
+            'w_gate': dense_init(ks[5], nl, ne, cfg.dim, cfg.ffn_dim,
+                                 fan_in=cfg.dim),
+            'w_up': dense_init(ks[6], nl, ne, cfg.dim, cfg.ffn_dim,
+                               fan_in=cfg.dim),
+            'w_down': dense_init(ks[7], nl, ne, cfg.ffn_dim, cfg.dim,
+                                 fan_in=cfg.ffn_dim),
+        },
+        'final_norm': jnp.ones((cfg.dim,), dt),
+        'lm_head': dense_init(k_head, cfg.dim, cfg.vocab_size,
+                              fan_in=cfg.dim),
+    }
+
+
+def param_specs(cfg: MoEConfig) -> Dict:
+    """Expert parallelism: the E dim shards over 'tp' (experts replace
+    the tp-sharded dense FFN); attention stays Megatron-sharded."""
+    del cfg
+    return {
+        'tok_emb': P('tp', 'fsdp'),
+        'layers': {
+            'attn_norm': P(None, None),
+            'wq': P(None, 'fsdp', 'tp'),
+            'wk': P(None, 'fsdp', 'tp'),
+            'wv': P(None, 'fsdp', 'tp'),
+            'wo': P(None, 'tp', 'fsdp'),
+            'mlp_norm': P(None, None),
+            'router': P(None, 'fsdp', None),
+            'w_gate': P(None, 'tp', 'fsdp', None),
+            'w_up': P(None, 'tp', 'fsdp', None),
+            'w_down': P(None, 'tp', None, 'fsdp'),
+        },
+        'final_norm': P(None),
+        'lm_head': P('fsdp', 'tp'),
+    }
+
+
+def _route(xf: jax.Array, router: jax.Array,
+           cfg: MoEConfig) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routing -> (combine [T, E, C], aux loss scalar)."""
+    t = xf.shape[0]
+    e, k = cfg.n_experts, cfg.top_k
+    capacity = max(4, int(cfg.capacity_factor * t * k / e))
+    logits = (xf @ router.astype(xf.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)          # [T, E]
+    weights, idx = lax.top_k(probs, k)               # [T, k]
+    weights = weights / jnp.maximum(
+        weights.sum(-1, keepdims=True), 1e-9)
+
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # Expert fill is tracked ACROSS the k slots: slot 1 continues
+    # where slot 0 left off, so two tokens never share a capacity row.
+    fill = jnp.zeros((e,), jnp.int32)
+    for slot in range(k):
+        onehot = jax.nn.one_hot(idx[:, slot], e, dtype=jnp.int32)
+        pos = fill[None, :] + jnp.cumsum(onehot, axis=0) - onehot
+        pos = jnp.sum(pos * onehot, axis=-1)          # [T]
+        keep = pos < capacity
+        cap_onehot = jax.nn.one_hot(pos, capacity,
+                                    dtype=jnp.float32)  # [T, C]
+        combine += (weights[:, slot, None, None] *
+                    keep[:, None, None] *
+                    onehot[:, :, None].astype(jnp.float32) *
+                    cap_onehot[:, None, :])
+        fill = fill + jnp.sum(onehot, axis=0)
+
+    # Load-balancing aux (Switch eq. 4): fraction of tokens routed to
+    # each expert (top-1 assignment) x mean router prob, scaled by E.
+    top1 = jax.nn.one_hot(idx[:, 0], e, dtype=jnp.float32)
+    aux = cfg.n_experts * jnp.sum(
+        jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+    return combine, aux
+
+
+def _moe_block(x: jax.Array, lp: Dict, cfg: MoEConfig
+               ) -> Tuple[jax.Array, jax.Array]:
+    """x [B, S, D] -> (y [B, S, D], aux loss)."""
+    cdt = cfg.compute_dtype
+    b, s, d = x.shape
+    xf = x.reshape(b * s, d)
+    combine, aux = _route(xf, lp['router'], cfg)
+    dispatch = (combine > 0).astype(cdt)              # [T, E, C]
+    expert_in = jnp.einsum('tec,td->ecd', dispatch, xf)
+    gate = jax.nn.silu(
+        jnp.einsum('ecd,edf->ecf', expert_in,
+                   lp['w_gate'].astype(cdt)))
+    up = jnp.einsum('ecd,edf->ecf', expert_in, lp['w_up'].astype(cdt))
+    out_e = jnp.einsum('ecf,efd->ecd', gate * up,
+                       lp['w_down'].astype(cdt))
+    y = jnp.einsum('tec,ecd->td', combine.astype(cdt), out_e)
+    return y.reshape(b, s, d), aux
+
+
+def forward_hidden(params: Dict, tokens: jax.Array, cfg: MoEConfig,
+                   mesh=None) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (hidden [B, S, D], total aux loss)."""
+    cdt = cfg.compute_dtype
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
+                                 (b, s))
+
+    def constrain(x, spec):
+        if mesh is None:
+            return x
+        return lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
+
+    emb = constrain(params['tok_emb'], P(None, None))
+    x = emb.astype(cdt)[tokens]
+    x = constrain(x, ACT_SPEC)
+
+    def layer(carry, lp):
+        x, aux = carry
+        h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
+        q = (h @ lp['wq'].astype(cdt)).reshape(b, s, cfg.n_heads,
+                                               cfg.head_dim)
+        k = (h @ lp['wk'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        v = (h @ lp['wv'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
+                                               cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        o = _attention(q, k, v, cfg, mesh)
+        o = o.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        x = x + constrain(o @ lp['wo'].astype(cdt), ACT_SPEC)
+
+        h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
+        y, layer_aux = _moe_block(h, lp, cfg)
+        x = x + constrain(y, ACT_SPEC)
+        return (x, aux + layer_aux), None
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    (x, aux), _ = lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
+                           params['layers'])
+    return _rmsnorm(x, params['final_norm'], cfg.norm_eps), aux
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: MoEConfig,
+            mesh=None) -> jax.Array:
+    x, _ = forward_hidden(params, tokens, cfg, mesh)
+    return jnp.einsum('bsd,dv->bsv', x,
+                      params['lm_head'].astype(cfg.compute_dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(params: Dict, batch: Dict[str, jax.Array], cfg: MoEConfig,
+            mesh=None) -> jax.Array:
+    """Next-token CE + router load-balancing aux."""
+    if 'inputs' in batch:
+        inputs, targets = batch['inputs'], batch['targets']
+    else:
+        inputs, targets = batch['tokens'][:, :-1], batch['tokens'][:, 1:]
+    x, aux = forward_hidden(params, inputs, cfg, mesh)
+    logits = jnp.einsum('bsd,dv->bsv', x,
+                        params['lm_head'].astype(cfg.compute_dtype),
+                        preferred_element_type=jnp.float32)
+    mask = (targets >= 0).astype(jnp.float32)
+    targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None],
+                               axis=-1)[..., 0]
+    ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return ce + cfg.router_aux_coef * aux

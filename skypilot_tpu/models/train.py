@@ -20,6 +20,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from skypilot_tpu.models import llama
 
 
+def _family(cfg):
+    """Model family module for a config (llama or moe) — both expose
+    init_params / param_specs / loss_fn with the same signatures."""
+    from skypilot_tpu.models import moe
+    return moe if isinstance(cfg, moe.MoEConfig) else llama
+
+
 @dataclasses.dataclass
 class TrainState:
     params: Any
@@ -45,7 +52,7 @@ def make_optimizer(lr: float = 3e-4,
 
 
 def _state_specs(cfg: llama.LlamaConfig, optimizer, params_shape):
-    pspecs = llama.param_specs(cfg)
+    pspecs = _family(cfg).param_specs(cfg)
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
 
     # Optimizer moments mirror the param tree inside each optax state
@@ -84,14 +91,14 @@ def init_train_state(cfg: llama.LlamaConfig,
     optimizer = optimizer or make_optimizer()
 
     def _init(key):
-        params = llama.init_params(cfg, key)
+        params = _family(cfg).init_params(cfg, key)
         return TrainState(params=params,
                           opt_state=optimizer.init(params),
                           step=jnp.zeros((), jnp.int32))
 
     if mesh is None:
         return jax.jit(_init)(key), optimizer
-    params_shape = jax.eval_shape(functools.partial(llama.init_params,
+    params_shape = jax.eval_shape(functools.partial(_family(cfg).init_params,
                                                     cfg), key)
     specs = _state_specs(cfg, optimizer, params_shape)
     shardings = jax.tree.map(
@@ -107,7 +114,7 @@ def make_train_step(cfg: llama.LlamaConfig,
     """Returns jitted (state, batch) -> (state, metrics)."""
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
-        loss, grads = jax.value_and_grad(llama.loss_fn)(
+        loss, grads = jax.value_and_grad(_family(cfg).loss_fn)(
             state.params, batch, cfg, mesh)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
@@ -133,5 +140,5 @@ def shard_batch(batch: Dict[str, jax.Array], mesh):
 
 def make_eval_step(cfg: llama.LlamaConfig, mesh=None):
     def eval_step(params, batch):
-        return llama.loss_fn(params, batch, cfg, mesh)
+        return _family(cfg).loss_fn(params, batch, cfg, mesh)
     return jax.jit(eval_step)

@@ -10,7 +10,10 @@ attention (sequence/context parallelism) over the ICI torus.
 """
 from skypilot_tpu.parallel.distributed import initialize_from_env
 from skypilot_tpu.parallel.mesh import (MeshPlan, make_mesh, plan_mesh)
-from skypilot_tpu.parallel.ring_attention import ring_attention
+from skypilot_tpu.parallel.pipeline import (pipeline_apply,
+                                            pipeline_mesh)
+from skypilot_tpu.parallel.ring_attention import (ring_attention,
+                                                  zigzag_indices)
 from skypilot_tpu.parallel.sharding import (batch_spec, logical_to_spec,
                                             shard_pytree)
 
@@ -19,7 +22,10 @@ __all__ = [
     'MeshPlan',
     'make_mesh',
     'plan_mesh',
+    'pipeline_apply',
+    'pipeline_mesh',
     'ring_attention',
+    'zigzag_indices',
     'batch_spec',
     'logical_to_spec',
     'shard_pytree',

@@ -1,0 +1,125 @@
+"""Pipeline parallelism: GPipe microbatching over a mesh axis.
+
+SURVEY.md §2.11 lists pipeline parallelism as absent from the
+reference (its recipes may use it internally; the framework offers
+nothing). Here it is a library primitive in the TPU idiom: the layer
+stack [L, ...] is sharded over a 'pp' mesh axis (stage s holds layers
+s*L/n .. (s+1)*L/n), and inside ``shard_map`` every stage runs the
+same traced program — a ``lax.scan`` over M + n - 1 ticks in which
+activations hop stage-to-stage via ``lax.ppermute`` (neighbor DMA on
+the ICI/DCN link between stages) while each stage processes one
+microbatch per tick. Bubble fraction is the standard (n-1)/(M+n-1);
+choose num_microbatches >> n_stages.
+
+Backprop works by construction: ppermute is differentiable, so
+``jax.grad`` of a loss through ``pipeline_apply`` yields the reverse
+pipeline schedule automatically.
+
+Use DCN-adjacent mesh axes for 'pp' (stages exchange only one
+activation tensor per tick, the lowest-bandwidth traffic in the
+stack) — the scaling-book placement: pp over DCN, fsdp/tp inside the
+slice.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_mesh(pp: int, devices=None):
+    """A 1-axis ('pp',) mesh over the first pp devices."""
+    import numpy as np
+    if devices is None:
+        devices = jax.devices()
+    return jax.sharding.Mesh(
+        np.asarray(devices[:pp]).reshape(pp), ('pp',))
+
+
+def _stage_apply(layer_fn: Callable, local_params, x):
+    """Apply this stage's layers (leading dim = L/n_stages)."""
+
+    def body(h, lp):
+        return layer_fn(lp, h), None
+
+    out, _ = lax.scan(body, x, local_params)
+    return out
+
+
+def pipeline_apply(layer_fn: Callable,
+                   stacked_params,
+                   x: jax.Array,
+                   *,
+                   mesh,
+                   num_microbatches: int,
+                   axis_name: str = 'pp') -> jax.Array:
+    """Run ``x`` through a layer stack pipelined over ``axis_name``.
+
+    Args:
+      layer_fn: (layer_params, h) -> h for ONE layer.
+      stacked_params: pytree with leading layer dim L (L divisible by
+        the number of stages).
+      x: [batch, ...] activations (batch divisible by
+        num_microbatches).
+      mesh: a Mesh containing ``axis_name``.
+      num_microbatches: GPipe M.
+
+    Returns [batch, ...], same as applying the layers sequentially.
+    """
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    mb = b // num_microbatches
+    m = num_microbatches
+    xm = x.reshape((m, mb) + x.shape[1:])
+
+    def per_stage(local_params, xm):
+        stage = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        # Seed carries with a device-varying term (0 * stage) so they
+        # match the loop body's varying-manual-axes type under
+        # shard_map (same trick as ring_attention's accumulators).
+        varying_zero = (stage * 0).astype(x.dtype)
+        state = jnp.zeros_like(xm[0]) + varying_zero
+        outputs = jnp.zeros_like(xm) + varying_zero
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 feeds from the input microbatches; later stages
+            # from the activation just received from the left.
+            feed_idx = jnp.clip(t, 0, m - 1)
+            inp = jnp.where(stage == 0, xm[feed_idx], state)
+            out = _stage_apply(layer_fn, local_params, inp)
+            # The last stage emits microbatch t - (n-1) at tick t.
+            out_idx = t - (n_stages - 1)
+            write = ((stage == n_stages - 1) & (out_idx >= 0) &
+                     (out_idx < m))
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, out, outputs[jnp.clip(out_idx, 0,
+                                                       m - 1)]),
+                jnp.clip(out_idx, 0, m - 1), 0)
+            state = lax.ppermute(out, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(m + n_stages - 1))
+        # Only the last stage holds real outputs (earlier stages wrote
+        # nothing); psum replicates them everywhere.
+        keep = (stage == n_stages - 1).astype(outputs.dtype)
+        return lax.psum(outputs * keep, axis_name)
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(per_stage, mesh=mesh,
+                   in_specs=(param_specs, P()), out_specs=P())
+    out = fn(stacked_params, xm)
+    return out.reshape((b,) + x.shape[1:])

@@ -110,12 +110,13 @@ def run_instances(
                        _list_instances(ec2, config.cluster_name_on_cloud)
                        if i['State']['Name'] in ('stopping', 'stopped')]
         ids = [i['InstanceId'] for i in stopped]
-        try:
-            ec2.start_instances(InstanceIds=ids)
-        except Exception as e:  # pylint: disable=broad-except
-            raise translate_error(e, 'start_instances') from e
-        resumed = ids
-        alive += stopped
+        if ids:  # all may have terminated while settling
+            try:
+                ec2.start_instances(InstanceIds=ids)
+            except Exception as e:  # pylint: disable=broad-except
+                raise translate_error(e, 'start_instances') from e
+            resumed = ids
+            alive += stopped
 
     missing = config.count - len(alive)
     if missing > 0:

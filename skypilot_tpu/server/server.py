@@ -169,9 +169,19 @@ async def handle_list(request: web.Request) -> web.Response:
 
 
 async def handle_health(request: web.Request) -> web.Response:
+    try:
+        with open('/etc/machine-id', encoding='utf-8') as f:
+            machine_id = f.read().strip() or None
+    except OSError:
+        machine_id = None
     return web.json_response({
         'status': 'healthy',
         'api_version': API_VERSION,
+        # Clients compare against their own machine id to decide
+        # whether the server shares this filesystem (workdir upload
+        # elision) — a loopback hostname alone proves nothing under
+        # port-forwarding.
+        'machine_id': machine_id,
     })
 
 
