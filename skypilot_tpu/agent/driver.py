@@ -32,6 +32,64 @@ from skypilot_tpu.utils import subprocess_utils
 
 JobStatus = job_lib.JobStatus
 
+# Worker liveness probing (weak spot of head-only agents: a hung
+# worker host used to be visible only as a hung SSH). Overridable for
+# tests.
+_PROBE_INTERVAL = float(os.environ.get('SKYTPU_WORKER_PROBE_INTERVAL',
+                                       '30'))
+_PROBE_THRESHOLD = int(os.environ.get('SKYTPU_WORKER_PROBE_THRESHOLD',
+                                      '3'))
+
+
+def monitor_workers(runners: List[runner_lib.CommandRunner],
+                    stop_event: threading.Event,
+                    on_dead,
+                    interval: float = None,
+                    threshold: int = None) -> None:
+    """Probe every host while ranks run; after ``threshold``
+    consecutive failed probes on any host, call ``on_dead(rank)``.
+
+    The reference has no equivalent (its workers are reached only by
+    in-flight SSH; a dead worker hangs the job until TCP gives up) —
+    here a wedged TPU-VM worker converts into a clean job failure the
+    jobs controller can treat as a preemption and recover from.
+
+    One prober thread per host: a single wedged host blocking in its
+    SSH probe must not delay detection of (or probes to) the others.
+    ``on_dead`` never fires after ``stop_event`` is set, so a probe
+    in flight while the job finishes cannot fail a succeeded job.
+    """
+    interval = _PROBE_INTERVAL if interval is None else interval
+    threshold = _PROBE_THRESHOLD if threshold is None else threshold
+
+    death = threading.Event()
+
+    def probe_host(rank: int) -> None:
+        runner = runners[rank]
+        misses = 0
+        while not stop_event.wait(interval):
+            if death.is_set():
+                return
+            try:
+                ok = runner.check_connection()
+            except Exception:  # pylint: disable=broad-except
+                ok = False
+            misses = 0 if ok else misses + 1
+            if misses >= threshold:
+                if not stop_event.is_set():
+                    on_dead(rank)
+                death.set()
+                return
+
+    threads = [
+        threading.Thread(target=probe_host, args=(rank,), daemon=True)
+        for rank in range(len(runners))
+    ]
+    for t in threads:
+        t.start()
+    while not (stop_event.is_set() or death.is_set()):
+        time.sleep(min(interval, 0.05))
+
 
 def load_hosts(state_dir: str) -> List[Dict]:
     path = os.path.join(state_dir, constants.HOSTS_FILE)
@@ -154,7 +212,32 @@ def main() -> None:
             job_lib.set_status(state_dir, job_id, JobStatus.FAILED_SETUP)
             return
         job_lib.set_status(state_dir, job_id, JobStatus.RUNNING)
-        rcs = _run_ranks(state_dir, job_id, spec, runners)
+        stop_probing = threading.Event()
+
+        def on_dead(rank: int) -> None:
+            print(f'Worker {rank} unreachable for '
+                  f'{_PROBE_THRESHOLD} consecutive probes; failing '
+                  f'job {job_id}.')
+            job_lib.set_status(state_dir, job_id, JobStatus.FAILED)
+            # Kill our whole subprocess tree first: the SSH clients
+            # driving ranks on still-HEALTHY hosts would otherwise be
+            # orphaned and keep their remote processes holding TPU
+            # devices into the next scheduled job. Then exit hard —
+            # rank threads may be wedged inside SSH to the dead host;
+            # the status is already terminal, and agentd's next tick
+            # resumes scheduling.
+            subprocess_utils.kill_process_tree(os.getpid(),
+                                               include_parent=False)
+            os._exit(1)
+
+        probe = threading.Thread(
+            target=monitor_workers,
+            args=(runners, stop_probing, on_dead), daemon=True)
+        probe.start()
+        try:
+            rcs = _run_ranks(state_dir, job_id, spec, runners)
+        finally:
+            stop_probing.set()
         if any(rc != 0 for rc in rcs):
             print(f'Job {job_id} failed: per-rank return codes {rcs}')
             job_lib.set_status(state_dir, job_id, JobStatus.FAILED)

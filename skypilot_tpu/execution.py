@@ -146,24 +146,39 @@ def launch(
     blocked_regions: Optional[List[str]] = None,
 ) -> Tuple[Optional[int], Optional[gang_backend.GangResourceHandle]]:
     """Provision (or reuse) a cluster and run the task on it."""
-    return _execute(
-        entrypoint,
-        cluster_name=cluster_name,
-        stages=[
-            Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
-            Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.PRE_EXEC,
-            Stage.EXEC, Stage.DOWN
-        ],
-        dryrun=dryrun,
-        stream_logs=stream_logs,
-        optimize_target=optimize_target,
-        detach_run=detach_run,
-        idle_minutes_to_autostop=idle_minutes_to_autostop,
-        down=down,
-        retry_until_up=retry_until_up,
-        no_setup=no_setup,
-        blocked_regions=blocked_regions,
-    )
+    from skypilot_tpu import usage
+    task0 = (entrypoint.tasks[0]
+             if isinstance(entrypoint, dag_lib.Dag) and entrypoint.tasks
+             else entrypoint)
+    res = next(iter(task0.resources)) if getattr(
+        task0, 'resources', None) else None
+    with usage.timed_event(
+            'launch',
+            cloud=(str(res.cloud)
+                   if res is not None and res.cloud is not None
+                   else None),
+            accelerator=(res.tpu.name
+                         if res is not None and res.is_tpu else None),
+            num_nodes=getattr(task0, 'num_nodes', None),
+            use_spot=res.use_spot if res is not None else None):
+        return _execute(
+            entrypoint,
+            cluster_name=cluster_name,
+            stages=[
+                Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+                Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.PRE_EXEC,
+                Stage.EXEC, Stage.DOWN
+            ],
+            dryrun=dryrun,
+            stream_logs=stream_logs,
+            optimize_target=optimize_target,
+            detach_run=detach_run,
+            idle_minutes_to_autostop=idle_minutes_to_autostop,
+            down=down,
+            retry_until_up=retry_until_up,
+            no_setup=no_setup,
+            blocked_regions=blocked_regions,
+        )
 
 
 def exec_(  # pylint: disable=redefined-builtin

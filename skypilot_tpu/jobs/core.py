@@ -154,6 +154,9 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
     log_dir = _log_dir()
     os.makedirs(log_dir, exist_ok=True)
 
+    from skypilot_tpu import usage
+    usage.record_event('jobs.launch',
+                       use_spot=any(r.use_spot for r in task.resources))
     job_id = state.add_job(
         name=job_name,
         task_yaml='',

@@ -48,6 +48,8 @@ def up(task: task_lib.Task,
        lb_port: Optional[int] = None,
        controller_loop_gap: Optional[float] = None) -> Dict[str, Any]:
     """Start a service; returns {'name', 'endpoint'}."""
+    from skypilot_tpu import usage
+    usage.record_event('serve.up')
     if task.service is None:
         raise exceptions.InvalidTaskError(
             'Task has no service: section.')

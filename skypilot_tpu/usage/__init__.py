@@ -1,0 +1,18 @@
+"""Opt-out usage recording (reference ``sky/usage/usage_lib.py:341``).
+
+The reference POSTs schema-scrubbed usage messages to a Loki
+endpoint; this build has no telemetry backend (and runs in zero-
+egress environments), so events append to a local JSONL ring under
+``$SKYTPU_DATA_DIR/usage/`` — same scrubbing contract, same opt-out
+(``SKYTPU_DISABLE_USAGE=1``). A deployment that wants a collector
+tails/ships that file; an in-process POST sink is deliberately not
+built.
+
+Scrubbing: only whitelisted, non-identifying fields are recorded
+(operation name, cloud, accelerator type, counts, durations, status).
+Never commands, paths, env vars, or resource names.
+"""
+from skypilot_tpu.usage.usage_lib import (disabled, messages_path,
+                                          record_event, timed_event)
+
+__all__ = ['record_event', 'timed_event', 'disabled', 'messages_path']

@@ -1,0 +1,87 @@
+"""Local JSONL usage sink with schema scrubbing."""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, Iterator
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_DISABLE_ENV = 'SKYTPU_DISABLE_USAGE'
+
+# The whitelist IS the schema: anything not listed never leaves the
+# call site (reference scrubs via schemas too,
+# sky/usage/usage_lib.py + design_docs/usage_collection.md).
+_ALLOWED_FIELDS = frozenset({
+    'op', 'cloud', 'accelerator', 'num_chips', 'num_hosts',
+    'num_nodes', 'use_spot', 'duration_s', 'status', 'error_type',
+    'backend', 'recovery_count', 'candidate_count',
+})
+
+_MAX_BYTES = 4 * 1024 * 1024  # ring cap
+
+
+def disabled() -> bool:
+    return os.environ.get(_DISABLE_ENV, '').lower() in ('1', 'true')
+
+
+def messages_path() -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_DATA_DIR', '~/.skytpu'))
+    path = os.path.join(base, 'usage')
+    os.makedirs(path, exist_ok=True)
+    return os.path.join(path, 'messages.jsonl')
+
+
+def _scrub(fields: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for key, value in fields.items():
+        if key not in _ALLOWED_FIELDS:
+            continue
+        if isinstance(value, (str, int, float, bool)) or value is None:
+            out[key] = value
+        else:
+            out[key] = str(value)
+    return out
+
+
+def record_event(op: str, **fields: Any) -> None:
+    """Append one scrubbed event; never raises, never blocks long."""
+    if disabled():
+        return
+    try:
+        event = {
+            'ts': time.time(),
+            'run_id': common_utils.get_user_hash(),
+            'op': op,
+            **_scrub(fields),
+        }
+        path = messages_path()
+        # Ring behavior: start over when the file grows too large.
+        if (os.path.exists(path) and
+                os.path.getsize(path) > _MAX_BYTES):
+            os.replace(path, path + '.1')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(event) + '\n')
+    except Exception:  # pylint: disable=broad-except
+        pass  # usage must never break the product
+
+
+@contextlib.contextmanager
+def timed_event(op: str, **fields: Any) -> Iterator[None]:
+    """Record ``op`` with duration + success/error status."""
+    start = time.time()
+    status, error_type = 'ok', None
+    try:
+        yield
+    except BaseException as e:
+        status, error_type = 'error', type(e).__name__
+        raise
+    finally:
+        record_event(op, duration_s=round(time.time() - start, 3),
+                     status=status, error_type=error_type, **fields)

@@ -191,3 +191,38 @@ def test_tpu_pod_stop_rejected(cluster_name):
     with pytest.raises(exceptions.NotSupportedError):
         GCP.check_features_are_supported(
             r, {cloud_lib.CloudImplementationFeatures.STOP})
+
+
+def test_worker_liveness_monitor_detects_dead_host():
+    """monitor_workers fires on_dead after `threshold` consecutive
+    failed probes of one host and never for healthy hosts."""
+    import threading
+
+    from skypilot_tpu.agent import driver
+
+    class FakeRunner:
+
+        def __init__(self, alive):
+            self.alive = alive
+
+        def check_connection(self):
+            return self.alive
+
+    dead_ranks = []
+    stop = threading.Event()
+    driver.monitor_workers(
+        [FakeRunner(True), FakeRunner(False), FakeRunner(True)],
+        stop, dead_ranks.append, interval=0.01, threshold=3)
+    assert dead_ranks == [1]
+
+    # All-healthy: returns only when stopped, no on_dead.
+    dead_ranks.clear()
+    stop = threading.Event()
+    t = threading.Thread(
+        target=driver.monitor_workers,
+        args=([FakeRunner(True)], stop, dead_ranks.append, 0.01, 3))
+    t.start()
+    time.sleep(0.2)
+    stop.set()
+    t.join(timeout=2)
+    assert not t.is_alive() and dead_ranks == []

@@ -87,3 +87,37 @@ def test_profiler_trace_hook(tmp_path, monkeypatch):
     assert traces, 'no trace captured'
     # Only one capture: flag latched.
     assert profiling._traced_once
+
+
+def test_usage_recording_scrubbed(isolated_state, monkeypatch):
+    """Usage events land in the local JSONL sink, schema-scrubbed;
+    SKYTPU_DISABLE_USAGE suppresses them entirely."""
+    import json
+
+    import pytest
+
+    from skypilot_tpu import usage
+    usage.record_event('launch', cloud='local', num_nodes=2,
+                       secret_command='rm -rf /', workdir='/home/x')
+    with open(usage.messages_path(), encoding='utf-8') as f:
+        events = [json.loads(l) for l in f]
+    assert events[-1]['op'] == 'launch'
+    assert events[-1]['cloud'] == 'local'
+    # Non-whitelisted fields never reach the sink.
+    assert 'secret_command' not in events[-1]
+    assert 'workdir' not in events[-1]
+
+    with pytest.raises(ValueError):
+        with usage.timed_event('exec', cloud='gcp'):
+            raise ValueError('boom')
+    with open(usage.messages_path(), encoding='utf-8') as f:
+        events = [json.loads(l) for l in f]
+    assert events[-1]['status'] == 'error'
+    assert events[-1]['error_type'] == 'ValueError'
+    assert events[-1]['duration_s'] >= 0
+
+    monkeypatch.setenv('SKYTPU_DISABLE_USAGE', '1')
+    n = len(events)
+    usage.record_event('launch', cloud='local')
+    with open(usage.messages_path(), encoding='utf-8') as f:
+        assert len(f.readlines()) == n
