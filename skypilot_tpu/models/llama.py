@@ -39,7 +39,9 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     attn_impl: str = 'auto'   # auto | flash | ring | xla
-    remat: bool = True
+    # True = full remat; 'dots' = selective (save matmul outputs,
+    # recompute elementwise); False = none.
+    remat: Any = True
     loss_chunk: int = 512     # seq positions per cross-entropy chunk
 
     @property
@@ -148,6 +150,24 @@ def param_specs(cfg: LlamaConfig) -> Dict:
     }
 
 
+def remat_layer_fn(layer, remat):
+    """Apply the config's rematerialization policy to a scan body.
+
+    True = full remat (checkpoint everything); 'dots' = selective
+    (keep matmul outputs — the expensive MXU work — and recompute
+    only elementwise/norm ops in the backward: cheaper recompute than
+    full remat at a fraction of no-remat's activation memory);
+    False = no remat.
+    """
+    if remat == 'dots':
+        return jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if remat:
+        return jax.checkpoint(layer)
+    return layer
+
+
 ACT_SPEC = P(('dp', 'fsdp'), 'sp', None)          # [B, S, D]
 HEAD_SPEC = P(('dp', 'fsdp'), 'sp', 'tp', None)   # [B, S, H, hd]
 
@@ -246,8 +266,8 @@ def forward_hidden(params: Dict,
                           ACT_SPEC)
         return x, None
 
-    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
-    x, _ = lax.scan(layer_fn, x, params['layers'])
+    x, _ = lax.scan(remat_layer_fn(layer, cfg.remat),
+                    x, params['layers'])
 
     return _rmsnorm(x, params['final_norm'], cfg.norm_eps)
 

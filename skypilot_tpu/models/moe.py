@@ -35,7 +35,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models.llama import (ACT_SPEC, LlamaConfig,
-                                       _attention, _rmsnorm, _rope)
+                                       _attention, _rmsnorm, _rope,
+                                       remat_layer_fn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,8 +223,8 @@ def forward_hidden(params: Dict, tokens: jax.Array, cfg: MoEConfig,
         x = x + constrain(y, ACT_SPEC)
         return (x, aux + layer_aux), None
 
-    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
-    (x, aux), _ = lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
+    (x, aux), _ = lax.scan(remat_layer_fn(layer, cfg.remat),
+                           (x, jnp.zeros((), jnp.float32)),
                            params['layers'])
     return _rmsnorm(x, params['final_norm'], cfg.norm_eps), aux
 

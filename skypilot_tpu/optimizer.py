@@ -94,8 +94,8 @@ def _runtime_seconds(task: task_lib.Task,
     Uses task.estimate_runtime (seconds on a reference 8-chip slice) if
     set; scales inversely with chip count for TPU resources.
     """
-    base = getattr(task, 'estimate_runtime', None) or _DEFAULT_RUNTIME_SECONDS
-    if launchable.is_tpu and getattr(task, 'estimate_runtime', None):
+    base = task.estimate_runtime or _DEFAULT_RUNTIME_SECONDS
+    if launchable.is_tpu and task.estimate_runtime:
         scale = launchable.tpu.num_chips / 8.0
         return base / max(scale, 1e-6)
     return base

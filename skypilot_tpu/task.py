@@ -39,6 +39,7 @@ class Task:
         workdir: Optional[str] = None,
         num_nodes: Optional[int] = None,
         file_mounts: Optional[Dict[str, str]] = None,
+        estimate_runtime: Optional[float] = None,
         storage_mounts: Optional[Dict[str, Any]] = None,
         service: Optional[Any] = None,
     ) -> None:
@@ -50,6 +51,10 @@ class Task:
         self._envs = dict(envs) if envs else {}
         self.file_mounts: Optional[Dict[str, str]] = (dict(file_mounts)
                                                       if file_mounts else None)
+        # Seconds on a reference 8-chip slice; the optimizer's TIME
+        # objective scales it by chip count.
+        self.estimate_runtime: Optional[float] = (
+            float(estimate_runtime) if estimate_runtime else None)
         self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
         self.service = service
         self._resources: Set[resources_lib.Resources] = {
@@ -180,6 +185,10 @@ class Task:
             from skypilot_tpu.serve import service_spec
             task.service = service_spec.ServiceSpec.from_yaml_config(
                 config['service'])
+        if config.get('estimate_runtime') is not None:
+            # Seconds on a reference 8-chip slice; the optimizer's
+            # TIME objective scales it by chip count.
+            task.estimate_runtime = float(config['estimate_runtime'])
         resources_config = config.get('resources')
         parsed = resources_lib.Resources.from_yaml_config(resources_config)
         task.set_resources(parsed if isinstance(parsed, list) else {parsed})
@@ -218,6 +227,7 @@ class Task:
         add('storage_mounts', self.storage_mounts or None)
         if self.service is not None:
             add('service', self.service.to_yaml_config())
+        add('estimate_runtime', self.estimate_runtime)
         return config
 
     # ------------------------------------------------------------------

@@ -102,6 +102,7 @@ TASK_SCHEMA = {
             },
         },
         'num_nodes': {'type': 'integer', 'minimum': 1},
+        'estimate_runtime': {'type': 'number', 'exclusiveMinimum': 0},
         'resources': _RESOURCES_SCHEMA,
         'file_mounts': {'type': 'object'},
         'storage_mounts': {'type': 'object'},

@@ -92,3 +92,22 @@ def test_sharded_train_step_no_involuntary_remat(capfd):
     # sees it where capsys would not.
     err = capfd.readouterr().err
     assert 'Involuntary full rematerialization' not in err, err
+
+
+def test_selective_remat_matches_full():
+    """remat='dots' (save matmuls, recompute elementwise) computes
+    the same loss/gradients as full remat."""
+    import jax.numpy as jnp
+    batch = _toy_batch(models.LlamaConfig.tiny())
+    losses = {}
+    for remat in (True, 'dots'):
+        cfg = models.LlamaConfig.tiny(remat=remat)
+        params = models.init_params(cfg, jax.random.PRNGKey(0))
+        loss, grads = jax.value_and_grad(models.loss_fn)(
+            params, batch, cfg)
+        losses[remat] = (float(loss),
+                         float(jnp.sum(grads['tok_emb'] ** 2)))
+    np.testing.assert_allclose(losses[True][0], losses['dots'][0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(losses[True][1], losses['dots'][1],
+                               rtol=1e-4)

@@ -76,3 +76,12 @@ def test_invalid_num_nodes():
 def test_callable_run():
     t = Task(run=lambda rank, ips: f'echo rank {rank}')
     assert callable(t.run)
+
+
+def test_estimate_runtime_yaml_roundtrip():
+    config = {'name': 'est', 'run': 'true',
+              'resources': {'cloud': 'local'},
+              'estimate_runtime': 7200}
+    task = Task.from_yaml_config(config)
+    assert task.estimate_runtime == 7200.0
+    assert task.to_yaml_config()['estimate_runtime'] == 7200.0
