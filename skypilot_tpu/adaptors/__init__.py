@@ -1,0 +1,10 @@
+"""Cloud SDK adaptors: lazy imports + cached auth.
+
+Re-design of reference ``sky/adaptors/`` (``common.py:9-45``
+LazyImport): an unused cloud's SDK must cost nothing at import time —
+``import skypilot_tpu`` pulls no boto3/google-auth — and repeated
+credential loads within one process reuse one authorized session.
+"""
+from skypilot_tpu.adaptors.common import LazyImport
+
+__all__ = ['LazyImport']

@@ -1,0 +1,61 @@
+"""LazyImport + per-cloud cached sessions."""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Callable, Optional
+
+
+class LazyImport:
+    """Defer a module import until first attribute access.
+
+    ``boto3 = LazyImport('boto3')`` costs nothing unless AWS code
+    actually runs; a missing SDK raises only when used, with an
+    install hint (reference sky/adaptors/common.py:9).
+    """
+
+    def __init__(self, module_name: str,
+                 import_error_message: Optional[str] = None) -> None:
+        self._module_name = module_name
+        self._module: Any = None
+        self._error = import_error_message
+        self._lock = threading.Lock()
+
+    def _load(self) -> Any:
+        if self._module is None:
+            with self._lock:
+                if self._module is None:
+                    try:
+                        self._module = importlib.import_module(
+                            self._module_name)
+                    except ImportError as e:
+                        msg = self._error or (
+                            f'Failed to import {self._module_name!r}; '
+                            f'install it to use this cloud.')
+                        raise ImportError(msg) from e
+        return self._module
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._load(), name)
+
+
+class CachedSession:
+    """One authorized session per process (auth handshakes are
+    hundreds of ms; status refresh loops would otherwise pay it per
+    call — the reference caches via module globals in each adaptor)."""
+
+    def __init__(self, factory: Callable[[], Any]) -> None:
+        self._factory = factory
+        self._session: Any = None
+        self._lock = threading.Lock()
+
+    def get(self) -> Any:
+        if self._session is None:
+            with self._lock:
+                if self._session is None:
+                    self._session = self._factory()
+        return self._session
+
+    def reset(self) -> None:
+        with self._lock:
+            self._session = None

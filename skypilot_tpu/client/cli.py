@@ -178,6 +178,23 @@ def check() -> None:
     click.echo('Enabled clouds: ' + ', '.join(enabled))
 
 
+@cli.command('cost-report')
+def cost_report() -> None:
+    """Accumulated cost per cluster from usage intervals."""
+    # Through the SDK: the cluster history lives in the API server's
+    # DB, which may be on another machine (team deployment).
+    rows = sdk.get(sdk.cost_report())
+    _echo_table([{
+        'name': r['name'],
+        'nodes': r['num_nodes'],
+        'duration_h': round((r['duration'] or 0) / 3600.0, 2),
+        'resources': r['resources'],
+        'cost_usd': (round(r['cost'], 2) if r['cost'] is not None
+                     else '-'),
+    } for r in rows], ['name', 'nodes', 'duration_h', 'resources',
+                       'cost_usd'])
+
+
 @cli.command('show-tpus')
 @click.option('--name-filter', default=None)
 def show_tpus(name_filter: Optional[str]) -> None:

@@ -235,6 +235,10 @@ def cancel(cluster_name: str,
                              'job_ids': job_ids, 'all_jobs': all_jobs})
 
 
+def cost_report() -> str:
+    return submit('cost_report', {})
+
+
 def check() -> str:
     return submit('check', {})
 

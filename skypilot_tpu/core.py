@@ -171,7 +171,9 @@ def cost_report() -> List[Dict[str, Any]]:
             'name': row['name'],
             'duration': duration,
             'num_nodes': row['num_nodes'],
-            'resources': launched,
+            # repr, not the object: results cross the API server as
+            # JSON.
+            'resources': repr(launched) if launched is not None else None,
             'cost': cost,
             'queried_at': time_lib.time(),
         })

@@ -71,19 +71,37 @@ def translate_error(status_code: int, body: Dict[str, Any],
         f'{what}: HTTP {status_code}: {message}')
 
 
+# One authorized session per factory (clients are constructed
+# per-call by the provision ops; without this cache every status poll
+# would redo the google-auth handshake). Keyed by the factory object
+# so tests that monkeypatch ``session_factory`` get a fresh session —
+# which is why this is not a plain adaptors.CachedSession. Locked:
+# the API server runs provision ops on an 8-thread pool.
+import threading as _threading
+
+_session_cache: Dict[Any, Any] = {}
+_session_lock = _threading.Lock()
+
+
+def _get_session():
+    factory = session_factory
+    with _session_lock:
+        if factory not in _session_cache:
+            _session_cache.clear()  # replaced factory obsoletes old
+            _session_cache[factory] = factory()
+        return _session_cache[factory]
+
+
 class RestClient:
     """Shared request/poll plumbing for the TPU and GCE clients."""
 
     def __init__(self, base_url: str, project: str) -> None:
         self.base = base_url
         self.project = project
-        self._session = None
 
     @property
     def session(self):
-        if self._session is None:
-            self._session = session_factory()
-        return self._session
+        return _get_session()
 
     def request(self, method: str, path: str, *,
                 json_body: Optional[Dict] = None,

@@ -140,3 +140,13 @@ def test_cli_exec_on_missing_cluster_errors(runner, server_env,
     result = runner.invoke(
         cli_mod.cli, ['exec', 'nosuch', _task_yaml(tmp_path)])
     assert result.exit_code != 0
+
+
+def test_cli_cost_report(runner, server_env, tmp_path):
+    result = runner.invoke(
+        cli_mod.cli, ['launch', _task_yaml(tmp_path), '-c', 'costc'])
+    assert result.exit_code == 0, result.output
+    runner.invoke(cli_mod.cli, ['down', 'costc'])
+    result = runner.invoke(cli_mod.cli, ['cost-report'])
+    assert result.exit_code == 0, result.output
+    assert 'costc' in result.output

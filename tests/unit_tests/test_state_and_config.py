@@ -121,3 +121,45 @@ def test_usage_recording_scrubbed(isolated_state, monkeypatch):
     usage.record_event('launch', cloud='local')
     with open(usage.messages_path(), encoding='utf-8') as f:
         assert len(f.readlines()) == n
+
+
+def test_lazy_import_and_cached_session():
+    from skypilot_tpu.adaptors import LazyImport
+    from skypilot_tpu.adaptors.common import CachedSession
+    mod = LazyImport('json')
+    assert mod.dumps({'a': 1}) == '{"a": 1}'
+    missing = LazyImport('definitely_not_a_module_xyz',
+                         import_error_message='install the xyz SDK')
+    import pytest
+    with pytest.raises(ImportError, match='install the xyz SDK'):
+        missing.anything
+
+    calls = []
+    cache = CachedSession(lambda: calls.append(1) or object())
+    a, b = cache.get(), cache.get()
+    assert a is b and len(calls) == 1
+    cache.reset()
+    cache.get()
+    assert len(calls) == 2
+
+
+def test_gcp_session_cache_respects_factory_swap(monkeypatch):
+    from skypilot_tpu.provision.gcp import api
+    made = []
+
+    def factory_a():
+        made.append('a')
+        return object()
+
+    monkeypatch.setattr(api, 'session_factory', factory_a)
+    c = api.RestClient('https://x', 'p')
+    s1, s2 = c.session, c.session
+    assert s1 is s2 and made == ['a']
+
+    def factory_b():
+        made.append('b')
+        return object()
+
+    monkeypatch.setattr(api, 'session_factory', factory_b)
+    s3 = api.RestClient('https://x', 'p').session
+    assert s3 is not s1 and made == ['a', 'b']
