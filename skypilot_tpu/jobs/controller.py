@@ -42,10 +42,19 @@ class JobsController:
         assert record is not None, managed_job_id
         self.job_id = managed_job_id
         self.cluster_name = record['cluster_name']
-        self.task = task_lib.Task.from_yaml_config(record['dag'])
+        dag = record['dag']
+        # dag_json: historically one task config, now a list (chain
+        # pipeline); normalize.
+        configs = dag if isinstance(dag, list) else [dag]
+        self.tasks = [task_lib.Task.from_yaml_config(c) for c in configs]
+        self.task = self.tasks[0]
         self.strategy = recovery_strategy.StrategyExecutor.make(
             self.cluster_name, self.task)
         self.check_gap = check_gap
+
+    def _task_cluster(self, index: int) -> str:
+        return (self.cluster_name if index == 0 else
+                f'{self.cluster_name}-t{index}')
 
     # ------------------------------------------------------------------
     def _cluster_status(self) -> Optional[status_lib.ClusterStatus]:
@@ -124,6 +133,24 @@ class JobsController:
 
     # ------------------------------------------------------------------
     def run(self) -> state.ManagedJobStatus:
+        """Run every task of the (chain) dag in order; the managed job
+        succeeds only if all tasks do."""
+        result = state.ManagedJobStatus.SUCCEEDED
+        for index, task in enumerate(self.tasks):
+            self.task = task
+            self.strategy = recovery_strategy.StrategyExecutor.make(
+                self._task_cluster(index), task)
+            self.cluster_name = self.strategy.cluster_name
+            if index > 0:
+                logger.info('Pipeline task %d/%d: %s.', index + 1,
+                            len(self.tasks), task.name)
+            result = self._run_task()
+            if result != state.ManagedJobStatus.SUCCEEDED:
+                return result
+        state.set_status(self.job_id, state.ManagedJobStatus.SUCCEEDED)
+        return result
+
+    def _run_task(self) -> state.ManagedJobStatus:
         state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
         # Launches are slot-limited (jobs/scheduler.py): a burst of
         # submissions provisions at most launch_parallelism() clusters
@@ -154,7 +181,11 @@ class JobsController:
                 return state.ManagedJobStatus.CANCELLED
             if result != state.ManagedJobStatus.RECOVERING:
                 self.strategy.terminate_cluster()
-                state.set_status(self.job_id, result)
+                if result is not state.ManagedJobStatus.SUCCEEDED:
+                    state.set_status(self.job_id, result)
+                # SUCCEEDED is recorded by run() only after the LAST
+                # task — a watcher must never observe a terminal
+                # status mid-pipeline.
                 return result
             # Preemption: recover.
             n = state.bump_recovery(self.job_id)

@@ -144,11 +144,13 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
            controller_check_gap: Optional[float] = None) -> int:
     """Submit a managed job; returns the managed job id."""
     if isinstance(entrypoint, dag_lib.Dag):
-        assert len(entrypoint.tasks) == 1, (
-            'Managed jobs currently take a single task.')
-        task = entrypoint.tasks[0]
+        if not entrypoint.is_chain():
+            raise exceptions.NotSupportedError(
+                'Managed jobs take a single task or a chain pipeline.')
+        tasks = entrypoint.get_sorted_tasks()
     else:
-        task = entrypoint
+        tasks = [entrypoint]
+    task = tasks[0]
     job_name = name or task.name or 'managed'
     cluster_name = (f'{job_name}-{common_utils.generate_run_id(4)}')
     log_dir = _log_dir()
@@ -157,12 +159,16 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
     from skypilot_tpu import usage
     usage.record_event('jobs.launch',
                        use_spot=any(r.use_spot for r in task.resources))
+    # dag_json is a LIST of task configs: one task = [config], a chain
+    # pipeline = its tasks in topological order, each run on its own
+    # cluster by the controller (reference jobs run chain dags the
+    # same way, sky/jobs/controller.py:371 iterating dag.tasks).
     job_id = state.add_job(
         name=job_name,
         task_yaml='',
         cluster_name=cluster_name,
         log_path='',  # id-dependent; recorded just below
-        dag_json=json.dumps(task.to_yaml_config()))
+        dag_json=json.dumps([t.to_yaml_config() for t in tasks]))
     log_path = os.path.join(log_dir, f'{job_id}-{job_name}.log')
     state.set_log_path(job_id, log_path)
     state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)

@@ -176,3 +176,40 @@ def test_jobs_dashboard_renders(isolated_state):
             assert any(j['job_id'] == job_id for j in jobs)
 
     asyncio.run(drive())
+
+
+def test_pipeline_chain_runs_tasks_in_order(isolated_state, tmp_path):
+    """A chain dag runs task-per-cluster sequentially; the job is
+    SUCCEEDED only after the last task (reference jobs controller
+    iterating dag.tasks)."""
+    from skypilot_tpu import dag as dag_lib
+    order = tmp_path / 'order.txt'
+    with dag_lib.Dag() as dag:
+        a = task_lib.Task('stage-a', run=f'echo A >> {order}')
+        a.set_resources(resources_lib.Resources(cloud='local'))
+        b = task_lib.Task('stage-b', run=f'echo B >> {order}')
+        b.set_resources(resources_lib.Resources(cloud='local'))
+    dag.add_edge(a, b) if hasattr(dag, 'add_edge') else a >> b
+    job_id = jobs_core.launch(dag, controller_check_gap=0.3)
+    job = _wait_status(job_id,
+                       state.ManagedJobStatus.terminal_statuses(),
+                       timeout=120)
+    assert job['status'] == state.ManagedJobStatus.SUCCEEDED, job
+    assert order.read_text().split() == ['A', 'B']
+
+
+def test_pipeline_chain_stops_on_failure(isolated_state, tmp_path):
+    from skypilot_tpu import dag as dag_lib
+    marker = tmp_path / 'ran_b'
+    with dag_lib.Dag() as dag:
+        a = task_lib.Task('bad-a', run='exit 7')
+        a.set_resources(resources_lib.Resources(cloud='local'))
+        b = task_lib.Task('never-b', run=f'touch {marker}')
+        b.set_resources(resources_lib.Resources(cloud='local'))
+    a >> b
+    job_id = jobs_core.launch(dag, controller_check_gap=0.3)
+    job = _wait_status(job_id,
+                       state.ManagedJobStatus.terminal_statuses(),
+                       timeout=120)
+    assert job['status'] == state.ManagedJobStatus.FAILED, job
+    assert not marker.exists(), 'task B must not run after A failed'
