@@ -16,7 +16,6 @@ import time
 from typing import Any, Dict, List, Optional
 
 import filelock
-import psutil
 
 from skypilot_tpu.agent import constants
 from skypilot_tpu.utils import log as sky_logging
@@ -171,13 +170,7 @@ def _query(state_dir: str, suffix: str, params: tuple
 # ----------------------------------------------------------------------
 # Scheduler
 def _driver_alive(pid: Optional[int]) -> bool:
-    if pid is None:
-        return False
-    try:
-        proc = psutil.Process(pid)
-        return proc.is_running() and proc.status() != psutil.STATUS_ZOMBIE
-    except psutil.NoSuchProcess:
-        return False
+    return subprocess_utils.process_alive(pid)
 
 
 def update_dead_drivers(state_dir: str) -> None:

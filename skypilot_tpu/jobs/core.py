@@ -28,6 +28,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
 
@@ -36,22 +37,11 @@ def _log_dir() -> str:
         os.environ.get('SKYTPU_JOBS_LOG_DIR', '~/.skytpu/managed_jobs'))
 
 
-def _controller_alive(pid: Optional[int]) -> bool:
-    if not pid:
-        return False
-    try:
-        os.kill(pid, 0)
-    except (OSError, ProcessLookupError):
-        return False
-    # A zombie (un-reaped child of a long-lived launcher, e.g. the API
-    # server) still answers kill(0); check the process state.
-    try:
-        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
-            # field 3 (after the parenthesized comm) is the state.
-            state_char = f.read().rsplit(')', 1)[1].split()[0]
-        return state_char != 'Z'
-    except (OSError, IndexError):
-        return True
+def _controller_alive(pid: Optional[int], job_id: int) -> bool:
+    # The cmdline tokens guard against pid recycling (see
+    # subprocess_utils.process_alive); they also exclude zombies.
+    return subprocess_utils.process_alive(
+        pid, cmdline_tokens=(state.CONTROLLER_MODULE, str(job_id)))
 
 
 CONTROLLER_CLUSTER_NAME = 'skytpu-jobs-controller'
@@ -118,7 +108,7 @@ def _submit_to_controller_cluster(job_id: int,
     ensure_controller_cluster()
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    cmd = (f'python -u -m skypilot_tpu.jobs.controller {job_id}')
+    cmd = f'python -u -m {state.CONTROLLER_MODULE} {job_id}'
     if check_gap is not None:
         cmd += f' --check-gap {check_gap}'
     envs = {'PYTHONPATH': repo_root}
@@ -183,7 +173,7 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
         return job_id
 
     cmd = [
-        sys.executable, '-u', '-m', 'skypilot_tpu.jobs.controller',
+        sys.executable, '-u', '-m', state.CONTROLLER_MODULE,
         str(job_id)
     ]
     if controller_check_gap is not None:
@@ -227,7 +217,8 @@ def queue(refresh: bool = True) -> List[Dict[str, Any]]:
                         job['controller_job_id']):
                     _mark_controller_dead(job)
                 continue
-            if not _controller_alive(job['controller_pid']):
+            if not _controller_alive(job['controller_pid'],
+                                     job['job_id']):
                 _mark_controller_dead(job)
     return jobs
 

@@ -22,6 +22,7 @@ from typing import Optional
 
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
 
@@ -55,9 +56,16 @@ def _sweep_dead_launchers() -> None:
         pid = job.get('controller_pid')
         if not pid:
             continue
-        try:
-            os.kill(pid, 0)
-        except (OSError, ProcessLookupError):
+        # The cmdline tokens distinguish THIS job's live controller
+        # from an unrelated process (or another job's controller) that
+        # recycled its pid — e.g. after a reboot, where EPERM from
+        # another user's process would otherwise read as either
+        # alive-forever or dead depending on taste, both wrong in one
+        # direction.
+        if not subprocess_utils.process_alive(
+                pid,
+                cmdline_tokens=(state.CONTROLLER_MODULE,
+                                str(job['job_id']))):
             logger.warning(
                 'Managed job %d: controller %d died holding a launch '
                 'slot; releasing.', job['job_id'], pid)

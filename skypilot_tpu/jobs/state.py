@@ -19,6 +19,12 @@ from skypilot_tpu.utils.status_lib import ManagedJobStatus
 _DB_PATH_ENV = 'SKYTPU_JOBS_DB'
 _DEFAULT_DB = '~/.skytpu/managed_jobs.db'
 
+# The controller's module path. Load-bearing twice: it is how the
+# controller is spawned (`python -m <module> <job_id>`) AND the cmdline
+# marker liveness checks use to tell a live controller from an
+# unrelated process that recycled its recorded pid.
+CONTROLLER_MODULE = 'skypilot_tpu.jobs.controller'
+
 
 def _db_path() -> str:
     return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))

@@ -157,9 +157,12 @@ def run_instances(
 def wait_instances(cluster_name_on_cloud: str, region: str,
                    zone: Optional[str], state: Optional[str]) -> None:
     del zone
+    # Provider contract (matches aws/local): state=None waits for
+    # 'running'; teardown waits must pass state='terminated' explicitly.
+    state = state or 'running'
     client = _client(region)
     deadline = time.time() + _WAIT_TIMEOUT
-    want_gone = state in (None, 'terminated')
+    want_gone = state == 'terminated'
     while time.time() < deadline:
         pods = client.list_pods(_selector(cluster_name_on_cloud))
         if state == 'running':

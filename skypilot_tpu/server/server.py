@@ -67,11 +67,15 @@ async def handle_upload(request: web.Request) -> web.Response:
         try:
             with zipfile.ZipFile(io.BytesIO(data)) as zf:
                 # Reject entries escaping the extraction root.
+                extract_root = os.path.realpath(dst + '.tmp')
                 for name in zf.namelist():
+                    if os.path.isabs(name):
+                        raise web.HTTPBadRequest(
+                            text=f'unsafe zip entry {name!r}')
                     target = os.path.realpath(
-                        os.path.join(dst + '.tmp', name))
-                    if not target.startswith(
-                            os.path.realpath(dst + '.tmp')):
+                        os.path.join(extract_root, name))
+                    if os.path.commonpath([extract_root,
+                                           target]) != extract_root:
                         raise web.HTTPBadRequest(
                             text=f'unsafe zip entry {name!r}')
                 zf.extractall(dst + '.tmp')

@@ -62,12 +62,26 @@ def record_event(op: str, **fields: Any) -> None:
             **_scrub(fields),
         }
         path = messages_path()
-        # Ring behavior: start over when the file grows too large.
-        if (os.path.exists(path) and
-                os.path.getsize(path) > _MAX_BYTES):
-            os.replace(path, path + '.1')
-        with open(path, 'a', encoding='utf-8') as f:
-            f.write(json.dumps(event) + '\n')
+        # Ring behavior: start over when the file grows too large. The
+        # rotate-then-append pair is guarded by a file lock because the
+        # jobs controller and CLI write concurrently; without it two
+        # writers can both rotate and drop the first rotation's events.
+        import filelock
+        line = json.dumps(event) + '\n'
+        try:
+            with filelock.FileLock(path + '.lock', timeout=1):
+                if (os.path.exists(path) and
+                        os.path.getsize(path) > _MAX_BYTES):
+                    os.replace(path, path + '.1')
+                with open(path, 'a', encoding='utf-8') as f:
+                    f.write(line)
+        except Exception:  # pylint: disable=broad-except
+            # Lock contended (>1s) or unusable (e.g. unwritable .lock
+            # file): append lock-less rather than drop the live event.
+            # Worst case a rotation races, losing only rotated history —
+            # the pre-lock behavior.
+            with open(path, 'a', encoding='utf-8') as f:
+                f.write(line)
     except Exception:  # pylint: disable=broad-except
         pass  # usage must never break the product
 

@@ -46,6 +46,43 @@ def run_in_parallel(fn: Callable[..., Any],
         return list(pool.map(fn, args_list))
 
 
+def process_alive(
+        pid: Optional[int],
+        cmdline_tokens: Optional[Sequence[str]] = None) -> bool:
+    """True iff ``pid`` is a live (non-zombie) process and, when
+    ``cmdline_tokens`` is given, every token appears as an exact argv
+    element of its command line.
+
+    The tokens guard against PID recycling: after a reboot or PID
+    wraparound a recorded pid may name an unrelated process — possibly
+    another user's, where ``kill(pid, 0)`` raises EPERM. Exact argv
+    matching (not substring) lets callers pin the specific invocation,
+    e.g. ``('skypilot_tpu.jobs.controller', '123')`` distinguishes job
+    123's controller from job 12's. ``cmdline`` is world-readable on
+    Linux, so the check works across users; when the process cannot be
+    inspected at all and tokens were given, it cannot be one we spawned
+    as this user, so it counts as dead.
+    """
+    if not pid:
+        return False
+    try:
+        proc = psutil.Process(pid)
+        if proc.status() == psutil.STATUS_ZOMBIE:
+            return False
+        if cmdline_tokens is None:
+            return True
+        argv = proc.cmdline()
+        return all(tok in argv for tok in cmdline_tokens)
+    except psutil.NoSuchProcess:
+        return False
+    except psutil.AccessDenied:
+        if cmdline_tokens is not None:
+            return False
+        # Exists but unreadable and no tokens to compare: report alive
+        # (conservative — never tear down someone else's live process).
+        return True
+
+
 def kill_process_tree(pid: int, include_parent: bool = True) -> None:
     """SIGTERM then SIGKILL a whole process tree rooted at pid."""
     try:
