@@ -35,6 +35,7 @@ from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import provisioner
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import docker_utils
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import registry
 from skypilot_tpu.utils import status_lib
@@ -319,6 +320,17 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 ssh_key, _ = authentication.get_or_generate_keys()
             else:
                 ssh_key = None
+            # Task container (image_id: docker:<img>): recorded on the
+            # cluster info so hosts.json carries it to the gang driver.
+            # Kubernetes is excluded — there image_id overrides the pod
+            # image itself (provision/kubernetes/instance.py), no
+            # nested container needed.
+            docker_image = launched.extract_docker_image()
+            if (docker_image is not None and
+                    cluster_info.provider_name != 'kubernetes'):
+                cluster_info.docker_config = (
+                    docker_utils.make_docker_config(
+                        docker_image, task.envs or {}, cluster_name))
             state_dir = provisioner.post_provision_runtime_setup(
                 cluster_info,
                 ssh_private_key=ssh_key,

@@ -96,7 +96,10 @@ class AWS(cloud_lib.Cloud):
             'instance_type': resources.instance_type,
             'use_spot': resources.use_spot,
             'disk_size': resources.disk_size,
-            'image_id': resources.image_id,  # AMI id; None = default
+            # AMI id; None = default. docker:<img> is a task container
+            # (bootstrapped post-provision), not an AMI.
+            'image_id': (None if resources.extract_docker_image() else
+                         resources.image_id),
             'labels': resources.labels or {},
             'ports': resources.ports or [],
             'num_hosts': 1,

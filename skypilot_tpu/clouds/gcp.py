@@ -136,7 +136,12 @@ class GCP(cloud_lib.Cloud):
             vars_.update({
                 'tpu_vm': False,
                 'instance_type': resources.instance_type,
-                'image_id': resources.image_id,
+                # docker:<img> is a task container, not a VM source
+                # image — the VM boots its default image and the
+                # backend bootstraps the container on it.
+                'image_id': (None
+                             if resources.extract_docker_image()
+                             else resources.image_id),
                 'num_hosts': 1,
             })
         return vars_

@@ -117,7 +117,10 @@ class Kubernetes(cloud_lib.Cloud):
             'context': region,
             'region': region,
             'zone': None,
-            'image_id': resources.image_id,
+            # docker:<img> and a bare image mean the same thing on k8s:
+            # the pod runs that image (no nested container).
+            'image_id': (resources.extract_docker_image() or
+                         resources.image_id),
             'cpus': resources.cpus,
             'memory': resources.memory,
             'labels': resources.labels or {},

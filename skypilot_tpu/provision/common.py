@@ -75,6 +75,10 @@ class ClusterInfo:
     ssh_user: str = 'skytpu'
     # Provider-specific extras (e.g. TPU topology string).
     provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Set by the backend when the task's image_id names a docker image
+    # (docker_utils.make_docker_config): every host then runs job
+    # commands inside this container.
+    docker_config: Optional[Dict[str, Any]] = None
 
     def all_hosts(self) -> List[InstanceInfo]:
         """Hosts in stable rank order: head instance first, then by id;

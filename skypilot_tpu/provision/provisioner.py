@@ -47,6 +47,7 @@ def host_entries(cluster_info: common.ClusterInfo,
                  ssh_private_key: Optional[str]) -> List[Dict]:
     """hosts.json content: one entry per host in stable rank order."""
     entries = []
+    docker_config = cluster_info.docker_config
     for host in cluster_info.all_hosts():
         host_dir = host.tags.get('host_dir')
         if host_dir is not None:
@@ -74,14 +75,29 @@ def host_entries(cluster_info: common.ClusterInfo,
                 'key': ssh_private_key,
                 'port': host.ssh_port,
             })
+    if docker_config:
+        for entry in entries:
+            cfg = dict(docker_config)
+            if entry['kind'] == 'local':
+                # Simulated hosts share this machine's one docker
+                # daemon; a per-host suffix keeps their containers (and
+                # rm -f during bootstrap) from colliding. Real hosts
+                # each run their own daemon, so the shared name stands.
+                safe = ''.join(c if c.isalnum() or c in '_-' else '-'
+                               for c in entry['host_id'])
+                cfg['container'] = f"{cfg['container']}-{safe}"
+            entry['docker'] = cfg
     return entries
 
 
 def make_runners(cluster_info: common.ClusterInfo,
                  ssh_private_key: Optional[str]
                  ) -> List[runner_lib.CommandRunner]:
+    """Host-level runners (control plane: file sync, job submission,
+    log tail). Job commands go through the driver's own
+    runner_from_host_entry call, which applies the docker wrap."""
     return [
-        runner_lib.runner_from_host_entry(e)
+        runner_lib.runner_from_host_entry(e, in_container=False)
         for e in host_entries(cluster_info, ssh_private_key)
     ]
 
@@ -155,6 +171,26 @@ def setup_runtime_on_cluster(runners: List[runner_lib.CommandRunner],
     subprocess_utils.run_in_parallel(setup_one, list(enumerate(runners)))
 
 
+def setup_docker_on_cluster(cluster_info: common.ClusterInfo,
+                            ssh_private_key: Optional[str],
+                            log_dir: str) -> None:
+    """Bring up the task container on every host in parallel
+    (idempotent — cluster reuse and exec fast paths skip the pull).
+    Built from host entries so each host gets its per-host container
+    name (the same names the gang driver will exec into)."""
+
+    def bootstrap_one(pair) -> None:
+        idx, entry = pair
+        docker_runner = runner_lib.runner_from_host_entry(entry)
+        assert isinstance(docker_runner, runner_lib.DockerCommandRunner)
+        docker_runner.bootstrap(
+            log_path=os.path.join(log_dir, f'docker_setup-{idx}.log'))
+
+    entries = host_entries(cluster_info, ssh_private_key)
+    subprocess_utils.run_in_parallel(bootstrap_one,
+                                     list(enumerate(entries)))
+
+
 def start_agent_on_head(head_runner: runner_lib.CommandRunner,
                         state_dir: str, log_dir: str) -> None:
     """Start (or restart) agentd detached on the head host."""
@@ -190,6 +226,8 @@ def post_provision_runtime_setup(
         raise exceptions.ProvisionError('Cluster has no hosts.')
     wait_for_connectivity(runners)
     setup_runtime_on_cluster(runners, log_dir)
+    if cluster_info.docker_config:
+        setup_docker_on_cluster(cluster_info, ssh_private_key, log_dir)
     state_dir = head_state_dir(cluster_info)
     head_runner = runners[0]
     entries = host_entries(cluster_info, ssh_private_key)

@@ -218,6 +218,13 @@ class Resources:
     def image_id(self) -> Optional[str]:
         return self._image_id
 
+    def extract_docker_image(self) -> Optional[str]:
+        """Container image when image_id is ``docker:<image>`` —
+        the task then runs inside that container on every host
+        (reference sky/resources.py extract_docker_image)."""
+        from skypilot_tpu.utils import docker_utils
+        return docker_utils.extract_image(self._image_id)
+
     @property
     def ports(self) -> Optional[List[str]]:
         return self._ports

@@ -190,26 +190,105 @@ class LocalProcessRunner(CommandRunner):
             shutil.copy2(src, dst)
 
 
-def runner_from_host_entry(entry: Dict) -> CommandRunner:
+def runner_from_host_entry(entry: Dict,
+                           in_container: bool = True) -> CommandRunner:
     """Build a runner from a hosts.json entry (written at provision
     time; see backend). kind 'local' -> sandboxed local execution,
-    'ssh' -> real remote host."""
+    'ssh' -> real remote host.
+
+    An entry carrying a ``docker`` config wraps the host runner in
+    :class:`DockerCommandRunner` so job setup/run commands execute
+    inside the task container. Control-plane callers (runtime install,
+    agent start, log sync) pass ``in_container=False`` to reach the
+    host itself.
+    """
     kind = entry.get('kind', 'ssh')
     if kind == 'local':
-        return LocalProcessRunner(entry['host_id'], entry['host_dir'])
-    if kind == 'k8s':
-        return KubernetesCommandRunner(
+        runner: CommandRunner = LocalProcessRunner(entry['host_id'],
+                                                   entry['host_dir'])
+    elif kind == 'k8s':
+        runner = KubernetesCommandRunner(
             namespace=entry['namespace'],
             pod=entry['pod'],
             context=entry.get('context'),
         )
-    return SSHCommandRunner(
-        ip=entry['ip'],
-        ssh_user=entry['user'],
-        ssh_private_key=entry['key'],
-        port=entry.get('port', 22),
-        ssh_proxy_command=entry.get('proxy_command'),
-    )
+    else:
+        runner = SSHCommandRunner(
+            ip=entry['ip'],
+            ssh_user=entry['user'],
+            ssh_private_key=entry['key'],
+            port=entry.get('port', 22),
+            ssh_proxy_command=entry.get('proxy_command'),
+        )
+    if in_container and entry.get('docker'):
+        return DockerCommandRunner(runner, entry['docker'])
+    return runner
+
+
+class DockerCommandRunner(CommandRunner):
+    """Executes commands inside a task container on a host.
+
+    Wraps any host runner (reference sky/utils/command_runner.py:435
+    runs docker through a modified SSH runner instead; wrapping keeps
+    one docker implementation for SSH, local and future host kinds).
+    ``run`` wraps the script in ``docker exec``; env exports and cwd
+    are folded INTO the wrapped script so they take effect inside the
+    container, not in the docker client's environment. ``rsync``
+    delegates to the host runner unchanged — the container bind-mounts
+    the host home (docker_utils.bootstrap_command), so host-side syncs
+    are already visible inside.
+    """
+
+    def __init__(self, inner: CommandRunner,
+                 docker_config: Dict) -> None:
+        super().__init__(inner.host_id, inner.ip)
+        self.inner = inner
+        self.docker_config = docker_config
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env: Optional[Dict[str, str]] = None,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            cwd: Optional[str] = None,
+            check: bool = False,
+            line_processor=None) -> Union[int, Tuple[int, str, str]]:
+        from skypilot_tpu.utils import docker_utils
+        script = _as_script(cmd)
+        if env:
+            exports = '; '.join(
+                f'export {k}={shlex.quote(v)}' for k, v in env.items())
+            script = f'{exports}; {script}'
+        if cwd:
+            script = f'cd {shell_path(cwd)} && {script}'
+        wrapped = docker_utils.exec_command(self.docker_config, script)
+        return self.inner.run(wrapped,
+                              log_path=log_path,
+                              stream_logs=stream_logs,
+                              require_outputs=require_outputs,
+                              check=check,
+                              line_processor=line_processor)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        self.inner.rsync(source, target, up=up, log_path=log_path)
+
+    def check_connection(self) -> bool:
+        # Probes the container, not just the host: a crashed container
+        # reads as a dead worker, which the driver converts into a job
+        # failure the jobs controller can recover from.
+        try:
+            return self.run('true') == 0
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    def bootstrap(self, log_path: str = '/dev/null') -> None:
+        """Bring up the task container on this host (idempotent)."""
+        from skypilot_tpu.utils import docker_utils
+        self.inner.run(docker_utils.bootstrap_command(self.docker_config),
+                       log_path=log_path, check=True)
 
 
 class SSHCommandRunner(CommandRunner):

@@ -1,0 +1,304 @@
+"""Container execution path (image_id: docker:<image>).
+
+Hermetic: a stub `docker` CLI on PATH simulates the daemon (state
+files for containers, pass-through bash for `exec`), so the whole
+chain — Resources parsing, provision-time container bootstrap,
+hosts.json docker entries, driver-side docker-exec wrapping — runs
+with real processes and no docker daemon. Mirrors the reference's
+container capability (sky/utils/command_runner.py:435 docker exec
+mode, sky/backends/local_docker_backend.py:33) on the local provider.
+"""
+import json
+import os
+import stat
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import docker_utils
+from skypilot_tpu.utils import status_lib
+
+JobStatus = status_lib.JobStatus
+
+_STUB = r'''#!/usr/bin/env python3
+"""Stub docker CLI: records every invocation; simulates containers as
+state files; `exec` runs the command through local bash (the bind-mount
+design means host and container share $HOME anyway)."""
+import json, os, subprocess, sys
+
+state_dir = os.environ['DOCKER_STUB_STATE']
+os.makedirs(state_dir, exist_ok=True)
+argv = sys.argv[1:]
+with open(os.path.join(state_dir, 'calls.jsonl'), 'a') as f:
+    f.write(json.dumps(argv) + '\n')
+
+def cpath(name):
+    return os.path.join(state_dir, 'container-' + name)
+
+cmd = argv[0] if argv else ''
+if cmd == 'info':
+    sys.exit(0)
+if cmd == 'inspect':
+    name = argv[-1]
+    if os.path.exists(cpath(name)):
+        print('true')
+        sys.exit(0)
+    sys.exit(1)
+if cmd == 'pull':
+    sys.exit(0)
+if cmd == 'login':
+    sys.stdin.read()
+    sys.exit(0)
+if cmd == 'rm':
+    name = argv[-1]
+    try:
+        os.remove(cpath(name))
+    except OSError:
+        pass
+    sys.exit(0)
+if cmd == 'run':
+    name = argv[argv.index('--name') + 1]
+    with open(cpath(name), 'w') as f:
+        f.write(argv[-3])  # image (argv: ... <image> tail -f /dev/null)
+    sys.exit(0)
+if cmd == 'exec':
+    name = argv[1]
+    if not os.path.exists(cpath(name)):
+        sys.stderr.write('No such container: %s\n' % name)
+        sys.exit(125)
+    script = argv[-1]  # exec <name> bash -c <script>
+    proc = subprocess.run(['bash', '-c', script])
+    sys.exit(proc.returncode)
+sys.stderr.write('stub docker: unknown command %r\n' % (argv,))
+sys.exit(64)
+'''
+
+
+@pytest.fixture
+def stub_docker(tmp_path, monkeypatch):
+    """Install a fake `docker` binary on PATH; returns its state dir."""
+    bin_dir = tmp_path / 'stub_bin'
+    bin_dir.mkdir()
+    stub = bin_dir / 'docker'
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    state = tmp_path / 'docker_state'
+    monkeypatch.setenv('PATH', f'{bin_dir}:{os.environ["PATH"]}')
+    monkeypatch.setenv('DOCKER_STUB_STATE', str(state))
+    yield state
+
+
+def _calls(state_dir):
+    path = state_dir / 'calls.jsonl'
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+@pytest.fixture
+def cluster_name():
+    name = 'dockc'
+    yield name
+    try:
+        core.down(name)
+    except exceptions.ClusterDoesNotExist:
+        pass
+
+
+def _wait_job(cluster, job_id, timeout=30.0):
+    deadline = time.time() + timeout
+    st = None
+    while time.time() < deadline:
+        st = core.job_status(cluster, [job_id])[job_id]
+        if st is not None and st.is_terminal():
+            return st
+        time.sleep(0.3)
+    raise TimeoutError(f'job {job_id} still not terminal; last={st}')
+
+
+# ---------------------------------------------------------------- unit
+def test_extract_docker_image():
+    assert docker_utils.extract_image('docker:ubuntu:22.04') == (
+        'ubuntu:22.04')
+    assert docker_utils.extract_image('projects/x/images/y') is None
+    assert docker_utils.extract_image(None) is None
+    r = sky.Resources(cloud='local', image_id='docker:python:3.11')
+    assert r.extract_docker_image() == 'python:3.11'
+
+
+def test_bootstrap_command_shape():
+    cfg = docker_utils.make_docker_config(
+        'img:v1', {
+            'SKYTPU_DOCKER_USERNAME': 'u',
+            'SKYTPU_DOCKER_PASSWORD': 'p',
+            'SKYTPU_DOCKER_SERVER': 'reg.example.com',
+        }, 'my-cluster')
+    cmd = docker_utils.bootstrap_command(cfg)
+    assert 'docker login' in cmd and 'docker pull' in cmd
+    assert '--net=host --privileged' in cmd
+    assert 'skytpu-my-cluster' in cmd
+    # run is chained on pull success: a failed pull must not silently
+    # fall back to a stale cached image.
+    assert 'docker pull img:v1 &&' in cmd
+    # No credentials -> no login step.
+    cmd2 = docker_utils.bootstrap_command(
+        docker_utils.make_docker_config('img:v1', {}, 'c'))
+    assert 'docker login' not in cmd2
+    # Docker Hub (no server env): the server argument is omitted, not
+    # passed as ''.
+    cmd3 = docker_utils.bootstrap_command(
+        docker_utils.make_docker_config(
+            'img:v1', {'SKYTPU_DOCKER_USERNAME': 'u',
+                       'SKYTPU_DOCKER_PASSWORD': 'p'}, 'c'))
+    assert '--password-stdin &&' in cmd3 and "''" not in cmd3
+
+
+def test_docker_runner_wraps_and_shares_home(tmp_path, stub_docker):
+    host_dir = tmp_path / 'host0'
+    inner = runner_lib.LocalProcessRunner('h0', str(host_dir))
+    cfg = docker_utils.make_docker_config('python:3.11', {}, 'c1')
+    runner = runner_lib.DockerCommandRunner(inner, cfg)
+    runner.bootstrap()
+    # Container state exists; bootstrap is idempotent (2nd call: no pull).
+    runner.bootstrap()
+    pulls = [c for c in _calls(stub_docker) if c[0] == 'pull']
+    assert len(pulls) == 1 and pulls[0][1] == 'python:3.11'
+
+    # run() executes through docker exec with env + cwd folded in.
+    (host_dir / 'wd').mkdir(parents=True)
+    log = tmp_path / 'out.log'
+    rc = runner.run('echo VAL=$MYVAR in $(pwd)',
+                    env={'MYVAR': 'xyz'},
+                    cwd='~/wd',
+                    log_path=str(log))
+    assert rc == 0
+    text = log.read_text()
+    assert 'VAL=xyz' in text and text.strip().endswith('/wd')
+    execs = [c for c in _calls(stub_docker) if c[0] == 'exec']
+    assert execs and execs[-1][1] == 'skytpu-c1'
+
+    # rsync bypasses docker (bind-mounted home).
+    src = tmp_path / 'f.txt'
+    src.write_text('data')
+    runner.rsync(str(src), '~/f.txt', up=True)
+    assert (host_dir / 'f.txt').read_text() == 'data'
+
+    # A dead container reads as a dead worker.
+    assert runner.check_connection()
+    inner.run('docker rm -f skytpu-c1')
+    assert not runner.check_connection()
+
+
+def test_entry_roundtrip_wraps_docker():
+    entry = {
+        'kind': 'local', 'host_id': 'h', 'ip': '127.0.0.1',
+        'host_dir': '/tmp/x',
+        'docker': {'image': 'i', 'container': 'skytpu-c'},
+    }
+    r = runner_lib.runner_from_host_entry(entry)
+    assert isinstance(r, runner_lib.DockerCommandRunner)
+    host = runner_lib.runner_from_host_entry(entry, in_container=False)
+    assert isinstance(host, runner_lib.LocalProcessRunner)
+
+
+# ---------------------------------------------------- end-to-end local
+def test_launch_in_container(cluster_name, stub_docker):
+    task = sky.Task(
+        'containered',
+        setup='echo setup-in-container',
+        run='echo run-in-container marker=$SKYTPU_NODE_RANK')
+    task.set_resources(
+        sky.Resources(cloud='local', image_id='docker:python:3.11-slim'))
+    job_id, handle = sky.launch(task, cluster_name=cluster_name,
+                                stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.SUCCEEDED
+    log_path = os.path.expanduser(
+        log_lib.run_log_path(handle.state_dir, job_id))
+    with open(log_path, encoding='utf-8') as f:
+        assert 'run-in-container marker=0' in f.read()
+
+    calls = _calls(stub_docker)
+    # Provision bootstrapped the container with the right image...
+    assert ['pull', 'python:3.11-slim'] in calls
+    runs = [c for c in calls if c[0] == 'run']
+    assert runs and any(
+        name.startswith(f'skytpu-{cluster_name}') for name in runs[0])
+    # ...and setup + run both went through docker exec.
+    execs = [c for c in calls if c[0] == 'exec']
+    assert any('setup-in-container' in c[-1] for c in execs)
+    assert any('run-in-container' in c[-1] for c in execs)
+
+    # hosts.json carries the docker entry (what the driver consumed).
+    hosts_path = os.path.join(os.path.expanduser(handle.state_dir),
+                              'hosts.json')
+    with open(hosts_path, encoding='utf-8') as f:
+        entries = json.load(f)
+    assert entries[0]['docker']['image'] == 'python:3.11-slim'
+
+
+def test_multihost_slice_gets_per_host_containers(cluster_name,
+                                                  stub_docker):
+    """4 simulated hosts share one daemon: each must get its own
+    container, and every rank's command must exec into its own."""
+    task = sky.Task(
+        'gangdock',
+        run='echo docked rank=$SKYTPU_NODE_RANK')
+    task.set_resources(
+        sky.Resources(cloud='local', accelerators='tpu-v5e-16',
+                      image_id='docker:python:3.11-slim'))
+    job_id, handle = sky.launch(task, cluster_name=cluster_name,
+                                stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.SUCCEEDED
+    log_path = os.path.expanduser(
+        log_lib.run_log_path(handle.state_dir, job_id))
+    with open(log_path, encoding='utf-8') as f:
+        log = f.read()
+    for rank in range(4):
+        assert f'docked rank={rank}' in log
+    calls = _calls(stub_docker)
+    started = {c[c.index('--name') + 1] for c in calls if c[0] == 'run'}
+    assert len(started) == 4, started
+    execed = {c[1] for c in calls if c[0] == 'exec'}
+    assert execed == started
+
+
+def test_exec_reuses_container(cluster_name, stub_docker):
+    task = sky.Task('one', run='echo first')
+    task.set_resources(
+        sky.Resources(cloud='local', image_id='docker:busybox'))
+    job1, _ = sky.launch(task, cluster_name=cluster_name,
+                         stream_logs=False)
+    assert _wait_job(cluster_name, job1) == JobStatus.SUCCEEDED
+    pulls_before = len([c for c in _calls(stub_docker) if c[0] == 'pull'])
+
+    job2, _ = sky.exec(sky.Task('two', run='echo second'), cluster_name)
+    assert _wait_job(cluster_name, job2) == JobStatus.SUCCEEDED
+    # exec fast path: no re-provision, no second pull.
+    pulls_after = len([c for c in _calls(stub_docker) if c[0] == 'pull'])
+    assert pulls_after == pulls_before
+
+
+def test_plain_task_untouched_by_docker(cluster_name, stub_docker):
+    """No image_id -> no docker calls at all."""
+    task = sky.Task('plain', run='echo no-container')
+    task.set_resources(sky.Resources(cloud='local'))
+    job_id, _ = sky.launch(task, cluster_name=cluster_name,
+                           stream_logs=False)
+    assert _wait_job(cluster_name, job_id) == JobStatus.SUCCEEDED
+    assert _calls(stub_docker) == []
+
+
+# ------------------------------------------------------------- k8s
+def test_k8s_pod_image_override():
+    """On kubernetes, docker:<img> overrides the pod image directly."""
+    from skypilot_tpu.clouds import Kubernetes
+    r = sky.Resources(cloud='kubernetes',
+                      image_id='docker:my/train:v2')
+    vars_ = Kubernetes().make_deploy_resources_variables(
+        r, 'c-on-cloud', 'ctx', None)
+    assert vars_['image_id'] == 'my/train:v2'
