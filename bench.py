@@ -133,14 +133,31 @@ def decode_bench():
     peak = _PEAK_TFLOPS[gen] * 1e12
     on_tpu = jax.default_backend() not in ('cpu',)
 
-    batch = int(os.environ.get('BENCH_DECODE_BATCH', '32'))
+    # int8 KV cache (default on): half the bytes/step lets the batch
+    # double at the same cache HBM budget as the round-2 bf16 config
+    # (batch 32), which on a bandwidth-bound step ~doubles tokens/s.
+    kv_quant = os.environ.get('BENCH_DECODE_QUANT', '1') == '1'
+    batch = int(os.environ.get('BENCH_DECODE_BATCH',
+                               '128' if kv_quant else '32'))
     context = int(os.environ.get('BENCH_DECODE_CONTEXT', '1024'))
     steps = int(os.environ.get('BENCH_DECODE_STEPS', '64'))
+    # Cache sized the way a serving engine sizes it: prompt context
+    # plus a generation-headroom region (256 >= any real max_new here).
+    # Every decode step reads the whole [B, max_seq] page, so unused
+    # tail slots are pure bandwidth waste.
+    headroom = int(os.environ.get('BENCH_DECODE_HEADROOM', '256'))
+    max_seq = context + headroom
+    if steps > headroom:
+        raise SystemExit(
+            f'BENCH_DECODE_STEPS ({steps}) exceeds the cache headroom '
+            f'({headroom}): writes past the cache end would clamp to '
+            'the last slot and corrupt the measurement. Raise '
+            'BENCH_DECODE_HEADROOM.')
     if not on_tpu:
         batch, context, steps = 4, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
     else:
-        cfg = models.LlamaConfig.tpu_1b(max_seq=2048,
+        cfg = models.LlamaConfig.tpu_1b(max_seq=max_seq,
                                         param_dtype=jnp.bfloat16)
     from skypilot_tpu.models.llama import num_params
     n_params = num_params(cfg)
@@ -150,7 +167,8 @@ def decode_bench():
     lengths = jnp.full((batch,), context, jnp.int32)
     params = models.init_params(cfg, jax.random.PRNGKey(1))
     _, cache = jax.jit(
-        lambda p, t, n: inference.prefill(p, t, n, cfg),
+        lambda p, t, n: inference.prefill(p, t, n, cfg,
+                                          kv_quant=kv_quant),
     )(params, prompt, lengths)
 
     # The whole decode loop lives inside one jit (lax.scan), exactly
@@ -193,6 +211,7 @@ def decode_bench():
         'detail': {
             'step_time_ms': round(dt * 1000, 3),
             'batch': batch, 'context': context,
+            'kv_quant': kv_quant,
             'n_params': n_params, 'chip': gen,
             'backend': jax.default_backend(),
             'decode_mfu_pct': round(decode_mfu * 100, 2),
@@ -202,7 +221,86 @@ def decode_bench():
     print(json.dumps(result))
 
 
+def serve_bench():
+    """Continuous-batching served throughput (ServingEngine): R
+    requests with mixed prompt/output lengths through a fixed slot
+    batch — the number to set against JetStream's 11.42 req/s on the
+    reference's v6e serving demo (examples/tpu/v6e/README.md:95-120).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import Request, ServingEngine
+
+    dev = jax.devices()[0]
+    gen = _detect_generation(dev)
+    on_tpu = jax.default_backend() not in ('cpu',)
+
+    n_requests = int(os.environ.get('BENCH_SERVE_REQUESTS', '64'))
+    batch = int(os.environ.get('BENCH_SERVE_BATCH', '64'))
+    max_prompt = int(os.environ.get('BENCH_SERVE_PROMPT', '1024'))
+    max_new = int(os.environ.get('BENCH_SERVE_MAX_NEW', '128'))
+    kv_quant = os.environ.get('BENCH_SERVE_QUANT', '1') == '1'
+    chunk = int(os.environ.get('BENCH_SERVE_CHUNK', '32'))
+    if not on_tpu:
+        n_requests, batch, max_prompt, max_new = 6, 2, 64, 8
+        cfg = models.LlamaConfig.tiny(max_seq=256)
+        max_seq = 128
+    else:
+        # Decode region = 4x max_new: slots recycle ~4 requests per
+        # cache round before a reset.
+        max_seq = max_prompt + 4 * max_new
+        cfg = models.LlamaConfig.tpu_1b(max_seq=max_seq,
+                                        param_dtype=jnp.bfloat16)
+    from skypilot_tpu.models.llama import num_params
+    n_params = num_params(cfg)
+
+    params = models.init_params(cfg, jax.random.PRNGKey(1))
+    engine = ServingEngine(params, cfg, batch_size=batch,
+                           max_prompt=max_prompt, max_seq=max_seq,
+                           kv_quant=kv_quant, decode_chunk=chunk)
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(n_requests):
+        plen = int(rng.integers(max_prompt // 4, max_prompt))
+        toks = list(rng.integers(0, cfg.vocab_size, plen))
+        reqs.append(Request(i, toks, max_new=max_new))
+
+    # Compile all programs outside the timed window (a second engine
+    # would double HBM, so warm the same one).
+    engine.warmup()
+
+    t0 = time.perf_counter()
+    results = engine.run(reqs)
+    dt = time.perf_counter() - t0
+    out_tokens = sum(len(r.tokens) for r in results.values())
+    result = {
+        'metric': 'llama_serve_req_s',
+        'value': round(n_requests / dt, 2),
+        'unit': 'req/s/chip',
+        # JetStream demo: 11.42 req/s (Llama-2-7B on v6e); scale by
+        # model size ratio so the comparison is flops-normalized.
+        'vs_baseline': round(
+            (n_requests / dt) / (11.42 * 6.74e9 / n_params), 2),
+        'detail': {
+            'wall_s': round(dt, 2),
+            'output_tok_s': round(out_tokens / dt, 1),
+            'n_requests': n_requests, 'batch_slots': batch,
+            'max_new': max_new, 'kv_quant': kv_quant,
+            'n_params': n_params, 'chip': gen,
+            'backend': jax.default_backend(),
+        },
+    }
+    print(json.dumps(result))
+
+
 if __name__ == '__main__':
     mode = (sys.argv[1] if len(sys.argv) > 1 else
             os.environ.get('BENCH_MODE', 'train'))
-    sys.exit(decode_bench() if mode == 'decode' else main())
+    if mode == 'decode':
+        sys.exit(decode_bench())
+    if mode == 'serve':
+        sys.exit(serve_bench())
+    sys.exit(main())

@@ -219,13 +219,34 @@ def main() -> None:
                   f'{_PROBE_THRESHOLD} consecutive probes; failing '
                   f'job {job_id}.')
             job_lib.set_status(state_dir, job_id, JobStatus.FAILED)
-            # Kill our whole subprocess tree first: the SSH clients
-            # driving ranks on still-HEALTHY hosts would otherwise be
-            # orphaned and keep their remote processes holding TPU
-            # devices into the next scheduled job. Then exit hard —
-            # rank threads may be wedged inside SSH to the dead host;
-            # the status is already terminal, and agentd's next tick
-            # resumes scheduling.
+            # Containered jobs first: docker-exec'd processes are not
+            # children of the exec client, so killing our subprocess
+            # tree alone would leave them alive inside the container
+            # holding TPU devices. Restart each healthy host's
+            # container (best-effort, bounded — a wedged host's SSH
+            # must not block the teardown).
+            docker_runners = [
+                (i, r) for i, r in enumerate(runners)
+                if isinstance(r, runner_lib.DockerCommandRunner) and
+                i != rank
+            ]
+            if docker_runners:
+                kill_threads = [
+                    threading.Thread(target=r.kill_workload,
+                                     daemon=True)
+                    for _, r in docker_runners
+                ]
+                for t in kill_threads:
+                    t.start()
+                for t in kill_threads:
+                    t.join(timeout=10)
+            # Kill our whole subprocess tree: the SSH clients driving
+            # ranks on still-HEALTHY hosts would otherwise be orphaned
+            # and keep their remote processes holding TPU devices into
+            # the next scheduled job. Then exit hard — rank threads
+            # may be wedged inside SSH to the dead host; the status is
+            # already terminal, and agentd's next tick resumes
+            # scheduling.
             subprocess_utils.kill_process_tree(os.getpid(),
                                                include_parent=False)
             os._exit(1)

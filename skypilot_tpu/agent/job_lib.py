@@ -230,6 +230,21 @@ def cancel_job(state_dir: str, job_id: int) -> bool:
         return False
     if job['driver_pid']:
         subprocess_utils.kill_process_tree(job['driver_pid'])
+    # Containered jobs: the killed tree holds only docker-exec
+    # clients; the workload survives inside the container. Restart
+    # each host's container so cancel actually frees the TPU.
+    try:
+        hosts_path = os.path.join(os.path.expanduser(state_dir),
+                                  constants.HOSTS_FILE)
+        with open(hosts_path, encoding='utf-8') as f:
+            entries = json.load(f)
+        from skypilot_tpu.utils import command_runner as runner_lib
+        for entry in entries:
+            if entry.get('docker'):
+                runner = runner_lib.runner_from_host_entry(entry)
+                runner.kill_workload()
+    except (OSError, ValueError):
+        pass  # hosts.json gone (teardown race): nothing left to kill
     set_status(state_dir, job_id, JobStatus.CANCELLED)
     schedule_step(state_dir)
     return True

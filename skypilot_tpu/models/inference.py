@@ -46,11 +46,40 @@ from skypilot_tpu.models.llama import (LlamaConfig, _attention,
 
 # Cache layout: [n_layers, B, max_seq, n_kv_heads, head_dim].
 CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
+# Per-vector quantization scales: [n_layers, B, max_seq, n_kv_heads].
+SCALE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp')
 
 
-def cache_specs() -> Dict:
-    return {'k': CACHE_SPEC, 'v': CACHE_SPEC,
-            'length': P(('dp', 'fsdp')), 'base': P(), 'steps': P()}
+def cache_specs(kv_quant: bool = False) -> Dict:
+    specs = {'k': CACHE_SPEC, 'v': CACHE_SPEC,
+             'length': P(('dp', 'fsdp')),
+             'dmask': P(('dp', 'fsdp'), None),
+             'base': P(), 'steps': P()}
+    if kv_quant:
+        specs['k_scale'] = SCALE_SPEC
+        specs['v_scale'] = SCALE_SPEC
+    return specs
+
+
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 per-vector quantization over head_dim.
+
+    Decode is cache-bandwidth-bound (see decode_step): int8 halves the
+    bytes per step vs bf16, which at equal HBM budget doubles the
+    batch — the same lever JetStream pulls with quantize_kvcache.
+    Scale is per (position, kv-head) vector: accurate enough that
+    greedy decode matches bf16 on short horizons (tested), 1/16 the
+    overhead bytes.
+    """
+    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / scale[..., None]).astype(jnp.int8)
+    return q, scale.astype(jnp.bfloat16)
+
+
+def _dequantize_kv(q: jax.Array, scale: jax.Array,
+                   dtype) -> jax.Array:
+    return q.astype(dtype) * scale[..., None].astype(dtype)
 
 
 def _reject_unsupported_family(cfg: LlamaConfig) -> None:
@@ -70,9 +99,15 @@ def _reject_unsupported_family(cfg: LlamaConfig) -> None:
 # step i writes slot base+i for EVERY row. The write index is
 # therefore a traced *scalar*, so the cache update is a
 # dynamic_update_slice XLA performs in place on the loop carry — no
-# scatter, no full-cache rewrite. Per-row raggedness lives entirely
-# in the validity mask and the RoPE positions. ``prefill`` is the
-# only constructor of this pytree.
+# scatter, no full-cache rewrite. Which slots are READABLE per row is
+# an explicit bool mask ``dmask`` [B, S]: prompt slots < length at
+# prefill, and each decode write flips its column on for the rows
+# that were active that step. The mask (B*S bits — negligible HBM)
+# is what makes *continuous batching* exact: when ServingEngine
+# recycles a batch slot for a new request (insert_prefill), clearing
+# the row's mask makes every stale decode slot of the previous
+# occupant unreadable, with no cache rewrite. Per-row raggedness
+# lives in the mask and the RoPE positions.
 
 
 def _constrain(x, spec, mesh):
@@ -82,14 +117,24 @@ def _constrain(x, spec, mesh):
         x, jax.sharding.NamedSharding(mesh, spec))
 
 
-def _gqa_decode_attention(q, kc, vc, valid, k_self=None, v_self=None):
+def _gqa_decode_attention(q, kc, vc, valid, k_self=None, v_self=None,
+                          k_scale=None, v_scale=None):
     """One-position GQA attention against the cache (+ self).
 
-    q: [B, n_heads, hd]; kc/vc: [B, S, n_kv, hd]; valid: [B, S] bool;
-    k_self/v_self: [B, n_kv, hd] — the incoming token's own K/V,
-    attended without being read back from the cache. Returns
-    [B, n_heads * hd]. K/V stay at n_kv_heads — query heads fold into
-    [B, n_kv, rep, hd] instead (GQA-native, no repeat).
+    q: [B, n_heads, hd]; kc/vc: [B, S, n_kv, hd] (bf16, or int8 with
+    k_scale/v_scale [B, S, n_kv]); valid: [B, S] bool; k_self/v_self:
+    [B, n_kv, hd] — the incoming token's own K/V, attended without
+    being read back from the cache. Returns [B, n_heads * hd]. K/V
+    stay at n_kv_heads — query heads fold into [B, n_kv, rep, hd]
+    instead (GQA-native, no repeat).
+
+    int8 handling: the convert-to-bf16 happens *inside* the einsum
+    operand (a fusible unary op — the dot reads int8 from HBM) and the
+    per-vector scales are applied OUTSIDE the contraction: on the
+    [.., s]-indexed scores for K, and folded into probs for V (the
+    contraction is over s, so a per-s scale factors through linearly).
+    Pre-multiplying the page (dequantize-then-attend) materializes a
+    full bf16 copy and measured *slower* than bf16 caches on v5e.
     """
     b, s, n_kv, hd = kc.shape
     rep = q.shape[1] // n_kv
@@ -98,8 +143,12 @@ def _gqa_decode_attention(q, kc, vc, valid, k_self=None, v_self=None):
     # would double the traffic).
     qf = q.reshape(b, n_kv, rep, hd)
     scores = jnp.einsum(
-        'bkrh,bskh->bkrs', qf, kc,
+        'bkrh,bskh->bkrs', qf, kc.astype(qf.dtype),
         preferred_element_type=jnp.float32) * hd**-0.5
+    if k_scale is not None:
+        # [B, S, n_kv] -> [B, n_kv, 1, S]
+        scores = scores * jnp.transpose(
+            k_scale, (0, 2, 1))[:, :, None, :].astype(jnp.float32)
     scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
     if k_self is not None:
         s_self = jnp.einsum('bkrh,bkh->bkr', qf, k_self,
@@ -113,7 +162,12 @@ def _gqa_decode_attention(q, kc, vc, valid, k_self=None, v_self=None):
     probs = e / denom
     if k_self is not None:
         probs, p_self = probs[..., :-1], probs[..., -1]
-    out = jnp.einsum('bkrs,bskh->bkrh', probs.astype(kc.dtype), vc,
+    pv = probs
+    if v_scale is not None:
+        pv = probs * jnp.transpose(
+            v_scale, (0, 2, 1))[:, :, None, :].astype(probs.dtype)
+    out = jnp.einsum('bkrs,bskh->bkrh', pv.astype(q.dtype),
+                     vc.astype(q.dtype),
                      preferred_element_type=jnp.float32)
     if v_self is not None:
         out = out + (p_self[..., None] *
@@ -126,13 +180,15 @@ def prefill(params: Dict,
             lengths: jax.Array,
             cfg: LlamaConfig,
             mesh=None,
-            max_seq: Optional[int] = None) -> Tuple[jax.Array, Dict]:
+            max_seq: Optional[int] = None,
+            kv_quant: bool = False) -> Tuple[jax.Array, Dict]:
     """Process prompts and build the cache.
 
     tokens: [B, S] right-padded prompts; lengths: [B] true lengths.
     Returns (next-token logits [B, vocab] f32 at each prompt's last
-    position, cache). Padded positions write garbage K/V but decode
-    masks everything >= length, so they are never read.
+    position, cache). Padded positions write garbage K/V but the
+    dmask marks everything >= length unreadable. ``kv_quant`` stores
+    K/V as int8 with per-vector scales (half the decode bandwidth).
     """
     _reject_unsupported_family(cfg)
     cdt = cfg.compute_dtype
@@ -166,9 +222,14 @@ def prefill(params: Dict,
         x = x + (gate * up) @ lp['w_down'].astype(cdt)
         # Pad this layer's K/V out to the cache length.
         pad = [(0, 0), (0, s_max - s), (0, 0), (0, 0)]
+        if kv_quant:
+            qk, sk = _quantize_kv(k)
+            qv, sv = _quantize_kv(v)
+            return x, (jnp.pad(qk, pad), jnp.pad(qv, pad),
+                       jnp.pad(sk, pad[:3]), jnp.pad(sv, pad[:3]))
         return x, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-    x, (ks, vs) = lax.scan(layer, x, params['layers'])
+    x, ys = lax.scan(layer, x, params['layers'])
     x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
 
     # Hidden state at each prompt's final position -> logits.
@@ -178,11 +239,20 @@ def prefill(params: Dict,
                         params['lm_head'].astype(cdt),
                         preferred_element_type=jnp.float32)
 
-    cache = {'k': _constrain(ks, CACHE_SPEC, mesh),
-             'v': _constrain(vs, CACHE_SPEC, mesh),
-             'length': lengths.astype(jnp.int32),
+    lengths = lengths.astype(jnp.int32)
+    dmask = jnp.arange(s_max)[None, :] < lengths[:, None]
+    cache = {'length': lengths,
+             'dmask': _constrain(dmask, P(('dp', 'fsdp'), None), mesh),
              'base': jnp.asarray(s, jnp.int32),
              'steps': jnp.zeros((), jnp.int32)}
+    if kv_quant:
+        ks, vs, sks, svs = ys
+        cache['k_scale'] = _constrain(sks, SCALE_SPEC, mesh)
+        cache['v_scale'] = _constrain(svs, SCALE_SPEC, mesh)
+    else:
+        ks, vs = ys
+    cache['k'] = _constrain(ks, CACHE_SPEC, mesh)
+    cache['v'] = _constrain(vs, CACHE_SPEC, mesh)
     return logits, cache
 
 
@@ -190,12 +260,17 @@ def decode_step(params: Dict,
                 cache: Dict,
                 tokens: jax.Array,
                 cfg: LlamaConfig,
-                mesh=None) -> Tuple[jax.Array, Dict]:
+                mesh=None,
+                active: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, Dict]:
     """Advance every sequence by one token.
 
     tokens: [B] int32 (the tokens being fed in, whose K/V are appended
-    at slot ``base + steps``). Returns (logits [B, vocab] f32 for the
-    *next* token, updated cache).
+    at slot ``base + steps``). ``active``: optional [B] bool — rows
+    marked inactive still compute (the batch is one traced program)
+    but their write column stays masked, so an empty ServingEngine
+    slot never contaminates a later occupant. Returns (logits
+    [B, vocab] f32 for the *next* token, updated cache).
 
     Structure (why this is fast on TPU): the layer loop is a
     ``lax.scan`` whose *carry* holds the full stacked cache; each
@@ -207,29 +282,31 @@ def decode_step(params: Dict,
     Per-step HBM traffic = params + one cache read + O(B*kv*hd)
     writes. Alternatives measured on v5e (1B model, batch 32, ctx
     1024): per-row scatter ~52 ms/step, select-rewrite ~37 ms/step,
-    this layout is bandwidth-bound.
+    this layout is bandwidth-bound. int8 caches (see _quantize_kv)
+    halve the read traffic; dequantization happens in-register after
+    the sliced page is loaded.
     """
     cdt = cfg.compute_dtype
     b = tokens.shape[0]
-    s_max = cache['k'].shape[2]
+    quant = 'k_scale' in cache
     pos = cache['length']                       # [B] logical position
     base, steps = cache['base'], cache['steps']
     slot = base + steps                         # scalar write slot
-    slots = jnp.arange(s_max)
-    # Readable slots: each row's own prompt (its true prompt length
-    # is pos - steps; slots beyond it up to base are padding garbage)
-    # plus every already-written decode slot (base..slot-1, uniform
-    # across rows). The incoming token is handled by the explicit
-    # self term, so ``slot`` itself is not read from the cache.
-    prompt_len = pos - steps
-    valid = ((slots[None, :] < prompt_len[:, None]) |
-             ((slots >= base) & (slots < slot))[None, :])
+    # Readable slots: exactly the dmask. The incoming token is handled
+    # by the explicit self term, so ``slot`` itself is not read back.
+    valid = cache['dmask']
+    if active is None:
+        active = jnp.ones((b,), bool)
 
     x = params['tok_emb'].astype(cdt)[tokens]   # [B, D]
     x = _constrain(x, P(('dp', 'fsdp'), None), mesh)
 
     def layer(carry, inp):
-        x, kc, vc = carry                   # kc/vc [L, B, S, kv, hd]
+        if quant:                           # kc/vc [L, B, S, kv, hd]
+            x, kc, vc, ksc, vsc = carry
+        else:
+            x, kc, vc = carry
+            ksc = vsc = None
         lp, li = inp
         h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
         q = (h @ lp['wq'].astype(cdt)).reshape(b, cfg.n_heads,
@@ -242,8 +319,15 @@ def decode_step(params: Dict,
         k = _rope(k[:, None], pos[:, None], cfg.rope_theta)[:, 0]
         page_k = lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
         page_v = lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+        page_ks = page_vs = None
+        if quant:
+            page_ks = lax.dynamic_index_in_dim(ksc, li, 0,
+                                               keepdims=False)
+            page_vs = lax.dynamic_index_in_dim(vsc, li, 0,
+                                               keepdims=False)
         o = _gqa_decode_attention(q, page_k, page_v, valid,
-                                  k_self=k, v_self=v)
+                                  k_self=k, v_self=v,
+                                  k_scale=page_ks, v_scale=page_vs)
         x = x + o @ lp['wo'].astype(cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
@@ -252,40 +336,95 @@ def decode_step(params: Dict,
         x = x + (gate * up) @ lp['w_down'].astype(cdt)
 
         # In-place sliver write at scalar (layer, slot).
+        if quant:
+            k, sk = _quantize_kv(k)
+            v, sv = _quantize_kv(v)
+            ksc = lax.dynamic_update_slice(
+                ksc, sk[None, :, None], (li, 0, slot, 0))
+            vsc = lax.dynamic_update_slice(
+                vsc, sv[None, :, None], (li, 0, slot, 0))
         kc = lax.dynamic_update_slice(
             kc, k[None, :, None].astype(kc.dtype), (li, 0, slot, 0, 0))
         vc = lax.dynamic_update_slice(
             vc, v[None, :, None].astype(vc.dtype), (li, 0, slot, 0, 0))
+        if quant:
+            return (x, kc, vc, ksc, vsc), None
         return (x, kc, vc), None
 
-    (x, ks, vs), _ = lax.scan(
-        layer, (x, cache['k'], cache['v']),
-        (params['layers'], jnp.arange(cfg.n_layers)))
+    if quant:
+        carry0 = (x, cache['k'], cache['v'], cache['k_scale'],
+                  cache['v_scale'])
+    else:
+        carry0 = (x, cache['k'], cache['v'])
+    out_carry, _ = lax.scan(
+        layer, carry0, (params['layers'], jnp.arange(cfg.n_layers)))
+    if quant:
+        x, ks, vs, sks, svs = out_carry
+    else:
+        (x, ks, vs), sks, svs = out_carry, None, None
     x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
     logits = jnp.einsum('bd,dv->bv', x, params['lm_head'].astype(cdt),
                         preferred_element_type=jnp.float32)
+    dmask = lax.dynamic_update_slice(cache['dmask'], active[:, None],
+                                     (0, slot))
     new_cache = {'k': _constrain(ks, CACHE_SPEC, mesh),
                  'v': _constrain(vs, CACHE_SPEC, mesh),
-                 'length': pos + 1, 'base': base, 'steps': steps + 1}
+                 'length': jnp.where(active, pos + 1, pos),
+                 'dmask': dmask,
+                 'base': base, 'steps': steps + 1}
+    if quant:
+        new_cache['k_scale'] = _constrain(sks, SCALE_SPEC, mesh)
+        new_cache['v_scale'] = _constrain(svs, SCALE_SPEC, mesh)
     return logits, new_cache
 
 
+def insert_prefill(cache: Dict, one: Dict, slot: jax.Array) -> Dict:
+    """Insert a single-request prefill cache into batch slot ``slot``.
+
+    The continuous-batching primitive (JetStream's insert): ``one`` is
+    a batch-1 cache from ``prefill`` whose max_seq (the padded prompt
+    bucket) must be <= the batch cache's prompt region ``base``. All
+    writes are dynamic_update_slice at a scalar batch index — in place
+    under donation. Clearing the row's dmask beyond the prompt makes
+    every decode slot of the slot's previous occupant unreadable.
+    """
+    p1 = one['k'].shape[2]
+    s_max = cache['k'].shape[2]
+    new = dict(cache)
+    for f in ('k', 'v', 'k_scale', 'v_scale'):
+        if f in cache:
+            block = one[f].astype(cache[f].dtype)
+            start = (0, slot, 0) + (0,) * (cache[f].ndim - 3)
+            new[f] = lax.dynamic_update_slice(cache[f], block, start)
+    row_mask = jnp.pad(one['dmask'], ((0, 0), (0, s_max - p1)))
+    new['dmask'] = lax.dynamic_update_slice(cache['dmask'], row_mask,
+                                            (slot, 0))
+    new['length'] = lax.dynamic_update_slice(
+        cache['length'], one['length'].astype(cache['length'].dtype),
+        (slot,))
+    return new
+
+
 def _sample(logits, key, temperature, top_k: int):
-    """temperature is a *traced* value (<= 0 means greedy), so a
+    """temperature is a *traced* value (<= 0 means greedy) — a scalar,
+    or a [B] vector for per-request temperatures in one batch — so a
     server can vary it per request without recompiling; top_k is
     static (it shapes the threshold computation)."""
     if top_k > 0 and top_k < logits.shape[-1]:
         thresh = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    temp = jnp.asarray(temperature, jnp.float32)
+    t = jnp.maximum(temp, 1e-6)
+    if t.ndim == 1:
+        t = t[:, None]
     sampled = jax.random.categorical(
         key, logits / t, axis=-1).astype(jnp.int32)
-    return jnp.where(jnp.asarray(temperature) <= 0.0, greedy, sampled)
+    return jnp.where(temp <= 0.0, greedy, sampled)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    'cfg', 'max_new', 'top_k', 'max_seq'))
+    'cfg', 'max_new', 'top_k', 'max_seq', 'kv_quant'))
 def generate(params: Dict,
              tokens: jax.Array,
              lengths: jax.Array,
@@ -294,7 +433,8 @@ def generate(params: Dict,
              temperature: float = 0.0,
              top_k: int = 0,
              key: Optional[jax.Array] = None,
-             max_seq: Optional[int] = None) -> jax.Array:
+             max_seq: Optional[int] = None,
+             kv_quant: bool = False) -> jax.Array:
     """Prefill + autoregressive decode, one traced program.
 
     tokens: [B, S] right-padded prompts; lengths: [B]. Returns
@@ -312,7 +452,7 @@ def generate(params: Dict,
             f'exceeds the cache ({s_max} slots); raise max_seq or '
             'trim the prompt.')
     logits, cache = prefill(params, tokens, lengths, cfg,
-                            max_seq=max_seq)
+                            max_seq=max_seq, kv_quant=kv_quant)
     first = _sample(logits, key, temperature, top_k)
 
     def step(carry, _):

@@ -1,0 +1,422 @@
+"""Continuous-batching serving engine over the KV-cache decoder.
+
+The role JetStream plays for the reference
+(examples/tpu/v6e/README.md:95-120: an orchestrator that keeps a
+fixed-size decode batch full by inserting freshly-prefilled requests
+into slots as running ones finish). The static-batch ``generate`` in
+``models.inference`` drains a whole batch before admitting new work —
+a finished sequence's slot idles, capping served throughput well below
+what the decode step sustains. This engine recycles slots:
+
+- a fixed decode batch of ``batch_size`` slots, one traced
+  ``decode_step`` program regardless of which slots are live
+  (``active`` mask — no recompiles as load varies);
+- per-request prefill at bucketed prompt lengths (powers of two up to
+  ``max_prompt``), inserted into a free slot with
+  ``inference.insert_prefill`` — dynamic_update_slice at the batch
+  index, in place under donation;
+- slot validity via the cache's dmask, so a recycled slot never reads
+  its previous occupant's K/V;
+- optional int8 KV cache (``kv_quant=True``): half the decode
+  bandwidth, which at fixed HBM doubles ``batch_size``.
+
+Decode capacity: every engine decode step consumes one shared cache
+slot (the scalar-write-slot design that keeps the step
+bandwidth-bound — see inference.decode_step). A request admitted when
+``remaining_slots() >= max_new`` is guaranteed to finish; when the
+region is exhausted and all slots are idle the engine resets the
+cache (steps=0) and keeps admitting. Size ``max_seq`` several times
+the typical ``max_new`` so resets are rare.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import inference
+from skypilot_tpu.models.llama import LlamaConfig
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: Any
+    tokens: Sequence[int]          # prompt token ids
+    max_new: int
+    # None -> the engine's default temperature. Per-request values are
+    # traced (a [B] vector), so mixing them never recompiles.
+    temperature: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request_id: Any
+    max_new: int
+    generated: List[int]
+    pending_first: Optional[int]   # token sampled from prefill logits
+    prompt_len: int = 0
+
+
+@dataclasses.dataclass
+class Result:
+    request_id: Any
+    tokens: List[int]
+    prompt_len: int
+    submitted_at: float
+    finished_at: float
+
+
+def _buckets(max_prompt: int) -> List[int]:
+    out, b = [], 32
+    while b < max_prompt:
+        out.append(b)
+        b *= 2
+    out.append(max_prompt)
+    return out
+
+
+class ServingEngine:
+    """Host-side slot orchestrator; all device work is jitted."""
+
+    def __init__(self,
+                 params: Dict,
+                 cfg: LlamaConfig,
+                 batch_size: int = 8,
+                 max_prompt: int = 512,
+                 max_seq: Optional[int] = None,
+                 kv_quant: bool = False,
+                 eos_id: Optional[int] = None,
+                 temperature: float = 0.0,
+                 top_k: int = 0,
+                 decode_chunk: int = 8) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.max_prompt = max_prompt
+        self.max_seq = max_seq or cfg.max_seq
+        if self.max_seq <= max_prompt:
+            raise ValueError(
+                f'max_seq ({self.max_seq}) must exceed max_prompt '
+                f'({max_prompt}) to leave decode slots.')
+        self.kv_quant = kv_quant
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.top_k = top_k
+        # Decode steps per host round-trip. Each tick scans `chunk`
+        # steps on device and syncs token values once — slots that
+        # finish mid-chunk idle until the tick ends (≈chunk/2 wasted
+        # steps per request), but host dispatch/transfer amortizes
+        # chunk-fold. 8 balances the two for max_new ~100s.
+        self.decode_chunk = max(1, decode_chunk)
+        self.buckets = _buckets(max_prompt)
+        # Admissions go to the device in fixed-size groups (padded by
+        # repetition) so each prompt bucket compiles exactly one
+        # prefill+insert program.
+        self.admit_group = min(8, batch_size)
+
+        self.queue: collections.deque = collections.deque()
+        self.slots: List[Optional[_SlotState]] = [None] * batch_size
+        self.results: Dict[Any, Result] = {}
+        self._submitted_at: Dict[Any, float] = {}
+        self._key = jax.random.PRNGKey(0)
+        self._steps_done = 0
+
+        cdt = cfg.compute_dtype
+        kv_dtype = jnp.int8 if kv_quant else cdt
+        kv_shape = (cfg.n_layers, batch_size, self.max_seq,
+                    cfg.n_kv_heads, cfg.head_dim)
+        self._empty = {
+            'k': jnp.zeros(kv_shape, kv_dtype),
+            'v': jnp.zeros(kv_shape, kv_dtype),
+            'length': jnp.zeros((batch_size,), jnp.int32),
+            'dmask': jnp.zeros((batch_size, self.max_seq), bool),
+            'base': jnp.asarray(max_prompt, jnp.int32),
+            'steps': jnp.zeros((), jnp.int32),
+        }
+        if kv_quant:
+            self._empty['k_scale'] = jnp.ones(
+                kv_shape[:4], jnp.bfloat16)
+            self._empty['v_scale'] = jnp.ones(
+                kv_shape[:4], jnp.bfloat16)
+        self.cache = jax.tree.map(jnp.copy, self._empty)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _prefill_insert(params, cache, tokens, lengths, slots,
+                            key, temperature):
+            """Prefill a group of same-bucket prompts and insert each
+            into its batch slot — ONE device call per admission group
+            (per-request calls would pay a host round-trip each, which
+            dominates serving latency on high-dispatch-cost links).
+            tokens: [m, bucket]; slots: [m]. Returns first sampled
+            token per request [m].
+            """
+            logits, group = inference.prefill(
+                params, tokens, lengths, self.cfg,
+                max_seq=tokens.shape[1], kv_quant=self.kv_quant)
+            firsts = inference._sample(logits, key, temperature,
+                                       self.top_k)
+            m = tokens.shape[0]
+            for j in range(m):  # static unroll: m <= batch_size
+                # Batch axis is second for k/v/scales ([L, B, S, ...]),
+                # first for length/dmask.
+                one = {
+                    f: (group[f][:, j:j + 1]
+                        if f in ('k', 'v', 'k_scale', 'v_scale')
+                        else group[f][j:j + 1])
+                    for f in group if f not in ('base', 'steps')
+                }
+                one['base'] = group['base']
+                cache = inference.insert_prefill(cache, one, slots[j])
+            return cache, firsts
+
+        self._prefill_insert = _prefill_insert
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=('n',))
+        def _decode(params, cache, tokens, active, key, temperature,
+                    *, n):
+            """Scan ``n`` decode steps on device, feeding each sampled
+            token forward; one host sync per call, not per token."""
+
+            def body(carry, _):
+                cache, tok, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = inference.decode_step(
+                    params, cache, tok, self.cfg, active=active)
+                nxt = inference._sample(logits, sub, temperature,
+                                        self.top_k)
+                return (cache, nxt, key), nxt
+
+            (cache, _, _), toks = jax.lax.scan(
+                body, (cache, tokens, key), None, length=n)
+            return cache, toks          # toks: [n, B]
+
+        self._decode = _decode
+        # Per-slot current token fed into the next decode step, and
+        # per-slot sampling temperature (requests may override the
+        # engine default; temperature is traced, so this never
+        # recompiles).
+        self._tokens = np.zeros((batch_size,), np.int32)
+        self._temps = np.full((batch_size,), temperature, np.float32)
+
+    # ------------------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile every program a serving run can hit (one per prompt
+        bucket, plus the decode chunks), then reset. Without this the
+        first request of each shape pays multi-second XLA compiles
+        inside its serving latency."""
+        import numpy as _np
+        rng = _np.random.default_rng(0)
+        # Every admission call is padded to (admit_group, bucket), so
+        # one request per bucket compiles its whole program.
+        reqs = [
+            Request(('warmup', b),
+                    list(rng.integers(0, self.cfg.vocab_size, b)),
+                    max_new=2) for b in self.buckets
+        ]
+        self.run(reqs)
+        # Also compile the power-of-two tail decode chunks step() can
+        # fold to near capacity exhaustion — otherwise the compile
+        # lands inside a live request's latency.
+        n = self.decode_chunk
+        while n > 1:
+            n //= 2
+            self._key, sub = jax.random.split(self._key)
+            self.cache, _ = self._decode(
+                self.params, self.cache, jnp.asarray(self._tokens),
+                jnp.zeros((self.batch_size,), bool), sub,
+                jnp.asarray(self._temps), n=n)
+        self.reset()
+
+    def reset(self) -> None:
+        """Drop all cache state (keeps compiled programs). Only valid
+        when no requests are in flight."""
+        if self.num_active() or self.queue:
+            raise RuntimeError('reset() with requests in flight')
+        self.cache = jax.tree.map(jnp.copy, self._empty)
+        self._steps_done = 0
+        self.results = {}
+
+    def submit(self, request: Request) -> None:
+        if len(request.tokens) > self.max_prompt:
+            raise ValueError(
+                f'prompt ({len(request.tokens)}) exceeds max_prompt '
+                f'({self.max_prompt}).')
+        if request.max_new > self.decode_capacity():
+            raise ValueError(
+                f'max_new ({request.max_new}) exceeds the decode '
+                f'capacity ({self.decode_capacity()}); raise max_seq.')
+        self._submitted_at[request.request_id] = time.time()
+        self.queue.append(request)
+
+    def decode_capacity(self) -> int:
+        return self.max_seq - self.max_prompt
+
+    def remaining_slots(self) -> int:
+        return self.decode_capacity() - self._steps_done
+
+    def num_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    # ------------------------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise AssertionError(n)
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue, grouped by prompt bucket so
+        each group costs one fused prefill+insert device call."""
+        admits = []
+        for slot_idx, state in enumerate(self.slots):
+            if state is not None or not self.queue:
+                continue
+            if self.queue[0].max_new > self.remaining_slots():
+                if self.num_active() == 0 and not admits:
+                    # Region exhausted, nothing running: fresh cache.
+                    self.cache = jax.tree.map(jnp.copy, self._empty)
+                    self._steps_done = 0
+                else:
+                    break  # wait for running requests to drain
+            admits.append((slot_idx, self.queue.popleft()))
+        if not admits:
+            return
+
+        groups: Dict[int, list] = collections.defaultdict(list)
+        for slot_idx, req in admits:
+            groups[self._bucket_for(len(req.tokens))].append(
+                (slot_idx, req))
+        chunks = []
+        for bucket, items in groups.items():
+            for i in range(0, len(items), self.admit_group):
+                chunks.append((bucket, items[i:i + self.admit_group]))
+        for bucket, items in chunks:
+            m = len(items)
+            # Pad every group to the fixed admit_group size by
+            # repeating the first entry (a duplicate insert rewrites
+            # the same slot with the same content): exactly ONE
+            # compiled program per bucket, all covered by warmup().
+            m_pad = self.admit_group
+            padded = items + [items[0]] * (m_pad - m)
+            tokens = np.zeros((m_pad, bucket), np.int32)
+            lengths = np.zeros((m_pad,), np.int32)
+            slot_arr = np.zeros((m_pad,), np.int32)
+            for j, (slot_idx, req) in enumerate(padded):
+                tokens[j, :len(req.tokens)] = req.tokens
+                lengths[j] = len(req.tokens)
+                slot_arr[j] = slot_idx
+            temps = np.asarray([
+                (req.temperature if req.temperature is not None
+                 else self.temperature) for _, req in padded
+            ], np.float32)
+            self._key, sub = jax.random.split(self._key)
+            self.cache, firsts = self._prefill_insert(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(lengths), jnp.asarray(slot_arr), sub,
+                jnp.asarray(temps))
+            firsts = np.asarray(firsts)
+            for j, (slot_idx, req) in enumerate(items):
+                first = int(firsts[j])
+                self.slots[slot_idx] = _SlotState(
+                    request_id=req.request_id, max_new=req.max_new,
+                    generated=[], pending_first=first,
+                    prompt_len=len(req.tokens))
+                self._tokens[slot_idx] = first
+                self._temps[slot_idx] = temps[j]
+
+    def _finish(self, slot_idx: int) -> None:
+        state = self.slots[slot_idx]
+        self.results[state.request_id] = Result(
+            request_id=state.request_id,
+            tokens=state.generated,
+            prompt_len=state.prompt_len,
+            submitted_at=self._submitted_at.pop(state.request_id, 0.0),
+            finished_at=time.time())
+        self.slots[slot_idx] = None
+
+    def _is_done(self, state: _SlotState) -> bool:
+        return (len(state.generated) >= state.max_new or
+                (self.eos_id is not None and state.generated and
+                 state.generated[-1] == self.eos_id))
+
+    def step(self) -> int:
+        """One engine tick: admit, then a chunk of decode steps.
+
+        Returns the number of tokens emitted (0 when fully idle).
+        """
+        self._admit()
+        emitted = 0
+        # The prefill-sampled token is the first emission; it is also
+        # the token fed into the decode step that produces the second.
+        for i, state in enumerate(self.slots):
+            if state is not None and state.pending_first is not None:
+                state.generated.append(state.pending_first)
+                state.pending_first = None
+                emitted += 1
+                if self._is_done(state):
+                    self._finish(i)
+        active_list = [s is not None for s in self.slots]
+        if not any(active_list):
+            return emitted
+
+        # Chunk size: bounded by global capacity (admission guarantees
+        # every active request fits in the remaining region) and kept
+        # to power-of-two tails so at most log2(chunk) programs exist.
+        n = min(self.decode_chunk, self.remaining_slots())
+        while n & (n - 1):
+            n &= n - 1
+        assert n >= 1, 'capacity accounting violated'
+        self._key, sub = jax.random.split(self._key)
+        active = jnp.asarray(active_list)
+        self.cache, toks = self._decode(
+            self.params, self.cache, jnp.asarray(self._tokens),
+            active, sub, jnp.asarray(self._temps), n=n)
+        self._steps_done += n
+        toks_host = np.asarray(toks)            # [n, B]
+        self._tokens = toks_host[-1].copy()
+        for i, state in enumerate(self.slots):
+            if state is None:
+                continue
+            for j in range(n):
+                state.generated.append(int(toks_host[j, i]))
+                emitted += 1
+                if self._is_done(state):
+                    # Tokens past max_new/EOS within the chunk are
+                    # discarded; the slot frees at the tick boundary.
+                    self._finish(i)
+                    break
+        return emitted
+
+    def run(self,
+            requests: Sequence[Request],
+            on_result: Optional[Callable[[Result], None]] = None
+            ) -> Dict[Any, Result]:
+        """Serve ``requests`` to completion (continuous batching).
+
+        Returns (and fires ``on_result`` for) only THIS call's
+        requests — ``self.results`` archives across calls.
+        """
+        wanted = set()
+        for r in requests:
+            if r.request_id in wanted or r.request_id in self.results:
+                raise ValueError(
+                    f'duplicate request_id {r.request_id!r}')
+            wanted.add(r.request_id)
+        for r in requests:
+            self.submit(r)
+        seen = set(self.results) - wanted
+        while self.queue or self.num_active():
+            self.step()
+            if on_result:
+                for rid, res in self.results.items():
+                    if rid not in seen:
+                        seen.add(rid)
+                        on_result(res)
+        return {rid: self.results[rid] for rid in wanted}

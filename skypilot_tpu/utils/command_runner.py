@@ -290,6 +290,13 @@ class DockerCommandRunner(CommandRunner):
         self.inner.run(docker_utils.bootstrap_command(self.docker_config),
                        log_path=log_path, check=True)
 
+    def kill_workload(self, log_path: str = '/dev/null') -> None:
+        """Kill all processes inside the container (restart it)."""
+        from skypilot_tpu.utils import docker_utils
+        self.inner.run(
+            docker_utils.kill_workload_command(self.docker_config),
+            log_path=log_path)
+
 
 class SSHCommandRunner(CommandRunner):
     """ssh/rsync against a real host (a TPU-VM worker)."""

@@ -89,9 +89,14 @@ def bootstrap_command(config: Dict[str, Any]) -> str:
         # docker already works.
         'docker info >/dev/null 2>&1 || '
         'sudo chmod 666 /var/run/docker.sock 2>/dev/null || true',
-        f'if docker inspect -f "{{{{.State.Running}}}}" '
-        f'{shlex.quote(cname)} 2>/dev/null | grep -q true; then '
-        f'echo "container {cname} already running"; else',
+        # Idempotency requires BOTH running state and the requested
+        # image: a reused cluster whose task switched image_id must
+        # get a fresh container, not silently run in the old image.
+        f'if [ "$(docker inspect -f '
+        '"{{.State.Running}}|{{.Config.Image}}" '
+        f'{shlex.quote(cname)} 2>/dev/null)" = '
+        f'{shlex.quote("true|" + image)} ]; then '
+        f'echo "container {cname} already running {image}"; else',
     ]
     if login:
         # Empty server = Docker Hub: the argument must be omitted, not
@@ -121,3 +126,16 @@ def exec_command(config: Dict[str, Any], script: str) -> str:
     """Wrap ``script`` to execute inside the task container."""
     cname = shlex.quote(config['container'])
     return f'docker exec {cname} bash -c {shlex.quote(script)}'
+
+
+def kill_workload_command(config: Dict[str, Any]) -> str:
+    """Kill everything inside the container, keeping it running.
+
+    ``docker exec``'d processes are NOT children of the exec client —
+    killing the client (or its SSH session) leaves them alive inside
+    the container, still holding /dev/accel*. ``docker restart -t 0``
+    SIGKILLs the container's whole pid namespace and brings it back up
+    (the keepalive is PID 1), so the next job finds a clean container.
+    """
+    cname = shlex.quote(config['container'])
+    return f'docker restart -t 0 {cname}'

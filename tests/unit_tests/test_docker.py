@@ -44,9 +44,13 @@ cmd = argv[0] if argv else ''
 if cmd == 'info':
     sys.exit(0)
 if cmd == 'inspect':
+    # Supports -f "{{.State.Running}}|{{.Config.Image}}" (bootstrap
+    # idempotency) and -f "{{.State.Running}}".
     name = argv[-1]
+    fmt = argv[argv.index('-f') + 1] if '-f' in argv else ''
     if os.path.exists(cpath(name)):
-        print('true')
+        image = open(cpath(name)).read()
+        print('true|' + image if 'Config.Image' in fmt else 'true')
         sys.exit(0)
     sys.exit(1)
 if cmd == 'pull':
@@ -61,10 +65,13 @@ if cmd == 'rm':
     except OSError:
         pass
     sys.exit(0)
+if cmd == 'restart':
+    name = argv[-1]
+    sys.exit(0 if os.path.exists(cpath(name)) else 1)
 if cmd == 'run':
     name = argv[argv.index('--name') + 1]
     with open(cpath(name), 'w') as f:
-        f.write(argv[-3])  # image (argv: ... <image> tail -f /dev/null)
+        f.write(argv[-4])  # image (argv: ... <image> tail -f /dev/null)
     sys.exit(0)
 if cmd == 'exec':
     name = argv[1]
@@ -192,6 +199,32 @@ def test_docker_runner_wraps_and_shares_home(tmp_path, stub_docker):
     assert runner.check_connection()
     inner.run('docker rm -f skytpu-c1')
     assert not runner.check_connection()
+
+
+def test_image_change_rebootstraps(tmp_path, stub_docker):
+    """A reused container running a DIFFERENT image must be replaced,
+    not silently reused."""
+    inner = runner_lib.LocalProcessRunner('h0', str(tmp_path / 'h0'))
+    cfg_a = docker_utils.make_docker_config('img:a', {}, 'c2')
+    runner_lib.DockerCommandRunner(inner, cfg_a).bootstrap()
+    cfg_b = docker_utils.make_docker_config('img:b', {}, 'c2')
+    runner_lib.DockerCommandRunner(inner, cfg_b).bootstrap()
+    pulls = [c[1] for c in _calls(stub_docker) if c[0] == 'pull']
+    assert pulls == ['img:a', 'img:b']
+    # And same-image re-bootstrap still skips the pull.
+    runner_lib.DockerCommandRunner(inner, cfg_b).bootstrap()
+    pulls = [c[1] for c in _calls(stub_docker) if c[0] == 'pull']
+    assert pulls == ['img:a', 'img:b']
+
+
+def test_kill_workload_restarts_container(tmp_path, stub_docker):
+    inner = runner_lib.LocalProcessRunner('h0', str(tmp_path / 'h0'))
+    cfg = docker_utils.make_docker_config('img:a', {}, 'c3')
+    runner = runner_lib.DockerCommandRunner(inner, cfg)
+    runner.bootstrap()
+    runner.kill_workload()
+    restarts = [c for c in _calls(stub_docker) if c[0] == 'restart']
+    assert restarts and restarts[0][-1] == 'skytpu-c3'
 
 
 def test_entry_roundtrip_wraps_docker():
