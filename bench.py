@@ -296,6 +296,117 @@ def serve_bench():
     print(json.dumps(result))
 
 
+def serve_stack_bench():
+    """Served QPS through the REAL serving stack: concurrent HTTP
+    clients -> serve LoadBalancer (reverse proxy, least-load policy)
+    -> EngineServer replica -> ServingEngine. The end-to-end shape of
+    the reference's JetStream demo (client -> sky serve LB -> JetStream
+    HTTP server), measured on this chip.
+    """
+    import asyncio
+
+    import aiohttp
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+
+    gen = _detect_generation(jax.devices()[0])
+    on_tpu = jax.default_backend() not in ('cpu',)
+    n_requests = int(os.environ.get('BENCH_SERVE_REQUESTS', '64'))
+    max_new = int(os.environ.get('BENCH_SERVE_MAX_NEW', '128'))
+    if not on_tpu:
+        n_requests, max_new = 6, 8
+        cfg = models.LlamaConfig.tiny(max_seq=256)
+        batch, max_prompt, max_seq, chunk = 2, 64, 128, 4
+    else:
+        batch = int(os.environ.get('BENCH_SERVE_BATCH', '64'))
+        max_prompt = int(os.environ.get('BENCH_SERVE_PROMPT', '1024'))
+        chunk = int(os.environ.get('BENCH_SERVE_CHUNK', '32'))
+        max_seq = max_prompt + 4 * max_new
+        cfg = models.LlamaConfig.tpu_1b(max_seq=max_seq,
+                                        param_dtype=jnp.bfloat16)
+    # Enough in-flight clients to keep every engine slot busy.
+    concurrency = int(os.environ.get('BENCH_SERVE_CONCURRENCY',
+                                     str(batch)))
+    from skypilot_tpu.models.llama import num_params
+    n_params = num_params(cfg)
+    params = models.init_params(cfg, jax.random.PRNGKey(1))
+    engine = ServingEngine(params, cfg, batch_size=batch,
+                           max_prompt=max_prompt, max_seq=max_seq,
+                           kv_quant=on_tpu, decode_chunk=chunk)
+    server = EngineServer(engine)
+    rng = np.random.default_rng(0)
+
+    async def run_bench():
+        runner = await server.start(18801)
+        lb = LoadBalancer(port=18800, policy='least_load')
+        await lb.start()
+        lb.set_replica_urls(['http://127.0.0.1:18801'])
+        async with aiohttp.ClientSession() as session:
+            while True:  # readiness (engine warmup)
+                try:
+                    async with session.get(
+                            'http://127.0.0.1:18800/health') as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.5)
+
+            sem = asyncio.Semaphore(concurrency)
+            latencies = []
+
+            async def one(i):
+                plen = int(rng.integers(max_prompt // 4, max_prompt))
+                toks = [int(t) for t in
+                        rng.integers(0, cfg.vocab_size, plen)]
+                async with sem:
+                    t0 = time.perf_counter()
+                    async with session.post(
+                            'http://127.0.0.1:18800/generate',
+                            json={'tokens': toks, 'max_new': max_new},
+                            timeout=aiohttp.ClientTimeout(
+                                total=600)) as r:
+                        body = await r.json()
+                    latencies.append(time.perf_counter() - t0)
+                    return len(body['tokens'])
+
+            t0 = time.perf_counter()
+            counts = await asyncio.gather(
+                *[one(i) for i in range(n_requests)])
+            dt = time.perf_counter() - t0
+        await lb.stop()
+        await runner.cleanup()
+        server.stop()
+        return dt, sum(counts), latencies
+
+    dt, out_tokens, latencies = asyncio.run(run_bench())
+    lat = sorted(latencies)
+    result = {
+        'metric': 'llama_serve_stack_req_s',
+        'value': round(n_requests / dt, 2),
+        'unit': 'req/s/chip',
+        'vs_baseline': round((n_requests / dt) / 11.42, 2),
+        'detail': {
+            'wall_s': round(dt, 2),
+            'output_tok_s': round(out_tokens / dt, 1),
+            'p50_latency_s': round(lat[len(lat) // 2], 2),
+            'p95_latency_s': round(lat[int(len(lat) * 0.95)], 2),
+            'n_requests': n_requests, 'concurrency': concurrency,
+            'batch_slots': batch, 'max_new': max_new,
+            'n_params': n_params, 'chip': gen,
+            'backend': jax.default_backend(),
+            'path': 'http client -> LB -> EngineServer -> engine',
+        },
+    }
+    print(json.dumps(result))
+
+
 if __name__ == '__main__':
     mode = (sys.argv[1] if len(sys.argv) > 1 else
             os.environ.get('BENCH_MODE', 'train'))
@@ -303,4 +414,6 @@ if __name__ == '__main__':
         sys.exit(decode_bench())
     if mode == 'serve':
         sys.exit(serve_bench())
+    if mode == 'serve_stack':
+        sys.exit(serve_stack_bench())
     sys.exit(main())

@@ -222,24 +222,9 @@ def main() -> None:
             # Containered jobs first: docker-exec'd processes are not
             # children of the exec client, so killing our subprocess
             # tree alone would leave them alive inside the container
-            # holding TPU devices. Restart each healthy host's
-            # container (best-effort, bounded — a wedged host's SSH
-            # must not block the teardown).
-            docker_runners = [
-                (i, r) for i, r in enumerate(runners)
-                if isinstance(r, runner_lib.DockerCommandRunner) and
-                i != rank
-            ]
-            if docker_runners:
-                kill_threads = [
-                    threading.Thread(target=r.kill_workload,
-                                     daemon=True)
-                    for _, r in docker_runners
-                ]
-                for t in kill_threads:
-                    t.start()
-                for t in kill_threads:
-                    t.join(timeout=10)
+            # holding TPU devices.
+            runner_lib.kill_docker_workloads(
+                [r for i, r in enumerate(runners) if i != rank])
             # Kill our whole subprocess tree: the SSH clients driving
             # ranks on still-HEALTHY hosts would otherwise be orphaned
             # and keep their remote processes holding TPU devices into

@@ -228,23 +228,29 @@ def cancel_job(state_dir: str, job_id: int) -> bool:
         raise exceptions.JobNotFoundError(f'No job {job_id} on cluster.')
     if job['status'].is_terminal():
         return False
+    started = (job['driver_pid'] or
+               job['status'] in (JobStatus.SETTING_UP,
+                                 JobStatus.RUNNING))
     if job['driver_pid']:
         subprocess_utils.kill_process_tree(job['driver_pid'])
     # Containered jobs: the killed tree holds only docker-exec
     # clients; the workload survives inside the container. Restart
-    # each host's container so cancel actually frees the TPU.
-    try:
-        hosts_path = os.path.join(os.path.expanduser(state_dir),
-                                  constants.HOSTS_FILE)
-        with open(hosts_path, encoding='utf-8') as f:
-            entries = json.load(f)
-        from skypilot_tpu.utils import command_runner as runner_lib
-        for entry in entries:
-            if entry.get('docker'):
-                runner = runner_lib.runner_from_host_entry(entry)
-                runner.kill_workload()
-    except (OSError, ValueError):
-        pass  # hosts.json gone (teardown race): nothing left to kill
+    # each host's container so cancel actually frees the TPU. Gated
+    # on the job having STARTED — cancelling a PENDING job must not
+    # SIGKILL whatever other job currently owns the containers.
+    if started:
+        try:
+            hosts_path = os.path.join(os.path.expanduser(state_dir),
+                                      constants.HOSTS_FILE)
+            with open(hosts_path, encoding='utf-8') as f:
+                entries = json.load(f)
+            from skypilot_tpu.utils import command_runner as runner_lib
+            runner_lib.kill_docker_workloads([
+                runner_lib.runner_from_host_entry(e) for e in entries
+                if e.get('docker')
+            ])
+        except (OSError, ValueError):
+            pass  # hosts.json gone (teardown race): nothing to kill
     set_status(state_dir, job_id, JobStatus.CANCELLED)
     schedule_step(state_dir)
     return True

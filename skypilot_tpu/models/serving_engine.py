@@ -223,8 +223,11 @@ class ServingEngine:
         self.run(reqs)
         # Also compile the power-of-two tail decode chunks step() can
         # fold to near capacity exhaustion — otherwise the compile
-        # lands inside a live request's latency.
+        # lands inside a live request's latency. Fold to a power of
+        # two first, exactly as step() does.
         n = self.decode_chunk
+        while n & (n - 1):
+            n &= n - 1
         while n > 1:
             n //= 2
             self._key, sub = jax.random.split(self._key)
@@ -394,6 +397,19 @@ class ServingEngine:
                     break
         return emitted
 
+    def drain_results(self) -> Dict[Any, Result]:
+        """Pop and return all finished results. Long-running servers
+        MUST drain (rather than read ``results``) or every request's
+        tokens are archived forever."""
+        out = self.results
+        self.results = {}
+        return out
+
+    def _inflight_ids(self) -> set:
+        ids = {r.request_id for r in self.queue}
+        ids.update(s.request_id for s in self.slots if s is not None)
+        return ids
+
     def run(self,
             requests: Sequence[Request],
             on_result: Optional[Callable[[Result], None]] = None
@@ -401,22 +417,22 @@ class ServingEngine:
         """Serve ``requests`` to completion (continuous batching).
 
         Returns (and fires ``on_result`` for) only THIS call's
-        requests — ``self.results`` archives across calls.
+        requests; finished results are drained, not archived.
         """
         wanted = set()
+        inflight = self._inflight_ids()
         for r in requests:
-            if r.request_id in wanted or r.request_id in self.results:
+            if r.request_id in wanted or r.request_id in inflight:
                 raise ValueError(
                     f'duplicate request_id {r.request_id!r}')
             wanted.add(r.request_id)
         for r in requests:
             self.submit(r)
-        seen = set(self.results) - wanted
+        collected: Dict[Any, Result] = {}
         while self.queue or self.num_active():
             self.step()
-            if on_result:
-                for rid, res in self.results.items():
-                    if rid not in seen:
-                        seen.add(rid)
-                        on_result(res)
-        return {rid: self.results[rid] for rid in wanted}
+            for rid, res in self.drain_results().items():
+                collected[rid] = res
+                if on_result and rid in wanted:
+                    on_result(res)
+        return {rid: collected[rid] for rid in wanted}

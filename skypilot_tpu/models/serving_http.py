@@ -1,0 +1,181 @@
+"""HTTP front end for the continuous-batching ServingEngine.
+
+The replica-side process of a served model: what JetStream's server
+is to the reference's serving recipe
+(/root/reference/examples/tpu/v6e/serve-llama2-7b.yaml launches a
+JetStream HTTP server per replica; the serve stack's load balancer
+fronts it). A replica task runs
+
+    python -m skypilot_tpu.models.serving_http --port 8801 ...
+
+and the serve stack probes ``/health`` for readiness and proxies
+generation traffic to ``/generate``.
+
+Structure: aiohttp handlers submit requests into the ServingEngine
+queue and await an asyncio future; a single engine thread drives
+``engine.step()`` continuously (the engine is a host-side orchestrator
+over jitted device programs — one driver thread is the device-order
+guarantee) and resolves futures as requests finish.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+class EngineServer:
+    """aiohttp app over a ServingEngine; one background driver thread."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._futures: Dict[Any, asyncio.Future] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+
+    # ---------------------------------------------------------- engine
+    def _drive(self) -> None:
+        self.engine.warmup()
+        self._ready.set()
+        while not self._stop.is_set():
+            with self._lock:
+                busy = bool(self.engine.queue or
+                            self.engine.num_active())
+            if not busy:
+                time.sleep(0.002)
+                continue
+            self.engine.step()
+            # Drain (not read) so a long-lived replica never
+            # accumulates every past request's tokens.
+            for rid, res in self.engine.drain_results().items():
+                fut = self._futures.pop(rid, None)
+                if fut is not None and self._loop is not None:
+                    self._loop.call_soon_threadsafe(
+                        lambda f=fut, r=res: (not f.done() and
+                                              f.set_result(r)))
+
+    # ------------------------------------------------------------ http
+    async def handle_generate(self, request: web.Request
+                              ) -> web.Response:
+        from skypilot_tpu.models.serving_engine import Request
+        body = await request.json()
+        tokens = body['tokens']
+        max_new = int(body.get('max_new', 64))
+        temperature = body.get('temperature')
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        fut = asyncio.get_event_loop().create_future()
+        self._futures[rid] = fut
+        try:
+            with self._lock:
+                self.engine.submit(Request(rid, tokens, max_new,
+                                           temperature=temperature))
+        except ValueError as e:
+            self._futures.pop(rid, None)
+            return web.json_response({'error': str(e)}, status=400)
+        result = await fut
+        return web.json_response({
+            'tokens': result.tokens,
+            'latency_s': result.finished_at - result.submitted_at,
+        })
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        if not self._ready.is_set():
+            return web.json_response({'status': 'warming'}, status=503)
+        return web.json_response({'status': 'ok'})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post('/generate', self.handle_generate)
+        app.router.add_get('/health', self.handle_health)
+        return app
+
+    async def start(self, port: int) -> web.AppRunner:
+        self._loop = asyncio.get_event_loop()
+        self._thread.start()
+        runner = web.AppRunner(self.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, '0.0.0.0', port)
+        await site.start()
+        logger.info('Engine server on :%d', port)
+        return runner
+
+    def stop(self) -> None:
+        self._stop.set()
+        # Join so interpreter teardown never kills the driver thread
+        # mid-device-call (which aborts with an unraisable C++
+        # exception). Bounded: warmup compiles can outlast it, and a
+        # daemon thread dying later is only unclean at exit.
+        if self._thread.ident is not None and self._thread.is_alive():
+            self._thread.join(timeout=10)
+
+
+def _build_engine(args) -> 'Any':
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    cfg_fn = getattr(models.LlamaConfig, args.model)
+    cfg = cfg_fn(max_seq=args.max_seq)
+    if jax.default_backend() != 'cpu':
+        cfg = cfg_fn(max_seq=args.max_seq,
+                     param_dtype=jnp.bfloat16)
+    if args.checkpoint:
+        import os
+
+        import orbax.checkpoint as ocp
+        target = jax.eval_shape(
+            lambda: models.init_params(cfg, jax.random.PRNGKey(0)))
+        params = ocp.StandardCheckpointer().restore(
+            os.path.abspath(os.path.expanduser(args.checkpoint)),
+            target)
+    else:
+        logger.warning('No --checkpoint: serving randomly initialized '
+                       'weights (benchmark / smoke mode).')
+        params = models.init_params(cfg, jax.random.PRNGKey(0))
+    return ServingEngine(params, cfg, batch_size=args.batch,
+                         max_prompt=args.max_prompt,
+                         max_seq=args.max_seq,
+                         kv_quant=args.kv_quant,
+                         decode_chunk=args.decode_chunk)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=8801)
+    parser.add_argument('--model', default='tiny',
+                        help='LlamaConfig classmethod name')
+    parser.add_argument('--checkpoint', default=None)
+    parser.add_argument('--batch', type=int, default=8)
+    parser.add_argument('--max-prompt', type=int, default=512)
+    parser.add_argument('--max-seq', type=int, default=1024)
+    parser.add_argument('--decode-chunk', type=int, default=8)
+    parser.add_argument('--kv-quant', action='store_true')
+    args = parser.parse_args()
+
+    server = EngineServer(_build_engine(args))
+
+    async def _run():
+        await server.start(args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == '__main__':
+    main()

@@ -298,6 +298,29 @@ class DockerCommandRunner(CommandRunner):
             log_path=log_path)
 
 
+def kill_docker_workloads(runners: List[CommandRunner],
+                          timeout: float = 10.0) -> None:
+    """Best-effort, bounded-parallel restart of every docker runner's
+    container. Used when tearing down a containered job (cancel,
+    worker death): docker-exec'd processes survive their exec client,
+    so killing the client tree alone leaves the workload holding TPU
+    devices. One wedged host's SSH must not block the others or the
+    caller — each restart runs in a daemon thread joined at
+    ``timeout``.
+    """
+    import threading
+    docker_runners = [r for r in runners
+                      if isinstance(r, DockerCommandRunner)]
+    threads = [
+        threading.Thread(target=r.kill_workload, daemon=True)
+        for r in docker_runners
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+
+
 class SSHCommandRunner(CommandRunner):
     """ssh/rsync against a real host (a TPU-VM worker)."""
 

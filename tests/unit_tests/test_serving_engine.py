@@ -147,8 +147,13 @@ def test_per_request_temperature_and_run_scoping():
                       on_result=lambda r: delivered.append(r.request_id))
     assert set(res2) == {'next'}
     assert delivered == ['next']
+    # Finished ids may be reused (results are drained, not archived);
+    # duplicates are rejected only while in flight.
+    res3 = engine.run([Request('next', p1, max_new=3)])
+    assert set(res3) == {'next'}
     with pytest.raises(ValueError, match='duplicate request_id'):
-        engine.run([Request('next', p1, max_new=3)])
+        engine.run([Request('dup', p1, max_new=3),
+                    Request('dup', p2, max_new=3)])
 
 
 def test_engine_rejections():

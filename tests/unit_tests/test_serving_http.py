@@ -1,0 +1,94 @@
+"""HTTP serving stack: client -> LoadBalancer -> EngineServer ->
+ServingEngine, hermetic on the CPU backend with the tiny model."""
+import asyncio
+
+import aiohttp
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.models.serving_engine import ServingEngine
+from skypilot_tpu.models.serving_http import EngineServer
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+
+
+@pytest.fixture
+def stack():
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    server = EngineServer(engine)
+    yield cfg, params, server
+    server.stop()
+
+
+def test_generate_through_lb(stack):
+    cfg, params, server = stack
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{port}'])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        async with aiohttp.ClientSession() as session:
+            # Health turns ok once the engine warms.
+            for _ in range(600):
+                try:
+                    async with session.get(base + '/health') as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError('engine never became ready')
+
+            rng = np.random.default_rng(0)
+            prompts = [
+                [int(t) for t in rng.integers(0, cfg.vocab_size, n)]
+                for n in (9, 6, 12)
+            ]
+            results = await asyncio.gather(*[
+                session.post(base + '/generate',
+                             json={'tokens': p, 'max_new': 5})
+                for p in prompts
+            ])
+            bodies = [await r.json() for r in results]
+        await lb.stop()
+        await runner.cleanup()
+        return prompts, bodies
+
+    prompts, bodies = asyncio.run(scenario())
+    for p, body in zip(prompts, bodies):
+        import jax.numpy as jnp
+        want = inference.generate(
+            params, jnp.asarray([p], jnp.int32),
+            jnp.asarray([len(p)], jnp.int32),
+            models.LlamaConfig.tiny(), max_new=5)
+        assert body['tokens'] == [int(t) for t in np.asarray(want[0])]
+        assert body['latency_s'] > 0
+
+
+def test_oversized_request_rejected(stack):
+    cfg, params, server = stack
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f'http://127.0.0.1:{port}/generate',
+                    json={'tokens': list(range(100)),
+                          'max_new': 5}) as r:
+                status = r.status
+                body = await r.json()
+        await runner.cleanup()
+        return status, body
+
+    status, body = asyncio.run(scenario())
+    assert status == 400 and 'exceeds max_prompt' in body['error']
