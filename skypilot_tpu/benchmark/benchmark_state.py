@@ -35,9 +35,19 @@ def _conn() -> sqlite3.Connection:
             num_steps INTEGER,
             seconds_per_step REAL,
             cost_per_step REAL,
+            total_steps INTEGER,
+            eta_seconds REAL,
+            total_cost REAL,
             status TEXT DEFAULT 'RUNNING',
             PRIMARY KEY (benchmark, cluster_name)
         )""")
+    # Migrate pre-ETA databases in place.
+    cols = {r[1] for r in conn.execute('PRAGMA table_info(candidates)')}
+    for col, typ in (('total_steps', 'INTEGER'),
+                     ('eta_seconds', 'REAL'), ('total_cost', 'REAL')):
+        if col not in cols:
+            conn.execute(
+                f'ALTER TABLE candidates ADD COLUMN {col} {typ}')
     return conn
 
 

@@ -100,6 +100,16 @@ def collect_results(benchmark: str) -> List[Dict[str, Any]]:
         span = summary['last_step'] - summary['first_step']
         sec_per_step = span / max(1, steps - 1)
         cost_per_step = row['hourly_price'] * sec_per_step / 3600.0
+        # ETA / total-$ projection (reference benchmark report): when
+        # the callback knows the run's total step count, project the
+        # remaining wall time and the whole run's cost on this
+        # candidate from the measured steady-state step time.
+        total_steps = summary.get('total_steps')
+        eta_seconds = total_cost = None
+        if total_steps:
+            eta_seconds = max(0, total_steps - steps) * sec_per_step
+            total_cost = (row['hourly_price'] * total_steps *
+                          sec_per_step / 3600.0)
         status = 'RUNNING'
         try:
             job_status = core.job_status(
@@ -111,7 +121,9 @@ def collect_results(benchmark: str) -> List[Dict[str, Any]]:
         benchmark_state.update_candidate(
             benchmark, cluster, num_steps=steps,
             seconds_per_step=sec_per_step,
-            cost_per_step=cost_per_step, status=status)
+            cost_per_step=cost_per_step, total_steps=total_steps,
+            eta_seconds=eta_seconds, total_cost=total_cost,
+            status=status)
     return benchmark_state.get_candidates(benchmark)
 
 

@@ -323,6 +323,42 @@ def bench_show(benchmark: str) -> None:
                        '$/step', 'status'])
 
 
+@bench.command('report')
+@click.argument('benchmark')
+def bench_report(benchmark: str) -> None:
+    """Ranked candidate table with ETA and projected total cost
+    (reference `sky bench show`'s richer report)."""
+    from skypilot_tpu import benchmark as bench_lib
+
+    def _dur(seconds):
+        if seconds is None:
+            return '-'
+        seconds = int(seconds)
+        if seconds >= 3600:
+            return f'{seconds // 3600}h{(seconds % 3600) // 60:02d}m'
+        if seconds >= 60:
+            return f'{seconds // 60}m{seconds % 60:02d}s'
+        return f'{seconds}s'
+
+    rows = bench_lib.report(benchmark)
+    _echo_table([{
+        'cluster': r['cluster_name'],
+        'resources': r['resources_repr'],
+        '$/hr': round(r['hourly_price'], 2),
+        'steps': (f"{r['num_steps']}/{r['total_steps']}"
+                  if r.get('total_steps') else (r['num_steps'] or '-')),
+        's/step': (round(r['seconds_per_step'], 4)
+                   if r['seconds_per_step'] else '-'),
+        '$/step': (round(r['cost_per_step'], 6)
+                   if r['cost_per_step'] is not None else '-'),
+        'eta': _dur(r.get('eta_seconds')),
+        'total $': (round(r['total_cost'], 2)
+                    if r.get('total_cost') is not None else '-'),
+        'status': r['status'],
+    } for r in rows], ['cluster', 'resources', '$/hr', 'steps',
+                       's/step', '$/step', 'eta', 'total $', 'status'])
+
+
 @bench.command('down')
 @click.argument('benchmark')
 def bench_down(benchmark: str) -> None:

@@ -127,6 +127,15 @@ class Cloud:
     def hourly_price(self, resources: 'Resources') -> float:
         raise NotImplementedError
 
+    # $/GB leaving this cloud to the public internet / another cloud.
+    # Reference carries this per cloud (sky/clouds/*.py get_egress_cost);
+    # subclasses override. 0.09 is the common public-cloud list price.
+    _EGRESS_PER_GB = 0.09
+
+    def egress_cost(self, num_gigabytes: float) -> float:
+        """Total $ to move ``num_gigabytes`` OUT of this cloud."""
+        return self._EGRESS_PER_GB * max(0.0, num_gigabytes)
+
     def validate_region_zone(
             self, region: Optional[str],
             zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:

@@ -33,6 +33,7 @@ class GCP(cloud_lib.Cloud):
 
     _REPR = 'GCP'
     MAX_CLUSTER_NAME_LEN_LIMIT = 35
+    _EGRESS_PER_GB = 0.12  # premium-tier internet egress list price
 
     @classmethod
     def unsupported_features_for_resources(

@@ -32,6 +32,7 @@ class Kubernetes(cloud_lib.Cloud):
     _REPR = 'Kubernetes'
     # DNS-1123 subdomain limit for pod names, minus our suffixes.
     MAX_CLUSTER_NAME_LEN_LIMIT = 40
+    _EGRESS_PER_GB = 0.0   # cluster-internal by default
 
     @classmethod
     def unsupported_features_for_resources(

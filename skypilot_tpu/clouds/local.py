@@ -31,6 +31,7 @@ class Local(cloud_lib.Cloud):
     """Runs 'clusters' as processes on this machine."""
 
     _REPR = 'Local'
+    _EGRESS_PER_GB = 0.0   # same machine; nothing leaves
 
     def regions_with_offering(
             self, resources: 'Resources') -> List[cloud_lib.Region]:

@@ -39,6 +39,9 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     attn_impl: str = 'auto'   # auto | flash | ring | xla
+    # GPipe microbatches when the mesh has pp > 1 (0 = auto: 4 *
+    # n_stages, bubble fraction (n-1)/(M+n-1) ≈ 19% at pp=2).
+    pp_microbatches: int = 0
     # True = full remat; 'dots' = selective (save matmul outputs,
     # recompute elementwise); False = none.
     remat: Any = True
@@ -128,22 +131,25 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict:
     }
 
 
-def param_specs(cfg: LlamaConfig) -> Dict:
+def param_specs(cfg: LlamaConfig, pp: bool = False) -> Dict:
     """PartitionSpec pytree matching init_params: Megatron ('tp' on
-    heads/ffn/vocab) + ZeRO-3 ('fsdp' on the other matrix dim)."""
+    heads/ffn/vocab) + ZeRO-3 ('fsdp' on the other matrix dim). With
+    ``pp``, the stacked layer dim is sharded over the pipeline axis
+    (stage s holds its contiguous block of layers)."""
     del cfg
+    layer_axis = 'pp' if pp else None
     return {
         'tok_emb': P('tp', 'fsdp'),
         'layers': {
-            'attn_norm': P(None, None),
-            'wq': P(None, 'fsdp', 'tp'),
-            'wk': P(None, 'fsdp', 'tp'),
-            'wv': P(None, 'fsdp', 'tp'),
-            'wo': P(None, 'tp', 'fsdp'),
-            'mlp_norm': P(None, None),
-            'w_gate': P(None, 'fsdp', 'tp'),
-            'w_up': P(None, 'fsdp', 'tp'),
-            'w_down': P(None, 'tp', 'fsdp'),
+            'attn_norm': P(layer_axis, None),
+            'wq': P(layer_axis, 'fsdp', 'tp'),
+            'wk': P(layer_axis, 'fsdp', 'tp'),
+            'wv': P(layer_axis, 'fsdp', 'tp'),
+            'wo': P(layer_axis, 'tp', 'fsdp'),
+            'mlp_norm': P(layer_axis, None),
+            'w_gate': P(layer_axis, 'fsdp', 'tp'),
+            'w_up': P(layer_axis, 'fsdp', 'tp'),
+            'w_down': P(layer_axis, 'tp', 'fsdp'),
         },
         'final_norm': P(None),
         'lm_head': P('fsdp', 'tp'),
@@ -194,8 +200,8 @@ def _rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, cfg: LlamaConfig, mesh):
-    impl = cfg.attn_impl
+def _attention(q, k, v, cfg: LlamaConfig, mesh, impl_override=None):
+    impl = impl_override or cfg.attn_impl
     if impl == 'auto':
         if mesh is not None and mesh.shape.get('sp', 1) > 1:
             impl = 'ring'
@@ -231,6 +237,11 @@ def forward_hidden(params: Dict,
     def constrain(x, spec):
         if mesh is None:
             return x
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is not None and len(ambient.shape) > 0:
+            # Ambient-mesh form (bare spec): required inside the
+            # partial-manual pipeline region, equivalent outside it.
+            return lax.with_sharding_constraint(x, spec)
         return lax.with_sharding_constraint(
             x, jax.sharding.NamedSharding(mesh, spec))
 
@@ -245,18 +256,21 @@ def forward_hidden(params: Dict,
     x = emb.astype(cdt)[tokens]                      # [B, S, D]
     x = constrain(x, ACT_SPEC)
 
-    def layer(x, lp):
+    def decoder_layer(x, lp, pos, attn_override=None):
+        """One decoder block; shapes derived from x so the same body
+        runs on full batches (scan path) and microbatches (pp path)."""
+        bx, sx = x.shape[0], x.shape[1]
         h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
-        q = (h @ lp['wq'].astype(cdt)).reshape(b, s, cfg.n_heads,
+        q = (h @ lp['wq'].astype(cdt)).reshape(bx, sx, cfg.n_heads,
                                                cfg.head_dim)
-        k = (h @ lp['wk'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
+        k = (h @ lp['wk'].astype(cdt)).reshape(bx, sx, cfg.n_kv_heads,
                                                cfg.head_dim)
-        v = (h @ lp['wv'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
+        v = (h @ lp['wv'].astype(cdt)).reshape(bx, sx, cfg.n_kv_heads,
                                                cfg.head_dim)
-        q = constrain(_rope(q, positions, cfg.rope_theta), HEAD_SPEC)
-        k = _rope(k, positions, cfg.rope_theta)
-        o = _attention(q, k, v, cfg, mesh)
-        o = o.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        q = constrain(_rope(q, pos, cfg.rope_theta), HEAD_SPEC)
+        k = _rope(k, pos, cfg.rope_theta)
+        o = _attention(q, k, v, cfg, mesh, impl_override=attn_override)
+        o = o.reshape(bx, sx, cfg.n_heads * cfg.head_dim)
         x = x + constrain(o @ lp['wo'].astype(cdt), ACT_SPEC)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
@@ -264,10 +278,49 @@ def forward_hidden(params: Dict,
         up = h @ lp['w_up'].astype(cdt)
         x = x + constrain((gate * up) @ lp['w_down'].astype(cdt),
                           ACT_SPEC)
-        return x, None
+        return x
 
-    x, _ = lax.scan(remat_layer_fn(layer, cfg.remat),
-                    x, params['layers'])
+    pp = mesh.shape.get('pp', 1) if mesh is not None else 1
+    if pp > 1:
+        # GPipe over the 'pp' mesh axis (parallel/pipeline.py
+        # pipeline_layers): manual only over 'pp', so the Megatron/
+        # ZeRO-3/sp sharding of the layer math above keeps working
+        # inside each stage unchanged. Sharding constraints inside the
+        # partial-manual region must use bare PartitionSpecs under the
+        # ambient mesh (jax.set_mesh) — a NamedSharding over the
+        # concrete mesh would type 'pp' as Auto and be rejected.
+        from skypilot_tpu.parallel.pipeline import pipeline_layers
+
+        def pipe_layer(lp, h):
+            sx = h.shape[1]
+            pos = jnp.broadcast_to(jnp.arange(sx, dtype=jnp.int32),
+                                   (h.shape[0], sx))
+            # Ring attention's own shard_map cannot nest inside the
+            # pp-manual region today (jax 0.9 rejects the backward's
+            # residual capture across nested partial-manual regions);
+            # inside pipeline stages, sequence parallelism runs as
+            # XLA auto-sp instead (seq stays sharded over 'sp'; the
+            # partitioner all-gathers K/V for the attention — more
+            # bytes than the ring but on the same ICI links).
+            override = 'xla' if (
+                mesh.shape.get('sp', 1) > 1 or
+                cfg.attn_impl == 'ring') else None
+            return decoder_layer(h, lp, pos, attn_override=override)
+
+        m = cfg.pp_microbatches or min(b, 4 * pp)
+        while b % m:
+            m -= 1
+        with jax.sharding.use_abstract_mesh(mesh.abstract_mesh):
+            x = pipeline_layers(remat_layer_fn(pipe_layer, cfg.remat),
+                                params['layers'], x, mesh=mesh,
+                                num_microbatches=m)
+    else:
+
+        def layer(x, lp):
+            return decoder_layer(x, lp, positions), None
+
+        x, _ = lax.scan(remat_layer_fn(layer, cfg.remat),
+                        x, params['layers'])
 
     return _rmsnorm(x, params['final_norm'], cfg.norm_eps)
 

@@ -106,10 +106,15 @@ def init_params(cfg: MoEConfig, key: jax.Array) -> Dict:
     }
 
 
-def param_specs(cfg: MoEConfig) -> Dict:
+def param_specs(cfg: MoEConfig, pp: bool = False) -> Dict:
     """Expert parallelism: the E dim shards over 'tp' (experts replace
     the tp-sharded dense FFN); attention stays Megatron-sharded."""
     del cfg
+    if pp:
+        raise NotImplementedError(
+            "MoE with a pp>1 flagship mesh is not wired up; use "
+            "parallel.pipeline.pipeline_apply (the MoE GPipe path) "
+            "or pp=1.")
     return {
         'tok_emb': P('tp', 'fsdp'),
         'layers': {

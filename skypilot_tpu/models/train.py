@@ -51,8 +51,9 @@ def make_optimizer(lr: float = 3e-4,
     )
 
 
-def _state_specs(cfg: llama.LlamaConfig, optimizer, params_shape):
-    pspecs = _family(cfg).param_specs(cfg)
+def _state_specs(cfg: llama.LlamaConfig, optimizer, params_shape,
+                 pp: bool = False):
+    pspecs = _family(cfg).param_specs(cfg, pp=pp)
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
 
     # Optimizer moments mirror the param tree inside each optax state
@@ -100,7 +101,8 @@ def init_train_state(cfg: llama.LlamaConfig,
         return jax.jit(_init)(key), optimizer
     params_shape = jax.eval_shape(functools.partial(_family(cfg).init_params,
                                                     cfg), key)
-    specs = _state_specs(cfg, optimizer, params_shape)
+    specs = _state_specs(cfg, optimizer, params_shape,
+                         pp=mesh.shape.get('pp', 1) > 1)
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))

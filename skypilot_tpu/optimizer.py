@@ -118,6 +118,11 @@ def _egress_cost(src: Optional[resources_lib.Resources],
     same_region = same_cloud and src.region == dst.region
     if same_region:
         return 0.0
+    # Egress is billed by the SOURCE cloud at its own rate (reference
+    # sky/clouds/*.py get_egress_cost); fall back to the flat default
+    # when the source cloud is unknown.
+    if src.cloud is not None:
+        return src.cloud.egress_cost(gigabytes)
     return _EGRESS_COST_PER_GB * gigabytes
 
 

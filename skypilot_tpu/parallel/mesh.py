@@ -7,6 +7,15 @@ Axes convention (outer → inner, DCN-slowest → ICI-fastest):
   ``sp``    sequence/context parallelism (ring attention over ICI)
   ``tp``    tensor (Megatron) parallelism — innermost, so its
             collectives ride the fastest ICI links
+  ``pp``    pipeline parallelism (stages exchange one activation per
+            microbatch tick — the lowest-bandwidth traffic in the
+            step). Listed last for a partitioner constraint: inside
+            the pp-manual pipeline region the OTHER axes become
+            manual, and shardy requires manual axes to precede free
+            axes within any dimension sharding — which holds exactly
+            when pp is the final mesh axis. Physical placement of pp
+            onto DCN is a device-order concern handled in make_mesh,
+            not by the logical axis order.
 
 The reference has no equivalent (it is an orchestrator; SURVEY.md §2.11)
 — this is the TPU-native layer its recipes would otherwise hand-roll.
@@ -17,12 +26,13 @@ import dataclasses
 import math
 from typing import Mapping, Optional, Sequence, Tuple
 
-AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp')
+AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp', 'pp')
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
     """A concrete axis-size assignment for a device count."""
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
     sp: int = 1
@@ -30,7 +40,7 @@ class MeshPlan:
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.pp * self.dp * self.fsdp * self.sp * self.tp
 
     def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
         return tuple((a, getattr(self, a)) for a in AXIS_ORDER)
@@ -41,6 +51,7 @@ def plan_mesh(num_devices: int,
               tp: int = 1,
               sp: int = 1,
               dp: int = 1,
+              pp: int = 1,
               fsdp: int = -1) -> MeshPlan:
     """Fill in one -1 axis so the product equals ``num_devices``.
 
@@ -49,7 +60,7 @@ def plan_mesh(num_devices: int,
     fully-sharded params + ICI all-gather is the bandwidth-optimal
     layout (scaling-book recipe).
     """
-    sizes = {'dp': dp, 'fsdp': fsdp, 'sp': sp, 'tp': tp}
+    sizes = {'pp': pp, 'dp': dp, 'fsdp': fsdp, 'sp': sp, 'tp': tp}
     free = [a for a, s in sizes.items() if s == -1]
     if len(free) > 1:
         raise ValueError(f'At most one axis may be -1, got {free}')

@@ -123,3 +123,76 @@ def pipeline_apply(layer_fn: Callable,
                    in_specs=(param_specs, P()), out_specs=P())
     out = fn(stacked_params, xm)
     return out.reshape((b,) + x.shape[1:])
+
+
+def pipeline_layers(layer_fn: Callable,
+                    stacked_params,
+                    x: jax.Array,
+                    *,
+                    mesh,
+                    num_microbatches: int,
+                    axis_name: str = 'pp') -> jax.Array:
+    """GPipe over ``axis_name`` with every OTHER mesh axis automatic.
+
+    The flagship-integration variant of :func:`pipeline_apply`: the
+    shard_map is manual over the pipeline axis ONLY
+    (``axis_names={axis_name}``), so the tensor/fsdp/sequence sharding
+    of the layer math keeps working exactly as in the non-pipelined
+    path — XLA still auto-inserts the Megatron all-reduces and ZeRO-3
+    all-gathers inside each stage, and sharding constraints on
+    dp/fsdp/sp/tp remain valid inside the pipelined body. Activations
+    hop stages via ppermute (one [mb, ...] tensor per tick, the
+    cheapest traffic in the step — put 'pp' on DCN).
+
+    ``stacked_params`` must be sharded P('pp', ...) on the layer dim
+    (see llama.param_specs(pp=True)); layer count divisible by the
+    stage count, batch by ``num_microbatches``.
+    """
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    mb = b // num_microbatches
+    m = num_microbatches
+    xm = x.reshape((m, mb) + x.shape[1:])
+
+    def per_stage(local_params, xm):
+        stage = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        varying_zero = (stage * 0).astype(x.dtype)
+        state = jnp.zeros_like(xm[0]) + varying_zero
+        outputs = jnp.zeros_like(xm) + varying_zero
+
+        def tick(carry, t):
+            state, outputs = carry
+            feed_idx = jnp.clip(t, 0, m - 1)
+            inp = jnp.where(stage == 0, xm[feed_idx], state)
+            out = _stage_apply(layer_fn, local_params, inp)
+            out_idx = t - (n_stages - 1)
+            write = ((stage == n_stages - 1) & (out_idx >= 0) &
+                     (out_idx < m))
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write, out, outputs[jnp.clip(out_idx, 0,
+                                                       m - 1)]),
+                jnp.clip(out_idx, 0, m - 1), 0)
+            state = lax.ppermute(out, axis_name, perm)
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(m + n_stages - 1))
+        keep = (stage == n_stages - 1).astype(outputs.dtype)
+        return lax.psum(outputs * keep, axis_name)
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = shard_map(per_stage,
+                   mesh=mesh,
+                   in_specs=(param_specs, P()),
+                   out_specs=P(),
+                   axis_names={axis_name})
+    out = fn(stacked_params, xm)
+    return out.reshape((b,) + x.shape[1:])

@@ -174,6 +174,13 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = 'sp',
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+    import jax as _jax
+    # Inside a partial-manual region (the pp pipeline), shard_map must
+    # receive the CONTEXT mesh (some axes already Manual) rather than
+    # the concrete all-Auto mesh, or jax rejects the mismatch.
+    ambient = _jax.sharding.get_abstract_mesh()
+    if ambient is not None and len(ambient.shape) > 0:
+        mesh = ambient
     spec = P(('dp', 'fsdp'), axis_name, 'tp', None)
     if positions is None:
         fn = shard_map(

@@ -49,8 +49,21 @@ def test_benchmark_two_local_candidates(bench_env):
         assert r['num_steps'] == 5
         assert r['seconds_per_step'] == pytest.approx(0.05, rel=1.0)
         assert r['cost_per_step'] is not None
+        # ETA + total-$ projection from the callback's total_steps.
+        assert r['total_steps'] == 5
+        assert r['eta_seconds'] == 0  # run finished: nothing remains
+        assert r['total_cost'] == pytest.approx(
+            r['hourly_price'] * 5 * r['seconds_per_step'] / 3600.0)
     # Ranked: cheapest first (stable even with equal local prices).
     assert rows[0]['cost_per_step'] <= rows[1]['cost_per_step']
+
+    # The report CLI renders the ranked table with ETA / total $.
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli as cli_mod
+    out = CliRunner().invoke(cli_mod.cli, ['bench', 'report', 'b1'])
+    assert out.exit_code == 0, out.output
+    assert 'ETA' in out.output and 'TOTAL $' in out.output
+    assert '5/5' in out.output
 
     bench_lib.down_benchmark('b1')
     assert benchmark_state.get_candidates('b1') == []

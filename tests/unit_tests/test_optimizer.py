@@ -30,6 +30,38 @@ def test_picks_cheapest_zone():
     assert best.region.startswith('us-')
 
 
+def test_spot_picks_cheapest_spot_zone():
+    """Spot prices vary per zone independently of on-demand; the
+    optimizer must pick the zone by SPOT price when use_spot."""
+    from skypilot_tpu import catalog
+    dag = _single_task_dag(
+        {Resources(accelerators='tpu-v2-8', use_spot=True,
+                   region='us-central1')})
+    Optimizer.optimize(dag, quiet=True)
+    best = dag.tasks[0].best_resources
+    assert best.zone is not None
+    offerings = catalog.get_tpu_offerings('tpu-v2-8',
+                                          region='us-central1',
+                                          use_spot=True)
+    spot_prices = {o.zone: o.hourly_price(True) for o in offerings}
+    assert len(set(spot_prices.values())) > 1, (
+        'catalog must carry per-zone spot variation')
+    assert spot_prices[best.zone] == min(spot_prices.values())
+
+
+def test_egress_rate_is_per_source_cloud():
+    from skypilot_tpu import optimizer as opt
+    from skypilot_tpu.clouds import GCP, Local
+    src_gcp = Resources(cloud='gcp', instance_type='n2-standard-2',
+                        region='us-central1')
+    src_local = Resources(cloud='local')
+    dst = Resources(cloud='local')
+    # GCP bills 0.12/GB out; local egress is free; same-region is free.
+    assert opt._egress_cost(src_gcp, dst, 10.0) == pytest.approx(1.2)
+    assert opt._egress_cost(src_local, dst, 10.0) == 0.0
+    assert opt._egress_cost(src_gcp, src_gcp, 10.0) == 0.0
+
+
 def test_any_of_prefers_cheaper_generation():
     dag = _single_task_dag({
         Resources(accelerators='tpu-v5e-8'),

@@ -22,8 +22,74 @@ def test_plan_mesh_inference():
 
 def test_make_mesh_axes():
     mesh = make_mesh(tp=2, sp=2)
-    assert mesh.shape == {'dp': 1, 'fsdp': 2, 'sp': 2, 'tp': 2}
+    assert mesh.shape == {'dp': 1, 'fsdp': 2, 'sp': 2, 'tp': 2,
+                          'pp': 1}
     assert mesh.devices.size == 8
+
+
+def test_flagship_pipeline_parallel_train_step():
+    """pp=2 in the FLAGSHIP mesh (not the MoE GPipe island): forward
+    matches pp=1 exactly and a full train step over
+    (pp, dp, fsdp, sp, tp) produces the same loss."""
+    from skypilot_tpu import models
+    from skypilot_tpu.parallel import plan_mesh
+
+    cfg = models.LlamaConfig.tiny(n_layers=4, attn_impl='xla')
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+    want = models.forward(params, tokens, cfg)
+    mesh = make_mesh(plan_mesh(8, pp=2, tp=2, sp=1, dp=1),
+                     devices=jax.devices())
+    got = jax.jit(lambda p, t: models.forward(p, t, cfg, mesh))(
+        params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+    batch = {'inputs': jnp.zeros((4, 64), jnp.int32),
+             'targets': jnp.ones((4, 64), jnp.int32)}
+    state, opt = models.init_train_state(cfg, jax.random.PRNGKey(0),
+                                         mesh)
+    step = models.make_train_step(cfg, opt, mesh)
+    state, m_pp = step(state, models.shard_batch(batch, mesh))
+
+    mesh1 = make_mesh(plan_mesh(8, tp=2, sp=1, dp=1),
+                      devices=jax.devices())
+    state1, opt1 = models.init_train_state(cfg, jax.random.PRNGKey(0),
+                                           mesh1)
+    step1 = models.make_train_step(cfg, opt1, mesh1)
+    state1, m_ref = step1(state1, models.shard_batch(batch, mesh1))
+    assert abs(float(m_pp['loss']) - float(m_ref['loss'])) < 1e-3
+    # Layer params really are sharded over pp (per-stage blocks).
+    wq_shard = state.params['layers']['wq'].sharding
+    assert 'pp' in (wq_shard.spec[0] or ())
+
+
+def test_flagship_pipeline_with_sequence_parallel():
+    """pp=2 x sp=2 x tp=2: inside pipeline stages, sp runs as XLA
+    auto-sp (ring's nested shard_map is not composable with the
+    pp-manual region on this jax); loss still matches pp=1."""
+    from skypilot_tpu import models
+    from skypilot_tpu.parallel import plan_mesh
+
+    cfg = models.LlamaConfig.tiny(n_layers=4, attn_impl='ring')
+    batch = {'inputs': jnp.zeros((4, 64), jnp.int32),
+             'targets': jnp.ones((4, 64), jnp.int32)}
+    mesh = make_mesh(plan_mesh(8, pp=2, tp=2, sp=2, dp=1),
+                     devices=jax.devices())
+    state, opt = models.init_train_state(cfg, jax.random.PRNGKey(0),
+                                         mesh)
+    step = models.make_train_step(cfg, opt, mesh)
+    state, m_pp = step(state, models.shard_batch(batch, mesh))
+
+    cfgx = models.LlamaConfig.tiny(n_layers=4, attn_impl='xla')
+    mesh1 = make_mesh(plan_mesh(8, tp=2, sp=1, dp=1),
+                      devices=jax.devices())
+    state1, opt1 = models.init_train_state(cfgx, jax.random.PRNGKey(0),
+                                           mesh1)
+    step1 = models.make_train_step(cfgx, opt1, mesh1)
+    state1, m_ref = step1(state1, models.shard_batch(batch, mesh1))
+    assert abs(float(m_pp['loss']) - float(m_ref['loss'])) < 1e-2
 
 
 @pytest.mark.parametrize('causal', [True, False])

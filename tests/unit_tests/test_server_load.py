@@ -1,0 +1,147 @@
+"""API server under concurrency (reference
+tests/load_tests/test_load_on_server.py): N simultaneous launches
+against the local provider through the real HTTP server + detached
+worker processes, asserting request-DB consistency and no leaked
+worker processes."""
+import concurrent.futures
+import threading
+import time
+
+import psutil
+import pytest
+import requests as http
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+
+
+@pytest.fixture
+def api_env(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYTPU_API_DB',
+                       str(isolated_state / 'requests.db'))
+    monkeypatch.setenv('SKYTPU_API_LOG_DIR',
+                       str(isolated_state / 'api_logs'))
+    yield isolated_state
+
+
+@pytest.fixture
+def live_server(api_env, monkeypatch):
+    import asyncio
+
+    from aiohttp import web
+
+    from skypilot_tpu.server.server import make_app
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    port_holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', 0)
+        loop.run_until_complete(site.start())
+        port_holder['port'] = site._server.sockets[0].getsockname()[1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    url = f'http://127.0.0.1:{port_holder["port"]}'
+    monkeypatch.setenv('SKYTPU_API_SERVER_ENDPOINT', url)
+    yield url
+    loop.call_soon_threadsafe(loop.stop)
+
+
+_N = 6
+
+
+def _worker_pids():
+    """PIDs of detached request-worker processes."""
+    out = []
+    for proc in psutil.process_iter(['cmdline']):
+        try:
+            cmd = ' '.join(proc.info['cmdline'] or [])
+        except psutil.Error:
+            continue
+        if 'skypilot_tpu.server.worker' in cmd:
+            out.append(proc.pid)
+    return out
+
+
+def test_concurrent_launches_consistent_and_no_leaks(live_server):
+    import skypilot_tpu as sky
+    from skypilot_tpu.client import sdk
+
+    def launch_one(i):
+        task = sky.Task(f'load{i}', run=f'echo load-test-{i}')
+        task.set_resources(sky.Resources(cloud='local'))
+        request_id = sdk.launch(task, cluster_name=f'loadc{i}')
+        return i, request_id, sdk.get(request_id, timeout=180)
+
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=_N) as pool:
+        results = list(pool.map(launch_one, range(_N)))
+    wall = time.time() - t0
+
+    # Every request exists in the DB exactly once and SUCCEEDED.
+    listing = http.get(live_server + '/api/requests', timeout=10)
+    listing.raise_for_status()
+    records = {r['request_id']: r for r in listing.json()['requests']}
+    request_ids = [rid for _, rid, _ in results]
+    assert len(set(request_ids)) == _N
+    for i, rid, result in results:
+        assert rid in records, (rid, records.keys())
+        assert records[rid]['status'] == 'SUCCEEDED', records[rid]
+
+    # All clusters actually exist and ran their job.
+    for i in range(_N):
+        rec = core.status(f'loadc{i}')
+        assert rec and rec[0]['status'].value == 'UP', (i, rec)
+
+    # Workers drain: no request-worker process survives its request.
+    deadline = time.time() + 30
+    while time.time() < deadline and _worker_pids():
+        time.sleep(0.5)
+    assert _worker_pids() == [], 'leaked request workers'
+
+    # Teardown through the same concurrent path.
+    def down_one(i):
+        return sdk.get(sdk.down(f'loadc{i}'), timeout=60)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=_N) as pool:
+        list(pool.map(down_one, range(_N)))
+    for i in range(_N):
+        assert core.status(f'loadc{i}') == []
+    print(f'{_N} concurrent launches in {wall:.1f}s')
+
+
+def test_interleaved_status_reads_never_block(live_server):
+    """SHORT requests (status) stay responsive while LONG launches
+    run — the two-queue design's whole point."""
+    import skypilot_tpu as sky
+    from skypilot_tpu.client import sdk
+
+    bg_task = sky.Task('bg', run='sleep 3')
+    bg_task.set_resources(sky.Resources(cloud='local'))
+    rid = sdk.launch(bg_task, cluster_name='loadbg')
+    latencies = []
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        t0 = time.time()
+        http.get(live_server + '/api/requests', timeout=10)
+        latencies.append(time.time() - t0)
+        rec = http.get(live_server + '/api/status',
+                       params={'request_id': rid}, timeout=10).json()
+        if rec.get('status') in ('SUCCEEDED', 'FAILED'):
+            break
+        time.sleep(0.2)
+    assert max(latencies) < 2.0, latencies
+    sdk.get(rid, timeout=60)
+    try:
+        sdk.get(sdk.down('loadbg'), timeout=60)
+    except exceptions.SkyTpuError:
+        pass
