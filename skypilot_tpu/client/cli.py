@@ -460,7 +460,7 @@ def api() -> None:
 def api_list() -> None:
     import requests as http
     url = sdk.ensure_server()
-    rows = http.get(url + '/api/requests', timeout=10).json()
+    rows = http.get(url + '/api/requests', timeout=10).json()['requests']
     _echo_table(rows, ['request_id', 'name', 'status'])
 
 

@@ -17,7 +17,7 @@ from typing import Optional, Tuple
 from skypilot_tpu import exceptions
 from skypilot_tpu.data import storage as storage_lib
 
-_SCHEMES = ('gs://', 's3://', 'local://')
+_SCHEMES = ('gs://', 's3://', 'r2://', 'az://', 'local://')
 
 
 def is_cloud_url(path: str) -> bool:
@@ -44,18 +44,33 @@ def download_command(url: str, dst: str,
         is_dir = url.endswith('/') or not posixpath.splitext(key)[1]
     src = url.rstrip('/')
     q_dst = shlex.quote(dst)
-    if scheme in ('gs', 's3'):
+    if scheme in ('gs', 's3', 'r2', 'az'):
         # Directory fetches reuse the Store classes' own download
-        # commands (one place owns the gsutil/aws CLI invocations);
+        # commands (one place owns the gsutil/aws/az CLI invocations);
         # only the single-object copy is specific to this module.
-        cls = (storage_lib.GcsStore if scheme == 'gs'
-               else storage_lib.S3Store)
+        cls = {
+            'gs': storage_lib.GcsStore,
+            's3': storage_lib.S3Store,
+            'r2': storage_lib.R2Store,
+            'az': storage_lib.AzureBlobStore,
+        }[scheme]
         store = cls(f'{bucket}/{key}'.rstrip('/') if key else bucket)
         if is_dir:
             return store.download_command(dst)
-        tool = ('gsutil cp' if scheme == 'gs' else 'aws s3 cp')
+        if scheme == 'gs':
+            tool, obj = 'gsutil cp', shlex.quote(src)
+        elif scheme == 'az':
+            return (f'mkdir -p $(dirname {q_dst}) && '
+                    f'az storage blob download -c {bucket} '
+                    f'-n {shlex.quote(key)} -f {q_dst}')
+        else:
+            # s3 and r2 share the aws CLI; R2 adds endpoint/creds.
+            aws = (storage_lib.R2Store(bucket)._aws()  # pylint: disable=protected-access
+                   if scheme == 'r2' else 'aws')
+            tool = f'{aws} s3 cp'
+            obj = shlex.quote(f's3://{bucket}/{key}'.rstrip('/'))
         return (f'mkdir -p $(dirname {q_dst}) && '
-                f'{tool} {shlex.quote(src)} {q_dst}')
+                f'{tool} {obj} {q_dst}')
     # local:// — hermetic bucket directory.
     root = storage_lib.LocalStore.bucket_root()
     path = shlex.quote(f'{root}/{bucket}/{key}'.rstrip('/'))

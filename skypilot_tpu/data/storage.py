@@ -1,19 +1,26 @@
 """Storage — named buckets synced or FUSE-mounted onto clusters.
 
 Re-design of reference ``sky/data/storage.py`` (Storage :484, StoreType
-:114, GcsStore :1802) trimmed to the TPU-relevant stores:
+:114, GcsStore :1802, AzureBlobStore :2309, R2Store :3156) on the
+TPU-relevant stores:
 
 - GCS (primary): data/checkpoint buckets for TPU jobs; COPY downloads
   to each host, MOUNT uses gcsfuse. The durable MOUNT bucket is the
   checkpoint/resume substrate for managed spot jobs (reference §5
   checkpoint discussion).
+- S3 / R2: aws CLI (R2 = S3 API against the Cloudflare account
+  endpoint, credentials in ~/.cloudflare as the reference lays them
+  out); MOUNT via goofys (R2: goofys --endpoint).
+- AZURE: blob container via the az CLI; MOUNT via blobfuse2 — the
+  reference's 4-tool FUSE matrix (mounting_utils.py:25-268:
+  goofys/gcsfuse/blobfuse2/rclone) mapped onto this layer's
+  CLI-not-SDK stance.
 - LOCAL (hermetic): a directory under $SKYTPU_DATA_DIR/buckets acts as
   the bucket; MOUNT is a symlink. Lets recovery tests exercise the
   checkpoint-resume path with zero cloud deps.
 
-All cloud interaction goes through the ``gsutil``/``gcloud storage``
-CLI (like the reference's mounting shell, mounting_utils.py), so this
-layer stays dependency-light.
+Every store can ``list_objects()`` (name + size), which is what makes
+cross-store transfer *verified* (data_transfer.verify_transfer).
 """
 from __future__ import annotations
 
@@ -21,7 +28,7 @@ import enum
 import os
 import shutil
 import subprocess
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import log as sky_logging
@@ -32,6 +39,8 @@ logger = sky_logging.init_logger(__name__)
 class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
+    R2 = 'R2'
+    AZURE = 'AZURE'
     LOCAL = 'LOCAL'
 
 
@@ -48,6 +57,16 @@ def run_storage_command(cmd: str) -> None:
     if proc.returncode != 0:
         raise exceptions.StorageError(
             f'Storage command failed ({cmd}): {proc.stderr}')
+
+
+def run_storage_command_output(cmd: str) -> str:
+    """Like run_storage_command but returns stdout (listings)."""
+    proc = subprocess.run(cmd, shell=True, capture_output=True,
+                          text=True, check=False)
+    if proc.returncode != 0:
+        raise exceptions.StorageError(
+            f'Storage command failed ({cmd}): {proc.stderr}')
+    return proc.stdout
 
 
 class AbstractStore:
@@ -76,6 +95,13 @@ class AbstractStore:
 
     def url(self) -> str:
         raise NotImplementedError
+
+    def list_objects(self) -> List[Tuple[str, int]]:
+        """(key, size) for every object — the transfer-verification
+        manifest (data_transfer.verify_transfer compares src/dst)."""
+        raise NotImplementedError
+
+    _run_out = staticmethod(run_storage_command_output)
 
 
 class GcsStore(AbstractStore):
@@ -113,6 +139,28 @@ class GcsStore(AbstractStore):
     def delete(self) -> None:
         self._run(f'gsutil -m rm -r {self.url()} || true')
 
+    def list_objects(self) -> List[Tuple[str, int]]:
+        # `gsutil ls -l -r`: "  <size>  <timestamp>  gs://bucket/key"
+        # with a trailing "TOTAL:" line and "dir/:" section headers.
+        # Listing failures must RAISE (a vacuously-empty manifest
+        # would make transfer verification pass on a broken listing);
+        # only the empty-bucket "matched no objects" case is benign.
+        try:
+            out = self._run_out(f'gsutil ls -l -r {self.url()}/**')
+        except exceptions.StorageError as e:
+            if 'matched no objects' in str(e):
+                return []
+            raise
+        prefix = self.url() + '/'
+        objs = []
+        for line in out.splitlines():
+            # maxsplit=2: keys may contain whitespace.
+            parts = line.split(None, 2)
+            if (len(parts) == 3 and parts[0].isdigit() and
+                    parts[2].startswith(prefix)):
+                objs.append((parts[2][len(prefix):], int(parts[0])))
+        return objs
+
 
 class S3Store(AbstractStore):
     """Amazon S3 bucket via the aws CLI; MOUNT via goofys.
@@ -128,15 +176,17 @@ class S3Store(AbstractStore):
         if self.source is None:
             return
         src = os.path.abspath(os.path.expanduser(self.source))
-        self._run(f'aws s3 mb {self.url()} || true')
+        aws = self._aws()
+        self._run(f'{aws} s3 mb {self.url()} || true')
         if os.path.isdir(src):
-            self._run(f'aws s3 sync --exclude ".git/*" {src} '
+            self._run(f'{aws} s3 sync --exclude ".git/*" {src} '
                       f'{self.url()}')
         else:
-            self._run(f'aws s3 cp {src} {self.url()}/')
+            self._run(f'{aws} s3 cp {src} {self.url()}/')
 
     def download_command(self, dst: str) -> str:
-        return f'mkdir -p {dst} && aws s3 sync {self.url()} {dst}'
+        return (f'mkdir -p {dst} && '
+                f'{self._aws()} s3 sync {self.url()} {dst}')
 
     def mount_command(self, mount_path: str) -> str:
         # goofys, as the reference's S3 MOUNT adapter
@@ -151,7 +201,149 @@ class S3Store(AbstractStore):
                 f'goofys {self.name} {mount_path})')
 
     def delete(self) -> None:
-        self._run(f'aws s3 rb --force {self.url()} || true')
+        self._run(f'{self._aws()} s3 rb --force {self.url()} || true')
+
+    def list_objects(self) -> List[Tuple[str, int]]:
+        # `aws s3 ls --recursive`: "<date> <time> <size> <key>".
+        out = self._run_out(
+            f'{self._aws()} s3 ls --recursive {self.url()}')
+        objs = []
+        for line in out.splitlines():
+            parts = line.split(None, 3)
+            if len(parts) == 4 and parts[2].isdigit():
+                objs.append((parts[3], int(parts[2])))
+        return objs
+
+    def _aws(self) -> str:
+        """The aws CLI invocation (R2 overrides with endpoint/creds)."""
+        return 'aws'
+
+
+class R2Store(S3Store):
+    """Cloudflare R2 bucket — the S3 API against the per-account R2
+    endpoint (reference ``sky/data/storage.py:3156`` R2Store: aws CLI
+    with ``AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials``,
+    profile ``r2``; account id from ``~/.cloudflare/accountid``).
+    MOUNT via goofys ``--endpoint`` (same adapter the reference's
+    mounting matrix assigns to R2)."""
+
+    CREDENTIALS_PATH = '~/.cloudflare/r2.credentials'
+    ACCOUNT_ID_PATH = '~/.cloudflare/accountid'
+
+    @classmethod
+    def endpoint(cls) -> str:
+        account_id = os.environ.get('R2_ACCOUNT_ID')
+        if not account_id:
+            try:
+                with open(os.path.expanduser(cls.ACCOUNT_ID_PATH),
+                          encoding='utf-8') as f:
+                    account_id = f.read().strip()
+            except OSError:
+                raise exceptions.StorageError(
+                    'R2 needs an account id: set R2_ACCOUNT_ID or '
+                    f'write {cls.ACCOUNT_ID_PATH}.') from None
+        return f'https://{account_id}.r2.cloudflarestorage.com'
+
+    def _aws(self) -> str:
+        creds = self.CREDENTIALS_PATH
+        return (f'AWS_SHARED_CREDENTIALS_FILE={creds} aws '
+                f'--endpoint-url {self.endpoint()} --profile r2')
+
+    def url(self) -> str:
+        # The aws CLI still addresses R2 buckets as s3://<name>; the
+        # endpoint selects R2. r2:// is this layer's display scheme.
+        # upload/download_command/delete are inherited from S3Store —
+        # they differ only through the _aws() hook.
+        return f's3://{self.name}'
+
+    def display_url(self) -> str:
+        return f'r2://{self.name}'
+
+    def mount_command(self, mount_path: str) -> str:
+        install = (
+            'which goofys >/dev/null 2>&1 || '
+            '(sudo curl -sSL https://github.com/kahing/goofys/releases/'
+            'latest/download/goofys -o /usr/local/bin/goofys && '
+            'sudo chmod +x /usr/local/bin/goofys)')
+        creds = self.CREDENTIALS_PATH
+        return (f'{install}; mkdir -p {mount_path} && '
+                f'(mountpoint -q {mount_path} || '
+                f'AWS_SHARED_CREDENTIALS_FILE={creds} AWS_PROFILE=r2 '
+                f'goofys --endpoint {self.endpoint()} '
+                f'{self.name} {mount_path})')
+
+    def delete(self) -> None:
+        self._run(f'{self._aws()} s3 rb --force {self.url()} || true')
+
+
+class AzureBlobStore(AbstractStore):
+    """Azure Blob container via the az CLI; MOUNT via blobfuse2.
+
+    Re-design of reference ``sky/data/storage.py:2309``
+    (AzureBlobStore) + ``mounting_utils.py`` blobfuse2 branch, on this
+    layer's CLI stance: storage account from $AZURE_STORAGE_ACCOUNT
+    (key/auth from the az CLI's own login or $AZURE_STORAGE_KEY).
+    """
+
+    @staticmethod
+    def account() -> str:
+        account = os.environ.get('AZURE_STORAGE_ACCOUNT')
+        if not account:
+            raise exceptions.StorageError(
+                'Azure blob storage needs AZURE_STORAGE_ACCOUNT set '
+                '(and az login / AZURE_STORAGE_KEY for auth).')
+        return account
+
+    def url(self) -> str:
+        return f'az://{self.name}'
+
+    def https_url(self) -> str:
+        return (f'https://{self.account()}.blob.core.windows.net/'
+                f'{self.name}')
+
+    def upload(self) -> None:
+        if self.source is None:
+            return
+        src = os.path.abspath(os.path.expanduser(self.source))
+        self._run(f'az storage container create -n {self.name} || true')
+        if os.path.isdir(src):
+            self._run(f'az storage blob upload-batch -d {self.name} '
+                      f'-s {src} --overwrite')
+        else:
+            self._run(f'az storage blob upload -c {self.name} '
+                      f'-f {src} -n {os.path.basename(src)} '
+                      f'--overwrite')
+
+    def download_command(self, dst: str) -> str:
+        return (f'mkdir -p {dst} && az storage blob download-batch '
+                f'-d {dst} -s {self.name}')
+
+    def mount_command(self, mount_path: str) -> str:
+        # blobfuse2 (reference mounting_utils.py blobfuse2 branch);
+        # auth rides the env contract (AZURE_STORAGE_ACCOUNT/KEY).
+        install = (
+            'which blobfuse2 >/dev/null 2>&1 || '
+            '(sudo apt-get update -qq && '
+            'sudo apt-get install -y -qq blobfuse2)')
+        return (f'{install}; mkdir -p {mount_path} && '
+                f'(mountpoint -q {mount_path} || '
+                f'blobfuse2 mount {mount_path} '
+                f'--container-name={self.name} '
+                f'--tmp-path=/tmp/blobfuse2-{self.name})')
+
+    def delete(self) -> None:
+        self._run(f'az storage container delete -n {self.name} || true')
+
+    def list_objects(self) -> List[Tuple[str, int]]:
+        out = self._run_out(
+            f'az storage blob list -c {self.name} --query '
+            f'"[].[name, properties.contentLength]" -o tsv')
+        objs = []
+        for line in out.splitlines():
+            parts = line.rsplit('\t', 1)
+            if len(parts) == 2 and parts[1].strip().isdigit():
+                objs.append((parts[0], int(parts[1])))
+        return objs
 
 
 class LocalStore(AbstractStore):
@@ -196,10 +388,22 @@ class LocalStore(AbstractStore):
     def delete(self) -> None:
         shutil.rmtree(self.path(), ignore_errors=True)
 
+    def list_objects(self) -> List[Tuple[str, int]]:
+        root = self.path()
+        objs = []
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                objs.append((os.path.relpath(full, root),
+                             os.path.getsize(full)))
+        return sorted(objs)
+
 
 _STORE_CLASSES = {
     StoreType.GCS: GcsStore,
     StoreType.S3: S3Store,
+    StoreType.R2: R2Store,
+    StoreType.AZURE: AzureBlobStore,
     StoreType.LOCAL: LocalStore,
 }
 

@@ -291,10 +291,7 @@ def forward_hidden(params: Dict,
         # concrete mesh would type 'pp' as Auto and be rejected.
         from skypilot_tpu.parallel.pipeline import pipeline_layers
 
-        def pipe_layer(lp, h):
-            sx = h.shape[1]
-            pos = jnp.broadcast_to(jnp.arange(sx, dtype=jnp.int32),
-                                   (h.shape[0], sx))
+        def pipe_layer(lp, h, pos):
             # Ring attention's own shard_map cannot nest inside the
             # pp-manual region today (jax 0.9 rejects the backward's
             # residual capture across nested partial-manual regions);
@@ -311,9 +308,12 @@ def forward_hidden(params: Dict,
         while b % m:
             m -= 1
         with jax.sharding.use_abstract_mesh(mesh.abstract_mesh):
+            # Caller-supplied positions are split per microbatch
+            # alongside x, so custom RoPE offsets survive pipelining.
             x = pipeline_layers(remat_layer_fn(pipe_layer, cfg.remat),
                                 params['layers'], x, mesh=mesh,
-                                num_microbatches=m)
+                                num_microbatches=m,
+                                positions=positions)
     else:
 
         def layer(x, lp):

@@ -18,7 +18,13 @@ what the decode step sustains. This engine recycles slots:
 - slot validity via the cache's dmask, so a recycled slot never reads
   its previous occupant's K/V;
 - optional int8 KV cache (``kv_quant=True``): half the decode
-  bandwidth, which at fixed HBM doubles ``batch_size``.
+  bandwidth, which at fixed HBM doubles ``batch_size``;
+- double-buffered dispatch: the next-token vector lives on device, so
+  ``step()`` dispatches decode chunk N+1 before syncing chunk N —
+  host-side work (result attribution, admission grouping, HTTP
+  serving, streaming callbacks) overlaps device decode instead of
+  stalling it. Prefill-sampled first tokens flow into the decode
+  chain on device; their host values sync lazily for emission.
 
 Decode capacity: every engine decode step consumes one shared cache
 slot (the scalar-write-slot design that keeps the step
@@ -59,8 +65,15 @@ class _SlotState:
     request_id: Any
     max_new: int
     generated: List[int]
-    pending_first: Optional[int]   # token sampled from prefill logits
+    # Device ref (array, row) to the prefill-sampled first token;
+    # synced lazily when the slot's first decode chunk is processed,
+    # so admission never blocks the pipeline on a host round-trip.
+    first_ref: Optional[tuple]
     prompt_len: int = 0
+    # Occupancy generation: a decode chunk snapshot only credits its
+    # tokens to a slot whose epoch still matches — a slot freed and
+    # re-admitted while the chunk was in flight discards them.
+    epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -126,6 +139,17 @@ class ServingEngine:
         self._submitted_at: Dict[Any, float] = {}
         self._key = jax.random.PRNGKey(0)
         self._steps_done = 0
+        self._epoch = 0
+        # The in-flight decode chunk (double buffering): step()
+        # dispatches chunk N+1 to the device BEFORE syncing chunk N's
+        # tokens, so host work — result sync, admission grouping, HTTP
+        # handling between ticks — overlaps device decode instead of
+        # serializing with it.
+        self._pending: Optional[Dict[str, Any]] = None
+        # Optional streaming hook: called on the driving thread as
+        # on_token(request_id, [new tokens]) every time a live
+        # request's tokens reach the host (per decode chunk).
+        self.on_token: Optional[Callable[[Any, List[int]], None]] = None
 
         cdt = cfg.compute_dtype
         kv_dtype = jnp.int8 if kv_quant else cdt
@@ -146,15 +170,18 @@ class ServingEngine:
                 kv_shape[:4], jnp.bfloat16)
         self.cache = jax.tree.map(jnp.copy, self._empty)
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def _prefill_insert(params, cache, tokens, lengths, slots,
-                            key, temperature):
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _prefill_insert(params, cache, cur_tokens, tokens, lengths,
+                            slots, key, temperature):
             """Prefill a group of same-bucket prompts and insert each
             into its batch slot — ONE device call per admission group
             (per-request calls would pay a host round-trip each, which
             dominates serving latency on high-dispatch-cost links).
-            tokens: [m, bucket]; slots: [m]. Returns first sampled
-            token per request [m].
+            tokens: [m, bucket]; slots: [m]; cur_tokens: the
+            device-resident [B] next-token vector, updated in place so
+            the following decode chunk can consume the prefill-sampled
+            first tokens WITHOUT a host sync. Returns (cache,
+            cur_tokens, firsts).
             """
             logits, group = inference.prefill(
                 params, tokens, lengths, self.cfg,
@@ -173,7 +200,8 @@ class ServingEngine:
                 }
                 one['base'] = group['base']
                 cache = inference.insert_prefill(cache, one, slots[j])
-            return cache, firsts
+            cur_tokens = cur_tokens.at[slots].set(firsts)
+            return cache, cur_tokens, firsts
 
         self._prefill_insert = _prefill_insert
 
@@ -193,16 +221,19 @@ class ServingEngine:
                                         self.top_k)
                 return (cache, nxt, key), nxt
 
-            (cache, _, _), toks = jax.lax.scan(
+            (cache, last, _), toks = jax.lax.scan(
                 body, (cache, tokens, key), None, length=n)
-            return cache, toks          # toks: [n, B]
+            return cache, toks, last    # toks: [n, B]; last: [B]
 
         self._decode = _decode
-        # Per-slot current token fed into the next decode step, and
-        # per-slot sampling temperature (requests may override the
+        # Per-slot current token fed into the next decode step —
+        # DEVICE-resident: the token chain between chunks (and from
+        # prefill into the first chunk) resolves on device, which is
+        # what lets chunk N+1 dispatch before chunk N's host sync.
+        self._tokens_dev = jnp.zeros((batch_size,), jnp.int32)
+        # Per-slot sampling temperature (requests may override the
         # engine default; temperature is traced, so this never
         # recompiles).
-        self._tokens = np.zeros((batch_size,), np.int32)
         self._temps = np.full((batch_size,), temperature, np.float32)
 
     # ------------------------------------------------------------------
@@ -231,8 +262,8 @@ class ServingEngine:
         while n > 1:
             n //= 2
             self._key, sub = jax.random.split(self._key)
-            self.cache, _ = self._decode(
-                self.params, self.cache, jnp.asarray(self._tokens),
+            self.cache, _, self._tokens_dev = self._decode(
+                self.params, self.cache, self._tokens_dev,
                 jnp.zeros((self.batch_size,), bool), sub,
                 jnp.asarray(self._temps), n=n)
         self.reset()
@@ -240,7 +271,7 @@ class ServingEngine:
     def reset(self) -> None:
         """Drop all cache state (keeps compiled programs). Only valid
         when no requests are in flight."""
-        if self.num_active() or self.queue:
+        if self.num_active() or self.queue or self._pending is not None:
             raise RuntimeError('reset() with requests in flight')
         self.cache = jax.tree.map(jnp.copy, self._empty)
         self._steps_done = 0
@@ -282,8 +313,10 @@ class ServingEngine:
             if state is not None or not self.queue:
                 continue
             if self.queue[0].max_new > self.remaining_slots():
-                if self.num_active() == 0 and not admits:
-                    # Region exhausted, nothing running: fresh cache.
+                if (self.num_active() == 0 and not admits and
+                        self._pending is None):
+                    # Region exhausted, nothing running (and no chunk
+                    # still in flight): fresh cache.
                     self.cache = jax.tree.map(jnp.copy, self._empty)
                     self._steps_done = 0
                 else:
@@ -320,18 +353,20 @@ class ServingEngine:
                  else self.temperature) for _, req in padded
             ], np.float32)
             self._key, sub = jax.random.split(self._key)
-            self.cache, firsts = self._prefill_insert(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(lengths), jnp.asarray(slot_arr), sub,
-                jnp.asarray(temps))
-            firsts = np.asarray(firsts)
+            # Fully async: the prefill-sampled first tokens land in
+            # the device-resident token vector for the next decode
+            # chunk; the host-side values (for emission) sync lazily
+            # when that chunk's results are processed.
+            self.cache, self._tokens_dev, firsts = self._prefill_insert(
+                self.params, self.cache, self._tokens_dev,
+                jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(slot_arr), sub, jnp.asarray(temps))
             for j, (slot_idx, req) in enumerate(items):
-                first = int(firsts[j])
+                self._epoch += 1
                 self.slots[slot_idx] = _SlotState(
                     request_id=req.request_id, max_new=req.max_new,
-                    generated=[], pending_first=first,
-                    prompt_len=len(req.tokens))
-                self._tokens[slot_idx] = first
+                    generated=[], first_ref=(firsts, j),
+                    prompt_len=len(req.tokens), epoch=self._epoch)
                 self._temps[slot_idx] = temps[j]
 
     def _finish(self, slot_idx: int) -> None:
@@ -350,51 +385,107 @@ class ServingEngine:
                  state.generated[-1] == self.eos_id))
 
     def step(self) -> int:
-        """One engine tick: admit, then a chunk of decode steps.
+        """One pipelined engine tick.
 
-        Returns the number of tokens emitted (0 when fully idle).
+        Admit queued requests, DISPATCH decode chunk N+1 (device),
+        then sync and process chunk N. The device is already decoding
+        the next chunk while the host attributes tokens, finishes
+        requests, runs streaming callbacks and serves HTTP — decode
+        never waits on host work (double buffering).
+
+        Results therefore surface one tick after their final decode
+        chunk. Returns the number of tokens emitted this tick.
         """
         self._admit()
-        emitted = 0
-        # The prefill-sampled token is the first emission; it is also
-        # the token fed into the decode step that produces the second.
-        for i, state in enumerate(self.slots):
-            if state is not None and state.pending_first is not None:
-                state.generated.append(state.pending_first)
-                state.pending_first = None
-                emitted += 1
-                if self._is_done(state):
-                    self._finish(i)
+        new_entry = self._dispatch_chunk()
+        prev, self._pending = self._pending, new_entry
+        return self._process_chunk(prev)
+
+    def flush(self) -> int:
+        """Sync and process the in-flight chunk without dispatching a
+        new one (pipeline drain at shutdown / idle)."""
+        prev, self._pending = self._pending, None
+        return self._process_chunk(prev)
+
+    @property
+    def has_pending(self) -> bool:
+        return self._pending is not None
+
+    def _dispatch_chunk(self) -> Optional[Dict[str, Any]]:
         active_list = [s is not None for s in self.slots]
         if not any(active_list):
-            return emitted
-
+            return None
         # Chunk size: bounded by global capacity (admission guarantees
         # every active request fits in the remaining region) and kept
         # to power-of-two tails so at most log2(chunk) programs exist.
         n = min(self.decode_chunk, self.remaining_slots())
+        if n < 1:
+            # Region exhausted while slots are still occupied. Because
+            # slots free one tick AFTER their final chunk (pipelining),
+            # this is the normal end state of a request whose max_new
+            # consumed the region exactly: every active slot has
+            # already decoded its full max_new in flight — admission
+            # guarantees capacity ≥ the largest outstanding need, and
+            # all slots advance together. Dispatch nothing; processing
+            # the pending chunk frees them.
+            if self._pending is None:
+                raise RuntimeError(
+                    'capacity accounting violated: region exhausted '
+                    'with active slots and no chunk in flight')
+            return None
         while n & (n - 1):
             n &= n - 1
-        assert n >= 1, 'capacity accounting violated'
         self._key, sub = jax.random.split(self._key)
-        active = jnp.asarray(active_list)
-        self.cache, toks = self._decode(
-            self.params, self.cache, jnp.asarray(self._tokens),
-            active, sub, jnp.asarray(self._temps), n=n)
+        self.cache, toks, self._tokens_dev = self._decode(
+            self.params, self.cache, self._tokens_dev,
+            jnp.asarray(active_list), sub, jnp.asarray(self._temps),
+            n=n)
         self._steps_done += n
-        toks_host = np.asarray(toks)            # [n, B]
-        self._tokens = toks_host[-1].copy()
-        for i, state in enumerate(self.slots):
-            if state is None:
-                continue
-            for j in range(n):
-                state.generated.append(int(toks_host[j, i]))
+        # Snapshot which occupant each decoded column belongs to: by
+        # the time this chunk is synced the slot may have finished and
+        # been recycled (its column decoded garbage — discarded by the
+        # epoch check).
+        snapshot = [(i, s.epoch) for i, s in enumerate(self.slots)
+                    if s is not None]
+        return {'toks': toks, 'n': n, 'snapshot': snapshot}
+
+    def _process_chunk(self, entry: Optional[Dict[str, Any]]) -> int:
+        if entry is None:
+            return 0
+        toks_host = np.asarray(entry['toks'])   # [n, B] — THE sync
+        emitted = 0
+        firsts_cache: Dict[int, np.ndarray] = {}
+        for slot_idx, epoch in entry['snapshot']:
+            state = self.slots[slot_idx]
+            if state is None or state.epoch != epoch:
+                continue          # freed/recycled mid-flight
+            fresh: List[int] = []
+            if state.first_ref is not None:
+                # Prefill-sampled first token: computed strictly
+                # before this chunk on device, so this sync is free.
+                arr, j = state.first_ref
+                host = firsts_cache.get(id(arr))
+                if host is None:
+                    host = np.asarray(arr)
+                    firsts_cache[id(arr)] = host
+                state.first_ref = None
+                state.generated.append(int(host[j]))
+                fresh.append(int(host[j]))
                 emitted += 1
-                if self._is_done(state):
-                    # Tokens past max_new/EOS within the chunk are
-                    # discarded; the slot frees at the tick boundary.
-                    self._finish(i)
-                    break
+            if not self._is_done(state):
+                for t in range(entry['n']):
+                    tok = int(toks_host[t, slot_idx])
+                    state.generated.append(tok)
+                    fresh.append(tok)
+                    emitted += 1
+                    if self._is_done(state):
+                        # Tokens past max_new/EOS within the chunk
+                        # are discarded.
+                        break
+            if fresh and self.on_token is not None:
+                self.on_token(state.request_id, fresh)
+            if self._is_done(state):
+                self._finish(slot_idx)
         return emitted
 
     def drain_results(self) -> Dict[Any, Result]:
@@ -429,7 +520,7 @@ class ServingEngine:
         for r in requests:
             self.submit(r)
         collected: Dict[Any, Result] = {}
-        while self.queue or self.num_active():
+        while self.queue or self.num_active() or self.has_pending:
             self.step()
             for rid, res in self.drain_results().items():
                 collected[rid] = res

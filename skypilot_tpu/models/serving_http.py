@@ -16,11 +16,20 @@ queue and await an asyncio future; a single engine thread drives
 ``engine.step()`` continuously (the engine is a host-side orchestrator
 over jitted device programs — one driver thread is the device-order
 guarantee) and resolves futures as requests finish.
+
+Streaming: ``{"stream": true}`` in the /generate body switches the
+response to server-sent events — each decode chunk's tokens are
+flushed the moment they reach the host (``engine.on_token``), ending
+with a ``done`` event. The serve load balancer proxies response bodies
+chunk-by-chunk, so first tokens reach the client while the request is
+still decoding (reference analog: sky/serve/load_balancer.py:22
+proxies streaming responses).
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -38,45 +47,146 @@ class EngineServer:
     def __init__(self, engine) -> None:
         self.engine = engine
         self._futures: Dict[Any, asyncio.Future] = {}
+        # rid -> asyncio.Queue of token batches for streaming requests.
+        self._streams: Dict[Any, asyncio.Queue] = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop = threading.Event()
         self._ready = threading.Event()
+        self._dead: Optional[str] = None
         self._thread = threading.Thread(target=self._drive, daemon=True)
 
     # ---------------------------------------------------------- engine
+    def _push_stream(self, rid: Any, item: Any) -> None:
+        q = self._streams.get(rid)
+        if q is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(q.put_nowait, item)
+
     def _drive(self) -> None:
-        self.engine.warmup()
+        try:
+            self.engine.warmup()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('Engine warmup failed')
+            self._die(f'warmup failed: {e}')
+            return
+        self.engine.on_token = self._push_stream
         self._ready.set()
         while not self._stop.is_set():
             with self._lock:
                 busy = bool(self.engine.queue or
                             self.engine.num_active())
             if not busy:
+                if self.engine.has_pending:
+                    # Drain the double-buffered chunk so its requests
+                    # finish even when no new work arrives.
+                    try:
+                        self.engine.flush()
+                    except Exception as e:  # pylint: disable=broad-except
+                        logger.exception('Engine flush failed')
+                        self._die(str(e))
+                        return
+                    self._resolve_finished()
+                    continue
                 time.sleep(0.002)
                 continue
-            self.engine.step()
-            # Drain (not read) so a long-lived replica never
-            # accumulates every past request's tokens.
-            for rid, res in self.engine.drain_results().items():
-                fut = self._futures.pop(rid, None)
-                if fut is not None and self._loop is not None:
-                    self._loop.call_soon_threadsafe(
-                        lambda f=fut, r=res: (not f.done() and
-                                              f.set_result(r)))
+            try:
+                self.engine.step()
+            except Exception as e:  # pylint: disable=broad-except
+                # A dead engine must not look healthy: fail every
+                # in-flight request and flip /health so the load
+                # balancer stops routing here (a silently-wedged
+                # replica hangs every future request instead).
+                logger.exception('Engine step failed')
+                self._die(str(e))
+                return
+            self._resolve_finished()
+
+    def _resolve_finished(self) -> None:
+        # Drain (not read) so a long-lived replica never accumulates
+        # every past request's tokens.
+        for rid, res in self.engine.drain_results().items():
+            self._push_stream(rid, ('done', res))
+            fut = self._futures.pop(rid, None)
+            if fut is not None and self._loop is not None:
+                self._loop.call_soon_threadsafe(
+                    lambda f=fut, r=res: (not f.done() and
+                                          f.set_result(r)))
+
+    def _die(self, reason: str) -> None:
+        self._dead = reason
+        self._ready.set()      # unblock anything waiting on readiness
+        if self._loop is None:
+            return
+
+        def fail_all():
+            err = RuntimeError(f'serving engine died: {reason}')
+            for fut in self._futures.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._futures.clear()
+            for q in self._streams.values():
+                q.put_nowait(('error', reason))
+
+        self._loop.call_soon_threadsafe(fail_all)
 
     # ------------------------------------------------------------ http
-    async def handle_generate(self, request: web.Request
-                              ) -> web.Response:
-        from skypilot_tpu.models.serving_engine import Request
-        body = await request.json()
-        tokens = body['tokens']
-        max_new = int(body.get('max_new', 64))
+    @staticmethod
+    def _parse_generate(body: Any) -> tuple:
+        """Validate a /generate body; raises ValueError with a
+        client-safe message (-> 400). The engine driver thread must
+        never see malformed input: an exception there kills serving
+        for every in-flight request."""
+        if not isinstance(body, dict):
+            raise ValueError('body must be a JSON object')
+        tokens = body.get('tokens')
+        if (not isinstance(tokens, list) or not tokens or
+                not all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in tokens)):
+            raise ValueError("'tokens' must be a non-empty list of "
+                             'integer token ids')
+        max_new = body.get('max_new', 64)
+        if not isinstance(max_new, int) or isinstance(max_new, bool) \
+                or max_new < 1:
+            raise ValueError("'max_new' must be a positive integer")
         temperature = body.get('temperature')
+        if temperature is not None and \
+                not isinstance(temperature, (int, float)):
+            raise ValueError("'temperature' must be a number")
+        return tokens, max_new, temperature, bool(body.get('stream'))
+
+    async def handle_generate(self, request: web.Request
+                              ) -> web.StreamResponse:
+        from skypilot_tpu.models.serving_engine import Request
+        if self._dead is not None:
+            return web.json_response(
+                {'error': f'engine dead: {self._dead}'}, status=503)
+        try:
+            body = await request.json()
+            tokens, max_new, temperature, stream = \
+                self._parse_generate(body)
+            # Static-limit checks are host-side and safe pre-warmup;
+            # rejecting here keeps them 400s even while warming.
+            if len(tokens) > self.engine.max_prompt:
+                raise ValueError(
+                    f'prompt ({len(tokens)}) exceeds max_prompt '
+                    f'({self.engine.max_prompt}).')
+            if max_new > self.engine.decode_capacity():
+                raise ValueError(
+                    f'max_new ({max_new}) exceeds the decode '
+                    f'capacity ({self.engine.decode_capacity()}).')
+        except (ValueError, UnicodeDecodeError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        if not self._ready.is_set():
+            # Requests submitted during warmup would be drained by
+            # warmup's own run() and silently lost.
+            return web.json_response({'status': 'warming'}, status=503)
         with self._lock:
             rid = self._next_id
             self._next_id += 1
+        if stream:
+            return await self._generate_stream(
+                request, rid, tokens, max_new, temperature)
         fut = asyncio.get_event_loop().create_future()
         self._futures[rid] = fut
         try:
@@ -86,13 +196,77 @@ class EngineServer:
         except ValueError as e:
             self._futures.pop(rid, None)
             return web.json_response({'error': str(e)}, status=400)
+        if self._dead is not None:
+            # The engine died between the entry check and our future
+            # registration (both on the loop thread, but the body
+            # await yields): _die's fail_all may already have swept
+            # _futures, so this future would hang forever.
+            self._futures.pop(rid, None)
+            return web.json_response(
+                {'error': f'engine dead: {self._dead}'}, status=503)
         result = await fut
         return web.json_response({
             'tokens': result.tokens,
             'latency_s': result.finished_at - result.submitted_at,
         })
 
+    async def _generate_stream(self, request: web.Request, rid: Any,
+                               tokens, max_new, temperature
+                               ) -> web.StreamResponse:
+        """SSE: one ``data:`` event per decode chunk, then ``done``."""
+        from skypilot_tpu.models.serving_engine import Request
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        try:
+            with self._lock:
+                self.engine.submit(Request(rid, tokens, max_new,
+                                           temperature=temperature))
+        except ValueError as e:
+            self._streams.pop(rid, None)
+            return web.json_response({'error': str(e)}, status=400)
+        if self._dead is not None:
+            # Same race as the non-streaming path: registered after
+            # fail_all swept the stream registry -> would hang.
+            self._streams.pop(rid, None)
+            return web.json_response(
+                {'error': f'engine dead: {self._dead}'}, status=503)
+        resp = web.StreamResponse(headers={
+            'Content-Type': 'text/event-stream',
+            'Cache-Control': 'no-cache',
+            'X-Accel-Buffering': 'no',
+        })
+        await resp.prepare(request)
+        try:
+            while True:
+                item = await q.get()
+                if isinstance(item, tuple) and item[0] == 'done':
+                    res = item[1]
+                    payload = {
+                        'done': True,
+                        'tokens': res.tokens,
+                        'latency_s': (res.finished_at -
+                                      res.submitted_at),
+                    }
+                    await resp.write(
+                        f'data: {json.dumps(payload)}\n\n'.encode())
+                    break
+                if isinstance(item, tuple) and item[0] == 'error':
+                    payload = {'error': item[1]}
+                    await resp.write(
+                        f'data: {json.dumps(payload)}\n\n'.encode())
+                    break
+                await resp.write(
+                    f'data: {json.dumps({"tokens": item})}\n\n'
+                    .encode())
+        finally:
+            self._streams.pop(rid, None)
+        await resp.write_eof()
+        return resp
+
     async def handle_health(self, request: web.Request) -> web.Response:
+        if self._dead is not None:
+            return web.json_response(
+                {'status': 'dead', 'reason': self._dead}, status=503)
         if not self._ready.is_set():
             return web.json_response({'status': 'warming'}, status=503)
         return web.json_response({'status': 'ok'})

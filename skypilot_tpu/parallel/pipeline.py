@@ -40,11 +40,14 @@ def pipeline_mesh(pp: int, devices=None):
         np.asarray(devices[:pp]).reshape(pp), ('pp',))
 
 
-def _stage_apply(layer_fn: Callable, local_params, x):
-    """Apply this stage's layers (leading dim = L/n_stages)."""
+def _stage_apply(layer_fn: Callable, local_params, x, pos=None):
+    """Apply this stage's layers (leading dim = L/n_stages). With
+    ``pos``, each layer also receives the microbatch's positions."""
 
     def body(h, lp):
-        return layer_fn(lp, h), None
+        if pos is None:
+            return layer_fn(lp, h), None
+        return layer_fn(lp, h, pos), None
 
     out, _ = lax.scan(body, x, local_params)
     return out
@@ -131,7 +134,8 @@ def pipeline_layers(layer_fn: Callable,
                     *,
                     mesh,
                     num_microbatches: int,
-                    axis_name: str = 'pp') -> jax.Array:
+                    axis_name: str = 'pp',
+                    positions=None) -> jax.Array:
     """GPipe over ``axis_name`` with every OTHER mesh axis automatic.
 
     The flagship-integration variant of :func:`pipeline_apply`: the
@@ -147,6 +151,13 @@ def pipeline_layers(layer_fn: Callable,
     ``stacked_params`` must be sharded P('pp', ...) on the layer dim
     (see llama.param_specs(pp=True)); layer count divisible by the
     stage count, batch by ``num_microbatches``.
+
+    ``positions``: optional per-token aux input [batch, ...] split
+    into microbatches alongside ``x``; when given, ``layer_fn`` is
+    called as ``layer_fn(lp, h, pos)`` with the positions of the
+    microbatch the stage is processing (stage s at tick t holds
+    microbatch t - s, so each stage indexes the replicated
+    microbatched array directly — no extra ppermute traffic).
     """
     n_stages = mesh.shape[axis_name]
     b = x.shape[0]
@@ -154,8 +165,12 @@ def pipeline_layers(layer_fn: Callable,
     mb = b // num_microbatches
     m = num_microbatches
     xm = x.reshape((m, mb) + x.shape[1:])
+    pm = None
+    if positions is not None:
+        assert positions.shape[0] == b, (positions.shape, b)
+        pm = positions.reshape((m, mb) + positions.shape[1:])
 
-    def per_stage(local_params, xm):
+    def per_stage(local_params, xm, pm):
         stage = lax.axis_index(axis_name)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         varying_zero = (stage * 0).astype(x.dtype)
@@ -166,7 +181,11 @@ def pipeline_layers(layer_fn: Callable,
             state, outputs = carry
             feed_idx = jnp.clip(t, 0, m - 1)
             inp = jnp.where(stage == 0, xm[feed_idx], state)
-            out = _stage_apply(layer_fn, local_params, inp)
+            # Microbatch index this stage is processing at tick t
+            # (clip: out-of-range ticks compute discarded garbage).
+            pos = (None if pm is None else
+                   pm[jnp.clip(t - stage, 0, m - 1)])
+            out = _stage_apply(layer_fn, local_params, inp, pos)
             out_idx = t - (n_stages - 1)
             write = ((stage == n_stages - 1) & (out_idx >= 0) &
                      (out_idx < m))
@@ -189,10 +208,18 @@ def pipeline_layers(layer_fn: Callable,
         from jax.experimental.shard_map import shard_map
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    fn = shard_map(per_stage,
-                   mesh=mesh,
-                   in_specs=(param_specs, P()),
-                   out_specs=P(),
-                   axis_names={axis_name})
-    out = fn(stacked_params, xm)
+    if pm is None:
+        fn = shard_map(lambda lp, xm_: per_stage(lp, xm_, None),
+                       mesh=mesh,
+                       in_specs=(param_specs, P()),
+                       out_specs=P(),
+                       axis_names={axis_name})
+        out = fn(stacked_params, xm)
+    else:
+        fn = shard_map(per_stage,
+                       mesh=mesh,
+                       in_specs=(param_specs, P(), P()),
+                       out_specs=P(),
+                       axis_names={axis_name})
+        out = fn(stacked_params, xm, pm)
     return out.reshape((b,) + x.shape[1:])

@@ -38,6 +38,12 @@ class FixedReplicaAutoscaler:
     def record_request(self, now: Optional[float] = None) -> None:
         pass
 
+    def to_state(self) -> dict:
+        return {}
+
+    def restore(self, state: dict) -> None:
+        pass
+
     def initial(self) -> ScalingDecision:
         return initial_decision(self.spec)
 
@@ -96,6 +102,34 @@ class RequestRateAutoscaler:
 
     def initial(self) -> ScalingDecision:
         return initial_decision(self.spec)
+
+    # -------------------------------------------------- durability
+    def to_state(self) -> dict:
+        """Snapshot for serve_state persistence: the QPS window and
+        hysteresis clocks survive a controller restart (reference
+        sky/serve/autoscalers.py:431 persists LB request timestamps),
+        so a restart under load does not forget demand and
+        spuriously downscale."""
+        return {
+            'timestamps': list(self._timestamps),
+            'target': self._target,
+            'desired': self._desired,
+            'desire_since': self._desire_since,
+        }
+
+    def restore(self, state: dict) -> None:
+        now = time.time()
+        cutoff = now - _QPS_WINDOW_SECONDS
+        self._timestamps = deque(
+            t for t in state.get('timestamps', ()) if t >= cutoff)
+        self._target = max(self.spec.min_replicas,
+                           int(state.get('target',
+                                         self.spec.min_replicas)))
+        if self.spec.max_replicas is not None:
+            # A rolling update may have lowered max_replicas.
+            self._target = min(self._target, self.spec.max_replicas)
+        self._desired = state.get('desired')
+        self._desire_since = state.get('desire_since')
 
     # ------------------------------------------------------------------
     def record_request(self, now: Optional[float] = None) -> None:

@@ -16,6 +16,7 @@ import asyncio
 import json
 import os
 import traceback
+from typing import Optional
 
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import serve_state
@@ -41,14 +42,37 @@ class ServeController:
         self._version = serve_state.get_current_version(service_name)
         self.spec = ServiceSpec.from_yaml_config(record['spec'])
         self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        # A restarted controller resumes the persisted QPS window +
+        # hysteresis clocks instead of starting cold (which would
+        # forget demand and downscale a loaded service).
+        saved = serve_state.load_autoscaler_state(service_name)
+        if saved:
+            self.autoscaler.restore(saved)
         self.replica_manager = ReplicaManager(service_name, self.spec,
-                                              record['task'])
+                                              record['task'],
+                                              drain_fn=self._drain_url)
         self.load_balancer = LoadBalancer(
             lb_port,
             policy=self.spec.load_balancing_policy,
             on_request=self.autoscaler.record_request)
         self.loop_gap = loop_gap
         self._shutdown = asyncio.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def _drain_url(self, url: str) -> None:
+        """Blocking LB drain of a replica URL; called from replica
+        teardown threads so in-flight requests finish before the
+        cluster goes down (rolling update / downscale)."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.load_balancer.drain(url), loop)
+            fut.result(timeout=90)
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('Drain of %s did not complete:\n%s', url,
+                           traceback.format_exc())
 
     def _refresh_version(self) -> None:
         """Pick up a rolling update: when current_version moves, reload
@@ -66,6 +90,11 @@ class ServeController:
         self.spec = ServiceSpec.from_yaml_config(record['spec'])
         self.replica_manager.spec = self.spec
         self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        # Demand does not reset because the policy changed: carry the
+        # persisted QPS window into the new version's autoscaler.
+        saved = serve_state.load_autoscaler_state(self.name)
+        if saved:
+            self.autoscaler.restore(saved)
         self.load_balancer.on_request = self.autoscaler.record_request
 
     # ------------------------------------------------------------------
@@ -100,6 +129,8 @@ class ServeController:
                     r['status'] is ReplicaStatus.READY)
                 decision = self.autoscaler.evaluate(
                     len(pool), num_ready_spot=num_ready_spot)
+                serve_state.save_autoscaler_state(
+                    self.name, self.autoscaler.to_state())
                 await asyncio.to_thread(self.replica_manager.reconcile,
                                         decision)
                 urls = self.replica_manager.ready_urls()
@@ -117,6 +148,7 @@ class ServeController:
                 pass
 
     async def run(self) -> None:
+        self._loop = asyncio.get_running_loop()
         await self.load_balancer.start()
         # Publish the actually-bound port (the row holds the preferred
         # port, possibly 0 = auto; `up` polls for the real one).

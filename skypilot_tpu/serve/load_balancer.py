@@ -5,13 +5,25 @@ Re-design of reference ``sky/serve/load_balancer.py:22`` +
 LeastLoadPolicy). Runs inside the service controller process; replica
 URLs are pushed in by the replica manager, and every proxied request
 is reported to the autoscaler as load signal.
+
+Proxying is streaming end to end: response bodies are forwarded
+chunk-by-chunk (SSE token streams from the engine front end reach the
+client as they are produced, like the reference LB's streaming
+passthrough), upstream connections come from one pooled
+``ClientSession`` (per-request sessions pay TCP+TLS setup on every
+proxied call), and a request whose replica cannot be reached — the
+connection failed, so the replica never saw it — is transparently
+retried on a different ready replica. Replica removal (rolling
+update, downscale) can ``drain()`` a URL: stop picking it, then wait
+for its in-flight requests to finish before teardown.
 """
 from __future__ import annotations
 
 import asyncio
 import itertools
 import threading
-from typing import Callable, Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
@@ -32,7 +44,7 @@ class LoadBalancingPolicy:
     def set_urls(self, urls: List[str]) -> None:
         raise NotImplementedError
 
-    def pick(self) -> Optional[str]:
+    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         raise NotImplementedError
 
     def done(self, url: str) -> None:
@@ -50,10 +62,14 @@ class RoundRobinPolicy(LoadBalancingPolicy):
             self._urls = list(urls)
             self._it = itertools.cycle(self._urls)
 
-    def pick(self) -> Optional[str]:
+    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         if not self._urls:
             return None
-        return next(self._it)
+        for _ in range(len(self._urls)):
+            url = next(self._it)
+            if not exclude or url not in exclude:
+                return url
+        return None
 
 
 class LeastLoadPolicy(LoadBalancingPolicy):
@@ -71,11 +87,13 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                 if url not in urls:
                     del self._load[url]
 
-    def pick(self) -> Optional[str]:
+    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         with self._lock:
-            if not self._load:
+            candidates = [u for u in self._load
+                          if not exclude or u not in exclude]
+            if not candidates:
                 return None
-            url = min(self._load, key=self._load.get)
+            url = min(candidates, key=self._load.get)
             self._load[url] += 1
             return url
 
@@ -94,6 +112,8 @@ POLICIES = {
 class LoadBalancer:
     """aiohttp app proxying every request to a picked replica."""
 
+    MAX_ATTEMPTS = 3
+
     def __init__(self, port: int, policy: str = 'least_load',
                  on_request: Optional[Callable[[], None]] = None) -> None:
         # port 0 = let the OS pick; the actual port is in `bound_port`
@@ -103,18 +123,87 @@ class LoadBalancer:
         self.policy: LoadBalancingPolicy = POLICIES[policy]()
         self.on_request = on_request
         self._runner: Optional[web.AppRunner] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        # Per-replica in-flight request counts (for drain()); kept
+        # apart from the policy, which is free to track its own load.
+        self._inflight: Dict[str, int] = {}
+        self._draining: Set[str] = set()
 
     def set_replica_urls(self, urls: List[str]) -> None:
         self.policy.set_urls(urls)
+        self._draining &= set(urls)
+
+    def inflight(self, url: str) -> int:
+        return self._inflight.get(url, 0)
+
+    async def drain(self, url: str, timeout: float = 60.0) -> bool:
+        """Stop routing new requests to ``url`` and wait for its
+        in-flight ones to finish (rolling update / downscale: tear the
+        replica down only after this returns). True = drained."""
+        self._draining.add(url)
+        deadline = time.time() + timeout
+        while self._inflight.get(url, 0) > 0:
+            if time.time() > deadline:
+                return False
+            await asyncio.sleep(0.05)
+        return True
 
     # ------------------------------------------------------------------
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         if self.on_request is not None:
             self.on_request()
-        url = self.policy.pick()
-        if url is None:
+        body = await request.read()
+        tried: Set[str] = set()
+        last_err: Optional[BaseException] = None
+        for _ in range(self.MAX_ATTEMPTS):
+            url = self.policy.pick(exclude=tried | self._draining)
+            if url is None:
+                break
+            tried.add(url)
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+            try:
+                return await self._proxy_once(request, url, body)
+            except aiohttp.ClientConnectorError as e:
+                # TCP connect failed: the replica NEVER received the
+                # request — safe to retry on another replica for any
+                # method.
+                logger.warning('Replica %s unreachable (%s); retrying '
+                               'on another replica', url, e)
+                last_err = e
+            except aiohttp.ClientConnectionError as e:
+                # Connection dropped after the request was sent (e.g.
+                # ServerDisconnectedError): the replica may have
+                # started executing it. Retrying would double-execute
+                # non-idempotent work, so only safe methods retry.
+                if request.method not in ('GET', 'HEAD', 'OPTIONS'):
+                    logger.warning('Replica %s dropped mid-request '
+                                   '(%s); not retrying %s', url, e,
+                                   request.method)
+                    last_err = e
+                    break
+                logger.warning('Replica %s dropped %s (%s); retrying',
+                               url, request.method, e)
+                last_err = e
+            except _MidStreamError as e:
+                # Bytes already reached the client: cannot retry.
+                logger.warning('Replica %s died mid-response: %s', url,
+                               e.cause)
+                return e.response
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                logger.warning('Proxy to %s failed: %s', url, e)
+                last_err = e
+            finally:
+                self.policy.done(url)
+                self._inflight[url] = max(
+                    0, self._inflight.get(url, 1) - 1)
+        if last_err is None:
             return web.Response(status=503,
                                 text='No ready replicas.\n')
+        return web.Response(status=502,
+                            text=f'Replica unreachable: {last_err}\n')
+
+    async def _proxy_once(self, request: web.Request, url: str,
+                          body: bytes) -> web.StreamResponse:
         target = url.rstrip('/') + '/' + request.rel_url.path.lstrip('/')
         if request.rel_url.query_string:
             target += '?' + request.rel_url.query_string
@@ -122,28 +211,37 @@ class LoadBalancer:
             k: v for k, v in request.headers.items()
             if k.lower() not in _HOP_HEADERS
         }
-        body = await request.read()
-        try:
-            timeout = aiohttp.ClientTimeout(total=300)
-            async with aiohttp.ClientSession(timeout=timeout) as session:
-                async with session.request(request.method, target,
-                                           headers=headers,
-                                           data=body) as resp:
-                    payload = await resp.read()
-                    out_headers = {
-                        k: v for k, v in resp.headers.items()
-                        if k.lower() not in _HOP_HEADERS and
-                        k.lower() != 'content-length'
-                    }
-                    return web.Response(status=resp.status,
-                                        body=payload,
-                                        headers=out_headers)
-        except aiohttp.ClientError as e:
-            logger.warning('Proxy to %s failed: %s', url, e)
-            return web.Response(status=502,
-                                text=f'Replica unreachable: {e}\n')
-        finally:
-            self.policy.done(url)
+        assert self._session is not None, 'start() not called'
+        async with self._session.request(request.method, target,
+                                         headers=headers,
+                                         data=body) as resp:
+            out_headers = {
+                k: v for k, v in resp.headers.items()
+                if k.lower() not in _HOP_HEADERS and
+                k.lower() != 'content-length'
+            }
+            out = web.StreamResponse(status=resp.status,
+                                     headers=out_headers)
+            started = False
+            try:
+                # Chunk-by-chunk passthrough: an SSE token stream (or
+                # any long body) reaches the client as the replica
+                # produces it, instead of buffering end-to-end.
+                async for chunk in resp.content.iter_chunked(1 << 16):
+                    if not started:
+                        await out.prepare(request)
+                        started = True
+                    await out.write(chunk)
+                if not started:
+                    await out.prepare(request)
+                await out.write_eof()
+                return out
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                if started:
+                    # Headers/body already sent; surface the abort to
+                    # the wrapper as non-retryable.
+                    raise _MidStreamError(out, e) from e
+                raise
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -151,6 +249,17 @@ class LoadBalancer:
         app.router.add_route('*', '/{tail:.*}', self._proxy)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
+        # One pooled upstream session: per-request sessions pay
+        # connection setup on every proxied call (18% stack tax in the
+        # r03 full-stack bench). No total timeout — long-lived SSE
+        # streams are legitimate; sock_read bounds replica *silence*
+        # instead, so a wedged replica still gets cut.
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10,
+                                          sock_read=300),
+            connector=aiohttp.TCPConnector(limit=0,
+                                           limit_per_host=0,
+                                           keepalive_timeout=60))
         site = web.TCPSite(self._runner, '0.0.0.0', self.port)
         await site.start()
         sockets = site._server.sockets  # pylint: disable=protected-access
@@ -158,5 +267,18 @@ class LoadBalancer:
         logger.info('Load balancer listening on :%d', self.bound_port)
 
     async def stop(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
         if self._runner is not None:
             await self._runner.cleanup()
+
+
+class _MidStreamError(Exception):
+    """Upstream died after response bytes reached the client."""
+
+    def __init__(self, response: web.StreamResponse,
+                 cause: BaseException) -> None:
+        super().__init__(str(cause))
+        self.response = response
+        self.cause = cause

@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 import traceback
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import requests
 
@@ -52,10 +52,17 @@ _FAILED_ROW_TTL_SECONDS = 1800.0
 class ReplicaManager:
 
     def __init__(self, service_name: str, spec: ServiceSpec,
-                 task_config: dict) -> None:
+                 task_config: dict,
+                 drain_fn: Optional[Callable[[str], None]] = None
+                 ) -> None:
         self.service_name = service_name
         self.spec = spec
         self.task_config = task_config
+        # Blocking callable draining a replica URL at the LB before a
+        # VOLUNTARY teardown (downscale / rolling update); involuntary
+        # paths (preemption, failed probes) skip it — the replica is
+        # already gone.
+        self.drain_fn = drain_fn
         self._launch_threads: Dict[int, threading.Thread] = {}
         self._lock = threading.Lock()
         self._failed_probes: Dict[int, int] = {}
@@ -149,12 +156,26 @@ class ReplicaManager:
 
     # ------------------------------------------------------------------
     def scale_down(self, replica_ids: List[int]) -> None:
+        records = {
+            r['replica_id']: r
+            for r in serve_state.get_replicas(self.service_name)
+        }
         for replica_id in replica_ids:
             serve_state.set_replica_status(self.service_name, replica_id,
                                            ReplicaStatus.SHUTTING_DOWN)
-            thread = threading.Thread(target=self._terminate_replica,
-                                      args=(replica_id,), daemon=True)
-            thread.start()
+            url = (records.get(replica_id) or {}).get('url')
+
+            def work(rid=replica_id, u=url):
+                if u and self.drain_fn is not None:
+                    try:
+                        self.drain_fn(u)
+                    except Exception:  # pylint: disable=broad-except
+                        logger.warning(
+                            'LB drain of %s failed:\n%s', u,
+                            traceback.format_exc())
+                self._terminate_replica(rid)
+
+            threading.Thread(target=work, daemon=True).start()
 
     def _terminate_replica(
             self, replica_id: int,

@@ -72,6 +72,17 @@ def _conn() -> sqlite3.Connection:
             is_spot INTEGER DEFAULT 0,
             PRIMARY KEY (service_name, replica_id)
         )""")
+    # Autoscaler durability (reference sky/serve/autoscalers.py:431
+    # couples LB request timestamps into persisted state): the QPS
+    # window + hysteresis clocks survive a controller restart, so a
+    # restart under load does not forget demand and spuriously
+    # downscale.
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS autoscaler_state (
+            service_name TEXT PRIMARY KEY,
+            state_json TEXT,
+            updated_at REAL
+        )""")
     # Migrate DBs created before these columns existed (CREATE TABLE IF
     # NOT EXISTS is a no-op on an old schema).
     for table, column, decl in (
@@ -213,6 +224,25 @@ def remove_service(name: str) -> None:
                      (name,))
         conn.execute('DELETE FROM version_specs WHERE service_name = ?',
                      (name,))
+        conn.execute(
+            'DELETE FROM autoscaler_state WHERE service_name = ?',
+            (name,))
+
+
+def save_autoscaler_state(name: str, state: Dict[str, Any]) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO autoscaler_state '
+            '(service_name, state_json, updated_at) VALUES (?, ?, ?)',
+            (name, json.dumps(state), time.time()))
+
+
+def load_autoscaler_state(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT state_json FROM autoscaler_state '
+            'WHERE service_name = ?', (name,)).fetchone()
+    return json.loads(row['state_json']) if row else None
 
 
 # ------------------------------------------------------------- replicas

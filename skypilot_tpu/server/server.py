@@ -169,7 +169,7 @@ async def handle_cancel(request: web.Request) -> web.Response:
 
 
 async def handle_list(request: web.Request) -> web.Response:
-    return web.json_response(requests_db.list_requests())
+    return web.json_response({'requests': requests_db.list_requests()})
 
 
 async def handle_health(request: web.Request) -> web.Response:

@@ -286,7 +286,25 @@ class DockerCommandRunner(CommandRunner):
 
     def bootstrap(self, log_path: str = '/dev/null') -> None:
         """Bring up the task container on this host (idempotent)."""
+        import os
+        import tempfile
+
         from skypilot_tpu.utils import docker_utils
+        login = self.docker_config.get('login')
+        if login and login.get('password'):
+            # Ship the registry password as a 0600 file via rsync so
+            # it never appears on a remote command line (`ps`) or in
+            # docker_setup-*.log; bootstrap_command reads it with
+            # --password-stdin and removes it.
+            fd, local = tempfile.mkstemp(prefix='skytpu-docker-cred-')
+            try:
+                os.fchmod(fd, 0o600)
+                with os.fdopen(fd, 'w') as f:
+                    f.write(login['password'])
+                self.inner.rsync(local, f'~/{docker_utils.CRED_FILE}',
+                                 up=True, log_path=log_path)
+            finally:
+                os.unlink(local)
         self.inner.run(docker_utils.bootstrap_command(self.docker_config),
                        log_path=log_path, check=True)
 

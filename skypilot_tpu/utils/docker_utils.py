@@ -32,6 +32,12 @@ DOCKER_SERVER_ENV = 'SKYTPU_DOCKER_SERVER'
 
 _IMAGE_PREFIX = 'docker:'
 
+# Remote path the registry password is shipped to (rsync of a 0600
+# local temp file — see DockerCommandRunner.bootstrap). The password
+# must never ride a shell command line: remote commands are visible in
+# `ps` on the host and are echoed into docker_setup-*.log.
+CRED_FILE = '.skytpu_docker_cred'
+
 
 def extract_image(image_id: Optional[str]) -> Optional[str]:
     """The container image named by ``image_id``, or None.
@@ -103,10 +109,12 @@ def bootstrap_command(config: Dict[str, Any]) -> str:
         # passed as '' (docker treats '' as a registry host).
         server = (' ' + shlex.quote(login['server'])
                   if login.get('server') else '')
+        # The password comes from CRED_FILE, pre-shipped by
+        # DockerCommandRunner.bootstrap() via rsync with 0600 perms —
+        # only the (non-secret) username/server appear in the command.
         lines.append(
-            f'echo {shlex.quote(login["password"])} | '
             f'docker login --username {shlex.quote(login["username"])} '
-            f'--password-stdin{server} &&')
+            f'--password-stdin{server} < "$HOME/{CRED_FILE}" &&')
     # run stays inside the && chain: a failed pull (revoked creds,
     # registry outage) must fail the bootstrap, not silently fall back
     # to a stale cached image.
@@ -118,6 +126,13 @@ def bootstrap_command(config: Dict[str, Any]) -> str:
         '-v "$HOME":"$HOME":rslave -e "HOME=$HOME" -w "$HOME" '
         f'{shlex.quote(image)} tail -f /dev/null; }}',
         'fi',
+        # The shipped credential must not outlive the bootstrap,
+        # whichever branch ran — but the cleanup must not mask the
+        # bootstrap's exit status (a failed login/pull has to fail the
+        # caller's check=True).
+        'rc=$?',
+        f'rm -f "$HOME/{CRED_FILE}" 2>/dev/null || true',
+        'exit $rc',
     ])
     return '\n'.join(lines)
 

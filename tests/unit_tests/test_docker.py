@@ -162,7 +162,16 @@ def test_bootstrap_command_shape():
         docker_utils.make_docker_config(
             'img:v1', {'SKYTPU_DOCKER_USERNAME': 'u',
                        'SKYTPU_DOCKER_PASSWORD': 'p'}, 'c'))
-    assert '--password-stdin &&' in cmd3 and "''" not in cmd3
+    assert f'--password-stdin < "$HOME/{docker_utils.CRED_FILE}"' \
+        in cmd3
+    assert "''" not in cmd3
+    # The password itself must NEVER ride the command line (visible in
+    # `ps` and docker_setup-*.log); it ships via rsync of a 0600 file.
+    for c in (cmd, cmd3):
+        assert 'p' not in c.split() and "echo 'p'" not in c
+        assert docker_utils.CRED_FILE in c
+    # Cleanup must not mask a failed login/pull from check=True.
+    assert cmd.rstrip().endswith('exit $rc')
 
 
 def test_docker_runner_wraps_and_shares_home(tmp_path, stub_docker):

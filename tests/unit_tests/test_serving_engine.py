@@ -167,3 +167,22 @@ def test_engine_rejections():
     with pytest.raises(ValueError, match='must exceed max_prompt'):
         ServingEngine(params, cfg, batch_size=1, max_prompt=64,
                       max_seq=64)
+
+
+def test_max_new_equal_to_decode_capacity():
+    """A request whose max_new consumes the decode region exactly must
+    finish cleanly: with pipelined dispatch the slot frees one tick
+    AFTER its final chunk, so the engine briefly sees remaining==0
+    with an occupied slot (regression: 'capacity accounting violated'
+    assert killed the engine here)."""
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=64, decode_chunk=4)
+    cap = engine.decode_capacity()
+    p = _prompt(cfg, 5, 3)
+    results = engine.run([Request('full', p, max_new=cap)])
+    assert len(results['full'].tokens) == cap
+    assert results['full'].tokens == _solo_generate(params, cfg, p, cap)
+    # Engine remains serviceable after the region reset.
+    again = engine.run([Request('after', p, max_new=4)])
+    assert again['after'].tokens == _solo_generate(params, cfg, p, 4)

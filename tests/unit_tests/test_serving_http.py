@@ -92,3 +92,70 @@ def test_oversized_request_rejected(stack):
 
     status, body = asyncio.run(scenario())
     assert status == 400 and 'exceeds max_prompt' in body['error']
+
+
+def test_streaming_generate_through_lb(stack):
+    """stream:true yields SSE token chunks whose concatenation equals
+    the non-streaming result (greedy decode), proxied through the LB's
+    chunked passthrough."""
+    cfg, params, server = stack
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{port}'])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                try:
+                    async with session.get(base + '/health') as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError('engine never became ready')
+
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': prompt, 'max_new': 6}) as r:
+                oracle = (await r.json())['tokens']
+
+            import json as _json
+            events = []
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': prompt, 'max_new': 6,
+                          'stream': True}) as r:
+                assert r.status == 200
+                assert 'text/event-stream' in r.headers['Content-Type']
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if line.startswith('data: '):
+                        events.append(_json.loads(line[len('data: '):]))
+            assert events and events[-1].get('done')
+            streamed = [t for e in events[:-1] for t in e['tokens']]
+            assert streamed == oracle == events[-1]['tokens']
+
+            # Malformed bodies are 400s, not driver-thread poison.
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': ['x', 'y'], 'max_new': 2}) as r:
+                assert r.status == 400
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': [1, 2], 'max_new': 0}) as r:
+                assert r.status == 400
+            # Engine still alive after the rejects.
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': prompt, 'max_new': 2}) as r:
+                assert r.status == 200
+        await lb.stop()
+        await runner.cleanup()
+
+    asyncio.run(scenario())

@@ -158,3 +158,119 @@ def test_file_mounts_from_bucket_url_end_to_end(isolated_state):
         for p in paths)
     assert 'from-bucket' in out
     core.down('bkt-c')
+
+
+def test_r2_store_commands(monkeypatch):
+    from skypilot_tpu.data.storage import R2Store
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+    r = R2Store('mybkt')
+    assert r.endpoint() == 'https://acct123.r2.cloudflarestorage.com'
+    assert r.url() == 's3://mybkt'            # aws CLI address
+    assert r.display_url() == 'r2://mybkt'
+    d = r.download_command('/dst')
+    assert '--endpoint-url https://acct123.r2.cloudflarestorage.com' in d
+    assert '--profile r2' in d
+    assert 'AWS_SHARED_CREDENTIALS_FILE=~/.cloudflare/r2.credentials' in d
+    m = r.mount_command('/mnt/r2')
+    assert 'goofys' in m and '--endpoint' in m and 'mybkt /mnt/r2' in m
+
+
+def test_r2_requires_account_id(monkeypatch, tmp_path):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.data.storage import R2Store
+    monkeypatch.delenv('R2_ACCOUNT_ID', raising=False)
+    monkeypatch.setattr(R2Store, 'ACCOUNT_ID_PATH',
+                        str(tmp_path / 'missing'))
+    with pytest.raises(exceptions.StorageError):
+        R2Store.endpoint()
+
+
+def test_azure_store_commands(monkeypatch):
+    from skypilot_tpu.data.storage import AzureBlobStore
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'myacct')
+    a = AzureBlobStore('ctr')
+    assert a.url() == 'az://ctr'
+    assert a.https_url() == 'https://myacct.blob.core.windows.net/ctr'
+    d = a.download_command('/dst')
+    assert 'az storage blob download-batch -d /dst -s ctr' in d
+    m = a.mount_command('/mnt/az')
+    assert 'blobfuse2' in m and '--container-name=ctr' in m
+
+
+def test_store_listing_parsers(monkeypatch):
+    """Each cloud store's list_objects parses its CLI's real output
+    shape (canned output; no cloud)."""
+    from skypilot_tpu.data import storage as st
+
+    gcs_out = (
+        '       123  2025-01-01T00:00:00Z  gs://bkt/a.txt\n'
+        '      4567  2025-01-01T00:00:00Z  gs://bkt/dir/b.bin\n'
+        'TOTAL: 2 objects, 4690 bytes (4.58 KiB)\n')
+    monkeypatch.setattr(st.GcsStore, '_run_out',
+                        staticmethod(lambda cmd: gcs_out))
+    assert st.GcsStore('bkt').list_objects() == [
+        ('a.txt', 123), ('dir/b.bin', 4567)]
+
+    s3_out = ('2025-01-01 00:00:00        123 a.txt\n'
+              '2025-01-01 00:00:01       4567 dir/b with space.bin\n')
+    monkeypatch.setattr(st.S3Store, '_run_out',
+                        staticmethod(lambda cmd: s3_out))
+    assert st.S3Store('bkt').list_objects() == [
+        ('a.txt', 123), ('dir/b with space.bin', 4567)]
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct')
+    assert st.R2Store('bkt').list_objects() == [
+        ('a.txt', 123), ('dir/b with space.bin', 4567)]
+
+    az_out = 'a.txt\t123\ndir/b.bin\t4567\n'
+    monkeypatch.setattr(st.AzureBlobStore, '_run_out',
+                        staticmethod(lambda cmd: az_out))
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'acct')
+    assert st.AzureBlobStore('ctr').list_objects() == [
+        ('a.txt', 123), ('dir/b.bin', 4567)]
+
+
+def test_verified_transfer_roundtrip(tmp_path, monkeypatch):
+    """LOCAL->LOCAL transfer with manifest verification; corruption of
+    the destination is caught."""
+    monkeypatch.setenv('SKYTPU_DATA_DIR', str(tmp_path))
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.data import data_transfer
+    from skypilot_tpu.data.storage import LocalStore
+
+    srcdir = tmp_path / 'data'
+    (srcdir / 'sub').mkdir(parents=True)
+    (srcdir / 'a.txt').write_text('hello')
+    (srcdir / 'sub' / 'b.bin').write_bytes(b'x' * 1024)
+    src = LocalStore('srcb', source=str(srcdir))
+    src.upload()
+    dst = LocalStore('dstb')
+    dst.upload()  # creates empty bucket dir
+
+    data_transfer.transfer(src, dst)   # verify=True default
+    assert dict(dst.list_objects()) == dict(src.list_objects())
+
+    # Corrupt one object in dst: verification must fail.
+    bad = tmp_path / 'buckets' / 'dstb' / 'sub' / 'b.bin'
+    bad.write_bytes(b'x' * 100)
+    with pytest.raises(exceptions.StorageError, match='verification'):
+        data_transfer.verify_transfer(src, dst)
+
+    # A missing object also fails.
+    bad.unlink()
+    with pytest.raises(exceptions.StorageError, match='verification'):
+        data_transfer.verify_transfer(src, dst)
+
+
+def test_cloud_stores_r2_az_urls(monkeypatch):
+    from skypilot_tpu.data import cloud_stores
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+    assert cloud_stores.is_cloud_url('r2://b/k')
+    assert cloud_stores.is_cloud_url('az://b/k')
+    cmd = cloud_stores.download_command('r2://bkt/prefix/', '/data')
+    assert 's3://bkt/prefix /data' in cmd and '--endpoint-url' in cmd
+    cmd = cloud_stores.download_command('r2://bkt/f.txt', '/d/f.txt')
+    assert 's3 cp' in cmd and '--profile r2' in cmd
+    cmd = cloud_stores.download_command('az://ctr/prefix/', '/data')
+    assert 'download-batch' in cmd
+    cmd = cloud_stores.download_command('az://ctr/f.txt', '/d/f.txt')
+    assert 'az storage blob download -c ctr -n f.txt -f /d/f.txt' in cmd
