@@ -3,12 +3,14 @@ from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.aws import AWS
+from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
     'AWS',
+    'Azure',
     'Cloud',
     'CloudImplementationFeatures',
     'Region',

@@ -1,0 +1,146 @@
+"""Azure — the third metered VM cloud (controllers, CPU tasks,
+cross-cloud arbitrage; the reference's second-largest cloud).
+
+Re-design of reference ``sky/clouds/azure.py`` (708 LoC) scoped the
+same way as the AWS plugin here: catalog-backed feasibility/pricing
+so the optimizer genuinely arbitrates three clouds, plus the az-CLI
+provision plugin behind the standard seam
+(``provision/azure/instance.py``). No TPUs on Azure. Azure regions
+have no user-facing zones in this catalog (placement inside a region
+is Azure's allocator's job), so zone is always None.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_CREDENTIAL_HINT = (
+    'Install the az CLI and run `az login` (or set a service '
+    'principal via AZURE_CLIENT_ID/SECRET/TENANT).')
+
+
+@registry.CLOUD_REGISTRY.register(name='azure')
+class Azure(cloud_lib.Cloud):
+    """Microsoft Azure (VMs via the az CLI)."""
+
+    _REPR = 'Azure'
+    # Azure resource names allow 64, but the VM name embeds
+    # '-<idx>' and the group 'skytpu-' prefix.
+    MAX_CLUSTER_NAME_LEN_LIMIT = 42
+
+    # ------------------------------------------------------------------
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        if resources.is_tpu:
+            return []
+        instance_type = (resources.instance_type or
+                         catalog.get_default_instance_type(
+                             resources.cpus, resources.memory,
+                             cloud='azure'))
+        if instance_type is None:
+            return []
+        regions = sorted({
+            o.region
+            for o in catalog.get_instance_offerings(
+                instance_type, resources.region, None, cloud='azure')
+        })
+        return [cloud_lib.Region(name) for name in regions]
+
+    def zones_provision_loop(self, resources: 'Resources',
+                             region: Optional[str] = None):
+        # No zones: one candidate per region (Azure's allocator places
+        # within the region).
+        for r in self.regions_with_offering(resources):
+            if region is not None and r.name != region:
+                continue
+            yield (r.name, None)
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        if resources.cloud is not None and not self.is_same_cloud(
+                resources.cloud):
+            return []
+        if resources.is_tpu:
+            return []  # no TPUs on Azure
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = catalog.get_default_instance_type(
+                resources.cpus, resources.memory, cloud='azure')
+            if instance_type is None:
+                return []
+        if not catalog.get_instance_offerings(
+                instance_type, resources.region, None, cloud='azure'):
+            return []
+        return [resources.copy(cloud=self, instance_type=instance_type)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        assert resources.instance_type is not None, resources
+        return catalog.get_hourly_cost(resources.instance_type,
+                                       resources.use_spot,
+                                       resources.region, None,
+                                       cloud='azure')
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError(
+                'Azure placement is per-region; zones are not '
+                'exposed (drop the zone).')
+        return catalog.validate_region_zone(region, None)
+
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            # Marketplace image alias/URN; docker:<img> is a task
+            # container (bootstrapped post-provision), not a VM image.
+            'image_id': (None if resources.extract_docker_image() else
+                         resources.image_id),
+            'labels': resources.labels or {},
+            'ports': resources.ports or [],
+            'num_hosts': 1,
+        }
+
+    # ------------------------------------------------------------------
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.azure import api
+        try:
+            account = api.run_az(['account', 'show'], timeout=30)
+        except FileNotFoundError:
+            return False, 'az CLI not installed. ' + _CREDENTIAL_HINT
+        except Exception as e:  # pylint: disable=broad-except
+            return False, f'{e}. {_CREDENTIAL_HINT}'
+        if not account or not account.get('id'):
+            return False, ('az is not logged in. ' + _CREDENTIAL_HINT)
+        return True, None
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        path = os.path.expanduser('~/.azure')
+        if os.path.isdir(path):
+            return {'~/.azure': path}
+        return {}
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.azure import api
+        try:
+            account = api.run_az(['account', 'show'], timeout=30)
+            if account:
+                return [[f"{account.get('user', {}).get('name', '')}"
+                         f"@{account.get('id', '')}"]]
+        except Exception:  # pylint: disable=broad-except
+            pass
+        return None

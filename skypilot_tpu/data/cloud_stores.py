@@ -48,21 +48,41 @@ def download_command(url: str, dst: str,
         # Directory fetches reuse the Store classes' own download
         # commands (one place owns the gsutil/aws/az CLI invocations);
         # only the single-object copy is specific to this module.
+        if scheme == 'az':
+            # Azure container names cannot carry a '/': a key prefix
+            # must go through --pattern, not into the -s container —
+            # and download-batch recreates blob paths relative to the
+            # container, so a prefix fetch stages through a temp dir
+            # and moves the prefix's CONTENTS into dst (matching the
+            # gs://'s rsync-of-prefix semantics).
+            if is_dir:
+                prefix = key.rstrip('/')
+                if not prefix:
+                    return (f'mkdir -p {q_dst} && '
+                            f'az storage blob download-batch '
+                            f'-d {q_dst} -s {bucket}')
+                q_prefix = shlex.quote(prefix)
+                return (
+                    f'azdl=$(mktemp -d) && '
+                    f'az storage blob download-batch -d "$azdl" '
+                    f'-s {bucket} '
+                    f'--pattern {shlex.quote(prefix + "/*")} && '
+                    f'mkdir -p {q_dst} && '
+                    f'cp -a "$azdl"/{q_prefix}/. {q_dst}/ && '
+                    f'rm -rf "$azdl"')
+            return (f'mkdir -p $(dirname {q_dst}) && '
+                    f'az storage blob download -c {bucket} '
+                    f'-n {shlex.quote(key)} -f {q_dst}')
         cls = {
             'gs': storage_lib.GcsStore,
             's3': storage_lib.S3Store,
             'r2': storage_lib.R2Store,
-            'az': storage_lib.AzureBlobStore,
         }[scheme]
         store = cls(f'{bucket}/{key}'.rstrip('/') if key else bucket)
         if is_dir:
             return store.download_command(dst)
         if scheme == 'gs':
             tool, obj = 'gsutil cp', shlex.quote(src)
-        elif scheme == 'az':
-            return (f'mkdir -p $(dirname {q_dst}) && '
-                    f'az storage blob download -c {bucket} '
-                    f'-n {shlex.quote(key)} -f {q_dst}')
         else:
             # s3 and r2 share the aws CLI; R2 adds endpoint/creds.
             aws = (storage_lib.R2Store(bucket)._aws()  # pylint: disable=protected-access

@@ -44,7 +44,10 @@ def transfer(src: storage_lib.AbstractStore,
     elif s_local:
         # Reuse the store's own upload path with the bucket dir as
         # source (multipart thresholds handled by the store's CLI).
-        uploader = type(dst)(dst.name, source=src.path())
+        # exclude_git=False: a bucket copy must move EVERY key, or
+        # verification fails on '.git/'-prefixed objects.
+        uploader = type(dst)(dst.name, source=src.path(),
+                             exclude_git=False)
         uploader.upload()
     elif d_local:
         os.makedirs(dst.path(), exist_ok=True)
@@ -61,7 +64,8 @@ def transfer(src: storage_lib.AbstractStore,
         # CLI machinery (R2 endpoints, az batch uploads, ...).
         with tempfile.TemporaryDirectory() as tmp:
             _run(src.download_command(tmp))
-            uploader = type(dst)(dst.name, source=tmp)
+            uploader = type(dst)(dst.name, source=tmp,
+                                 exclude_git=False)
             uploader.upload()
     if verify:
         verify_transfer(src, dst)

@@ -74,9 +74,14 @@ class AbstractStore:
 
     _run = staticmethod(run_storage_command)
 
-    def __init__(self, name: str, source: Optional[str] = None) -> None:
+    def __init__(self, name: str, source: Optional[str] = None,
+                 exclude_git: bool = True) -> None:
         self.name = name
         self.source = source
+        # '.git/*' exclusion is a user-source-directory heuristic; a
+        # bucket-to-bucket staged transfer must copy EVERY key or its
+        # verification manifest fails (data_transfer sets False).
+        self.exclude_git = exclude_git
 
     def upload(self) -> None:
         """Sync self.source into the bucket (no-op if source is None)."""
@@ -116,7 +121,8 @@ class GcsStore(AbstractStore):
         src = os.path.abspath(os.path.expanduser(self.source))
         self._run(f'gsutil mb -c standard {self.url()} || true')
         if os.path.isdir(src):
-            self._run(f'gsutil -m rsync -r -x ".git/*" {src} {self.url()}')
+            exclude = ' -x ".git/*"' if self.exclude_git else ''
+            self._run(f'gsutil -m rsync -r{exclude} {src} {self.url()}')
         else:
             self._run(f'gsutil cp {src} {self.url()}/')
 
@@ -179,8 +185,9 @@ class S3Store(AbstractStore):
         aws = self._aws()
         self._run(f'{aws} s3 mb {self.url()} || true')
         if os.path.isdir(src):
-            self._run(f'{aws} s3 sync --exclude ".git/*" {src} '
-                      f'{self.url()}')
+            exclude = (' --exclude ".git/*"' if self.exclude_git
+                       else '')
+            self._run(f'{aws} s3 sync{exclude} {src} {self.url()}')
         else:
             self._run(f'{aws} s3 cp {src} {self.url()}/')
 
@@ -307,8 +314,20 @@ class AzureBlobStore(AbstractStore):
         src = os.path.abspath(os.path.expanduser(self.source))
         self._run(f'az storage container create -n {self.name} || true')
         if os.path.isdir(src):
-            self._run(f'az storage blob upload-batch -d {self.name} '
-                      f'-s {src} --overwrite')
+            if self.exclude_git and os.path.isdir(
+                    os.path.join(src, '.git')):
+                # upload-batch has include-patterns only; honoring the
+                # '.git/*' exclusion (like GCS/S3/R2) means staging a
+                # copy without it.
+                self._run(
+                    f'azup=$(mktemp -d) && cp -a {src}/. "$azup"/ && '
+                    f'rm -rf "$azup"/.git && '
+                    f'az storage blob upload-batch -d {self.name} '
+                    f'-s "$azup" --overwrite && rm -rf "$azup"')
+            else:
+                self._run(
+                    f'az storage blob upload-batch -d {self.name} '
+                    f'-s {src} --overwrite')
         else:
             self._run(f'az storage blob upload -c {self.name} '
                       f'-f {src} -n {os.path.basename(src)} '

@@ -163,12 +163,32 @@ def remat_layer_fn(layer, remat):
     (keep matmul outputs — the expensive MXU work — and recompute
     only elementwise/norm ops in the backward: cheaper recompute than
     full remat at a fraction of no-remat's activation memory);
+    'qkvo' = save only the attention projections (q/k/v/o, named in
+    decoder_layer) — the middle ground for memory-tight single-chip
+    training: the ffn activations (the bulk of 'dots' memory) still
+    remat, but the backward skips recomputing the qkv/o projections
+    and feeds the flash-attention backward from saved q/k/v;
     False = no remat.
     """
     if remat == 'dots':
         return jax.checkpoint(
             layer,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if remat == 'qkvo':
+        return jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                'attn_q', 'attn_k', 'attn_v', 'attn_o'))
+    if remat == 'kvo':
+        # Like 'qkvo' minus the q projection (the largest saved
+        # tensor, n_heads x head_dim per token): q recomputes from
+        # the saved layer input at one matmul+rope, buying ~2 GB at
+        # seq 8192 batch 4 — the difference between fitting and
+        # OOMing on a 16 GB chip.
+        return jax.checkpoint(
+            layer,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                'attn_k', 'attn_v', 'attn_o'))
     if remat:
         return jax.checkpoint(layer)
     return layer
@@ -260,6 +280,7 @@ def forward_hidden(params: Dict,
         """One decoder block; shapes derived from x so the same body
         runs on full batches (scan path) and microbatches (pp path)."""
         bx, sx = x.shape[0], x.shape[1]
+        from jax.ad_checkpoint import checkpoint_name as name
         h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
         q = (h @ lp['wq'].astype(cdt)).reshape(bx, sx, cfg.n_heads,
                                                cfg.head_dim)
@@ -267,10 +288,13 @@ def forward_hidden(params: Dict,
                                                cfg.head_dim)
         v = (h @ lp['wv'].astype(cdt)).reshape(bx, sx, cfg.n_kv_heads,
                                                cfg.head_dim)
-        q = constrain(_rope(q, pos, cfg.rope_theta), HEAD_SPEC)
-        k = _rope(k, pos, cfg.rope_theta)
+        q = name(constrain(_rope(q, pos, cfg.rope_theta), HEAD_SPEC),
+                 'attn_q')
+        k = name(_rope(k, pos, cfg.rope_theta), 'attn_k')
+        v = name(v, 'attn_v')
         o = _attention(q, k, v, cfg, mesh, impl_override=attn_override)
-        o = o.reshape(bx, sx, cfg.n_heads * cfg.head_dim)
+        o = name(o.reshape(bx, sx, cfg.n_heads * cfg.head_dim),
+                 'attn_o')
         x = x + constrain(o @ lp['wo'].astype(cdt), ACT_SPEC)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)

@@ -135,8 +135,20 @@ def make_train_step(cfg: llama.LlamaConfig,
 
 
 def shard_batch(batch: Dict[str, jax.Array], mesh):
-    """Device-put a host batch with [batch, seq] dp/sp sharding."""
+    """Shard a host batch with [batch, seq] dp/sp sharding.
+
+    Single-process: ``batch`` is the global batch (device_put).
+    Multi-process (pod slice / hybrid DCN×ICI mesh): ``batch`` holds
+    THIS process's rows — the global array is assembled from the
+    per-process shards, so dp rides the process (DCN) axis without any
+    host ever materializing the global batch.
+    """
     sharding = NamedSharding(mesh, P(('dp', 'fsdp'), 'sp'))
+    if jax.process_count() > 1:
+        import numpy as np
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), batch)
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 

@@ -1,0 +1,195 @@
+"""Hybrid-mesh validation: N processes × M local devices (DCN × ICI).
+
+The pod-slice shape the env contract promises (SURVEY.md §2.11;
+reference analog ``examples/nccl_test.yaml:30-40`` validates its NCCL
+world the same way): data parallelism over the PROCESS axis — the DCN
+boundary on real hardware — with fsdp/tp sharding INSIDE each
+process's devices (ICI). The single-process 8-device dryrun cannot
+see process-boundary bugs (host-local batch assembly, cross-process
+collectives in the optimizer, coordinator wiring); this check can.
+
+Run directly (driver-runnable)::
+
+    python -m skypilot_tpu.parallel.hybrid_check            # 2 × 4
+    python -m skypilot_tpu.parallel.hybrid_check --procs 2 --local 2
+
+The parent spawns the N-process world over localhost using the SAME
+``SKYTPU_*`` env contract a gang-launched job gets (so
+``distributed.initialize_from_env`` is exercised, not bypassed), runs
+two sharded train steps of the tiny Llama config, then replays them
+single-process on N×M virtual devices and asserts loss parity.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_STEPS = 2
+_BATCH = 8           # global batch rows
+_SEQ = 64
+
+
+def _make_global_batch(vocab_size: int):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    inputs = rng.integers(0, vocab_size, (_BATCH, _SEQ),
+                          dtype=np.int32)
+    targets = rng.integers(1, vocab_size, (_BATCH, _SEQ),
+                           dtype=np.int32)
+    return {'inputs': inputs, 'targets': targets}
+
+
+def _run_steps(mesh, local_rows):
+    """Init + _STEPS sharded train steps; returns per-step losses."""
+    import jax
+
+    from skypilot_tpu import models
+
+    cfg = models.LlamaConfig.tiny()
+    batch_np = _make_global_batch(cfg.vocab_size)
+    batch = models.shard_batch(
+        {k: v[local_rows] for k, v in batch_np.items()}, mesh)
+    state, opt = models.init_train_state(cfg, jax.random.PRNGKey(0),
+                                         mesh)
+    step = models.make_train_step(cfg, opt, mesh)
+    losses = []
+    for _ in range(_STEPS):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics['loss']))
+    return losses
+
+
+def _force_cpu() -> None:
+    """Pin jax to the CPU platform even when the image's sitecustomize
+    already imported jax with a TPU/axon plugin selected via env."""
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+def _child(procs: int, local: int, out_path: str) -> None:
+    _force_cpu()
+    import jax
+
+    from skypilot_tpu.parallel import distributed
+    from skypilot_tpu.parallel import make_mesh, plan_mesh
+
+    assert distributed.initialize_from_env(), 'env contract missing'
+    assert jax.process_count() == procs, (jax.process_count(), procs)
+    n = procs * local
+    assert len(jax.devices()) == n, (len(jax.devices()), n)
+    # dp over the process (DCN) axis; tp innermost on the fastest
+    # links, the rest of each process's devices to fsdp (ICI).
+    tp = 2 if local % 2 == 0 else 1
+    mesh = make_mesh(plan_mesh(n, dp=procs, tp=tp, sp=1, fsdp=-1),
+                     devices=jax.devices())
+    rank = jax.process_index()
+    rows = slice(rank * _BATCH // procs, (rank + 1) * _BATCH // procs)
+    losses = _run_steps(mesh, rows)
+    with open(out_path, 'w', encoding='utf-8') as f:
+        json.dump({'rank': rank, 'losses': losses}, f)
+    print(f'hybrid_check child rank={rank} losses={losses}')
+
+
+def _oracle(procs: int, local: int) -> list:
+    import jax
+
+    from skypilot_tpu.parallel import make_mesh, plan_mesh
+    n = procs * local
+    tp = 2 if local % 2 == 0 else 1
+    mesh = make_mesh(plan_mesh(n, dp=procs, tp=tp, sp=1, fsdp=-1),
+                     devices=jax.devices()[:n])
+    return _run_steps(mesh, slice(0, _BATCH))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--procs', type=int, default=2)
+    parser.add_argument('--local', type=int, default=4,
+                        help='virtual devices per process')
+    parser.add_argument('--port', type=int, default=0,
+                        help='coordinator port (0 = pick free)')
+    args = parser.parse_args()
+
+    if os.environ.get('_SKYTPU_HYBRID_ROLE') == 'child':
+        _child(args.procs, args.local,
+               os.environ['_SKYTPU_HYBRID_OUT'])
+        return 0
+
+    port = args.port
+    if port == 0:
+        import socket
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            port = s.getsockname()[1]
+
+    from skypilot_tpu.utils import env_contract
+    tmpdir = tempfile.mkdtemp(prefix='skytpu-hybrid-')
+    ips = ['127.0.0.1'] * args.procs
+    children = []
+    for rank in range(args.procs):
+        env = dict(os.environ)
+        env.update(
+            env_contract.make_rank_env(rank, ips,
+                                       coordinator_port=port))
+        env['JAX_PLATFORMS'] = 'cpu'
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        env['XLA_FLAGS'] = (
+            env.get('XLA_FLAGS', '').split(
+                '--xla_force_host_platform_device_count')[0].strip() +
+            f' --xla_force_host_platform_device_count={args.local}'
+        ).strip()
+        env['_SKYTPU_HYBRID_ROLE'] = 'child'
+        env['_SKYTPU_HYBRID_OUT'] = os.path.join(
+            tmpdir, f'rank{rank}.json')
+        children.append(
+            subprocess.Popen([sys.executable, '-m',
+                              'skypilot_tpu.parallel.hybrid_check',
+                              '--procs', str(args.procs),
+                              '--local', str(args.local)],
+                             env=env))
+    rcs = [p.wait(timeout=600) for p in children]
+    if any(rcs):
+        print(f'hybrid_check: child rcs={rcs}', file=sys.stderr)
+        return 1
+
+    per_rank = []
+    for rank in range(args.procs):
+        with open(os.path.join(tmpdir, f'rank{rank}.json'),
+                  encoding='utf-8') as f:
+            per_rank.append(json.load(f)['losses'])
+    # Every rank must report the identical (psum-replicated) loss.
+    for rank, losses in enumerate(per_rank[1:], 1):
+        assert losses == per_rank[0], (rank, losses, per_rank[0])
+
+    # Single-process oracle in THIS process (no jax backend touched
+    # until now, so the device count/platform can still be forced).
+    n = args.procs * args.local
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags +
+            f' --xla_force_host_platform_device_count={n}').strip()
+    _force_cpu()
+    oracle = _oracle(args.procs, args.local)
+
+    import numpy as np
+    ok = np.allclose(per_rank[0], oracle, rtol=1e-4, atol=1e-5)
+    print(f'hybrid_check: {args.procs} procs x {args.local} devices '
+          f'losses={per_rank[0]} oracle={oracle} parity={ok}')
+    if not ok:
+        return 1
+    print(f'hybrid_check({args.procs}x{args.local}): OK')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

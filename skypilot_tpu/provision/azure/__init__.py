@@ -1,0 +1,1 @@
+"""Azure provision plugin (az-CLI based)."""

@@ -1,0 +1,75 @@
+"""Thin az-CLI client for the Azure provision plugin.
+
+Re-design of reference ``sky/provision/azure/`` (1,301 LoC of Azure
+SDK + ARM template deploys) on this framework's CLI-not-SDK stance
+(same as the GCS/S3 storage layer): every operation is one ``az``
+invocation with ``-o json``, so the plugin needs no azure-* pip
+packages, and tests drive the full lifecycle through the ``runner``
+seam with canned JSON — the same seam pattern as
+``provision/aws/instance.py``'s ``client_factory``.
+
+Error taxonomy: Azure's capacity/quota failures surface as error
+codes in az's stderr; :func:`translate_error` maps them onto the
+typed exceptions the failover provisioner keys on (reference
+``FailoverCloudErrorHandlerV2`` decodes the same codes from the SDK).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+from typing import Any, Callable, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# Azure allocation-failure codes = stockout; quota codes = quota
+# (reference sky/backends azure failover handler decodes these).
+_STOCKOUT_CODES = ('skunotavailable', 'allocationfailed',
+                   'overconstrainedallocationrequest',
+                   'zonalallocationfailed', 'allocationtimeout')
+_QUOTA_CODES = ('quotaexceeded', 'operationnotallowed')
+
+
+class AzCliError(Exception):
+
+    def __init__(self, argv: List[str], returncode: int,
+                 stderr: str) -> None:
+        super().__init__(
+            f'az {" ".join(argv)} failed ({returncode}): {stderr}')
+        self.argv = argv
+        self.returncode = returncode
+        self.stderr = stderr
+
+
+def _subprocess_runner(argv: List[str],
+                       timeout: float = 600.0) -> Optional[Any]:
+    proc = subprocess.run(['az'] + argv + ['-o', 'json'],
+                          capture_output=True, text=True,
+                          timeout=timeout, check=False)
+    if proc.returncode != 0:
+        raise AzCliError(argv, proc.returncode, proc.stderr)
+    out = proc.stdout.strip()
+    return json.loads(out) if out else None
+
+
+# Test seam: replaced with a fake az in tests (canned JSON responses).
+runner: Callable[..., Optional[Any]] = _subprocess_runner
+
+
+def run_az(argv: List[str], timeout: float = 600.0) -> Optional[Any]:
+    """Run one az command, returning parsed JSON (None if empty)."""
+    return runner(argv, timeout)
+
+
+def translate_error(exc: Exception,
+                    what: str) -> exceptions.ProvisionError:
+    """Map an az failure onto the stockout/quota/provision taxonomy."""
+    blob = str(exc).lower()
+    if any(code in blob for code in _QUOTA_CODES) or 'quota' in blob:
+        return exceptions.QuotaExceededError(f'{what}: {exc}')
+    if any(code in blob for code in _STOCKOUT_CODES) or (
+            'capacity' in blob and 'insufficient' in blob):
+        return exceptions.StockoutError(f'{what}: {exc}')
+    return exceptions.ProvisionError(f'{what}: {exc}')

@@ -1,0 +1,249 @@
+"""Azure VM provision ops.
+
+Re-design of reference ``sky/provision/azure/instance.py`` (ARM
+template deployment + SDK polling) as az-CLI calls against one
+RESOURCE GROUP per cluster: creation is idempotent against the
+group's VM list, teardown is one group delete (nothing can leak —
+NICs, disks and IPs die with the group), and STOP maps to
+``az vm deallocate`` (compute billing stops, disks persist — the real
+stop semantics the reference's Azure supports and the reason Azure
+carries the STOP capability flag here, unlike Kubernetes).
+
+State mapping: Azure ``powerState`` ('VM running'/'VM deallocated'/
+'VM stopped'/...) -> the provider-neutral 'running'/'stopped'/
+'pending' statuses the reconciler consumes.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_CLUSTER_TAG = 'skypilot-tpu-cluster'
+
+_WAIT_TIMEOUT = 1200.0
+_POLL_INTERVAL = 5.0
+
+DEFAULT_IMAGE = 'Ubuntu2204'
+SSH_USER = 'skytpu'
+
+
+def resource_group(cluster_name_on_cloud: str) -> str:
+    return f'skytpu-{cluster_name_on_cloud}'
+
+
+def _vm_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}'
+
+
+def _list_vms(rg: str) -> List[Dict[str, Any]]:
+    """VMs in the cluster's group with powerState (-d), or [] when the
+    group does not exist yet."""
+    try:
+        return api.run_az(['vm', 'list', '-g', rg, '-d']) or []
+    except api.AzCliError as e:
+        if 'resourcegroupnotfound' in str(e).lower():
+            return []
+        raise api.translate_error(e, 'vm list') from e
+
+
+def _power_state(vm: Dict[str, Any]) -> str:
+    return (vm.get('powerState') or '').lower()
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    """Ensure the cluster's resource group exists (the unit of both
+    placement and teardown)."""
+    rg = resource_group(config.cluster_name_on_cloud)
+    try:
+        api.run_az(['group', 'create', '-n', rg, '-l', config.region,
+                    '--tags', f'{_CLUSTER_TAG}='
+                    f'{config.cluster_name_on_cloud}'])
+    except api.AzCliError as e:
+        raise api.translate_error(e, 'group create') from e
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    cluster = config.cluster_name_on_cloud
+    rg = resource_group(cluster)
+    existing = {vm['name']: vm for vm in _list_vms(rg)}
+    created: List[str] = []
+    resumed: List[str] = []
+    for idx in range(config.count):
+        name = _vm_name(cluster, idx)
+        vm = existing.get(name)
+        if vm is not None:
+            state = _power_state(vm)
+            if 'deallocated' in state or 'stopped' in state:
+                try:
+                    api.run_az(['vm', 'start', '-g', rg, '-n', name])
+                except api.AzCliError as e:
+                    raise api.translate_error(e, 'vm start') from e
+                resumed.append(name)
+            continue
+        argv = [
+            'vm', 'create', '-g', rg, '-n', name,
+            '--image', node.get('image_id') or DEFAULT_IMAGE,
+            '--size', node['instance_type'],
+            '--admin-username', SSH_USER,
+            '--tags', f'{_CLUSTER_TAG}={cluster}',
+            '--os-disk-size-gb', str(node.get('disk_size') or 256),
+            '--public-ip-sku', 'Standard',
+        ]
+        if node.get('ssh_public_key'):
+            argv += ['--ssh-key-values', node['ssh_public_key']]
+        else:
+            argv += ['--generate-ssh-keys']
+        if node.get('use_spot'):
+            # Deallocate on eviction: the jobs controller's preemption
+            # reconciler sees a 'stopped' VM and recovers (same signal
+            # shape as a GCP TPU preemption).
+            argv += ['--priority', 'Spot',
+                     '--eviction-policy', 'Deallocate']
+        for k, v in (node.get('labels') or {}).items():
+            argv += ['--tags', f'{k}={v}']
+        try:
+            api.run_az(argv)
+        except api.AzCliError as e:
+            raise api.translate_error(e, 'vm create') from e
+        created.append(name)
+    all_names = sorted(set(existing) | set(created))
+    if not all_names:
+        raise exceptions.ProvisionError('run_instances created nothing')
+    return common.ProvisionRecord(
+        provider_name='azure',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=_vm_name(cluster, 0),
+    )
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    rg = resource_group(cluster_name_on_cloud)
+    want = state or 'running'
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        vms = _list_vms(rg)
+        if want == 'terminated':
+            if not vms:
+                return
+        elif vms and all(want in _power_state(vm) or
+                         (want == 'stopped' and
+                          'deallocated' in _power_state(vm))
+                         for vm in vms):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} VMs to reach '
+        f'{want!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del region, zone
+    out: Dict[str, Optional[str]] = {}
+    for vm in _list_vms(resource_group(cluster_name_on_cloud)):
+        state = _power_state(vm)
+        if 'running' in state:
+            status = 'running'
+        elif 'deallocated' in state or 'stopped' in state:
+            status = 'stopped'
+        elif 'deleting' in state:
+            status = 'terminated'
+        else:  # starting / creating / unknown
+            status = 'pending'
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[vm['name']] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    rg = resource_group(cluster_name_on_cloud)
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for vm in sorted(_list_vms(rg), key=lambda v: v['name']):
+        infos[vm['name']] = [
+            common.InstanceInfo(
+                instance_id=vm['name'],
+                internal_ip=vm.get('privateIps', '').split(',')[0],
+                external_ip=(vm.get('publicIps') or '').split(',')[0]
+                or None,
+                host_index=0,
+                tags=vm.get('tags') or {},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='azure',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user=SSH_USER,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del region, zone
+    rg = resource_group(cluster_name_on_cloud)
+    for vm in _list_vms(rg):
+        if 'running' in _power_state(vm) or \
+                'starting' in _power_state(vm):
+            try:
+                # Deallocate (not 'vm stop'): a stopped-but-allocated
+                # Azure VM still bills compute.
+                api.run_az(['vm', 'deallocate', '-g', rg, '-n',
+                            vm['name'], '--no-wait'])
+            except api.AzCliError as e:
+                raise api.translate_error(e, 'vm deallocate') from e
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    rg = resource_group(cluster_name_on_cloud)
+    try:
+        # The group owns every resource (VMs, NICs, IPs, disks):
+        # one delete, nothing leaks.
+        api.run_az(['group', 'delete', '-n', rg, '--yes', '--no-wait'])
+    except api.AzCliError as e:
+        if 'resourcegroupnotfound' in str(e).lower():
+            return
+        raise api.translate_error(e, 'group delete') from e
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    del region, zone
+    rg = resource_group(cluster_name_on_cloud)
+    for vm in _list_vms(rg):
+        for port in ports:
+            try:
+                api.run_az(['vm', 'open-port', '-g', rg, '-n',
+                            vm['name'], '--port', str(port)])
+            except api.AzCliError as e:
+                raise api.translate_error(e, 'vm open-port') from e
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

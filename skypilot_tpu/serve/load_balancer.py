@@ -192,6 +192,11 @@ class LoadBalancer:
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 logger.warning('Proxy to %s failed: %s', url, e)
                 last_err = e
+                if request.method not in ('GET', 'HEAD', 'OPTIONS'):
+                    # Same double-execution risk as the dropped-
+                    # connection branch: the replica may have run the
+                    # request (e.g. 200 headers then a payload error).
+                    break
             finally:
                 self.policy.done(url)
                 self._inflight[url] = max(

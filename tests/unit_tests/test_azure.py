@@ -1,0 +1,233 @@
+"""Azure cloud + az-CLI provision plugin (fake az seam), three-cloud
+optimization.
+
+The fake az plays the CLI: lifecycle tests cover the resource-group-
+scoped idempotent create/reuse/restart contract, deallocate-stop
+semantics, and the allocation/quota error taxonomy; the optimizer
+test proves genuine three-way (GCP/AWS/Azure) price arbitration.
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import api as az_api
+from skypilot_tpu.provision.azure import instance as az_instance
+
+
+class FakeAz:
+    """In-memory az CLI: resource groups + VMs with power states."""
+
+    def __init__(self):
+        self.groups = {}            # name -> {'location':, 'tags':}
+        self.vms = {}               # (rg, name) -> dict
+        self.create_error = None    # AzCliError to raise on vm create
+        self.calls = []
+
+    def __call__(self, argv, timeout=600.0):
+        self.calls.append(argv)
+        cmd = tuple(argv[:2])
+        if cmd == ('group', 'create'):
+            rg = argv[argv.index('-n') + 1]
+            self.groups[rg] = {'location': argv[argv.index('-l') + 1]}
+            return {'name': rg}
+        if cmd == ('group', 'delete'):
+            rg = argv[argv.index('-n') + 1]
+            if rg not in self.groups:
+                raise az_api.AzCliError(argv, 3,
+                                        'ResourceGroupNotFound')
+            self.groups.pop(rg)
+            for key in [k for k in self.vms if k[0] == rg]:
+                self.vms.pop(key)
+            return None
+        if cmd == ('vm', 'list'):
+            rg = argv[argv.index('-g') + 1]
+            if rg not in self.groups:
+                raise az_api.AzCliError(argv, 3,
+                                        'ResourceGroupNotFound')
+            return [dict(v) for (g, _), v in self.vms.items()
+                    if g == rg]
+        if cmd == ('vm', 'create'):
+            if self.create_error is not None:
+                raise self.create_error
+            rg = argv[argv.index('-g') + 1]
+            name = argv[argv.index('-n') + 1]
+            n = len(self.vms) + 1
+            self.vms[(rg, name)] = {
+                'name': name,
+                'powerState': 'VM running',
+                'privateIps': f'10.0.0.{n}',
+                'publicIps': f'20.0.0.{n}',
+                'tags': {},
+                'hardwareProfile': {
+                    'vmSize': argv[argv.index('--size') + 1]},
+                'priority': ('Spot' if '--priority' in argv else
+                             'Regular'),
+            }
+            return dict(self.vms[(rg, name)])
+        if cmd == ('vm', 'start'):
+            rg = argv[argv.index('-g') + 1]
+            name = argv[argv.index('-n') + 1]
+            self.vms[(rg, name)]['powerState'] = 'VM running'
+            return None
+        if cmd == ('vm', 'deallocate'):
+            rg = argv[argv.index('-g') + 1]
+            name = argv[argv.index('-n') + 1]
+            self.vms[(rg, name)]['powerState'] = 'VM deallocated'
+            return None
+        if cmd == ('vm', 'open-port'):
+            return None
+        if cmd == ('account', 'show'):
+            return {'id': 'sub-123', 'user': {'name': 'me@corp'}}
+        raise AssertionError(f'unhandled az {argv}')
+
+
+@pytest.fixture
+def az(monkeypatch):
+    fake = FakeAz()
+    monkeypatch.setattr(az_api, 'runner', fake)
+    monkeypatch.setattr(az_instance, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _config(count=1, use_spot=False):
+    return common.ProvisionConfig(
+        provider_name='azure',
+        cluster_name='az-c',
+        cluster_name_on_cloud='az-c',
+        region='eastus',
+        zone=None,
+        node_config={'instance_type': 'Standard_D8s_v5',
+                     'use_spot': use_spot, 'labels': {},
+                     'disk_size': 128, 'image_id': None},
+        count=count,
+    )
+
+
+# ----------------------------------------------------------- lifecycle
+
+def test_run_wait_query_info_terminate(az):
+    config = az_instance.bootstrap_instances(_config(count=2))
+    record = az_instance.run_instances(config)
+    assert record.head_instance_id == 'az-c-0'
+    assert sorted(record.created_instance_ids) == ['az-c-0', 'az-c-1']
+    assert 'skytpu-az-c' in az.groups
+
+    az_instance.wait_instances('az-c', 'eastus', None, None)
+    status = az_instance.query_instances('az-c', 'eastus', None)
+    assert status == {'az-c-0': 'running', 'az-c-1': 'running'}
+
+    info = az_instance.get_cluster_info('az-c', 'eastus', None)
+    assert info.head_instance_id == 'az-c-0'
+    assert info.ssh_user == az_instance.SSH_USER
+    ips = [i[0].internal_ip for i in info.instances.values()]
+    assert all(ip.startswith('10.0.0.') for ip in ips)
+
+    az_instance.terminate_instances('az-c', 'eastus', None)
+    assert not az.groups
+    az_instance.wait_instances('az-c', 'eastus', None, 'terminated')
+    # Idempotent teardown: group already gone is not an error.
+    az_instance.terminate_instances('az-c', 'eastus', None)
+
+
+def test_deallocate_stop_and_restart(az):
+    config = az_instance.bootstrap_instances(_config(count=1))
+    az_instance.run_instances(config)
+    az_instance.stop_instances('az-c', 'eastus', None)
+    assert az.vms[('skytpu-az-c', 'az-c-0')]['powerState'] == (
+        'VM deallocated')
+    assert az_instance.query_instances('az-c', 'eastus', None) == {
+        'az-c-0': 'stopped'}
+    # run_instances on a deallocated VM restarts it (no new create).
+    record = az_instance.run_instances(config)
+    assert record.resumed_instance_ids == ['az-c-0']
+    assert record.created_instance_ids == []
+    assert az.vms[('skytpu-az-c', 'az-c-0')]['powerState'] == (
+        'VM running')
+
+
+def test_run_instances_idempotent(az):
+    config = az_instance.bootstrap_instances(_config(count=2))
+    az_instance.run_instances(config)
+    record = az_instance.run_instances(config)
+    assert record.created_instance_ids == []
+    assert len(az.vms) == 2
+
+
+def test_spot_priority(az):
+    config = az_instance.bootstrap_instances(_config(use_spot=True))
+    az_instance.run_instances(config)
+    assert az.vms[('skytpu-az-c', 'az-c-0')]['priority'] == 'Spot'
+
+
+def test_error_taxonomy(az):
+    config = az_instance.bootstrap_instances(_config())
+    az.create_error = az_api.AzCliError(
+        ['vm', 'create'], 1,
+        'Allocation failed: SkuNotAvailable in eastus')
+    with pytest.raises(exceptions.StockoutError):
+        az_instance.run_instances(config)
+    az.create_error = az_api.AzCliError(
+        ['vm', 'create'], 1,
+        'Operation could not be completed: QuotaExceeded for '
+        'standardDSv5Family')
+    with pytest.raises(exceptions.QuotaExceededError):
+        az_instance.run_instances(config)
+
+
+# --------------------------------------------------------- cloud layer
+
+@pytest.fixture
+def three_clouds(az, monkeypatch):
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu.clouds import AWS, GCP, Azure
+    monkeypatch.setattr(check_lib, 'get_cached_enabled_clouds',
+                        lambda *a, **k: [GCP(), AWS(), Azure()])
+    yield
+
+
+def test_cloud_feasibility_and_credentials(az):
+    from skypilot_tpu.clouds import Azure
+    from skypilot_tpu.resources import Resources
+    cloud = Azure()
+    ok, _ = cloud.check_credentials()
+    assert ok
+    feas = cloud.get_feasible_launchable_resources(
+        Resources(cpus='8+'))
+    assert feas and feas[0].instance_type == 'Standard_F8s_v2'
+    # TPUs are never feasible on Azure.
+    assert cloud.get_feasible_launchable_resources(
+        Resources(accelerators='tpu-v5e-8')) == []
+    regions = cloud.regions_with_offering(
+        Resources(instance_type='Standard_D8s_v5'))
+    assert any(r.name == 'eastus' for r in regions)
+    # Zones are not a thing on Azure here.
+    with pytest.raises(ValueError):
+        cloud.validate_region_zone('eastus', 'eastus-a')
+
+
+def test_optimizer_arbitrates_three_clouds(three_clouds,
+                                           isolated_state):
+    from skypilot_tpu import catalog
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.resources import Resources
+
+    prices = {}
+    for cloud in ('gcp', 'aws', 'azure'):
+        itype = catalog.get_default_instance_type('8+', cloud=cloud)
+        prices[cloud] = catalog.get_hourly_cost(itype, cloud=cloud)
+    cheapest = min(prices, key=prices.get)
+
+    with dag_lib.Dag() as dag:
+        t = task_lib.Task('cpu', run='echo hi')
+        t.set_resources(Resources(cpus='8+'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud.canonical_name() == cheapest
+    # Pinning azure explicitly works end to end through the optimizer.
+    with dag_lib.Dag() as dag:
+        t = task_lib.Task('cpu', run='echo hi')
+        t.set_resources(Resources(cloud='azure', cpus='8+'))
+    optimizer_lib.Optimizer.optimize(dag, quiet=True)
+    assert t.best_resources.cloud.canonical_name() == 'azure'
+    assert t.best_resources.instance_type == 'Standard_F8s_v2'

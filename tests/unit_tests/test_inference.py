@@ -44,6 +44,7 @@ def test_generate_matches_cache_free_oracle():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_ragged_batch_matches_per_sequence_decode():
     """A batch of different-length prompts decodes identically to each
     prompt decoded alone."""

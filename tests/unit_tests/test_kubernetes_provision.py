@@ -350,3 +350,69 @@ def test_cpu_task_candidates_are_launchable(k8s_env, monkeypatch,
     vars_ = Kubernetes().make_deploy_resources_variables(
         t.best_resources, 'c', 'gke_test', None)
     assert vars_['cpus'] == '8' and vars_['memory'] == '32.0'
+
+
+# ----------------------------------------------- port-forward runner
+
+def test_port_forward_runner_tunnel_lifecycle(monkeypatch):
+    """Exec-less-cluster mode: the runner starts a (fake) tunnel
+    process lazily, waits for the local socket, routes ssh at the
+    forwarded port, and restarts a dead tunnel on next use."""
+    import sys
+    from skypilot_tpu.utils.command_runner import (
+        KubernetesPortForwardRunner)
+
+    runner = KubernetesPortForwardRunner(
+        namespace='ns', pod='mypod', ssh_user='u',
+        ssh_private_key='/tmp/k', context='ctx')
+
+    # Command shape: kubectl port-forward pod/<name> local:22.
+    cmd = runner._tunnel_cmd(12345)
+    assert cmd[:3] == ['kubectl', '--context', 'ctx']
+    assert '-n' in cmd and 'ns' in cmd and 'port-forward' in cmd
+    assert 'pod/mypod' in cmd and '12345:22' in cmd
+
+    # Fake tunnel: a TCP listener on the picked port.
+    listener = (
+        'import socket, sys, time\n'
+        's = socket.socket()\n'
+        's.bind(("127.0.0.1", int(sys.argv[1])))\n'
+        's.listen(8)\n'
+        'time.sleep(60)\n')
+    monkeypatch.setattr(
+        runner, '_tunnel_cmd',
+        lambda port: [sys.executable, '-c', listener, str(port)])
+
+    port = runner.ensure_tunnel(timeout=15)
+    assert runner.port == port > 0
+    assert f'127.0.0.1-{port}' in runner._control_path
+    # ssh goes through the tunnel, not at the pod directly.
+    base = runner._ssh_base()
+    assert '-p' in base and str(port) in base
+    assert base[-1] == 'u@127.0.0.1'
+    # Idempotent while alive.
+    assert runner.ensure_tunnel() == port
+
+    # Kill the tunnel: next ensure restarts on a fresh port.
+    runner._tunnel.kill()
+    runner._tunnel.wait()
+    port2 = runner.ensure_tunnel(timeout=15)
+    assert runner._tunnel.poll() is None
+    runner.close()
+    assert runner._tunnel is None
+    del port2
+
+
+def test_port_forward_runner_from_host_entry():
+    from skypilot_tpu.utils import command_runner as cr
+    runner = cr.runner_from_host_entry({
+        'kind': 'k8s', 'mode': 'port-forward', 'namespace': 'ns',
+        'pod': 'p0', 'user': 'sky', 'key': '/tmp/key',
+    })
+    assert isinstance(runner, cr.KubernetesPortForwardRunner)
+    # Default (no mode) stays on the exec runner.
+    runner2 = cr.runner_from_host_entry({
+        'kind': 'k8s', 'namespace': 'ns', 'pod': 'p0',
+    })
+    assert isinstance(runner2, cr.KubernetesCommandRunner)
+    assert not isinstance(runner2, cr.KubernetesPortForwardRunner)

@@ -6,6 +6,7 @@ import numpy as np
 
 from skypilot_tpu import models
 from skypilot_tpu.parallel import make_mesh
+import pytest
 
 
 def _toy_batch(cfg, b=4, seed=0):
@@ -23,6 +24,7 @@ def test_forward_shapes():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_loss_decreases():
     cfg = models.LlamaConfig.tiny()
     state, opt = models.init_train_state(cfg, jax.random.PRNGKey(0))
@@ -36,6 +38,7 @@ def test_loss_decreases():
     assert int(state.step) == 8
 
 
+@pytest.mark.slow
 def test_sharded_train_matches_single_device():
     cfg = models.LlamaConfig.tiny(remat=False)
     batch = _toy_batch(cfg)
@@ -72,6 +75,7 @@ def test_sequence_parallel_forward_matches():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sharded_train_step_no_involuntary_remat(capfd):
     """Compiling the full sharded train step over the (fsdp, sp, tp)
     mesh must not hit XLA SPMD's replicate-as-last-resort path
@@ -94,20 +98,22 @@ def test_sharded_train_step_no_involuntary_remat(capfd):
     assert 'Involuntary full rematerialization' not in err, err
 
 
+@pytest.mark.slow
 def test_selective_remat_matches_full():
     """remat='dots' (save matmuls, recompute elementwise) computes
     the same loss/gradients as full remat."""
     import jax.numpy as jnp
     batch = _toy_batch(models.LlamaConfig.tiny())
     losses = {}
-    for remat in (True, 'dots'):
+    for remat in (True, 'dots', 'kvo', 'qkvo'):
         cfg = models.LlamaConfig.tiny(remat=remat)
         params = models.init_params(cfg, jax.random.PRNGKey(0))
         loss, grads = jax.value_and_grad(models.loss_fn)(
             params, batch, cfg)
         losses[remat] = (float(loss),
                          float(jnp.sum(grads['tok_emb'] ** 2)))
-    np.testing.assert_allclose(losses[True][0], losses['dots'][0],
-                               rtol=1e-5)
-    np.testing.assert_allclose(losses[True][1], losses['dots'][1],
-                               rtol=1e-4)
+    for mode in ('dots', 'kvo', 'qkvo'):
+        np.testing.assert_allclose(losses[True][0], losses[mode][0],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(losses[True][1], losses[mode][1],
+                                   rtol=1e-4)

@@ -89,6 +89,7 @@ def test_managed_job_cancel(isolated_state):
     assert job['status'] == state.ManagedJobStatus.CANCELLED, job
 
 
+@pytest.mark.slow
 def test_jobs_scheduler_limits_parallel_launches(isolated_state,
                                                  monkeypatch):
     """10 jobs submitted, at most N provision concurrently (reference

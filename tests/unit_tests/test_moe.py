@@ -11,6 +11,7 @@ from skypilot_tpu.models import moe
 from skypilot_tpu.parallel import make_mesh
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_aux():
     cfg = models.MoEConfig.tiny_moe()
     params = moe.init_params(cfg, jax.random.PRNGKey(0))
@@ -24,6 +25,7 @@ def test_forward_shapes_and_aux():
     assert 0.5 < float(aux) / cfg.n_layers < 4.0
 
 
+@pytest.mark.slow
 def test_single_expert_matches_dense_llama():
     """n_experts=1, top_k=1, ample capacity => exactly the dense
     Llama block (same weights), proving dispatch loses nothing."""
@@ -53,6 +55,7 @@ def test_single_expert_matches_dense_llama():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_loss_decreases():
     cfg = models.MoEConfig.tiny_moe()
     state, opt = models.init_train_state(cfg, jax.random.PRNGKey(0))
@@ -66,6 +69,7 @@ def test_moe_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_expert_parallel_matches_single_device():
     """tp=2 mesh (experts sharded over 'tp') computes the same loss
     as single-device."""
@@ -88,6 +92,7 @@ def test_expert_parallel_matches_single_device():
     assert 'tp' in sharding.spec
 
 
+@pytest.mark.slow
 def test_capacity_drops_overflow_tokens():
     """A tiny capacity factor forces drops; forward stays finite and
     the dropped tokens contribute zero MoE output (residual only)."""

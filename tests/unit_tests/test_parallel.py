@@ -27,6 +27,7 @@ def test_make_mesh_axes():
     assert mesh.devices.size == 8
 
 
+@pytest.mark.slow
 def test_flagship_pipeline_parallel_train_step():
     """pp=2 in the FLAGSHIP mesh (not the MoE GPipe island): forward
     matches pp=1 exactly and a full train step over
@@ -65,6 +66,7 @@ def test_flagship_pipeline_parallel_train_step():
     assert 'pp' in (wq_shard.spec[0] or ())
 
 
+@pytest.mark.slow
 def test_flagship_pipeline_with_sequence_parallel():
     """pp=2 x sp=2 x tp=2: inside pipeline stages, sp runs as XLA
     auto-sp (ring's nested shard_map is not composable with the
@@ -109,6 +111,7 @@ def test_ring_attention_matches_reference(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_finite():
     b, s, h, d = 1, 32, 2, 8
     mesh = make_mesh(sp=8, fsdp=1)
@@ -121,6 +124,7 @@ def test_ring_attention_grad_finite():
     assert np.isfinite(np.asarray(g)).all()
 
 
+@pytest.mark.slow
 def test_ring_attention_gqa_native():
     """K/V enter the ring at n_kv_heads (no repeat) and still match
     the reference's GQA attention."""
@@ -174,6 +178,7 @@ def test_ring_attention_zigzag_layout():
         range(s - chunk, s))
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential():
     """GPipe pipeline over a 4-stage 'pp' mesh == sequential layer
     scan (forward and gradients)."""

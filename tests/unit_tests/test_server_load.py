@@ -72,6 +72,7 @@ def _worker_pids():
     return out
 
 
+@pytest.mark.slow
 def test_concurrent_launches_consistent_and_no_leaks(live_server):
     import skypilot_tpu as sky
     from skypilot_tpu.client import sdk

@@ -44,6 +44,7 @@ def test_engine_matches_static_generate():
         assert results[i].tokens == want, (i, results[i].tokens, want)
 
 
+@pytest.mark.slow
 def test_slot_recycling_serves_more_requests_than_slots():
     """5 requests through 2 slots: recycled slots must not leak the
     previous occupant's KV (every output matches its solo decode)."""
@@ -60,6 +61,7 @@ def test_slot_recycling_serves_more_requests_than_slots():
         assert results[i].tokens == want, (i, results[i].tokens, want)
 
 
+@pytest.mark.slow
 def test_mixed_lengths_interleaved_admission():
     """A long request keeps running while short ones come and go —
     the hallmark of continuous batching."""
@@ -92,6 +94,7 @@ def test_capacity_reset():
         assert results[i].tokens == _solo_generate(params, cfg, p, 8)
 
 
+@pytest.mark.slow
 def test_int8_kv_cache_close_to_bf16():
     cfg, params = _setup()
     b, s = 2, 13
@@ -169,6 +172,7 @@ def test_engine_rejections():
                       max_seq=64)
 
 
+@pytest.mark.slow
 def test_max_new_equal_to_decode_capacity():
     """A request whose max_new consumes the decode region exactly must
     finish cleanly: with pipelined dispatch the slot frees one tick

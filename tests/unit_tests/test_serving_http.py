@@ -25,6 +25,7 @@ def stack():
     server.stop()
 
 
+@pytest.mark.slow
 def test_generate_through_lb(stack):
     cfg, params, server = stack
 
@@ -74,6 +75,7 @@ def test_generate_through_lb(stack):
         assert body['latency_s'] > 0
 
 
+@pytest.mark.slow
 def test_oversized_request_rejected(stack):
     cfg, params, server = stack
 
@@ -94,6 +96,7 @@ def test_oversized_request_rejected(stack):
     assert status == 400 and 'exceeds max_prompt' in body['error']
 
 
+@pytest.mark.slow
 def test_streaming_generate_through_lb(stack):
     """stream:true yields SSE token chunks whose concatenation equals
     the non-streaming result (greedy decode), proxied through the LB's
