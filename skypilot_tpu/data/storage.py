@@ -317,11 +317,14 @@ class AzureBlobStore(AbstractStore):
             if self.exclude_git and os.path.isdir(
                     os.path.join(src, '.git')):
                 # upload-batch has include-patterns only; honoring the
-                # '.git/*' exclusion (like GCS/S3/R2) means staging a
-                # copy without it.
+                # '.git/*' exclusion (like GCS/S3/R2) means staging —
+                # via tar --exclude, so only the bytes that will
+                # upload are copied (cp-then-delete would stage the
+                # whole .git object store too).
                 self._run(
-                    f'azup=$(mktemp -d) && cp -a {src}/. "$azup"/ && '
-                    f'rm -rf "$azup"/.git && '
+                    f'azup=$(mktemp -d) && '
+                    f'tar -C {src} --exclude .git -cf - . | '
+                    f'tar -xf - -C "$azup" && '
                     f'az storage blob upload-batch -d {self.name} '
                     f'-s "$azup" --overwrite && rm -rf "$azup"')
             else:

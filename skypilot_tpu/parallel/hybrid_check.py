@@ -24,9 +24,19 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
+
+
+def _with_device_count(flags: str, n: int) -> str:
+    """Replace (or add) the host-device-count flag, preserving every
+    other XLA flag in the string."""
+    flags = re.sub(r'--xla_force_host_platform_device_count=\S+', '',
+                   flags).strip()
+    return (f'{flags} '
+            f'--xla_force_host_platform_device_count={n}').strip()
 
 _STEPS = 2
 _BATCH = 8           # global batch rows
@@ -142,11 +152,8 @@ def main() -> int:
                                        coordinator_port=port))
         env['JAX_PLATFORMS'] = 'cpu'
         env.pop('PALLAS_AXON_POOL_IPS', None)
-        env['XLA_FLAGS'] = (
-            env.get('XLA_FLAGS', '').split(
-                '--xla_force_host_platform_device_count')[0].strip() +
-            f' --xla_force_host_platform_device_count={args.local}'
-        ).strip()
+        env['XLA_FLAGS'] = _with_device_count(
+            env.get('XLA_FLAGS', ''), args.local)
         env['_SKYTPU_HYBRID_ROLE'] = 'child'
         env['_SKYTPU_HYBRID_OUT'] = os.path.join(
             tmpdir, f'rank{rank}.json')
@@ -173,11 +180,8 @@ def main() -> int:
     # Single-process oracle in THIS process (no jax backend touched
     # until now, so the device count/platform can still be forced).
     n = args.procs * args.local
-    flags = os.environ.get('XLA_FLAGS', '')
-    if '--xla_force_host_platform_device_count' not in flags:
-        os.environ['XLA_FLAGS'] = (
-            flags +
-            f' --xla_force_host_platform_device_count={n}').strip()
+    os.environ['XLA_FLAGS'] = _with_device_count(
+        os.environ.get('XLA_FLAGS', ''), n)
     _force_cpu()
     oracle = _oracle(args.procs, args.local)
 

@@ -79,6 +79,7 @@ def run_instances(
     existing = {vm['name']: vm for vm in _list_vms(rg)}
     created: List[str] = []
     resumed: List[str] = []
+    to_create: List[str] = []
     for idx in range(config.count):
         name = _vm_name(cluster, idx)
         vm = existing.get(name)
@@ -91,15 +92,23 @@ def run_instances(
                     raise api.translate_error(e, 'vm start') from e
                 resumed.append(name)
             continue
+        to_create.append(name)
+
+    def _create(name: str) -> None:
         argv = [
             'vm', 'create', '-g', rg, '-n', name,
             '--image', node.get('image_id') or DEFAULT_IMAGE,
             '--size', node['instance_type'],
             '--admin-username', SSH_USER,
-            '--tags', f'{_CLUSTER_TAG}={cluster}',
             '--os-disk-size-gb', str(node.get('disk_size') or 256),
             '--public-ip-sku', 'Standard',
         ]
+        # ONE --tags flag taking space-separated k=v pairs: repeated
+        # --tags occurrences overwrite each other in the az CLI (last
+        # wins), which would silently drop the cluster tag.
+        argv += ['--tags', f'{_CLUSTER_TAG}={cluster}']
+        argv += [f'{k}={v}'
+                 for k, v in (node.get('labels') or {}).items()]
         if node.get('ssh_public_key'):
             argv += ['--ssh-key-values', node['ssh_public_key']]
         else:
@@ -110,13 +119,24 @@ def run_instances(
             # shape as a GCP TPU preemption).
             argv += ['--priority', 'Spot',
                      '--eviction-policy', 'Deallocate']
-        for k, v in (node.get('labels') or {}).items():
-            argv += ['--tags', f'{k}={v}']
         try:
             api.run_az(argv)
         except api.AzCliError as e:
             raise api.translate_error(e, 'vm create') from e
-        created.append(name)
+
+    if to_create:
+        # Parallel synchronous creates: `az vm create` blocks 1-3 min
+        # per VM (serial = tens of minutes for a pod-scale cluster),
+        # while --no-wait would defer allocation errors past the
+        # create call and lose the stockout/quota taxonomy the
+        # failover provisioner keys on. Threads keep both.
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(16, len(to_create))) as pool:
+            futures = {pool.submit(_create, n): n for n in to_create}
+            for fut in concurrent.futures.as_completed(futures):
+                fut.result()   # re-raise the first typed error
+                created.append(futures[fut])
     all_names = sorted(set(existing) | set(created))
     if not all_names:
         raise exceptions.ProvisionError('run_instances created nothing')
@@ -234,14 +254,19 @@ def terminate_instances(cluster_name_on_cloud: str, region: str,
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                region: str, zone: Optional[str]) -> None:
     del region, zone
+    if not ports:
+        return
     rg = resource_group(cluster_name_on_cloud)
+    # One call with a comma-joined port list: per-port calls would
+    # each create an NSG rule at the default priority (900) and the
+    # second one fails Azure's unique-priority constraint.
+    port_arg = ','.join(str(p) for p in ports)
     for vm in _list_vms(rg):
-        for port in ports:
-            try:
-                api.run_az(['vm', 'open-port', '-g', rg, '-n',
-                            vm['name'], '--port', str(port)])
-            except api.AzCliError as e:
-                raise api.translate_error(e, 'vm open-port') from e
+        try:
+            api.run_az(['vm', 'open-port', '-g', rg, '-n',
+                        vm['name'], '--port', port_arg])
+        except api.AzCliError as e:
+            raise api.translate_error(e, 'vm open-port') from e
 
 
 def cleanup_ports(cluster_name_on_cloud: str, region: str,

@@ -215,8 +215,15 @@ def query_instances(
 
 def get_cluster_info(cluster_name_on_cloud: str, region: str,
                      zone: Optional[str]) -> common.ClusterInfo:
+    from skypilot_tpu import skypilot_config
     client = _client(region)
     pods = client.list_pods(_selector(cluster_name_on_cloud))
+    # Exec-less clusters: `kubernetes: {runner: port-forward}` in
+    # ~/.skytpu/config.yaml routes commands over SSH through a
+    # kubectl port-forward tunnel instead of the exec channel
+    # (KubernetesPortForwardRunner; the pod must run sshd).
+    runner_mode = skypilot_config.get_nested(('kubernetes', 'runner'),
+                                             None)
     instances: Dict[str, List[common.InstanceInfo]] = {}
     head_id = None
     for pod in sorted(
@@ -235,10 +242,14 @@ def get_cluster_info(cluster_name_on_cloud: str, region: str,
                 host_index=0,
                 tags={
                     # Host-entry routing: command runner goes through
-                    # kubectl exec, not ssh (no sshd in the pods).
+                    # kubectl exec, not ssh (no sshd in the pods) —
+                    # unless runner_mode requests the port-forward
+                    # tunnel for exec-less clusters.
                     'k8s_pod': name,
                     'k8s_namespace': client.namespace,
                     'k8s_context': client.ctx.context_name,
+                    **({'k8s_runner_mode': runner_mode}
+                       if runner_mode else {}),
                 },
             )
         ]

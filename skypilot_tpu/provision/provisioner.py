@@ -58,14 +58,23 @@ def host_entries(cluster_info: common.ClusterInfo,
                 'host_dir': host_dir,
             })
         elif host.tags.get('k8s_pod') is not None:
-            entries.append({
+            entry = {
                 'kind': 'k8s',
                 'host_id': f'{host.instance_id}-h{host.host_index}',
                 'ip': host.get_feasible_ip(),
                 'pod': host.tags['k8s_pod'],
                 'namespace': host.tags.get('k8s_namespace', 'default'),
                 'context': host.tags.get('k8s_context'),
-            })
+            }
+            # Exec-less clusters (admission policy denies kubectl
+            # exec): the provisioner tags hosts with the port-forward
+            # runner mode (kubernetes.runner: port-forward in config),
+            # and commands go over SSH through a kubectl tunnel.
+            if host.tags.get('k8s_runner_mode'):
+                entry['mode'] = host.tags['k8s_runner_mode']
+                entry['user'] = cluster_info.ssh_user
+                entry['key'] = ssh_private_key
+            entries.append(entry)
         else:
             entries.append({
                 'kind': 'ssh',

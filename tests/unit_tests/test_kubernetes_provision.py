@@ -416,3 +416,37 @@ def test_port_forward_runner_from_host_entry():
     })
     assert isinstance(runner2, cr.KubernetesCommandRunner)
     assert not isinstance(runner2, cr.KubernetesPortForwardRunner)
+
+
+def test_port_forward_mode_reaches_host_entries(k8s_env, monkeypatch):
+    """kubernetes.runner: port-forward in config flows through
+    get_cluster_info tags into hosts.json entries, activating the
+    tunnel runner on exec-less clusters."""
+    from skypilot_tpu import skypilot_config
+    from skypilot_tpu.provision import provisioner as prov
+    from skypilot_tpu.utils import command_runner as cr
+
+    pods = [{
+        'metadata': {'name': 'c-head',
+                     'labels': {'skypilot-tpu/cluster': 'c',
+                                'skypilot-tpu/role': 'head',
+                                'skypilot-tpu/host-index': '0'}},
+        'status': {'phase': 'Running', 'podIP': '10.1.0.5'},
+    }]
+
+    def handler(method, url, body, params):
+        if method == 'GET' and url.endswith('/pods'):
+            return 200, {'items': pods}
+        raise AssertionError((method, url))
+
+    k8s_env(handler)
+    monkeypatch.setattr(
+        skypilot_config, 'get_nested',
+        lambda keys, default=None: ('port-forward'
+                                    if keys == ('kubernetes', 'runner')
+                                    else default))
+    info = k8s_instance.get_cluster_info('c', None, None)
+    entries = prov.host_entries(info, ssh_private_key='/tmp/key')
+    assert entries[0]['mode'] == 'port-forward'
+    runner = cr.runner_from_host_entry(entries[0])
+    assert isinstance(runner, cr.KubernetesPortForwardRunner)
