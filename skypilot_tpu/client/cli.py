@@ -17,6 +17,7 @@ import click
 import yaml
 
 from skypilot_tpu.client import sdk
+from skypilot_tpu.utils import rich_utils
 
 
 def _load_task(entrypoint: str, **overrides):
@@ -99,21 +100,26 @@ def exec_cmd(cluster: str, entrypoint: str, name: Optional[str]) -> None:
 @click.option('--refresh', '-r', is_flag=True, default=False)
 def status(refresh: bool) -> None:
     """Show clusters."""
-    rows = sdk.get(sdk.status(refresh=refresh))
+    with rich_utils.client_status(
+            'Refreshing cluster status from the cloud...'
+            if refresh else 'Fetching cluster status...'):
+        rows = sdk.get(sdk.status(refresh=refresh))
     _echo_table(rows, ['name', 'status', 'resources', 'autostop'])
 
 
 @cli.command()
 @click.argument('cluster')
 def stop(cluster: str) -> None:
-    sdk.get(sdk.stop(cluster))
+    with rich_utils.client_status(f'Stopping cluster {cluster}...'):
+        sdk.get(sdk.stop(cluster))
     click.echo(f'Cluster {cluster} stopped.')
 
 
 @cli.command()
 @click.argument('cluster')
 def start(cluster: str) -> None:
-    sdk.get(sdk.start(cluster))
+    with rich_utils.client_status(f'Starting cluster {cluster}...'):
+        sdk.get(sdk.start(cluster))
     click.echo(f'Cluster {cluster} started.')
 
 
@@ -121,7 +127,8 @@ def start(cluster: str) -> None:
 @click.argument('cluster')
 @click.option('--purge', is_flag=True, default=False)
 def down(cluster: str, purge: bool) -> None:
-    sdk.get(sdk.down(cluster, purge=purge))
+    with rich_utils.client_status(f'Terminating cluster {cluster}...'):
+        sdk.get(sdk.down(cluster, purge=purge))
     click.echo(f'Cluster {cluster} terminated.')
 
 

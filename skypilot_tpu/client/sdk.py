@@ -36,10 +36,33 @@ def server_url() -> str:
     return f'http://127.0.0.1:{DEFAULT_PORT}'
 
 
+# API versions this client can talk to. A server outside the range
+# fails FAST with an actionable message instead of surfacing as
+# mysterious 404s/shape errors mid-request (the failure mode the
+# reference's backward_compatibility_tests.sh harness guards).
+MIN_API_VERSION = 1
+MAX_API_VERSION = 1
+
+
+def _check_api_version(body: dict, url: str) -> None:
+    version = body.get('api_version')
+    if version is None:
+        return   # pre-versioning server: let requests proceed
+    if not MIN_API_VERSION <= version <= MAX_API_VERSION:
+        raise exceptions.ApiVersionMismatchError(
+            f'API server at {url} speaks version {version}; this '
+            f'client supports {MIN_API_VERSION}..{MAX_API_VERSION}. '
+            'Upgrade the older side (server: redeploy; client: pip '
+            'install -U / git pull).')
+
+
 def _healthy(url: str) -> bool:
     try:
         resp = http.get(url + '/api/health', timeout=2)
-        return resp.status_code == 200
+        if resp.status_code != 200:
+            return False
+        _check_api_version(resp.json(), url)
+        return True
     except http.RequestException:
         return False
 

@@ -6,6 +6,7 @@ from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
+from skypilot_tpu.clouds.lambda_cloud import LambdaCloud
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
@@ -16,5 +17,6 @@ __all__ = [
     'Region',
     'GCP',
     'Kubernetes',
+    'LambdaCloud',
     'Local',
 ]

@@ -1,0 +1,155 @@
+"""Lambda Cloud — long-tail GPU cloud (the reference's most-used
+neocloud plugin).
+
+Re-design of reference ``sky/clouds/lambda_cloud.py`` (303 LoC):
+catalog-backed feasibility/pricing behind the standard seam, REST
+provision plugin (``provision/lambda_cloud/``). Lambda has no
+regions-with-zones (region only), no spot market, and no stop
+operation — STOP/AUTOSTOP are declared unsupported so the optimizer
+and autostop paths degrade cleanly. No TPUs here.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+_CREDENTIAL_HINT = (
+    'Set LAMBDA_API_KEY or write ~/.lambda_cloud/lambda_keys '
+    "('api_key = <key>').")
+
+
+@registry.CLOUD_REGISTRY.register(name='lambda',
+                                  aliases=['lambda_cloud',
+                                           'lambdacloud'])
+class LambdaCloud(cloud_lib.Cloud):
+    """Lambda Cloud (GPU VMs over REST)."""
+
+    _REPR = 'Lambda'
+    MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    @classmethod
+    def canonical_name(cls) -> str:
+        return 'lambda'
+
+    def provider_name(self) -> str:
+        # 'lambda' is a Python keyword: the provision module lives at
+        # provision/lambda_cloud/.
+        return 'lambda_cloud'
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud_lib.CloudImplementationFeatures.STOP:
+                'Lambda Cloud cannot stop instances, only terminate.',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'Use autodown (no stop operation on Lambda Cloud).',
+            cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+                'Lambda Cloud has no spot market.',
+        }
+
+    # ------------------------------------------------------------------
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        if resources.is_tpu:
+            return []
+        instance_type = (resources.instance_type or
+                         catalog.get_default_instance_type(
+                             resources.cpus, resources.memory,
+                             cloud='lambda'))
+        if instance_type is None:
+            return []
+        regions = sorted({
+            o.region
+            for o in catalog.get_instance_offerings(
+                instance_type, resources.region, None, cloud='lambda')
+        })
+        return [cloud_lib.Region(name) for name in regions]
+
+    def zones_provision_loop(self, resources: 'Resources',
+                             region: Optional[str] = None):
+        for r in self.regions_with_offering(resources):
+            if region is not None and r.name != region:
+                continue
+            yield (r.name, None)
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        if resources.cloud is not None and not self.is_same_cloud(
+                resources.cloud):
+            return []
+        if resources.is_tpu or resources.use_spot:
+            return []
+        instance_type = resources.instance_type
+        if instance_type is None:
+            instance_type = catalog.get_default_instance_type(
+                resources.cpus, resources.memory, cloud='lambda')
+            if instance_type is None:
+                return []
+        if not catalog.get_instance_offerings(
+                instance_type, resources.region, None, cloud='lambda'):
+            return []
+        return [resources.copy(cloud=self, instance_type=instance_type)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        assert resources.instance_type is not None, resources
+        return catalog.get_hourly_cost(resources.instance_type,
+                                       resources.use_spot,
+                                       resources.region, None,
+                                       cloud='lambda')
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError('Lambda Cloud has regions, not zones.')
+        return catalog.validate_region_zone(region, None)
+
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'image_id': None,   # Lambda picks its own Ubuntu image
+            'labels': resources.labels or {},
+            'ports': resources.ports or [],
+            'num_hosts': 1,
+        }
+
+    # ------------------------------------------------------------------
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.lambda_cloud import api
+        if api.read_api_key():
+            return True, None
+        return False, 'No Lambda Cloud API key. ' + _CREDENTIAL_HINT
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        from skypilot_tpu.provision.lambda_cloud import api
+        path = os.path.expanduser(api.CREDENTIALS_PATH)
+        if os.path.exists(path):
+            return {api.CREDENTIALS_PATH: path}
+        return {}
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        from skypilot_tpu.provision.lambda_cloud import api
+        key = api.read_api_key()
+        # The key itself is the identity; report a stable digest, not
+        # the secret.
+        if key:
+            import hashlib
+            return [[hashlib.sha256(key.encode()).hexdigest()[:16]]]
+        return None

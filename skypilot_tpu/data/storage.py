@@ -267,6 +267,26 @@ class R2Store(S3Store):
         return f'r2://{self.name}'
 
     def mount_command(self, mount_path: str) -> str:
+        # Two FUSE adapters (completing the reference's 4-tool matrix
+        # goofys/gcsfuse/blobfuse2/rclone,
+        # sky/data/mounting_utils.py:25-268): goofys --endpoint by
+        # default; SKYTPU_R2_MOUNT_TOOL=rclone switches to rclone
+        # configured entirely via env vars (the reference's R2/IBM
+        # adapter), which needs no config file on the host.
+        if os.environ.get('SKYTPU_R2_MOUNT_TOOL') == 'rclone':
+            install = ('which rclone >/dev/null 2>&1 || '
+                       '(curl -sSL https://rclone.org/install.sh | '
+                       'sudo bash)')
+            env = (f'RCLONE_CONFIG_R2_TYPE=s3 '
+                   f'RCLONE_CONFIG_R2_PROVIDER=Cloudflare '
+                   f'RCLONE_CONFIG_R2_ENDPOINT={self.endpoint()} '
+                   f'RCLONE_CONFIG_R2_ENV_AUTH=true '
+                   f'AWS_SHARED_CREDENTIALS_FILE='
+                   f'{self.CREDENTIALS_PATH} AWS_PROFILE=r2')
+            return (f'{install}; mkdir -p {mount_path} && '
+                    f'(mountpoint -q {mount_path} || '
+                    f'{env} rclone mount r2:{self.name} {mount_path} '
+                    f'--daemon --vfs-cache-mode writes)')
         install = (
             'which goofys >/dev/null 2>&1 || '
             '(sudo curl -sSL https://github.com/kahing/goofys/releases/'

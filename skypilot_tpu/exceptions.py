@@ -139,3 +139,7 @@ class ApiServerConnectionError(SkyTpuError):
             f'Could not connect to API server at {server_url}. '
             'Start one with `skytpu api start`.')
         self.server_url = server_url
+
+
+class ApiVersionMismatchError(SkyTpuError):
+    """Client and API server speak incompatible protocol versions."""

@@ -109,10 +109,14 @@ def run_instances(
         argv += ['--tags', f'{_CLUSTER_TAG}={cluster}']
         argv += [f'{k}={v}'
                  for k, v in (node.get('labels') or {}).items()]
-        if node.get('ssh_public_key'):
-            argv += ['--ssh-key-values', node['ssh_public_key']]
-        else:
-            argv += ['--generate-ssh-keys']
+        public_key = node.get('ssh_public_key')
+        if not public_key:
+            # Install the FRAMEWORK keypair: post-provision SSH uses
+            # ~/.skytpu/keys (gang_backend), which an az-generated
+            # keypair would not match.
+            from skypilot_tpu import authentication
+            public_key = authentication.public_key_openssh()
+        argv += ['--ssh-key-values', public_key]
         if node.get('use_spot'):
             # Deallocate on eviction: the jobs controller's preemption
             # reconciler sees a 'stopped' VM and recovers (same signal

@@ -1,0 +1,117 @@
+"""Minimal Lambda Cloud REST client.
+
+Re-design of reference ``sky/provision/lambda_cloud/lambda_utils.py``
+(metadata client): bearer-token REST against
+``cloud.lambdalabs.com/api/v1`` — instances are launched/terminated
+through ``instance-operations`` and listed via ``/instances``; the
+cloud has no tags, so cluster membership rides instance NAMES
+(``<cluster>-<idx>``), and no stop operation exists (terminate only).
+
+The ``http`` seam (a requests.Session-alike) is replaced with a fake
+in tests, same pattern as the aws/azure plugins.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://cloud.lambdalabs.com/api/v1'
+CREDENTIALS_PATH = '~/.lambda_cloud/lambda_keys'
+
+
+class LambdaApiError(Exception):
+    pass
+
+
+def read_api_key() -> Optional[str]:
+    """api_key from the env or the reference-compatible keys file
+    (``api_key = <value>`` lines)."""
+    key = os.environ.get('LAMBDA_API_KEY')
+    if key:
+        return key
+    try:
+        with open(os.path.expanduser(CREDENTIALS_PATH),
+                  encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('api_key'):
+                    return line.split('=', 1)[1].strip()
+    except OSError:
+        pass
+    return None
+
+
+def _requests_session():
+    import requests
+    return requests.Session()
+
+
+# Test seam.
+session_factory = _requests_session
+
+
+class LambdaClient:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        self.api_key = api_key or read_api_key()
+        if not self.api_key:
+            raise exceptions.ProvisionError(
+                'No Lambda Cloud API key (set LAMBDA_API_KEY or '
+                f'write {CREDENTIALS_PATH}).')
+        self.http = session_factory()
+
+    def _call(self, method: str, path: str,
+              json: Optional[Dict[str, Any]] = None) -> Any:
+        resp = self.http.request(
+            method, f'{API_ENDPOINT}{path}', json=json,
+            headers={'Authorization': f'Bearer {self.api_key}'},
+            timeout=60)
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {}
+        if resp.status_code >= 400:
+            err = body.get('error', {})
+            raise translate_error(
+                f"{err.get('code', resp.status_code)}: "
+                f"{err.get('message', resp.text[:200])}", path)
+        return body.get('data')
+
+    # ------------------------------------------------------------ ops
+    def list_instances(self) -> list:
+        return self._call('GET', '/instances') or []
+
+    def launch(self, *, region: str, instance_type: str, name: str,
+               ssh_key_names: list) -> list:
+        data = self._call(
+            'POST', '/instance-operations/launch',
+            json={
+                'region_name': region,
+                'instance_type_name': instance_type,
+                'ssh_key_names': ssh_key_names,
+                'quantity': 1,
+                'name': name,
+            })
+        return (data or {}).get('instance_ids', [])
+
+    def terminate(self, instance_ids: list) -> None:
+        self._call('POST', '/instance-operations/terminate',
+                   json={'instance_ids': instance_ids})
+
+    def list_ssh_keys(self) -> list:
+        return self._call('GET', '/ssh-keys') or []
+
+    def add_ssh_key(self, name: str, public_key: str) -> None:
+        self._call('POST', '/ssh-keys',
+                   json={'name': name, 'public_key': public_key})
+
+
+def translate_error(message: str, what: str) -> Exception:
+    blob = message.lower()
+    if ('insufficient-capacity' in blob or 'capacity' in blob or
+            'not enough' in blob):
+        return exceptions.StockoutError(f'{what}: {message}')
+    if 'quota' in blob or 'limit' in blob:
+        return exceptions.QuotaExceededError(f'{what}: {message}')
+    return exceptions.ProvisionError(f'{what}: {message}')

@@ -1,0 +1,231 @@
+"""Lambda Cloud provision ops.
+
+Re-design of reference ``sky/provision/lambda_cloud/instance.py`` on
+this framework's seam: NAME-scoped cluster membership (the API has no
+tags — instances are named ``<cluster>-<idx>`` and listed by prefix),
+one launch call per missing index, terminate by collected ids. The
+cloud cannot stop instances, so the cloud layer declares STOP
+unsupported and ``stop_instances`` raises.
+
+Status mapping: Lambda's ``booting``/``active``/``unhealthy``/
+``terminating`` -> 'pending'/'running'/'pending'/'terminated'.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.lambda_cloud import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_TIMEOUT = 1800.0   # GPU boxes can take a while to boot
+_POLL_INTERVAL = 5.0
+
+SSH_USER = 'ubuntu'
+
+
+def _vm_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}'
+
+
+def _cluster_instances(client: api.LambdaClient,
+                       cluster: str) -> Dict[str, Dict[str, Any]]:
+    """name -> instance for this cluster's members (name prefix).
+
+    When a dying and a live instance briefly share a name (relaunch
+    right after a terminate), the LIVE one wins the key so status/
+    info paths never report the corpse."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for inst in client.list_instances():
+        name = inst.get('name') or ''
+        if not name.startswith(f'{cluster}-'):
+            continue
+        prev = out.get(name)
+        if prev is not None and prev.get('status') not in (
+                'terminating', 'terminated'):
+            continue
+        out[name] = inst
+    return out
+
+
+def _ensure_ssh_key(client: api.LambdaClient,
+                    public_key: Optional[str]) -> List[str]:
+    """Register (once) and return the ssh key name to launch with."""
+    if not public_key:
+        keys = client.list_ssh_keys()
+        if not keys:
+            raise exceptions.ProvisionError(
+                'No SSH keys registered with Lambda Cloud and no '
+                'ssh_public_key provided.')
+        return [keys[0]['name']]
+    digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
+    key_name = f'skytpu-{digest}'
+    if not any(k.get('name') == key_name
+               for k in client.list_ssh_keys()):
+        client.add_ssh_key(key_name, public_key)
+    return [key_name]
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    """Nothing to pre-create (no VPCs/groups on Lambda)."""
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    cluster = config.cluster_name_on_cloud
+    client = api.LambdaClient()
+    public_key = node.get('ssh_public_key')
+    if not public_key:
+        # The framework keypair must be installed on the instances or
+        # every post-provision SSH (runtime setup, gang exec) fails:
+        # gang_backend connects with ~/.skytpu/keys, not whatever key
+        # happens to be registered with the Lambda account.
+        from skypilot_tpu import authentication
+        public_key = authentication.public_key_openssh()
+    key_names = _ensure_ssh_key(client, public_key)
+    created: List[str] = []
+    for idx in range(config.count):
+        name = _vm_name(cluster, idx)
+        inst = _cluster_instances(client, cluster).get(name)
+        if inst is not None:
+            status = inst.get('status')
+            if status not in ('terminating', 'terminated'):
+                continue
+            if status == 'terminating':
+                # Same-named launch while the old instance is dying
+                # would collide in the name-keyed membership map
+                # (down immediately followed by launch): wait for
+                # the name to free first.
+                deadline = time.time() + 300
+                while time.time() < deadline:
+                    cur = _cluster_instances(client, cluster).get(name)
+                    if cur is None or cur.get('status') == 'terminated':
+                        break
+                    time.sleep(_POLL_INTERVAL)
+        ids = client.launch(region=config.region,
+                            instance_type=node['instance_type'],
+                            name=name,
+                            ssh_key_names=key_names)
+        created.extend(ids)
+    return common.ProvisionRecord(
+        provider_name='lambda_cloud',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        head_instance_id=_vm_name(cluster, 0),
+    )
+
+
+def _status(inst: Dict[str, Any]) -> str:
+    return {
+        'active': 'running',
+        'booting': 'pending',
+        'unhealthy': 'pending',
+        'terminating': 'terminated',
+        'terminated': 'terminated',
+    }.get(inst.get('status', ''), 'pending')
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    client = api.LambdaClient()
+    want = state or 'running'
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        insts = _cluster_instances(client, cluster_name_on_cloud)
+        if want == 'terminated':
+            if not insts or all(_status(i) == 'terminated'
+                                for i in insts.values()):
+                return
+        elif insts and all(_status(i) == want
+                           for i in insts.values()):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} to reach '
+        f'{want!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del region, zone
+    client = api.LambdaClient()
+    out: Dict[str, Optional[str]] = {}
+    for name, inst in _cluster_instances(client,
+                                         cluster_name_on_cloud).items():
+        status = _status(inst)
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[name] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    client = api.LambdaClient()
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for name, inst in sorted(
+            _cluster_instances(client, cluster_name_on_cloud).items()):
+        infos[name] = [
+            common.InstanceInfo(
+                instance_id=inst.get('id', name),
+                internal_ip=inst.get('private_ip') or
+                inst.get('ip', ''),
+                external_ip=inst.get('ip'),
+                host_index=0,
+                tags={'name': name},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='lambda_cloud',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user=SSH_USER,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    raise exceptions.NotSupportedError(
+        'Lambda Cloud cannot stop instances, only terminate '
+        '(the cloud layer declares STOP unsupported).')
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    client = api.LambdaClient()
+    ids = [
+        inst.get('id') for inst in
+        _cluster_instances(client, cluster_name_on_cloud).values()
+        if inst.get('status') not in ('terminating', 'terminated')
+    ]
+    if ids:
+        client.terminate(ids)
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    logger.info('lambda_cloud: instances have open ingress by '
+                'default; open_ports(%s) is a no-op.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

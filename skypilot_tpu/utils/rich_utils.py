@@ -1,0 +1,75 @@
+"""Terminal status UX: spinners that degrade to plain logging.
+
+Re-design of reference ``sky/utils/rich_utils.py``: long-running CLI
+operations (provisioning, refresh, teardown) show a live spinner with
+updatable text when stdout is an interactive terminal and ``rich`` is
+importable; in pipes, CI, or minimal images the same code path prints
+nothing extra (the operation's own log lines remain the record).
+Nested ``client_status`` calls reuse the outer spinner (the reference
+does the same so helper functions can annotate progress without
+fighting over the terminal).
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+from typing import Iterator, Optional
+
+_active = threading.local()
+
+
+class _NoopStatus:
+    """Fallback and nested-call handle: update() is a cheap no-op."""
+
+    def update(self, message: str) -> None:
+        pass
+
+
+class _RichStatus:
+
+    def __init__(self, status) -> None:
+        self._status = status
+
+    def update(self, message: str) -> None:
+        self._status.update(message)
+
+
+def _rich_console():
+    try:
+        import rich.console
+        return rich.console.Console()
+    except ImportError:
+        return None
+
+
+def safe_status_enabled() -> bool:
+    return sys.stdout.isatty() and _rich_console() is not None
+
+
+@contextlib.contextmanager
+def client_status(message: str) -> Iterator:
+    """Spinner context; yields a handle with .update(message).
+
+    TTY + rich -> live spinner. Otherwise, or when nested inside an
+    active spinner, a no-op handle (the outer spinner keeps spinning;
+    updates from nested scopes retext it).
+    """
+    outer: Optional[object] = getattr(_active, 'status', None)
+    if outer is not None:
+        # Nested: retext the outer spinner, hand out a proxy so
+        # updates keep landing on it.
+        outer.update(message)
+        yield outer
+        return
+    console = _rich_console()
+    if console is None or not sys.stdout.isatty():
+        yield _NoopStatus()
+        return
+    with console.status(message) as status:
+        handle = _RichStatus(status)
+        _active.status = handle
+        try:
+            yield handle
+        finally:
+            _active.status = None

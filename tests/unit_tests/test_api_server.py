@@ -218,3 +218,40 @@ def test_remote_server_workdir_upload_and_log_download(
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_api_version_compat_gate(monkeypatch):
+    """A server outside the client's supported API-version range fails
+    fast with an actionable error (the reference's backward-compat
+    harness guards the same seam); in-range and pre-versioning
+    servers pass."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import sdk
+
+    class _Resp:
+        status_code = 200
+
+        def __init__(self, body):
+            self._body = body
+
+        def json(self):
+            return self._body
+
+    def fake_get(url, timeout=None):
+        return _Resp({'status': 'healthy', 'api_version': 999})
+
+    monkeypatch.setattr(sdk.http, 'get', fake_get)
+    with pytest.raises(exceptions.ApiVersionMismatchError,
+                       match='version 999'):
+        sdk._healthy('http://127.0.0.1:1')
+
+    monkeypatch.setattr(
+        sdk.http, 'get',
+        lambda url, timeout=None: _Resp({'status': 'healthy',
+                                         'api_version': 1}))
+    assert sdk._healthy('http://127.0.0.1:1')
+    # Pre-versioning server (no field): tolerated.
+    monkeypatch.setattr(
+        sdk.http, 'get',
+        lambda url, timeout=None: _Resp({'status': 'healthy'}))
+    assert sdk._healthy('http://127.0.0.1:1')

@@ -1,0 +1,157 @@
+"""Lambda Cloud plugin: REST lifecycle against a fake HTTP session,
+feasibility/pricing, and the no-stop capability gate."""
+import json
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.lambda_cloud import api as lambda_api
+from skypilot_tpu.provision.lambda_cloud import instance as lam
+
+
+class _Resp:
+
+    def __init__(self, status_code, body):
+        self.status_code = status_code
+        self._body = body
+        self.text = json.dumps(body)
+
+    def json(self):
+        return self._body
+
+
+class FakeLambdaHttp:
+    """Plays cloud.lambdalabs.com/api/v1."""
+
+    def __init__(self):
+        self.instances = {}          # id -> dict
+        self.ssh_keys = []
+        self.launch_error = None
+        self._n = 0
+
+    def request(self, method, url, json=None, headers=None,
+                timeout=None):
+        assert headers['Authorization'].startswith('Bearer ')
+        path = url.split('/api/v1', 1)[1]
+        if method == 'GET' and path == '/instances':
+            return _Resp(200, {'data': list(self.instances.values())})
+        if method == 'GET' and path == '/ssh-keys':
+            return _Resp(200, {'data': list(self.ssh_keys)})
+        if method == 'POST' and path == '/ssh-keys':
+            self.ssh_keys.append(dict(json))
+            return _Resp(200, {'data': json})
+        if method == 'POST' and path == '/instance-operations/launch':
+            if self.launch_error is not None:
+                return _Resp(400, {'error': self.launch_error})
+            self._n += 1
+            iid = f'lam-{self._n:04d}'
+            self.instances[iid] = {
+                'id': iid,
+                'name': json['name'],
+                'region': {'name': json['region_name']},
+                'status': 'active',
+                'ip': f'144.0.0.{self._n}',
+                'private_ip': f'10.9.0.{self._n}',
+            }
+            return _Resp(200, {'data': {'instance_ids': [iid]}})
+        if method == 'POST' and path == '/instance-operations/terminate':
+            for iid in json['instance_ids']:
+                self.instances[iid]['status'] = 'terminated'
+            return _Resp(200, {'data': {}})
+        raise AssertionError((method, path))
+
+
+@pytest.fixture
+def lam_http(monkeypatch):
+    fake = FakeLambdaHttp()
+    monkeypatch.setattr(lambda_api, 'session_factory', lambda: fake)
+    monkeypatch.setenv('LAMBDA_API_KEY', 'key-123')
+    monkeypatch.setattr(lam, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _config(count=1):
+    return common.ProvisionConfig(
+        provider_name='lambda_cloud',
+        cluster_name='lc',
+        cluster_name_on_cloud='lc',
+        region='us-east-1',
+        zone=None,
+        node_config={'instance_type': 'gpu_1x_a10',
+                     'ssh_public_key': 'ssh-ed25519 AAAA test',
+                     'labels': {}},
+        count=count,
+    )
+
+
+def test_lifecycle(lam_http):
+    record = lam.run_instances(_config(count=2))
+    assert record.head_instance_id == 'lc-0'
+    assert len(record.created_instance_ids) == 2
+    # The ssh key got registered exactly once.
+    assert len(lam_http.ssh_keys) == 1
+    assert lam_http.ssh_keys[0]['name'].startswith('skytpu-')
+
+    lam.wait_instances('lc', 'us-east-1', None, None)
+    status = lam.query_instances('lc', 'us-east-1', None)
+    assert status == {'lc-0': 'running', 'lc-1': 'running'}
+
+    # Idempotent: rerun creates nothing new (and reuses the key).
+    record2 = lam.run_instances(_config(count=2))
+    assert record2.created_instance_ids == []
+    assert len(lam_http.ssh_keys) == 1
+
+    info = lam.get_cluster_info('lc', 'us-east-1', None)
+    assert info.head_instance_id == 'lc-0'
+    assert info.ssh_user == 'ubuntu'
+    head = info.instances['lc-0'][0]
+    assert head.external_ip.startswith('144.')
+    assert head.internal_ip.startswith('10.9.')
+
+    with pytest.raises(exceptions.NotSupportedError):
+        lam.stop_instances('lc', 'us-east-1', None)
+
+    lam.terminate_instances('lc', 'us-east-1', None)
+    lam.wait_instances('lc', 'us-east-1', None, 'terminated')
+    assert lam.query_instances('lc', 'us-east-1', None) == {}
+
+
+def test_error_taxonomy(lam_http):
+    lam_http.launch_error = {
+        'code': 'instance-operations/launch/insufficient-capacity',
+        'message': 'Not enough capacity in us-east-1.'}
+    with pytest.raises(exceptions.StockoutError):
+        lam.run_instances(_config())
+    lam_http.launch_error = {
+        'code': 'global/quota-exceeded',
+        'message': 'Instance quota exceeded.'}
+    with pytest.raises(exceptions.QuotaExceededError):
+        lam.run_instances(_config())
+
+
+def test_cloud_feasibility_and_caps(lam_http):
+    from skypilot_tpu.clouds import LambdaCloud
+    from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
+    from skypilot_tpu.resources import Resources
+    cloud = LambdaCloud()
+    assert cloud.canonical_name() == 'lambda'
+    assert cloud.provider_name() == 'lambda_cloud'
+    ok, _ = cloud.check_credentials()
+    assert ok
+
+    feas = cloud.get_feasible_launchable_resources(
+        Resources(instance_type='gpu_1x_a10'))
+    assert feas and feas[0].instance_type == 'gpu_1x_a10'
+    assert cloud.hourly_price(feas[0]) == 0.75
+    # No TPUs, no spot.
+    assert cloud.get_feasible_launchable_resources(
+        Resources(accelerators='tpu-v5e-8')) == []
+    assert cloud.get_feasible_launchable_resources(
+        Resources(instance_type='gpu_1x_a10', use_spot=True)) == []
+    caps = cloud.unsupported_features_for_resources(feas[0])
+    assert CloudImplementationFeatures.STOP in caps
+    # Registry round trip incl. aliases.
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    assert CLOUD_REGISTRY.from_str('lambda') is LambdaCloud
+    assert CLOUD_REGISTRY.from_str('lambda_cloud') is LambdaCloud

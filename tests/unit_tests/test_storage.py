@@ -274,3 +274,15 @@ def test_cloud_stores_r2_az_urls(monkeypatch):
     assert 'download-batch' in cmd
     cmd = cloud_stores.download_command('az://ctr/f.txt', '/d/f.txt')
     assert 'az storage blob download -c ctr -n f.txt -f /d/f.txt' in cmd
+
+
+def test_r2_rclone_mount_tool(monkeypatch):
+    from skypilot_tpu.data.storage import R2Store
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+    monkeypatch.setenv('SKYTPU_R2_MOUNT_TOOL', 'rclone')
+    m = R2Store('mybkt').mount_command('/mnt/r2')
+    assert 'rclone mount r2:mybkt /mnt/r2' in m
+    assert 'RCLONE_CONFIG_R2_ENDPOINT=https://acct123.r2.' in m
+    assert '--vfs-cache-mode writes' in m
+    monkeypatch.delenv('SKYTPU_R2_MOUNT_TOOL')
+    assert 'goofys' in R2Store('mybkt').mount_command('/mnt/r2')
