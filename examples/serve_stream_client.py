@@ -1,0 +1,68 @@
+"""Streaming client for a served model: tokens print as they decode.
+
+Usage (against `skytpu serve up examples/llama_serve.yaml`):
+
+    python serve_stream_client.py --endpoint http://<lb-host>:<port> \
+        --tokens 5,6,7 --max-new 64
+
+The service streams server-sent events through the serve load
+balancer's chunk-by-chunk proxy (one `data:` event per decode chunk,
+then a `done` event) — first tokens arrive while the request is still
+decoding, exactly like the reference's JetStream streaming demo.
+"""
+import argparse
+import json
+import sys
+import time
+
+import requests
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--endpoint', required=True)
+    parser.add_argument('--tokens', default='5,6,7',
+                        help='comma-separated prompt token ids')
+    parser.add_argument('--max-new', type=int, default=64)
+    parser.add_argument('--temperature', type=float, default=None)
+    args = parser.parse_args()
+
+    body = {
+        'tokens': [int(t) for t in args.tokens.split(',')],
+        'max_new': args.max_new,
+        'stream': True,
+    }
+    if args.temperature is not None:
+        body['temperature'] = args.temperature
+
+    t0 = time.time()
+    first = None
+    with requests.post(f'{args.endpoint.rstrip("/")}/generate',
+                       json=body, stream=True, timeout=600) as resp:
+        resp.raise_for_status()
+        for raw in resp.iter_lines():
+            line = raw.decode().strip()
+            if not line.startswith('data: '):
+                continue
+            event = json.loads(line[len('data: '):])
+            if event.get('error'):
+                print(f'\nerror: {event["error"]}', file=sys.stderr)
+                return 1
+            if event.get('done'):
+                dt = time.time() - t0
+                n = len(event['tokens'])
+                print(f'\n-- {n} tokens in {dt:.2f}s '
+                      f'({n / dt:.1f} tok/s, first token at '
+                      f'{first - t0:.2f}s), '
+                      f'engine latency {event["latency_s"]:.2f}s')
+                return 0
+            if first is None:
+                first = time.time()
+            print(' '.join(str(t) for t in event['tokens']),
+                  end=' ', flush=True)
+    print('\nstream ended without a done event', file=sys.stderr)
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

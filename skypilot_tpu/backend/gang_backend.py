@@ -24,6 +24,7 @@ import time
 import typing
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import authentication
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision
@@ -144,6 +145,14 @@ class RetryingProvisioner:
         cloud = to_provision.cloud
         deploy_vars = cloud.make_deploy_resources_variables(
             to_provision, self._cluster_name_on_cloud, region, zone)
+        # Every SSH-reachable cloud must install the FRAMEWORK keypair
+        # (post-provision runtime setup / gang exec connect with
+        # ~/.skytpu/keys): inject it once here so no per-cloud plugin
+        # can forget it. Plugins with their own key channels (GCP
+        # metadata) simply ignore the field.
+        if 'ssh_public_key' not in deploy_vars:
+            deploy_vars['ssh_public_key'] = (
+                authentication.public_key_openssh())
         config = provision_common.ProvisionConfig(
             provider_name=cloud.provider_name(),
             cluster_name=self._cluster_name,

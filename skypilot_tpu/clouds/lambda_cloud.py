@@ -83,6 +83,21 @@ class LambdaCloud(cloud_lib.Cloud):
                 continue
             yield (r.name, None)
 
+    @staticmethod
+    def _instance_type_for_accelerator(
+            accelerators: dict) -> Optional[str]:
+        """Map {'A100': 8}-style requests onto Lambda's gpu_<n>x_<gpu>
+        instance-type names; None if no catalog type matches."""
+        (name, count), = accelerators.items()
+        prefix = f'gpu_{count}x_{name.lower()}'
+        matches = sorted({
+            o.instance_type
+            for o in catalog.get_instance_offerings(None, None, None,
+                                                    cloud='lambda')
+            if o.instance_type.startswith(prefix)
+        })
+        return matches[0] if matches else None
+
     def get_feasible_launchable_resources(
             self, resources: 'Resources') -> List['Resources']:
         if resources.cloud is not None and not self.is_same_cloud(
@@ -91,6 +106,14 @@ class LambdaCloud(cloud_lib.Cloud):
         if resources.is_tpu or resources.use_spot:
             return []
         instance_type = resources.instance_type
+        if instance_type is None and resources.accelerators:
+            # A GPU request must select GPU hardware — silently
+            # satisfying it with the cheapest CPU box would launch
+            # the wrong machine.
+            instance_type = self._instance_type_for_accelerator(
+                resources.accelerators)
+            if instance_type is None:
+                return []
         if instance_type is None:
             instance_type = catalog.get_default_instance_type(
                 resources.cpus, resources.memory, cloud='lambda')

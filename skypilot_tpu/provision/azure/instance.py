@@ -109,14 +109,15 @@ def run_instances(
         argv += ['--tags', f'{_CLUSTER_TAG}={cluster}']
         argv += [f'{k}={v}'
                  for k, v in (node.get('labels') or {}).items()]
-        public_key = node.get('ssh_public_key')
-        if not public_key:
-            # Install the FRAMEWORK keypair: post-provision SSH uses
-            # ~/.skytpu/keys (gang_backend), which an az-generated
-            # keypair would not match.
-            from skypilot_tpu import authentication
-            public_key = authentication.public_key_openssh()
-        argv += ['--ssh-key-values', public_key]
+        # The framework public key, injected by gang_backend (plugins
+        # must not fall back to provider-generated keys: post-
+        # provision SSH connects with ~/.skytpu/keys).
+        if not node.get('ssh_public_key'):
+            raise exceptions.ProvisionError(
+                'azure: node_config.ssh_public_key missing — the '
+                'backend injects the framework keypair; direct '
+                'plugin callers must supply one.')
+        argv += ['--ssh-key-values', node['ssh_public_key']]
         if node.get('use_spot'):
             # Deallocate on eviction: the jobs controller's preemption
             # reconciler sees a 'stopped' VM and recovers (same signal

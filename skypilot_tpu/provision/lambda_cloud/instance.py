@@ -83,19 +83,16 @@ def run_instances(
     node = config.node_config
     cluster = config.cluster_name_on_cloud
     client = api.LambdaClient()
-    public_key = node.get('ssh_public_key')
-    if not public_key:
-        # The framework keypair must be installed on the instances or
-        # every post-provision SSH (runtime setup, gang exec) fails:
-        # gang_backend connects with ~/.skytpu/keys, not whatever key
-        # happens to be registered with the Lambda account.
-        from skypilot_tpu import authentication
-        public_key = authentication.public_key_openssh()
-    key_names = _ensure_ssh_key(client, public_key)
+    # ssh_public_key is the framework keypair, injected by
+    # gang_backend for every cloud (post-provision SSH connects with
+    # ~/.skytpu/keys); _ensure_ssh_key still tolerates None for
+    # direct plugin use.
+    key_names = _ensure_ssh_key(client, node.get('ssh_public_key'))
     created: List[str] = []
+    existing = _cluster_instances(client, cluster)
     for idx in range(config.count):
         name = _vm_name(cluster, idx)
-        inst = _cluster_instances(client, cluster).get(name)
+        inst = existing.get(name)
         if inst is not None:
             status = inst.get('status')
             if status not in ('terminating', 'terminated'):
@@ -104,12 +101,18 @@ def run_instances(
                 # Same-named launch while the old instance is dying
                 # would collide in the name-keyed membership map
                 # (down immediately followed by launch): wait for
-                # the name to free first.
+                # the name to free, and REFUSE to launch a duplicate
+                # if it never does.
                 deadline = time.time() + 300
-                while time.time() < deadline:
+                while True:
                     cur = _cluster_instances(client, cluster).get(name)
                     if cur is None or cur.get('status') == 'terminated':
                         break
+                    if time.time() > deadline:
+                        raise exceptions.ProvisionError(
+                            f'Instance {name} stuck terminating; '
+                            'refusing to launch a same-named '
+                            'duplicate. Retry once it is gone.')
                     time.sleep(_POLL_INTERVAL)
         ids = client.launch(region=config.region,
                             instance_type=node['instance_type'],

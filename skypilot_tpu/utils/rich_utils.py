@@ -7,7 +7,8 @@ importable; in pipes, CI, or minimal images the same code path prints
 nothing extra (the operation's own log lines remain the record).
 Nested ``client_status`` calls reuse the outer spinner (the reference
 does the same so helper functions can annotate progress without
-fighting over the terminal).
+fighting over the terminal); on nested-scope exit the outer message
+is restored.
 """
 from __future__ import annotations
 
@@ -20,7 +21,7 @@ _active = threading.local()
 
 
 class _NoopStatus:
-    """Fallback and nested-call handle: update() is a cheap no-op."""
+    """Fallback handle: update() is a cheap no-op."""
 
     def update(self, message: str) -> None:
         pass
@@ -28,10 +29,12 @@ class _NoopStatus:
 
 class _RichStatus:
 
-    def __init__(self, status) -> None:
+    def __init__(self, status, message: str) -> None:
         self._status = status
+        self.message = message
 
     def update(self, message: str) -> None:
+        self.message = message
         self._status.update(message)
 
 
@@ -43,31 +46,30 @@ def _rich_console():
         return None
 
 
-def safe_status_enabled() -> bool:
-    return sys.stdout.isatty() and _rich_console() is not None
-
-
 @contextlib.contextmanager
 def client_status(message: str) -> Iterator:
     """Spinner context; yields a handle with .update(message).
 
-    TTY + rich -> live spinner. Otherwise, or when nested inside an
-    active spinner, a no-op handle (the outer spinner keeps spinning;
-    updates from nested scopes retext it).
+    TTY + rich -> live spinner. Otherwise a no-op handle. Nested
+    calls retext the outer spinner and restore its message on exit,
+    so a helper's progress note never outlives the helper.
     """
     outer: Optional[object] = getattr(_active, 'status', None)
     if outer is not None:
-        # Nested: retext the outer spinner, hand out a proxy so
-        # updates keep landing on it.
+        saved = getattr(outer, 'message', None)
         outer.update(message)
-        yield outer
+        try:
+            yield outer
+        finally:
+            if saved is not None:
+                outer.update(saved)
         return
     console = _rich_console()
     if console is None or not sys.stdout.isatty():
         yield _NoopStatus()
         return
     with console.status(message) as status:
-        handle = _RichStatus(status)
+        handle = _RichStatus(status, message)
         _active.status = handle
         try:
             yield handle

@@ -98,7 +98,9 @@ def _config(count=1, use_spot=False):
         zone=None,
         node_config={'instance_type': 'Standard_D8s_v5',
                      'use_spot': use_spot, 'labels': {},
-                     'disk_size': 128, 'image_id': None},
+                     'disk_size': 128, 'image_id': None,
+                     # Injected by gang_backend in production.
+                     'ssh_public_key': 'ssh-ed25519 AAAA test'},
         count=count,
     )
 

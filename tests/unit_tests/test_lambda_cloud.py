@@ -155,3 +155,18 @@ def test_cloud_feasibility_and_caps(lam_http):
     from skypilot_tpu.utils.registry import CLOUD_REGISTRY
     assert CLOUD_REGISTRY.from_str('lambda') is LambdaCloud
     assert CLOUD_REGISTRY.from_str('lambda_cloud') is LambdaCloud
+
+
+def test_gpu_accelerator_selects_matching_type(lam_http):
+    from skypilot_tpu.clouds import LambdaCloud
+    from skypilot_tpu.resources import Resources
+    cloud = LambdaCloud()
+    feas = cloud.get_feasible_launchable_resources(
+        Resources(accelerators='A10:1'))
+    assert feas and feas[0].instance_type == 'gpu_1x_a10'
+    feas = cloud.get_feasible_launchable_resources(
+        Resources(accelerators={'H100_sxm5': 8}))
+    assert feas and feas[0].instance_type == 'gpu_8x_h100_sxm5'
+    # Unknown GPU shapes must NOT silently land on a CPU box.
+    assert cloud.get_feasible_launchable_resources(
+        Resources(accelerators='V100:4')) == []

@@ -13,18 +13,23 @@ def test_noop_in_non_tty(monkeypatch, capsys):
     assert 'working' not in out.out
 
 
-def test_nested_reuses_outer(monkeypatch):
+def test_nested_reuses_outer_and_restores(monkeypatch):
     updates = []
 
     class FakeStatus:
+        message = 'outer msg'
+
         def update(self, msg):
+            self.message = msg
             updates.append(msg)
 
     monkeypatch.setattr(rich_utils._active, 'status', FakeStatus(),
                         raising=False)
     with rich_utils.client_status('inner msg') as st:
         st.update('inner update')
-    assert updates == ['inner msg', 'inner update']
+    # Nested scope retexts the outer spinner, then restores the
+    # message it found on entry.
+    assert updates == ['inner msg', 'inner update', 'outer msg']
     rich_utils._active.status = None
 
 
