@@ -107,7 +107,22 @@ class ServingEngine:
                  eos_id: Optional[int] = None,
                  temperature: float = 0.0,
                  top_k: int = 0,
-                 decode_chunk: int = 8) -> None:
+                 decode_chunk: int = 8,
+                 mesh=None) -> None:
+        # ``mesh``: serve a model larger than one chip — params shard
+        # Megatron-style (tp on heads/ffn/vocab) and the KV cache's
+        # kv-head axis shards over 'tp' (inference.CACHE_SPEC), the
+        # slice-serving shape of the reference's JetStream demo. The
+        # host-side slot orchestration is mesh-oblivious; only the
+        # jitted programs carry shardings.
+        self.mesh = mesh
+        if mesh is not None:
+            from skypilot_tpu.models.llama import param_specs
+            params = jax.device_put(
+                params,
+                jax.tree.map(
+                    lambda spec: jax.sharding.NamedSharding(mesh, spec),
+                    param_specs(cfg)))
         self.params = params
         self.cfg = cfg
         self.batch_size = batch_size
@@ -168,6 +183,13 @@ class ServingEngine:
                 kv_shape[:4], jnp.bfloat16)
             self._empty['v_scale'] = jnp.ones(
                 kv_shape[:4], jnp.bfloat16)
+        if mesh is not None:
+            specs = inference.cache_specs(kv_quant)
+            self._empty = {
+                f: jax.device_put(
+                    v, jax.sharding.NamedSharding(mesh, specs[f]))
+                for f, v in self._empty.items()
+            }
         self.cache = jax.tree.map(jnp.copy, self._empty)
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
@@ -184,7 +206,7 @@ class ServingEngine:
             cur_tokens, firsts).
             """
             logits, group = inference.prefill(
-                params, tokens, lengths, self.cfg,
+                params, tokens, lengths, self.cfg, mesh=self.mesh,
                 max_seq=tokens.shape[1], kv_quant=self.kv_quant)
             firsts = inference._sample(logits, key, temperature,
                                        self.top_k)
@@ -216,7 +238,8 @@ class ServingEngine:
                 cache, tok, key = carry
                 key, sub = jax.random.split(key)
                 logits, cache = inference.decode_step(
-                    params, cache, tok, self.cfg, active=active)
+                    params, cache, tok, self.cfg, mesh=self.mesh,
+                    active=active)
                 nxt = inference._sample(logits, sub, temperature,
                                         self.top_k)
                 return (cache, nxt, key), nxt

@@ -308,12 +308,31 @@ def _build_engine(args) -> 'Any':
     if jax.default_backend() != 'cpu':
         cfg = cfg_fn(max_seq=args.max_seq,
                      param_dtype=jnp.bfloat16)
+    mesh = None
+    if args.tp > 1:
+        # Serve a model larger than one chip: Megatron tp over the
+        # replica's local chips (params + kv-head cache axis shard).
+        from skypilot_tpu.parallel import make_mesh, plan_mesh
+        mesh = make_mesh(plan_mesh(args.tp, tp=args.tp),
+                         devices=jax.devices()[:args.tp])
     if args.checkpoint:
         import os
 
         import orbax.checkpoint as ocp
         target = jax.eval_shape(
             lambda: models.init_params(cfg, jax.random.PRNGKey(0)))
+        if mesh is not None:
+            # The whole point of --tp is a model LARGER than one chip:
+            # the restore target must carry shardings so orbax loads
+            # each shard straight to its device instead of
+            # materializing the full tree on one chip (OOM).
+            from skypilot_tpu.models.llama import param_specs
+            specs = param_specs(cfg)
+            target = jax.tree.map(
+                lambda shape_dtype, spec: jax.ShapeDtypeStruct(
+                    shape_dtype.shape, shape_dtype.dtype,
+                    sharding=jax.sharding.NamedSharding(mesh, spec)),
+                target, specs)
         params = ocp.StandardCheckpointer().restore(
             os.path.abspath(os.path.expanduser(args.checkpoint)),
             target)
@@ -325,7 +344,8 @@ def _build_engine(args) -> 'Any':
                          max_prompt=args.max_prompt,
                          max_seq=args.max_seq,
                          kv_quant=args.kv_quant,
-                         decode_chunk=args.decode_chunk)
+                         decode_chunk=args.decode_chunk,
+                         mesh=mesh)
 
 
 def main() -> None:
@@ -337,8 +357,11 @@ def main() -> None:
     parser.add_argument('--batch', type=int, default=8)
     parser.add_argument('--max-prompt', type=int, default=512)
     parser.add_argument('--max-seq', type=int, default=1024)
-    parser.add_argument('--decode-chunk', type=int, default=8)
+    parser.add_argument('--decode-chunk', type=int, default=16)
     parser.add_argument('--kv-quant', action='store_true')
+    parser.add_argument('--tp', type=int, default=1,
+                        help='Tensor-parallel ways over local chips '
+                        '(serve models larger than one chip).')
     args = parser.parse_args()
 
     server = EngineServer(_build_engine(args))

@@ -270,14 +270,82 @@ def terminate_instances(cluster_name_on_cloud: str, region: str,
         ec2.terminate_instances(InstanceIds=ids)
 
 
+def _cluster_sg_ids(ec2, cluster_name_on_cloud: str) -> List[str]:
+    """Security-group ids attached to the cluster's instances."""
+    sgs: List[str] = []
+    for inst in _list_instances(ec2, cluster_name_on_cloud):
+        for sg in inst.get('SecurityGroups', []):
+            if sg['GroupId'] not in sgs:
+                sgs.append(sg['GroupId'])
+    return sgs
+
+
+def _rule_marker(cluster_name_on_cloud: str) -> str:
+    return f'skytpu:{cluster_name_on_cloud}'
+
+
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                region: str, zone: Optional[str]) -> None:
-    """Security-group ingress rules (reference aws/instance.py
-    open_ports). Scoped out with the default-SG assumption above."""
-    logger.info('aws: open_ports(%s) not implemented for the default '
-                'security group; open them in the console/SG.', ports)
+    """Authorize TCP ingress on the instances' security groups
+    (reference sky/provision/aws open_ports).
+
+    One authorize call PER rule: AWS rejects a batch atomically on
+    any duplicate, which would silently skip genuinely-new ports.
+    Each rule's description carries a cluster marker so
+    cleanup_ports can revoke exactly what this cluster added (the
+    default SG is shared VPC infrastructure that outlives the
+    instances)."""
+    del zone
+    ec2 = client_factory(region)
+    marker = _rule_marker(cluster_name_on_cloud)
+    for sg_id in _cluster_sg_ids(ec2, cluster_name_on_cloud):
+        for p in ports:
+            permission = {
+                'IpProtocol': 'tcp',
+                'FromPort': int(str(p).split('-')[0]),
+                'ToPort': int(str(p).split('-')[-1]),
+                'IpRanges': [{'CidrIp': '0.0.0.0/0',
+                              'Description': marker}],
+            }
+            try:
+                ec2.authorize_security_group_ingress(
+                    GroupId=sg_id, IpPermissions=[permission])
+            except Exception as e:  # pylint: disable=broad-except
+                resp = getattr(e, 'response', None)
+                code = ''
+                if isinstance(resp, dict):
+                    code = str(resp.get('Error', {}).get('Code', ''))
+                if code == 'InvalidPermission.Duplicate':
+                    continue
+                raise translate_error(e, 'open_ports') from e
 
 
 def cleanup_ports(cluster_name_on_cloud: str, region: str,
                   zone: Optional[str]) -> None:
-    pass
+    """Revoke the marker-tagged ingress rules open_ports added.
+
+    Runs BEFORE terminate (provisioner.teardown_cluster) so the
+    instances still resolve their security groups; without this, the
+    0.0.0.0/0 rules would persist on the VPC's shared default SG
+    forever."""
+    del zone
+    ec2 = client_factory(region)
+    marker = _rule_marker(cluster_name_on_cloud)
+    for sg_id in _cluster_sg_ids(ec2, cluster_name_on_cloud):
+        try:
+            resp = ec2.describe_security_groups(GroupIds=[sg_id])
+        except Exception as e:  # pylint: disable=broad-except
+            raise translate_error(e, 'cleanup_ports') from e
+        for sg in resp.get('SecurityGroups', []):
+            to_revoke = []
+            for perm in sg.get('IpPermissions', []):
+                ranges = [r for r in perm.get('IpRanges', [])
+                          if r.get('Description') == marker]
+                if ranges:
+                    to_revoke.append({**perm, 'IpRanges': ranges})
+            if to_revoke:
+                try:
+                    ec2.revoke_security_group_ingress(
+                        GroupId=sg_id, IpPermissions=to_revoke)
+                except Exception as e:  # pylint: disable=broad-except
+                    raise translate_error(e, 'cleanup_ports') from e

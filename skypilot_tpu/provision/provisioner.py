@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import shlex
+import traceback
 from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions
@@ -257,6 +258,17 @@ def teardown_cluster(provider_name: str, cluster_name_on_cloud: str,
                      region: str, zone: Optional[str],
                      terminate: bool) -> None:
     if terminate:
+        # Before the instances go away: port cleanup may need them to
+        # resolve which security groups carry this cluster's rules
+        # (rules on shared/default SGs outlive the instances).
+        try:
+            provision.cleanup_ports(provider_name,
+                                    cluster_name_on_cloud, region,
+                                    zone)
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('cleanup_ports failed for %s:\n%s',
+                           cluster_name_on_cloud,
+                           traceback.format_exc())
         provision.terminate_instances(provider_name, cluster_name_on_cloud,
                                       region, zone)
     else:

@@ -24,6 +24,7 @@ class FakeEC2:
         self.instances = {}          # id -> dict
         self._ids = itertools.count(1)
         self.run_error = None        # exception to raise on create
+        self.sg_rules = {}           # sg id -> ingress permissions
 
     def _new_id(self):
         return f'i-{next(self._ids):017x}'
@@ -62,10 +63,37 @@ class FakeEC2:
                 'PrivateIpAddress': f'172.31.0.{len(self.instances) + 1}',
                 'PublicIpAddress': f'54.0.0.{len(self.instances) + 1}',
                 'Tags': kwargs['TagSpecifications'][0]['Tags'],
+                'SecurityGroups': [{'GroupId': 'sg-default',
+                                    'GroupName': 'default'}],
             }
             self.instances[iid] = inst
             created.append(dict(inst))
         return {'Instances': created}
+
+    def authorize_security_group_ingress(self, GroupId, IpPermissions):
+        # AWS semantics: the batch is ATOMIC — any duplicate rejects
+        # the whole request and adds nothing.
+        rules = self.sg_rules.setdefault(GroupId, [])
+        existing = [(r['FromPort'], r['ToPort']) for r in rules]
+        for perm in IpPermissions:
+            if (perm['FromPort'], perm['ToPort']) in existing:
+                raise FakeClientError(
+                    'InvalidPermission.Duplicate', 'already exists')
+        rules.extend(IpPermissions)
+
+    def describe_security_groups(self, GroupIds):
+        return {'SecurityGroups': [
+            {'GroupId': gid, 'IpPermissions': list(self.sg_rules.get(gid, []))}
+            for gid in GroupIds
+        ]}
+
+    def revoke_security_group_ingress(self, GroupId, IpPermissions):
+        rules = self.sg_rules.get(GroupId, [])
+        for perm in IpPermissions:
+            for r in list(rules):
+                if (r['FromPort'], r['ToPort']) == (perm['FromPort'],
+                                                   perm['ToPort']):
+                    rules.remove(r)
 
     def start_instances(self, InstanceIds):
         for iid in InstanceIds:
@@ -285,3 +313,27 @@ def test_failover_all_gcp_blocked_lands_on_aws(both_clouds,
     assert len(aws_attempts) == 1
     # GCP was exhausted across multiple regions before the switch.
     assert len({r for _, r in gcp_attempts}) > 1
+
+
+def test_open_ports_authorizes_and_cleanup_revokes(ec2):
+    config = _config(count=2)
+    aws_instance.run_instances(config)
+    aws_instance.open_ports('aws-c', ['8080', '9000-9010'],
+                            'us-east-1', None)
+    rules = ec2.sg_rules['sg-default']
+    assert {(r['FromPort'], r['ToPort']) for r in rules} == {
+        (8080, 8080), (9000, 9010)}
+    # Per-rule authorize: re-opening 8080 alongside a NEW port must
+    # still open the new one (an atomic batch would add neither).
+    aws_instance.open_ports('aws-c', ['8080', '7070'],
+                            'us-east-1', None)
+    assert (7070, 7070) in {(r['FromPort'], r['ToPort'])
+                            for r in rules}
+    # Rules carry the cluster marker; a foreign rule survives cleanup.
+    ec2.sg_rules['sg-default'].append({
+        'IpProtocol': 'tcp', 'FromPort': 22, 'ToPort': 22,
+        'IpRanges': [{'CidrIp': '0.0.0.0/0'}]})
+    aws_instance.cleanup_ports('aws-c', 'us-east-1', None)
+    left = {(r['FromPort'], r['ToPort'])
+            for r in ec2.sg_rules['sg-default']}
+    assert left == {(22, 22)}

@@ -190,3 +190,35 @@ def test_max_new_equal_to_decode_capacity():
     # Engine remains serviceable after the region reset.
     again = engine.run([Request('after', p, max_new=4)])
     assert again['after'].tokens == _solo_generate(params, cfg, p, 4)
+
+
+@pytest.mark.slow
+def test_tp_sharded_engine_matches_unsharded():
+    """A tensor-parallel serving engine (params + kv-head cache axis
+    sharded over 'tp') produces exactly the unsharded engine's greedy
+    tokens — the serve-models-bigger-than-one-chip path."""
+    from skypilot_tpu.parallel import make_mesh, plan_mesh
+    cfg, params = _setup()
+    reqs = [Request(i, _prompt(cfg, n, i), max_new=6)
+            for i, n in enumerate((11, 7, 13))]
+
+    plain = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                          max_seq=128, decode_chunk=4)
+    want = plain.run(list(reqs))
+
+    mesh = make_mesh(plan_mesh(2, tp=2),
+                     devices=__import__('jax').devices()[:2])
+    sharded = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                            max_seq=128, decode_chunk=4, mesh=mesh)
+    got = sharded.run(list(reqs))
+    for i in want:
+        assert got[i].tokens == want[i].tokens, (i, got[i].tokens,
+                                                 want[i].tokens)
+
+    # int8 KV cache under tp: the per-vector scale tensors shard on
+    # the same kv-head axis; the program must compile and serve.
+    quant = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                          max_seq=128, decode_chunk=4, mesh=mesh,
+                          kv_quant=True)
+    got_q = quant.run([Request('q', _prompt(cfg, 9, 7), max_new=5)])
+    assert len(got_q['q'].tokens) == 5
