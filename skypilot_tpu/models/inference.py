@@ -82,15 +82,26 @@ def _dequantize_kv(q: jax.Array, scale: jax.Array,
     return q.astype(dtype) * scale[..., None].astype(dtype)
 
 
-def _reject_unsupported_family(cfg: LlamaConfig) -> None:
-    """This engine walks the dense Llama param tree; an MoE config
-    would KeyError deep inside the scan — fail with intent instead."""
+def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig) -> jax.Array:
+    """The residual-branch MLP output for one layer, by model family:
+    dense SwiGLU for LlamaConfig; for MoEConfig, DROPLESS exact top-k
+    expert mixing (moe.moe_block_dropless) — training's capacity
+    dispatch drops tokens batch-dependently, which would make served
+    tokens depend on their batchmates. Static shapes either way, so
+    decode never recompiles. The router aux loss is a training
+    signal; inference has none."""
     from skypilot_tpu.models import moe
+    cdt = cfg.compute_dtype
     if isinstance(cfg, moe.MoEConfig):
-        raise NotImplementedError(
-            'KV-cache inference for MoE models is not implemented '
-            'yet; serve dense (LlamaConfig) models, or train MoE and '
-            'distill/serve dense.')
+        # DROPLESS routing (see moe.moe_block_dropless): capacity
+        # drops are batch-composition-dependent, which would make a
+        # served token depend on its batchmates.
+        h3 = h if h.ndim == 3 else h[:, None]
+        y = moe.moe_block_dropless(h3, lp, cfg)
+        return y if h.ndim == 3 else y[:, 0]
+    gate = jax.nn.silu(h @ lp['w_gate'].astype(cdt))
+    up = h @ lp['w_up'].astype(cdt)
+    return (gate * up) @ lp['w_down'].astype(cdt)
 
 
 # Cache slot layout (the key to fast TPU decode): prompts occupy
@@ -190,7 +201,6 @@ def prefill(params: Dict,
     dmask marks everything >= length unreadable. ``kv_quant`` stores
     K/V as int8 with per-vector scales (half the decode bandwidth).
     """
-    _reject_unsupported_family(cfg)
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     s_max = max_seq or cfg.max_seq
@@ -217,9 +227,7 @@ def prefill(params: Dict,
         x = x + o @ lp['wo'].astype(cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp['w_gate'].astype(cdt))
-        up = h @ lp['w_up'].astype(cdt)
-        x = x + (gate * up) @ lp['w_down'].astype(cdt)
+        x = x + _mlp_delta(h, lp, cfg)
         # Pad this layer's K/V out to the cache length.
         pad = [(0, 0), (0, s_max - s), (0, 0), (0, 0)]
         if kv_quant:
@@ -331,9 +339,7 @@ def decode_step(params: Dict,
         x = x + o @ lp['wo'].astype(cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp['w_gate'].astype(cdt))
-        up = h @ lp['w_up'].astype(cdt)
-        x = x + (gate * up) @ lp['w_down'].astype(cdt)
+        x = x + _mlp_delta(h, lp, cfg)
 
         # In-place sliver write at scalar (layer, slot).
         if quant:
@@ -473,12 +479,18 @@ def reference_generate(params: Dict, tokens: jax.Array,
                        max_new: int) -> jax.Array:
     """Cache-free greedy generation (full forward per token) — the
     correctness oracle for the KV-cache path in tests."""
+    from skypilot_tpu.models import moe
     b, s = tokens.shape
     buf = jnp.concatenate(
         [tokens, jnp.zeros((b, max_new), jnp.int32)], axis=1)
     cur = lengths.astype(jnp.int32)
-    full = jax.jit(lambda p, t: forward_hidden(p, t, cfg) @
-                   p['lm_head'].astype(cfg.compute_dtype))
+    if isinstance(cfg, moe.MoEConfig):
+        # Dropless, matching the cache path's inference routing.
+        full = jax.jit(lambda p, t: moe.forward(p, t, cfg,
+                                                dropless=True))
+    else:
+        full = jax.jit(lambda p, t: forward_hidden(p, t, cfg) @
+                       p['lm_head'].astype(cfg.compute_dtype))
     out = []
     for _ in range(max_new):
         logits = full(params, buf)

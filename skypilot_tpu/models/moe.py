@@ -21,8 +21,10 @@ layer, built the TPU way —
 
 API mirrors models.llama (init_params / param_specs / forward /
 loss_fn), so the same train step and checkpointing drive both
-families. (KV-cache serving is dense-only for now; models/inference
-rejects MoE configs explicitly.)
+families. KV-cache serving (models/inference, ServingEngine) serves
+MoE too, with DROPLESS routing (``moe_block_dropless``): capacity
+drops are a training device whose pattern depends on batch
+composition, which served tokens must not.
 """
 from __future__ import annotations
 
@@ -140,11 +142,7 @@ def _route(xf: jax.Array, router: jax.Array,
     t = xf.shape[0]
     e, k = cfg.n_experts, cfg.top_k
     capacity = max(4, int(cfg.capacity_factor * t * k / e))
-    logits = (xf @ router.astype(xf.dtype)).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)          # [T, E]
-    weights, idx = lax.top_k(probs, k)               # [T, k]
-    weights = weights / jnp.maximum(
-        weights.sum(-1, keepdims=True), 1e-9)
+    weights, idx, probs = _topk_weights(xf, router, cfg)
 
     combine = jnp.zeros((t, e, capacity), jnp.float32)
     # Expert fill is tracked ACROSS the k slots: slot 1 continues
@@ -171,6 +169,52 @@ def _route(xf: jax.Array, router: jax.Array,
     return combine, aux
 
 
+def _topk_weights(xf: jax.Array, router: jax.Array,
+                  cfg: MoEConfig) -> Tuple[jax.Array, jax.Array,
+                                           jax.Array]:
+    """Shared router prologue: (weights [T,k], idx [T,k], probs
+    [T,E]). ONE definition for training and inference — the f32 cast
+    placement and renorm floor define the expert mixture a checkpoint
+    was trained with; a serving-side copy that drifted would silently
+    change which experts serve each token."""
+    logits = (xf @ router.astype(xf.dtype)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, idx = lax.top_k(probs, cfg.top_k)
+    weights = weights / jnp.maximum(
+        weights.sum(-1, keepdims=True), 1e-9)
+    return weights, idx, probs
+
+
+def moe_block_dropless(x: jax.Array, lp: Dict,
+                       cfg: MoEConfig) -> jax.Array:
+    """Exact top-k expert mixing with NO capacity drops — the
+    INFERENCE routing. Capacity dropping is a training-throughput
+    device (static dispatch shapes, load-balance pressure) whose drop
+    pattern depends on which other tokens share the batch; under
+    incremental decode that would make generated tokens depend on
+    batch composition. Serving engines therefore route dropless (as
+    vLLM/JetStream-class MoE serving does): every token reaches its
+    exact top-k experts. Cost: all E experts run for every token
+    (E/k-fold ffn flops) — the simple dense form; capacity dispatch
+    with an ample factor is the optimization when E is large."""
+    cdt = cfg.compute_dtype
+    b, s, d = x.shape
+    xf = x.reshape(b * s, d)
+    weights, idx, probs = _topk_weights(xf, lp['router'], cfg)
+    wfull = jnp.zeros_like(probs)
+    for slot in range(cfg.top_k):
+        wfull += (weights[:, slot, None] *
+                  jax.nn.one_hot(idx[:, slot], cfg.n_experts,
+                                 dtype=jnp.float32))
+    gate = jax.nn.silu(
+        jnp.einsum('td,edf->tef', xf, lp['w_gate'].astype(cdt)))
+    up = jnp.einsum('td,edf->tef', xf, lp['w_up'].astype(cdt))
+    out = jnp.einsum('tef,efd->ted', gate * up,
+                     lp['w_down'].astype(cdt))
+    y = jnp.einsum('te,ted->td', wfull.astype(cdt), out)
+    return y.reshape(b, s, d)
+
+
 def _moe_block(x: jax.Array, lp: Dict, cfg: MoEConfig
                ) -> Tuple[jax.Array, jax.Array]:
     """x [B, S, D] -> (y [B, S, D], aux loss)."""
@@ -191,8 +235,12 @@ def _moe_block(x: jax.Array, lp: Dict, cfg: MoEConfig
 
 
 def forward_hidden(params: Dict, tokens: jax.Array, cfg: MoEConfig,
-                   mesh=None) -> Tuple[jax.Array, jax.Array]:
-    """tokens [B, S] -> (hidden [B, S, D], total aux loss)."""
+                   mesh=None,
+                   dropless: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] -> (hidden [B, S, D], total aux loss).
+
+    ``dropless=True`` routes with exact top-k mixing (no capacity
+    drops) — inference semantics, used by the KV-cache oracle."""
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
@@ -224,7 +272,11 @@ def forward_hidden(params: Dict, tokens: jax.Array, cfg: MoEConfig,
         x = x + constrain(o @ lp['wo'].astype(cdt), ACT_SPEC)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
-        y, layer_aux = _moe_block(h, lp, cfg)
+        if dropless:
+            y, layer_aux = (moe_block_dropless(h, lp, cfg),
+                            jnp.zeros((), jnp.float32))
+        else:
+            y, layer_aux = _moe_block(h, lp, cfg)
         x = x + constrain(y, ACT_SPEC)
         return (x, aux + layer_aux), None
 
@@ -235,8 +287,9 @@ def forward_hidden(params: Dict, tokens: jax.Array, cfg: MoEConfig,
 
 
 def forward(params: Dict, tokens: jax.Array, cfg: MoEConfig,
-            mesh=None) -> jax.Array:
-    x, _ = forward_hidden(params, tokens, cfg, mesh)
+            mesh=None, dropless: bool = False) -> jax.Array:
+    x, _ = forward_hidden(params, tokens, cfg, mesh,
+                          dropless=dropless)
     return jnp.einsum('bsd,dv->bsv', x,
                       params['lm_head'].astype(cfg.compute_dtype),
                       preferred_element_type=jnp.float32)

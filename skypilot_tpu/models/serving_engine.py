@@ -117,12 +117,14 @@ class ServingEngine:
         # jitted programs carry shardings.
         self.mesh = mesh
         if mesh is not None:
-            from skypilot_tpu.models.llama import param_specs
+            # Family-dispatched specs: MoE params carry 'router' +
+            # 3-D expert weights that llama's dense tree lacks.
+            from skypilot_tpu.models.train import _family
             params = jax.device_put(
                 params,
                 jax.tree.map(
                     lambda spec: jax.sharding.NamedSharding(mesh, spec),
-                    param_specs(cfg)))
+                    _family(cfg).param_specs(cfg)))
         self.params = params
         self.cfg = cfg
         self.batch_size = batch_size

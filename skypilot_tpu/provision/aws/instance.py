@@ -316,6 +316,16 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
                 if isinstance(resp, dict):
                     code = str(resp.get('Error', {}).get('Code', ''))
                 if code == 'InvalidPermission.Duplicate':
+                    # AWS rule identity ignores descriptions: the
+                    # existing rule may belong to ANOTHER cluster on a
+                    # shared (default) SG, whose teardown will revoke
+                    # it out from under this one. Surface that.
+                    logger.warning(
+                        'aws: port %s on %s is already open by '
+                        'another rule (possibly another cluster on '
+                        'this shared security group); it may close '
+                        'when that owner tears down. Use a dedicated '
+                        'SG/VPC for isolation.', p, sg_id)
                     continue
                 raise translate_error(e, 'open_ports') from e
 
