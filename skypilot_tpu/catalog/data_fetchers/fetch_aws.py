@@ -16,27 +16,27 @@ from __future__ import annotations
 import csv
 import os
 
-# (type, vcpu, mem GiB, $/hr us-east-1); spot ~= 30% of on-demand.
+# (type, vcpu, mem GiB, $/hr us-east-1); spot fractions per-AZ below.
 _TYPES = [
-    ('t3.medium', 2, 4, 0.0416),
-    ('m6i.large', 2, 8, 0.096),
-    ('m6i.xlarge', 4, 16, 0.192),
-    ('m6i.2xlarge', 8, 32, 0.384),
-    ('m6i.4xlarge', 16, 64, 0.768),
-    ('c6i.2xlarge', 8, 16, 0.34),
-    ('c6i.4xlarge', 16, 32, 0.68),
-    ('r6i.2xlarge', 8, 64, 0.504),
+    ('t3.medium', 2, 4, 0.0416), ('t3.xlarge', 4, 16, 0.1664),
+    ('m6i.large', 2, 8, 0.096), ('m6i.xlarge', 4, 16, 0.192),
+    ('m6i.2xlarge', 8, 32, 0.384), ('m6i.4xlarge', 16, 64, 0.768),
+    ('m6i.8xlarge', 32, 128, 1.536), ('m6i.16xlarge', 64, 256, 3.072),
+    ('c6i.xlarge', 4, 8, 0.17), ('c6i.2xlarge', 8, 16, 0.34),
+    ('c6i.4xlarge', 16, 32, 0.68), ('c6i.8xlarge', 32, 64, 1.36),
+    ('r6i.xlarge', 4, 32, 0.252), ('r6i.2xlarge', 8, 64, 0.504),
+    ('r6i.4xlarge', 16, 128, 1.008), ('m5.8xlarge', 32, 128, 1.536),
 ]
 
 # region -> (price multiplier vs us-east-1, zone letters)
 _REGIONS = {
     'us-east-1': (1.00, 'abc'),
+    'us-east-2': (1.00, 'abc'),
     'us-west-2': (1.00, 'abc'),
     'eu-west-1': (1.11, 'abc'),
-    'ap-northeast-1': (1.21, 'ac'),
+    'eu-central-1': (1.15, 'abc'),
+    'ap-northeast-1': (1.22, 'abc'),
 }
-
-_SPOT_FRACTION = 0.3
 
 
 def fetch(out_path: str = None) -> str:
@@ -50,10 +50,12 @@ def fetch(out_path: str = None) -> str:
         for name, vcpu, mem, base in _TYPES:
             for region, (mult, letters) in _REGIONS.items():
                 price = round(base * mult, 4)
-                for letter in letters:
+                for i, letter in enumerate(letters):
+                    # Spot varies per AZ (the failover provisioner's
+                    # per-zone candidates depend on that).
+                    spot = round(price * (0.30 + 0.02 * i), 4)
                     w.writerow([name, vcpu, mem, region,
-                                f'{region}{letter}', price,
-                                round(price * _SPOT_FRACTION, 4)])
+                                f'{region}{letter}', price, spot])
     return out_path
 
 

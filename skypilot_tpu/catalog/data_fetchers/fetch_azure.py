@@ -1,0 +1,66 @@
+"""Regenerate data/azure_catalog.csv.
+
+Counterpart of reference ``sky/clouds/service_catalog/data_fetchers/
+fetch_azure.py`` (which walks the Azure Retail Prices API). With zero
+egress in this build image the CSV regenerates from an embedded
+snapshot of public pay-as-you-go prices (azure.com pricing, 2025);
+spot ≈ 13% of on-demand (Azure's typical eviction-priced discount).
+Azure exposes no user-facing zones in this catalog — placement inside
+a region is the allocator's job — so AvailabilityZone stays empty.
+
+Run: ``python -m skypilot_tpu.catalog.data_fetchers.fetch_azure``
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+# (size, vcpu, mem GiB, $/hr eastus)
+_TYPES = [
+    ('Standard_B2s', 2, 4, 0.0416),
+    ('Standard_D2s_v5', 2, 8, 0.096),
+    ('Standard_D4s_v5', 4, 16, 0.192),
+    ('Standard_D8s_v5', 8, 32, 0.384),
+    ('Standard_D16s_v5', 16, 64, 0.768),
+    ('Standard_D32s_v5', 32, 128, 1.536),
+    ('Standard_D64s_v5', 64, 256, 3.072),
+    ('Standard_E4s_v5', 4, 32, 0.252),
+    ('Standard_E8s_v5', 8, 64, 0.504),
+    ('Standard_E16s_v5', 16, 128, 1.008),
+    ('Standard_E32s_v5', 32, 256, 2.016),
+    ('Standard_F4s_v2', 4, 8, 0.169),
+    ('Standard_F8s_v2', 8, 16, 0.338),
+    ('Standard_F16s_v2', 16, 32, 0.676),
+    ('Standard_F32s_v2', 32, 64, 1.353),
+]
+
+# region -> price multiplier vs eastus.
+_REGIONS = {
+    'eastus': 1.0,
+    'westus2': 1.0,
+    'westeurope': 1.115,
+    'southcentralus': 1.042,
+    'southeastasia': 1.125,
+}
+
+_SPOT_FRACTION = 0.13
+
+
+def fetch(out_path: str = None) -> str:
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'data', 'azure_catalog.csv')
+    with open(out_path, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow(['InstanceType', 'vCPUs', 'MemoryGiB', 'Region',
+                    'AvailabilityZone', 'Price', 'SpotPrice'])
+        for name, vcpu, mem, base in _TYPES:
+            for region, mult in _REGIONS.items():
+                price = round(base * mult, 4)
+                w.writerow([name, vcpu, mem, region, '', price,
+                            round(price * _SPOT_FRACTION, 4)])
+    return out_path
+
+
+if __name__ == '__main__':
+    print(fetch())

@@ -1,0 +1,47 @@
+"""Regenerate data/lambda_catalog.csv.
+
+Counterpart of reference ``sky/clouds/service_catalog/data_fetchers/
+fetch_lambda_cloud.py`` (which queries /instance-types with an API
+key). With a key in the env this could query the live endpoint; the
+hermetic default regenerates from an embedded snapshot of Lambda's
+public on-demand prices (lambdalabs.com/service/gpu-cloud, 2025).
+Lambda has no spot market, so SpotPrice mirrors Price (use_spot is
+never feasible on this cloud anyway) and no zones.
+
+Run: ``python -m skypilot_tpu.catalog.data_fetchers.fetch_lambda``
+"""
+from __future__ import annotations
+
+import csv
+import os
+
+# (type, vcpu, mem GiB, $/hr)
+_TYPES = [
+    ('cpu_4x_general', 4, 16, 0.08),
+    ('gpu_1x_a10', 30, 200, 0.75),
+    ('gpu_1x_a100_sxm4', 30, 200, 1.29),
+    ('gpu_1x_h100_pcie', 26, 200, 2.49),
+    ('gpu_8x_a100_80gb_sxm4', 240, 1800, 14.32),
+    ('gpu_8x_h100_sxm5', 208, 1800, 23.92),
+]
+
+_REGIONS = ['us-east-1', 'us-west-1', 'us-south-1',
+            'europe-central-1', 'asia-northeast-1']
+
+
+def fetch(out_path: str = None) -> str:
+    out_path = out_path or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'data', 'lambda_catalog.csv')
+    with open(out_path, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow(['InstanceType', 'vCPUs', 'MemoryGiB', 'Region',
+                    'AvailabilityZone', 'Price', 'SpotPrice'])
+        for name, vcpu, mem, price in _TYPES:
+            for region in _REGIONS:
+                w.writerow([name, vcpu, mem, region, '', price, price])
+    return out_path
+
+
+if __name__ == '__main__':
+    print(fetch())

@@ -16,9 +16,32 @@ from skypilot_tpu.models.train import (TrainState, init_train_state,
                                        make_eval_step, make_optimizer,
                                        make_train_step, shard_batch)
 
+
+def family(cfg):
+    """Model-family module for a config (llama or moe) — both expose
+    init_params / param_specs / forward / loss_fn with the same
+    signatures. The ONE family-dispatch point: training, serving and
+    checkpoint-restore all route through it."""
+    from skypilot_tpu.models import llama as llama_mod
+    from skypilot_tpu.models import moe as moe_mod
+    return (moe_mod if isinstance(cfg, moe_mod.MoEConfig)
+            else llama_mod)
+
+
+def config_preset(name: str):
+    """Resolve a preset name ('tpu_1b', 'mixtral_8x7b', ...) across
+    families (used by serving_http --model)."""
+    for cls in (LlamaConfig, MoEConfig):
+        fn = getattr(cls, name, None)
+        if fn is not None:
+            return fn
+    raise ValueError(f'No model preset named {name!r} on LlamaConfig '
+                     'or MoEConfig.')
+
+
 __all__ = [
     'LlamaConfig', 'MoEConfig', 'forward', 'init_params', 'loss_fn',
-    'param_specs',
+    'param_specs', 'family', 'config_preset',
     'TrainState', 'init_train_state', 'make_eval_step', 'make_optimizer',
     'make_train_step', 'shard_batch',
     'cache_specs', 'decode_step', 'generate', 'prefill',

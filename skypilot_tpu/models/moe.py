@@ -206,12 +206,18 @@ def moe_block_dropless(x: jax.Array, lp: Dict,
         wfull += (weights[:, slot, None] *
                   jax.nn.one_hot(idx[:, slot], cfg.n_experts,
                                  dtype=jnp.float32))
-    gate = jax.nn.silu(
-        jnp.einsum('td,edf->tef', xf, lp['w_gate'].astype(cdt)))
-    up = jnp.einsum('td,edf->tef', xf, lp['w_up'].astype(cdt))
-    out = jnp.einsum('tef,efd->ted', gate * up,
-                     lp['w_down'].astype(cdt))
-    y = jnp.einsum('te,ted->td', wfull.astype(cdt), out)
+    wfull = wfull.astype(cdt)
+    # Loop over experts (static unroll, E is small): the all-experts
+    # einsum form materializes [T, E, F] activations — at Mixtral
+    # scale (S 8192, E 8, F 14336) that is gigabytes per layer and
+    # OOMs prefill. Per-expert matmuls keep the working set at
+    # [T, F] while computing the identical dropless result.
+    y = jnp.zeros_like(xf)
+    for e in range(cfg.n_experts):
+        gate = jax.nn.silu(xf @ lp['w_gate'][e].astype(cdt))
+        up = xf @ lp['w_up'][e].astype(cdt)
+        out_e = (gate * up) @ lp['w_down'][e].astype(cdt)
+        y = y + wfull[:, e, None] * out_e
     return y.reshape(b, s, d)
 
 

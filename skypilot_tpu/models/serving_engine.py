@@ -119,12 +119,12 @@ class ServingEngine:
         if mesh is not None:
             # Family-dispatched specs: MoE params carry 'router' +
             # 3-D expert weights that llama's dense tree lacks.
-            from skypilot_tpu.models.train import _family
+            from skypilot_tpu import models
             params = jax.device_put(
                 params,
                 jax.tree.map(
                     lambda spec: jax.sharding.NamedSharding(mesh, spec),
-                    _family(cfg).param_specs(cfg)))
+                    models.family(cfg).param_specs(cfg)))
         self.params = params
         self.cfg = cfg
         self.batch_size = batch_size

@@ -303,7 +303,10 @@ def _build_engine(args) -> 'Any':
 
     from skypilot_tpu import models
     from skypilot_tpu.models.serving_engine import ServingEngine
-    cfg_fn = getattr(models.LlamaConfig, args.model)
+    # Cross-family preset lookup: 'tiny'/'tpu_1b' (dense) and
+    # 'tiny_moe'/'mixtral_8x7b' (MoE) all serve through this front
+    # end.
+    cfg_fn = models.config_preset(args.model)
     cfg = cfg_fn(max_seq=args.max_seq)
     if jax.default_backend() != 'cpu':
         cfg = cfg_fn(max_seq=args.max_seq,
@@ -319,15 +322,15 @@ def _build_engine(args) -> 'Any':
         import os
 
         import orbax.checkpoint as ocp
+        fam = models.family(cfg)
         target = jax.eval_shape(
-            lambda: models.init_params(cfg, jax.random.PRNGKey(0)))
+            lambda: fam.init_params(cfg, jax.random.PRNGKey(0)))
         if mesh is not None:
             # The whole point of --tp is a model LARGER than one chip:
             # the restore target must carry shardings so orbax loads
             # each shard straight to its device instead of
             # materializing the full tree on one chip (OOM).
-            from skypilot_tpu.models.llama import param_specs
-            specs = param_specs(cfg)
+            specs = fam.param_specs(cfg)
             target = jax.tree.map(
                 lambda shape_dtype, spec: jax.ShapeDtypeStruct(
                     shape_dtype.shape, shape_dtype.dtype,
@@ -339,7 +342,8 @@ def _build_engine(args) -> 'Any':
     else:
         logger.warning('No --checkpoint: serving randomly initialized '
                        'weights (benchmark / smoke mode).')
-        params = models.init_params(cfg, jax.random.PRNGKey(0))
+        params = models.family(cfg).init_params(cfg,
+                                                jax.random.PRNGKey(0))
     return ServingEngine(params, cfg, batch_size=args.batch,
                          max_prompt=args.max_prompt,
                          max_seq=args.max_seq,

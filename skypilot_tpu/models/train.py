@@ -21,10 +21,10 @@ from skypilot_tpu.models import llama
 
 
 def _family(cfg):
-    """Model family module for a config (llama or moe) — both expose
-    init_params / param_specs / loss_fn with the same signatures."""
-    from skypilot_tpu.models import moe
-    return moe if isinstance(cfg, moe.MoEConfig) else llama
+    """Family dispatch — delegates to the package-level single source
+    (models.family)."""
+    from skypilot_tpu import models
+    return models.family(cfg)
 
 
 @dataclasses.dataclass

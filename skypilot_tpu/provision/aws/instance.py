@@ -284,6 +284,23 @@ def _rule_marker(cluster_name_on_cloud: str) -> str:
     return f'skytpu:{cluster_name_on_cloud}'
 
 
+def _owns_rule(ec2, sg_id: str, permission: Dict[str, Any],
+               marker: str) -> bool:
+    """Whether the existing rule matching ``permission`` carries this
+    cluster's marker (duplicate-on-relaunch is benign)."""
+    try:
+        resp = ec2.describe_security_groups(GroupIds=[sg_id])
+    except Exception:  # pylint: disable=broad-except
+        return False
+    for sg in resp.get('SecurityGroups', []):
+        for perm in sg.get('IpPermissions', []):
+            if (perm.get('FromPort') == permission['FromPort'] and
+                    perm.get('ToPort') == permission['ToPort']):
+                return any(r.get('Description') == marker
+                           for r in perm.get('IpRanges', []))
+    return False
+
+
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                region: str, zone: Optional[str]) -> None:
     """Authorize TCP ingress on the instances' security groups
@@ -317,15 +334,18 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
                     code = str(resp.get('Error', {}).get('Code', ''))
                 if code == 'InvalidPermission.Duplicate':
                     # AWS rule identity ignores descriptions: the
-                    # existing rule may belong to ANOTHER cluster on a
-                    # shared (default) SG, whose teardown will revoke
-                    # it out from under this one. Surface that.
-                    logger.warning(
-                        'aws: port %s on %s is already open by '
-                        'another rule (possibly another cluster on '
-                        'this shared security group); it may close '
-                        'when that owner tears down. Use a dedicated '
-                        'SG/VPC for isolation.', p, sg_id)
+                    # existing rule may be OURS (benign relaunch) or
+                    # another cluster's on a shared default SG, whose
+                    # teardown will revoke it out from under us. Only
+                    # the foreign case deserves a warning.
+                    if not _owns_rule(ec2, sg_id, permission, marker):
+                        logger.warning(
+                            'aws: port %s on %s is already open by '
+                            'another rule (possibly another cluster '
+                            'on this shared security group); it may '
+                            'close when that owner tears down. Use a '
+                            'dedicated SG/VPC for isolation.', p,
+                            sg_id)
                     continue
                 raise translate_error(e, 'open_ports') from e
 

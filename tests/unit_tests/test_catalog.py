@@ -47,3 +47,25 @@ def test_validate_region_zone():
 def test_regions_with_tpu():
     regions = catalog.regions_with_tpu('tpu-v4-8')
     assert regions == ['us-central2']
+
+
+def test_fetchers_regenerate_shipped_catalogs(tmp_path):
+    """Every VM catalog CSV is exactly reproducible from its fetcher's
+    embedded snapshot — the shipped data can never drift from the
+    regeneration path."""
+    import filecmp
+    import os
+
+    import skypilot_tpu.catalog as catalog_pkg
+    from skypilot_tpu.catalog.data_fetchers import (fetch_aws,
+                                                    fetch_azure,
+                                                    fetch_lambda)
+    data_dir = os.path.join(
+        os.path.dirname(os.path.abspath(catalog_pkg.__file__)),
+        'data')
+    for fetcher, fname in ((fetch_aws, 'aws_catalog.csv'),
+                           (fetch_azure, 'azure_catalog.csv'),
+                           (fetch_lambda, 'lambda_catalog.csv')):
+        out = fetcher.fetch(str(tmp_path / fname))
+        assert filecmp.cmp(out, os.path.join(data_dir, fname),
+                           shallow=False), f'{fname} drifted'
