@@ -59,6 +59,18 @@ class MoEConfig(LlamaConfig):
         return cls(**d)
 
     @classmethod
+    def tpu_moe_1b(cls, **kw) -> 'MoEConfig':
+        """~1.9B-param (8 experts, ~0.7B active) single-chip MoE:
+        tpu_1b's attention stack with the dense ffn split into 8
+        experts of ffn_dim 2048, top-2 routed — fits a 16 GB v5e for
+        serving benchmarks of the MoE family."""
+        d = dict(vocab_size=128256, dim=2048, n_layers=16, n_heads=16,
+                 n_kv_heads=8, ffn_dim=2048, max_seq=8192,
+                 n_experts=8, top_k=2)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
     def mixtral_8x7b(cls, **kw) -> 'MoEConfig':
         """Mixtral-8x7B shape (public): the MoE flagship."""
         d = dict(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
