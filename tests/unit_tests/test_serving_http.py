@@ -162,3 +162,45 @@ def test_streaming_generate_through_lb(stack):
         await runner.cleanup()
 
     asyncio.run(scenario())
+
+
+@pytest.mark.slow
+def test_moe_model_serves_over_http():
+    """--model tiny_moe resolves across families (config_preset) and
+    serves through the same HTTP front end."""
+    import argparse
+
+    from skypilot_tpu.models import serving_http
+
+    args = argparse.Namespace(model='tiny_moe', max_seq=128,
+                              checkpoint=None, batch=2, max_prompt=32,
+                              decode_chunk=4, kv_quant=False, tp=1)
+    engine = serving_http._build_engine(args)
+    server = serving_http.EngineServer(engine)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                try:
+                    async with session.get(
+                            f'http://127.0.0.1:{port}/health') as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError('moe engine never became ready')
+            async with session.post(
+                    f'http://127.0.0.1:{port}/generate',
+                    json={'tokens': [3, 1, 4], 'max_new': 5}) as r:
+                assert r.status == 200
+                body = await r.json()
+        await runner.cleanup()
+        return body
+
+    body = asyncio.run(scenario())
+    server.stop()
+    assert len(body['tokens']) == 5
