@@ -80,16 +80,28 @@ def main():
         # won the sweep (512-block variants lose 2-8 MFU points; 2048
         # blocks exceed VMEM).
         raw = os.environ.get('BENCH_REMAT', 'kvo')
-        cfg = models.LlamaConfig.tpu_1b(
+        # BENCH_MODEL=tpu_moe_1b benches the MoE family's train step
+        # (MFU counted against ACTIVE params, the standard MoE
+        # convention).
+        cfg = models.config_preset(
+            os.environ.get('BENCH_MODEL', 'tpu_1b'))(
             max_seq=seq, param_dtype=dtype,
             loss_chunk=int(os.environ.get('BENCH_LOSS_CHUNK', '1024')),
             remat={'1': True, '0': False}.get(raw, raw))
 
-    from skypilot_tpu.models.llama import num_params
-    n_params = num_params(cfg)
-    # flops/token: 6N (matmuls fwd+bwd) + causal attention
+    import numpy as _np
+    shapes = jax.eval_shape(
+        lambda: models.family(cfg).init_params(cfg,
+                                               jax.random.PRNGKey(0)))
+    n_params = sum(int(_np.prod(x.shape))
+                   for x in jax.tree.leaves(shapes))
+    n_active = n_params
+    if isinstance(cfg, models.MoEConfig):
+        n_active -= ((cfg.n_experts - cfg.top_k) * 3 * cfg.dim *
+                     cfg.ffn_dim * cfg.n_layers)
+    # flops/token: 6N_active (matmuls fwd+bwd) + causal attention
     # 6*L*S*d (QK^T + PV fwd+bwd, halved by causality).
-    flops_per_token = 6 * n_params + 6 * cfg.n_layers * seq * cfg.dim
+    flops_per_token = 6 * n_active + 6 * cfg.n_layers * seq * cfg.dim
 
     # Adafactor matches the baseline recipe's --optim adafactor and has
     # built-in update clipping (no extra full-size grad copy).
@@ -124,6 +136,7 @@ def main():
             'tokens_per_sec_per_chip': round(tokens_per_sec, 1),
             'step_time_s': round(dt, 4),
             'seq': seq, 'batch': batch, 'n_params': n_params,
+            'n_active_params': n_active,
             'chip': gen, 'backend': jax.default_backend(),
             'baseline_mfu_pct': round(_BASELINE_MFU * 100, 2),
         },
