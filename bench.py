@@ -39,6 +39,19 @@ _BASELINE_MFU = (_BASELINE_TOKENS_PER_SEC_PER_CHIP *
                  _BASELINE_FLOPS_PER_TOKEN / 918e12)
 
 
+def _count_params(cfg) -> int:
+    """Family-aware param count (llama.num_params only counts the
+    dense tree; MoE presets carry router + expert banks)."""
+    import jax
+    import numpy as np
+
+    from skypilot_tpu import models
+    shapes = jax.eval_shape(
+        lambda: models.family(cfg).init_params(cfg,
+                                               jax.random.PRNGKey(0)))
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
+
+
 def _detect_generation(device) -> str:
     kind = getattr(device, 'device_kind', '').lower()
     for gen in ('v6e', 'v5p', 'v5e', 'v5 lite', 'v4', 'v3', 'v2'):
@@ -160,8 +173,17 @@ def decode_bench():
     # double at the same cache HBM budget as the round-2 bf16 config
     # (batch 32), which on a bandwidth-bound step ~doubles tokens/s.
     kv_quant = os.environ.get('BENCH_DECODE_QUANT', '1') == '1'
-    batch = int(os.environ.get('BENCH_DECODE_BATCH',
-                               '128' if kv_quant else '32'))
+    # BENCH_DECODE_MODEL=llama3_8b decodes the reference's own serving
+    # class (7-8B) on this chip via int8 weights (a bf16 8B tree alone
+    # exceeds the 16 GB v5e).
+    model = os.environ.get('BENCH_DECODE_MODEL', 'tpu_1b')
+    wquant = os.environ.get(
+        'BENCH_DECODE_WQUANT',
+        '1' if model == 'llama3_8b' else '0') == '1'
+    batch = int(os.environ.get(
+        'BENCH_DECODE_BATCH',
+        ('32' if model == 'llama3_8b' else
+         '128' if kv_quant else '32')))
     context = int(os.environ.get('BENCH_DECODE_CONTEXT', '1024'))
     steps = int(os.environ.get('BENCH_DECODE_STEPS', '64'))
     # Cache sized the way a serving engine sizes it: prompt context
@@ -179,16 +201,23 @@ def decode_bench():
     if not on_tpu:
         batch, context, steps = 4, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
+        wquant = False
     else:
-        cfg = models.LlamaConfig.tpu_1b(max_seq=max_seq,
-                                        param_dtype=jnp.bfloat16)
-    from skypilot_tpu.models.llama import num_params
-    n_params = num_params(cfg)
+        cfg = models.config_preset(model)(max_seq=max_seq,
+                                          param_dtype=jnp.bfloat16)
+    n_params = _count_params(cfg)
 
     prompt = jax.random.randint(jax.random.PRNGKey(0),
                                 (batch, context), 0, cfg.vocab_size)
     lengths = jnp.full((batch,), context, jnp.int32)
-    params = models.init_params(cfg, jax.random.PRNGKey(1))
+    from skypilot_tpu.models import quantization
+    if wquant:
+        params = quantization.init_quantized_params(
+            cfg, jax.random.PRNGKey(1))
+    else:
+        params = models.family(cfg).init_params(cfg,
+                                                jax.random.PRNGKey(1))
+    param_bytes = quantization.quantized_bytes(params)
     _, cache = jax.jit(
         lambda p, t, n: inference.prefill(p, t, n, cfg,
                                           kv_quant=kv_quant),
@@ -234,8 +263,10 @@ def decode_bench():
         'detail': {
             'step_time_ms': round(dt * 1000, 3),
             'batch': batch, 'context': context,
-            'kv_quant': kv_quant,
-            'n_params': n_params, 'chip': gen,
+            'model': model,
+            'kv_quant': kv_quant, 'weight_quant': wquant,
+            'n_params': n_params, 'param_bytes': param_bytes,
+            'chip': gen,
             'backend': jax.default_backend(),
             'decode_mfu_pct': round(decode_mfu * 100, 2),
             'baseline_decode_mfu_pct': round(base_mfu * 100, 2),
@@ -266,8 +297,17 @@ def serve_bench():
     # chunk 16 beats 32 (less tail waste past EOS/max_new) and 8 (too
     # many dispatches) now that double-buffered dispatch hides the
     # host sync. batch 96+ OOMs at this cache shape.
+    # BENCH_SERVE_MODEL=llama3_8b serves the reference's own workload
+    # class (JetStream's demo is Llama-2-7B) on this chip: int8
+    # weights (~8 GB) + int8 KV cache fit the 16 GB v5e that bf16
+    # could never fit (params alone 16 GB).
+    model = os.environ.get('BENCH_SERVE_MODEL', 'tpu_1b')
+    wquant = os.environ.get(
+        'BENCH_SERVE_WQUANT',
+        '1' if model == 'llama3_8b' else '0') == '1'
     n_requests = int(os.environ.get('BENCH_SERVE_REQUESTS', '192'))
-    batch = int(os.environ.get('BENCH_SERVE_BATCH', '64'))
+    batch = int(os.environ.get(
+        'BENCH_SERVE_BATCH', '32' if model == 'llama3_8b' else '64'))
     max_prompt = int(os.environ.get('BENCH_SERVE_PROMPT', '1024'))
     max_new = int(os.environ.get('BENCH_SERVE_MAX_NEW', '128'))
     kv_quant = os.environ.get('BENCH_SERVE_QUANT', '1') == '1'
@@ -276,19 +316,27 @@ def serve_bench():
         n_requests, batch, max_prompt, max_new = 6, 2, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
         max_seq = 128
+        wquant = False
     else:
         # Decode region = 4x max_new: slots recycle ~4 requests per
         # cache round before a reset.
         max_seq = max_prompt + 4 * max_new
-        cfg = models.LlamaConfig.tpu_1b(max_seq=max_seq,
-                                        param_dtype=jnp.bfloat16)
-    from skypilot_tpu.models.llama import num_params
-    n_params = num_params(cfg)
+        cfg = models.config_preset(model)(max_seq=max_seq,
+                                          param_dtype=jnp.bfloat16)
+    n_params = _count_params(cfg)
 
-    params = models.init_params(cfg, jax.random.PRNGKey(1))
+    from skypilot_tpu.models import quantization
+    if wquant:
+        params = quantization.init_quantized_params(
+            cfg, jax.random.PRNGKey(1))
+    else:
+        params = models.family(cfg).init_params(cfg,
+                                                jax.random.PRNGKey(1))
+    param_bytes = quantization.quantized_bytes(params)
     engine = ServingEngine(params, cfg, batch_size=batch,
                            max_prompt=max_prompt, max_seq=max_seq,
-                           kv_quant=kv_quant, decode_chunk=chunk)
+                           kv_quant=kv_quant, weight_quant=wquant,
+                           decode_chunk=chunk)
     rng = np.random.default_rng(0)
     reqs = []
     for i in range(n_requests):
@@ -316,8 +364,10 @@ def serve_bench():
             'wall_s': round(dt, 2),
             'output_tok_s': round(out_tokens / dt, 1),
             'n_requests': n_requests, 'batch_slots': batch,
-            'max_new': max_new, 'kv_quant': kv_quant,
-            'n_params': n_params, 'chip': gen,
+            'max_new': max_new, 'model': model,
+            'kv_quant': kv_quant, 'weight_quant': wquant,
+            'n_params': n_params, 'param_bytes': param_bytes,
+            'chip': gen,
             'backend': jax.default_backend(),
         },
     }
@@ -363,9 +413,8 @@ def serve_stack_bench():
     # r4 measured 17.5 -> 19.5 req/s going 64 -> 128 in-flight.
     concurrency = int(os.environ.get('BENCH_SERVE_CONCURRENCY',
                                      str(2 * batch)))
-    from skypilot_tpu.models.llama import num_params
-    n_params = num_params(cfg)
-    params = models.init_params(cfg, jax.random.PRNGKey(1))
+    n_params = _count_params(cfg)
+    params = models.family(cfg).init_params(cfg, jax.random.PRNGKey(1))
     engine = ServingEngine(params, cfg, batch_size=batch,
                            max_prompt=max_prompt, max_seq=max_seq,
                            kv_quant=on_tpu, decode_chunk=chunk)

@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models.llama import (LlamaConfig, _attention,
                                        _rmsnorm, _rope, forward_hidden)
+from skypilot_tpu.models.quantization import qdot, qembed
 
 # Cache layout: [n_layers, B, max_seq, n_kv_heads, head_dim].
 CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
@@ -99,9 +100,9 @@ def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig) -> jax.Array:
         h3 = h if h.ndim == 3 else h[:, None]
         y = moe.moe_block_dropless(h3, lp, cfg)
         return y if h.ndim == 3 else y[:, 0]
-    gate = jax.nn.silu(h @ lp['w_gate'].astype(cdt))
-    up = h @ lp['w_up'].astype(cdt)
-    return (gate * up) @ lp['w_down'].astype(cdt)
+    gate = jax.nn.silu(qdot(h, lp['w_gate'], cdt))
+    up = qdot(h, lp['w_up'], cdt)
+    return qdot(gate * up, lp['w_down'], cdt)
 
 
 # Cache slot layout (the key to fast TPU decode): prompts occupy
@@ -206,17 +207,17 @@ def prefill(params: Dict,
     s_max = max_seq or cfg.max_seq
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
-    x = params['tok_emb'].astype(cdt)[tokens]
+    x = qembed(params['tok_emb'], tokens, cdt)
     x = _constrain(x, P(('dp', 'fsdp'), None, None), mesh)
 
     def layer(x, lp):
         h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
-        q = (h @ lp['wq'].astype(cdt)).reshape(b, s, cfg.n_heads,
-                                               cfg.head_dim)
-        k = (h @ lp['wk'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
-                                               cfg.head_dim)
-        v = (h @ lp['wv'].astype(cdt)).reshape(b, s, cfg.n_kv_heads,
-                                               cfg.head_dim)
+        q = qdot(h, lp['wq'], cdt).reshape(b, s, cfg.n_heads,
+                                           cfg.head_dim)
+        k = qdot(h, lp['wk'], cdt).reshape(b, s, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        v = qdot(h, lp['wv'], cdt).reshape(b, s, cfg.n_kv_heads,
+                                           cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         # Same attention dispatch as training (Pallas flash kernel on
@@ -224,7 +225,7 @@ def prefill(params: Dict,
         # the [S, S] score matrix.
         o = _attention(q, k, v, cfg, mesh)
         o = o.reshape(b, s, cfg.n_heads * cfg.head_dim).astype(cdt)
-        x = x + o @ lp['wo'].astype(cdt)
+        x = x + qdot(o, lp['wo'], cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
         x = x + _mlp_delta(h, lp, cfg)
@@ -243,9 +244,8 @@ def prefill(params: Dict,
     # Hidden state at each prompt's final position -> logits.
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    logits = jnp.einsum('bd,dv->bv', last,
-                        params['lm_head'].astype(cdt),
-                        preferred_element_type=jnp.float32)
+    logits = qdot(last, params['lm_head'], cdt,
+                  preferred=jnp.float32)
 
     lengths = lengths.astype(jnp.int32)
     dmask = jnp.arange(s_max)[None, :] < lengths[:, None]
@@ -306,7 +306,7 @@ def decode_step(params: Dict,
     if active is None:
         active = jnp.ones((b,), bool)
 
-    x = params['tok_emb'].astype(cdt)[tokens]   # [B, D]
+    x = qembed(params['tok_emb'], tokens, cdt)  # [B, D]
     x = _constrain(x, P(('dp', 'fsdp'), None), mesh)
 
     def layer(carry, inp):
@@ -317,12 +317,12 @@ def decode_step(params: Dict,
             ksc = vsc = None
         lp, li = inp
         h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
-        q = (h @ lp['wq'].astype(cdt)).reshape(b, cfg.n_heads,
-                                               cfg.head_dim)
-        k = (h @ lp['wk'].astype(cdt)).reshape(b, cfg.n_kv_heads,
-                                               cfg.head_dim)
-        v = (h @ lp['wv'].astype(cdt)).reshape(b, cfg.n_kv_heads,
-                                               cfg.head_dim)
+        q = qdot(h, lp['wq'], cdt).reshape(b, cfg.n_heads,
+                                           cfg.head_dim)
+        k = qdot(h, lp['wk'], cdt).reshape(b, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        v = qdot(h, lp['wv'], cdt).reshape(b, cfg.n_kv_heads,
+                                           cfg.head_dim)
         q = _rope(q[:, None], pos[:, None], cfg.rope_theta)[:, 0]
         k = _rope(k[:, None], pos[:, None], cfg.rope_theta)[:, 0]
         page_k = lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
@@ -336,7 +336,7 @@ def decode_step(params: Dict,
         o = _gqa_decode_attention(q, page_k, page_v, valid,
                                   k_self=k, v_self=v,
                                   k_scale=page_ks, v_scale=page_vs)
-        x = x + o @ lp['wo'].astype(cdt)
+        x = x + qdot(o, lp['wo'], cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
         x = x + _mlp_delta(h, lp, cfg)
@@ -369,8 +369,7 @@ def decode_step(params: Dict,
     else:
         (x, ks, vs), sks, svs = out_carry, None, None
     x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
-    logits = jnp.einsum('bd,dv->bv', x, params['lm_head'].astype(cdt),
-                        preferred_element_type=jnp.float32)
+    logits = qdot(x, params['lm_head'], cdt, preferred=jnp.float32)
     dmask = lax.dynamic_update_slice(cache['dmask'], active[:, None],
                                      (0, slot))
     new_cache = {'k': _constrain(ks, CACHE_SPEC, mesh),

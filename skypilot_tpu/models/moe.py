@@ -224,11 +224,12 @@ def moe_block_dropless(x: jax.Array, lp: Dict,
     # scale (S 8192, E 8, F 14336) that is gigabytes per layer and
     # OOMs prefill. Per-expert matmuls keep the working set at
     # [T, F] while computing the identical dropless result.
+    from skypilot_tpu.models.quantization import qdot, qindex
     y = jnp.zeros_like(xf)
     for e in range(cfg.n_experts):
-        gate = jax.nn.silu(xf @ lp['w_gate'][e].astype(cdt))
-        up = xf @ lp['w_up'][e].astype(cdt)
-        out_e = (gate * up) @ lp['w_down'][e].astype(cdt)
+        gate = jax.nn.silu(qdot(xf, qindex(lp['w_gate'], e), cdt))
+        up = qdot(xf, qindex(lp['w_up'], e), cdt)
+        out_e = qdot(gate * up, qindex(lp['w_down'], e), cdt)
         y = y + wfull[:, e, None] * out_e
     return y.reshape(b, s, d)
 

@@ -1,0 +1,210 @@
+"""Post-training int8 weight quantization for serving.
+
+The reference serves its 7B-class models through JetStream with
+``quantize_weights=True`` (examples/tpu/v6e/serve-llama2-7b.yaml,
+README.md:95-120) — weight-only int8 is what fits a 7-8B model on a
+single 16 GB chip and is the standard serving quantization on TPU.
+This module is the TPU-native equivalent for our engine:
+
+- **Per-output-channel symmetric int8.** Each weight matrix ``w``
+  [.., in, out] stores ``q = round(w / s)`` as int8 with a scale
+  ``s = max|w| / 127`` per *output* channel ([.., out]). Because the
+  scale is constant along the contraction (``in``) axis it factors
+  out of the matmul: ``x @ w  ==  (x @ q) * s`` — the dot reads int8
+  straight from HBM (the convert is a fusible unary on the operand)
+  and the dequantize is one cheap per-column multiply on the output.
+  Decode is weight-bandwidth-bound, so halving the bytes per step
+  (~2x vs bf16) is, to first order, 2x decode throughput — the same
+  lever the int8 KV cache pulls for the cache reads.
+- **Embedding rows quantize per-row** (the lookup gathers rows, so
+  the scale must be constant along ``dim``, not ``vocab``).
+- **Norm weights and the MoE router stay unquantized**: together they
+  are <0.1% of bytes, and the router's top-k is the one place a
+  quantization flip changes *which* weights run, not just their
+  values.
+
+A quantized leaf is the pytree dict ``{'q': int8, 's': f32}`` — the
+params tree keeps its keys, so ``lax.scan`` over stacked layers, the
+engine's donation, and checkpoint save/restore all work unchanged.
+
+``init_quantized_params`` builds a random *already-quantized* tree
+directly (int8 allocation only): an 8B bf16 tree (16 GB) cannot be
+materialized then quantized on a 16 GB chip, but its int8 form
+(~8 GB) fits with room for the KV cache — which is exactly the
+configuration the serving benchmark runs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Tree keys never quantized: norms are vectors (negligible bytes) and
+# the MoE router decides top-k expert identity (precision-critical).
+_SKIP_KEYS = frozenset({'attn_norm', 'mlp_norm', 'final_norm',
+                        'router'})
+# Keys quantized per-ROW (scale over the last axis) because they are
+# consumed by gather, not matmul.
+_ROW_KEYS = frozenset({'tok_emb'})
+
+# Uniform int8 in [-127, 127] has std sqrt((255^2 - 1) / 12) — used by
+# init_quantized_params to pick scales that reproduce the bf16 init's
+# fan-in-normalized weight std.
+_INT8_UNIFORM_STD = 73.6116
+
+
+def is_quantized(params: Dict) -> bool:
+    """True if the tree contains any {'q', 's'} quantized leaf."""
+    if isinstance(params, dict):
+        if set(params.keys()) == {'q', 's'}:
+            return True
+        return any(is_quantized(v) for v in params.values())
+    return False
+
+
+def _quantize_leaf(w: jax.Array, axis: int) -> Dict[str, jax.Array]:
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=axis) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.round(wf / jnp.expand_dims(s, axis))
+    return {'q': q.astype(jnp.int8), 's': s}
+
+
+def dequantize_leaf(w: Dict[str, jax.Array], axis: int,
+                    dtype=jnp.float32) -> jax.Array:
+    return (w['q'].astype(dtype) *
+            jnp.expand_dims(w['s'], axis).astype(dtype))
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Quantize a dense param tree (llama or moe family) to int8.
+
+    Matmul weights quantize over their contraction axis (-2: scale
+    per output channel); embedding tables per-row (-1). Stacked layer
+    and expert leading axes are untouched — a [L, E, in, out] MoE
+    expert bank gets scales [L, E, out].
+    """
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out[k] = quantize_params(v)
+        elif k in _SKIP_KEYS:
+            out[k] = v
+        elif k in _ROW_KEYS:
+            out[k] = _quantize_leaf(v, -1)
+        else:
+            out[k] = _quantize_leaf(v, -2)
+    return out
+
+
+def quantize_specs(specs: Dict, params: Dict) -> Dict:
+    """PartitionSpec tree matching ``quantize_params(params)``.
+
+    The int8 payload keeps the dense leaf's spec; the scale drops the
+    spec entry of the reduced axis (contraction axis for matmuls, the
+    trailing dim for embeddings), so e.g. wq P(None, 'fsdp', 'tp')
+    -> {'q': P(None, 'fsdp', 'tp'), 's': P(None, 'tp')}.
+    """
+    from jax.sharding import PartitionSpec as P
+    out: Dict[str, Any] = {}
+    for k, spec in specs.items():
+        if isinstance(spec, dict):
+            out[k] = quantize_specs(spec, params[k])
+            continue
+        leaf = params[k]
+        if not (isinstance(leaf, dict) and set(leaf) == {'q', 's'}):
+            out[k] = spec
+            continue
+        axis = -1 if k in _ROW_KEYS else -2
+        entries = list(spec) + [None] * (leaf['q'].ndim - len(spec))
+        del entries[axis]
+        out[k] = {'q': spec, 's': P(*entries)}
+    return out
+
+
+def qdot(x: jax.Array, w, cdt,
+         preferred: Optional[Any] = None) -> jax.Array:
+    """``x @ w`` where ``w`` is a dense array OR a quantized leaf.
+
+    For quantized weights the int8 payload is the matmul operand (XLA
+    fuses the int8->cdt convert into the dot's HBM read — never
+    materialize a dequantized copy; decode is weight-bandwidth-bound)
+    and the per-output-channel scale multiplies the result.
+    """
+    if isinstance(w, dict):
+        y = jnp.matmul(x, w['q'].astype(cdt),
+                       preferred_element_type=preferred)
+        return y * w['s'].astype(y.dtype)
+    return jnp.matmul(x, w.astype(cdt),
+                      preferred_element_type=preferred)
+
+
+def qembed(emb, tokens: jax.Array, cdt) -> jax.Array:
+    """Embedding lookup for a dense or per-row-quantized table."""
+    if isinstance(emb, dict):
+        return (emb['q'][tokens].astype(cdt) *
+                emb['s'][tokens][..., None].astype(cdt))
+    return emb.astype(cdt)[tokens]
+
+
+def qindex(w, e) -> Any:
+    """Index an expert bank along its leading expert axis, preserving
+    quantization ({'q': q[e], 's': s[e]})."""
+    if isinstance(w, dict):
+        return {'q': w['q'][e], 's': w['s'][e]}
+    return w[e]
+
+
+def init_quantized_params(cfg, key: jax.Array) -> Dict:
+    """Random params born int8 — the structure ``quantize_params``
+    would produce, without ever materializing the bf16 tree (an 8B
+    bf16 tree is 16 GB; its int8 form fits the serving chip).
+
+    Weight values are uniform int8 with per-channel scales chosen so
+    the dequantized std matches the dense init's fan_in**-0.5 —
+    magnitudes (hence activation/logit ranges and step timings) match
+    a real quantized checkpoint; values are random.
+    """
+    from skypilot_tpu import models
+    fam = models.family(cfg)
+    shapes = jax.eval_shape(lambda k: fam.init_params(cfg, k),
+                            jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+    def build(tree, key):
+        out: Dict[str, Any] = {}
+        for k, v in tree.items():
+            key, sub = jax.random.split(key)
+            if isinstance(v, dict):
+                out[k] = build(v, sub)
+            elif k in _SKIP_KEYS:
+                # Same skip set as quantize_params, so the two trees
+                # always share one structure. Norms init to ones; the
+                # router (the one skipped matmul) gets the fan-in init.
+                if k == 'router':
+                    out[k] = (jax.random.normal(sub, v.shape,
+                                                jnp.float32)
+                              * v.shape[-2]**-0.5).astype(
+                                  cfg.param_dtype)
+                else:
+                    out[k] = jnp.ones(v.shape, cfg.param_dtype)
+            else:
+                axis = -1 if k in _ROW_KEYS else -2
+                fan_in = v.shape[axis]
+                s_shape = list(v.shape)
+                del s_shape[axis]
+                q = jax.random.randint(sub, v.shape, -127, 128,
+                                       jnp.int8)
+                s = jnp.full(tuple(s_shape),
+                             fan_in**-0.5 / _INT8_UNIFORM_STD,
+                             jnp.float32)
+                out[k] = {'q': q, 's': s}
+        return out
+
+    return build(shapes, key)
+
+
+def quantized_bytes(params: Dict) -> int:
+    """Total on-device bytes of a (possibly quantized) param tree."""
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree.leaves(params))

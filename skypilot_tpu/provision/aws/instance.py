@@ -287,17 +287,28 @@ def _rule_marker(cluster_name_on_cloud: str) -> str:
 def _owns_rule(ec2, sg_id: str, permission: Dict[str, Any],
                marker: str) -> bool:
     """Whether the existing rule matching ``permission`` carries this
-    cluster's marker (duplicate-on-relaunch is benign)."""
+    cluster's marker (duplicate-on-relaunch is benign).
+
+    The match is the FULL rule identity — protocol, port range, and
+    CIDR — and every matching permission is inspected: an SG can hold
+    a UDP rule or a different-CIDR TCP rule on the same port range,
+    and keying on ports alone could mis-attribute the probed rule to
+    (or away from) this cluster."""
+    want_cidrs = {r['CidrIp'] for r in permission['IpRanges']}
     try:
         resp = ec2.describe_security_groups(GroupIds=[sg_id])
     except Exception:  # pylint: disable=broad-except
         return False
     for sg in resp.get('SecurityGroups', []):
         for perm in sg.get('IpPermissions', []):
-            if (perm.get('FromPort') == permission['FromPort'] and
-                    perm.get('ToPort') == permission['ToPort']):
-                return any(r.get('Description') == marker
-                           for r in perm.get('IpRanges', []))
+            if (perm.get('IpProtocol') != permission['IpProtocol'] or
+                    perm.get('FromPort') != permission['FromPort'] or
+                    perm.get('ToPort') != permission['ToPort']):
+                continue
+            if any(r.get('CidrIp') in want_cidrs and
+                   r.get('Description') == marker
+                   for r in perm.get('IpRanges', [])):
+                return True
     return False
 
 

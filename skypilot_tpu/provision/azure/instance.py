@@ -256,20 +256,40 @@ def terminate_instances(cluster_name_on_cloud: str, region: str,
         raise api.translate_error(e, 'group delete') from e
 
 
+def _next_nsg_priority(rg: str) -> int:
+    """First NSG rule priority >= 900 unused by ANY rule in the
+    group's NSGs. ``az vm open-port`` defaults every rule to priority
+    900, so a second open_ports call on the same cluster (ports added
+    on a later launch/update) would violate Azure's unique-priority
+    constraint; an explicit fresh priority per call avoids it."""
+    try:
+        nsgs = api.run_az(['network', 'nsg', 'list', '-g', rg]) or []
+    except api.AzCliError:
+        return 900
+    used = {r.get('priority') for nsg in nsgs
+            for r in (nsg.get('securityRules') or [])}
+    p = 900
+    while p in used:
+        p += 1
+    return p
+
+
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                region: str, zone: Optional[str]) -> None:
     del region, zone
     if not ports:
         return
     rg = resource_group(cluster_name_on_cloud)
-    # One call with a comma-joined port list: per-port calls would
-    # each create an NSG rule at the default priority (900) and the
-    # second one fails Azure's unique-priority constraint.
+    # One call with a comma-joined port list (per-port calls would
+    # each need their own priority), at a priority no existing rule
+    # in the group uses.
     port_arg = ','.join(str(p) for p in ports)
+    priority = _next_nsg_priority(rg)
     for vm in _list_vms(rg):
         try:
             api.run_az(['vm', 'open-port', '-g', rg, '-n',
-                        vm['name'], '--port', port_arg])
+                        vm['name'], '--port', port_arg,
+                        '--priority', str(priority)])
         except api.AzCliError as e:
             raise api.translate_error(e, 'vm open-port') from e
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
 import time
 from typing import Any, Dict, List, Optional
 
@@ -36,15 +37,21 @@ def _vm_name(cluster: str, idx: int) -> str:
 
 def _cluster_instances(client: api.LambdaClient,
                        cluster: str) -> Dict[str, Dict[str, Any]]:
-    """name -> instance for this cluster's members (name prefix).
+    """name -> instance for this cluster's members.
+
+    Membership is an EXACT ``<cluster>-<rank>`` match, not a prefix
+    test: cluster names may extend each other (``prod`` vs
+    ``prod-eu``), and a prefix sweep would pull a foreign cluster's
+    instances into this one's status — and, worse, its terminate.
 
     When a dying and a live instance briefly share a name (relaunch
     right after a terminate), the LIVE one wins the key so status/
     info paths never report the corpse."""
+    member = re.compile(re.escape(cluster) + r'-\d+\Z')
     out: Dict[str, Dict[str, Any]] = {}
     for inst in client.list_instances():
         name = inst.get('name') or ''
-        if not name.startswith(f'{cluster}-'):
+        if not member.fullmatch(name):
             continue
         prev = out.get(name)
         if prev is not None and prev.get('status') not in (

@@ -20,6 +20,7 @@ class FakeAz:
     def __init__(self):
         self.groups = {}            # name -> {'location':, 'tags':}
         self.vms = {}               # (rg, name) -> dict
+        self.nsg_rules = []         # open-port rules ({'priority':})
         self.create_error = None    # AzCliError to raise on vm create
         self.calls = []
 
@@ -75,7 +76,18 @@ class FakeAz:
             self.vms[(rg, name)]['powerState'] = 'VM deallocated'
             return None
         if cmd == ('vm', 'open-port'):
+            # Azure rejects two rules in one NSG at equal priority.
+            prio = (int(argv[argv.index('--priority') + 1])
+                    if '--priority' in argv else 900)
+            if any(r['priority'] == prio for r in self.nsg_rules):
+                raise az_api.AzCliError(
+                    argv, 1, 'SecurityRuleConflict: priority in use')
+            self.nsg_rules.append(
+                {'priority': prio,
+                 'ports': argv[argv.index('--port') + 1]})
             return None
+        if tuple(argv[:3]) == ('network', 'nsg', 'list'):
+            return [{'securityRules': list(self.nsg_rules)}]
         if cmd == ('account', 'show'):
             return {'id': 'sub-123', 'user': {'name': 'me@corp'}}
         raise AssertionError(f'unhandled az {argv}')
@@ -153,6 +165,19 @@ def test_run_instances_idempotent(az):
     record = az_instance.run_instances(config)
     assert record.created_instance_ids == []
     assert len(az.vms) == 2
+
+
+def test_open_ports_twice_uses_distinct_priorities(az):
+    """Ports added on a later launch/update of the same cluster must
+    not collide with the first call's NSG rule priority (Azure
+    enforces unique priorities per NSG)."""
+    config = az_instance.bootstrap_instances(_config(count=1))
+    az_instance.run_instances(config)
+    az_instance.open_ports('az-c', ['8080'], 'eastus', None)
+    az_instance.open_ports('az-c', ['9090-9099'], 'eastus', None)
+    prios = [r['priority'] for r in az.nsg_rules]
+    assert len(prios) == len(set(prios)) == 2
+    assert {r['ports'] for r in az.nsg_rules} == {'8080', '9090-9099'}
 
 
 def test_spot_priority(az):
