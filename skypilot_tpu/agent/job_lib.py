@@ -2,10 +2,19 @@
 
 Re-design of reference ``sky/skylet/job_lib.py`` (JobStatus :121,
 JobScheduler :204, driver liveness :538). State lives in a SQLite DB in
-the cluster's agent state dir. Jobs run strictly FIFO, one gang at a
-time (a TPU slice is a single atomic resource, so there is no
-fractional-accelerator packing to do — simpler than the reference's
-resource-counting scheduler, same observable semantics for TPU tasks).
+the cluster's agent state dir. Scheduling is FIFO in submission order
+with a resource-class split:
+
+- **TPU jobs** (spec carries an accelerator_type) are slice-exclusive
+  — a TPU slice is one atomic resource, so exactly one gang owns it
+  at a time (no fractional-accelerator packing to do).
+- **CPU jobs** pack concurrently up to ``SKYTPU_MAX_CONCURRENT_JOBS``
+  (default: the host's CPU count), the role of the reference's
+  resource-counting JobScheduler (:204) on controller-class clusters.
+
+FIFO order is never bypassed: the head of the queue waits for what it
+needs rather than being overtaken, so a TPU job can't be starved by a
+stream of small CPU jobs.
 """
 from __future__ import annotations
 
@@ -188,35 +197,67 @@ def update_dead_drivers(state_dir: str) -> None:
             set_status(state_dir, job['job_id'], JobStatus.FAILED)
 
 
-def schedule_step(state_dir: str) -> Optional[int]:
-    """Start the oldest PENDING job if nothing is running.
+def _is_tpu_job(job: Dict[str, Any]) -> bool:
+    spec = job.get('spec') or {}
+    return bool(spec.get('accelerator_type'))
 
-    Returns the started job_id, or None. The driver process is spawned
-    detached (`python -m skypilot_tpu.agent.driver`), exactly one per
-    job, like the reference's generated driver program.
+
+def _max_concurrent_jobs() -> int:
+    try:
+        return max(1, int(os.environ['SKYTPU_MAX_CONCURRENT_JOBS']))
+    except (KeyError, ValueError):
+        return max(1, os.cpu_count() or 1)
+
+
+def _can_start(job: Dict[str, Any],
+               active: List[Dict[str, Any]]) -> bool:
+    if not active:
+        return True
+    # TPU jobs own the slice exclusively, in both directions.
+    if _is_tpu_job(job) or any(_is_tpu_job(a) for a in active):
+        return False
+    return len(active) < _max_concurrent_jobs()
+
+
+def _start_job(state_dir: str, job: Dict[str, Any]) -> int:
+    job_id = job['job_id']
+    log_path = os.path.join(constants.job_dir(state_dir, job_id),
+                            'driver.log')
+    pid = subprocess_utils.daemonize(
+        ['python', '-u', '-m', 'skypilot_tpu.agent.driver',
+         '--state-dir', state_dir, '--job-id', str(job_id)],
+        log_path=log_path)
+    set_driver_pid(state_dir, job_id, pid)
+    # Driver moves it to SETTING_UP/RUNNING; mark it out of PENDING
+    # now so a concurrent schedule_step won't double-start.
+    set_status(state_dir, job_id, JobStatus.SETTING_UP)
+    return job_id
+
+
+def schedule_step(state_dir: str) -> Optional[int]:
+    """Start every PENDING job the concurrency policy admits, oldest
+    first and without queue bypass (the head waits for what it needs;
+    nothing overtakes it).
+
+    Returns the first started job_id, or None. Each driver process is
+    spawned detached (`python -m skypilot_tpu.agent.driver`), exactly
+    one per job, like the reference's generated driver program.
     """
+    first: Optional[int] = None
     with _lock(state_dir):
         update_dead_drivers(state_dir)
-        active = get_jobs(state_dir,
-                          [JobStatus.SETTING_UP, JobStatus.RUNNING])
-        if active:
-            return None
-        pending = get_jobs(state_dir, [JobStatus.PENDING])
-        if not pending:
-            return None
-        job = pending[-1]  # oldest (rows are DESC)
-        job_id = job['job_id']
-        log_path = os.path.join(constants.job_dir(state_dir, job_id),
-                                'driver.log')
-        pid = subprocess_utils.daemonize(
-            ['python', '-u', '-m', 'skypilot_tpu.agent.driver',
-             '--state-dir', state_dir, '--job-id', str(job_id)],
-            log_path=log_path)
-        set_driver_pid(state_dir, job_id, pid)
-        # Driver moves it to SETTING_UP/RUNNING; mark it out of PENDING
-        # now so a concurrent schedule_step won't double-start.
-        set_status(state_dir, job_id, JobStatus.SETTING_UP)
-        return job_id
+        while True:
+            active = get_jobs(state_dir,
+                              [JobStatus.SETTING_UP, JobStatus.RUNNING])
+            pending = get_jobs(state_dir, [JobStatus.PENDING])
+            if not pending:
+                return first
+            job = pending[-1]  # oldest (rows are DESC)
+            if not _can_start(job, active):
+                return first
+            started = _start_job(state_dir, job)
+            if first is None:
+                first = started
 
 
 def cancel_job(state_dir: str, job_id: int) -> bool:

@@ -36,8 +36,21 @@ def cluster_lock_path(cluster_name: str) -> str:
     return os.path.join(lock_dir, f'{cluster_name}.lock')
 
 
+class _TimelineFileLock(filelock.FileLock):
+    """FileLock whose acquire wait is a timeline event (reference
+    sky/utils/timeline.py FileLock events): contended cluster locks
+    are exactly where a slow launch hides, and the B/E pair makes the
+    wait visible in the Chrome trace. Zero overhead when tracing is
+    off (timeline.Event no-ops)."""
+
+    def acquire(self, *args, **kwargs):
+        from skypilot_tpu.utils import timeline
+        with timeline.Event(f'[lock.acquire] {self.lock_file}'):
+            return super().acquire(*args, **kwargs)
+
+
 def cluster_file_lock(cluster_name: str) -> filelock.FileLock:
-    return filelock.FileLock(cluster_lock_path(cluster_name))
+    return _TimelineFileLock(cluster_lock_path(cluster_name))
 
 
 def _query_cloud_status(

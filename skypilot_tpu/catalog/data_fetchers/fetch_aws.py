@@ -38,6 +38,26 @@ _REGIONS = {
     'ap-northeast-1': (1.22, 'abc'),
 }
 
+# GPU SKUs (type, vcpu, mem, $/hr, spot $/hr, accelerator, count) —
+# p3/p4/p5 + g4dn/g5/g6 families (public on-demand list, 2025
+# snapshot), offered in the three largest GPU regions.
+_GPU_TYPES = [
+    ('g4dn.xlarge', 4, 16, 0.526, 0.158, 'T4', 1),
+    ('g4dn.12xlarge', 48, 192, 3.912, 1.174, 'T4', 4),
+    ('g5.xlarge', 4, 16, 1.006, 0.302, 'A10G', 1),
+    ('g5.12xlarge', 48, 192, 5.672, 1.702, 'A10G', 4),
+    ('g5.48xlarge', 192, 768, 16.288, 4.886, 'A10G', 8),
+    ('g6.xlarge', 4, 16, 0.805, 0.242, 'L4', 1),
+    ('g6.12xlarge', 48, 192, 4.602, 1.381, 'L4', 4),
+    ('p3.2xlarge', 8, 61, 3.06, 0.918, 'V100', 1),
+    ('p3.8xlarge', 32, 244, 12.24, 3.672, 'V100', 4),
+    ('p3.16xlarge', 64, 488, 24.48, 7.344, 'V100', 8),
+    ('p4d.24xlarge', 96, 1152, 32.773, 9.832, 'A100', 8),
+    ('p4de.24xlarge', 96, 1152, 40.966, 12.29, 'A100-80GB', 8),
+    ('p5.48xlarge', 192, 2048, 98.32, 29.5, 'H100', 8),
+]
+_GPU_REGIONS = ['us-east-1', 'us-west-2', 'eu-west-1']
+
 
 def fetch(out_path: str = None) -> str:
     out_path = out_path or os.path.join(
@@ -46,7 +66,8 @@ def fetch(out_path: str = None) -> str:
     with open(out_path, 'w', newline='', encoding='utf-8') as f:
         w = csv.writer(f)
         w.writerow(['InstanceType', 'vCPUs', 'MemoryGiB', 'Region',
-                    'AvailabilityZone', 'Price', 'SpotPrice'])
+                    'AvailabilityZone', 'Price', 'SpotPrice',
+                    'AcceleratorName', 'AcceleratorCount'])
         for name, vcpu, mem, base in _TYPES:
             for region, (mult, letters) in _REGIONS.items():
                 price = round(base * mult, 4)
@@ -55,7 +76,12 @@ def fetch(out_path: str = None) -> str:
                     # per-zone candidates depend on that).
                     spot = round(price * (0.30 + 0.02 * i), 4)
                     w.writerow([name, vcpu, mem, region,
-                                f'{region}{letter}', price, spot])
+                                f'{region}{letter}', price, spot,
+                                '', ''])
+        for name, vcpu, mem, price, spot, acc, n in _GPU_TYPES:
+            for region in _GPU_REGIONS:
+                w.writerow([name, vcpu, mem, region, f'{region}a',
+                            price, spot, acc, n])
     return out_path
 
 

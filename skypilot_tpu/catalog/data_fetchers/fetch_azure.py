@@ -45,6 +45,25 @@ _REGIONS = {
 
 _SPOT_FRACTION = 0.13
 
+# GPU SKUs (size, vcpu, mem, $/hr, spot $/hr, accelerator, count) —
+# NC (T4/V100/A100) + ND (A100/H100) series, public list 2025
+# snapshot, offered in the three largest GPU regions.
+_GPU_TYPES = [
+    ('Standard_NC4as_T4_v3', 4, 28, 0.526, 0.158, 'T4', 1),
+    ('Standard_NC64as_T4_v3', 64, 440, 4.352, 1.306, 'T4', 4),
+    ('Standard_NC6s_v3', 6, 112, 3.06, 0.918, 'V100', 1),
+    ('Standard_NC24s_v3', 24, 448, 12.24, 3.672, 'V100', 4),
+    ('Standard_NC24ads_A100_v4', 24, 220, 3.673, 1.102,
+     'A100-80GB', 1),
+    ('Standard_NC96ads_A100_v4', 96, 880, 14.692, 4.408,
+     'A100-80GB', 4),
+    ('Standard_ND96asr_v4', 96, 900, 27.197, 8.159, 'A100', 8),
+    ('Standard_ND96amsr_A100_v4', 96, 1900, 32.77, 9.831,
+     'A100-80GB', 8),
+    ('Standard_ND96isr_H100_v5', 96, 1900, 98.32, 29.496, 'H100', 8),
+]
+_GPU_REGIONS = ['eastus', 'westus2', 'westeurope']
+
 
 def fetch(out_path: str = None) -> str:
     out_path = out_path or os.path.join(
@@ -53,12 +72,17 @@ def fetch(out_path: str = None) -> str:
     with open(out_path, 'w', newline='', encoding='utf-8') as f:
         w = csv.writer(f)
         w.writerow(['InstanceType', 'vCPUs', 'MemoryGiB', 'Region',
-                    'AvailabilityZone', 'Price', 'SpotPrice'])
+                    'AvailabilityZone', 'Price', 'SpotPrice',
+                    'AcceleratorName', 'AcceleratorCount'])
         for name, vcpu, mem, base in _TYPES:
             for region, mult in _REGIONS.items():
                 price = round(base * mult, 4)
                 w.writerow([name, vcpu, mem, region, '', price,
-                            round(price * _SPOT_FRACTION, 4)])
+                            round(price * _SPOT_FRACTION, 4), '', ''])
+        for name, vcpu, mem, price, spot, acc, n in _GPU_TYPES:
+            for region in _GPU_REGIONS:
+                w.writerow([name, vcpu, mem, region, '', price, spot,
+                            acc, n])
     return out_path
 
 

@@ -73,6 +73,26 @@ _GCE_SIZES = [2, 4, 8, 16, 32, 48, 64, 96]
 _GCE_REGIONS = sorted(_REGION_FACTOR)
 _GCE_SPOT_FACTOR = 0.30
 
+# GPU shapes (type, vcpu, mem, $/hr, spot $/hr, accelerator, count):
+# a2 (A100), a3 (H100), g2 (L4), n1+attached T4/V100 — public list
+# 2025 snapshot, offered in three GPU zones.
+_GPU_TYPES = [
+    ('g2-standard-4', 4, 16, 0.71, 0.213, 'L4', 1),
+    ('g2-standard-48', 48, 192, 3.997, 1.199, 'L4', 4),
+    ('g2-standard-96', 96, 384, 7.994, 2.398, 'L4', 8),
+    ('n1-standard-8-t4', 8, 30, 0.73, 0.219, 'T4', 1),
+    ('n1-standard-8-v100', 8, 30, 2.86, 0.858, 'V100', 1),
+    ('a2-highgpu-1g', 12, 85, 3.673, 1.102, 'A100', 1),
+    ('a2-highgpu-4g', 48, 340, 14.694, 4.408, 'A100', 4),
+    ('a2-highgpu-8g', 96, 680, 29.387, 8.816, 'A100', 8),
+    ('a2-ultragpu-1g', 12, 170, 5.069, 1.521, 'A100-80GB', 1),
+    ('a2-ultragpu-8g', 96, 1360, 40.55, 12.165, 'A100-80GB', 8),
+    ('a3-highgpu-8g', 208, 1872, 88.25, 26.475, 'H100', 8),
+]
+_GPU_ZONES = [('us-central1', 'us-central1-a'),
+              ('us-east1', 'us-east1-b'),
+              ('europe-west4', 'europe-west4-a')]
+
 
 def _region_of(zone: str) -> str:
     return zone.rsplit('-', 1)[0]
@@ -87,7 +107,12 @@ def write_tpu_catalog(path: str) -> int:
                 region = _region_of(zone)
                 factor = _REGION_FACTOR[region]
                 price = _TPU_CHIP_HOUR[gen] * factor
-                spot = price * _SPOT_FACTOR[gen]
+                # Spot varies per zone (+6% per zone letter): the
+                # optimizer's cheapest-spot-zone ranking and the
+                # failover provisioner's per-zone candidates depend
+                # on this variation existing.
+                zi = ord(zone[-1]) - ord('a')
+                spot = price * _SPOT_FACTOR[gen] * (1 + 0.06 * zi)
                 rows.append({
                     'AcceleratorName': s.name,
                     'AcceleratorCount': 1,
@@ -117,7 +142,7 @@ def write_gce_catalog(path: str) -> int:
             base = size * vcpu_price + mem * mem_price
             for region in _GCE_REGIONS:
                 factor = _REGION_FACTOR[region]
-                for zone_suffix in ('a', 'b', 'c'):
+                for zi, zone_suffix in enumerate(('a', 'b', 'c')):
                     zone = f'{region}-{zone_suffix}'
                     rows.append({
                         'InstanceType': f'{family}-{size}',
@@ -126,9 +151,26 @@ def write_gce_catalog(path: str) -> int:
                         'Region': region,
                         'AvailabilityZone': zone,
                         'Price': round(base * factor, 4),
-                        'SpotPrice': round(base * factor * _GCE_SPOT_FACTOR,
-                                           4),
+                        # Per-zone spot variation (see TPU rows).
+                        'SpotPrice': round(
+                            base * factor * _GCE_SPOT_FACTOR *
+                            (1 + 0.06 * zi), 4),
+                        'AcceleratorName': '',
+                        'AcceleratorCount': '',
                     })
+    for (name, vcpu, mem, price, spot, acc, n) in _GPU_TYPES:
+        for region, zone in _GPU_ZONES:
+            rows.append({
+                'InstanceType': name,
+                'vCPUs': vcpu,
+                'MemoryGiB': mem,
+                'Region': region,
+                'AvailabilityZone': zone,
+                'Price': price,
+                'SpotPrice': spot,
+                'AcceleratorName': acc,
+                'AcceleratorCount': n,
+            })
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, 'w', newline='', encoding='utf-8') as f:
         writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))

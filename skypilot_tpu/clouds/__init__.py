@@ -4,10 +4,13 @@ from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
 from skypilot_tpu.clouds.cloud import Region
 from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.azure import Azure
+from skypilot_tpu.clouds.fluidstack import Fluidstack
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.lambda_cloud import LambdaCloud
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.nebius import Nebius
+from skypilot_tpu.clouds.runpod import RunPod
 
 __all__ = [
     'AWS',
@@ -15,8 +18,11 @@ __all__ = [
     'Cloud',
     'CloudImplementationFeatures',
     'Region',
+    'Fluidstack',
     'GCP',
     'Kubernetes',
     'LambdaCloud',
     'Local',
+    'Nebius',
+    'RunPod',
 ]

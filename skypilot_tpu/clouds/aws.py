@@ -64,6 +64,14 @@ class AWS(cloud_lib.Cloud):
         if resources.is_tpu:
             return []  # no TPUs on AWS
         instance_type = resources.instance_type
+        if instance_type is None and resources.accelerators:
+            # A GPU ask must select GPU hardware — falling through to
+            # the cheapest CPU shape would launch the wrong machine.
+            (name, count), = resources.accelerators.items()
+            instance_type = catalog.get_instance_type_for_accelerator(
+                name, count, cloud='aws')
+            if instance_type is None:
+                return []
         if instance_type is None:
             instance_type = catalog.get_default_instance_type(
                 resources.cpus, resources.memory, cloud='aws')

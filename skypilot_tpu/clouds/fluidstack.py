@@ -1,0 +1,32 @@
+"""FluidStack — GPU neocloud (REST).
+
+Re-design of reference ``sky/clouds/fluidstack.py`` (~260 LoC) as a
+RestNeocloud subclass: catalog-backed feasibility/pricing, REST
+provision plugin (``provision/fluidstack/``). Region-only placement,
+stop/start supported, no spot market, no TPUs.
+"""
+from __future__ import annotations
+
+from skypilot_tpu.clouds import neocloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='fluidstack')
+class Fluidstack(neocloud.RestNeocloud):
+    """FluidStack (GPU VMs over REST)."""
+
+    _REPR = 'FluidStack'
+    CATALOG_CLOUD = 'fluidstack'
+    _PROVIDER = 'fluidstack'
+    _CREDENTIAL_HINT = ('Set FLUIDSTACK_API_KEY or write the key to '
+                        '~/.fluidstack/api_key.')
+
+    @classmethod
+    def _creds_api(cls):
+        from skypilot_tpu.provision.fluidstack import api
+        return api
+
+    @staticmethod
+    def _accel_prefix(name: str, count: int) -> str:
+        # Catalog names look like '8x_H100_SXM5'.
+        return f'{count}x_{name}'

@@ -83,6 +83,15 @@ class GCP(cloud_lib.Cloud):
                 return []
             return [resources.copy(cloud=self)]
         instance_type = resources.instance_type
+        if instance_type is None and resources.accelerators:
+            # Non-TPU accelerator (GPU) ask: select an a2/a3/g2-class
+            # shape — falling through to the cheapest CPU shape would
+            # launch the wrong machine.
+            (name, count), = resources.accelerators.items()
+            instance_type = catalog.get_instance_type_for_accelerator(
+                name, count, cloud='gcp')
+            if instance_type is None:
+                return []
         if instance_type is None:
             instance_type = catalog.get_default_instance_type(
                 resources.cpus, resources.memory)

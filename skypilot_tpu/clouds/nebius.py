@@ -1,0 +1,37 @@
+"""Nebius — AI neocloud (REST/IAM).
+
+Re-design of reference ``sky/clouds/nebius.py`` (~320 LoC) as a
+RestNeocloud subclass: catalog-backed feasibility/pricing, token-
+bearer REST provision plugin (``provision/nebius/``). Region-only
+placement, stop/start supported, spot descoped, no TPUs (Nebius is a
+GPU cloud).
+"""
+from __future__ import annotations
+
+from skypilot_tpu.clouds import neocloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='nebius')
+class Nebius(neocloud.RestNeocloud):
+    """Nebius (GPU VMs over REST, IAM-token auth)."""
+
+    _REPR = 'Nebius'
+    CATALOG_CLOUD = 'nebius'
+    _PROVIDER = 'nebius'
+    _CREDENTIAL_HINT = ('Set NEBIUS_IAM_TOKEN or write '
+                        '~/.nebius/credentials.json '
+                        '(\'{"token": "<iam token>"}\').')
+
+    @classmethod
+    def _creds_api(cls):
+        from skypilot_tpu.provision.nebius import api
+        return api
+
+    @staticmethod
+    def _accel_prefix(name: str, count: int) -> str:
+        """Catalog names are '<platform>_<count>gpu-<preset>', e.g.
+        'gpu-h100-sxm_8gpu-128vcpu-1600gb': match on the platform
+        carrying the GPU model plus the preset's leading count."""
+        model = name.lower().replace('_', '-')
+        return f'gpu-{model}_{count}gpu'

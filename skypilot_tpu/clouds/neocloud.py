@@ -1,0 +1,192 @@
+"""Template base for REST neoclouds (Lambda-class GPU clouds).
+
+The reference carries ten-plus near-identical ~300-LoC cloud modules
+(``sky/clouds/fluidstack.py``, ``runpod.py``, ``nebius.py``, ...);
+this base factors the shared shape — catalog-backed feasibility and
+pricing, region-only placement, accelerator-to-instance-type mapping,
+credential plumbing — so a concrete neocloud is ~50 declarative lines
+(see clouds/runpod.py, fluidstack.py, nebius.py). This is the
+"adding a cloud is mechanical" claim of docs/clouds.md, made literal.
+
+Subclasses declare:
+  - ``CATALOG_CLOUD``: key of data/<name>_catalog.csv
+  - ``_PROVIDER``: provision module name (provision/<name>/)
+  - ``_creds_api()``: module exposing read key + CREDENTIALS_PATH
+  - ``_accel_prefix(name, count)``: catalog-name prefix for a GPU ask
+  - ``unsupported_features_for_resources`` when the default (spot
+    unsupported) is not right
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.resources import Resources
+
+
+class RestNeocloud(cloud_lib.Cloud):
+    """Catalog-backed, region-only GPU cloud over a REST/GraphQL API."""
+
+    CATALOG_CLOUD: str = ''
+    _PROVIDER: str = ''
+    _CREDENTIAL_HINT: str = ''
+    MAX_CLUSTER_NAME_LEN_LIMIT = 50
+
+    # ---- subclass seams ----------------------------------------------
+    @classmethod
+    def _creds_api(cls):
+        """provision.<name>.api module (read_api_key/read_token +
+        CREDENTIALS_PATH)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _accel_prefix(name: str, count: int) -> str:
+        """Catalog instance-type prefix for an accelerator request."""
+        raise NotImplementedError
+
+    @classmethod
+    def _read_key(cls) -> Optional[str]:
+        mod = cls._creds_api()
+        reader = getattr(mod, 'read_api_key', None) or mod.read_token
+        return reader()
+
+    # ---- shared implementation ---------------------------------------
+    @classmethod
+    def canonical_name(cls) -> str:
+        return cls.CATALOG_CLOUD
+
+    def provider_name(self) -> str:
+        return self._PROVIDER
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        del resources
+        return {
+            cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE:
+                f'{cls._REPR} spot instances are not supported here.',
+        }
+
+    def regions_with_offering(
+            self, resources: 'Resources') -> List[cloud_lib.Region]:
+        if resources.is_tpu:
+            return []
+        instance_type = (resources.instance_type or
+                         catalog.get_default_instance_type(
+                             resources.cpus, resources.memory,
+                             cloud=self.CATALOG_CLOUD))
+        if instance_type is None:
+            return []
+        regions = sorted({
+            o.region
+            for o in catalog.get_instance_offerings(
+                instance_type, resources.region, None,
+                cloud=self.CATALOG_CLOUD)
+        })
+        return [cloud_lib.Region(name) for name in regions]
+
+    def zones_provision_loop(self, resources: 'Resources',
+                             region: Optional[str] = None):
+        for r in self.regions_with_offering(resources):
+            if region is not None and r.name != region:
+                continue
+            yield (r.name, None)
+
+    def _instance_type_for_accelerator(
+            self, accelerators: dict) -> Optional[str]:
+        (name, count), = accelerators.items()
+        prefix = self._accel_prefix(name, count).lower()
+        matches = sorted({
+            o.instance_type
+            for o in catalog.get_instance_offerings(
+                None, None, None, cloud=self.CATALOG_CLOUD)
+            if o.instance_type.lower().startswith(prefix)
+        })
+        return matches[0] if matches else None
+
+    def get_feasible_launchable_resources(
+            self, resources: 'Resources') -> List['Resources']:
+        if resources.cloud is not None and not self.is_same_cloud(
+                resources.cloud):
+            return []
+        if resources.is_tpu:
+            return []
+        if resources.use_spot and (
+                cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE
+                in self.unsupported_features_for_resources(resources)):
+            return []
+        instance_type = resources.instance_type
+        if instance_type is None and resources.accelerators:
+            # A GPU request must select GPU hardware — silently
+            # satisfying it with the cheapest CPU box would launch
+            # the wrong machine.
+            instance_type = self._instance_type_for_accelerator(
+                resources.accelerators)
+            if instance_type is None:
+                return []
+        if instance_type is None:
+            instance_type = catalog.get_default_instance_type(
+                resources.cpus, resources.memory,
+                cloud=self.CATALOG_CLOUD)
+            if instance_type is None:
+                return []
+        if not catalog.get_instance_offerings(
+                instance_type, resources.region, None,
+                cloud=self.CATALOG_CLOUD):
+            return []
+        return [resources.copy(cloud=self, instance_type=instance_type)]
+
+    def hourly_price(self, resources: 'Resources') -> float:
+        assert resources.instance_type is not None, resources
+        return catalog.get_hourly_cost(resources.instance_type,
+                                       resources.use_spot,
+                                       resources.region, None,
+                                       cloud=self.CATALOG_CLOUD)
+
+    def validate_region_zone(self, region, zone):
+        if zone is not None:
+            raise ValueError(
+                f'{self._REPR} has regions, not zones.')
+        return catalog.validate_region_zone(region, None)
+
+    def make_deploy_resources_variables(
+            self, resources: 'Resources', cluster_name_on_cloud: str,
+            region: str, zone: Optional[str]) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region,
+            'zone': None,
+            'instance_type': resources.instance_type,
+            'use_spot': False,
+            'disk_size': resources.disk_size,
+            'image_id': None,
+            'labels': resources.labels or {},
+            'ports': resources.ports or [],
+            'num_hosts': 1,
+        }
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        if self._read_key():
+            return True, None
+        return (False,
+                f'No {self._REPR} credentials. ' + self._CREDENTIAL_HINT)
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        mod = self._creds_api()
+        path = os.path.expanduser(mod.CREDENTIALS_PATH)
+        if os.path.exists(path):
+            return {mod.CREDENTIALS_PATH: path}
+        return {}
+
+    def get_user_identities(self) -> Optional[List[List[str]]]:
+        key = self._read_key()
+        if key:
+            import hashlib
+            return [[hashlib.sha256(key.encode()).hexdigest()[:16]]]
+        return None

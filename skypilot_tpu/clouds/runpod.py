@@ -1,0 +1,39 @@
+"""RunPod — GPU neocloud (pods over GraphQL).
+
+Re-design of reference ``sky/clouds/runpod.py`` (~290 LoC) as a
+~50-line RestNeocloud subclass (clouds/neocloud.py): catalog-backed
+feasibility/pricing, GraphQL provision plugin (``provision/runpod/``).
+RunPod has data centers (region only, no zones) and CAN stop pods —
+STOP/AUTOSTOP work (unlike Lambda); the spot/bid market is descoped.
+No TPUs.
+"""
+from __future__ import annotations
+
+import typing
+
+from skypilot_tpu.clouds import neocloud
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    pass
+
+
+@registry.CLOUD_REGISTRY.register(name='runpod')
+class RunPod(neocloud.RestNeocloud):
+    """RunPod (GPU pods over GraphQL)."""
+
+    _REPR = 'RunPod'
+    CATALOG_CLOUD = 'runpod'
+    _PROVIDER = 'runpod'
+    _CREDENTIAL_HINT = ('Set RUNPOD_API_KEY or write '
+                        "~/.runpod/config.toml ('api_key = <key>').")
+
+    @classmethod
+    def _creds_api(cls):
+        from skypilot_tpu.provision.runpod import api
+        return api
+
+    @staticmethod
+    def _accel_prefix(name: str, count: int) -> str:
+        # Catalog names look like '1x_A100-80GB_SECURE'.
+        return f'{count}x_{name}'

@@ -17,7 +17,8 @@ from typing import Optional, Tuple
 from skypilot_tpu import exceptions
 from skypilot_tpu.data import storage as storage_lib
 
-_SCHEMES = ('gs://', 's3://', 'r2://', 'az://', 'local://')
+_SCHEMES = ('gs://', 's3://', 'r2://', 'az://', 'cos://', 'oci://',
+            'local://')
 
 
 def is_cloud_url(path: str) -> bool:
@@ -44,6 +45,31 @@ def download_command(url: str, dst: str,
         is_dir = url.endswith('/') or not posixpath.splitext(key)[1]
     src = url.rstrip('/')
     q_dst = shlex.quote(dst)
+    if scheme in ('cos', 'oci'):
+        # One S3-compat fetch shape for both; cos:// carries the
+        # region as its first path segment
+        # (cos://<region>/<bucket>/<key>, the reference's IBM URL
+        # shape) and the region stays PER STORE — never process
+        # state, or the first URL's region would leak into later
+        # commands.
+        store_kwargs = {}
+        if scheme == 'cos':
+            region, bucket, key = bucket, *key.partition('/')[::2]
+            if not bucket:
+                raise exceptions.StorageSpecError(
+                    f'Bad COS URL {url!r}: want '
+                    'cos://region/bucket/...')
+            store_kwargs['region'] = region
+        cls = (storage_lib.IbmCosStore if scheme == 'cos'
+               else storage_lib.OciStore)
+        store = cls(f'{bucket}/{key}'.rstrip('/') if key else bucket,
+                    **store_kwargs)
+        if is_dir:
+            return store.download_command(dst)
+        aws = cls(bucket, **store_kwargs)._aws()  # pylint: disable=protected-access
+        obj = shlex.quote(f's3://{bucket}/{key}'.rstrip('/'))
+        return (f'mkdir -p $(dirname {q_dst}) && '
+                f'{aws} s3 cp {obj} {q_dst}')
     if scheme in ('gs', 's3', 'r2', 'az'):
         # Directory fetches reuse the Store classes' own download
         # commands (one place owns the gsutil/aws/az CLI invocations);

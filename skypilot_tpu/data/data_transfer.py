@@ -59,6 +59,31 @@ def transfer(src: storage_lib.AbstractStore,
         # rsync (reference data_transfer.py s3_to_gcs). R2 is excluded:
         # its endpoint is not AWS, gsutil can't reach it.
         _run(f'gsutil -m rsync -r {src.url()} {dst.url()}')
+    elif (type(src) is type(dst) and
+          isinstance(src, storage_lib.S3Store)):
+        # Same-endpoint S3-family pair (S3->S3, R2->R2, COS->COS,
+        # OCI->OCI): bucket-to-bucket `s3 sync` issues SERVER-SIDE
+        # CopyObject — no object bytes stage through this host. This
+        # is the TB-scale path, the role the reference delegates to
+        # cloud-side transfer services (sky/data/data_transfer.py).
+        _run(f'{src._aws()} s3 sync {src.url()} {dst.url()}')  # pylint: disable=protected-access
+    elif (isinstance(src, storage_lib.AzureBlobStore) and
+          isinstance(dst, storage_lib.AzureBlobStore)):
+        # Azure-side async blob copy between containers (server-side).
+        # start-batch only ENQUEUES copies, so poll until no blob in
+        # the destination reports copy.status == pending — verifying
+        # (or returning) against an in-flight copy would fail on (or
+        # hand the caller) a partial bucket.
+        _run(f'az storage blob copy start-batch '
+             f'--destination-container {dst.name} '
+             f'--source-container {src.name}')
+        _run('for i in $(seq 180); do '
+             f'pending=$(az storage blob list -c {dst.name} '
+             '--query "length([?properties.copy.status==\'pending\'])" '
+             '-o tsv); '
+             '[ "${pending:-0}" = "0" ] && exit 0; sleep 5; done; '
+             f'echo "azure copy into {dst.name} still pending" >&2; '
+             'exit 1')
     else:
         # Generic path: stage through a temp dir with each store's own
         # CLI machinery (R2 endpoints, az batch uploads, ...).

@@ -41,6 +41,8 @@ class StoreType(enum.Enum):
     S3 = 'S3'
     R2 = 'R2'
     AZURE = 'AZURE'
+    IBM = 'IBM'
+    OCI = 'OCI'
     LOCAL = 'LOCAL'
 
 
@@ -303,6 +305,137 @@ class R2Store(S3Store):
         self._run(f'{self._aws()} s3 rb --force {self.url()} || true')
 
 
+class IbmCosStore(S3Store):
+    """IBM Cloud Object Storage bucket — COS's S3-compatible API
+    against the regional endpoint (role of reference
+    ``sky/data/storage.py:3600`` IBMCosStore, which drives ibm_boto3 +
+    rclone; here the aws CLI with a dedicated profile does the same
+    transfers, and MOUNT uses the reference's own IBM adapter:
+    rclone). Region from ``IBM_COS_REGION`` or ``~/.ibm/cos_region``
+    (default us-south); HMAC credentials in
+    ``~/.ibm/cos.credentials`` profile ``ibm``."""
+
+    CREDENTIALS_PATH = '~/.ibm/cos.credentials'
+    REGION_PATH = '~/.ibm/cos_region'
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 exclude_git: bool = True,
+                 region: Optional[str] = None) -> None:
+        super().__init__(name, source, exclude_git)
+        # Region is PER STORE (cos:// URLs carry it): two buckets in
+        # different regions must not share process-global state.
+        self._region = region
+
+    def region(self) -> str:
+        region = self._region or os.environ.get('IBM_COS_REGION')
+        if not region:
+            try:
+                with open(os.path.expanduser(self.REGION_PATH),
+                          encoding='utf-8') as f:
+                    region = f.read().strip()
+            except OSError:
+                region = 'us-south'
+        return region or 'us-south'
+
+    def endpoint(self) -> str:
+        return (f'https://s3.{self.region()}'
+                '.cloud-object-storage.appdomain.cloud')
+
+    def _aws(self) -> str:
+        return (f'AWS_SHARED_CREDENTIALS_FILE={self.CREDENTIALS_PATH} '
+                f'aws --endpoint-url {self.endpoint()} --profile ibm')
+
+    def url(self) -> str:
+        return f's3://{self.name}'
+
+    def display_url(self) -> str:
+        return f'cos://{self.region()}/{self.name}'
+
+    def mount_command(self, mount_path: str) -> str:
+        # rclone via env config (no host config file), the adapter the
+        # reference's mounting matrix assigns to IBM COS.
+        install = ('which rclone >/dev/null 2>&1 || '
+                   '(curl -sSL https://rclone.org/install.sh | '
+                   'sudo bash)')
+        env = (f'RCLONE_CONFIG_IBM_TYPE=s3 '
+               f'RCLONE_CONFIG_IBM_PROVIDER=IBMCOS '
+               f'RCLONE_CONFIG_IBM_ENDPOINT={self.endpoint()} '
+               f'RCLONE_CONFIG_IBM_ENV_AUTH=true '
+               f'AWS_SHARED_CREDENTIALS_FILE={self.CREDENTIALS_PATH} '
+               f'AWS_PROFILE=ibm')
+        return (f'{install}; mkdir -p {mount_path} && '
+                f'(mountpoint -q {mount_path} || '
+                f'{env} rclone mount ibm:{self.name} {mount_path} '
+                f'--daemon --vfs-cache-mode writes)')
+
+    def delete(self) -> None:
+        self._run(f'{self._aws()} s3 rb --force {self.url()} || true')
+
+
+class OciStore(S3Store):
+    """OCI Object Storage bucket — OCI's S3-compatible API against the
+    namespace's compat endpoint (role of reference
+    ``sky/data/storage.py:4053`` OciStore, which drives the oci SDK;
+    the compat API lets one CLI family serve every S3-shaped store).
+    Namespace from ``OCI_NAMESPACE`` or ``~/.oci/namespace``; region
+    from ``OCI_REGION`` or ``~/.oci/region``; customer secret keys in
+    ``~/.oci/s3.credentials`` profile ``oci``. MOUNT via goofys
+    ``--endpoint`` (same adapter as R2)."""
+
+    CREDENTIALS_PATH = '~/.oci/s3.credentials'
+    NAMESPACE_PATH = '~/.oci/namespace'
+    REGION_PATH = '~/.oci/region'
+
+    @classmethod
+    def _read(cls, env: str, path: str,
+              what: str) -> str:
+        value = os.environ.get(env)
+        if not value:
+            try:
+                with open(os.path.expanduser(path),
+                          encoding='utf-8') as f:
+                    value = f.read().strip()
+            except OSError:
+                raise exceptions.StorageError(
+                    f'OCI needs a {what}: set {env} or write '
+                    f'{path}.') from None
+        return value
+
+    @classmethod
+    def endpoint(cls) -> str:
+        ns = cls._read('OCI_NAMESPACE', cls.NAMESPACE_PATH,
+                       'namespace')
+        region = cls._read('OCI_REGION', cls.REGION_PATH, 'region')
+        return (f'https://{ns}.compat.objectstorage.{region}'
+                '.oraclecloud.com')
+
+    def _aws(self) -> str:
+        return (f'AWS_SHARED_CREDENTIALS_FILE={self.CREDENTIALS_PATH} '
+                f'aws --endpoint-url {self.endpoint()} --profile oci')
+
+    def url(self) -> str:
+        return f's3://{self.name}'
+
+    def display_url(self) -> str:
+        return f'oci://{self.name}'
+
+    def mount_command(self, mount_path: str) -> str:
+        install = (
+            'which goofys >/dev/null 2>&1 || '
+            '(sudo curl -sSL https://github.com/kahing/goofys/releases/'
+            'latest/download/goofys -o /usr/local/bin/goofys && '
+            'sudo chmod +x /usr/local/bin/goofys)')
+        return (f'{install}; mkdir -p {mount_path} && '
+                f'(mountpoint -q {mount_path} || '
+                f'AWS_SHARED_CREDENTIALS_FILE={self.CREDENTIALS_PATH} '
+                f'AWS_PROFILE=oci '
+                f'goofys --endpoint {self.endpoint()} '
+                f'{self.name} {mount_path})')
+
+    def delete(self) -> None:
+        self._run(f'{self._aws()} s3 rb --force {self.url()} || true')
+
+
 class AzureBlobStore(AbstractStore):
     """Azure Blob container via the az CLI; MOUNT via blobfuse2.
 
@@ -446,6 +579,8 @@ _STORE_CLASSES = {
     StoreType.S3: S3Store,
     StoreType.R2: R2Store,
     StoreType.AZURE: AzureBlobStore,
+    StoreType.IBM: IbmCosStore,
+    StoreType.OCI: OciStore,
     StoreType.LOCAL: LocalStore,
 }
 

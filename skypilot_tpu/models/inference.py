@@ -94,11 +94,19 @@ def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig) -> jax.Array:
     from skypilot_tpu.models import moe
     cdt = cfg.compute_dtype
     if isinstance(cfg, moe.MoEConfig):
-        # DROPLESS routing (see moe.moe_block_dropless): capacity
-        # drops are batch-composition-dependent, which would make a
-        # served token depend on its batchmates.
         h3 = h if h.ndim == 3 else h[:, None]
-        y = moe.moe_block_dropless(h3, lp, cfg)
+        if cfg.infer_dispatch == 'capacity':
+            # Capacity-gather dispatch (moe.moe_block_capacity):
+            # expert compute scales with the capacity factor, not E —
+            # the form that scales past E=8. At the default auto cf
+            # it is provably dropless (and flop-equal to dropless);
+            # cf < E/k buys the compute saving at an accepted
+            # batch-dependent drop risk. See the block's docstring.
+            y = moe.moe_block_capacity(h3, lp, cfg)
+        else:
+            # DROPLESS all-experts routing (moe.moe_block_dropless):
+            # exact top-k mixing, right for small E.
+            y = moe.moe_block_dropless(h3, lp, cfg)
         return y if h.ndim == 3 else y[:, 0]
     gate = jax.nn.silu(qdot(h, lp['w_gate'], cdt))
     up = qdot(h, lp['w_up'], cdt)

@@ -189,27 +189,35 @@ class ServingEngine:
         kv_dtype = jnp.int8 if kv_quant else cdt
         kv_shape = (cfg.n_layers, batch_size, self.max_seq,
                     cfg.n_kv_heads, cfg.head_dim)
-        self._empty = {
-            'k': jnp.zeros(kv_shape, kv_dtype),
-            'v': jnp.zeros(kv_shape, kv_dtype),
-            'length': jnp.zeros((batch_size,), jnp.int32),
-            'dmask': jnp.zeros((batch_size, self.max_seq), bool),
-            'base': jnp.asarray(max_prompt, jnp.int32),
-            'steps': jnp.zeros((), jnp.int32),
-        }
-        if kv_quant:
-            self._empty['k_scale'] = jnp.ones(
-                kv_shape[:4], jnp.bfloat16)
-            self._empty['v_scale'] = jnp.ones(
-                kv_shape[:4], jnp.bfloat16)
-        if mesh is not None:
-            specs = inference.cache_specs(kv_quant)
-            self._empty = {
-                f: jax.device_put(
-                    v, jax.sharding.NamedSharding(mesh, specs[f]))
-                for f, v in self._empty.items()
+
+        def _make_empty():
+            """Build a fresh zero cache ON DEMAND. No persistent
+            empty template: a resident template plus the live cache
+            would hold 2x the cache HBM for the engine's lifetime —
+            at 8B serving shapes (3+ GB of int8 KV) exactly the
+            difference between fitting a 16 GB chip and OOMing."""
+            empty = {
+                'k': jnp.zeros(kv_shape, kv_dtype),
+                'v': jnp.zeros(kv_shape, kv_dtype),
+                'length': jnp.zeros((batch_size,), jnp.int32),
+                'dmask': jnp.zeros((batch_size, self.max_seq), bool),
+                'base': jnp.asarray(max_prompt, jnp.int32),
+                'steps': jnp.zeros((), jnp.int32),
             }
-        self.cache = jax.tree.map(jnp.copy, self._empty)
+            if kv_quant:
+                empty['k_scale'] = jnp.ones(kv_shape[:4], jnp.bfloat16)
+                empty['v_scale'] = jnp.ones(kv_shape[:4], jnp.bfloat16)
+            if mesh is not None:
+                specs = inference.cache_specs(kv_quant)
+                empty = {
+                    f: jax.device_put(
+                        v, jax.sharding.NamedSharding(mesh, specs[f]))
+                    for f, v in empty.items()
+                }
+            return empty
+
+        self._make_empty = _make_empty
+        self.cache = _make_empty()
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def _prefill_insert(params, cache, cur_tokens, tokens, lengths,
@@ -315,7 +323,10 @@ class ServingEngine:
         when no requests are in flight."""
         if self.num_active() or self.queue or self._pending is not None:
             raise RuntimeError('reset() with requests in flight')
-        self.cache = jax.tree.map(jnp.copy, self._empty)
+        # Drop the old cache BEFORE building the new one so the two
+        # never coexist on device.
+        self.cache = None
+        self.cache = self._make_empty()
         self._steps_done = 0
         self.results = {}
 
@@ -358,8 +369,10 @@ class ServingEngine:
                 if (self.num_active() == 0 and not admits and
                         self._pending is None):
                     # Region exhausted, nothing running (and no chunk
-                    # still in flight): fresh cache.
-                    self.cache = jax.tree.map(jnp.copy, self._empty)
+                    # still in flight): fresh cache (old one dropped
+                    # first — see reset()).
+                    self.cache = None
+                    self.cache = self._make_empty()
                     self._steps_done = 0
                 else:
                     break  # wait for running requests to drain

@@ -87,17 +87,41 @@ class Optimizer:
         return _estimate(task, task.best_resources, OptimizeTarget.COST)
 
 
+# Measured per-chip training throughput anchor: this repo's own bench
+# (bench.py train: 1B-class Llama, seq 8192, bf16, Pallas flash
+# attention, 'kvo' remat) measures 10,729 tokens/s/chip at 58.8% MFU
+# on v5e. Other generations are seeded by applying that measured MFU
+# to their public bf16 peaks (tpu_utils' per-generation table) until
+# bench.py runs on that hardware. This replaces the generation-blind
+# linear-chips guess (the reference seeds per-accelerator throughput
+# from its catalog instead — sky/optimizer.py:236): TIME optimization
+# now knows a v6e chip does ~4.7x a v5e chip's work.
+_MEASURED_V5E_TOKENS_PER_SEC_PER_CHIP = 10729.0
+_V5E_PEAK_TFLOPS = 197.0
+
+
+def _tokens_per_sec_per_chip(tpu) -> float:
+    """Estimated bench-workload throughput for one chip of this
+    generation (measured on v5e; MFU-extrapolated elsewhere)."""
+    return (_MEASURED_V5E_TOKENS_PER_SEC_PER_CHIP *
+            tpu.bf16_tflops_per_chip / _V5E_PEAK_TFLOPS)
+
+
 def _runtime_seconds(task: task_lib.Task,
                      launchable: resources_lib.Resources) -> float:
     """Estimated runtime on these resources.
 
-    Uses task.estimate_runtime (seconds on a reference 8-chip slice) if
-    set; scales inversely with chip count for TPU resources.
+    ``task.estimate_runtime`` is seconds on the reference slice
+    (v5e-8). For TPU candidates it rescales by the candidate's
+    aggregate measured throughput (chips x per-chip rate), so both
+    MORE chips and a FASTER generation shorten the estimate.
     """
     base = task.estimate_runtime or _DEFAULT_RUNTIME_SECONDS
     if launchable.is_tpu and task.estimate_runtime:
-        scale = launchable.tpu.num_chips / 8.0
-        return base / max(scale, 1e-6)
+        ref_rate = 8.0 * _MEASURED_V5E_TOKENS_PER_SEC_PER_CHIP
+        rate = (launchable.tpu.num_chips *
+                _tokens_per_sec_per_chip(launchable.tpu))
+        return base * ref_rate / max(rate, 1e-6)
     return base
 
 

@@ -7,6 +7,11 @@ Axes convention (outer → inner, DCN-slowest → ICI-fastest):
   ``sp``    sequence/context parallelism (ring attention over ICI)
   ``tp``    tensor (Megatron) parallelism — innermost, so its
             collectives ride the fastest ICI links
+  ``ep``    expert parallelism (MoE expert banks shard their E axis
+            here; token dispatch crosses it as an all-to-all). Sits
+            between tp and pp: its all-to-all is lighter than tp's
+            per-matmul all-reduces but heavier than pp's activation
+            handoffs
   ``pp``    pipeline parallelism (stages exchange one activation per
             microbatch tick — the lowest-bandwidth traffic in the
             step). Listed last for a partitioner constraint: inside
@@ -26,7 +31,7 @@ import dataclasses
 import math
 from typing import Mapping, Optional, Sequence, Tuple
 
-AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp', 'pp')
+AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp', 'ep', 'pp')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,10 +42,12 @@ class MeshPlan:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.pp * self.dp * self.fsdp * self.sp * self.tp
+        return (self.pp * self.dp * self.fsdp * self.sp * self.tp *
+                self.ep)
 
     def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
         return tuple((a, getattr(self, a)) for a in AXIS_ORDER)
@@ -52,6 +59,7 @@ def plan_mesh(num_devices: int,
               sp: int = 1,
               dp: int = 1,
               pp: int = 1,
+              ep: int = 1,
               fsdp: int = -1) -> MeshPlan:
     """Fill in one -1 axis so the product equals ``num_devices``.
 
@@ -60,7 +68,8 @@ def plan_mesh(num_devices: int,
     fully-sharded params + ICI all-gather is the bandwidth-optimal
     layout (scaling-book recipe).
     """
-    sizes = {'pp': pp, 'dp': dp, 'fsdp': fsdp, 'sp': sp, 'tp': tp}
+    sizes = {'pp': pp, 'dp': dp, 'fsdp': fsdp, 'sp': sp, 'tp': tp,
+             'ep': ep}
     free = [a for a, s in sizes.items() if s == -1]
     if len(free) > 1:
         raise ValueError(f'At most one axis may be -1, got {free}')

@@ -256,22 +256,26 @@ def terminate_instances(cluster_name_on_cloud: str, region: str,
         raise api.translate_error(e, 'group delete') from e
 
 
-def _next_nsg_priority(rg: str) -> int:
-    """First NSG rule priority >= 900 unused by ANY rule in the
-    group's NSGs. ``az vm open-port`` defaults every rule to priority
-    900, so a second open_ports call on the same cluster (ports added
-    on a later launch/update) would violate Azure's unique-priority
-    constraint; an explicit fresh priority per call avoids it."""
+def _free_nsg_priorities(rg: str, n: int) -> List[int]:
+    """First ``n`` NSG rule priorities >= 900 unused by ANY rule in
+    the group's NSGs. ``az vm open-port`` defaults every rule to
+    priority 900, so a second open_ports call on the same cluster
+    (ports added on a later launch/update) — or two VMs whose NICs
+    share a subnet-level NSG within ONE call — would violate Azure's
+    unique-priority constraint; explicit fresh priorities avoid it."""
+    used = set()
     try:
         nsgs = api.run_az(['network', 'nsg', 'list', '-g', rg]) or []
+        used = {r.get('priority') for nsg in nsgs
+                for r in (nsg.get('securityRules') or [])}
     except api.AzCliError:
-        return 900
-    used = {r.get('priority') for nsg in nsgs
-            for r in (nsg.get('securityRules') or [])}
-    p = 900
-    while p in used:
+        pass
+    out, p = [], 900
+    while len(out) < n:
+        if p not in used:
+            out.append(p)
         p += 1
-    return p
+    return out
 
 
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
@@ -280,12 +284,15 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
     if not ports:
         return
     rg = resource_group(cluster_name_on_cloud)
-    # One call with a comma-joined port list (per-port calls would
-    # each need their own priority), at a priority no existing rule
-    # in the group uses.
+    # One call per VM with a comma-joined port list (per-port calls
+    # would each need their own priority), each VM at its own fresh
+    # priority: when NICs share an NSG (subnet-level NSG), reusing one
+    # priority across the VM loop would trip Azure's unique-priority
+    # constraint on the second VM.
     port_arg = ','.join(str(p) for p in ports)
-    priority = _next_nsg_priority(rg)
-    for vm in _list_vms(rg):
+    vms = _list_vms(rg)
+    priorities = _free_nsg_priorities(rg, len(vms))
+    for vm, priority in zip(vms, priorities):
         try:
             api.run_az(['vm', 'open-port', '-g', rg, '-n',
                         vm['name'], '--port', port_arg,

@@ -1,0 +1,109 @@
+"""Minimal FluidStack REST client.
+
+Role of reference ``sky/provision/fluidstack/fluidstack_utils.py``,
+re-designed: api-key REST against ``platform.fluidstack.io``.
+Instances are created with POST /instances, stopped/started with
+``/instances/<id>/stop|start``, deleted with DELETE, listed via
+GET /instances. Cluster membership rides instance NAMES
+(``<cluster>-<idx>``). Same fake-session test seam as the
+lambda_cloud/runpod plugins.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://platform.fluidstack.io'
+CREDENTIALS_PATH = '~/.fluidstack/api_key'
+
+
+def read_api_key() -> Optional[str]:
+    key = os.environ.get('FLUIDSTACK_API_KEY')
+    if key:
+        return key
+    try:
+        with open(os.path.expanduser(CREDENTIALS_PATH),
+                  encoding='utf-8') as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _requests_session():
+    import requests
+    return requests.Session()
+
+
+# Test seam.
+session_factory = _requests_session
+
+
+class FluidstackClient:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        self.api_key = api_key or read_api_key()
+        if not self.api_key:
+            raise exceptions.ProvisionError(
+                'No FluidStack API key (set FLUIDSTACK_API_KEY or '
+                f'write {CREDENTIALS_PATH}).')
+        self.http = session_factory()
+
+    def _call(self, method: str, path: str,
+              json: Optional[Dict[str, Any]] = None) -> Any:
+        resp = self.http.request(
+            method, f'{API_ENDPOINT}{path}', json=json,
+            headers={'api-key': self.api_key}, timeout=60)
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {}
+        if resp.status_code >= 400:
+            msg = (body.get('message') or body.get('error') or
+                   resp.text[:200])
+            raise translate_error(str(msg), path)
+        return body
+
+    # ------------------------------------------------------------ ops
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return self._call('GET', '/instances') or []
+
+    def create(self, *, name: str, gpu_type: str, gpu_count: int,
+               region: str, ssh_key_name: str) -> str:
+        body = self._call(
+            'POST', '/instances',
+            json={
+                'name': name,
+                'gpu_type': gpu_type,
+                'gpu_count': gpu_count,
+                'region': region,
+                'ssh_key': ssh_key_name,
+            })
+        return body['id']
+
+    def stop(self, instance_id: str) -> None:
+        self._call('POST', f'/instances/{instance_id}/stop')
+
+    def start(self, instance_id: str) -> None:
+        self._call('POST', f'/instances/{instance_id}/start')
+
+    def delete(self, instance_id: str) -> None:
+        self._call('DELETE', f'/instances/{instance_id}')
+
+    def list_ssh_keys(self) -> List[Dict[str, Any]]:
+        return self._call('GET', '/ssh_keys') or []
+
+    def add_ssh_key(self, name: str, public_key: str) -> None:
+        self._call('POST', '/ssh_keys',
+                   json={'name': name, 'public_key': public_key})
+
+
+def translate_error(message: str, what: str) -> Exception:
+    blob = message.lower()
+    if ('insufficient capacity' in blob or 'no capacity' in blob or
+            'out of stock' in blob or 'sold out' in blob):
+        return exceptions.StockoutError(f'{what}: {message}')
+    if 'quota' in blob or 'limit' in blob:
+        return exceptions.QuotaExceededError(f'{what}: {message}')
+    return exceptions.ProvisionError(f'{what}: {message}')

@@ -1,0 +1,218 @@
+"""FluidStack provision ops (nine-op contract).
+
+Role of reference ``sky/provision/fluidstack/instance.py``,
+re-designed stateless: NAME-scoped membership (``<cluster>-<idx>``),
+one create per missing index with an idempotently-registered ssh key,
+stop/start supported, delete by id.
+
+Status mapping: FluidStack ``pending``/``provisioning``/``running``/
+``stopping``/``stopped``/``terminated`` -> framework
+'pending'/'running'/'stopped'/'terminated'.
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.fluidstack import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_TIMEOUT = 1800.0
+_POLL_INTERVAL = 5.0
+
+SSH_USER = 'ubuntu'
+
+
+def _vm_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}'
+
+
+def _cluster_instances(client: api.FluidstackClient,
+                       cluster: str) -> Dict[str, Dict[str, Any]]:
+    """name -> instance, EXACT ``<cluster>-<rank>`` match."""
+    member = re.compile(re.escape(cluster) + r'-\d+\Z')
+    out: Dict[str, Dict[str, Any]] = {}
+    for inst in client.list_instances():
+        name = inst.get('name') or ''
+        if member.fullmatch(name):
+            out[name] = inst
+    return out
+
+
+def _ensure_ssh_key(client: api.FluidstackClient,
+                    public_key: Optional[str]) -> str:
+    if not public_key:
+        keys = client.list_ssh_keys()
+        if not keys:
+            raise exceptions.ProvisionError(
+                'No SSH keys registered with FluidStack and no '
+                'ssh_public_key provided.')
+        return keys[0]['name']
+    digest = hashlib.sha256(public_key.encode()).hexdigest()[:12]
+    key_name = f'skytpu-{digest}'
+    if not any(k.get('name') == key_name
+               for k in client.list_ssh_keys()):
+        client.add_ssh_key(key_name, public_key)
+    return key_name
+
+
+def _gpu_parts(instance_type: str) -> Dict[str, Any]:
+    """'4x_H100_SXM5'-style catalog names -> create args."""
+    m = re.match(r'(\d+)x_(.+)\Z', instance_type or '')
+    if not m:
+        raise exceptions.ProvisionError(
+            f'Unparseable FluidStack instance type {instance_type!r} '
+            "(expected '<n>x_<GPU>').")
+    return {'gpu_count': int(m.group(1)), 'gpu_type': m.group(2)}
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    cluster = config.cluster_name_on_cloud
+    client = api.FluidstackClient()
+    key_name = _ensure_ssh_key(client, node.get('ssh_public_key'))
+    gpu = _gpu_parts(node['instance_type'])
+    created: List[str] = []
+    resumed: List[str] = []
+    existing = _cluster_instances(client, cluster)
+    for idx in range(config.count):
+        name = _vm_name(cluster, idx)
+        inst = existing.get(name)
+        if inst is not None:
+            if inst.get('status') == 'stopped':
+                client.start(inst['id'])
+                resumed.append(inst['id'])
+            continue
+        created.append(client.create(
+            name=name,
+            gpu_type=gpu['gpu_type'],
+            gpu_count=gpu['gpu_count'],
+            region=config.region,
+            ssh_key_name=key_name))
+    return common.ProvisionRecord(
+        provider_name='fluidstack',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=_vm_name(cluster, 0),
+    )
+
+
+def _status(inst: Dict[str, Any]) -> str:
+    return {
+        'running': 'running',
+        'pending': 'pending',
+        'provisioning': 'pending',
+        'stopping': 'stopped',
+        'stopped': 'stopped',
+        'terminated': 'terminated',
+    }.get(inst.get('status', ''), 'pending')
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    client = api.FluidstackClient()
+    want = state or 'running'
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        insts = _cluster_instances(client, cluster_name_on_cloud)
+        if want == 'terminated':
+            if not insts or all(_status(i) == 'terminated'
+                                for i in insts.values()):
+                return
+        elif insts and all(_status(i) == want
+                           for i in insts.values()):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} to reach '
+        f'{want!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del region, zone
+    client = api.FluidstackClient()
+    out: Dict[str, Optional[str]] = {}
+    for name, inst in _cluster_instances(
+            client, cluster_name_on_cloud).items():
+        status = _status(inst)
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[name] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    client = api.FluidstackClient()
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for name, inst in sorted(
+            _cluster_instances(client, cluster_name_on_cloud).items()):
+        infos[name] = [
+            common.InstanceInfo(
+                instance_id=inst.get('id', name),
+                internal_ip=inst.get('private_ip') or
+                inst.get('ip_address', ''),
+                external_ip=inst.get('ip_address'),
+                host_index=0,
+                tags={'name': name},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='fluidstack',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user=SSH_USER,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del region, zone
+    client = api.FluidstackClient()
+    for inst in _cluster_instances(client,
+                                   cluster_name_on_cloud).values():
+        if _status(inst) == 'running':
+            client.stop(inst['id'])
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    client = api.FluidstackClient()
+    for inst in _cluster_instances(client,
+                                   cluster_name_on_cloud).values():
+        if _status(inst) != 'terminated':
+            client.delete(inst['id'])
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    logger.info('fluidstack: instances have open ingress by default; '
+                'open_ports(%s) is a no-op.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

@@ -1,0 +1,106 @@
+"""Minimal Nebius compute REST client.
+
+Role of reference ``sky/provision/nebius/utils.py`` (which drives the
+``nebius`` SDK); re-designed as a token-bearer JSON client against the
+compute endpoint. Instances carry gRPC-style SCREAMING statuses
+(PROVISIONING/RUNNING/STOPPING/STOPPED/DELETING) and errors carry a
+``code`` in the same vocabulary (RESOURCE_EXHAUSTED, QUOTA_EXCEEDED)
+— the error taxonomy maps codes, not prose. Cluster membership rides
+instance NAMES (``<cluster>-<idx>``). Same fake-session test seam as
+the other REST plugins.
+"""
+from __future__ import annotations
+
+import json as json_lib
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://compute.api.nebius.cloud/v1'
+CREDENTIALS_PATH = '~/.nebius/credentials.json'
+
+
+def read_token() -> Optional[str]:
+    token = os.environ.get('NEBIUS_IAM_TOKEN')
+    if token:
+        return token
+    try:
+        with open(os.path.expanduser(CREDENTIALS_PATH),
+                  encoding='utf-8') as f:
+            return json_lib.load(f).get('token')
+    except (OSError, ValueError):
+        return None
+
+
+def _requests_session():
+    import requests
+    return requests.Session()
+
+
+# Test seam.
+session_factory = _requests_session
+
+
+class NebiusClient:
+
+    def __init__(self, token: Optional[str] = None) -> None:
+        self.token = token or read_token()
+        if not self.token:
+            raise exceptions.ProvisionError(
+                'No Nebius IAM token (set NEBIUS_IAM_TOKEN or write '
+                f'{CREDENTIALS_PATH}).')
+        self.http = session_factory()
+
+    def _call(self, method: str, path: str,
+              json: Optional[Dict[str, Any]] = None) -> Any:
+        resp = self.http.request(
+            method, f'{API_ENDPOINT}{path}', json=json,
+            headers={'Authorization': f'Bearer {self.token}'},
+            timeout=60)
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {}
+        if resp.status_code >= 400:
+            raise translate_error(body.get('code', ''),
+                                  body.get('message',
+                                           resp.text[:200]), path)
+        return body
+
+    # ------------------------------------------------------------ ops
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return self._call('GET', '/instances').get('items', [])
+
+    def create(self, *, name: str, platform: str, preset: str,
+               region: str, public_key: Optional[str]) -> str:
+        body = self._call(
+            'POST', '/instances',
+            json={
+                'name': name,
+                'platform': platform,         # e.g. gpu-h100-sxm
+                'preset': preset,             # e.g. 8gpu-128vcpu
+                'region': region,
+                'ssh_public_key': public_key or '',
+            })
+        return body['id']
+
+    def start(self, instance_id: str) -> None:
+        self._call('POST', f'/instances/{instance_id}:start')
+
+    def stop(self, instance_id: str) -> None:
+        self._call('POST', f'/instances/{instance_id}:stop')
+
+    def delete(self, instance_id: str) -> None:
+        self._call('DELETE', f'/instances/{instance_id}')
+
+
+def translate_error(code: str, message: str, what: str) -> Exception:
+    """Nebius errors carry structured codes — map those, not prose."""
+    code = (code or '').upper()
+    if code == 'RESOURCE_EXHAUSTED':
+        return exceptions.StockoutError(f'{what}: {message}')
+    if code == 'QUOTA_EXCEEDED':
+        return exceptions.QuotaExceededError(f'{what}: {message}')
+    return exceptions.ProvisionError(
+        f'{what}: {code or "ERROR"}: {message}')

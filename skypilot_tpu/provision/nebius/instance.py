@@ -1,0 +1,199 @@
+"""Nebius provision ops (nine-op contract).
+
+Role of reference ``sky/provision/nebius/instance.py``, re-designed
+stateless: NAME-scoped membership (``<cluster>-<idx>``), catalog
+instance types of the form ``<platform>_<preset>`` split into the
+API's (platform, preset) pair, stop/start supported, delete by id.
+
+Status mapping: PROVISIONING/STARTING -> 'pending', RUNNING ->
+'running', STOPPING/STOPPED -> 'stopped', DELETING/DELETED ->
+'terminated'.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.nebius import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_TIMEOUT = 1800.0
+_POLL_INTERVAL = 5.0
+
+SSH_USER = 'ubuntu'
+
+
+def _vm_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}'
+
+
+def _cluster_instances(client: api.NebiusClient,
+                       cluster: str) -> Dict[str, Dict[str, Any]]:
+    """name -> instance, EXACT ``<cluster>-<rank>`` match."""
+    member = re.compile(re.escape(cluster) + r'-\d+\Z')
+    out: Dict[str, Dict[str, Any]] = {}
+    for inst in client.list_instances():
+        name = inst.get('name') or ''
+        if member.fullmatch(name):
+            out[name] = inst
+    return out
+
+
+def _platform_preset(instance_type: str) -> Dict[str, str]:
+    """'gpu-h100-sxm_8gpu-128vcpu' catalog names -> API pair."""
+    parts = (instance_type or '').split('_', 1)
+    if len(parts) != 2:
+        raise exceptions.ProvisionError(
+            f'Unparseable Nebius instance type {instance_type!r} '
+            "(expected '<platform>_<preset>').")
+    return {'platform': parts[0], 'preset': parts[1]}
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    cluster = config.cluster_name_on_cloud
+    client = api.NebiusClient()
+    pp = _platform_preset(node['instance_type'])
+    created: List[str] = []
+    resumed: List[str] = []
+    existing = _cluster_instances(client, cluster)
+    for idx in range(config.count):
+        name = _vm_name(cluster, idx)
+        inst = existing.get(name)
+        if inst is not None:
+            if _status(inst) == 'stopped':
+                client.start(inst['id'])
+                resumed.append(inst['id'])
+            continue
+        created.append(client.create(
+            name=name,
+            platform=pp['platform'],
+            preset=pp['preset'],
+            region=config.region,
+            public_key=node.get('ssh_public_key')))
+    return common.ProvisionRecord(
+        provider_name='nebius',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=_vm_name(cluster, 0),
+    )
+
+
+def _status(inst: Dict[str, Any]) -> str:
+    return {
+        'PROVISIONING': 'pending',
+        'STARTING': 'pending',
+        'RUNNING': 'running',
+        'STOPPING': 'stopped',
+        'STOPPED': 'stopped',
+        'DELETING': 'terminated',
+        'DELETED': 'terminated',
+    }.get(inst.get('status', ''), 'pending')
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    client = api.NebiusClient()
+    want = state or 'running'
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        insts = _cluster_instances(client, cluster_name_on_cloud)
+        if want == 'terminated':
+            if not insts or all(_status(i) == 'terminated'
+                                for i in insts.values()):
+                return
+        elif insts and all(_status(i) == want
+                           for i in insts.values()):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} to reach '
+        f'{want!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del region, zone
+    client = api.NebiusClient()
+    out: Dict[str, Optional[str]] = {}
+    for name, inst in _cluster_instances(
+            client, cluster_name_on_cloud).items():
+        status = _status(inst)
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[name] = status
+    return out
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    client = api.NebiusClient()
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for name, inst in sorted(
+            _cluster_instances(client, cluster_name_on_cloud).items()):
+        infos[name] = [
+            common.InstanceInfo(
+                instance_id=inst.get('id', name),
+                internal_ip=inst.get('private_ipv4', ''),
+                external_ip=inst.get('public_ipv4'),
+                host_index=0,
+                tags={'name': name},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='nebius',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user=SSH_USER,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del region, zone
+    client = api.NebiusClient()
+    for inst in _cluster_instances(client,
+                                   cluster_name_on_cloud).values():
+        if _status(inst) == 'running':
+            client.stop(inst['id'])
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    client = api.NebiusClient()
+    for inst in _cluster_instances(client,
+                                   cluster_name_on_cloud).values():
+        if _status(inst) != 'terminated':
+            client.delete(inst['id'])
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    logger.info('nebius: default security group allows ingress; '
+                'open_ports(%s) is a no-op.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

@@ -1,0 +1,1 @@
+"""RunPod provision plugin."""

@@ -1,0 +1,122 @@
+"""Minimal RunPod GraphQL client.
+
+Role of reference ``sky/provision/runpod/utils.py`` (which wraps the
+``runpod`` SDK); re-designed as a dependency-free GraphQL-over-HTTP
+client against ``api.runpod.io/graphql``. Pods are the unit: deployed
+with ``podFindAndDeployOnDemand``, stopped/resumed/terminated with
+``podStop``/``podResume``/``podTerminate``, listed via ``myself {
+pods }``. Cluster membership rides pod NAMES (``<cluster>-<idx>``).
+
+The ``session_factory`` seam is replaced with a fake in tests, same
+pattern as the lambda_cloud plugin.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://api.runpod.io/graphql'
+CREDENTIALS_PATH = '~/.runpod/config.toml'
+
+
+def read_api_key() -> Optional[str]:
+    key = os.environ.get('RUNPOD_API_KEY')
+    if key:
+        return key
+    try:
+        with open(os.path.expanduser(CREDENTIALS_PATH),
+                  encoding='utf-8') as f:
+            for line in f:
+                if line.strip().startswith('api_key'):
+                    return line.split('=', 1)[1].strip().strip('"\'')
+    except OSError:
+        pass
+    return None
+
+
+def _requests_session():
+    import requests
+    return requests.Session()
+
+
+# Test seam.
+session_factory = _requests_session
+
+
+class RunPodClient:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        self.api_key = api_key or read_api_key()
+        if not self.api_key:
+            raise exceptions.ProvisionError(
+                'No RunPod API key (set RUNPOD_API_KEY or write '
+                f'{CREDENTIALS_PATH}).')
+        self.http = session_factory()
+
+    def _gql(self, query: str,
+             variables: Optional[Dict[str, Any]] = None) -> Any:
+        resp = self.http.request(
+            'POST', API_ENDPOINT,
+            json={'query': query, 'variables': variables or {}},
+            headers={'Authorization': f'Bearer {self.api_key}'},
+            timeout=60)
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {}
+        errors = body.get('errors') or (
+            [{'message': resp.text[:200]}] if resp.status_code >= 400
+            else [])
+        if errors:
+            raise translate_error(errors[0].get('message', ''),
+                                  query.split('(')[0].strip())
+        return body.get('data', {})
+
+    # ------------------------------------------------------------ ops
+    def list_pods(self) -> List[Dict[str, Any]]:
+        data = self._gql(
+            'query { myself { pods { id name desiredStatus costPerHr '
+            'runtime { ports { ip isIpPublic privatePort publicPort } '
+            '} machine { gpuDisplayName } dataCenterId } } }')
+        return (data.get('myself') or {}).get('pods', [])
+
+    def deploy(self, *, name: str, gpu_type: str, gpu_count: int,
+               region: str, disk_gb: int,
+               public_key: Optional[str]) -> str:
+        env = ''
+        if public_key:
+            env = ('env: [{ key: "PUBLIC_KEY", value: "%s" }], '
+                   % public_key.replace('"', ''))
+        data = self._gql(
+            'mutation { podFindAndDeployOnDemand(input: { '
+            f'name: "{name}", gpuTypeId: "{gpu_type}", '
+            f'gpuCount: {gpu_count}, dataCenterId: "{region}", '
+            f'volumeInGb: {disk_gb}, containerDiskInGb: {disk_gb}, '
+            f'{env}'
+            'cloudType: SECURE }) { id } }')
+        return data['podFindAndDeployOnDemand']['id']
+
+    def stop(self, pod_id: str) -> None:
+        self._gql('mutation { podStop(input: { podId: "%s" }) '
+                  '{ id desiredStatus } }' % pod_id)
+
+    def resume(self, pod_id: str) -> None:
+        self._gql('mutation { podResume(input: { podId: "%s" }) '
+                  '{ id desiredStatus } }' % pod_id)
+
+    def terminate(self, pod_id: str) -> None:
+        self._gql('mutation { podTerminate(input: { podId: "%s" }) }'
+                  % pod_id)
+
+
+def translate_error(message: str, what: str) -> Exception:
+    blob = message.lower()
+    if ('no longer any instances available' in blob or
+            'not enough' in blob or 'unavailable' in blob or
+            'out of stock' in blob):
+        return exceptions.StockoutError(f'{what}: {message}')
+    if 'quota' in blob or 'limit exceeded' in blob or 'spend' in blob:
+        return exceptions.QuotaExceededError(f'{what}: {message}')
+    return exceptions.ProvisionError(f'{what}: {message}')

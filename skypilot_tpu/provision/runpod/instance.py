@@ -1,0 +1,211 @@
+"""RunPod provision ops (nine-op contract).
+
+Role of reference ``sky/provision/runpod/instance.py``, re-designed on
+this framework's stateless seam: NAME-scoped membership (pods are
+named ``<cluster>-<idx>``), one GraphQL deploy per missing index,
+stop/resume supported (unlike Lambda), terminate by pod id.
+
+Status mapping: RunPod ``desiredStatus`` CREATED/RUNNING/EXITED/
+TERMINATED -> 'pending'/'running'/'stopped'/'terminated'.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.runpod import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_TIMEOUT = 1800.0
+_POLL_INTERVAL = 5.0
+
+SSH_USER = 'root'
+
+
+def _vm_name(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}'
+
+
+def _cluster_pods(client: api.RunPodClient,
+                  cluster: str) -> Dict[str, Dict[str, Any]]:
+    """name -> pod, EXACT ``<cluster>-<rank>`` match (a prefix sweep
+    could pull a foreign cluster into this one's terminate)."""
+    member = re.compile(re.escape(cluster) + r'-\d+\Z')
+    out: Dict[str, Dict[str, Any]] = {}
+    for pod in client.list_pods():
+        name = pod.get('name') or ''
+        if member.fullmatch(name):
+            out[name] = pod
+    return out
+
+
+def _gpu_parts(instance_type: str) -> Dict[str, Any]:
+    """'1x_A100-80GB_SECURE'-style catalog names -> deploy args."""
+    m = re.match(r'(\d+)x_(.+?)(?:_SECURE|_COMMUNITY)?\Z',
+                 instance_type or '')
+    if not m:
+        raise exceptions.ProvisionError(
+            f'Unparseable RunPod instance type {instance_type!r} '
+            "(expected '<n>x_<GPU>[_SECURE]').")
+    return {'gpu_count': int(m.group(1)), 'gpu_type': m.group(2)}
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    """Nothing to pre-create (no VPCs/security groups on RunPod)."""
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    cluster = config.cluster_name_on_cloud
+    client = api.RunPodClient()
+    gpu = _gpu_parts(node['instance_type'])
+    created: List[str] = []
+    resumed: List[str] = []
+    existing = _cluster_pods(client, cluster)
+    for idx in range(config.count):
+        name = _vm_name(cluster, idx)
+        pod = existing.get(name)
+        if pod is not None:
+            if pod.get('desiredStatus') == 'EXITED':
+                client.resume(pod['id'])
+                resumed.append(pod['id'])
+            continue
+        created.append(client.deploy(
+            name=name,
+            gpu_type=gpu['gpu_type'],
+            gpu_count=gpu['gpu_count'],
+            region=config.region,
+            disk_gb=int(node.get('disk_size') or 100),
+            public_key=node.get('ssh_public_key')))
+    return common.ProvisionRecord(
+        provider_name='runpod',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=_vm_name(cluster, 0),
+    )
+
+
+def _status(pod: Dict[str, Any]) -> str:
+    return {
+        'RUNNING': 'running',
+        'CREATED': 'pending',
+        'RESTARTING': 'pending',
+        'EXITED': 'stopped',
+        'TERMINATED': 'terminated',
+    }.get(pod.get('desiredStatus', ''), 'pending')
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    client = api.RunPodClient()
+    want = state or 'running'
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        pods = _cluster_pods(client, cluster_name_on_cloud)
+        if want == 'terminated':
+            if not pods or all(_status(p) == 'terminated'
+                               for p in pods.values()):
+                return
+        elif pods and all(_status(p) == want for p in pods.values()):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} to reach '
+        f'{want!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del region, zone
+    client = api.RunPodClient()
+    out: Dict[str, Optional[str]] = {}
+    for name, pod in _cluster_pods(client,
+                                   cluster_name_on_cloud).items():
+        status = _status(pod)
+        if non_terminated_only and status == 'terminated':
+            continue
+        out[name] = status
+    return out
+
+
+def _pod_ips(pod: Dict[str, Any]) -> Dict[str, Optional[str]]:
+    """Public/private IP from the runtime port map (RunPod exposes
+    SSH on the public IP's mapped port; private IP inside the DC)."""
+    public = private = None
+    runtime = pod.get('runtime') or {}
+    for port in runtime.get('ports') or []:
+        if port.get('isIpPublic'):
+            public = public or port.get('ip')
+        else:
+            private = private or port.get('ip')
+    return {'external': public, 'internal': private or public or ''}
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    client = api.RunPodClient()
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for name, pod in sorted(
+            _cluster_pods(client, cluster_name_on_cloud).items()):
+        ips = _pod_ips(pod)
+        infos[name] = [
+            common.InstanceInfo(
+                instance_id=pod.get('id', name),
+                internal_ip=ips['internal'],
+                external_ip=ips['external'],
+                host_index=0,
+                tags={'name': name},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='runpod',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user=SSH_USER,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del region, zone
+    client = api.RunPodClient()
+    for pod in _cluster_pods(client, cluster_name_on_cloud).values():
+        if pod.get('desiredStatus') == 'RUNNING':
+            client.stop(pod['id'])
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    client = api.RunPodClient()
+    for pod in _cluster_pods(client, cluster_name_on_cloud).values():
+        if pod.get('desiredStatus') != 'TERMINATED':
+            client.terminate(pod['id'])
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    logger.info('runpod: ports are exposed per-pod at deploy time; '
+                'open_ports(%s) is a no-op.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

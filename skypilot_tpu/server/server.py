@@ -189,10 +189,104 @@ async def handle_health(request: web.Request) -> web.Response:
     })
 
 
+def _resolve_ssh_endpoint(handle):
+    """(host, port, keepalive) the server can open a TCP stream to
+    for the cluster head's sshd. For kubernetes port-forward clusters
+    the server stands up (or reuses) its kubectl tunnel; the runner
+    object is returned as ``keepalive`` because the tunnel process is
+    finalized when the runner is garbage-collected."""
+    from skypilot_tpu.utils import command_runner as runner_lib
+    runner = handle.head_runner()
+    # Docker wrapping is irrelevant to a TCP bridge: unwrap to the
+    # host-level runner.
+    runner = getattr(runner, 'inner', runner)
+    if isinstance(runner, runner_lib.KubernetesPortForwardRunner):
+        port = runner.ensure_tunnel()
+        return '127.0.0.1', port, runner
+    ip = getattr(runner, 'ip', None) or handle.ip_list()[0]
+    port = getattr(runner, 'port', None) or 22
+    return ip, port, runner
+
+
+async def handle_ssh_proxy(request: web.Request) -> web.StreamResponse:
+    """WebSocket <-> cluster-head TCP bridge (the role of reference
+    sky/server/server.py:1008's kubernetes ssh proxy): a client of a
+    REMOTE API server opens an SSH stream to a cluster only the
+    server can reach — the server dials the head's sshd (through its
+    own kubectl port-forward tunnel for kubernetes clusters) and
+    pumps bytes both ways."""
+    cluster = request.match_info['cluster']
+    from skypilot_tpu import global_user_state
+    rec = global_user_state.get_cluster_from_name(cluster)
+    if rec is None or rec.get('handle') is None:
+        raise web.HTTPNotFound(text=f'No cluster {cluster!r}.')
+    try:
+        host, port, keepalive = await asyncio.get_event_loop(
+        ).run_in_executor(None, _resolve_ssh_endpoint, rec['handle'])
+    except Exception as e:  # pylint: disable=broad-except
+        raise web.HTTPBadGateway(
+            text=f'No SSH endpoint for {cluster!r}: {e}')
+    ws = web.WebSocketResponse()
+    await ws.prepare(request)
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+    except OSError as e:
+        await ws.close(code=1011, message=str(e).encode()[:100])
+        return ws
+
+    async def ws_to_tcp():
+        async for msg in ws:
+            if msg.type == web.WSMsgType.BINARY:
+                writer.write(msg.data)
+                await writer.drain()
+            elif msg.type in (web.WSMsgType.CLOSE, web.WSMsgType.ERROR):
+                break
+        writer.close()
+
+    async def tcp_to_ws():
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                await ws.send_bytes(data)
+        finally:
+            if not ws.closed:
+                await ws.close()
+
+    await asyncio.gather(ws_to_tcp(), tcp_to_ws(),
+                         return_exceptions=True)
+    del keepalive   # tunnel may now be reclaimed
+    return ws
+
+
+async def _heartbeat_ctx(app: web.Application):
+    """Periodic usage heartbeat while the server runs — the
+    fleet-visibility beacon a team API-server deployment reports to a
+    configured collector (no-op when SKYTPU_USAGE_COLLECTOR_URL /
+    usage.collector_url is unset). Reference
+    sky/usage/usage_lib.py:467."""
+    from skypilot_tpu.usage import usage_lib
+
+    interval = float(os.environ.get('SKYTPU_HEARTBEAT_INTERVAL',
+                                    '300'))
+
+    async def beat():
+        while True:
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda: usage_lib.heartbeat(op='api_server'))
+            await asyncio.sleep(interval)
+
+    task = asyncio.ensure_future(beat())
+    yield
+    task.cancel()
+
+
 def make_app() -> web.Application:
     # Workdir zips route through /api/upload — aiohttp's default
     # 1 MiB body cap would reject any real project.
     app = web.Application(client_max_size=4 * 1024**3)
+    app.cleanup_ctx.append(_heartbeat_ctx)
     app.router.add_get('/api/health', handle_health)
     app.router.add_get('/api/get', handle_get)
     app.router.add_get('/api/status', handle_status_poll)
@@ -200,6 +294,7 @@ def make_app() -> web.Application:
     app.router.add_post('/api/cancel', handle_cancel)
     app.router.add_post('/api/upload', handle_upload)
     app.router.add_get('/api/requests', handle_list)
+    app.router.add_get('/api/ssh-proxy/{cluster}', handle_ssh_proxy)
     app.router.add_post('/api/v1/{op:.+}', handle_op)
     return app
 

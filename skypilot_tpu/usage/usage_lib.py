@@ -1,11 +1,24 @@
-"""Local JSONL usage sink with schema scrubbing."""
+"""Usage telemetry: local JSONL sink + optional remote collector.
+
+Every event passes the field whitelist (the schema IS the scrub) and
+lands in the local ring file; when a collector is configured
+(``SKYTPU_USAGE_COLLECTOR_URL`` or config ``usage.collector_url``)
+the same scrubbed records are also POSTed in batches to
+``<collector>/usage`` from a daemon thread, and long-lived processes
+(the API server) POST a periodic ``<collector>/heartbeat`` — the
+fleet-visibility role of reference
+``sky/usage/usage_lib.py:341,467``. Opt-out: SKYTPU_DISABLE_USAGE=1
+silences both sinks. Telemetry is lossy by design: sends are
+best-effort, bounded, and can never break or block the product.
+"""
 from __future__ import annotations
 
 import contextlib
 import json
 import os
+import threading
 import time
-from typing import Any, Dict, Iterator
+from typing import Any, Dict, Iterator, List, Optional
 
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import log as sky_logging
@@ -13,6 +26,10 @@ from skypilot_tpu.utils import log as sky_logging
 logger = sky_logging.init_logger(__name__)
 
 _DISABLE_ENV = 'SKYTPU_DISABLE_USAGE'
+_COLLECTOR_ENV = 'SKYTPU_USAGE_COLLECTOR_URL'
+_FLUSH_INTERVAL_S = float(os.environ.get(
+    'SKYTPU_USAGE_FLUSH_INTERVAL', '30'))
+_MAX_PENDING = 1000
 
 # The whitelist IS the schema: anything not listed never leaves the
 # call site (reference scrubs via schemas too,
@@ -50,6 +67,94 @@ def _scrub(fields: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def collector_url() -> Optional[str]:
+    """Remote collector endpoint, or None (local-only)."""
+    url = os.environ.get(_COLLECTOR_ENV)
+    if url:
+        return url
+    try:
+        from skypilot_tpu import skypilot_config
+        return skypilot_config.get_nested(('usage', 'collector_url'))
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+_pending: List[dict] = []
+_pending_lock = threading.Lock()
+_flusher: Optional[threading.Thread] = None
+
+
+def _enqueue_remote(event: Dict[str, Any]) -> None:
+    if collector_url() is None:
+        return
+    global _flusher
+    with _pending_lock:
+        if len(_pending) < _MAX_PENDING:   # bounded: drop, not grow
+            _pending.append(event)
+        if _flusher is None or not _flusher.is_alive():
+            _flusher = threading.Thread(target=_flush_loop,
+                                        name='usage-flusher',
+                                        daemon=True)
+            _flusher.start()
+
+
+def _flush_loop() -> None:
+    while True:
+        time.sleep(_FLUSH_INTERVAL_S)
+        flush_remote()
+
+
+def flush_remote(timeout: float = 5.0) -> bool:
+    """POST pending events to ``<collector>/usage`` in one batch.
+
+    Returns True when there was nothing to send or the send
+    succeeded. Failed batches are dropped (telemetry is lossy, never
+    a queue that grows into the product's memory)."""
+    url = collector_url()
+    if url is None or disabled():
+        return False
+    with _pending_lock:
+        batch, _pending[:] = list(_pending), []
+    if not batch:
+        return True
+    try:
+        import requests
+        requests.post(url.rstrip('/') + '/usage',
+                      json={'source': common_utils.get_user_hash(),
+                            'events': batch},
+                      timeout=timeout)
+        return True
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def heartbeat(**fields: Any) -> bool:
+    """POST one liveness beacon to ``<collector>/heartbeat``.
+
+    Long-lived processes (the API server) call this periodically so a
+    team deployment has fleet visibility; payload is whitelisted the
+    same way events are, plus a cluster count from local state."""
+    url = collector_url()
+    if url is None or disabled():
+        return False
+    try:
+        from skypilot_tpu import global_user_state
+        n_clusters = len(global_user_state.get_clusters())
+    except Exception:  # pylint: disable=broad-except
+        n_clusters = None
+    try:
+        import requests
+        requests.post(url.rstrip('/') + '/heartbeat',
+                      json={'source': common_utils.get_user_hash(),
+                            'ts': time.time(),
+                            'n_clusters': n_clusters,
+                            **_scrub(fields)},
+                      timeout=5.0)
+        return True
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
 def record_event(op: str, **fields: Any) -> None:
     """Append one scrubbed event; never raises, never blocks long."""
     if disabled():
@@ -61,6 +166,7 @@ def record_event(op: str, **fields: Any) -> None:
             'op': op,
             **_scrub(fields),
         }
+        _enqueue_remote(event)
         path = messages_path()
         # Ring behavior: start over when the file grows too large. The
         # rotate-then-append pair is guarded by a file lock because the

@@ -60,8 +60,10 @@ _SERVICE_SCHEMA = {
                     'required': ['path'],
                     'properties': {
                         'path': {'type': 'string'},
-                        'initial_delay_seconds': {'type': 'number'},
-                        'timeout_seconds': {'type': 'number'},
+                        'initial_delay_seconds': {'type': 'number',
+                                                  'minimum': 0},
+                        'timeout_seconds': {'type': 'number',
+                                            'exclusiveMinimum': 0},
                         'post_data': {},
                     },
                 },
@@ -71,18 +73,41 @@ _SERVICE_SCHEMA = {
             'type': 'object',
             'additionalProperties': False,
             'properties': {
-                'min_replicas': {'type': 'integer'},
-                'max_replicas': {'type': 'integer'},
-                'target_qps_per_replica': {'type': 'number'},
-                'upscale_delay_seconds': {'type': 'number'},
-                'downscale_delay_seconds': {'type': 'number'},
-                'base_ondemand_fallback_replicas': {'type': 'integer'},
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': 'number',
+                                           'exclusiveMinimum': 0},
+                'upscale_delay_seconds': {'type': 'number',
+                                          'minimum': 0},
+                'downscale_delay_seconds': {'type': 'number',
+                                            'minimum': 0},
+                'base_ondemand_fallback_replicas': {'type': 'integer',
+                                                    'minimum': 0},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+                'use_spot': {'type': 'boolean'},
                 'spot_placer': {'type': 'string'},
             },
         },
-        'replicas': {'type': 'integer'},
-        'replica_port': {'type': 'integer'},
-        'load_balancing_policy': {'type': 'string'},
+        'replicas': {'type': 'integer', 'minimum': 0},
+        'replica_port': {'type': 'integer', 'minimum': 1,
+                         'maximum': 65535},
+        'load_balancing_policy': {
+            'enum': ['round_robin', 'least_load']},
+    },
+}
+
+# storage_mounts: <mount path> -> storage spec (data/storage.py
+# Storage.from_yaml_config's surface).
+_STORAGE_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': 'string'},
+        'source': {'type': 'string'},
+        'store': {'enum': ['gcs', 's3', 'r2', 'azure', 'ibm', 'oci',
+                           'local']},
+        'mode': {'enum': ['COPY', 'MOUNT', 'copy', 'mount']},
+        'persistent': {'type': 'boolean'},
     },
 }
 
@@ -104,8 +129,12 @@ TASK_SCHEMA = {
         'num_nodes': {'type': 'integer', 'minimum': 1},
         'estimate_runtime': {'type': 'number', 'exclusiveMinimum': 0},
         'resources': _RESOURCES_SCHEMA,
-        'file_mounts': {'type': 'object'},
-        'storage_mounts': {'type': 'object'},
+        # dst path -> local path or bucket URL (gs://, s3://, r2://,
+        # https://<account>.blob...).
+        'file_mounts': {'type': 'object',
+                        'additionalProperties': {'type': 'string'}},
+        'storage_mounts': {'type': 'object',
+                           'additionalProperties': _STORAGE_SCHEMA},
         'service': _SERVICE_SCHEMA,
     },
 }
@@ -117,7 +146,14 @@ CONFIG_SCHEMA = {
         'jobs': {
             'type': 'object',
             'properties': {
-                'controller': {'type': 'object'},
+                'controller': {
+                    'type': 'object',
+                    'properties': {
+                        'resources': _RESOURCES_SCHEMA,
+                        'max_parallel_launches': {'type': 'integer',
+                                                  'minimum': 1},
+                    },
+                },
             },
         },
         'gcp': {
@@ -130,6 +166,12 @@ CONFIG_SCHEMA = {
             'type': 'object',
             'properties': {
                 'endpoint': {'type': 'string'},
+            },
+        },
+        'usage': {
+            'type': 'object',
+            'properties': {
+                'collector_url': {'type': 'string'},
             },
         },
         'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},

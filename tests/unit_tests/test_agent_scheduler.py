@@ -1,0 +1,83 @@
+"""Agent job scheduler: CPU jobs pack concurrently under the
+resource-count cap; TPU jobs stay slice-exclusive; FIFO order is
+never bypassed (reference sky/skylet/job_lib.py:204)."""
+import pytest
+
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.utils import status_lib, subprocess_utils
+
+JobStatus = status_lib.JobStatus
+
+
+@pytest.fixture
+def sched(tmp_path, monkeypatch):
+    """job_lib against a temp state dir with driver spawning faked:
+    'started' jobs just get a live-looking pid."""
+    pids = iter(range(100000, 100100))
+    monkeypatch.setattr(subprocess_utils, 'daemonize',
+                        lambda cmd, log_path: next(pids))
+    monkeypatch.setattr(subprocess_utils, 'process_alive',
+                        lambda pid: True)
+    monkeypatch.setenv('SKYTPU_MAX_CONCURRENT_JOBS', '3')
+    return str(tmp_path)
+
+
+def _submit(state_dir, name, accelerator_type=''):
+    job_id = job_lib.add_job(
+        state_dir, name, 'tester', 'ts', 'res',
+        {'accelerator_type': accelerator_type})
+    job_lib.set_status(state_dir, job_id, JobStatus.PENDING)
+    return job_id
+
+
+def _statuses(state_dir):
+    return {j['job_id']: j['status']
+            for j in job_lib.get_jobs(state_dir)}
+
+
+def test_cpu_jobs_pack_up_to_cap(sched):
+    ids = [_submit(sched, f'cpu{i}') for i in range(5)]
+    job_lib.schedule_step(sched)
+    st = _statuses(sched)
+    # Cap is 3: the three oldest start, two wait.
+    assert [st[i] for i in ids[:3]] == [JobStatus.SETTING_UP] * 3
+    assert [st[i] for i in ids[3:]] == [JobStatus.PENDING] * 2
+    # One finishes -> exactly one more starts (FIFO).
+    job_lib.set_status(sched, ids[0], JobStatus.SUCCEEDED)
+    job_lib.schedule_step(sched)
+    st = _statuses(sched)
+    assert st[ids[3]] == JobStatus.SETTING_UP
+    assert st[ids[4]] == JobStatus.PENDING
+
+
+def test_tpu_job_is_slice_exclusive(sched):
+    tpu = _submit(sched, 'train', accelerator_type='tpu-v5e-16')
+    cpu = _submit(sched, 'cpu')
+    job_lib.schedule_step(sched)
+    st = _statuses(sched)
+    # The TPU job runs alone; the CPU job must wait.
+    assert st[tpu] == JobStatus.SETTING_UP
+    assert st[cpu] == JobStatus.PENDING
+    job_lib.set_status(sched, tpu, JobStatus.SUCCEEDED)
+    job_lib.schedule_step(sched)
+    assert _statuses(sched)[cpu] == JobStatus.SETTING_UP
+
+
+def test_tpu_job_not_starved_by_cpu_stream(sched):
+    """FIFO is never bypassed: a pending TPU job blocks younger CPU
+    jobs from overtaking it while the current CPU job drains."""
+    cpu1 = _submit(sched, 'cpu1')
+    job_lib.schedule_step(sched)
+    tpu = _submit(sched, 'train', accelerator_type='tpu-v5e-16')
+    cpu2 = _submit(sched, 'cpu2')
+    job_lib.schedule_step(sched)
+    st = _statuses(sched)
+    assert st[cpu1] == JobStatus.SETTING_UP
+    # TPU waits for exclusivity; cpu2 must NOT overtake it.
+    assert st[tpu] == JobStatus.PENDING
+    assert st[cpu2] == JobStatus.PENDING
+    job_lib.set_status(sched, cpu1, JobStatus.SUCCEEDED)
+    job_lib.schedule_step(sched)
+    st = _statuses(sched)
+    assert st[tpu] == JobStatus.SETTING_UP
+    assert st[cpu2] == JobStatus.PENDING

@@ -255,3 +255,69 @@ def test_api_version_compat_gate(monkeypatch):
         sdk.http, 'get',
         lambda url, timeout=None: _Resp({'status': 'healthy'}))
     assert sdk._healthy('http://127.0.0.1:1')
+
+
+def test_ssh_proxy_websocket_bridges_tcp(live_server, monkeypatch):
+    """/api/ssh-proxy/<cluster> bridges a websocket to the cluster
+    head's TCP endpoint (the remote-API-server SSH path, reference
+    sky/server/server.py:1008). A local echo server stands in for the
+    pod's sshd."""
+    import asyncio
+    import socket
+    import threading as _threading
+
+    import aiohttp
+
+    # TCP echo "sshd".
+    srv = socket.socket()
+    srv.bind(('127.0.0.1', 0))
+    srv.listen(1)
+    echo_port = srv.getsockname()[1]
+
+    def echo():
+        conn, _ = srv.accept()
+        while True:
+            data = conn.recv(65536)
+            if not data:
+                break
+            conn.sendall(data)
+        conn.close()
+
+    _threading.Thread(target=echo, daemon=True).start()
+
+    class FakeRunner:
+        ip = '127.0.0.1'
+        port = echo_port
+
+    class FakeHandle:
+
+        def head_runner(self):
+            return FakeRunner()
+
+        def ip_list(self):
+            return ['127.0.0.1']
+
+    from skypilot_tpu import global_user_state
+    monkeypatch.setattr(
+        global_user_state, 'get_cluster_from_name',
+        lambda name: ({'handle': FakeHandle()}
+                      if name == 'k8sc' else None))
+
+    async def drive():
+        async with aiohttp.ClientSession() as s:
+            # Unknown cluster -> 404.
+            async with s.get(
+                    f'{live_server}/api/ssh-proxy/nope') as r:
+                assert r.status == 404
+            async with s.ws_connect(
+                    f'{live_server}/api/ssh-proxy/k8sc') as ws:
+                await ws.send_bytes(b'SSH-2.0-probe\r\n')
+                msg = await asyncio.wait_for(ws.receive(), 10)
+                assert msg.type == aiohttp.WSMsgType.BINARY
+                assert msg.data == b'SSH-2.0-probe\r\n'
+                await ws.send_bytes(b'more')
+                msg2 = await asyncio.wait_for(ws.receive(), 10)
+                assert msg2.data == b'more'
+
+    asyncio.run(drive())
+    srv.close()

@@ -180,6 +180,17 @@ def test_open_ports_twice_uses_distinct_priorities(az):
     assert {r['ports'] for r in az.nsg_rules} == {'8080', '9090-9099'}
 
 
+def test_open_ports_multi_vm_distinct_priorities(az):
+    """Within ONE call, each VM's rule gets its own free priority —
+    NICs can share a subnet-level NSG, where a reused priority fails
+    (FakeAz models the shared-NSG worst case)."""
+    config = az_instance.bootstrap_instances(_config(count=3))
+    az_instance.run_instances(config)
+    az_instance.open_ports('az-c', ['8080'], 'eastus', None)
+    prios = [r['priority'] for r in az.nsg_rules]
+    assert len(prios) == len(set(prios)) == 3
+
+
 def test_spot_priority(az):
     config = az_instance.bootstrap_instances(_config(use_spot=True))
     az_instance.run_instances(config)

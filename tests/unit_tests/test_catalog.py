@@ -38,6 +38,49 @@ def test_default_instance_type():
     assert vcpus == 4
 
 
+def test_gpu_sku_selection():
+    """The widened catalogs carry GPU SKUs with accelerator columns;
+    lookups pick the cheapest exact (name, count) match and defaults
+    never land on a GPU box."""
+    assert catalog.get_instance_type_for_accelerator(
+        'A100', 8, cloud='aws') == 'p4d.24xlarge'
+    assert catalog.get_instance_type_for_accelerator(
+        'H100', 8, cloud='azure') == 'Standard_ND96isr_H100_v5'
+    assert catalog.get_instance_type_for_accelerator(
+        'A100', 8, cloud='gcp') == 'a2-highgpu-8g'
+    # Case-insensitive; exact-count only (no silent 4x when 8x asked).
+    assert catalog.get_instance_type_for_accelerator(
+        'a100', 1, cloud='gcp') == 'a2-highgpu-1g'
+    assert catalog.get_instance_type_for_accelerator(
+        'A100', 3, cloud='gcp') is None
+    # A plain CPU ask never lands on (and bills for) a GPU shape.
+    for cloud in ('aws', 'azure', 'gcp'):
+        t = catalog.get_default_instance_type(cpus='96+', cloud=cloud)
+        offs = catalog.get_instance_offerings(t, cloud=cloud)
+        assert offs and offs[0].accelerator_count == 0, (cloud, t)
+
+
+def test_gpu_cross_cloud_arbitration(enable_all_clouds, monkeypatch):
+    """Optimizer feasibility over the widened catalog: an 8x A100 ask
+    is priced across the majors and the cheapest cloud wins."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import optimizer as opt_lib
+    from skypilot_tpu.clouds import AWS, Azure, GCP
+    monkeypatch.setattr(
+        check_lib, 'get_cached_enabled_clouds',
+        lambda *a, **k: [GCP(), AWS(), Azure()])
+    with sky.Dag() as dag:
+        t = sky.Task('gpu', run='nvidia-smi')
+        t.set_resources(sky.Resources(accelerators='A100:8'))
+    opt_lib.Optimizer.optimize(dag, quiet=True)
+    best = dag.tasks[0].best_resources
+    # Azure ND96asr ($27.20) < GCP a2-highgpu-8g ($29.39) < AWS p4d
+    # ($32.77).
+    assert best.cloud.canonical_name() == 'azure'
+    assert best.instance_type == 'Standard_ND96asr_v4'
+
+
 def test_validate_region_zone():
     catalog.validate_region_zone('us-central1', 'us-central1-a')
     with pytest.raises(Exception):

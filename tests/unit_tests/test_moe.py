@@ -92,6 +92,96 @@ def test_expert_parallel_matches_single_device():
     assert 'tp' in sharding.spec
 
 
+def _layer0(params):
+    return jax.tree.map(lambda a: a[0], params['layers'])
+
+
+def test_sorted_and_dense_dispatch_agree():
+    """The sorted gather/scatter dispatch reproduces the dense
+    combine-tensor dispatch exactly (same slot-major fill => same
+    drops), up to float summation order."""
+    key = jax.random.PRNGKey(0)
+    cfg_s = models.MoEConfig.tiny_moe(dispatch='sorted')
+    cfg_d = models.MoEConfig.tiny_moe(dispatch='dense')
+    params = moe.init_params(cfg_s, key)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg_s.vocab_size)
+    xs, aux_s = moe.forward_hidden(params, tokens, cfg_s)
+    xd, aux_d = moe.forward_hidden(params, tokens, cfg_d)
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(xd),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(float(aux_s), float(aux_d), rtol=1e-5)
+
+
+def test_sorted_dispatch_drops_match_dense_under_pressure():
+    """Under a tight capacity factor both dispatches drop the SAME
+    assignments (slot-major fill order parity)."""
+    cfg = models.MoEConfig.tiny_moe(capacity_factor=0.5)
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    lp = _layer0(params)
+    h = jax.random.normal(jax.random.PRNGKey(2), (2, 24, cfg.dim),
+                          jnp.float32)
+    ys, _ = moe._moe_sorted(h.reshape(-1, cfg.dim), lp, cfg,
+                            moe._capacity(cfg, 48))
+    yd, _ = moe._moe_dense(h.reshape(-1, cfg.dim), lp, cfg)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(yd),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_capacity_infer_matches_dropless():
+    """At the auto capacity factor (E/k => C = T) the capacity-gather
+    serving dispatch is exactly dropless."""
+    cfg = models.MoEConfig.tiny_moe()
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    lp = _layer0(params)
+    h = jax.random.normal(jax.random.PRNGKey(3), (2, 16, cfg.dim),
+                          jnp.float32)
+    y_drop = moe.moe_block_dropless(h, lp, cfg)
+    y_cap = moe.moe_block_capacity(h, lp, cfg)
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_drop),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_generate_capacity_dispatch_matches_dropless():
+    import dataclasses
+
+    from skypilot_tpu.models import inference
+    cfg = models.MoEConfig.tiny_moe()
+    cfg_cap = dataclasses.replace(cfg, infer_dispatch='capacity')
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 9), 0,
+                                cfg.vocab_size).astype(jnp.int32)
+    lengths = jnp.full((2,), 9, jnp.int32)
+    want = inference.generate(params, tokens, lengths, cfg, max_new=6)
+    got = inference.generate(params, tokens, lengths, cfg_cap,
+                             max_new=6)
+    agree = (np.asarray(got) == np.asarray(want)).mean()
+    assert agree >= 0.9, agree
+
+
+@pytest.mark.slow
+def test_expert_parallel_ep_axis_matches_single_device():
+    """ep=2 mesh: experts shard over the dedicated 'ep' axis, the
+    dense all-to-all dispatch runs, and the loss matches
+    single-device training."""
+    cfg = models.MoEConfig.tiny_moe(remat=False)
+    batch = {'tokens': jax.random.randint(jax.random.PRNGKey(6),
+                                          (4, 33), 0, cfg.vocab_size)}
+    state1, opt1 = models.init_train_state(cfg, jax.random.PRNGKey(0))
+    step1 = models.make_train_step(cfg, opt1)
+    _, m1 = step1(state1, batch)
+
+    mesh = make_mesh(dp=2, fsdp=2, ep=2)
+    state2, opt2 = models.init_train_state(cfg, jax.random.PRNGKey(0),
+                                           mesh)
+    step2 = models.make_train_step(cfg, opt2, mesh)
+    _, m2 = step2(state2, models.shard_batch(batch, mesh))
+    np.testing.assert_allclose(float(m1['loss']), float(m2['loss']),
+                               rtol=1e-4)
+    sharding = state2.params['layers']['w_gate'].sharding
+    assert 'ep' in sharding.spec
+
+
 @pytest.mark.slow
 def test_capacity_drops_overflow_tokens():
     """A tiny capacity factor forces drops; forward stays finite and
@@ -102,3 +192,20 @@ def test_capacity_drops_overflow_tokens():
                                 cfg.vocab_size)
     logits = moe.forward(params, tokens, cfg)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_capacity_dispatch_scales_to_e64():
+    """The capacity-gather serving dispatch at DeepSeek/DBRX expert
+    counts (E=64, top-4): still exactly dropless at the auto capacity
+    factor, while computing C*E = T*k slots instead of the all-experts
+    loop's T*E (16x less expert compute at this shape)."""
+    cfg = models.MoEConfig.tiny_moe(n_experts=64, top_k=4,
+                                    ffn_dim=32)
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    lp = jax.tree.map(lambda a: a[0], params['layers'])
+    h = jax.random.normal(jax.random.PRNGKey(7), (2, 32, cfg.dim),
+                          jnp.float32)
+    y_cap = moe.moe_block_capacity(h, lp, cfg)
+    y_drop = moe.moe_block_dropless(h, lp, cfg)
+    np.testing.assert_allclose(np.asarray(y_cap), np.asarray(y_drop),
+                               atol=2e-4, rtol=2e-4)

@@ -1,0 +1,446 @@
+"""REST neoclouds (RunPod GraphQL, FluidStack REST, Nebius REST/IAM):
+nine-op lifecycle against fake HTTP transports, error taxonomy,
+catalog feasibility, and optimizer cross-cloud failover — proving
+docs/clouds.md's "adding a cloud is mechanical" claim with three
+plugins built from the Lambda template (clouds/neocloud.py)."""
+import json
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.fluidstack import api as fs_api
+from skypilot_tpu.provision.fluidstack import instance as fs
+from skypilot_tpu.provision.nebius import api as neb_api
+from skypilot_tpu.provision.nebius import instance as neb
+from skypilot_tpu.provision.runpod import api as rp_api
+from skypilot_tpu.provision.runpod import instance as rp
+
+
+class _Resp:
+
+    def __init__(self, status_code, body):
+        self.status_code = status_code
+        self._body = body
+        self.text = json.dumps(body)
+
+    def json(self):
+        return self._body
+
+
+# ---------------------------------------------------------------- RunPod
+
+
+class FakeRunPodHttp:
+    """Plays api.runpod.io/graphql."""
+
+    def __init__(self):
+        self.pods = {}              # id -> dict
+        self.deploy_error = None
+        self._n = 0
+
+    def request(self, method, url, json=None, headers=None,
+                timeout=None):
+        assert headers['Authorization'].startswith('Bearer ')
+        q = json['query']
+        if 'myself { pods' in q:
+            return _Resp(200, {'data': {'myself': {
+                'pods': [dict(p) for p in self.pods.values()]}}})
+        if 'podFindAndDeployOnDemand' in q:
+            if self.deploy_error is not None:
+                return _Resp(200, {'errors': [
+                    {'message': self.deploy_error}]})
+            self._n += 1
+            pid = f'rp-{self._n:04d}'
+            name = q.split('name: "', 1)[1].split('"', 1)[0]
+            self.pods[pid] = {
+                'id': pid, 'name': name, 'desiredStatus': 'RUNNING',
+                'costPerHr': 1.89,
+                'runtime': {'ports': [
+                    {'ip': f'38.0.0.{self._n}', 'isIpPublic': True,
+                     'privatePort': 22, 'publicPort': 10022},
+                    {'ip': f'10.1.0.{self._n}', 'isIpPublic': False,
+                     'privatePort': 22, 'publicPort': 22},
+                ]},
+                'machine': {'gpuDisplayName': 'A100-80GB'},
+                'dataCenterId': 'US-TX-3',
+            }
+            return _Resp(200, {'data': {
+                'podFindAndDeployOnDemand': {'id': pid}}})
+        if 'podStop' in q:
+            pid = q.split('podId: "', 1)[1].split('"', 1)[0]
+            self.pods[pid]['desiredStatus'] = 'EXITED'
+            return _Resp(200, {'data': {'podStop': {'id': pid}}})
+        if 'podResume' in q:
+            pid = q.split('podId: "', 1)[1].split('"', 1)[0]
+            self.pods[pid]['desiredStatus'] = 'RUNNING'
+            return _Resp(200, {'data': {'podResume': {'id': pid}}})
+        if 'podTerminate' in q:
+            pid = q.split('podId: "', 1)[1].split('"', 1)[0]
+            self.pods.pop(pid, None)
+            return _Resp(200, {'data': {'podTerminate': None}})
+        raise AssertionError(q)
+
+
+@pytest.fixture
+def rp_http(monkeypatch):
+    fake = FakeRunPodHttp()
+    monkeypatch.setattr(rp_api, 'session_factory', lambda: fake)
+    monkeypatch.setenv('RUNPOD_API_KEY', 'rp-key')
+    monkeypatch.setattr(rp, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _rp_config(count=1):
+    return common.ProvisionConfig(
+        provider_name='runpod',
+        cluster_name='rpc',
+        cluster_name_on_cloud='rpc',
+        region='US-TX-3',
+        zone=None,
+        node_config={'instance_type': '1x_A100-80GB_SECURE',
+                     'ssh_public_key': 'ssh-ed25519 AAAA test',
+                     'disk_size': 100, 'labels': {}},
+        count=count,
+    )
+
+
+def test_runpod_lifecycle(rp_http):
+    record = rp.run_instances(_rp_config(count=2))
+    assert record.head_instance_id == 'rpc-0'
+    assert len(record.created_instance_ids) == 2
+
+    rp.wait_instances('rpc', 'US-TX-3', None, None)
+    assert rp.query_instances('rpc', 'US-TX-3', None) == {
+        'rpc-0': 'running', 'rpc-1': 'running'}
+
+    # Idempotent rerun.
+    assert rp.run_instances(_rp_config(count=2)).created_instance_ids \
+        == []
+
+    info = rp.get_cluster_info('rpc', 'US-TX-3', None)
+    assert info.head_instance_id == 'rpc-0'
+    assert info.ssh_user == 'root'
+    head = info.instances['rpc-0'][0]
+    assert head.external_ip.startswith('38.')
+    assert head.internal_ip.startswith('10.1.')
+
+    # Stop -> stopped -> run_instances resumes (RunPod CAN stop).
+    rp.stop_instances('rpc', 'US-TX-3', None)
+    assert set(rp.query_instances('rpc', 'US-TX-3', None).values()) \
+        == {'stopped'}
+    record = rp.run_instances(_rp_config(count=2))
+    assert len(record.resumed_instance_ids) == 2
+    assert record.created_instance_ids == []
+
+    rp.terminate_instances('rpc', 'US-TX-3', None)
+    rp.wait_instances('rpc', 'US-TX-3', None, 'terminated')
+    assert rp.query_instances('rpc', 'US-TX-3', None) == {}
+
+    rp.open_ports('rpc', ['8080'], 'US-TX-3', None)   # no-op
+    rp.cleanup_ports('rpc', 'US-TX-3', None)
+
+
+def test_runpod_error_taxonomy(rp_http):
+    rp_http.deploy_error = ('There are no longer any instances '
+                            'available with the requested GPU.')
+    with pytest.raises(exceptions.StockoutError):
+        rp.run_instances(_rp_config())
+    rp_http.deploy_error = 'Spend limit exceeded for this account.'
+    with pytest.raises(exceptions.QuotaExceededError):
+        rp.run_instances(_rp_config())
+
+
+# ------------------------------------------------------------ FluidStack
+
+
+class FakeFluidstackHttp:
+    """Plays platform.fluidstack.io."""
+
+    def __init__(self):
+        self.instances = {}
+        self.ssh_keys = []
+        self.create_error = None
+        self._n = 0
+
+    def request(self, method, url, json=None, headers=None,
+                timeout=None):
+        assert headers['api-key'] == 'fs-key'
+        path = url.split('fluidstack.io', 1)[1]
+        if method == 'GET' and path == '/instances':
+            return _Resp(200, list(self.instances.values()))
+        if method == 'GET' and path == '/ssh_keys':
+            return _Resp(200, list(self.ssh_keys))
+        if method == 'POST' and path == '/ssh_keys':
+            self.ssh_keys.append(dict(json))
+            return _Resp(200, {})
+        if method == 'POST' and path == '/instances':
+            if self.create_error is not None:
+                return _Resp(400, {'message': self.create_error})
+            self._n += 1
+            iid = f'fs-{self._n:04d}'
+            self.instances[iid] = {
+                'id': iid, 'name': json['name'], 'status': 'running',
+                'region': json['region'],
+                'ip_address': f'93.0.0.{self._n}',
+                'private_ip': f'10.2.0.{self._n}',
+            }
+            return _Resp(200, {'id': iid})
+        if method == 'POST' and path.endswith('/stop'):
+            iid = path.split('/')[2]
+            self.instances[iid]['status'] = 'stopped'
+            return _Resp(200, {})
+        if method == 'POST' and path.endswith('/start'):
+            iid = path.split('/')[2]
+            self.instances[iid]['status'] = 'running'
+            return _Resp(200, {})
+        if method == 'DELETE':
+            iid = path.split('/')[2]
+            self.instances[iid]['status'] = 'terminated'
+            return _Resp(200, {})
+        raise AssertionError((method, path))
+
+
+@pytest.fixture
+def fs_http(monkeypatch):
+    fake = FakeFluidstackHttp()
+    monkeypatch.setattr(fs_api, 'session_factory', lambda: fake)
+    monkeypatch.setenv('FLUIDSTACK_API_KEY', 'fs-key')
+    monkeypatch.setattr(fs, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _fs_config(count=1):
+    return common.ProvisionConfig(
+        provider_name='fluidstack',
+        cluster_name='fsc',
+        cluster_name_on_cloud='fsc',
+        region='norway_4_eu',
+        zone=None,
+        node_config={'instance_type': '1x_A100_PCIE',
+                     'ssh_public_key': 'ssh-ed25519 AAAA test',
+                     'labels': {}},
+        count=count,
+    )
+
+
+def test_fluidstack_lifecycle(fs_http):
+    record = fs.run_instances(_fs_config(count=2))
+    assert record.head_instance_id == 'fsc-0'
+    assert len(record.created_instance_ids) == 2
+    assert len(fs_http.ssh_keys) == 1
+
+    fs.wait_instances('fsc', 'norway_4_eu', None, None)
+    assert fs.query_instances('fsc', 'norway_4_eu', None) == {
+        'fsc-0': 'running', 'fsc-1': 'running'}
+    assert fs.run_instances(_fs_config(count=2)).created_instance_ids \
+        == []
+
+    info = fs.get_cluster_info('fsc', 'norway_4_eu', None)
+    assert info.ssh_user == 'ubuntu'
+    assert info.instances['fsc-0'][0].external_ip.startswith('93.')
+
+    fs.stop_instances('fsc', 'norway_4_eu', None)
+    assert set(fs.query_instances('fsc', 'norway_4_eu',
+                                  None).values()) == {'stopped'}
+    record = fs.run_instances(_fs_config(count=2))
+    assert len(record.resumed_instance_ids) == 2
+
+    fs.terminate_instances('fsc', 'norway_4_eu', None)
+    fs.wait_instances('fsc', 'norway_4_eu', None, 'terminated')
+    assert fs.query_instances('fsc', 'norway_4_eu', None) == {}
+
+
+def test_fluidstack_error_taxonomy(fs_http):
+    fs_http.create_error = 'Insufficient capacity in norway_4_eu.'
+    with pytest.raises(exceptions.StockoutError):
+        fs.run_instances(_fs_config())
+    fs_http.create_error = 'Instance limit reached for your account.'
+    with pytest.raises(exceptions.QuotaExceededError):
+        fs.run_instances(_fs_config())
+
+
+# --------------------------------------------------------------- Nebius
+
+
+class FakeNebiusHttp:
+    """Plays compute.api.nebius.cloud/v1."""
+
+    def __init__(self):
+        self.instances = {}
+        self.create_error = None    # (code, message)
+        self._n = 0
+
+    def request(self, method, url, json=None, headers=None,
+                timeout=None):
+        assert headers['Authorization'] == 'Bearer neb-token'
+        path = url.split('/v1', 1)[1]
+        if method == 'GET' and path == '/instances':
+            return _Resp(200,
+                         {'items': list(self.instances.values())})
+        if method == 'POST' and path == '/instances':
+            if self.create_error is not None:
+                code, msg = self.create_error
+                return _Resp(429, {'code': code, 'message': msg})
+            self._n += 1
+            iid = f'neb-{self._n:04d}'
+            self.instances[iid] = {
+                'id': iid, 'name': json['name'], 'status': 'RUNNING',
+                'public_ipv4': f'51.0.0.{self._n}',
+                'private_ipv4': f'10.3.0.{self._n}',
+            }
+            return _Resp(200, {'id': iid})
+        if method == 'POST' and path.endswith(':stop'):
+            iid = path.split('/')[2].split(':')[0]
+            self.instances[iid]['status'] = 'STOPPED'
+            return _Resp(200, {})
+        if method == 'POST' and path.endswith(':start'):
+            iid = path.split('/')[2].split(':')[0]
+            self.instances[iid]['status'] = 'RUNNING'
+            return _Resp(200, {})
+        if method == 'DELETE':
+            iid = path.split('/')[2]
+            self.instances[iid]['status'] = 'DELETED'
+            return _Resp(200, {})
+        raise AssertionError((method, path))
+
+
+@pytest.fixture
+def neb_http(monkeypatch):
+    fake = FakeNebiusHttp()
+    monkeypatch.setattr(neb_api, 'session_factory', lambda: fake)
+    monkeypatch.setenv('NEBIUS_IAM_TOKEN', 'neb-token')
+    monkeypatch.setattr(neb, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _neb_config(count=1):
+    return common.ProvisionConfig(
+        provider_name='nebius',
+        cluster_name='nbc',
+        cluster_name_on_cloud='nbc',
+        region='eu-north1',
+        zone=None,
+        node_config={
+            'instance_type': 'gpu-h100_8gpu-160vcpu-1600gb',
+            'ssh_public_key': 'ssh-ed25519 AAAA test', 'labels': {}},
+        count=count,
+    )
+
+
+def test_nebius_lifecycle(neb_http):
+    record = neb.run_instances(_neb_config(count=2))
+    assert record.head_instance_id == 'nbc-0'
+    assert len(record.created_instance_ids) == 2
+
+    neb.wait_instances('nbc', 'eu-north1', None, None)
+    assert neb.query_instances('nbc', 'eu-north1', None) == {
+        'nbc-0': 'running', 'nbc-1': 'running'}
+    assert neb.run_instances(
+        _neb_config(count=2)).created_instance_ids == []
+
+    info = neb.get_cluster_info('nbc', 'eu-north1', None)
+    assert info.instances['nbc-0'][0].internal_ip.startswith('10.3.')
+
+    neb.stop_instances('nbc', 'eu-north1', None)
+    assert set(neb.query_instances('nbc', 'eu-north1',
+                                   None).values()) == {'stopped'}
+    record = neb.run_instances(_neb_config(count=2))
+    assert len(record.resumed_instance_ids) == 2
+
+    neb.terminate_instances('nbc', 'eu-north1', None)
+    neb.wait_instances('nbc', 'eu-north1', None, 'terminated')
+    assert neb.query_instances('nbc', 'eu-north1', None) == {}
+
+
+def test_nebius_error_taxonomy(neb_http):
+    neb_http.create_error = ('RESOURCE_EXHAUSTED',
+                             'No H100 capacity in eu-north1.')
+    with pytest.raises(exceptions.StockoutError):
+        neb.run_instances(_neb_config())
+    neb_http.create_error = ('QUOTA_EXCEEDED',
+                             'gpu.count quota exceeded.')
+    with pytest.raises(exceptions.QuotaExceededError):
+        neb.run_instances(_neb_config())
+
+
+# --------------------------------------------------- clouds + optimizer
+
+
+def test_cloud_feasibility_and_registry(rp_http, fs_http, neb_http):
+    from skypilot_tpu.clouds import Fluidstack, Nebius, RunPod
+    from skypilot_tpu.clouds.cloud import CloudImplementationFeatures
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+    for cls, name, itype, price in (
+            (RunPod, 'runpod', '1x_A100-80GB_SECURE', 1.89),
+            (Fluidstack, 'fluidstack', '1x_A100_PCIE', 1.29),
+            (Nebius, 'nebius', 'gpu-h100_1gpu-20vcpu-200gb', 2.95)):
+        cloud = cls()
+        assert cloud.canonical_name() == name
+        assert CLOUD_REGISTRY.from_str(name) is cls
+        ok, _ = cloud.check_credentials()
+        assert ok, name
+        feas = cloud.get_feasible_launchable_resources(
+            Resources(instance_type=itype))
+        assert feas and feas[0].instance_type == itype
+        assert cloud.hourly_price(feas[0]) == price
+        # No TPUs, no spot on any of the three.
+        assert cloud.get_feasible_launchable_resources(
+            Resources(accelerators='tpu-v5e-8')) == []
+        assert cloud.get_feasible_launchable_resources(
+            Resources(instance_type=itype, use_spot=True)) == []
+        caps = cloud.unsupported_features_for_resources(feas[0])
+        assert CloudImplementationFeatures.SPOT_INSTANCE in caps
+        # All three CAN stop (unlike Lambda).
+        assert CloudImplementationFeatures.STOP not in caps
+
+    # Accelerator-shaped requests map onto catalog instance types.
+    assert RunPod().get_feasible_launchable_resources(
+        Resources(accelerators='A100-80GB:8'))[0].instance_type == \
+        '8x_A100-80GB_SECURE'
+    assert Fluidstack().get_feasible_launchable_resources(
+        Resources(accelerators={'H100_SXM5': 8}))[0].instance_type == \
+        '8x_H100_SXM5'
+    assert Nebius().get_feasible_launchable_resources(
+        Resources(accelerators='H100:8'))[0].instance_type == \
+        'gpu-h100_8gpu-160vcpu-1600gb'
+
+
+def test_optimizer_failover_includes_neocloud(rp_http, fs_http,
+                                              neb_http, monkeypatch):
+    """Cross-cloud arbitration: with the neoclouds enabled, a GPU-8x
+    H100 ask is priced across them and the cheapest wins; blocking the
+    winner fails over to the next."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu import optimizer as opt_lib
+    from skypilot_tpu.clouds import Fluidstack, Nebius, RunPod
+
+    monkeypatch.setattr(
+        check_lib, 'get_cached_enabled_clouds',
+        lambda *a, **k: [RunPod(), Fluidstack(), Nebius()])
+
+    def best_for(blocked=()):
+        with sky.Dag() as dag:
+            t = sky.Task('gpu', run='nvidia-smi')
+            t.set_resources(sky.Resources(accelerators='H100:8'))
+        dag = opt_lib.Optimizer.optimize(dag, blocked_resources=list(
+            blocked))
+        return dag.tasks[0].best_resources
+
+    best = best_for()
+    # Nebius 23.60 < FluidStack 23.92 == RunPod 23.92: Nebius wins.
+    assert best.cloud.canonical_name() == 'nebius'
+    assert best.region == 'eu-north1'
+    # Block the winning region: failover stays on Nebius but moves to
+    # its other region (per-region blocking granularity, matching the
+    # reference's failover semantics).
+    best2 = best_for(blocked=[best])
+    assert best2.cloud.canonical_name() == 'nebius'
+    assert best2.region == 'eu-west1'
+    # Block BOTH Nebius regions: arbitration falls over to the
+    # next-cheapest neocloud.
+    best3 = best_for(blocked=[best, best2])
+    assert best3.cloud.canonical_name() in ('fluidstack', 'runpod')

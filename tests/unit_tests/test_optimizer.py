@@ -89,6 +89,38 @@ def test_time_target_prefers_bigger_slice():
     assert t.best_resources.tpu.num_chips == 8
 
 
+def test_time_target_knows_generations():
+    """TIME optimization is informed by measured per-chip throughput
+    (bench-anchored, optimizer._tokens_per_sec_per_chip): at equal
+    chip count a v6e chip does ~4.7x a v5e chip's work, so v6e-8 wins
+    TIME even though v5e-8 is cheaper — and COST still picks v5e."""
+    import skypilot_tpu.optimizer as opt
+    t = Task('t', run='true')
+    t.estimate_runtime = 3600.0  # seconds on the v5e-8 reference
+    with Dag() as dag:
+        pass
+    dag.add(t)
+    t.set_resources({
+        Resources(accelerators='tpu-v5e-8'),
+        Resources(accelerators='tpu-v6e-8'),
+    })
+    Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+    assert t.best_resources.tpu.generation == 'v6e'
+    # The estimate itself reflects the peak ratio (918/197 ~ 4.66x).
+    est = opt._runtime_seconds(t, t.best_resources)
+    assert est == pytest.approx(3600.0 * 197.0 / 918.0, rel=1e-3)
+    # COST with a known runtime: v6e finishes the JOB cheaper
+    # ($21.6/h x 0.21h < $9.6/h x 1h) — per-job economics, not
+    # per-hour sticker price.
+    Optimizer.optimize(dag, minimize=OptimizeTarget.COST, quiet=True)
+    assert t.best_resources.tpu.generation == 'v6e'
+    # Without a runtime estimate there is nothing to rescale: COST
+    # falls back to hourly price and picks the cheaper v5e.
+    t.estimate_runtime = None
+    Optimizer.optimize(dag, minimize=OptimizeTarget.COST, quiet=True)
+    assert t.best_resources.tpu.generation == 'v5e'
+
+
 def test_infeasible_raises():
     dag = _single_task_dag(
         {Resources(cloud='gcp', accelerators='tpu-v4-8',

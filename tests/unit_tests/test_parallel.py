@@ -23,7 +23,7 @@ def test_plan_mesh_inference():
 def test_make_mesh_axes():
     mesh = make_mesh(tp=2, sp=2)
     assert mesh.shape == {'dp': 1, 'fsdp': 2, 'sp': 2, 'tp': 2,
-                          'pp': 1}
+                          'ep': 1, 'pp': 1}
     assert mesh.devices.size == 8
 
 

@@ -185,6 +185,61 @@ def test_r2_requires_account_id(monkeypatch, tmp_path):
         R2Store.endpoint()
 
 
+def test_ibm_cos_store_commands(monkeypatch):
+    from skypilot_tpu.data.storage import IbmCosStore, StoreType
+    monkeypatch.setenv('IBM_COS_REGION', 'eu-de')
+    s = IbmCosStore('mybkt')
+    assert s.endpoint() == ('https://s3.eu-de'
+                            '.cloud-object-storage.appdomain.cloud')
+    assert s.url() == 's3://mybkt'
+    assert s.display_url() == 'cos://eu-de/mybkt'
+    d = s.download_command('/dst')
+    assert '--endpoint-url https://s3.eu-de' in d
+    assert '--profile ibm' in d
+    m = s.mount_command('/mnt/cos')
+    assert 'rclone mount ibm:mybkt /mnt/cos' in m
+    assert 'RCLONE_CONFIG_IBM_PROVIDER=IBMCOS' in m
+    assert StoreType.IBM is not None
+
+
+def test_oci_store_commands(monkeypatch):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.data.storage import OciStore
+    monkeypatch.setenv('OCI_NAMESPACE', 'mytenant')
+    monkeypatch.setenv('OCI_REGION', 'us-ashburn-1')
+    s = OciStore('mybkt')
+    assert s.endpoint() == ('https://mytenant.compat.objectstorage'
+                            '.us-ashburn-1.oraclecloud.com')
+    assert s.display_url() == 'oci://mybkt'
+    d = s.download_command('/dst')
+    assert '--profile oci' in d and 'compat.objectstorage' in d
+    m = s.mount_command('/mnt/oci')
+    assert 'goofys' in m and 'mybkt /mnt/oci' in m
+    # Missing namespace is a typed error, not a KeyError.
+    monkeypatch.delenv('OCI_NAMESPACE')
+    monkeypatch.setattr(OciStore, 'NAMESPACE_PATH', '/nonexistent')
+    with pytest.raises(exceptions.StorageError):
+        OciStore.endpoint()
+
+
+def test_cloud_stores_cos_oci_urls(monkeypatch):
+    from skypilot_tpu.data import cloud_stores
+    monkeypatch.setenv('IBM_COS_REGION', 'us-south')
+    monkeypatch.setenv('OCI_NAMESPACE', 'ns1')
+    monkeypatch.setenv('OCI_REGION', 'us-phoenix-1')
+    assert cloud_stores.is_cloud_url('cos://us-south/bkt/data/')
+    assert cloud_stores.is_cloud_url('oci://bkt/ckpt.bin')
+    d = cloud_stores.download_command('cos://us-south/bkt/f.bin',
+                                      '/dst/f.bin')
+    assert 's3://bkt/f.bin' in d and '--profile ibm' in d
+    d2 = cloud_stores.download_command('oci://bkt/ckpt.bin',
+                                       '/dst/ckpt.bin')
+    assert 's3://bkt/ckpt.bin' in d2 and '--profile oci' in d2
+    # Directory form routes through the store's download_command.
+    d3 = cloud_stores.download_command('oci://bkt/dir/', '/dst')
+    assert 's3 sync' in d3 or 's3 cp' in d3
+
+
 def test_azure_store_commands(monkeypatch):
     from skypilot_tpu.data.storage import AzureBlobStore
     monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'myacct')
@@ -286,3 +341,44 @@ def test_r2_rclone_mount_tool(monkeypatch):
     assert '--vfs-cache-mode writes' in m
     monkeypatch.delenv('SKYTPU_R2_MOUNT_TOOL')
     assert 'goofys' in R2Store('mybkt').mount_command('/mnt/r2')
+
+
+def test_same_provider_transfer_is_server_side(monkeypatch):
+    """S3-family same-endpoint pairs transfer bucket-to-bucket with
+    ONE server-side sync command — object bytes never stage through
+    the host (the TB-scale path; the reference delegates this to
+    cloud-side transfer services)."""
+    from skypilot_tpu.data import data_transfer
+    from skypilot_tpu.data.storage import (AzureBlobStore, R2Store,
+                                           S3Store)
+    cmds = []
+    monkeypatch.setattr(data_transfer, '_run',
+                        lambda cmd: cmds.append(cmd))
+    data_transfer.transfer(S3Store('srcb'), S3Store('dstb'),
+                           verify=False)
+    assert cmds == ['aws s3 sync s3://srcb s3://dstb']
+    cmds.clear()
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct')
+    data_transfer.transfer(R2Store('a'), R2Store('b'), verify=False)
+    assert len(cmds) == 1 and 's3 sync' in cmds[0]
+    assert '--endpoint-url https://acct.r2' in cmds[0]
+    cmds.clear()
+    monkeypatch.setenv('AZURE_STORAGE_ACCOUNT', 'acct')
+    data_transfer.transfer(AzureBlobStore('c1'), AzureBlobStore('c2'),
+                           verify=False)
+    # start-batch enqueues async copies; a poll-until-settled command
+    # must follow before the transfer may be considered complete.
+    assert len(cmds) == 2 and 'copy start-batch' in cmds[0]
+    assert "copy.status=='pending'" in cmds[1]
+    # Mixed S3-family endpoints (S3 -> R2) must NOT claim the
+    # server-side path: different endpoints stage generically.
+    cmds.clear()
+    import skypilot_tpu.data.storage as st
+    monkeypatch.setattr(st.S3Store, 'download_command',
+                        lambda self, dst: f'fake-download {dst}')
+    monkeypatch.setattr(st.R2Store, 'upload',
+                        lambda self: cmds.append('staged-upload'),
+                        raising=False)
+    data_transfer.transfer(S3Store('srcb'), R2Store('b'),
+                           verify=False)
+    assert 'staged-upload' in cmds

@@ -49,6 +49,34 @@ def test_null_env_requires_value():
     assert t.envs['TOKEN'] == 'abc'
 
 
+def test_subschema_validation():
+    """service/storage/file_mounts sub-schemas reject malformed specs
+    with a jsonschema path, not a deep parser traceback."""
+    base = {'run': 'echo hi'}
+    bad = [
+        {'service': {'replica_port': 99999}},           # > 65535
+        {'service': {'load_balancing_policy': 'nope'}},
+        {'service': {'replica_policy': {'min_replicas': -1}}},
+        {'service': {'replica_policy': {'bogus_knob': 1}}},
+        {'storage_mounts': {'/data': {'store': 'ftp'}}},
+        {'storage_mounts': {'/data': {'mode': 'SYMLINK'}}},
+        {'storage_mounts': {'/data': {'unknown_key': 'x'}}},
+        {'file_mounts': {'/dst': {'not': 'a string'}}},
+    ]
+    for extra in bad:
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml_config({**base, **extra})
+    # The well-formed variants all pass.
+    Task.from_yaml_config({
+        **base,
+        'service': {'replicas': 2, 'replica_port': 8080,
+                    'load_balancing_policy': 'least_load'},
+        'storage_mounts': {'/data': {'name': 'b', 'store': 'gcs',
+                                     'mode': 'MOUNT'}},
+        'file_mounts': {'/dst': 'gs://bucket/path'},
+    })
+
+
 def test_dag_context_and_chain():
     with Dag() as dag:
         a = Task('a', run='echo a')

@@ -1,0 +1,75 @@
+"""Orchestrator timeline tracing (utils/timeline.py): env-gated
+Chrome-trace capture of launch/provision/exec hot paths + lock-wait
+events (reference sky/utils/timeline.py:22-121)."""
+import json
+import os
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core, exceptions
+from skypilot_tpu.utils import timeline
+
+
+@pytest.fixture
+def trace_file(tmp_path, monkeypatch):
+    path = tmp_path / 'trace.json'
+    monkeypatch.setenv('SKYTPU_TIMELINE_FILE_PATH', str(path))
+    yield path
+    timeline._events.clear()
+
+
+def test_event_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv('SKYTPU_TIMELINE_FILE_PATH', raising=False)
+    before = len(timeline._events)
+    with timeline.Event('nothing'):
+        pass
+    assert len(timeline._events) == before
+    assert not timeline.enabled()
+
+
+def test_decorator_and_lock_events_round_trip(trace_file):
+    from skypilot_tpu.backend import backend_utils
+
+    @timeline.event
+    def traced_fn():
+        return 42
+
+    assert traced_fn() == 42
+    with backend_utils.cluster_file_lock('timeline-test'):
+        pass
+    timeline.save_timeline()
+    payload = json.loads(trace_file.read_text())
+    names = [e['name'] for e in payload['traceEvents']]
+    assert '[event] ' \
+        'test_decorator_and_lock_events_round_trip.<locals>.traced_fn' \
+        in names
+    assert any(n.startswith('[lock.acquire]') for n in names)
+    # Balanced begin/end pairs.
+    phases = [e['ph'] for e in payload['traceEvents']]
+    assert phases.count('B') == phases.count('E')
+
+
+def test_local_launch_emits_well_formed_trace(trace_file):
+    """A real local-cloud launch leaves a Chrome trace covering the
+    provision/exec hot paths."""
+    task = sky.Task('traced', run='echo traced')
+    task.set_resources(sky.Resources(cloud='local'))
+    try:
+        sky.launch(task, cluster_name='timelinec', stream_logs=False)
+    finally:
+        try:
+            core.down('timelinec')
+        except exceptions.ClusterDoesNotExist:
+            pass
+    timeline.save_timeline()
+    payload = json.loads(trace_file.read_text())
+    events = payload['traceEvents']
+    assert events, 'launch emitted no timeline events'
+    for e in events:
+        assert {'name', 'ph', 'pid', 'tid', 'ts'} <= set(e)
+        assert e['ph'] in ('B', 'E')
+    names = ' '.join(e['name'] for e in events)
+    assert 'provision' in names
+    assert any(n.startswith('[lock.acquire]')
+               for n in (e['name'] for e in events))

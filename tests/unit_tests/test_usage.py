@@ -1,0 +1,127 @@
+"""Usage telemetry: schema-scrubbed local JSONL sink, remote
+collector batching, API-server heartbeat, and the opt-out env
+(reference sky/usage/usage_lib.py:341,467)."""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from skypilot_tpu.usage import usage_lib
+
+
+class _Collector:
+    """Tiny HTTP collector recording /usage and /heartbeat posts."""
+
+    def __init__(self):
+        self.usage = []
+        self.heartbeats = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+
+            def do_POST(self):
+                n = int(self.headers.get('Content-Length', 0))
+                body = json.loads(self.rfile.read(n))
+                if self.path == '/usage':
+                    outer.usage.append(body)
+                elif self.path == '/heartbeat':
+                    outer.heartbeats.append(body)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.server = HTTPServer(('127.0.0.1', 0), Handler)
+        self.url = f'http://127.0.0.1:{self.server.server_port}'
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+@pytest.fixture
+def collector(monkeypatch, tmp_path):
+    c = _Collector()
+    monkeypatch.setenv('SKYTPU_USAGE_COLLECTOR_URL', c.url)
+    monkeypatch.setenv('SKYTPU_DATA_DIR', str(tmp_path))
+    monkeypatch.delenv('SKYTPU_DISABLE_USAGE', raising=False)
+    usage_lib._pending.clear()
+    yield c
+    c.stop()
+    usage_lib._pending.clear()
+
+
+def test_local_sink_scrubs_fields(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_DATA_DIR', str(tmp_path))
+    monkeypatch.delenv('SKYTPU_USAGE_COLLECTOR_URL', raising=False)
+    usage_lib.record_event('launch', cloud='gcp', num_chips=8,
+                           secret_path='/home/me/key',   # not allowed
+                           status='ok')
+    with open(usage_lib.messages_path(), encoding='utf-8') as f:
+        event = json.loads(f.readlines()[-1])
+    assert event['op'] == 'launch'
+    assert event['cloud'] == 'gcp'
+    assert event['num_chips'] == 8
+    assert 'secret_path' not in event
+
+
+def test_remote_batch_flush(collector):
+    usage_lib.record_event('launch', cloud='gcp', num_chips=8)
+    usage_lib.record_event('down', cloud='gcp')
+    assert usage_lib.flush_remote()
+    assert len(collector.usage) == 1
+    batch = collector.usage[0]
+    assert batch['source']
+    ops = [e['op'] for e in batch['events']]
+    assert ops == ['launch', 'down']
+    # Whitelist holds on the wire too.
+    assert all('secret' not in json.dumps(e) for e in batch['events'])
+    # Nothing pending -> flush is a cheap no-op True.
+    assert usage_lib.flush_remote()
+    assert len(collector.usage) == 1
+
+
+def test_heartbeat_posts_liveness(collector):
+    assert usage_lib.heartbeat(op='api_server')
+    hb = collector.heartbeats[-1]
+    assert hb['source']
+    assert 'n_clusters' in hb
+    assert hb['op'] == 'api_server'
+
+
+def test_opt_out_disables_both_sinks(collector, monkeypatch,
+                                     tmp_path):
+    monkeypatch.setenv('SKYTPU_DISABLE_USAGE', '1')
+    usage_lib.record_event('launch', cloud='gcp')
+    assert not usage_lib.heartbeat()
+    assert not usage_lib.flush_remote()
+    assert collector.usage == []
+    assert collector.heartbeats == []
+
+
+def test_server_heartbeat_ctx(collector, monkeypatch):
+    """The API server beats on startup (fleet visibility for team
+    deployments)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from skypilot_tpu.server import server as server_mod
+
+    monkeypatch.setenv('SKYTPU_HEARTBEAT_INTERVAL', '3600')
+
+    async def run():
+        app = server_mod.make_app()
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.get('/api/health')
+            assert resp.status == 200
+            for _ in range(100):
+                if collector.heartbeats:
+                    break
+                await asyncio.sleep(0.05)
+    asyncio.run(run())
+    assert collector.heartbeats
+    assert collector.heartbeats[0]['op'] == 'api_server'
