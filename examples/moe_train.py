@@ -1,9 +1,11 @@
 """MoE (Mixtral-style) training recipe — expert parallelism on TPU.
 
 The reference serves Mixtral through vLLM YAMLs (llm/mixtral/); here
-the MoE family trains natively: top-k routed experts sharded over the
-'tp' mesh axis (expert parallelism), everything else identical to the
-dense llama_finetune recipe. Synthetic data; swap in a real loader.
+the MoE family trains natively: top-k routed experts shard over the
+dedicated 'ep' mesh axis (token dispatch rides an XLA all-to-all
+across it) while 'tp' Megatron-shards the attention and each expert's
+ffn — everything else identical to the dense llama_finetune recipe.
+Synthetic data; swap in a real loader.
 
 Single host:  python examples/moe_train.py --model tiny_moe --steps 20
 Pod slice:    launched via examples/moe_train.yaml (gang env contract
@@ -26,15 +28,17 @@ def main() -> None:
     parser.add_argument('--seq', type=int, default=128)
     parser.add_argument('--batch-per-host', type=int, default=4)
     parser.add_argument('--steps', type=int, default=20)
-    parser.add_argument('--tp', type=int, default=1,
+    parser.add_argument('--ep', type=int, default=1,
                         help='Expert-parallel degree (experts shard '
-                        'over tp).')
+                        "over the 'ep' mesh axis).")
+    parser.add_argument('--tp', type=int, default=1,
+                        help='Megatron degree inside each expert.')
     parser.add_argument('--lr', type=float, default=3e-4)
     args = parser.parse_args()
 
     initialize_from_env()
     cfg = getattr(models.MoEConfig, args.model)(max_seq=args.seq)
-    mesh = make_mesh(tp=args.tp)
+    mesh = make_mesh(ep=args.ep, tp=args.tp)
     global_batch = args.batch_per_host * jax.process_count()
 
     state, opt = models.init_train_state(cfg, jax.random.PRNGKey(0),
@@ -57,7 +61,7 @@ def main() -> None:
         tok_s = args.steps * global_batch * args.seq / dt
         print(f'{args.steps} steps, {tok_s:.0f} tokens/s '
               f'({cfg.n_experts} experts, top-{cfg.top_k}, '
-              f'ep={args.tp})')
+              f'ep={args.ep}, tp={args.tp})')
 
 
 if __name__ == '__main__':

@@ -342,12 +342,22 @@ def _build_engine(args) -> 'Any':
     else:
         logger.warning('No --checkpoint: serving randomly initialized '
                        'weights (benchmark / smoke mode).')
-        params = models.family(cfg).init_params(cfg,
-                                                jax.random.PRNGKey(0))
+        if getattr(args, 'weight_quant', False):
+            # Born-int8 tree: an 8B bf16 tree (16 GB) cannot
+            # materialize on a 16 GB chip, but its int8 form serves
+            # (models/quantization.py).
+            from skypilot_tpu.models import quantization
+            params = quantization.init_quantized_params(
+                cfg, jax.random.PRNGKey(0))
+        else:
+            params = models.family(cfg).init_params(
+                cfg, jax.random.PRNGKey(0))
     return ServingEngine(params, cfg, batch_size=args.batch,
                          max_prompt=args.max_prompt,
                          max_seq=args.max_seq,
                          kv_quant=args.kv_quant,
+                         weight_quant=getattr(args, 'weight_quant',
+                                              False),
                          decode_chunk=args.decode_chunk,
                          mesh=mesh)
 
@@ -363,6 +373,12 @@ def main() -> None:
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--decode-chunk', type=int, default=16)
     parser.add_argument('--kv-quant', action='store_true')
+    parser.add_argument('--weight-quant', action='store_true',
+                        help='int8 weight-only quantization: serve '
+                        '8B-class models on one 16 GB chip. With '
+                        '--checkpoint the bf16 tree loads then '
+                        'quantizes (must fit dense); without, a '
+                        'born-int8 random tree serves (bench mode).')
     parser.add_argument('--tp', type=int, default=1,
                         help='Tensor-parallel ways over local chips '
                         '(serve models larger than one chip).')

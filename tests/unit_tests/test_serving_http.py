@@ -204,3 +204,22 @@ def test_moe_model_serves_over_http():
     body = asyncio.run(scenario())
     server.stop()
     assert len(body['tokens']) == 5
+
+
+def test_weight_quant_flag_builds_quantized_engine():
+    """--weight-quant builds a born-int8 engine (the 8B-on-one-chip
+    serving path) whose params tree is quantized end to end."""
+    import argparse
+
+    from skypilot_tpu.models import quantization, serving_http
+
+    args = argparse.Namespace(model='tiny', max_seq=128,
+                              checkpoint=None, batch=2, max_prompt=32,
+                              decode_chunk=4, kv_quant=True,
+                              weight_quant=True, tp=1)
+    engine = serving_http._build_engine(args)
+    assert quantization.is_quantized(engine.params)
+    assert engine.params['layers']['wq']['q'].dtype.name == 'int8'
+    from skypilot_tpu.models.serving_engine import Request
+    results = engine.run([Request(0, [5, 3, 2], max_new=4)])
+    assert len(results[0].tokens) == 4
