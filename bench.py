@@ -196,7 +196,7 @@ def decode_bench():
         'BENCH_DECODE_WQUANT',
         '1' if model == 'llama3_8b' else '0') == '1'
     # 8B default batch 48: the measured 16 GB ceiling (56 OOMs);
-    # 2,455 tok/s vs 1,865 at batch 32.
+    # 2,523 tok/s vs 1,865 at batch 32.
     batch = int(os.environ.get(
         'BENCH_DECODE_BATCH',
         ('48' if model == 'llama3_8b' else
@@ -348,8 +348,19 @@ def serve_bench():
         # Decode region = 4x max_new: slots recycle ~4 requests per
         # cache round before a reset.
         max_seq = max_prompt + 4 * max_new
-        cfg = models.config_preset(model)(max_seq=max_seq,
-                                          param_dtype=jnp.bfloat16)
+        a8 = wquant and os.environ.get('BENCH_SERVE_A8') == '1'
+        cfg = models.config_preset(model)(
+            max_seq=max_seq, param_dtype=jnp.bfloat16,
+            # BENCH_SERVE_A8=1: int8 activations for the
+            # (MXU-bound, serving-dominating) prefill matmuls.
+            prefill_a8=a8)
+        if a8 and isinstance(cfg, models.MoEConfig):
+            # prefill_a8 only covers the dense family's matmuls; the
+            # MoE expert blocks would stay weight-only, making a
+            # 'W8A8' label a lie for the flop-dominant compute.
+            raise SystemExit(
+                'BENCH_SERVE_A8 is dense-family only (MoE expert '
+                'blocks do not take the int8-activation path).')
     n_params = _count_params(cfg)
 
     from skypilot_tpu.models import quantization
@@ -535,6 +546,11 @@ _ALL_MODES = {
     'serve': {'BENCH_MODE': 'serve'},
     'serve_8b': {'BENCH_MODE': 'serve',
                  'BENCH_SERVE_MODEL': 'llama3_8b'},
+    # W8A8 prefill variant (opt-in accuracy trade; quantization.
+    # qdot_a8): int8 activations for the MXU-bound prefill.
+    'serve_8b_a8': {'BENCH_MODE': 'serve',
+                    'BENCH_SERVE_MODEL': 'llama3_8b',
+                    'BENCH_SERVE_A8': '1'},
     'serve_stack': {'BENCH_MODE': 'serve_stack'},
 }
 

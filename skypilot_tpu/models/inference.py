@@ -43,7 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models.llama import (LlamaConfig, _attention,
                                        _rmsnorm, _rope, forward_hidden)
-from skypilot_tpu.models.quantization import qdot, qembed
+from skypilot_tpu.models.quantization import qdot, qdot_a8, qembed
 
 # Cache layout: [n_layers, B, max_seq, n_kv_heads, head_dim].
 CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
@@ -83,7 +83,8 @@ def _dequantize_kv(q: jax.Array, scale: jax.Array,
     return q.astype(dtype) * scale[..., None].astype(dtype)
 
 
-def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig) -> jax.Array:
+def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig,
+               dot=qdot) -> jax.Array:
     """The residual-branch MLP output for one layer, by model family:
     dense SwiGLU for LlamaConfig; for MoEConfig, DROPLESS exact top-k
     expert mixing (moe.moe_block_dropless) — training's capacity
@@ -108,9 +109,9 @@ def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig) -> jax.Array:
             # exact top-k mixing, right for small E.
             y = moe.moe_block_dropless(h3, lp, cfg)
         return y if h.ndim == 3 else y[:, 0]
-    gate = jax.nn.silu(qdot(h, lp['w_gate'], cdt))
-    up = qdot(h, lp['w_up'], cdt)
-    return qdot(gate * up, lp['w_down'], cdt)
+    gate = jax.nn.silu(dot(h, lp['w_gate'], cdt))
+    up = dot(h, lp['w_up'], cdt)
+    return dot(gate * up, lp['w_down'], cdt)
 
 
 # Cache slot layout (the key to fast TPU decode): prompts occupy
@@ -217,15 +218,19 @@ def prefill(params: Dict,
 
     x = qembed(params['tok_emb'], tokens, cdt)
     x = _constrain(x, P(('dp', 'fsdp'), None, None), mesh)
+    # Prefill is MXU-bound: with int8 weights, cfg.prefill_a8 also
+    # quantizes activations per token so the matmuls run on the int8
+    # MXU path (quantization.qdot_a8). Decode never does this.
+    dot = qdot_a8 if cfg.prefill_a8 else qdot
 
     def layer(x, lp):
         h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
-        q = qdot(h, lp['wq'], cdt).reshape(b, s, cfg.n_heads,
-                                           cfg.head_dim)
-        k = qdot(h, lp['wk'], cdt).reshape(b, s, cfg.n_kv_heads,
-                                           cfg.head_dim)
-        v = qdot(h, lp['wv'], cdt).reshape(b, s, cfg.n_kv_heads,
-                                           cfg.head_dim)
+        q = dot(h, lp['wq'], cdt).reshape(b, s, cfg.n_heads,
+                                          cfg.head_dim)
+        k = dot(h, lp['wk'], cdt).reshape(b, s, cfg.n_kv_heads,
+                                          cfg.head_dim)
+        v = dot(h, lp['wv'], cdt).reshape(b, s, cfg.n_kv_heads,
+                                          cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         # Same attention dispatch as training (Pallas flash kernel on
@@ -233,10 +238,10 @@ def prefill(params: Dict,
         # the [S, S] score matrix.
         o = _attention(q, k, v, cfg, mesh)
         o = o.reshape(b, s, cfg.n_heads * cfg.head_dim).astype(cdt)
-        x = x + qdot(o, lp['wo'], cdt)
+        x = x + dot(o, lp['wo'], cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
-        x = x + _mlp_delta(h, lp, cfg)
+        x = x + _mlp_delta(h, lp, cfg, dot=dot)
         # Pad this layer's K/V out to the cache length.
         pad = [(0, 0), (0, s_max - s), (0, 0), (0, 0)]
         if kv_quant:

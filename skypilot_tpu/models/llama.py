@@ -46,6 +46,12 @@ class LlamaConfig:
     # recompute elementwise); False = none.
     remat: Any = True
     loss_chunk: int = 512     # seq positions per cross-entropy chunk
+    # Serving-only, DENSE family only: int8 ACTIVATIONS for prefill
+    # matmuls against int8-quantized weights (quantization.qdot_a8)
+    # — engages the MXU's int8 path. Decode stays weight-only
+    # (bandwidth-bound); MoE expert blocks ignore this flag (their
+    # dispatch paths are weight-only regardless).
+    prefill_a8: bool = False
 
     @property
     def head_dim(self) -> int:

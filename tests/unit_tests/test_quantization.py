@@ -213,3 +213,27 @@ def test_sharded_quantized_engine_on_mesh():
     for i in results:
         np.testing.assert_array_equal(results[i].tokens,
                                       results2[i].tokens)
+
+
+def test_prefill_a8_close_to_weight_only():
+    """W8A8 prefill (cfg.prefill_a8): per-token int8 activations stay
+    close to the weight-only path, and generation still runs."""
+    import dataclasses
+    cfg, params, tokens = _setup()
+    qp = quantization.quantize_params(params)
+    b, s = tokens.shape
+    lengths = jnp.full((b,), s, jnp.int32)
+    w8, _ = inference.prefill(qp, tokens, lengths, cfg)
+    cfg_a8 = dataclasses.replace(cfg, prefill_a8=True)
+    a8, _ = inference.prefill(qp, tokens, lengths, cfg_a8)
+    a = np.asarray(w8, np.float64)
+    bq = np.asarray(a8, np.float64)
+    cos = (a * bq).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                              np.linalg.norm(bq, axis=-1))
+    assert (cos > 0.98).all(), cos
+    out = inference.generate(qp, tokens, lengths, cfg_a8, max_new=4)
+    assert out.shape == (b, 4)
+    # Dense (unquantized) weights fall back to plain qdot unchanged.
+    d8, _ = inference.prefill(params, tokens, lengths, cfg_a8)
+    dref, _ = inference.prefill(params, tokens, lengths, cfg)
+    np.testing.assert_array_equal(np.asarray(d8), np.asarray(dref))
