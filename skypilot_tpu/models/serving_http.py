@@ -322,15 +322,29 @@ def _build_engine(args) -> 'Any':
         import os
 
         import orbax.checkpoint as ocp
+
+        from skypilot_tpu.models import quantization
         fam = models.family(cfg)
-        target = jax.eval_shape(
-            lambda: fam.init_params(cfg, jax.random.PRNGKey(0)))
+        ckpt_quantized = getattr(args, 'checkpoint_quantized', False)
+        if ckpt_quantized:
+            # int8 checkpoint (models.quantization CLI output): the
+            # restore target is the QUANTIZED tree shape, so an 8B
+            # model loads straight to a 16 GB chip without its bf16
+            # form ever existing in HBM.
+            target = jax.eval_shape(
+                lambda: quantization.init_quantized_params(
+                    cfg, jax.random.PRNGKey(0)))
+        else:
+            target = jax.eval_shape(
+                lambda: fam.init_params(cfg, jax.random.PRNGKey(0)))
         if mesh is not None:
             # The whole point of --tp is a model LARGER than one chip:
             # the restore target must carry shardings so orbax loads
             # each shard straight to its device instead of
             # materializing the full tree on one chip (OOM).
             specs = fam.param_specs(cfg)
+            if ckpt_quantized:
+                specs = quantization.quantize_specs(specs, target)
             target = jax.tree.map(
                 lambda shape_dtype, spec: jax.ShapeDtypeStruct(
                     shape_dtype.shape, shape_dtype.dtype,
@@ -368,6 +382,10 @@ def main() -> None:
     parser.add_argument('--model', default='tiny',
                         help='LlamaConfig classmethod name')
     parser.add_argument('--checkpoint', default=None)
+    parser.add_argument('--checkpoint-quantized', action='store_true',
+                        help='The checkpoint holds an int8 tree '
+                        '(models.quantization CLI output); restore '
+                        'it directly without a bf16 intermediate.')
     parser.add_argument('--batch', type=int, default=8)
     parser.add_argument('--max-prompt', type=int, default=512)
     parser.add_argument('--max-seq', type=int, default=1024)

@@ -237,3 +237,40 @@ def test_prefill_a8_close_to_weight_only():
     d8, _ = inference.prefill(params, tokens, lengths, cfg_a8)
     dref, _ = inference.prefill(params, tokens, lengths, cfg)
     np.testing.assert_array_equal(np.asarray(d8), np.asarray(dref))
+
+
+def test_quantize_checkpoint_roundtrip(tmp_path):
+    """Offline checkpoint quantization (models.quantization CLI):
+    dense orbax save -> host-side int8 save -> quantized restore
+    through serving_http's --checkpoint-quantized target produces
+    token-identical generations to in-memory quantization."""
+    import argparse
+
+    import orbax.checkpoint as ocp
+
+    cfg, params, tokens = _setup()
+    dense_path = tmp_path / 'dense'
+    q_path = tmp_path / 'int8'
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(str(dense_path), params)
+    ckptr.wait_until_finished()
+
+    qsaved = quantization.quantize_checkpoint(str(dense_path),
+                                              str(q_path), cfg)
+    assert quantization.is_quantized(qsaved)
+
+    args = argparse.Namespace(model='tiny', max_seq=96,
+                              checkpoint=str(q_path),
+                              checkpoint_quantized=True,
+                              batch=2, max_prompt=32, decode_chunk=4,
+                              kv_quant=False, weight_quant=True, tp=1)
+    from skypilot_tpu.models import serving_http
+    engine = serving_http._build_engine(args)
+    assert quantization.is_quantized(engine.params)
+
+    lengths = jnp.full((tokens.shape[0],), tokens.shape[1], jnp.int32)
+    want = inference.generate(quantization.quantize_params(params),
+                              tokens, lengths, cfg, max_new=5)
+    got = inference.generate(engine.params, tokens, lengths, cfg,
+                             max_new=5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
