@@ -349,11 +349,23 @@ def serve_bench():
         # cache round before a reset.
         max_seq = max_prompt + 4 * max_new
         a8 = wquant and os.environ.get('BENCH_SERVE_A8') == '1'
-        cfg = models.config_preset(model)(
+        preset = models.config_preset(model)
+        extra = {}
+        if os.environ.get('BENCH_SERVE_MOE_DISPATCH'):
+            # MoE decode dispatch: 'dropless' (all-E loop) or
+            # 'capacity' (gather form, flop-equal at the auto factor).
+            if not issubclass(getattr(preset, '__self__', object),
+                              models.MoEConfig):
+                raise SystemExit(
+                    'BENCH_SERVE_MOE_DISPATCH only applies to MoE '
+                    'presets (unset it for dense serve modes).')
+            extra['infer_dispatch'] = os.environ[
+                'BENCH_SERVE_MOE_DISPATCH']
+        cfg = preset(
             max_seq=max_seq, param_dtype=jnp.bfloat16,
             # BENCH_SERVE_A8=1: int8 activations for the
             # (MXU-bound, serving-dominating) prefill matmuls.
-            prefill_a8=a8)
+            prefill_a8=a8, **extra)
         if a8 and isinstance(cfg, models.MoEConfig):
             # prefill_a8 only covers the dense family's matmuls; the
             # MoE expert blocks would stay weight-only, making a
@@ -556,6 +568,9 @@ _ALL_MODES = {
     'serve_8b_a8': {'BENCH_MODE': 'serve',
                     'BENCH_SERVE_MODEL': 'llama3_8b',
                     'BENCH_SERVE_A8': '1'},
+    'serve_moe_w8': {'BENCH_MODE': 'serve',
+                     'BENCH_SERVE_MODEL': 'tpu_moe_1b',
+                     'BENCH_SERVE_WQUANT': '1'},
     'serve_stack': {'BENCH_MODE': 'serve_stack'},
 }
 

@@ -252,19 +252,26 @@ def moe_block_dropless(x: jax.Array, lp: Dict,
     # scale (S 8192, E 8, F 14336) that is gigabytes per layer and
     # OOMs prefill. Per-expert matmuls keep the working set at
     # [T, F] while computing the identical dropless result.
-    from skypilot_tpu.models.quantization import qdot, qindex
     y = jnp.zeros_like(xf)
     for e in range(cfg.n_experts):
-        gate = jax.nn.silu(qdot(xf, qindex(lp['w_gate'], e), cdt))
-        up = qdot(xf, qindex(lp['w_up'], e), cdt)
-        out_e = qdot(gate * up, qindex(lp['w_down'], e), cdt)
-        y = y + wfull[:, e, None] * out_e
+        y = y + wfull[:, e, None] * _expert_swiglu(xf, lp, e, cdt)
     return y.reshape(b, s, d)
 
 
 def _capacity(cfg: MoEConfig, t: int) -> int:
     return max(4, int(cfg.capacity_factor * t * cfg.top_k /
                       cfg.n_experts))
+
+
+def _expert_swiglu(x: jax.Array, lp: Dict, e, cdt) -> jax.Array:
+    """ONE expert's SwiGLU on [T, D] tokens — the single definition
+    both the dropless all-experts loop and the quantized capacity
+    path run, so the two serving dispatches can never diverge.
+    Handles dense and int8 expert banks (qdot/qindex)."""
+    from skypilot_tpu.models.quantization import qdot, qindex
+    gate = jax.nn.silu(qdot(x, qindex(lp['w_gate'], e), cdt))
+    up = qdot(x, qindex(lp['w_up'], e), cdt)
+    return qdot(gate * up, qindex(lp['w_down'], e), cdt)
 
 
 def _expert_matmul(expert_in: jax.Array, w, cdt,
@@ -283,6 +290,16 @@ def _expert_ffn(expert_in: jax.Array, lp: Dict,
     """SwiGLU over every expert's [C, D] slot block: [E, C, D] ->
     [E, C, D]. The three einsums are the MoE layer's MXU work."""
     cdt = cfg.compute_dtype
+    if isinstance(lp['w_gate'], dict):
+        # int8 expert banks run as per-expert 2-D dots (static E
+        # unroll): the batched 3-D einsum with an int8 operand
+        # kernel-faults the v5e TPU runtime (worker crash, observed
+        # round 5 and reproducible), while 2-D int8 dots are the
+        # dropless loop's proven path. Same math, same flops.
+        return jnp.stack([
+            _expert_swiglu(expert_in[e], lp, e, cdt)
+            for e in range(cfg.n_experts)
+        ])
     gate = jax.nn.silu(
         _expert_matmul(expert_in, lp['w_gate'], cdt, 'ecd,edf->ecf'))
     up = _expert_matmul(expert_in, lp['w_up'], cdt, 'ecd,edf->ecf')

@@ -253,9 +253,17 @@ def quantize_checkpoint(in_path: str, out_path: str, cfg) -> Dict:
 
     from skypilot_tpu import models
     fam = models.family(cfg)
-    target = jax.eval_shape(
-        lambda: fam.init_params(cfg, jax.random.PRNGKey(0)))
     cpu = jax.devices('cpu')[0]
+    host = jax.sharding.SingleDeviceSharding(cpu)
+    # EXPLICIT host sharding on every target leaf: an unsharded
+    # target makes orbax re-use the checkpoint's saved sharding file,
+    # so a TPU-saved training checkpoint would restore back into HBM
+    # — the exact OOM this tool exists to avoid.
+    target = jax.tree.map(
+        lambda sd: jax.ShapeDtypeStruct(sd.shape, sd.dtype,
+                                        sharding=host),
+        jax.eval_shape(
+            lambda: fam.init_params(cfg, jax.random.PRNGKey(0))))
     ckptr = ocp.StandardCheckpointer()
     with jax.default_device(cpu):
         params = ckptr.restore(

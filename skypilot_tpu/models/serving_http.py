@@ -350,6 +350,18 @@ def _build_engine(args) -> 'Any':
                     shape_dtype.shape, shape_dtype.dtype,
                     sharding=jax.sharding.NamedSharding(mesh, spec)),
                 target, specs)
+        else:
+            # Explicit serving-device sharding: an unsharded target
+            # makes orbax re-use the checkpoint's SAVED sharding, so
+            # a host-quantized int8 checkpoint (saved CPU-committed
+            # by the quantize CLI) would restore onto the CPU and
+            # every jitted step would fight a committed-device
+            # mismatch.
+            dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+            target = jax.tree.map(
+                lambda sd: jax.ShapeDtypeStruct(sd.shape, sd.dtype,
+                                                sharding=dev),
+                target)
         params = ocp.StandardCheckpointer().restore(
             os.path.abspath(os.path.expanduser(args.checkpoint)),
             target)
