@@ -103,8 +103,14 @@ def main():
         # 58.85% vs full remat's 58.27%) and loss_chunk 1024 (58.48%
         # vs 512's 58.27%). Block sizes: the 1024x1024 flash defaults
         # won the sweep (512-block variants lose 2-8 MFU points; 2048
-        # blocks exceed VMEM).
-        raw = os.environ.get('BENCH_REMAT', 'kvo')
+        # blocks exceed VMEM). GPT-2 lacks the Llama checkpoint_name
+        # tags the named policies key on, so its default is 'dots'.
+        from skypilot_tpu.models.gpt2 import GPT2Config as _G2
+        _preset0 = models.config_preset(
+            os.environ.get('BENCH_MODEL', 'tpu_1b'))
+        _default_remat = ('dots' if issubclass(
+            getattr(_preset0, '__self__', object), _G2) else 'kvo')
+        raw = os.environ.get('BENCH_REMAT', _default_remat)
         # BENCH_MODEL=tpu_moe_1b benches the MoE family's train step
         # (MFU counted against ACTIVE params, the standard MoE
         # convention).

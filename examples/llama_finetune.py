@@ -22,8 +22,10 @@ from skypilot_tpu.parallel import initialize_from_env, make_mesh
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='tpu_1b',
-                        choices=['tiny', 'tpu_1b', 'llama3_1b',
-                                 'llama3_8b'])
+                        help='Any config preset: tiny/tpu_1b/'
+                        'llama3_1b/llama3_8b (Llama), tiny_moe/'
+                        'tpu_moe_1b/mixtral_8x7b (MoE), tiny_gpt2/'
+                        'gpt2/gpt2_medium/gpt2_xl (GPT-2).')
     parser.add_argument('--seq', type=int, default=8192)
     parser.add_argument('--batch-per-host', type=int, default=4)
     parser.add_argument('--steps', type=int, default=50)
@@ -37,7 +39,7 @@ def main():
     initialize_from_env()
     mesh = make_mesh(tp=args.tp, sp=args.sp)
     n_hosts = jax.process_count()
-    cfg = getattr(models.LlamaConfig, args.model)(
+    cfg = models.config_preset(args.model)(
         max_seq=args.seq, param_dtype=jnp.bfloat16)
 
     optimizer = models.make_optimizer(lr=args.lr)

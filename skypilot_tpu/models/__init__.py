@@ -18,25 +18,30 @@ from skypilot_tpu.models.train import (TrainState, init_train_state,
 
 
 def family(cfg):
-    """Model-family module for a config (llama or moe) — both expose
-    init_params / param_specs / forward / loss_fn with the same
-    signatures. The ONE family-dispatch point: training, serving and
-    checkpoint-restore all route through it."""
+    """Model-family module for a config (llama, moe or gpt2) — each
+    exposes init_params / param_specs / forward / loss_fn with the
+    same signatures. The ONE family-dispatch point: training, serving
+    and checkpoint-restore all route through it."""
+    from skypilot_tpu.models import gpt2 as gpt2_mod
     from skypilot_tpu.models import llama as llama_mod
     from skypilot_tpu.models import moe as moe_mod
-    return (moe_mod if isinstance(cfg, moe_mod.MoEConfig)
-            else llama_mod)
+    if isinstance(cfg, moe_mod.MoEConfig):
+        return moe_mod
+    if isinstance(cfg, gpt2_mod.GPT2Config):
+        return gpt2_mod
+    return llama_mod
 
 
 def config_preset(name: str):
-    """Resolve a preset name ('tpu_1b', 'mixtral_8x7b', ...) across
-    families (used by serving_http --model)."""
-    for cls in (LlamaConfig, MoEConfig):
+    """Resolve a preset name ('tpu_1b', 'mixtral_8x7b', 'gpt2', ...)
+    across families (used by serving_http --model and the bench)."""
+    from skypilot_tpu.models.gpt2 import GPT2Config
+    for cls in (LlamaConfig, MoEConfig, GPT2Config):
         fn = getattr(cls, name, None)
         if fn is not None:
             return fn
-    raise ValueError(f'No model preset named {name!r} on LlamaConfig '
-                     'or MoEConfig.')
+    raise ValueError(f'No model preset named {name!r} on LlamaConfig, '
+                     'MoEConfig or GPT2Config.')
 
 
 __all__ = [

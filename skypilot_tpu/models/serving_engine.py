@@ -117,7 +117,17 @@ class ServingEngine:
         # host-side slot orchestration is mesh-oblivious; only the
         # jitted programs carry shardings.
         self.mesh = mesh
+        from skypilot_tpu.models import gpt2 as gpt2_mod
         from skypilot_tpu.models import quantization
+        if isinstance(cfg, gpt2_mod.GPT2Config):
+            # The KV-cache engine (models/inference.py) is structured
+            # around the Llama/MoE param tree; without this gate a
+            # GPT-2 config dies deep in prefill with KeyError
+            # 'tok_emb'.
+            from skypilot_tpu import exceptions
+            raise exceptions.NotSupportedError(
+                'The serving engine supports the Llama and MoE '
+                'families; GPT-2 is a training family here.')
         if weight_quant and not quantization.is_quantized(params):
             # int8 weight-only quantization (per-output-channel
             # scales): ~2x less HBM per decode step — what lets an 8B
