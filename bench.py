@@ -106,22 +106,20 @@ def main():
         # blocks exceed VMEM). GPT-2 lacks the Llama checkpoint_name
         # tags the named policies key on, so its default is 'dots'.
         from skypilot_tpu.models.gpt2 import GPT2Config as _G2
-        _preset0 = models.config_preset(
+        preset = models.config_preset(
             os.environ.get('BENCH_MODEL', 'tpu_1b'))
-        _default_remat = ('dots' if issubclass(
-            getattr(_preset0, '__self__', object), _G2) else 'kvo')
-        raw = os.environ.get('BENCH_REMAT', _default_remat)
+        preset_cls = getattr(preset, '__self__', object)
+        raw = os.environ.get(
+            'BENCH_REMAT',
+            'dots' if issubclass(preset_cls, _G2) else 'kvo')
         # BENCH_MODEL=tpu_moe_1b benches the MoE family's train step
         # (MFU counted against ACTIVE params, the standard MoE
         # convention).
-        preset = models.config_preset(
-            os.environ.get('BENCH_MODEL', 'tpu_1b'))
         extra = {}
         if os.environ.get('BENCH_CF'):
             # MoE capacity factor: lower cf = fewer expert slot
             # computes (cf*k per token) at a measured drop rate.
-            if not issubclass(getattr(preset, '__self__', object),
-                              models.MoEConfig):
+            if not issubclass(preset_cls, models.MoEConfig):
                 raise SystemExit(
                     'BENCH_CF only applies to MoE presets '
                     '(set BENCH_MODEL=tpu_moe_1b or mixtral_8x7b).')

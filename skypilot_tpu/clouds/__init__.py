@@ -11,6 +11,7 @@ from skypilot_tpu.clouds.lambda_cloud import LambdaCloud
 from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.nebius import Nebius
 from skypilot_tpu.clouds.runpod import RunPod
+from skypilot_tpu.clouds.vast import Vast
 
 __all__ = [
     'AWS',
@@ -25,4 +26,5 @@ __all__ = [
     'Local',
     'Nebius',
     'RunPod',
+    'Vast',
 ]

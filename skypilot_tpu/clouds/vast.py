@@ -1,0 +1,33 @@
+"""Vast.ai — GPU marketplace cloud.
+
+Re-design of reference ``sky/clouds/vast.py`` as a RestNeocloud
+subclass. Vast is a spot-like MARKETPLACE: catalog prices are typical
+market rates and the provision plugin rents from live offers
+(an empty market surfaces as a stockout, driving failover).
+Stop/start supported; 'regions' are coarse geolocations.
+"""
+from __future__ import annotations
+
+from skypilot_tpu.clouds import neocloud
+from skypilot_tpu.utils import registry
+
+
+@registry.CLOUD_REGISTRY.register(name='vast', aliases=['vastai'])
+class Vast(neocloud.RestNeocloud):
+    """Vast.ai (GPU rentals from a marketplace over REST)."""
+
+    _REPR = 'Vast'
+    CATALOG_CLOUD = 'vast'
+    _PROVIDER = 'vast'
+    _CREDENTIAL_HINT = ('Set VAST_API_KEY or write the key to '
+                        '~/.vast_api_key.')
+
+    @classmethod
+    def _creds_api(cls):
+        from skypilot_tpu.provision.vast import api
+        return api
+
+    @staticmethod
+    def _accel_prefix(name: str, count: int) -> str:
+        # Catalog names look like '2x_RTX_4090'.
+        return f'{count}x_{name}'

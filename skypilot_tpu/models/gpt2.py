@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from skypilot_tpu.models.llama import _chunked_ce, remat_layer_fn
+from skypilot_tpu.models.llama import (chunked_lm_loss,
+                                       remat_layer_fn, split_lm_batch)
 from skypilot_tpu.ops import flash_attention, reference_attention
 
 ACT_SPEC = P(('dp', 'fsdp'), 'sp', None)
@@ -238,19 +239,9 @@ def forward(params: Dict, tokens: jax.Array, cfg: GPT2Config,
 
 def loss_fn(params: Dict, batch: Dict[str, jax.Array],
             cfg: GPT2Config, mesh=None) -> jax.Array:
-    """Next-token cross entropy, tied head, sequence-chunked so the
-    [B, S, vocab] logits never materialize (shared _chunked_ce)."""
-    if 'inputs' in batch:
-        inputs, targets = batch['inputs'], batch['targets']
-    else:
-        inputs, targets = batch['tokens'][:, :-1], batch['tokens'][:, 1:]
+    """Next-token cross entropy with the TIED head (shared
+    chunked_lm_loss)."""
+    inputs, targets = split_lm_batch(batch)
     x = forward_hidden(params, inputs, cfg, mesh)
-    mask = (targets >= 0).astype(jnp.float32)
-    targets = jnp.maximum(targets, 0)
-    s = x.shape[1]
-    n_chunks = max(1, s // max(1, cfg.loss_chunk))
-    while s % n_chunks:
-        n_chunks -= 1
     head = jnp.transpose(params['wte'].astype(cfg.compute_dtype))
-    total = _chunked_ce(x, head, targets, mask, n_chunks)
-    return total / jnp.maximum(jnp.sum(mask), 1.0)
+    return chunked_lm_loss(x, head, targets, cfg)

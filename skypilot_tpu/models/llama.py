@@ -394,28 +394,42 @@ def _chunked_ce(x, lm_head, targets, mask, n_chunks):
     return total
 
 
-def loss_fn(params: Dict,
-            batch: Dict[str, jax.Array],
-            cfg: LlamaConfig,
-            mesh=None) -> jax.Array:
-    """Next-token cross entropy. batch: {'tokens': [B, S+1] or
-    'inputs'/'targets' [B, S]} (targets may use -100 = ignore)."""
+def split_lm_batch(batch: Dict[str, jax.Array]):
+    """(inputs, targets) from {'tokens': [B, S+1]} or
+    {'inputs'/'targets': [B, S]} (targets may use -100 = ignore) —
+    ONE definition for every model family."""
     if 'inputs' in batch:
-        inputs, targets = batch['inputs'], batch['targets']
-    else:
-        inputs, targets = batch['tokens'][:, :-1], batch['tokens'][:, 1:]
-    x = forward_hidden(params, inputs, cfg, mesh)
+        return batch['inputs'], batch['targets']
+    return batch['tokens'][:, :-1], batch['tokens'][:, 1:]
+
+
+def chunked_lm_loss(x: jax.Array, head: jax.Array,
+                    targets: jax.Array, cfg) -> jax.Array:
+    """Masked-mean next-token CE over hidden states ``x`` with the
+    unembedding ``head`` [D, V], sequence-chunked so [B, S, vocab]
+    logits never materialize (at 128k vocab and 8k seq that tensor
+    alone would be ~16 GB). Shared by every family — the ignore-index
+    convention and chunk-divisor walk must never diverge between
+    them."""
     mask = (targets >= 0).astype(jnp.float32)
     targets = jnp.maximum(targets, 0)
-    # Chunk the sequence so [B, S, vocab] logits never materialize
-    # (at 128k vocab and 8k seq that tensor alone would be ~16 GB).
     s = x.shape[1]
     n_chunks = max(1, s // max(1, cfg.loss_chunk))
     while s % n_chunks:
         n_chunks -= 1
-    total = _chunked_ce(x, params['lm_head'].astype(cfg.compute_dtype),
-                        targets, mask, n_chunks)
+    total = _chunked_ce(x, head, targets, mask, n_chunks)
     return total / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(params: Dict,
+            batch: Dict[str, jax.Array],
+            cfg: LlamaConfig,
+            mesh=None) -> jax.Array:
+    """Next-token cross entropy (see split_lm_batch for batch forms)."""
+    inputs, targets = split_lm_batch(batch)
+    x = forward_hidden(params, inputs, cfg, mesh)
+    return chunked_lm_loss(
+        x, params['lm_head'].astype(cfg.compute_dtype), targets, cfg)
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -500,19 +500,12 @@ def forward(params: Dict, tokens: jax.Array, cfg: MoEConfig,
 
 def loss_fn(params: Dict, batch: Dict[str, jax.Array], cfg: MoEConfig,
             mesh=None) -> jax.Array:
-    """Next-token CE + router load-balancing aux."""
-    if 'inputs' in batch:
-        inputs, targets = batch['inputs'], batch['targets']
-    else:
-        inputs, targets = batch['tokens'][:, :-1], batch['tokens'][:, 1:]
+    """Next-token CE (shared chunked_lm_loss — the [B, S, vocab]
+    logits never materialize) + router load-balancing aux."""
+    from skypilot_tpu.models.llama import (chunked_lm_loss,
+                                           split_lm_batch)
+    inputs, targets = split_lm_batch(batch)
     x, aux = forward_hidden(params, inputs, cfg, mesh)
-    logits = jnp.einsum('bsd,dv->bsv', x,
-                        params['lm_head'].astype(cfg.compute_dtype),
-                        preferred_element_type=jnp.float32)
-    mask = (targets >= 0).astype(jnp.float32)
-    targets = jnp.maximum(targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None],
-                               axis=-1)[..., 0]
-    ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    ce = chunked_lm_loss(
+        x, params['lm_head'].astype(cfg.compute_dtype), targets, cfg)
     return ce + cfg.router_aux_coef * aux

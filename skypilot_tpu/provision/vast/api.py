@@ -1,0 +1,128 @@
+"""Minimal Vast.ai REST client.
+
+Role of reference ``sky/provision/vast/utils.py`` (which wraps the
+``vastai_sdk``); re-designed as a plain REST client against
+``console.vast.ai/api/v0``. Vast is a MARKETPLACE: machines are not
+created from a type name but rented from a searched OFFER — launch is
+two-phase (search bundles matching the GPU ask, then PUT
+/asks/{offer_id}/ on the cheapest hit). Cluster membership rides the
+instance LABEL (vast has first-class labels; the name-based pattern
+the other neoclouds use is unnecessary here). Same fake-session test
+seam as the other REST plugins.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+API_ENDPOINT = 'https://console.vast.ai/api/v0'
+CREDENTIALS_PATH = '~/.vast_api_key'
+
+
+def read_api_key() -> Optional[str]:
+    key = os.environ.get('VAST_API_KEY')
+    if key:
+        return key
+    try:
+        with open(os.path.expanduser(CREDENTIALS_PATH),
+                  encoding='utf-8') as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _requests_session():
+    import requests
+    return requests.Session()
+
+
+# Test seam.
+session_factory = _requests_session
+
+
+class VastClient:
+
+    def __init__(self, api_key: Optional[str] = None) -> None:
+        self.api_key = api_key or read_api_key()
+        if not self.api_key:
+            raise exceptions.ProvisionError(
+                'No Vast.ai API key (set VAST_API_KEY or write '
+                f'{CREDENTIALS_PATH}).')
+        self.http = session_factory()
+
+    def _call(self, method: str, path: str,
+              json: Optional[Dict[str, Any]] = None) -> Any:
+        resp = self.http.request(
+            method, f'{API_ENDPOINT}{path}', json=json,
+            headers={'Authorization': f'Bearer {self.api_key}'},
+            timeout=60)
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {}
+        if resp.status_code >= 400 or body.get('success') is False:
+            raise translate_error(
+                str(body.get('error') or body.get('msg') or
+                    resp.text[:200]), path)
+        return body
+
+    # ------------------------------------------------------------ ops
+    def search_offers(self, *, gpu_name: str, num_gpus: int,
+                      region: Optional[str] = None
+                      ) -> List[Dict[str, Any]]:
+        """Rentable offers matching the GPU ask, cheapest first."""
+        query: Dict[str, Any] = {
+            'gpu_name': {'eq': gpu_name},
+            'num_gpus': {'eq': num_gpus},
+            'rentable': {'eq': True},
+            'order': [['dph_total', 'asc']],
+            'type': 'on-demand',
+        }
+        if region:
+            query['geolocation'] = {'eq': region}
+        body = self._call('PUT', '/bundles/', json={'q': query})
+        return body.get('offers', [])
+
+    def create_from_offer(self, offer_id: int, *, label: str,
+                          disk_gb: int,
+                          public_key: Optional[str]) -> int:
+        body = self._call(
+            'PUT', f'/asks/{offer_id}/',
+            json={
+                'client_id': 'me',
+                'image': 'ubuntu:22.04',
+                'disk': disk_gb,
+                'label': label,
+                'onstart': None,
+                'runtype': 'ssh',
+                'env': ({'SSH_PUBLIC_KEY': public_key}
+                        if public_key else {}),
+            })
+        return int(body['new_contract'])
+
+    def list_instances(self) -> List[Dict[str, Any]]:
+        return self._call('GET', '/instances/').get('instances', [])
+
+    def start(self, instance_id: int) -> None:
+        self._call('PUT', f'/instances/{instance_id}/',
+                   json={'state': 'running'})
+
+    def stop(self, instance_id: int) -> None:
+        self._call('PUT', f'/instances/{instance_id}/',
+                   json={'state': 'stopped'})
+
+    def delete(self, instance_id: int) -> None:
+        self._call('DELETE', f'/instances/{instance_id}/')
+
+
+def translate_error(message: str, what: str) -> Exception:
+    blob = message.lower()
+    if ('no_such_ask' in blob or 'no longer available' in blob or
+            'no offers' in blob or 'unavailable' in blob):
+        return exceptions.StockoutError(f'{what}: {message}')
+    if 'quota' in blob or 'insufficient credit' in blob or \
+            'balance' in blob:
+        return exceptions.QuotaExceededError(f'{what}: {message}')
+    return exceptions.ProvisionError(f'{what}: {message}')

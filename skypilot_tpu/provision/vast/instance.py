@@ -1,0 +1,214 @@
+"""Vast.ai provision ops (nine-op contract).
+
+Role of reference ``sky/provision/vast/instance.py``, re-designed
+stateless for the MARKETPLACE shape: ``run_instances`` first searches
+the offer market for machines matching the catalog GPU ask
+(cheapest-first), then rents each missing rank from an offer —
+an empty market IS the stockout signal. Membership rides instance
+LABELS (``<cluster>-<idx>``, exact match); stop/start supported.
+
+Status mapping: ``loading``/``running``/``stopped``/``exited``/
+``offline`` -> 'pending'/'running'/'stopped'/'stopped'/'pending'.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.vast import api
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_WAIT_TIMEOUT = 1800.0
+_POLL_INTERVAL = 5.0
+
+SSH_USER = 'root'
+
+
+def _label(cluster: str, idx: int) -> str:
+    return f'{cluster}-{idx}'
+
+
+def _cluster_instances(client: api.VastClient,
+                       cluster: str) -> Dict[str, Dict[str, Any]]:
+    """label -> instance, EXACT ``<cluster>-<rank>`` match."""
+    member = re.compile(re.escape(cluster) + r'-\d+\Z')
+    out: Dict[str, Dict[str, Any]] = {}
+    for inst in client.list_instances():
+        label = inst.get('label') or ''
+        if member.fullmatch(label):
+            out[label] = inst
+    return out
+
+
+def _gpu_parts(instance_type: str) -> Dict[str, Any]:
+    """'2x_RTX_4090'-style catalog names -> market search args."""
+    m = re.match(r'(\d+)x_(.+)\Z', instance_type or '')
+    if not m:
+        raise exceptions.ProvisionError(
+            f'Unparseable Vast instance type {instance_type!r} '
+            "(expected '<n>x_<GPU>').")
+    return {'num_gpus': int(m.group(1)),
+            'gpu_name': m.group(2).replace('_', ' ')}
+
+
+def bootstrap_instances(
+        config: common.ProvisionConfig) -> common.ProvisionConfig:
+    return config
+
+
+def run_instances(
+        config: common.ProvisionConfig) -> common.ProvisionRecord:
+    node = config.node_config
+    cluster = config.cluster_name_on_cloud
+    client = api.VastClient()
+    gpu = _gpu_parts(node['instance_type'])
+    created: List[str] = []
+    resumed: List[str] = []
+    existing = _cluster_instances(client, cluster)
+    offers: Optional[List[Dict[str, Any]]] = None
+    for idx in range(config.count):
+        label = _label(cluster, idx)
+        inst = existing.get(label)
+        if inst is not None:
+            if _status(inst) == 'stopped':
+                client.start(inst['id'])
+                resumed.append(str(inst['id']))
+            continue
+        if offers is None:
+            # ONE market search covers every missing rank (offers is
+            # cheapest-first; each rent consumes its head).
+            offers = client.search_offers(gpu_name=gpu['gpu_name'],
+                                          num_gpus=gpu['num_gpus'],
+                                          region=config.region)
+        if not offers:
+            # The marketplace has nothing matching the ask — Vast's
+            # form of a stockout, which drives the provisioner's
+            # cross-region/cloud failover.
+            raise exceptions.StockoutError(
+                f'No rentable Vast offers for '
+                f"{gpu['num_gpus']}x {gpu['gpu_name']} in "
+                f'{config.region!r}.')
+        offer = offers.pop(0)
+        created.append(str(client.create_from_offer(
+            offer['id'], label=label,
+            disk_gb=int(node.get('disk_size') or 100),
+            public_key=node.get('ssh_public_key'))))
+    return common.ProvisionRecord(
+        provider_name='vast',
+        cluster_name_on_cloud=cluster,
+        region=config.region,
+        zone=config.zone,
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        head_instance_id=_label(cluster, 0),
+    )
+
+
+def _status(inst: Dict[str, Any]) -> str:
+    return {
+        'running': 'running',
+        'loading': 'pending',
+        'created': 'pending',
+        'offline': 'pending',
+        'stopped': 'stopped',
+        'exited': 'stopped',
+    }.get(inst.get('actual_status', ''), 'pending')
+
+
+def wait_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str], state: Optional[str]) -> None:
+    del region, zone
+    client = api.VastClient()
+    want = state or 'running'
+    deadline = time.time() + _WAIT_TIMEOUT
+    while time.time() < deadline:
+        insts = _cluster_instances(client, cluster_name_on_cloud)
+        if want == 'terminated':
+            if not insts:
+                return
+        elif insts and all(_status(i) == want
+                           for i in insts.values()):
+            return
+        time.sleep(_POLL_INTERVAL)
+    raise exceptions.ProvisionError(
+        f'Timed out waiting for {cluster_name_on_cloud} to reach '
+        f'{want!r}.')
+
+
+def query_instances(
+        cluster_name_on_cloud: str, region: str, zone: Optional[str],
+        non_terminated_only: bool = True) -> Dict[str, Optional[str]]:
+    del region, zone, non_terminated_only
+    client = api.VastClient()
+    # Deleted rentals vanish from /instances — anything listed is
+    # non-terminated by construction.
+    return {
+        label: _status(inst)
+        for label, inst in _cluster_instances(
+            client, cluster_name_on_cloud).items()
+    }
+
+
+def get_cluster_info(cluster_name_on_cloud: str, region: str,
+                     zone: Optional[str]) -> common.ClusterInfo:
+    client = api.VastClient()
+    infos: Dict[str, List[common.InstanceInfo]] = {}
+    for label, inst in sorted(
+            _cluster_instances(client, cluster_name_on_cloud).items()):
+        infos[label] = [
+            common.InstanceInfo(
+                instance_id=str(inst.get('id', label)),
+                internal_ip=inst.get('local_ipaddrs') or
+                inst.get('public_ipaddr', ''),
+                external_ip=inst.get('public_ipaddr'),
+                # Vast exposes sshd on a mapped high port.
+                ssh_port=int(inst.get('ssh_port') or 22),
+                host_index=0,
+                tags={'label': label},
+            )
+        ]
+    head = min(infos) if infos else None
+    return common.ClusterInfo(
+        provider_name='vast',
+        cluster_name_on_cloud=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        instances=infos,
+        head_instance_id=head,
+        ssh_user=SSH_USER,
+    )
+
+
+def stop_instances(cluster_name_on_cloud: str, region: str,
+                   zone: Optional[str]) -> None:
+    del region, zone
+    client = api.VastClient()
+    for inst in _cluster_instances(client,
+                                   cluster_name_on_cloud).values():
+        if _status(inst) == 'running':
+            client.stop(inst['id'])
+
+
+def terminate_instances(cluster_name_on_cloud: str, region: str,
+                        zone: Optional[str]) -> None:
+    del region, zone
+    client = api.VastClient()
+    for inst in _cluster_instances(client,
+                                   cluster_name_on_cloud).values():
+        client.delete(inst['id'])
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               region: str, zone: Optional[str]) -> None:
+    logger.info('vast: port mappings are assigned per rental; '
+                'open_ports(%s) is a no-op.', ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, region: str,
+                  zone: Optional[str]) -> None:
+    pass

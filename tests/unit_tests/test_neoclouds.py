@@ -444,3 +444,148 @@ def test_optimizer_failover_includes_neocloud(rp_http, fs_http,
     # next-cheapest neocloud.
     best3 = best_for(blocked=[best, best2])
     assert best3.cloud.canonical_name() in ('fluidstack', 'runpod')
+
+
+# ----------------------------------------------------------------- Vast
+
+
+class FakeVastHttp:
+    """Plays console.vast.ai/api/v0 — a marketplace: offers are
+    searched and consumed; rentals carry labels."""
+
+    def __init__(self):
+        self.offers = [
+            {'id': 901, 'gpu_name': 'RTX 4090', 'num_gpus': 2,
+             'dph_total': 0.80},
+            {'id': 902, 'gpu_name': 'RTX 4090', 'num_gpus': 2,
+             'dph_total': 0.84},
+        ]
+        self.instances = {}
+        self.create_error = None
+        self._n = 0
+
+    def request(self, method, url, json=None, headers=None,
+                timeout=None):
+        assert headers['Authorization'].startswith('Bearer ')
+        path = url.split('/api/v0', 1)[1]
+        if method == 'PUT' and path == '/bundles/':
+            q = json['q']
+            hits = [o for o in self.offers
+                    if o['gpu_name'] == q['gpu_name']['eq'] and
+                    o['num_gpus'] == q['num_gpus']['eq']]
+            return _Resp(200, {'offers': hits})
+        if method == 'PUT' and path.startswith('/asks/'):
+            if self.create_error is not None:
+                return _Resp(400, {'success': False,
+                                   'error': self.create_error})
+            offer_id = int(path.split('/')[2])
+            assert any(o['id'] == offer_id for o in self.offers)
+            self.offers = [o for o in self.offers
+                           if o['id'] != offer_id]
+            self._n += 1
+            iid = 7000 + self._n
+            self.instances[iid] = {
+                'id': iid, 'label': json['label'],
+                'actual_status': 'running',
+                'public_ipaddr': f'70.0.0.{self._n}',
+                'local_ipaddrs': f'10.4.0.{self._n}',
+                'ssh_port': 41000 + self._n,
+            }
+            return _Resp(200, {'success': True, 'new_contract': iid})
+        if method == 'GET' and path == '/instances/':
+            return _Resp(200,
+                         {'instances': list(self.instances.values())})
+        if method == 'PUT' and path.startswith('/instances/'):
+            iid = int(path.split('/')[2])
+            self.instances[iid]['actual_status'] = (
+                'running' if json['state'] == 'running' else 'stopped')
+            return _Resp(200, {'success': True})
+        if method == 'DELETE':
+            iid = int(path.split('/')[2])
+            self.instances.pop(iid, None)
+            return _Resp(200, {'success': True})
+        raise AssertionError((method, path))
+
+
+@pytest.fixture
+def vast_http(monkeypatch):
+    from skypilot_tpu.provision.vast import api as vast_api
+    from skypilot_tpu.provision.vast import instance as vast
+    fake = FakeVastHttp()
+    monkeypatch.setattr(vast_api, 'session_factory', lambda: fake)
+    monkeypatch.setenv('VAST_API_KEY', 'vast-key')
+    monkeypatch.setattr(vast, '_POLL_INTERVAL', 0.0)
+    return fake
+
+
+def _vast_config(count=1):
+    return common.ProvisionConfig(
+        provider_name='vast',
+        cluster_name='vc',
+        cluster_name_on_cloud='vc',
+        region=None,
+        zone=None,
+        node_config={'instance_type': '2x_RTX_4090',
+                     'ssh_public_key': 'ssh-ed25519 AAAA test',
+                     'disk_size': 100, 'labels': {}},
+        count=count,
+    )
+
+
+def test_vast_market_lifecycle(vast_http):
+    from skypilot_tpu.provision.vast import instance as vast
+    record = vast.run_instances(_vast_config(count=2))
+    assert record.head_instance_id == 'vc-0'
+    assert len(record.created_instance_ids) == 2
+    # The two cheapest offers were consumed, cheapest first.
+    assert vast_http.offers == []
+
+    vast.wait_instances('vc', None, None, None)
+    assert vast.query_instances('vc', None, None) == {
+        'vc-0': 'running', 'vc-1': 'running'}
+    assert vast.run_instances(_vast_config(count=2)) \
+        .created_instance_ids == []
+
+    info = vast.get_cluster_info('vc', None, None)
+    head = info.instances['vc-0'][0]
+    assert head.external_ip.startswith('70.')
+    assert head.ssh_port > 40000        # marketplace-mapped sshd
+
+    vast.stop_instances('vc', None, None)
+    assert set(vast.query_instances('vc', None, None).values()) == \
+        {'stopped'}
+    record = vast.run_instances(_vast_config(count=2))
+    assert len(record.resumed_instance_ids) == 2
+
+    vast.terminate_instances('vc', None, None)
+    vast.wait_instances('vc', None, None, 'terminated')
+    assert vast.query_instances('vc', None, None) == {}
+
+
+def test_vast_empty_market_is_stockout(vast_http):
+    from skypilot_tpu.provision.vast import instance as vast
+    vast_http.offers = []
+    with pytest.raises(exceptions.StockoutError):
+        vast.run_instances(_vast_config())
+    vast_http.offers = [{'id': 1, 'gpu_name': 'RTX 4090',
+                         'num_gpus': 2, 'dph_total': 0.8}]
+    vast_http.create_error = 'insufficient credit balance'
+    with pytest.raises(exceptions.QuotaExceededError):
+        vast.run_instances(_vast_config())
+
+
+def test_vast_cloud_feasibility(vast_http):
+    from skypilot_tpu.clouds import Vast
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    cloud = Vast()
+    assert CLOUD_REGISTRY.from_str('vast') is Vast
+    assert CLOUD_REGISTRY.from_str('vastai') is Vast
+    ok, _ = cloud.check_credentials()
+    assert ok
+    feas = cloud.get_feasible_launchable_resources(
+        Resources(accelerators='RTX_4090:2'))
+    assert feas and feas[0].instance_type == '2x_RTX_4090'
+    assert cloud.hourly_price(feas[0]) == 0.84
+    assert cloud.get_feasible_launchable_resources(
+        Resources(accelerators='tpu-v5e-8')) == []
