@@ -112,3 +112,22 @@ def test_fetchers_regenerate_shipped_catalogs(tmp_path):
         out = fetcher.fetch(str(tmp_path / fname))
         assert filecmp.cmp(out, os.path.join(data_dir, fname),
                            shallow=False), f'{fname} drifted'
+
+
+def test_every_cloud_catalog_loads():
+    """Every VM_CATALOGS entry parses into >0 offerings with sane
+    prices, and every registered catalog-backed cloud has a catalog
+    key — a new cloud can't silently ship without pricing data."""
+    for cloud_key in catalog.VM_CATALOGS:
+        rows = catalog.get_instance_offerings(cloud=cloud_key)
+        assert rows, cloud_key
+        assert all(r.price > 0 and r.spot_price > 0 for r in rows), \
+            cloud_key
+        assert all(r.vcpus > 0 and r.memory_gib > 0 for r in rows), \
+            cloud_key
+    from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+    import skypilot_tpu.clouds  # noqa: F401 (registers)
+    catalog_backed = set(CLOUD_REGISTRY.keys()) - {
+        'local', 'kubernetes'}
+    assert catalog_backed <= set(catalog.VM_CATALOGS) | {'gcp'}, \
+        catalog_backed - set(catalog.VM_CATALOGS)
