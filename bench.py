@@ -624,9 +624,57 @@ def all_bench():
     }))
 
 
+def _device_watchdog(timeout_s: float = 180.0) -> None:
+    """Bounded device probe before any bench work: when the TPU
+    tunnel is dead, every device op hangs FOREVER (observed when the
+    relay process died mid-round) — a bench that hangs records
+    nothing. A tiny matmul on a watchdog thread converts that into a
+    bounded, recorded error JSON."""
+    import threading
+    result: list = []
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+            x = jnp.ones((8, 8))
+            result.append(float((x @ x).sum()))
+        except Exception as e:  # pylint: disable=broad-except
+            result.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    got = list(result)          # one snapshot: the probe thread may
+    if got and not isinstance(got[0], Exception):   # land mid-check
+        return
+    print(json.dumps({
+        'metric': 'bench_error',
+        'value': 0.0,
+        'unit': 'error',
+        'vs_baseline': 0.0,
+        'detail': {
+            'error': ('device unreachable: probe did not '
+                      f'complete in {timeout_s:.0f}s (TPU '
+                      'tunnel/relay dead?)' if not got
+                      else repr(got[0])[:300]),
+        },
+    }))
+    sys.stdout.flush()
+    # os._exit, NOT sys.exit: interpreter finalization would wait on
+    # jax/PJRT teardown, which blocks behind the very op that hung —
+    # reintroducing the infinite hang this watchdog exists to bound.
+    os._exit(1)
+
+
 if __name__ == '__main__':
     mode = (sys.argv[1] if len(sys.argv) > 1 else
             os.environ.get('BENCH_MODE', 'train'))
+    # 'all' probes ONCE in the parent (12 children each paying the
+    # timeout against a dead tunnel would burn ~36 min saying the
+    # same thing); other modes probe in-process.
+    _device_watchdog(float(os.environ.get(
+        'BENCH_DEVICE_TIMEOUT', '180')))
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':
