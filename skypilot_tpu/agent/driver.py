@@ -28,6 +28,7 @@ from skypilot_tpu.agent import job_lib
 from skypilot_tpu.agent import log_lib
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import env_contract
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import subprocess_utils
 
 JobStatus = job_lib.JobStatus
@@ -73,6 +74,13 @@ def monitor_workers(runners: List[runner_lib.CommandRunner],
             try:
                 ok = runner.check_connection()
             except Exception:  # pylint: disable=broad-except
+                ok = False
+            # Chaos site: a fired fault plays a dead worker heartbeat
+            # (match {"rank": N} targets one host = partial-gang loss).
+            if fault_injection.poll(
+                    'agent.worker_probe', rank=rank,
+                    host_id=getattr(runner, 'host_id',
+                                    None)) is not None:
                 ok = False
             misses = 0 if ok else misses + 1
             if misses >= threshold:

@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import os
 import shlex
-import time
 import typing
 from typing import Any, Dict, List, Optional
 
@@ -39,6 +38,7 @@ from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import docker_utils
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import status_lib
 from skypilot_tpu.utils import subprocess_utils
 
@@ -48,7 +48,18 @@ if typing.TYPE_CHECKING:
 
 logger = sky_logging.init_logger(__name__)
 
+# retry_until_up rounds: unlimited attempts, capped exponential
+# backoff (one shared RetryPolicy implementation; see utils/retry.py).
+# Jitter-free: each round re-issues real provider API calls for every
+# candidate zone, so the gap must be a guaranteed minimum, not
+# uniform(0, base). The clock is swappable for wall-clock-free tests.
 _PROVISION_BACKOFF_INITIAL = 5.0
+_PROVISION_RETRY_POLICY = retry_lib.RetryPolicy(
+    max_attempts=None,
+    initial_backoff=_PROVISION_BACKOFF_INITIAL,
+    max_backoff=300.0,
+    multiplier=1.6,
+    jitter='none')
 
 
 def log_root() -> str:
@@ -173,7 +184,7 @@ class RetryingProvisioner:
             num_nodes: int) -> provision_common.ClusterInfo:
         """Iterate candidates; block failed ones at the right granularity
         (zone for stockouts, region for quota)."""
-        backoff = common_utils.Backoff(_PROVISION_BACKOFF_INITIAL)
+        retry_state = _PROVISION_RETRY_POLICY.new_state()
         failover_history: List[Exception] = []
         while True:
             for region, zone in self._candidates(to_provision):
@@ -204,12 +215,12 @@ class RetryingProvisioner:
                     f'Failed to provision {to_provision!r} in all '
                     'candidate zones.',
                     failover_history=failover_history)
-            sleep = backoff.current_backoff()
-            logger.info('retry_until_up: retrying in %.0fs.', sleep)
             # Keep caller-seeded blocks across rounds; clear only the
             # blocks learned from this request's failures.
             self._blocked = set(self._seed_blocked)
-            time.sleep(sleep)
+            backoff = retry_state.next_backoff()
+            logger.info('retry_until_up: retrying in %.1fs.', backoff)
+            _PROVISION_RETRY_POLICY.clock.sleep(backoff)
 
 
 # ----------------------------------------------------------------------
@@ -273,7 +284,7 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                                      None) or []):
                     if cand != to_provision:
                         candidates.append(cand)
-            backoff = common_utils.Backoff(_PROVISION_BACKOFF_INITIAL)
+            retry_state = _PROVISION_RETRY_POLICY.new_state()
             while True:
                 last_error: Optional[Exception] = None
                 cluster_info = None
@@ -311,10 +322,10 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                 if not retry_until_up:
                     assert last_error is not None
                     raise last_error
-                sleep = backoff.current_backoff()
+                backoff = retry_state.next_backoff()
                 logger.info('retry_until_up: retrying all clouds in '
-                            '%.0fs.', sleep)
-                time.sleep(sleep)
+                            '%.1fs.', backoff)
+                _PROVISION_RETRY_POLICY.clock.sleep(backoff)
             launched = to_provision.copy(
                 region=cluster_info.region,
                 zone=cluster_info.zone,

@@ -25,6 +25,7 @@ from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import status_lib
 
@@ -78,6 +79,63 @@ class JobsController:
             return None
 
     # ------------------------------------------------------------------
+    def _maybe_inject_chaos(self) -> None:
+        """Chaos site `jobs.controller.heartbeat`: polled once per
+        monitor tick while the job is RUNNING. A fired preemption /
+        partial_gang_loss fault is ACTED OUT against cloud truth
+        through the provision layer (reclaim the cluster / one host),
+        so the normal detection + recovery machinery runs for real."""
+        plan = fault_injection.active_plan()
+        kinds = fault_injection.FaultKind
+        # Only reclaim kinds have an action at this site; the kinds
+        # filter keeps other specs' budgets untouched.
+        actionable = (kinds.PREEMPTION, kinds.PARTIAL_GANG_LOSS)
+        if plan is None or not plan.pending('jobs.controller.heartbeat',
+                                            actionable):
+            # Fast path: without an armed fault this must stay free —
+            # the monitor loop deliberately avoids per-tick cloud
+            # queries.
+            return
+        # Resolve the handle BEFORE polling: poll() consumes the
+        # fault's times budget and writes the record line, so firing
+        # while unable to act would silently drop a planned fault.
+        try:
+            record = backend_utils.refresh_cluster_record(
+                self.cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            record = None
+        if record is None or record.get('handle') is None:
+            return
+        fault = fault_injection.poll('jobs.controller.heartbeat',
+                                     kinds=actionable,
+                                     cluster_name=self.cluster_name)
+        if fault is None:
+            return
+        handle = record['handle']
+        logger.warning('[fault-injection] acting %s on cluster %s.',
+                       fault.kind.value, self.cluster_name)
+        try:
+            import importlib
+            module = importlib.import_module(
+                f'skypilot_tpu.provision.{handle.provider_name}.instance')
+            if (fault.kind is kinds.PARTIAL_GANG_LOSS and
+                    hasattr(module, 'preempt_host')):
+                module.preempt_host(
+                    handle.cluster_name_on_cloud,
+                    int(fault.params.get('host_index', 0)))
+            elif hasattr(module, 'preempt'):
+                module.preempt(handle.cluster_name_on_cloud)
+            else:
+                # Providers without a dedicated reclaim hook: a spot
+                # reclaim is indistinguishable from termination.
+                module.terminate_instances(handle.cluster_name_on_cloud,
+                                           handle.region, handle.zone)
+        except Exception:  # pylint: disable=broad-except
+            # A failed reclaim must not crash the controller — the
+            # monitor loop keeps watching the (still-live) cluster.
+            logger.warning('[fault-injection] acting %s failed:\n%s',
+                           fault.kind.value, traceback.format_exc())
+
     def _monitor_until_done(self, cluster_job_id: int) -> state.ManagedJobStatus:
         """Returns the terminal managed status for one launched attempt,
         or RECOVERING if the cluster was preempted."""
@@ -89,6 +147,8 @@ class JobsController:
             job_status = self._job_status(cluster_job_id)
             if job_status is not None:
                 missing_streak = 0
+            if job_status == agent_job_lib.JobStatus.RUNNING:
+                self._maybe_inject_chaos()
             if job_status == agent_job_lib.JobStatus.SUCCEEDED:
                 return state.ManagedJobStatus.SUCCEEDED
             if job_status == agent_job_lib.JobStatus.CANCELLED:
@@ -179,6 +239,28 @@ class JobsController:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
                 return state.ManagedJobStatus.CANCELLED
+            is_restart = False
+            if result in (state.ManagedJobStatus.FAILED,
+                          state.ManagedJobStatus.FAILED_SETUP):
+                # User failure on a healthy cluster: restart while the
+                # strategy's max_restarts_on_errors budget lasts
+                # (reference jobs/controller.py restart-on-errors).
+                if self.strategy.should_restart_on_failure():
+                    logger.info(
+                        'User failure; restarting on errors '
+                        '(%d/%d).',
+                        self.strategy.restart_count_on_errors,
+                        self.strategy.max_restarts_on_errors)
+                    result = state.ManagedJobStatus.RECOVERING
+                    is_restart = True
+                elif self.strategy.max_restarts_on_errors > 0:
+                    state.set_status(
+                        self.job_id, result,
+                        failure_reason=(
+                            'exhausted max_restarts_on_errors='
+                            f'{self.strategy.max_restarts_on_errors}'))
+                    self.strategy.terminate_cluster()
+                    return result
             if result != state.ManagedJobStatus.RECOVERING:
                 self.strategy.terminate_cluster()
                 if result is not state.ManagedJobStatus.SUCCEEDED:
@@ -206,7 +288,10 @@ class JobsController:
                                  state.ManagedJobStatus.CANCELLED)
                 return state.ManagedJobStatus.CANCELLED
             try:
-                cluster_job_id = self.strategy.recover()
+                # A restart follows a USER failure on healthy infra:
+                # relaunch without blocking the (healthy) region.
+                cluster_job_id = (self.strategy.restart() if is_restart
+                                  else self.strategy.recover())
             except exceptions.ResourcesUnavailableError as e:
                 state.set_status(
                     self.job_id,

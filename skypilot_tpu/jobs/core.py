@@ -54,6 +54,10 @@ _CONTROLLER_ENV_PASSTHROUGH = (
     'SKYTPU_JOBS_DB', 'SKYTPU_STATE_DB', 'SKYTPU_DATA_DIR',
     'SKYTPU_JOBS_LOG_DIR', 'SKYTPU_CONFIG', 'SKYTPU_USER_HASH',
     'SKYTPU_JOBS_LAUNCH_PARALLELISM',
+    # Chaos plans and their retry-schedule overrides must reach the
+    # controller wherever it runs (utils/fault_injection.py).
+    'SKYTPU_FAULT_PLAN', 'SKYTPU_JOBS_LAUNCH_MAX_ATTEMPTS',
+    'SKYTPU_JOBS_LAUNCH_RETRY_GAP',
 )
 
 

@@ -9,13 +9,14 @@ has capacity seconds later, so moving on converges faster.
 """
 from __future__ import annotations
 
-import time
+import os
 import typing
 from typing import Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import registry
+from skypilot_tpu.utils import retry as retry_lib
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
@@ -29,6 +30,28 @@ _MAX_LAUNCH_ATTEMPTS = 3
 _LAUNCH_RETRY_GAP_SECONDS = 30
 
 
+def _launch_retry_policy() -> retry_lib.RetryPolicy:
+    """Transient launch errors get bounded retries on the shared
+    RetryPolicy; ResourcesUnavailableError is permanent (no capacity
+    anywhere) and never retried. Env overrides let chaos tests tighten
+    the schedule in the detached controller process."""
+    return retry_lib.RetryPolicy(
+        max_attempts=int(
+            os.environ.get('SKYTPU_JOBS_LAUNCH_MAX_ATTEMPTS',
+                           _MAX_LAUNCH_ATTEMPTS)),
+        initial_backoff=float(
+            os.environ.get('SKYTPU_JOBS_LAUNCH_RETRY_GAP',
+                           _LAUNCH_RETRY_GAP_SECONDS)),
+        max_backoff=300.0,
+        multiplier=2.0,
+        # No jitter: the gap exists to stop hammering a struggling
+        # backend, so SKYTPU_JOBS_LAUNCH_RETRY_GAP must MEAN a gap —
+        # full jitter would allow ~0s relaunches.
+        jitter='none',
+        retryable=lambda e: not isinstance(
+            e, exceptions.ResourcesUnavailableError))
+
+
 class StrategyExecutor:
     """Launch/recover one task's cluster through the normal stack."""
 
@@ -36,7 +59,11 @@ class StrategyExecutor:
                  max_restarts_on_errors: int = 0) -> None:
         self.cluster_name = cluster_name
         self.task = task
+        # How many times a USER failure (job failed on a healthy
+        # cluster) may be answered with a restart before going
+        # terminal (reference job_recovery.max_restarts_on_errors).
         self.max_restarts_on_errors = max_restarts_on_errors
+        self.restart_count_on_errors = 0
         # Region of the last successful launch — captured here because
         # by the time recover() runs, the cluster record has usually
         # been reaped by status refresh.
@@ -49,10 +76,28 @@ class StrategyExecutor:
         recovery = None
         for r in task.resources:
             recovery = r.job_recovery or recovery
-        if recovery is not None:
+        max_restarts = 0
+        if isinstance(recovery, dict):
+            name = str(recovery.get('strategy') or name)
+            max_restarts = int(recovery.get('max_restarts_on_errors', 0))
+        elif recovery is not None:
             name = str(recovery)
         strategy_cls = RECOVERY_STRATEGY_REGISTRY.from_str(name)
-        return strategy_cls(cluster_name, task)
+        return strategy_cls(cluster_name, task,
+                            max_restarts_on_errors=max_restarts)
+
+    def should_restart_on_failure(self) -> bool:
+        """One user failure happened: is a restart still in budget?
+        Bumps the counter when it is.
+
+        Restarts relaunch through recover() and count toward the
+        controller's recovery tally, so the effective budget is also
+        bounded by the controller's _MAX_RECOVERIES backstop — set
+        max_restarts_on_errors well below it."""
+        if self.restart_count_on_errors >= self.max_restarts_on_errors:
+            return False
+        self.restart_count_on_errors += 1
+        return True
 
     # ------------------------------------------------------------------
     def _do_launch(self, *, blocked_regions=None) -> Optional[int]:
@@ -75,21 +120,21 @@ class StrategyExecutor:
 
     def launch(self) -> Optional[int]:
         """Initial launch with bounded retries on transient errors."""
-        last_exc: Optional[Exception] = None
-        for attempt in range(_MAX_LAUNCH_ATTEMPTS):
+        policy = _launch_retry_policy()
+        state = policy.new_state()
+        while True:
             try:
                 return self._do_launch()
             except exceptions.ResourcesUnavailableError:
                 raise  # permanent: no capacity anywhere
             except Exception as e:  # pylint: disable=broad-except
-                last_exc = e
                 logger.warning('Launch attempt %d failed: %s',
-                               attempt + 1, e)
-                if attempt + 1 < _MAX_LAUNCH_ATTEMPTS:
-                    time.sleep(_LAUNCH_RETRY_GAP_SECONDS)
-        raise exceptions.ProvisionError(
-            f'Launch failed after {_MAX_LAUNCH_ATTEMPTS} attempts: '
-            f'{last_exc}')
+                               state.attempt + 1, e)
+                if not state.should_retry(e):
+                    raise exceptions.ProvisionError(
+                        f'Launch failed after {state.attempt + 1} '
+                        f'attempts: {e}')
+                state.sleep()
 
     def terminate_cluster(self) -> None:
         from skypilot_tpu import core
@@ -97,6 +142,13 @@ class StrategyExecutor:
             core.down(self.cluster_name)
         except exceptions.ClusterDoesNotExist:
             pass
+
+    def restart(self) -> Optional[int]:
+        """Relaunch after a USER failure: the infrastructure was
+        provably healthy, so no region is blocked — unlike recover(),
+        which assumes the cluster's location just failed."""
+        self.terminate_cluster()
+        return self._do_launch()
 
     def recover(self) -> Optional[int]:
         raise NotImplementedError

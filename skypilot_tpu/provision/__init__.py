@@ -13,6 +13,7 @@ import importlib
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import timeline
 
@@ -27,6 +28,13 @@ def _route(op_name: str):
         @functools.wraps(stub)
         @timeline.event(name=f'provision.{op_name}')
         def wrapper(provider_name: str, *args, **kwargs):
+            # Chaos site for every provider op, e.g.
+            # `provision.local.run_instances` — a fired fault raises
+            # the typed error (quota/stockout/...) the failover
+            # machinery dispatches on.
+            fault_injection.inject(
+                f'provision.{provider_name}.{op_name}',
+                provider=provider_name)
             module = importlib.import_module(
                 f'skypilot_tpu.provision.{provider_name}.instance')
             impl = getattr(module, op_name, None)

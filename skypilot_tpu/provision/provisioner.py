@@ -21,6 +21,7 @@ from skypilot_tpu import provision
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import subprocess_utils
 from skypilot_tpu.utils import timeline
@@ -230,6 +231,12 @@ def post_provision_runtime_setup(
         ssh_private_key: Optional[str],
         log_dir: str) -> str:
     """Returns the head state dir after the cluster is fully usable."""
+    # Chaos site: a fired ssh_failure here plays a host that came up
+    # but cannot be set up (flaky runner) — callers see the typed
+    # CommandError and retry the whole launch boundedly.
+    fault_injection.inject(
+        'provisioner.post_provision_runtime_setup',
+        cluster=cluster_info.cluster_name_on_cloud)
     os.makedirs(os.path.expanduser(log_dir), exist_ok=True)
     runners = make_runners(cluster_info, ssh_private_key)
     if not runners:

@@ -48,7 +48,7 @@ class Resources:
         cpus: Optional[Union[int, float, str]] = None,
         memory: Optional[Union[int, float, str]] = None,
         use_spot: Optional[bool] = None,
-        job_recovery: Optional[str] = None,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
         region: Optional[str] = None,
         zone: Optional[str] = None,
         disk_size: Optional[int] = None,
@@ -63,7 +63,7 @@ class Resources:
         self._instance_type = instance_type
         self._use_spot_specified = use_spot is not None
         self._use_spot = bool(use_spot) if use_spot is not None else False
-        self._job_recovery = job_recovery.lower() if job_recovery else None
+        self._job_recovery = self._normalize_job_recovery(job_recovery)
         self._disk_size = (int(disk_size)
                            if disk_size is not None else _DEFAULT_DISK_SIZE_GB)
         self._disk_tier = disk_tier
@@ -202,8 +202,43 @@ class Resources:
     def use_spot_specified(self) -> bool:
         return self._use_spot_specified
 
+    @staticmethod
+    def _normalize_job_recovery(
+            job_recovery: Optional[Union[str, Dict[str, Any]]]
+    ) -> Optional[Union[str, Dict[str, Any]]]:
+        """A plain strategy name, or a dict with per-job knobs
+        (reference job_recovery: {strategy, max_restarts_on_errors})."""
+        if not job_recovery:
+            return None
+        if isinstance(job_recovery, str):
+            return job_recovery.lower()
+        if not isinstance(job_recovery, dict):
+            from skypilot_tpu import exceptions
+            raise exceptions.InvalidResourcesError(
+                f'job_recovery must be a string or a dict; got '
+                f'{job_recovery!r}.')
+        unknown = set(job_recovery) - {'strategy', 'max_restarts_on_errors'}
+        if unknown:
+            from skypilot_tpu import exceptions
+            raise exceptions.InvalidResourcesError(
+                f'Unknown job_recovery fields: {sorted(unknown)}')
+        normalized: Dict[str, Any] = {}
+        strategy = job_recovery.get('strategy')
+        if strategy:
+            normalized['strategy'] = str(strategy).lower()
+        max_restarts = job_recovery.get('max_restarts_on_errors')
+        if max_restarts is not None:
+            try:
+                normalized['max_restarts_on_errors'] = int(max_restarts)
+            except (TypeError, ValueError):
+                from skypilot_tpu import exceptions
+                raise exceptions.InvalidResourcesError(
+                    f'job_recovery.max_restarts_on_errors must be an '
+                    f'integer; got {max_restarts!r}.') from None
+        return normalized or None
+
     @property
-    def job_recovery(self) -> Optional[str]:
+    def job_recovery(self) -> Optional[Union[str, Dict[str, Any]]]:
         return self._job_recovery
 
     @property

@@ -21,7 +21,9 @@ from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import status_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -48,16 +50,37 @@ _PROBE_FAILURE_TERMINATE_THRESHOLD = 10
 # are garbage-collected.
 _FAILED_ROW_TTL_SECONDS = 1800.0
 
+# A probe request may never hang past this connect budget even when a
+# spec asks for a long read timeout (a replica that won't even accept
+# the TCP connection is down, not slow).
+_PROBE_CONNECT_TIMEOUT_SECONDS = 5.0
+_DEFAULT_PROBE_TIMEOUT_SECONDS = 15.0
+
+# Replica-cluster teardown goes through the shared RetryPolicy: cloud
+# teardown calls are flaky exactly when the cloud is having the bad
+# day that killed the replica. ClusterDoesNotExist is success.
+_TERMINATE_RETRY_POLICY = retry_lib.RetryPolicy(
+    max_attempts=3,
+    initial_backoff=1.0,
+    max_backoff=10.0,
+    jitter='full',
+    retryable=lambda e: not isinstance(e, exceptions.ClusterDoesNotExist))
+
 
 class ReplicaManager:
 
     def __init__(self, service_name: str, spec: ServiceSpec,
                  task_config: dict,
-                 drain_fn: Optional[Callable[[str], None]] = None
-                 ) -> None:
+                 drain_fn: Optional[Callable[[str], None]] = None,
+                 not_ready_threshold: int = _NOT_READY_THRESHOLD,
+                 probe_failure_terminate_threshold: int = (
+                     _PROBE_FAILURE_TERMINATE_THRESHOLD)) -> None:
         self.service_name = service_name
         self.spec = spec
         self.task_config = task_config
+        self.not_ready_threshold = not_ready_threshold
+        self.probe_failure_terminate_threshold = (
+            probe_failure_terminate_threshold)
         # Blocking callable draining a replica URL at the LB before a
         # VOLUNTARY teardown (downscale / rolling update); involuntary
         # paths (preemption, failed probes) skip it — the replica is
@@ -183,7 +206,8 @@ class ReplicaManager:
             remove: bool = False) -> None:
         from skypilot_tpu import core
         try:
-            core.down(self._cluster_name(replica_id))
+            _TERMINATE_RETRY_POLICY.call(core.down,
+                                         self._cluster_name(replica_id))
         except exceptions.ClusterDoesNotExist:
             pass
         except Exception:  # pylint: disable=broad-except
@@ -244,11 +268,25 @@ class ReplicaManager:
             return None
         return f'http://{ips[0]}:{self._replica_port(replica_id, spec)}'
 
-    def _probe_ready(self, url: str, spec: ServiceSpec) -> bool:
+    def _probe_ready(self, url: str, spec: ServiceSpec,
+                     replica_id: Optional[int] = None) -> bool:
+        """One readiness probe with an explicit, always-bounded
+        per-request timeout. A single failed probe never declares a
+        replica dead — probe_all counts consecutive failures against
+        not_ready_threshold / probe_failure_terminate_threshold."""
+        fault = fault_injection.poll('serve.replica.probe_ready',
+                                     replica_id=replica_id, url=url)
+        if fault is not None:
+            return False
+        read_timeout = (_DEFAULT_PROBE_TIMEOUT_SECONDS
+                        if spec.readiness_timeout_seconds is None
+                        else spec.readiness_timeout_seconds)
+        connect_timeout = min(_PROBE_CONNECT_TIMEOUT_SECONDS,
+                              read_timeout)
         try:
             resp = requests.get(
                 url.rstrip('/') + spec.readiness_path,
-                timeout=spec.readiness_timeout_seconds)
+                timeout=(connect_timeout, read_timeout))
             return resp.status_code < 500
         except requests.RequestException:
             return False
@@ -286,7 +324,8 @@ class ReplicaManager:
                 self._terminate_in_background(rid, remove=True)
                 continue
             url = self._replica_url(rid, cluster, spec)
-            ready = url is not None and self._probe_ready(url, spec)
+            ready = url is not None and self._probe_ready(
+                url, spec, replica_id=rid)
             if ready:
                 self._failed_probes[rid] = 0
                 serve_state.set_replica_status(self.service_name, rid,
@@ -297,7 +336,7 @@ class ReplicaManager:
                 self._failed_probes[rid] = (
                     self._failed_probes.get(rid, 0) + 1)
                 streak = self._failed_probes[rid]
-                if streak >= _PROBE_FAILURE_TERMINATE_THRESHOLD:
+                if streak >= self.probe_failure_terminate_threshold:
                     # App is dead though the cluster is UP: tear the
                     # replica down so reconcile replaces it, instead
                     # of letting a broken replica hold a slot forever.
@@ -311,7 +350,7 @@ class ReplicaManager:
                     # crash-looping app can't relaunch forever).
                     self._terminate_in_background(
                         rid, ReplicaStatus.FAILED_PROBING)
-                elif streak >= _NOT_READY_THRESHOLD:
+                elif streak >= self.not_ready_threshold:
                     # Transient blips tolerated; sustained demotes (LB
                     # stops routing to it).
                     serve_state.set_replica_status(

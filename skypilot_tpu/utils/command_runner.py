@@ -23,7 +23,9 @@ import subprocess
 from typing import Dict, List, Optional, Tuple, Union
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
@@ -91,6 +93,20 @@ class CommandRunner:
         if check and rc != 0:
             raise exceptions.CommandError(rc, cmd_str, stderr)
 
+    def _injected_run_fault(
+            self, check: bool, require_outputs: bool,
+            cmd_str: str) -> Optional[Union[int, Tuple[int, str, str]]]:
+        """Chaos site `command_runner.run`: a fired ssh_failure plays a
+        dead transport — exit code 255 exactly like a real ssh client,
+        so check= semantics and callers behave identically."""
+        fault = fault_injection.poll('command_runner.run',
+                                     host_id=self.host_id, ip=self.ip)
+        if fault is None:
+            return None
+        stderr = f'[fault-injection] {fault.kind.value} on {self.host_id}'
+        self._maybe_raise(check, 255, cmd_str, stderr)
+        return (255, '', stderr) if require_outputs else 255
+
 
 class LocalProcessRunner(CommandRunner):
     """Runs commands locally inside a per-host sandbox dir.
@@ -129,6 +145,9 @@ class LocalProcessRunner(CommandRunner):
             check: bool = False,
             line_processor=None) -> Union[int, Tuple[int, str, str]]:
         script = _as_script(cmd)
+        injected = self._injected_run_fault(check, require_outputs, script)
+        if injected is not None:
+            return injected
         full_env = dict(os.environ)
         full_env['HOME'] = self.host_dir
         # Keep the framework importable inside the sandbox.
@@ -392,6 +411,9 @@ class SSHCommandRunner(CommandRunner):
             check: bool = False,
             line_processor=None) -> Union[int, Tuple[int, str, str]]:
         script = _as_script(cmd)
+        injected = self._injected_run_fault(check, require_outputs, script)
+        if injected is not None:
+            return injected
         if env:
             exports = '; '.join(
                 f'export {k}={shlex.quote(v)}' for k, v in env.items())
@@ -481,6 +503,9 @@ class KubernetesCommandRunner(CommandRunner):
             check: bool = False,
             line_processor=None) -> Union[int, Tuple[int, str, str]]:
         script = _as_script(cmd)
+        injected = self._injected_run_fault(check, require_outputs, script)
+        if injected is not None:
+            return injected
         if env:
             exports = '; '.join(
                 f'export {k}={shlex.quote(v)}' for k, v in env.items())
@@ -577,6 +602,9 @@ class KubernetesPortForwardRunner(SSHCommandRunner):
     dead tunnel process is restarted on the next call).
     """
 
+    # Overridable clock so tunnel-readiness tests run wall-clock-free.
+    _clock = retry_lib.REAL_CLOCK
+
     def __init__(self, namespace: str, pod: str, ssh_user: str,
                  ssh_private_key: str,
                  context: Optional[str] = None,
@@ -608,9 +636,15 @@ class KubernetesPortForwardRunner(SSHCommandRunner):
 
     def ensure_tunnel(self, timeout: float = 30.0) -> int:
         """Start (or restart) the port-forward; returns the local
-        port. Readiness = the local socket accepts a connection."""
+        port. Readiness = the local socket accepts a connection.
+
+        The readiness wait runs on the shared RetryPolicy (overall
+        deadline, monotonic clock) instead of a hand-rolled
+        ``time.time()`` loop, so tests drive it with a FakeClock.
+        """
         import socket
-        import time as time_lib
+        fault_injection.inject('command_runner.ensure_tunnel',
+                               host_id=self.host_id)
         if self._tunnel is not None and self._tunnel.poll() is None:
             return self.port
         local_port = self._free_port()
@@ -624,8 +658,14 @@ class KubernetesPortForwardRunner(SSHCommandRunner):
         # on a long-lived agent/controller host.
         import weakref
         weakref.finalize(self, _terminate_tunnel, self._tunnel)
-        deadline = time_lib.time() + timeout
-        while time_lib.time() < deadline:
+        policy = retry_lib.RetryPolicy(max_attempts=None,
+                                       initial_backoff=0.2,
+                                       multiplier=1.0,
+                                       jitter='none',
+                                       deadline=timeout,
+                                       clock=self._clock)
+        state = policy.new_state()
+        while True:
             if self._tunnel.poll() is not None:
                 raise exceptions.CommandError(
                     self._tunnel.returncode or 1,
@@ -636,12 +676,12 @@ class KubernetesPortForwardRunner(SSHCommandRunner):
                         ('127.0.0.1', local_port), timeout=1):
                     break
             except OSError:
-                time_lib.sleep(0.2)
-        else:
-            self.close()
-            raise exceptions.CommandError(
-                1, ' '.join(self._tunnel_cmd(local_port)),
-                f'port-forward tunnel not ready in {timeout}s')
+                if not state.should_retry():
+                    self.close()
+                    raise exceptions.CommandError(
+                        1, ' '.join(self._tunnel_cmd(local_port)),
+                        f'port-forward tunnel not ready in {timeout}s')
+                state.sleep()
         self.port = local_port
         # Control path keys on (ip, port); the port just changed.
         self._control_path = os.path.expanduser(

@@ -10,7 +10,6 @@ import getpass
 import hashlib
 import os
 import re
-import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -157,41 +156,25 @@ def retry(fn: Optional[Callable] = None,
           max_retries: int = 3,
           initial_backoff: float = 1.0,
           exceptions_to_retry=(Exception,)) -> Callable:
-    """Exponential-backoff retry decorator."""
+    """Retry decorator — thin sugar over the one shared RetryPolicy
+    implementation (utils/retry.py)."""
     if fn is None:
         return functools.partial(retry,
                                  max_retries=max_retries,
                                  initial_backoff=initial_backoff,
                                  exceptions_to_retry=exceptions_to_retry)
 
+    from skypilot_tpu.utils import retry as retry_lib
+    policy = retry_lib.RetryPolicy(max_attempts=max_retries,
+                                   initial_backoff=initial_backoff,
+                                   jitter='none',
+                                   retryable=exceptions_to_retry)
+
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        backoff = initial_backoff
-        for attempt in range(max_retries):
-            try:
-                return fn(*args, **kwargs)
-            except exceptions_to_retry:
-                if attempt == max_retries - 1:
-                    raise
-                time.sleep(backoff)
-                backoff *= 2
+        return policy.call(fn, *args, **kwargs)
 
     return wrapper
-
-
-class Backoff:
-    """Capped exponential backoff with jitter-free determinism for tests."""
-
-    def __init__(self, initial: float = 5.0, cap: float = 300.0,
-                 factor: float = 1.6) -> None:
-        self._value = initial
-        self._cap = cap
-        self._factor = factor
-
-    def current_backoff(self) -> float:
-        value = self._value
-        self._value = min(self._value * self._factor, self._cap)
-        return value
 
 
 def format_exception(e: BaseException, use_bracket: bool = False) -> str:

@@ -1,0 +1,346 @@
+"""Deterministic fault-injection harness (chaos testing without a cloud).
+
+The paper's robustness story — zone/region failover with blocked
+resource sets, spot-preemption recovery, replica replacement — is
+exercised hermetically by injecting typed failures at *named sites*
+threaded through the stack:
+
+========================================== =============================
+site                                       instrumented in
+========================================== =============================
+``provision.<cloud>.<op>``                 provision/__init__.py router
+                                           (e.g. ``provision.local.
+                                           run_instances``)
+``provisioner.post_provision_runtime_setup`` provision/provisioner.py
+``command_runner.run``                     utils/command_runner.py
+``command_runner.ensure_tunnel``           utils/command_runner.py
+``agent.worker_probe``                     agent/driver.py
+``jobs.controller.heartbeat``              jobs/controller.py
+``serve.replica.probe_ready``              serve/replica_managers.py
+========================================== =============================
+
+A **fault plan** is JSON (env var ``SKYTPU_FAULT_PLAN``, either inline
+or a path to a file — child processes inherit the env var, so the
+detached jobs controller, agentd and job drivers all see the same
+plan) or a :func:`fault_plan` context manager for in-process tests::
+
+    {"seed": 42, "record": "/tmp/faults.jsonl",
+     "faults": [{"site": "jobs.controller.heartbeat",
+                 "kind": "preemption", "after": 2, "times": 1,
+                 "match": {"cluster_name": "spot-1"}}]}
+
+Per fault spec:
+
+- ``site``: exact name or ``fnmatch`` pattern (``provision.*``).
+- ``kind``: one of :class:`FaultKind`.
+- ``after``: calls to let PASS at this site before firing (default 0).
+- ``times``: max firings; ``null`` = unlimited (default 1).
+- ``probability``: fire chance per eligible call, drawn from the
+  plan's seeded RNG — same seed, same call sequence => same faults.
+  Specs with probability 1.0 never touch the RNG, so count-based
+  plans are exactly deterministic regardless of interleaving.
+- ``match``: equality filter on the site's context kwargs (a site
+  call with ``rank=1`` only matches ``{"match": {"rank": 1}}``).
+
+``record`` appends one JSON line per injected fault (pid, site, kind,
+context) — tests assert the exact injected sequence across process
+boundaries.
+
+Sites call :func:`poll` (returns the fired spec or None — the site
+decides how the failure manifests, e.g. a 255 exit code) or
+:func:`inject` (raises the typed exception for the kind). With no
+active plan both are a near-free attribute check, so production
+behavior and tier-1 runtime are unchanged by default.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import fnmatch
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+FAULT_PLAN_ENV = 'SKYTPU_FAULT_PLAN'
+
+
+class FaultKind(str, enum.Enum):
+    PREEMPTION = 'preemption'
+    PARTIAL_GANG_LOSS = 'partial_gang_loss'
+    QUOTA_EXCEEDED = 'quota_exceeded'
+    STOCKOUT = 'stockout'
+    PROVISION_FAILURE = 'provision_failure'
+    SSH_FAILURE = 'ssh_failure'
+    TUNNEL_FAILURE = 'tunnel_failure'
+    PROBE_TIMEOUT = 'probe_timeout'
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    kind: FaultKind
+    after: int = 0
+    times: Optional[int] = 1
+    probability: float = 1.0
+    match: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Site-interpreted parameters (e.g. {"host_index": 1} for
+    # partial_gang_loss at the controller heartbeat). NOT used for
+    # matching — match keys must be context kwargs the site passes.
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Mutable counters (guarded by the plan lock).
+    seen: int = 0
+    fired: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'FaultSpec':
+        known = {'site', 'kind', 'after', 'times', 'probability',
+                 'match', 'params'}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f'Unknown fault-spec fields: {sorted(unknown)}')
+        return cls(site=d['site'],
+                   kind=FaultKind(d['kind']),
+                   after=int(d.get('after', 0)),
+                   times=(None if d.get('times', 1) is None else
+                          int(d.get('times', 1))),
+                   probability=float(d.get('probability', 1.0)),
+                   match=dict(d.get('match') or {}),
+                   params=dict(d.get('params') or {}))
+
+
+class FaultPlan:
+    """A seeded, counting schedule of typed failures."""
+
+    def __init__(self,
+                 faults: List[Union[FaultSpec, Dict[str, Any]]],
+                 seed: int = 0,
+                 record_path: Optional[str] = None) -> None:
+        import random
+        self.specs = [
+            f if isinstance(f, FaultSpec) else FaultSpec.from_dict(f)
+            for f in faults
+        ]
+        self.seed = seed
+        self.record_path = record_path
+        self.log: List[Dict[str, Any]] = []
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_json(cls, raw: Union[str, Dict[str, Any]]) -> 'FaultPlan':
+        if isinstance(raw, str):
+            raw = json.loads(raw)
+        return cls(faults=raw.get('faults') or [],
+                   seed=int(raw.get('seed', 0)),
+                   record_path=raw.get('record'))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            'seed': self.seed,
+            'record': self.record_path,
+            'faults': [{
+                'site': s.site,
+                'kind': s.kind.value,
+                'after': s.after,
+                'times': s.times,
+                'probability': s.probability,
+                'match': s.match,
+                'params': s.params,
+            } for s in self.specs],
+        })
+
+    def _matches(self, spec: FaultSpec, site: str,
+                 context: Dict[str, Any]) -> bool:
+        if not (spec.site == site or fnmatch.fnmatch(site, spec.site)):
+            return False
+        return all(context.get(k) == v for k, v in spec.match.items())
+
+    def pending(self, site: str,
+                kinds: Optional[tuple] = None) -> bool:
+        """True if some spec could still fire at this site (budget
+        left; `after`/match not considered). A cheap gate for sites
+        whose pre-fault work is expensive — no counters are touched."""
+        with self._lock:
+            return any(
+                (spec.site == site or fnmatch.fnmatch(site, spec.site))
+                and (kinds is None or spec.kind in kinds)
+                and (spec.times is None or spec.fired < spec.times)
+                for spec in self.specs)
+
+    def poll(self, site: str, *, kinds: Optional[tuple] = None,
+             **context: Any) -> Optional[FaultSpec]:
+        """One site call: returns the spec that fired, or None.
+
+        ``kinds`` restricts which fault kinds this site consumes:
+        specs of other kinds are left untouched (not seen-counted,
+        not fired), so a site never burns the budget of — or records
+        — a fault it cannot act on.
+        """
+        with self._lock:
+            for spec in self.specs:
+                if kinds is not None and spec.kind not in kinds:
+                    continue
+                if not self._matches(spec, site, context):
+                    continue
+                spec.seen += 1
+                if spec.seen <= spec.after:
+                    continue
+                if spec.times is not None and spec.fired >= spec.times:
+                    continue
+                # probability==1.0 skips the RNG: pure-count plans stay
+                # deterministic no matter how threads interleave sites.
+                if spec.probability < 1.0 and (self._rng.random() >
+                                               spec.probability):
+                    continue
+                spec.fired += 1
+                self._record(spec, site, context)
+                return spec
+        return None
+
+    def _record(self, spec: FaultSpec, site: str,
+                context: Dict[str, Any]) -> None:
+        entry = {
+            'pid': os.getpid(),
+            'site': site,
+            'kind': spec.kind.value,
+            'fired': spec.fired,
+            'context': {k: repr(v) for k, v in context.items()},
+        }
+        self.log.append(entry)
+        if self.record_path:
+            try:
+                # One small write per line: atomic enough on POSIX for
+                # concurrent appends from several processes.
+                with open(self.record_path, 'a', encoding='utf-8') as f:
+                    f.write(json.dumps(entry) + '\n')
+            except OSError:
+                pass
+
+
+# ----------------------------------------------------------------------
+# Active-plan resolution: explicit (context manager) beats env var.
+_active: Optional[FaultPlan] = None
+_env_cache: Optional[tuple] = None  # (raw env value, parsed plan)
+_env_lock = threading.Lock()
+
+
+def _plan_from_env() -> Optional[FaultPlan]:
+    global _env_cache
+    raw = os.environ.get(FAULT_PLAN_ENV)
+    if not raw:
+        return None
+    # The lock makes the parse once-per-process: concurrent first
+    # polls (one worker-probe thread per rank) must share ONE plan —
+    # separate plans mean separate counters, and a times:1 fault
+    # would fire once per thread.
+    with _env_lock:
+        if _env_cache is not None and _env_cache[0] == raw:
+            return _env_cache[1]
+        text = raw
+        path = raw[1:] if raw.startswith('@') else raw
+        if not raw.lstrip().startswith('{') and os.path.exists(path):
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+        try:
+            plan = FaultPlan.from_json(text)
+        except (ValueError, KeyError) as e:
+            # Fail loudly AND clearly: this surfaces deep inside
+            # production sites, so name the env var (a bare
+            # JSONDecodeError from a typo'd path reads as a
+            # provisioning crash).
+            raise ValueError(
+                f'Invalid {FAULT_PLAN_ENV} fault plan '
+                f'({raw[:120]!r}): {e}') from e
+        _env_cache = (raw, plan)
+        return plan
+
+
+def active_plan() -> Optional[FaultPlan]:
+    if _active is not None:
+        return _active
+    if FAULT_PLAN_ENV not in os.environ:
+        return None
+    return _plan_from_env()
+
+
+def poll(site: str, *, kinds: Optional[tuple] = None,
+         **context: Any) -> Optional[FaultSpec]:
+    """Fast no-op without a plan; otherwise one plan poll."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.poll(site, kinds=kinds, **context)
+
+
+def inject(site: str, **context: Any) -> None:
+    """Poll the site and raise the typed exception for a fired fault."""
+    spec = poll(site, **context)
+    if spec is not None:
+        raise make_exception(spec, site)
+
+
+def make_exception(spec: FaultSpec, site: str) -> Exception:
+    """The exception a fired fault manifests as (typed: the failover
+    machinery dispatches on these classes)."""
+    from skypilot_tpu import exceptions
+    msg = f'[fault-injection] {spec.kind.value} at {site}'
+    if spec.kind is FaultKind.QUOTA_EXCEEDED:
+        return exceptions.QuotaExceededError(msg)
+    if spec.kind is FaultKind.STOCKOUT:
+        return exceptions.StockoutError(msg)
+    if spec.kind in (FaultKind.PROVISION_FAILURE, FaultKind.PREEMPTION,
+                     FaultKind.PARTIAL_GANG_LOSS):
+        return exceptions.ProvisionError(msg)
+    if spec.kind in (FaultKind.SSH_FAILURE, FaultKind.TUNNEL_FAILURE):
+        return exceptions.CommandError(255, f'<{site}>', msg)
+    if spec.kind is FaultKind.PROBE_TIMEOUT:
+        return TimeoutError(msg)
+    return AssertionError(f'unmapped fault kind {spec.kind}')
+
+
+class fault_plan:
+    """Context manager activating a plan in-process AND via the env
+    var, so processes spawned inside the block (jobs controller,
+    agentd, drivers) inherit it::
+
+        with fault_injection.fault_plan(
+                faults=[{'site': 'serve.replica.probe_ready',
+                         'kind': 'probe_timeout', 'times': None}],
+                record=str(tmp / 'faults.jsonl')):
+            ...
+    """
+
+    def __init__(self,
+                 faults: Optional[List[Dict[str, Any]]] = None,
+                 *,
+                 plan: Optional[FaultPlan] = None,
+                 seed: int = 0,
+                 record: Optional[str] = None) -> None:
+        if plan is None:
+            plan = FaultPlan(faults or [], seed=seed, record_path=record)
+        self.plan = plan
+        self._saved_active: Optional[FaultPlan] = None
+        self._saved_env: Optional[str] = None
+
+    def __enter__(self) -> FaultPlan:
+        global _active, _env_cache
+        self._saved_active = _active
+        self._saved_env = os.environ.get(FAULT_PLAN_ENV)
+        _active = self.plan
+        os.environ[FAULT_PLAN_ENV] = self.plan.to_json()
+        # Drop any cached env plan: its consumed counters must not
+        # leak into (or out of) this activation.
+        with _env_lock:
+            _env_cache = None
+        return self.plan
+
+    def __exit__(self, *exc_info: Any) -> None:
+        global _active, _env_cache
+        _active = self._saved_active
+        if self._saved_env is None:
+            os.environ.pop(FAULT_PLAN_ENV, None)
+        else:
+            os.environ[FAULT_PLAN_ENV] = self._saved_env
+        with _env_lock:
+            _env_cache = None

@@ -1,0 +1,172 @@
+"""Unified retry/backoff policy — the ONE retry implementation.
+
+Every layer of the stack used to roll its own sleep loop
+(provisioning failover backoff, managed-jobs launch gap, replica
+termination retries, tunnel-establishment deadline polling). This
+module replaces them with a single :class:`RetryPolicy`:
+
+- exponential backoff with a cap,
+- full jitter (seedable, so chaos tests replay identical schedules),
+- an optional overall deadline on top of the attempt cap,
+- a typed retryable-error predicate (exception classes or callable),
+- a monotonic :class:`Clock` abstraction so tests run wall-clock-free
+  (:class:`FakeClock` advances virtual time instead of sleeping).
+
+Two usage shapes:
+
+    policy.call(fn, *args)            # run fn with retries
+
+    state = policy.new_state()        # explicit loop control
+    while True:
+        try:
+            return attempt()
+        except exceptions.CommandError as e:
+            if not policy.is_retryable(e) or not state.should_retry():
+                raise
+            state.sleep()
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+
+class Clock:
+    """Monotonic clock + sleep — the only time source retries use."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+REAL_CLOCK = Clock()
+
+
+class FakeClock(Clock):
+    """Virtual clock for tests: sleeping advances time instantly."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = start
+        self.sleeps: list = []
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self._now += max(0.0, seconds)
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+
+Retryable = Union[Tuple[type, ...], Sequence[type],
+                  Callable[[BaseException], bool]]
+
+
+class RetryState:
+    """Per-call-site mutable state: attempt counter, elapsed time, RNG."""
+
+    def __init__(self, policy: 'RetryPolicy') -> None:
+        self.policy = policy
+        self.attempt = 0  # completed (failed) attempts so far
+        self._backoff = policy.initial_backoff
+        self._rng = random.Random(policy.seed)
+        self._started = policy.clock.now()
+
+    def elapsed(self) -> float:
+        return self.policy.clock.now() - self._started
+
+    def should_retry(self, exc: Optional[BaseException] = None) -> bool:
+        """May another attempt be made (after the one that just failed)?"""
+        if exc is not None and not self.policy.is_retryable(exc):
+            return False
+        p = self.policy
+        if p.max_attempts is not None and self.attempt + 1 >= p.max_attempts:
+            return False
+        if p.deadline is not None and self.elapsed() >= p.deadline:
+            return False
+        return True
+
+    def next_backoff(self) -> float:
+        """Backoff for the attempt that just failed; advances the state."""
+        self.attempt += 1
+        base = self._backoff
+        self._backoff = min(self._backoff * self.policy.multiplier,
+                            self.policy.max_backoff)
+        if self.policy.jitter == 'full':
+            backoff = self._rng.uniform(0.0, base)
+        else:
+            backoff = base
+        if self.policy.deadline is not None:
+            remaining = self.policy.deadline - self.elapsed()
+            backoff = max(0.0, min(backoff, remaining))
+        return backoff
+
+    def sleep(self) -> float:
+        """Sleep the next backoff on the policy clock; returns seconds."""
+        backoff = self.next_backoff()
+        self.policy.clock.sleep(backoff)
+        return backoff
+
+
+class RetryPolicy:
+    """Immutable retry schedule; produces :class:`RetryState` per call.
+
+    max_attempts=None means unlimited (bounded only by ``deadline``,
+    if any). ``retryable`` is a tuple of exception classes or a
+    predicate ``exc -> bool``. ``seed`` pins the jitter RNG so a chaos
+    test replays the exact same schedule.
+    """
+
+    def __init__(self,
+                 *,
+                 max_attempts: Optional[int] = 3,
+                 initial_backoff: float = 1.0,
+                 max_backoff: float = 300.0,
+                 multiplier: float = 2.0,
+                 jitter: str = 'full',
+                 deadline: Optional[float] = None,
+                 retryable: Retryable = (Exception,),
+                 seed: Optional[int] = None,
+                 clock: Optional[Clock] = None) -> None:
+        assert jitter in ('full', 'none'), jitter
+        self.max_attempts = max_attempts
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self.deadline = deadline
+        # A bare exception class is a class, and classes are callable:
+        # normalize it to a tuple up front so it is matched with
+        # isinstance, never mistaken for a predicate.
+        if isinstance(retryable, type) and issubclass(retryable,
+                                                      BaseException):
+            retryable = (retryable,)
+        self._retryable = retryable
+        self.seed = seed
+        self.clock = clock or REAL_CLOCK
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        if callable(self._retryable):
+            return bool(self._retryable(exc))
+        return isinstance(exc, tuple(self._retryable))
+
+    def new_state(self) -> RetryState:
+        return RetryState(self)
+
+    def call(self, fn: Callable[..., Any], *args: Any,
+             **kwargs: Any) -> Any:
+        """Run fn; retry per the policy; re-raise the last error."""
+        state = self.new_state()
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # pylint: disable=broad-except
+                if not state.should_retry(e):
+                    raise
+                state.sleep()

@@ -28,7 +28,17 @@ _RESOURCES_SCHEMA = {
         'cpus': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
         'memory': {'anyOf': [{'type': 'string'}, {'type': 'number'}]},
         'use_spot': {'type': 'boolean'},
-        'job_recovery': {'type': 'string'},
+        'job_recovery': {
+            'anyOf': [
+                {'type': 'string'},
+                {'type': 'object',
+                 'additionalProperties': False,
+                 'properties': {
+                     'strategy': {'type': 'string'},
+                     'max_restarts_on_errors': {'type': 'integer'},
+                 }},
+            ]
+        },
         'disk_size': {'type': 'integer'},
         'disk_tier': {'type': 'string'},
         'image_id': {'type': 'string'},

@@ -84,24 +84,44 @@ def process_alive(
 
 
 def kill_process_tree(pid: int, include_parent: bool = True) -> None:
-    """SIGTERM then SIGKILL a whole process tree rooted at pid."""
+    """SIGTERM then SIGKILL a whole process tree rooted at pid.
+
+    Tolerates the tree racing us to the grave: any member (including
+    the root, after the initial lookup) may exit between enumeration
+    and signalling — during teardown that is the NORMAL case, not an
+    error, so every psutil call is guarded.
+    """
     try:
         root = psutil.Process(pid)
+    except psutil.Error:
+        return  # already gone (or unreachable: nothing we can do)
+    try:
+        procs = root.children(recursive=True)
     except psutil.NoSuchProcess:
         return
-    procs = root.children(recursive=True)
+    except psutil.Error as e:
+        # Zombie/access races while walking children: we cannot kill
+        # what we cannot enumerate — still kill the root (its psutil
+        # identity is create-time-checked, so no pid-recycle risk),
+        # but say so: a surviving child tree is a leak worth a log.
+        logger.warning('kill_process_tree(%d): cannot enumerate '
+                       'children (%r); killing root only.', pid, e)
+        procs = []
     if include_parent:
         procs.append(root)
     for p in procs:
         try:
             p.terminate()
-        except psutil.NoSuchProcess:
+        except psutil.Error:
             pass
-    _, alive = psutil.wait_procs(procs, timeout=3)
+    try:
+        _, alive = psutil.wait_procs(procs, timeout=3)
+    except psutil.Error:
+        alive = procs
     for p in alive:
         try:
             p.kill()
-        except psutil.NoSuchProcess:
+        except psutil.Error:
             pass
 
 

@@ -31,6 +31,28 @@ os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 
 import pytest  # noqa: E402
 
+# New robustness suites (retry/fault-injection units, recovery-strategy
+# coverage, chaos integration tests) run AFTER the original tests:
+# chaos tests drive real local clusters and are the most expensive
+# items in the fast tier, so a time-capped CI run keeps maximum early
+# signal from the unit tests. The sort is stable — relative order
+# within each group is unchanged.
+_LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
+               'test_recovery_strategy.py')
+
+
+def pytest_collection_modifyitems(config, items):
+    del config
+
+    def weight(item):
+        if item.get_closest_marker('chaos'):
+            return 2
+        if os.path.basename(str(item.fspath)) in _LATE_FILES:
+            return 1
+        return 0
+
+    items.sort(key=weight)
+
 
 @pytest.fixture(autouse=True)
 def isolated_state(tmp_path, monkeypatch):

@@ -1,0 +1,304 @@
+"""Chaos integration tests: seeded fault plans driving the REAL local
+backend through preemption recovery, bounded launch retries, and
+replica replacement. Deterministic plans (count-based, probability
+1.0) run in tier-1; randomized sweeps are marked slow.
+"""
+import json
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+_SERVER_CMD = (
+    'python -c "'
+    'import http.server, os; '
+    'http.server.HTTPServer((\'127.0.0.1\', '
+    'int(os.environ[\'SKYTPU_SERVE_PORT\'])), '
+    'http.server.SimpleHTTPRequestHandler).serve_forever()"')
+
+
+def _read_record(path):
+    if not path.exists():
+        return []
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+# ----------------------------------------------------------- jobs
+
+def test_mid_job_preemption_recovers_and_blocks_region(
+        isolated_state, tmp_path, monkeypatch):
+    """Seeded plan preempts the cluster on the 3rd RUNNING heartbeat;
+    EAGER_NEXT_REGION blocks the preempted region (the only local
+    region — provable by its all-blocked fallback), relaunches, and
+    the managed job SUCCEEDS with recovery_count >= 1."""
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+
+    record_path = tmp_path / 'faults.jsonl'
+    plan = {
+        'seed': 42,
+        'record': str(record_path),
+        'faults': [{'site': 'jobs.controller.heartbeat',
+                    'kind': 'preemption', 'after': 2, 'times': 1}],
+    }
+    monkeypatch.setenv(fi.FAULT_PLAN_ENV, json.dumps(plan))
+
+    marker = tmp_path / 'attempt'
+    task = task_lib.Task(
+        'chaos-spot',
+        run=f'if [ -f {marker} ]; then echo recovered; '
+        f'else touch {marker}; sleep 120; fi')
+    task.set_resources(
+        resources_lib.Resources(cloud='local', use_spot=True))
+    job_id = jobs_core.launch(task, controller_check_gap=0.3)
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job and job['status'].is_terminal():
+            break
+        time.sleep(0.5)
+    assert job['status'] == state.ManagedJobStatus.SUCCEEDED, job
+    assert job['recovery_count'] >= 1, job
+
+    # The injected fault sequence is exactly the plan (cross-process:
+    # the record file was appended by the controller process).
+    fired = _read_record(record_path)
+    assert [f['kind'] for f in fired] == ['preemption']
+    assert fired[0]['site'] == 'jobs.controller.heartbeat'
+
+    # EAGER_NEXT_REGION really blocked the preempted region: with
+    # local's single region every candidate was blocked, and the
+    # strategy logged its retry-unrestricted fallback.
+    log_text = open(job['log_path'], encoding='utf-8').read()
+    assert 'Other regions full; retrying all regions.' in log_text
+    assert '[fault-injection] acting preemption' in log_text
+
+
+def test_flaky_runner_bounded_retries_then_typed_failure(
+        isolated_state, tmp_path, monkeypatch):
+    """Every post-provision setup hits an injected ssh_failure: the
+    launch retries exactly max_attempts times on the shared
+    RetryPolicy, then surfaces a typed ProvisionError."""
+    from skypilot_tpu.jobs import recovery_strategy
+    from skypilot_tpu.utils import retry as retry_lib
+
+    clock = retry_lib.FakeClock()
+    monkeypatch.setattr(
+        recovery_strategy, '_launch_retry_policy',
+        lambda: retry_lib.RetryPolicy(max_attempts=3,
+                                      initial_backoff=1.0,
+                                      jitter='none', clock=clock))
+    task = task_lib.Task('chaos-flaky', run='echo hi')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    executor = recovery_strategy.StrategyExecutor.make(
+        'chaos-flaky', task)
+
+    record_path = tmp_path / 'faults.jsonl'
+    with fi.fault_plan(
+            faults=[{'site': 'provisioner.post_provision_runtime_setup',
+                     'kind': 'ssh_failure', 'times': None}],
+            record=str(record_path)):
+        with pytest.raises(exceptions.ProvisionError) as err:
+            executor.launch()
+    assert 'after 3 attempts' in str(err.value)
+    assert '[fault-injection] ssh_failure' in str(err.value)
+    # Bounded: exactly one injection per attempt, no wall-clock sleeps.
+    assert len(_read_record(record_path)) == 3
+    assert clock.sleeps == [1.0, 2.0]
+    executor.terminate_cluster()  # reap the half-provisioned cluster
+
+
+def test_partial_gang_loss_fails_job_not_cluster(
+        isolated_state, tmp_path, monkeypatch):
+    """A fired agent.worker_probe fault on one rank of a 1-host gang
+    converts into a clean job failure (worker declared dead) while the
+    cluster itself stays UP — a user-failure, not a preemption, so the
+    managed job is NOT recovered."""
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+
+    record_path = tmp_path / 'faults.jsonl'
+    plan = {
+        'record': str(record_path),
+        'faults': [{'site': 'agent.worker_probe', 'kind':
+                    'partial_gang_loss', 'times': None,
+                    'match': {'rank': 0}}],
+    }
+    monkeypatch.setenv(fi.FAULT_PLAN_ENV, json.dumps(plan))
+    monkeypatch.setenv('SKYTPU_WORKER_PROBE_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYTPU_WORKER_PROBE_THRESHOLD', '3')
+
+    task = task_lib.Task('chaos-gangloss', run='sleep 120')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    job_id = jobs_core.launch(task, controller_check_gap=0.3)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job and job['status'].is_terminal():
+            break
+        time.sleep(0.5)
+    assert job['status'] == state.ManagedJobStatus.FAILED, job
+    assert job['recovery_count'] == 0, job
+    fired = _read_record(record_path)
+    assert len(fired) >= 3  # the probe threshold was really crossed
+    assert all(f['site'] == 'agent.worker_probe' for f in fired)
+
+
+# ----------------------------------------------------------- serve
+
+def _wait(predicate, timeout, desc):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.5)
+    raise TimeoutError(f'timed out waiting for {desc}')
+
+
+def test_replica_replaced_on_probe_failures_not_leaked(
+        isolated_state, tmp_path, monkeypatch):
+    """Repeated injected probe failures on a READY replica demote it,
+    then terminate it for replacement; reconcile launches a fresh
+    replica that becomes READY, and the failed replica's cluster is
+    actually gone (not leaked)."""
+    from skypilot_tpu.backend import backend_utils
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    spec = ServiceSpec(min_replicas=1, replica_port=18480,
+                       initial_delay_seconds=120,
+                       readiness_timeout_seconds=2)
+    task = task_lib.Task('rep', run=_SERVER_CMD)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    serve_state.add_service('chaossvc',
+                            json.dumps(spec.to_yaml_config()),
+                            json.dumps(task.to_yaml_config()),
+                            lb_port=0)
+    manager = replica_managers.ReplicaManager(
+        'chaossvc', spec, task.to_yaml_config(),
+        not_ready_threshold=1,
+        probe_failure_terminate_threshold=2)
+
+    def status_of(rid):
+        for r in serve_state.get_replicas('chaossvc'):
+            if r['replica_id'] == rid:
+                return r['status']
+        return None
+
+    try:
+        manager.scale_up(1, version=1)
+        _wait(lambda: (manager.probe_all() or
+                       status_of(1) is ReplicaStatus.READY),
+              timeout=90, desc='replica 1 READY')
+
+        record_path = tmp_path / 'faults.jsonl'
+        with fi.fault_plan(
+                faults=[{'site': 'serve.replica.probe_ready',
+                         'kind': 'probe_timeout', 'times': None,
+                         'match': {'replica_id': 1}}],
+                record=str(record_path)):
+            manager.probe_all()  # streak 1 >= not_ready_threshold
+            assert status_of(1) is ReplicaStatus.NOT_READY
+            manager.probe_all()  # streak 2 >= terminate threshold
+            assert status_of(1) is ReplicaStatus.FAILED_PROBING
+        assert len(_read_record(record_path)) == 2
+
+        # The dead replica's cluster is reaped (background thread).
+        _wait(lambda: backend_utils.refresh_cluster_record(
+            'chaossvc-replica-1') is None,
+              timeout=60, desc='replica 1 cluster reaped')
+
+        # Reconcile replaces it; the newcomer becomes READY while the
+        # failed row keeps counting against the crash-loop cap.
+        manager.reconcile(1)
+        _wait(lambda: (manager.probe_all() or
+                       status_of(2) is ReplicaStatus.READY),
+              timeout=90, desc='replacement replica READY')
+        assert status_of(1) is ReplicaStatus.FAILED_PROBING
+    finally:
+        manager.terminate_all()
+
+
+# ------------------------------------------------- randomized sweeps
+
+@pytest.mark.slow
+def test_randomized_probe_blips_tolerated_below_threshold(
+        isolated_state, monkeypatch):
+    """Long randomized sweep (opt-in): seeded sub-threshold probe
+    blips never demote a READY replica when every failure streak stays
+    under not_ready_threshold; and the injected sequence replays
+    identically for the same seed."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    spec = ServiceSpec(min_replicas=1, replica_port=18580,
+                       initial_delay_seconds=120,
+                       readiness_timeout_seconds=2)
+    task = task_lib.Task('rep', run=_SERVER_CMD)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    serve_state.add_service('sweepsvc',
+                            json.dumps(spec.to_yaml_config()),
+                            json.dumps(task.to_yaml_config()),
+                            lb_port=0)
+    manager = replica_managers.ReplicaManager(
+        'sweepsvc', spec, task.to_yaml_config(),
+        not_ready_threshold=5,
+        probe_failure_terminate_threshold=10)
+
+    def status_of(rid):
+        for r in serve_state.get_replicas('sweepsvc'):
+            if r['replica_id'] == rid:
+                return r['status']
+        return None
+
+    try:
+        manager.scale_up(1, version=1)
+        _wait(lambda: (manager.probe_all() or
+                       status_of(1) is ReplicaStatus.READY),
+              timeout=90, desc='replica READY')
+
+        def sweep(seed):
+            # Clean slate so both runs start from READY with a zero
+            # failure streak (status sequences must be comparable).
+            manager._failed_probes.clear()
+            manager.probe_all()
+            assert status_of(1) is ReplicaStatus.READY
+            plan = fi.FaultPlan(
+                [{'site': 'serve.replica.probe_ready',
+                  'kind': 'probe_timeout', 'times': None,
+                  'probability': 0.35}], seed=seed)
+            statuses = []
+            with fi.fault_plan(plan=plan):
+                for _ in range(60):
+                    manager.probe_all()
+                    status = status_of(1)
+                    assert status in (ReplicaStatus.READY,
+                                      ReplicaStatus.NOT_READY)
+                    statuses.append(status)
+            return statuses, len(plan.log)
+
+        statuses_a, fired_a = sweep(123)
+        assert 0 < fired_a < 60  # it really blipped both ways
+        statuses_b, fired_b = sweep(123)
+        # Same seed -> same injected fault sequence -> same FSM walk.
+        assert (statuses_a, fired_a) == (statuses_b, fired_b)
+        manager._failed_probes.clear()
+        manager.probe_all()
+        assert status_of(1) is ReplicaStatus.READY  # blips tolerated
+    finally:
+        manager.terminate_all()

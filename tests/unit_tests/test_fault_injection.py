@@ -1,0 +1,205 @@
+"""Fault-injection harness: plan parsing, counters, determinism,
+context matching, typed exceptions, env/context-manager activation."""
+import json
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import fault_injection as fi
+
+
+def _plan(*faults, seed=0, record=None):
+    return fi.FaultPlan(list(faults), seed=seed, record_path=record)
+
+
+def test_no_plan_is_noop(monkeypatch):
+    monkeypatch.delenv(fi.FAULT_PLAN_ENV, raising=False)
+    assert fi.poll('command_runner.run') is None
+    fi.inject('provision.local.run_instances')  # must not raise
+
+
+def test_after_and_times_counters():
+    plan = _plan({'site': 's', 'kind': 'ssh_failure',
+                  'after': 2, 'times': 2})
+    fired = [plan.poll('s') is not None for _ in range(6)]
+    # Passes twice, fires twice, then exhausted.
+    assert fired == [False, False, True, True, False, False]
+
+
+def test_unlimited_times():
+    plan = _plan({'site': 's', 'kind': 'ssh_failure', 'times': None})
+    assert all(plan.poll('s') for _ in range(10))
+
+
+def test_site_glob_and_context_match():
+    plan = _plan({'site': 'provision.*.run_instances',
+                  'kind': 'quota_exceeded', 'times': None,
+                  'match': {'provider': 'local'}})
+    assert plan.poll('provision.local.run_instances',
+                     provider='local') is not None
+    assert plan.poll('provision.gcp.run_instances',
+                     provider='gcp') is None
+    assert plan.poll('provision.local.wait_instances',
+                     provider='local') is None
+
+
+def test_probability_deterministic_same_seed():
+    def run(seed):
+        plan = _plan({'site': 's', 'kind': 'probe_timeout',
+                      'times': None, 'probability': 0.5}, seed=seed)
+        return [plan.poll('s') is not None for _ in range(50)]
+
+    a, b = run(7), run(7)
+    assert a == b  # same seed -> same injected fault sequence
+    assert run(8) != a  # and the seed actually matters
+    assert 5 < sum(a) < 45  # it does flip both ways
+
+
+def test_record_file_written(tmp_path):
+    record = tmp_path / 'faults.jsonl'
+    plan = _plan({'site': 's', 'kind': 'preemption', 'times': 2},
+                 record=str(record))
+    plan.poll('s', cluster_name='c1')
+    plan.poll('s', cluster_name='c1')
+    plan.poll('s', cluster_name='c1')  # exhausted: not recorded
+    lines = [json.loads(l) for l in record.read_text().splitlines()]
+    assert [l['kind'] for l in lines] == ['preemption', 'preemption']
+    assert lines[0]['site'] == 's'
+    assert lines[0]['fired'] == 1 and lines[1]['fired'] == 2
+    assert len(plan.log) == 2
+
+
+def test_typed_exceptions():
+    cases = {
+        'quota_exceeded': exceptions.QuotaExceededError,
+        'stockout': exceptions.StockoutError,
+        'provision_failure': exceptions.ProvisionError,
+        'preemption': exceptions.ProvisionError,
+        'ssh_failure': exceptions.CommandError,
+        'tunnel_failure': exceptions.CommandError,
+        'probe_timeout': TimeoutError,
+    }
+    for kind, exc_type in cases.items():
+        spec = fi.FaultSpec(site='s', kind=fi.FaultKind(kind))
+        assert isinstance(fi.make_exception(spec, 's'), exc_type), kind
+
+
+def test_inject_raises_on_fire():
+    with fi.fault_plan(faults=[{'site': 's', 'kind': 'quota_exceeded'}]):
+        with pytest.raises(exceptions.QuotaExceededError):
+            fi.inject('s')
+        fi.inject('s')  # times=1: second call passes
+
+
+def test_context_manager_sets_env_and_restores(monkeypatch):
+    monkeypatch.delenv(fi.FAULT_PLAN_ENV, raising=False)
+    import os
+    with fi.fault_plan(faults=[{'site': 's', 'kind': 'ssh_failure'}],
+                       seed=3):
+        raw = os.environ[fi.FAULT_PLAN_ENV]
+        round_trip = fi.FaultPlan.from_json(raw)
+        assert round_trip.seed == 3
+        assert round_trip.specs[0].site == 's'
+    assert fi.FAULT_PLAN_ENV not in os.environ
+    assert fi.active_plan() is None
+
+
+def test_env_plan_inline_and_file(tmp_path, monkeypatch):
+    plan_json = json.dumps(
+        {'faults': [{'site': 's', 'kind': 'ssh_failure',
+                     'times': None}]})
+    monkeypatch.setenv(fi.FAULT_PLAN_ENV, plan_json)
+    assert fi.poll('s') is not None
+    path = tmp_path / 'plan.json'
+    path.write_text(plan_json)
+    monkeypatch.setenv(fi.FAULT_PLAN_ENV, str(path))
+    assert fi.poll('s') is not None
+
+
+def test_invalid_env_plan_names_the_env_var(monkeypatch):
+    """A typo'd plan path/JSON must fail loudly naming the env var,
+    not as a cryptic JSONDecodeError inside a provisioning site."""
+    monkeypatch.setenv(fi.FAULT_PLAN_ENV, '/tmp/no-such-plan.json')
+    with pytest.raises(ValueError, match=fi.FAULT_PLAN_ENV):
+        fi.poll('s')
+
+
+def test_unknown_spec_field_rejected():
+    with pytest.raises(ValueError):
+        fi.FaultSpec.from_dict({'site': 's', 'kind': 'ssh_failure',
+                                'typo': 1})
+
+
+def test_kinds_filter_preserves_other_specs_budgets():
+    """A site polling with a kinds filter must not consume (or
+    record) specs of kinds it cannot act on."""
+    plan = _plan({'site': 's', 'kind': 'ssh_failure', 'times': 1},
+                 {'site': 's', 'kind': 'preemption', 'times': 1})
+    preempt_only = (fi.FaultKind.PREEMPTION,)
+    spec = plan.poll('s', kinds=preempt_only)
+    assert spec is not None and spec.kind is fi.FaultKind.PREEMPTION
+    assert len(plan.log) == 1
+    # The ssh_failure spec's budget is untouched: a later unfiltered
+    # poll still fires it.
+    assert plan.poll('s').kind is fi.FaultKind.SSH_FAILURE
+
+
+def test_pending_gate_checks_budget_without_counting():
+    plan = _plan({'site': 's', 'kind': 'preemption', 'times': 1,
+                  'after': 5})
+    kinds = (fi.FaultKind.PREEMPTION,)
+    assert plan.pending('s', kinds)
+    assert not plan.pending('s', (fi.FaultKind.SSH_FAILURE,))
+    assert plan.specs[0].seen == 0  # pending() never counts
+    for _ in range(6):
+        plan.poll('s')
+    assert plan.specs[0].fired == 1
+    assert not plan.pending('s', kinds)  # budget exhausted
+
+
+def test_params_round_trip_and_not_matched_on():
+    """`params` carries site-interpreted values (host_index) without
+    participating in context matching."""
+    plan = _plan({'site': 's', 'kind': 'partial_gang_loss',
+                  'params': {'host_index': 1},
+                  'match': {'cluster_name': 'c'}})
+    spec = plan.poll('s', cluster_name='c')
+    assert spec is not None and spec.params == {'host_index': 1}
+    round_trip = fi.FaultPlan.from_json(plan.to_json())
+    assert round_trip.specs[0].params == {'host_index': 1}
+
+
+def test_command_runner_run_site(tmp_path):
+    """A fired ssh_failure manifests as exit 255 (and a typed
+    CommandError under check=True), exactly like a dead transport."""
+    from skypilot_tpu.utils import command_runner as runner_lib
+    runner = runner_lib.LocalProcessRunner('h0', str(tmp_path / 'h0'))
+    with fi.fault_plan(faults=[{'site': 'command_runner.run',
+                                'kind': 'ssh_failure', 'times': 2}]):
+        assert runner.run('true') == 255
+        with pytest.raises(exceptions.CommandError):
+            runner.run('true', check=True)
+    assert runner.run('true') == 0  # plan gone: back to normal
+
+
+def test_provision_router_site(isolated_state):
+    """`provision.<cloud>.<op>` fires through the router with the
+    typed error the failover machinery dispatches on."""
+    from skypilot_tpu import provision
+    from skypilot_tpu.provision import common
+
+    config = common.ProvisionConfig(provider_name='local',
+                                    cluster_name='c',
+                                    cluster_name_on_cloud='c-x',
+                                    region='local',
+                                    zone='local-a',
+                                    node_config={'num_hosts': 1},
+                                    count=1,
+                                    ports_to_open=None)
+    with fi.fault_plan(faults=[{'site': 'provision.local.run_instances',
+                                'kind': 'quota_exceeded'}]):
+        with pytest.raises(exceptions.QuotaExceededError):
+            provision.run_instances('local', config)
+        # times=1: the next identical call provisions for real.
+        record = provision.run_instances('local', config)
+        assert record.cluster_name_on_cloud == 'c-x'

@@ -1,0 +1,176 @@
+"""Recovery strategies: FAILOVER ordering, launch retry policy, dict
+job_recovery parsing, and max_restarts_on_errors exhaustion."""
+import time
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.utils import retry as retry_lib
+
+
+def _task(**resource_kwargs):
+    task = task_lib.Task('t', run='echo hi')
+    task.set_resources(
+        resources_lib.Resources(cloud='local', **resource_kwargs))
+    return task
+
+
+class _ScriptedExecutor:
+    """Mixin driving _do_launch from a script of results."""
+
+    def __init__(self, executor, script):
+        self.executor = executor
+        self.script = list(script)
+        self.calls = []
+        self.terminations = 0
+        executor._do_launch = self._do_launch
+        executor.terminate_cluster = self._terminate
+
+    def _do_launch(self, *, blocked_regions=None):
+        self.calls.append(set(blocked_regions or ()))
+        step = self.script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+    def _terminate(self):
+        self.terminations += 1
+
+
+def test_make_parses_string_and_dict():
+    ex = recovery_strategy.StrategyExecutor.make(
+        'c', _task(job_recovery='FAILOVER'))
+    assert isinstance(ex, recovery_strategy.FailoverStrategy)
+    assert ex.max_restarts_on_errors == 0
+
+    ex = recovery_strategy.StrategyExecutor.make(
+        'c', _task(job_recovery={'strategy': 'FAILOVER',
+                                 'max_restarts_on_errors': 2}))
+    assert isinstance(ex, recovery_strategy.FailoverStrategy)
+    assert ex.max_restarts_on_errors == 2
+
+    ex = recovery_strategy.StrategyExecutor.make('c', _task())
+    assert isinstance(ex, recovery_strategy.EagerNextRegionStrategy)
+
+
+def test_job_recovery_dict_validation():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        resources_lib.Resources(cloud='local',
+                                job_recovery={'bogus_field': 1})
+    r = resources_lib.Resources(
+        cloud='local',
+        job_recovery={'strategy': 'FAILOVER',
+                      'max_restarts_on_errors': 3})
+    assert r.job_recovery == {'strategy': 'failover',
+                              'max_restarts_on_errors': 3}
+    # copy() keeps the dict.
+    assert r.copy().job_recovery == r.job_recovery
+
+
+def test_failover_retries_same_region_then_roams():
+    ex = recovery_strategy.StrategyExecutor.make(
+        'c', _task(job_recovery='failover'))
+    ex.last_region = 'us-central1'
+    scripted = _ScriptedExecutor(
+        ex, [exceptions.ResourcesUnavailableError('full'), 7])
+    assert ex.recover() == 7
+    # Attempt 1: in place (no blocks). Attempt 2: last region blocked.
+    assert scripted.calls == [set(), {'us-central1'}]
+    assert scripted.terminations == 2
+
+
+def test_failover_same_region_success_never_blocks():
+    ex = recovery_strategy.StrategyExecutor.make(
+        'c', _task(job_recovery='failover'))
+    ex.last_region = 'us-central1'
+    scripted = _ScriptedExecutor(ex, [11])
+    assert ex.recover() == 11
+    assert scripted.calls == [set()]
+    assert scripted.terminations == 1
+
+
+def test_eager_next_region_blocks_then_falls_back():
+    ex = recovery_strategy.StrategyExecutor.make('c', _task())
+    ex.last_region = 'local'
+    scripted = _ScriptedExecutor(
+        ex, [exceptions.ResourcesUnavailableError('all full'), 3])
+    assert ex.recover() == 3
+    # Blocks the preempted region first; retries unrestricted after.
+    assert scripted.calls == [{'local'}, set()]
+
+
+def test_restart_never_blocks_regions():
+    """restart() follows a USER failure on healthy infra: relaunch
+    with no blocked regions (unlike recover())."""
+    ex = recovery_strategy.StrategyExecutor.make(
+        'c', _task(job_recovery='failover'))
+    ex.last_region = 'us-central1'
+    scripted = _ScriptedExecutor(ex, [5])
+    assert ex.restart() == 5
+    assert scripted.calls == [set()]
+    assert scripted.terminations == 1
+
+
+def test_launch_bounded_retries_then_typed_failure(monkeypatch):
+    clock = retry_lib.FakeClock()
+    monkeypatch.setattr(
+        recovery_strategy, '_launch_retry_policy',
+        lambda: retry_lib.RetryPolicy(max_attempts=3,
+                                      initial_backoff=1.0,
+                                      jitter='none', clock=clock))
+    ex = recovery_strategy.StrategyExecutor.make('c', _task())
+    scripted = _ScriptedExecutor(ex, [RuntimeError('flaky')] * 5)
+    with pytest.raises(exceptions.ProvisionError) as err:
+        ex.launch()
+    assert 'after 3 attempts' in str(err.value)
+    assert len(scripted.calls) == 3
+    assert clock.sleeps == [1.0, 2.0]
+
+
+def test_launch_permanent_error_not_retried():
+    ex = recovery_strategy.StrategyExecutor.make('c', _task())
+    scripted = _ScriptedExecutor(
+        ex, [exceptions.ResourcesUnavailableError('nowhere')])
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        ex.launch()
+    assert len(scripted.calls) == 1
+
+
+def test_should_restart_on_failure_budget():
+    ex = recovery_strategy.StrategyExecutor.make(
+        'c', _task(job_recovery={'strategy': 'failover',
+                                 'max_restarts_on_errors': 2}))
+    assert ex.should_restart_on_failure()
+    assert ex.should_restart_on_failure()
+    assert not ex.should_restart_on_failure()  # budget spent
+    # Default budget is zero: user failures are terminal immediately.
+    ex0 = recovery_strategy.StrategyExecutor.make('c', _task())
+    assert not ex0.should_restart_on_failure()
+
+
+def test_failover_restart_exhaustion_end_to_end(isolated_state):
+    """A persistently-failing task with FAILOVER +
+    max_restarts_on_errors=1 is restarted exactly once, then fails
+    terminally with the exhaustion reason recorded."""
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+
+    task = task_lib.Task('alwaysfail', run='exit 3')
+    task.set_resources(
+        resources_lib.Resources(
+            cloud='local',
+            job_recovery={'strategy': 'FAILOVER',
+                          'max_restarts_on_errors': 1}))
+    job_id = jobs_core.launch(task, controller_check_gap=0.3)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job and job['status'].is_terminal():
+            break
+        time.sleep(0.5)
+    assert job['status'] == state.ManagedJobStatus.FAILED, job
+    assert job['recovery_count'] == 1, job
+    assert 'max_restarts_on_errors' in (job.get('failure_reason') or '')

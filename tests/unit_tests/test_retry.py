@@ -1,0 +1,133 @@
+"""Unified RetryPolicy: backoff schedule, jitter determinism,
+deadline, typed retryable predicate — all wall-clock-free (FakeClock)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import retry as retry_lib
+
+
+def test_call_retries_then_succeeds():
+    clock = retry_lib.FakeClock()
+    policy = retry_lib.RetryPolicy(max_attempts=5, initial_backoff=1.0,
+                                   jitter='none', clock=clock)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError('boom')
+        return 'ok'
+
+    assert policy.call(flaky) == 'ok'
+    assert len(calls) == 3
+    # Exponential, jitter-free: 1, 2.
+    assert clock.sleeps == [1.0, 2.0]
+
+
+def test_call_exhausts_attempts():
+    clock = retry_lib.FakeClock()
+    policy = retry_lib.RetryPolicy(max_attempts=3, initial_backoff=0.5,
+                                   jitter='none', clock=clock)
+    with pytest.raises(RuntimeError):
+        policy.call(lambda: (_ for _ in ()).throw(RuntimeError('x')))
+    assert len(clock.sleeps) == 2  # 3 attempts = 2 sleeps
+
+
+def test_backoff_capped():
+    clock = retry_lib.FakeClock()
+    policy = retry_lib.RetryPolicy(max_attempts=None, initial_backoff=10,
+                                   max_backoff=25, multiplier=2.0,
+                                   jitter='none', clock=clock)
+    state = policy.new_state()
+    assert [state.next_backoff() for _ in range(4)] == [10, 20, 25, 25]
+
+
+def test_typed_retryable_predicate():
+    clock = retry_lib.FakeClock()
+    policy = retry_lib.RetryPolicy(
+        max_attempts=5, initial_backoff=1.0, jitter='none', clock=clock,
+        retryable=lambda e: not isinstance(
+            e, exceptions.ResourcesUnavailableError))
+    calls = []
+
+    def permanent():
+        calls.append(1)
+        raise exceptions.ResourcesUnavailableError('no capacity')
+
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        policy.call(permanent)
+    assert len(calls) == 1  # not retried
+    assert clock.sleeps == []
+
+
+def test_retryable_exception_tuple():
+    policy = retry_lib.RetryPolicy(retryable=(ValueError,))
+    assert policy.is_retryable(ValueError('x'))
+    assert not policy.is_retryable(KeyError('x'))
+
+
+def test_retryable_bare_exception_class():
+    # A bare class must mean isinstance matching, not "predicate that
+    # is always truthy".
+    policy = retry_lib.RetryPolicy(retryable=ValueError)
+    assert policy.is_retryable(ValueError('x'))
+    assert not policy.is_retryable(KeyError('x'))
+
+
+def test_deadline_stops_retrying():
+    clock = retry_lib.FakeClock()
+    policy = retry_lib.RetryPolicy(max_attempts=None, initial_backoff=4.0,
+                                   multiplier=1.0, jitter='none',
+                                   deadline=10.0, clock=clock)
+    state = policy.new_state()
+    n = 0
+    while state.should_retry():
+        state.sleep()
+        n += 1
+        assert n < 100
+    # 4s backoffs against a 10s deadline: retries at t=4 and t=8 only,
+    # and the clock never runs past the deadline mid-sleep.
+    assert n == 3  # 4, 4, then clamped 2 -> deadline reached
+    assert clock.now() == pytest.approx(10.0)
+
+
+def test_full_jitter_is_seeded_and_bounded():
+    clock = retry_lib.FakeClock()
+    policy = retry_lib.RetryPolicy(max_attempts=None, initial_backoff=8.0,
+                                   multiplier=2.0, max_backoff=100.0,
+                                   jitter='full', seed=42, clock=clock)
+    s1 = [policy.new_state().next_backoff() for _ in range(1)]
+    series_a = policy.new_state()
+    series_b = policy.new_state()
+    a = [series_a.next_backoff() for _ in range(6)]
+    b = [series_b.next_backoff() for _ in range(6)]
+    assert a == b  # same seed -> identical schedule
+    assert s1[0] == a[0]
+    # Full jitter: every draw within [0, base_for_that_attempt].
+    base = 8.0
+    for draw in a:
+        assert 0.0 <= draw <= base
+        base = min(base * 2.0, 100.0)
+
+
+def test_fake_clock_never_wall_sleeps():
+    clock = retry_lib.FakeClock(start=100.0)
+    clock.sleep(3600.0)
+    assert clock.now() == 3700.0
+    assert clock.sleeps == [3600.0]
+
+
+def test_common_utils_retry_decorator_delegates():
+    """The legacy decorator rides the shared implementation."""
+    from skypilot_tpu.utils import common_utils
+    calls = []
+
+    @common_utils.retry(max_retries=3, initial_backoff=0.0)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ValueError('once')
+        return 7
+
+    assert flaky() == 7
+    assert len(calls) == 2
