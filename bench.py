@@ -14,6 +14,13 @@ visible and report steady-state MFU; ``vs_baseline`` is the MFU ratio.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+``BENCH_SMOKE=1`` forces the CPU backend (even when the image's
+sitecustomize registered a TPU plugin whose tunnel may be dead) and
+the tiny-config fallbacks, so ``BENCH_SMOKE=1 python bench.py
+decode`` is a seconds-long CI check that the bench emits a real
+parsed metric — the guard against a whole round recording
+``bench_error`` (r01-r05) because the device path broke.
+
 ``python bench.py decode`` (or BENCH_MODE=decode) instead benchmarks
 the KV-cache decode path (models/inference.py) and reports batch
 decode tokens/s against the reference's JetStream serving baseline
@@ -213,20 +220,63 @@ def decode_bench():
     # tail slots are pure bandwidth waste.
     headroom = int(os.environ.get('BENCH_DECODE_HEADROOM', '256'))
     max_seq = context + headroom
-    if steps > headroom:
-        raise SystemExit(
-            f'BENCH_DECODE_STEPS ({steps}) exceeds the cache headroom '
-            f'({headroom}): writes past the cache end would clamp to '
-            'the last slot and corrupt the measurement. Raise '
-            'BENCH_DECODE_HEADROOM.')
     if not on_tpu:
         batch, context, steps = 4, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
+        max_seq = 256
         wquant = False
     else:
         cfg = models.config_preset(model)(max_seq=max_seq,
                                           param_dtype=jnp.bfloat16)
+    if 2 * steps > max_seq - context:
+        # Checked against the EFFECTIVE shape (after the CPU/smoke
+        # tiny-config override — env leftovers must not abort a smoke
+        # run they don't apply to). 2x: the warmup run and the timed
+        # run share one donated cache, so the write frontier reaches
+        # context + 2*steps — past the cache end,
+        # dynamic_update_slice clamps to the last slot and silently
+        # corrupts the timed measurement.
+        raise SystemExit(
+            f'2 x BENCH_DECODE_STEPS ({steps}) exceeds the cache '
+            f'headroom ({max_seq - context}): the warmup + timed '
+            f'runs write {2 * steps} decode slots and writes past '
+            'the cache end would clamp to the last slot and corrupt '
+            'the measurement. Raise BENCH_DECODE_HEADROOM.')
     n_params = _count_params(cfg)
+
+    # Length-aware decode dispatch (ops.decode_attention): attention
+    # reads only the pages covering [0, context + steps), not the
+    # whole max_seq cache — on a bandwidth-bound step the unused
+    # headroom tail was pure wasted traffic. BENCH_DECODE_PAGED=0
+    # restores full-cache reads; BENCH_DECODE_ATTN forces the
+    # kernel choice ('paged'/'lax', default auto: paged on TPU).
+    from skypilot_tpu.ops import decode_attention as da
+    page = int(os.environ.get('BENCH_DECODE_PAGE',
+                              str(da.DEFAULT_PAGE)))
+    attn_impl = os.environ.get('BENCH_DECODE_ATTN') or None
+    total_pages = -(-max_seq // page)
+    num_pages = None
+    if os.environ.get('BENCH_DECODE_PAGED', '1') == '1':
+        # 2x steps: the warmup run and the timed run share one donated
+        # cache, so the write frontier reaches context + 2*steps.
+        num_pages = da.num_pages_for(context + 2 * steps, page,
+                                     total_pages)
+    elif attn_impl is None:
+        # A true full-read A/B baseline: the paged kernel skips dead
+        # pages via its per-row bound even with num_pages unset, so
+        # BENCH_DECODE_PAGED=0 must also drop to the lax einsum
+        # (unless BENCH_DECODE_ATTN explicitly overrides).
+        attn_impl = 'lax'
+    # The impl the step will ACTUALLY run (decode_step falls back to
+    # lax on a non-page-aligned cache) — the recorded detail must
+    # never credit the Pallas kernel for einsum numbers.
+    effective_attn = da.resolve_impl(attn_impl)
+    if max_seq % page != 0:
+        if effective_attn == 'paged' and attn_impl == 'paged':
+            raise SystemExit(
+                f'BENCH_DECODE_ATTN=paged needs max_seq ({max_seq}) '
+                f'to be a multiple of BENCH_DECODE_PAGE ({page}).')
+        effective_attn = 'lax'
 
     prompt = jax.random.randint(jax.random.PRNGKey(0),
                                 (batch, context), 0, cfg.vocab_size)
@@ -252,8 +302,9 @@ def decode_bench():
     def run(params, cache, tok):
         def body(carry, _):
             cache, tok = carry
-            logits, cache = inference.decode_step(params, cache, tok,
-                                                  cfg)
+            logits, cache = inference.decode_step(
+                params, cache, tok, cfg, attn_impl=effective_attn,
+                num_pages=num_pages, page=page)
             return (cache, jnp.argmax(logits, -1).astype(jnp.int32)), None
         (cache, tok), _ = lax.scan(body, (cache, tok), None,
                                    length=steps)
@@ -294,6 +345,9 @@ def decode_bench():
             'step_time_ms': round(dt * 1000, 3),
             'batch': batch, 'context': context,
             'model': model,
+            'decode_attn': effective_attn,
+            'page': page, 'num_pages': num_pages,
+            'total_pages': total_pages,
             'kv_quant': kv_quant, 'weight_quant': wquant,
             'n_params': n_params, 'n_active_params': n_active,
             'param_bytes': param_bytes,
@@ -670,11 +724,24 @@ def _device_watchdog(timeout_s: float = 180.0) -> None:
 if __name__ == '__main__':
     mode = (sys.argv[1] if len(sys.argv) > 1 else
             os.environ.get('BENCH_MODE', 'train'))
+    if os.environ.get('BENCH_SMOKE') == '1':
+        # Force the CPU backend BEFORE any device op: env var for
+        # child processes, jax.config because the image's
+        # sitecustomize may already have imported jax and registered
+        # the TPU plugin (env alone would be too late — see
+        # tests/conftest.py).
+        os.environ['JAX_PLATFORMS'] = 'cpu'
+        try:
+            import jax as _jax
+            _jax.config.update('jax_platforms', 'cpu')
+        except Exception:  # pragma: no cover - jax always importable
+            pass
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
     # same thing); other modes probe in-process.
     _device_watchdog(float(os.environ.get(
-        'BENCH_DEVICE_TIMEOUT', '180')))
+        'BENCH_DEVICE_TIMEOUT',
+        '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':

@@ -43,7 +43,9 @@ from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models.llama import (LlamaConfig, _attention,
                                        _rmsnorm, _rope, forward_hidden)
+from skypilot_tpu.models import quantization
 from skypilot_tpu.models.quantization import qdot, qdot_a8, qembed
+from skypilot_tpu.ops import decode_attention as decode_attn
 
 # Cache layout: [n_layers, B, max_seq, n_kv_heads, head_dim].
 CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
@@ -62,25 +64,10 @@ def cache_specs(kv_quant: bool = False) -> Dict:
     return specs
 
 
-def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Symmetric int8 per-vector quantization over head_dim.
-
-    Decode is cache-bandwidth-bound (see decode_step): int8 halves the
-    bytes per step vs bf16, which at equal HBM budget doubles the
-    batch — the same lever JetStream pulls with quantize_kvcache.
-    Scale is per (position, kv-head) vector: accurate enough that
-    greedy decode matches bf16 on short horizons (tested), 1/16 the
-    overhead bytes.
-    """
-    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
-    scale = jnp.maximum(scale, 1e-8)
-    q = jnp.round(x / scale[..., None]).astype(jnp.int8)
-    return q, scale.astype(jnp.bfloat16)
-
-
-def _dequantize_kv(q: jax.Array, scale: jax.Array,
-                   dtype) -> jax.Array:
-    return q.astype(dtype) * scale[..., None].astype(dtype)
+# KV-cache int8 quantization lives with the other quantization
+# machinery; aliased here for the cache write sites below.
+_quantize_kv = quantization.quantize_kv
+_dequantize_kv = quantization.dequantize_kv
 
 
 def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig,
@@ -282,7 +269,11 @@ def decode_step(params: Dict,
                 tokens: jax.Array,
                 cfg: LlamaConfig,
                 mesh=None,
-                active: Optional[jax.Array] = None
+                active: Optional[jax.Array] = None,
+                *,
+                attn_impl: Optional[str] = None,
+                num_pages: Optional[int] = None,
+                page: Optional[int] = None
                 ) -> Tuple[jax.Array, Dict]:
     """Advance every sequence by one token.
 
@@ -292,6 +283,17 @@ def decode_step(params: Dict,
     but their write column stays masked, so an empty ServingEngine
     slot never contaminates a later occupant. Returns (logits
     [B, vocab] f32 for the *next* token, updated cache).
+
+    Attention dispatch (all static, resolved at trace time):
+    ``attn_impl`` 'paged' runs the Pallas paged ragged kernel
+    (ops.decode_attention — reads only live cache pages, int8 dequant
+    fused), 'lax' the einsum reference, None/'auto' picks paged on
+    TPU. ``num_pages`` (with ``page``) bounds the cache region that
+    is READ to the first num_pages*page slots — length-aware
+    dispatch: callers that know the live region (ServingEngine,
+    bench) pass it so per-step HBM traffic scales with occupancy,
+    not ``max_seq``. Every dmask-true slot must lie below the bound;
+    cache WRITES are unaffected (they target the full buffer).
 
     Structure (why this is fast on TPU): the layer loop is a
     ``lax.scan`` whose *carry* holds the full stacked cache; each
@@ -318,6 +320,25 @@ def decode_step(params: Dict,
     valid = cache['dmask']
     if active is None:
         active = jnp.ones((b,), bool)
+
+    s_max = cache['k'].shape[2]
+    page = page or decode_attn.default_page()
+    impl = decode_attn.resolve_impl(attn_impl)
+    if mesh is not None or s_max % page != 0:
+        # The paged kernel is single-device (a sharded cache would
+        # need a shard_map wrapper) and needs page-aligned caches;
+        # the lax path still honors the length-aware slice below.
+        impl = 'lax'
+    n_slots = None
+    if num_pages is not None:
+        n_slots = min(num_pages * page, s_max)
+        if n_slots >= s_max:
+            n_slots = None                   # full cache; no slicing
+    # Per-row live upper bound for page skipping: before any decode
+    # write the live slots are exactly the (ragged) prompt lengths;
+    # once decode slots exist every row's region extends to the
+    # shared write frontier base + steps (prompt lengths are <= base).
+    row_bound = jnp.where(steps > 0, base + steps, pos)
 
     x = qembed(params['tok_emb'], tokens, cdt)  # [B, D]
     x = _constrain(x, P(('dp', 'fsdp'), None), mesh)
@@ -346,9 +367,28 @@ def decode_step(params: Dict,
                                                keepdims=False)
             page_vs = lax.dynamic_index_in_dim(vsc, li, 0,
                                                keepdims=False)
-        o = _gqa_decode_attention(q, page_k, page_v, valid,
-                                  k_self=k, v_self=v,
-                                  k_scale=page_ks, v_scale=page_vs)
+        if impl == 'paged':
+            # Grid-limited to num_pages; per-row early exit inside.
+            o = decode_attn.paged_gqa_decode_attention(
+                q, page_k, page_v, valid, row_bound,
+                k_self=k, v_self=v,
+                k_scale=page_ks, v_scale=page_vs,
+                page=page, num_pages=num_pages)
+        else:
+            pk, pv, vd = page_k, page_v, valid
+            pks, pvs = page_ks, page_vs
+            if n_slots is not None:
+                # Length-aware slice: XLA fuses the slice into the
+                # einsum's operand read, so the contraction only
+                # pulls the live region from HBM.
+                pk, pv = pk[:, :n_slots], pv[:, :n_slots]
+                vd = valid[:, :n_slots]
+                if quant:
+                    pks = pks[:, :n_slots]
+                    pvs = pvs[:, :n_slots]
+            o = _gqa_decode_attention(q, pk, pv, vd,
+                                      k_self=k, v_self=v,
+                                      k_scale=pks, v_scale=pvs)
         x = x + qdot(o, lp['wo'], cdt)
 
         h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
@@ -441,8 +481,6 @@ def _sample(logits, key, temperature, top_k: int):
     return jnp.where(temp <= 0.0, greedy, sampled)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    'cfg', 'max_new', 'top_k', 'max_seq', 'kv_quant'))
 def generate(params: Dict,
              tokens: jax.Array,
              lengths: jax.Array,
@@ -452,13 +490,40 @@ def generate(params: Dict,
              top_k: int = 0,
              key: Optional[jax.Array] = None,
              max_seq: Optional[int] = None,
-             kv_quant: bool = False) -> jax.Array:
+             kv_quant: bool = False,
+             attn_impl: Optional[str] = None,
+             page: Optional[int] = None) -> jax.Array:
     """Prefill + autoregressive decode, one traced program.
 
     tokens: [B, S] right-padded prompts; lengths: [B]. Returns
     generated tokens [B, max_new] (greedy when temperature <= 0;
     temperature is traced, so varying it does not recompile).
     """
+    # Resolve the attention dispatch BEFORE jit so the compiled-
+    # program cache key carries the concrete choice — resolving the
+    # SKYTPU_DECODE_ATTN/_PAGE env inside the trace would silently
+    # reuse a stale program after the env changes.
+    return _generate_jit(params, tokens, lengths, cfg, max_new,
+                         temperature, top_k, key, max_seq, kv_quant,
+                         decode_attn.resolve_impl(attn_impl),
+                         page or decode_attn.default_page())
+
+
+@functools.partial(jax.jit, static_argnames=(
+    'cfg', 'max_new', 'top_k', 'max_seq', 'kv_quant', 'attn_impl',
+    'page'))
+def _generate_jit(params: Dict,
+                  tokens: jax.Array,
+                  lengths: jax.Array,
+                  cfg: LlamaConfig,
+                  max_new: int,
+                  temperature: float,
+                  top_k: int,
+                  key: Optional[jax.Array],
+                  max_seq: Optional[int],
+                  kv_quant: bool,
+                  attn_impl: Optional[str],
+                  page: Optional[int]) -> jax.Array:
     if key is None:
         key = jax.random.PRNGKey(0)
     s_max = max_seq or cfg.max_seq
@@ -476,7 +541,8 @@ def generate(params: Dict,
     def step(carry, _):
         cache, tok, key = carry
         key, sub = jax.random.split(key)
-        logits, cache = decode_step(params, cache, tok, cfg)
+        logits, cache = decode_step(params, cache, tok, cfg,
+                                    attn_impl=attn_impl, page=page)
         nxt = _sample(logits, sub, temperature, top_k)
         return (cache, nxt, key), tok
 
@@ -484,6 +550,11 @@ def generate(params: Dict,
         step, (cache, first, key), None, length=max_new - 1)
     toks = jnp.moveaxis(toks, 0, 1)             # [B, max_new-1]
     return jnp.concatenate([toks, last[:, None]], axis=1)
+
+
+# The wrapper keeps the jitted function's compile-cache introspection
+# (tests assert traced-not-static argument behavior through it).
+generate._cache_size = _generate_jit._cache_size
 
 
 def reference_generate(params: Dict, tokens: jax.Array,

@@ -263,7 +263,10 @@ def forward_hidden(params: Dict,
     def constrain(x, spec):
         if mesh is None:
             return x
-        ambient = jax.sharding.get_abstract_mesh()
+        # get_abstract_mesh is absent on older jax (no set_mesh there
+        # either, so there is never an ambient mesh to honor).
+        ambient = getattr(jax.sharding, 'get_abstract_mesh',
+                          lambda: None)()
         if ambient is not None and len(ambient.shape) > 0:
             # Ambient-mesh form (bare spec): required inside the
             # partial-manual pipeline region, equivalent outside it.

@@ -54,6 +54,26 @@ _ROW_KEYS = frozenset({'tok_emb'})
 _INT8_UNIFORM_STD = 73.6116
 
 
+def quantize_kv(x: jax.Array) -> tuple:
+    """Symmetric int8 per-vector quantization over head_dim for the
+    KV cache. Decode is cache-bandwidth-bound: int8 halves the bytes
+    per step vs bf16, which at equal HBM budget doubles the batch —
+    the same lever JetStream pulls with quantize_kvcache. Scale is
+    per (position, kv-head) vector: accurate enough that greedy
+    decode matches bf16 on short horizons (tested), 1/16 the overhead
+    bytes. The paged decode kernel (ops.decode_attention) applies
+    these scales in-register, fused into the attention contraction.
+    """
+    scale = jnp.max(jnp.abs(x), axis=-1) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / scale[..., None]).astype(jnp.int8)
+    return q, scale.astype(jnp.bfloat16)
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
 def is_quantized(params: Dict) -> bool:
     """True if the tree contains any {'q', 's'} quantized leaf."""
     if isinstance(params, dict):

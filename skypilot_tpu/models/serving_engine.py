@@ -109,7 +109,10 @@ class ServingEngine:
                  temperature: float = 0.0,
                  top_k: int = 0,
                  decode_chunk: int = 8,
-                 mesh=None) -> None:
+                 mesh=None,
+                 page: Optional[int] = None,
+                 decode_attn: Optional[str] = None,
+                 paged_dispatch: bool = True) -> None:
         # ``mesh``: serve a model larger than one chip — params shard
         # Megatron-style (tp on heads/ffn/vocab) and the KV cache's
         # kv-head axis shards over 'tp' (inference.CACHE_SPEC), the
@@ -165,6 +168,27 @@ class ServingEngine:
         self.eos_id = eos_id
         self.temperature = temperature
         self.top_k = top_k
+        # Length-aware decode dispatch: decode cost should scale with
+        # cache OCCUPANCY, not max_seq. The engine tracks the live
+        # region (always [0, base + steps + chunk) — the prompt
+        # region up to ``base`` is pinned live the moment any decode
+        # slot exists, per-row raggedness below that is the kernel's
+        # per-row early exit), rounds it up to page granularity and
+        # passes the page count to the jitted decode as a static arg.
+        # Page counts beyond the prompt region grow in powers of two
+        # (ops.decode_attention.num_pages_for), so at most
+        # log2(headroom/page) decode programs exist per chunk size —
+        # the same compile discipline as the power-of-two chunks.
+        from skypilot_tpu.ops import decode_attention as decode_attn_mod
+        self._decode_attn_mod = decode_attn_mod
+        self._page = page or decode_attn_mod.default_page()
+        # Resolved NOW (not at trace time inside the jitted decode):
+        # the engine's dispatch is bound at construction, and the jit
+        # closures never depend on a later env change.
+        self._attn_impl = decode_attn_mod.resolve_impl(decode_attn)
+        self.paged_dispatch = paged_dispatch
+        self._total_pages = -(-self.max_seq // self._page)
+        self._base_pages = -(-max_prompt // self._page)
         # Decode steps per host round-trip. Each tick scans `chunk`
         # steps on device and syncs token values once — slots that
         # finish mid-chunk idle until the tick ends (≈chunk/2 wasted
@@ -265,18 +289,21 @@ class ServingEngine:
         self._prefill_insert = _prefill_insert
 
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=('n',))
+                           static_argnames=('n', 'num_pages'))
         def _decode(params, cache, tokens, active, key, temperature,
-                    *, n):
+                    *, n, num_pages=None):
             """Scan ``n`` decode steps on device, feeding each sampled
-            token forward; one host sync per call, not per token."""
+            token forward; one host sync per call, not per token.
+            ``num_pages`` (static) bounds the cache region attention
+            reads — the length-aware dispatch knob."""
 
             def body(carry, _):
                 cache, tok, key = carry
                 key, sub = jax.random.split(key)
                 logits, cache = inference.decode_step(
                     params, cache, tok, self.cfg, mesh=self.mesh,
-                    active=active)
+                    active=active, attn_impl=self._attn_impl,
+                    num_pages=num_pages, page=self._page)
                 nxt = inference._sample(logits, sub, temperature,
                                         self.top_k)
                 return (cache, nxt, key), nxt
@@ -312,20 +339,44 @@ class ServingEngine:
                     max_new=2) for b in self.buckets
         ]
         self.run(reqs)
-        # Also compile the power-of-two tail decode chunks step() can
-        # fold to near capacity exhaustion — otherwise the compile
-        # lands inside a live request's latency. Fold to a power of
-        # two first, exactly as step() does.
+        # Also compile every (chunk size, page count) static-arg pair
+        # a run can dispatch, so no XLA compile ever lands inside a
+        # live request's latency. Chunk sizes fold to powers of two
+        # exactly as step() does. The main chunk runs at any
+        # occupancy (page-stride enumeration — the page count only
+        # changes at page boundaries, and num_pages_for's pow2
+        # headroom rounding keeps the set log2-bounded); tail chunks
+        # fold only near region exhaustion, where remaining slots are
+        # in [n, 2n) — the count is monotone in occupancy, so that
+        # window's endpoints cover it.
         n = self.decode_chunk
         while n & (n - 1):
             n &= n - 1
+        chunk = n
+
+        def count_for(steps_done: int, n_: int) -> Optional[int]:
+            if not self.paged_dispatch:
+                return None
+            return self._decode_attn_mod.num_pages_for(
+                self.max_prompt + steps_done + n_, self._page,
+                self._total_pages, base_pages=self._base_pages)
+
+        cap = self.decode_capacity()
+        pairs = set()
+        for s in range(0, max(cap - chunk, 0) + 1,
+                       max(1, self._page)):
+            pairs.add((chunk, count_for(s, chunk)))
+        pairs.add((chunk, count_for(max(cap - chunk, 0), chunk)))
         while n > 1:
             n //= 2
+            pairs.add((n, count_for(max(0, cap - 2 * n + 1), n)))
+            pairs.add((n, count_for(max(0, cap - n), n)))
+        for n_, np_ in sorted(pairs, key=lambda t: (t[0], t[1] or 0)):
             self._key, sub = jax.random.split(self._key)
             self.cache, _, self._tokens_dev = self._decode(
                 self.params, self.cache, self._tokens_dev,
                 jnp.zeros((self.batch_size,), bool), sub,
-                jnp.asarray(self._temps), n=n)
+                jnp.asarray(self._temps), n=n_, num_pages=np_)
         self.reset()
 
     def reset(self) -> None:
@@ -354,6 +405,18 @@ class ServingEngine:
 
     def decode_capacity(self) -> int:
         return self.max_seq - self.max_prompt
+
+    def _num_pages(self, n: int) -> Optional[int]:
+        """Page count for the next ``n``-step decode chunk: covers the
+        live region [0, base + steps_done + n) rounded up per
+        ``num_pages_for`` (page-granular, pow2 headroom). None when
+        length-aware dispatch is off (full cache)."""
+        if not self.paged_dispatch:
+            return None
+        live = self.max_prompt + self._steps_done + n
+        return self._decode_attn_mod.num_pages_for(
+            live, self._page, self._total_pages,
+            base_pages=self._base_pages)
 
     def remaining_slots(self) -> int:
         return self.decode_capacity() - self._steps_done
@@ -504,7 +567,7 @@ class ServingEngine:
         self.cache, toks, self._tokens_dev = self._decode(
             self.params, self.cache, self._tokens_dev,
             jnp.asarray(active_list), sub, jnp.asarray(self._temps),
-            n=n)
+            n=n, num_pages=self._num_pages(n))
         self._steps_done += n
         # Snapshot which occupant each decoded column belongs to: by
         # the time this chunk is synced the slot may have finished and

@@ -42,10 +42,21 @@ logger = sky_logging.init_logger(__name__)
 
 
 class EngineServer:
-    """aiohttp app over a ServingEngine; one background driver thread."""
+    """aiohttp app over a ServingEngine; one background driver thread.
 
-    def __init__(self, engine) -> None:
+    ``max_pending`` bounds the engine's admission queue: when that
+    many requests are already queued (not yet admitted to a decode
+    slot), /generate answers 429 with a ``Retry-After`` hint instead
+    of queueing unboundedly — an overloaded replica should shed load
+    to the load balancer's other replicas, not grow a queue whose
+    tail latency is unbounded (and whose memory is, too). ``None``
+    keeps the legacy unbounded behavior (benches).
+    """
+
+    def __init__(self, engine, max_pending: Optional[int] = None
+                 ) -> None:
         self.engine = engine
+        self.max_pending = max_pending
         self._futures: Dict[Any, asyncio.Future] = {}
         # rid -> asyncio.Queue of token batches for streaming requests.
         self._streams: Dict[Any, asyncio.Queue] = {}
@@ -131,6 +142,27 @@ class EngineServer:
         self._loop.call_soon_threadsafe(fail_all)
 
     # ------------------------------------------------------------ http
+    def _overloaded_response(self) -> Optional[web.Response]:
+        """429 + Retry-After when the pending queue is full, else
+        None. Host-side only (safe pre-warmup); checked before the
+        readiness gate so a warming replica still sheds queue
+        overflow instead of 503-ing it ambiguously."""
+        if self.max_pending is None:
+            return None
+        with self._lock:
+            depth = len(self.engine.queue)
+        if depth < self.max_pending:
+            return None
+        # Rough drain-time hint: pending requests over the number of
+        # decode slots, one second per queued batch, clamped sane.
+        retry = max(1, min(30, depth //
+                           max(1, getattr(self.engine, 'batch_size',
+                                          1))))
+        return web.json_response(
+            {'error': 'server overloaded: pending queue is full',
+             'pending': depth, 'max_pending': self.max_pending},
+            status=429, headers={'Retry-After': str(retry)})
+
     @staticmethod
     def _parse_generate(body: Any) -> tuple:
         """Validate a /generate body; raises ValueError with a
@@ -177,6 +209,9 @@ class EngineServer:
                     f'capacity ({self.engine.decode_capacity()}).')
         except (ValueError, UnicodeDecodeError) as e:
             return web.json_response({'error': str(e)}, status=400)
+        overloaded = self._overloaded_response()
+        if overloaded is not None:
+            return overloaded
         if not self._ready.is_set():
             # Requests submitted during warmup would be drained by
             # warmup's own run() and silently lost.
@@ -412,9 +447,16 @@ def main() -> None:
     parser.add_argument('--tp', type=int, default=1,
                         help='Tensor-parallel ways over local chips '
                         '(serve models larger than one chip).')
+    parser.add_argument('--max-pending', type=int, default=256,
+                        help='Max queued (unadmitted) requests before '
+                        '/generate answers 429 + Retry-After; '
+                        '<= 0 means unbounded.')
     args = parser.parse_args()
 
-    server = EngineServer(_build_engine(args))
+    server = EngineServer(
+        _build_engine(args),
+        max_pending=(args.max_pending if args.max_pending > 0
+                     else None))
 
     async def _run():
         await server.start(args.port)

@@ -8,5 +8,8 @@ CPU tests and as the autodiff fallback.
 """
 from skypilot_tpu.ops.flash_attention import (flash_attention,
                                               reference_attention)
+from skypilot_tpu.ops.decode_attention import (num_pages_for,
+                                               paged_gqa_decode_attention)
 
-__all__ = ['flash_attention', 'reference_attention']
+__all__ = ['flash_attention', 'reference_attention',
+           'paged_gqa_decode_attention', 'num_pages_for']

@@ -1,0 +1,18 @@
+"""Shared Pallas import guard + jax version compat for ops/ kernels.
+
+Kept in one place so the next jax API rename is fixed once: the
+TPUCompilerParams -> CompilerParams rename is handled here, and the
+import stays optional so control-plane code paths never pay for
+Pallas (or fail where it is absent).
+"""
+from __future__ import annotations
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+    CompilerParams = getattr(pltpu, 'CompilerParams', None) or getattr(
+        pltpu, 'TPUCompilerParams')
+except ImportError:  # pragma: no cover
+    pl = pltpu = CompilerParams = None
+    HAS_PALLAS = False

@@ -1,0 +1,311 @@
+"""Paged ragged decode attention: the Pallas kernel behind decode.
+
+Decode is HBM-bandwidth-bound: every generated token re-reads the KV
+cache, so bytes-read-per-step IS the step time. The lax einsum path
+(`models.inference._gqa_decode_attention`, kept as the parity
+reference) contracts over the entire preallocated ``[B, max_seq]``
+cache and masks dead positions afterwards — a batch of short
+sequences pays full-``max_seq`` traffic per token. This module reads
+only live cache *pages* instead (the PagedAttention / JetStream
+ragged-attention observation):
+
+- **Paging.** The cache's ``max_seq`` axis is tiled into fixed
+  ``page``-sized blocks. The kernel grid is ``(B, n_kv_heads,
+  num_pages)`` with an online softmax accumulated across the page
+  axis in VMEM scratch (same running (m, l, acc) recurrence as
+  ``ops.flash_attention``).
+- **Per-row early exit.** Each row's live upper bound (``row_bound``,
+  scalar-prefetched so it is available to the *index maps*, not just
+  the kernel body) gates both compute (`pl.when(i * page < bound)`)
+  and DMA: the K/V/mask index maps clamp the page index to the row's
+  last live page, and Pallas elides a block copy whose index did not
+  change — dead pages are never fetched from HBM. A poison test
+  (NaNs planted beyond the bound) asserts this.
+- **Dispatch-level page count.** Callers pass ``num_pages`` (static)
+  so the grid itself — and therefore worst-case traffic — scales with
+  occupancy, not ``max_seq``. ``num_pages_for`` is the shared
+  occupancy -> page-count policy (page-granular, with a power-of-two
+  headroom round-up so the number of compiled programs stays
+  logarithmic, matching the serving engine's chunk discipline).
+- **Fused int8 KV dequant.** With a quantized cache the kernel reads
+  int8 pages (half the bytes) and applies the per-vector scales
+  in-register: on the score matrix for K, folded into the probs for V
+  — the dequantized page never exists anywhere.
+- **Ragged validity stays exact.** ``dmask`` remains the authority on
+  which slots are readable (continuous batching leaves masked holes
+  *inside* the live region: a recycled slot's stale tail, the gap
+  between a short prompt and the decode base). ``row_bound`` is only
+  a conservative upper bound used to skip whole pages.
+
+The incoming token's own K/V (the "self" term) is merged *outside*
+the kernel by one more online-softmax step in plain lax — it is a
+single position and keeping it out of the kernel keeps the page loop
+uniform.
+
+CPU tier-1 tests exercise the real kernel through ``interpret=True``
+(auto-selected off-TPU), so the grid logic, index-map clamping, and
+fused dequant are covered without hardware.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+_LANES = 128
+DEFAULT_PAGE = 128
+
+from skypilot_tpu.ops._pallas_compat import (HAS_PALLAS as _HAS_PALLAS,
+                                             CompilerParams as
+                                             _CompilerParams, pl, pltpu)
+
+
+def default_page() -> int:
+    """Page size (cache slots per block). 128 matches the TPU lane
+    width and the bf16/int8 tile constraints; override with
+    SKYTPU_DECODE_PAGE for experiments."""
+    return int(os.environ.get('SKYTPU_DECODE_PAGE', str(DEFAULT_PAGE)))
+
+
+def resolve_impl(impl: Optional[str] = None) -> str:
+    """'paged' | 'lax' from an explicit choice, SKYTPU_DECODE_ATTN,
+    or 'auto' (paged on TPU, lax elsewhere — interpret-mode Pallas is
+    orders slower than the einsum on CPU, so auto never picks it;
+    tests force 'paged' explicitly)."""
+    impl = impl or os.environ.get('SKYTPU_DECODE_ATTN', 'auto')
+    if impl not in ('auto', 'paged', 'lax'):
+        raise ValueError(
+            f"decode attention impl {impl!r} not in "
+            "('auto', 'paged', 'lax')")
+    if not _HAS_PALLAS:
+        return 'lax'
+    if impl == 'auto':
+        return 'paged' if jax.default_backend() == 'tpu' else 'lax'
+    return impl
+
+
+def num_pages_for(live: int, page: int, total_pages: int,
+                  base_pages: int = 0) -> int:
+    """Pages to dispatch for a live region of ``live`` slots.
+
+    Page-granular (cost scales with occupancy), with the pages beyond
+    ``base_pages`` (the always-live prompt region) rounded up to a
+    power of two: as decode occupancy grows the page count takes at
+    most log2(headroom/page) distinct values, so the number of
+    compiled decode programs stays logarithmic — the same discipline
+    the serving engine applies to its chunk sizes.
+    """
+    need = max(1, -(-live // page))
+    if base_pages and need > base_pages:
+        extra = need - base_pages
+        p2 = 1
+        while p2 < extra:
+            p2 *= 2
+        need = base_pages + p2
+    return max(1, min(need, total_pages))
+
+
+# ------------------------------------------------------------- kernel
+
+
+def _paged_kernel(bound_ref, *refs, scale, page, num_pages, quant):
+    """Grid (b, kv_head, page); online softmax over the page axis.
+
+    bound_ref: scalar-prefetched [B] int32 — row's live slot count.
+    Blocks: q (1,1,rep,hd); k/v (1,page,1,hd); mask (1,page) int8;
+    [k_scale/v_scale (1,page,1)]; outs acc (1,1,rep,hd) f32 and
+    m/l (1,1,rep,LANES) f32 — unnormalized, so the caller can merge
+    the self term with one more online-softmax step.
+    """
+    if quant:
+        (q_ref, k_ref, v_ref, mask_ref, ks_ref, vs_ref,
+         acc_ref, m_ref, l_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, mask_ref,
+         acc_ref, m_ref, l_ref, m_scr, l_scr, acc_scr) = refs
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Per-row early exit: pages at/beyond the row's bound contribute
+    # nothing — and were not even fetched (index maps clamp to the
+    # row's last live page, so the block index repeats and the
+    # pipeline elides the copy).
+    @pl.when(i * page < bound_ref[b])
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [rep, hd]
+        k = k_ref[0, :, 0].astype(jnp.float32)         # [page, hd]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [rep, page]
+        if ks_ref is not None:
+            # int8 K: per-vector scale is constant over head_dim, so
+            # it factors out of the contraction onto the scores.
+            s = s * ks_ref[0, :, 0].astype(jnp.float32)[None, :]
+        valid = (mask_ref[0, :] != 0)[None, :]         # [1, page]
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                          # [rep, 1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Explicitly zero masked probs: on an all-masked page
+        # exp(s - m_new) would be exp(0) = 1 (both at _NEG_INF), and
+        # it kills any NaN garbage sitting in masked slots.
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, :, 0].astype(jnp.float32)         # [page, hd]
+        if vs_ref is not None:
+            # int8 V: fold the per-vector scale into the probs (the
+            # contraction is over the page axis, so a per-slot scale
+            # factors through linearly).
+            p = p * vs_ref[0, :, 0].astype(jnp.float32)[None, :]
+        acc_scr[...] = acc_scr[...] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(i == num_pages - 1)
+    def _finalize():
+        acc_ref[0, 0] = acc_scr[...]
+        m_ref[0, 0] = m_scr[...]
+        l_ref[0, 0] = l_scr[...]
+
+
+def _paged_softmax_pages(q4, kc, vc, mask_i8, row_bound, k_scale,
+                         v_scale, *, page, num_pages, interpret):
+    """Run the page grid; returns unnormalized (acc, m, l) in f32."""
+    b, s, n_kv, hd = kc.shape
+    rep = q4.shape[2]
+    quant = k_scale is not None
+
+    def _last_page(bound_ref, bi):
+        # Last live page for row bi (>= 0 so an empty row still maps
+        # to a real block).
+        return jnp.maximum(bound_ref[bi] - 1, 0) // page
+
+    def q_map(bi, h, i, bound_ref):
+        del i, bound_ref
+        return bi, h, 0, 0
+
+    def kv_map(bi, h, i, bound_ref):
+        return bi, jnp.minimum(i, _last_page(bound_ref, bi)), h, 0
+
+    def mask_map(bi, h, i, bound_ref):
+        del h
+        return bi, jnp.minimum(i, _last_page(bound_ref, bi))
+
+    def scale_map(bi, h, i, bound_ref):
+        return bi, jnp.minimum(i, _last_page(bound_ref, bi)), h
+
+    in_specs = [
+        pl.BlockSpec((1, 1, rep, hd), q_map),
+        pl.BlockSpec((1, page, 1, hd), kv_map),
+        pl.BlockSpec((1, page, 1, hd), kv_map),
+        pl.BlockSpec((1, page), mask_map),
+    ]
+    args = [q4, kc, vc, mask_i8]
+    if quant:
+        in_specs += [pl.BlockSpec((1, page, 1), scale_map),
+                     pl.BlockSpec((1, page, 1), scale_map)]
+        args += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, n_kv, num_pages),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, rep, hd), q_map),
+            pl.BlockSpec((1, 1, rep, _LANES), q_map),
+            pl.BlockSpec((1, 1, rep, _LANES), q_map),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, scale=hd**-0.5, page=page, num_pages=num_pages,
+        quant=quant)
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n_kv, rep, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_kv, rep, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, n_kv, rep, _LANES), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(row_bound.astype(jnp.int32), *args)
+    return acc, m, l
+
+
+def paged_gqa_decode_attention(q, kc, vc, valid, row_bound,
+                               k_self=None, v_self=None,
+                               k_scale=None, v_scale=None, *,
+                               page: Optional[int] = None,
+                               num_pages: Optional[int] = None,
+                               interpret: Optional[bool] = None
+                               ) -> jax.Array:
+    """One-position GQA attention against a paged cache (+ self).
+
+    Drop-in signature match for the lax reference
+    (``models.inference._gqa_decode_attention``) plus paging controls:
+    q [B, n_heads, hd]; kc/vc [B, S, n_kv, hd] (bf16, or int8 with
+    k_scale/v_scale [B, S, n_kv]); valid [B, S] bool; row_bound [B]
+    int32 — per-row count of live slots (every valid slot of row b
+    must lie below row_bound[b]; pages at/beyond it are skipped
+    entirely). ``num_pages`` limits the grid (slots >= num_pages*page
+    are never read — the caller guarantees they are dead);
+    ``interpret`` defaults to True off-TPU so CPU tests run the real
+    kernel. Returns [B, n_heads * hd].
+    """
+    b, s, n_kv, hd = kc.shape
+    page = page or default_page()
+    if s % page != 0:
+        raise ValueError(f'cache length {s} is not a multiple of the '
+                         f'page size {page}')
+    total_pages = s // page
+    num_pages = total_pages if num_pages is None else min(
+        max(1, num_pages), total_pages)
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    rep = q.shape[1] // n_kv
+    q4 = q.reshape(b, n_kv, rep, hd)
+
+    acc, m, l = _paged_softmax_pages(
+        q4, kc, vc, valid.astype(jnp.int8), row_bound, k_scale,
+        v_scale, page=page, num_pages=num_pages, interpret=interpret)
+    m1 = m[..., 0]                                     # [B, n_kv, rep]
+    l1 = l[..., 0]
+    if k_self is None:
+        out = acc / jnp.maximum(l1, 1e-30)[..., None]
+    else:
+        # Merge the incoming token's own K/V with one more
+        # online-softmax step (mathematically identical to the
+        # reference's concat-then-softmax).
+        s_self = jnp.einsum(
+            'bkrh,bkh->bkr', q4, k_self,
+            preferred_element_type=jnp.float32) * hd**-0.5
+        m2 = jnp.maximum(m1, s_self)
+        c1 = jnp.exp(m1 - m2)
+        c2 = jnp.exp(s_self - m2)
+        l2 = jnp.maximum(l1 * c1 + c2, 1e-30)
+        out = (acc * c1[..., None] +
+               c2[..., None] * v_self[:, :, None].astype(jnp.float32)
+               ) / l2[..., None]
+    return out.reshape(b, n_kv * rep * hd).astype(q.dtype)

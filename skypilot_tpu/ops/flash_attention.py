@@ -60,12 +60,9 @@ def _repeat_kv(q, k, v):
     return q, k, v
 
 
-try:  # Pallas import kept optional so control-plane never pays for it.
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-except ImportError:  # pragma: no cover
-    _HAS_PALLAS = False
+from skypilot_tpu.ops._pallas_compat import (HAS_PALLAS as _HAS_PALLAS,
+                                             CompilerParams as
+                                             _CompilerParams, pl, pltpu)
 
 
 def _use_pallas():
@@ -171,7 +168,7 @@ def _flash_fwd_pallas(q, k, v, *, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -300,7 +297,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -325,7 +322,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, scale, block_q,
                         pltpu.VMEM((block_k, d), jnp.float32)],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,

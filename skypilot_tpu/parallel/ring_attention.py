@@ -178,7 +178,10 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = 'sp',
     # Inside a partial-manual region (the pp pipeline), shard_map must
     # receive the CONTEXT mesh (some axes already Manual) rather than
     # the concrete all-Auto mesh, or jax rejects the mismatch.
-    ambient = _jax.sharding.get_abstract_mesh()
+    # Absent on older jax (which also has no set_mesh, so there is
+    # never an ambient mesh to honor there).
+    ambient = getattr(_jax.sharding, 'get_abstract_mesh',
+                      lambda: None)()
     if ambient is not None and len(ambient.shape) > 0:
         mesh = ambient
     spec = P(('dp', 'fsdp'), axis_name, 'tp', None)

@@ -36,9 +36,12 @@ import pytest  # noqa: E402
 # chaos tests drive real local clusters and are the most expensive
 # items in the fast tier, so a time-capped CI run keeps maximum early
 # signal from the unit tests. The sort is stable — relative order
-# within each group is unchanged.
+# within each group is unchanged. The paged decode-attention parity
+# suite (interpret-mode Pallas: slow per-test) and the bench smoke
+# subprocesses follow the same discipline.
 _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
-               'test_recovery_strategy.py')
+               'test_recovery_strategy.py', 'test_decode_attention.py',
+               'test_bench_smoke.py')
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,341 @@
+"""Paged ragged decode-attention kernel (ops/decode_attention.py):
+parity against the lax einsum reference across GQA ratios, ragged
+length mixes, int8 KV, and page-boundary lengths; page-skip
+verification via NaN poison (dead pages must never be read); the
+length-aware page-count policy; and interpret-mode microbenches
+(perf_smoke)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.ops import decode_attention as da
+
+# Interpret-mode Pallas is slow: keep tier-1 shapes tiny.
+HD = 16
+
+
+def _inputs(b, s, n_kv, rep, hd=HD, *, quant=False, self_term=True,
+            seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 8)
+    q = jax.random.normal(ks[0], (b, n_kv * rep, hd), jnp.bfloat16)
+    if quant:
+        kc = jax.random.randint(ks[1], (b, s, n_kv, hd), -127, 128,
+                                jnp.int8)
+        vc = jax.random.randint(ks[2], (b, s, n_kv, hd), -127, 128,
+                                jnp.int8)
+        ksc = (jax.random.uniform(ks[3], (b, s, n_kv)) * 0.02 +
+               0.001).astype(jnp.bfloat16)
+        vsc = (jax.random.uniform(ks[4], (b, s, n_kv)) * 0.02 +
+               0.001).astype(jnp.bfloat16)
+    else:
+        kc = jax.random.normal(ks[1], (b, s, n_kv, hd), jnp.bfloat16)
+        vc = jax.random.normal(ks[2], (b, s, n_kv, hd), jnp.bfloat16)
+        ksc = vsc = None
+    k_self = v_self = None
+    if self_term:
+        k_self = jax.random.normal(ks[5], (b, n_kv, hd), jnp.bfloat16)
+        v_self = jax.random.normal(ks[6], (b, n_kv, hd), jnp.bfloat16)
+    return q, kc, vc, ksc, vsc, k_self, v_self
+
+
+def _compare(q, kc, vc, valid, bound, ksc, vsc, k_self, v_self, *,
+             page, num_pages=None, atol=1e-2):
+    ref = inference._gqa_decode_attention(
+        q, kc, vc, valid, k_self=k_self, v_self=v_self,
+        k_scale=ksc, v_scale=vsc)
+    got = da.paged_gqa_decode_attention(
+        q, kc, vc, valid, bound, k_self=k_self, v_self=v_self,
+        k_scale=ksc, v_scale=vsc, page=page, num_pages=num_pages)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=atol, rtol=0)
+
+
+# --------------------------------------------------------------- parity
+
+
+@pytest.mark.parametrize('n_kv,rep', [(4, 1), (2, 4), (1, 8)],
+                         ids=['gqa1to1', 'gqa4to1', 'gqa8to1'])
+@pytest.mark.parametrize('quant', [False, True],
+                         ids=['bf16', 'int8kv'])
+def test_parity_gqa_ratios_ragged(n_kv, rep, quant):
+    """Ragged prefix-valid batches across GQA ratios, with and
+    without the fused int8 dequant."""
+    b, s, page = 3, 128, 32
+    q, kc, vc, ksc, vsc, k_self, v_self = _inputs(
+        b, s, n_kv, rep, quant=quant)
+    lengths = jnp.asarray([5, 63, 128], jnp.int32)
+    valid = jnp.arange(s)[None, :] < lengths[:, None]
+    _compare(q, kc, vc, valid, lengths, ksc, vsc, k_self, v_self,
+             page=page)
+
+
+@pytest.mark.parametrize('length', [31, 32, 33, 63, 64, 65, 0, 128],
+                         ids=str)
+def test_parity_page_boundary_lengths(length):
+    """length == k*page +/- 1 exercises the partial-page mask and the
+    per-row last-page clamp on both sides of every boundary."""
+    b, s, page = 2, 128, 32
+    q, kc, vc, ksc, vsc, k_self, v_self = _inputs(b, s, 2, 2, seed=1)
+    lengths = jnp.asarray([length, max(1, length // 2)], jnp.int32)
+    valid = jnp.arange(s)[None, :] < lengths[:, None]
+    _compare(q, kc, vc, valid, lengths, ksc, vsc, k_self, v_self,
+             page=page)
+
+
+def test_parity_holes_inside_live_region():
+    """Continuous-batching dmask shape: prompt prefix + a decode
+    region behind ``base``, with a dead gap in between — row_bound
+    only skips whole pages; dmask stays the validity authority."""
+    b, s, page, base, steps = 2, 128, 32, 64, 9
+    q, kc, vc, ksc, vsc, k_self, v_self = _inputs(b, s, 2, 4, seed=2)
+    plens = jnp.asarray([17, 50], jnp.int32)
+    pos = jnp.arange(s)[None, :]
+    valid = (pos < plens[:, None]) | ((pos >= base) &
+                                     (pos < base + steps))
+    bound = jnp.full((b,), base + steps, jnp.int32)
+    _compare(q, kc, vc, valid, bound, ksc, vsc, k_self, v_self,
+             page=page)
+
+
+def test_parity_no_self_term():
+    b, s, page = 2, 64, 32
+    q, kc, vc, ksc, vsc, _, _ = _inputs(b, s, 2, 2, self_term=False,
+                                        seed=3)
+    lengths = jnp.asarray([5, 64], jnp.int32)
+    valid = jnp.arange(s)[None, :] < lengths[:, None]
+    _compare(q, kc, vc, valid, lengths, ksc, vsc, None, None,
+             page=page)
+
+
+def test_empty_rows_fall_back_to_self():
+    """All-dead rows (a recycled, not-yet-refilled engine slot) must
+    return exactly the self-attention value, not NaN."""
+    b, s, page = 2, 64, 32
+    q, kc, vc, _, _, k_self, v_self = _inputs(b, s, 2, 2, seed=4)
+    valid = jnp.zeros((b, s), bool)
+    bound = jnp.zeros((b,), jnp.int32)
+    got = da.paged_gqa_decode_attention(
+        q, kc, vc, valid, bound, k_self=k_self, v_self=v_self,
+        page=page)
+    want = jnp.broadcast_to(
+        v_self[:, :, None], (b, 2, 2, HD)).reshape(b, -1)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=1e-2, rtol=0)
+    assert np.isfinite(np.asarray(got, np.float32)).all()
+
+
+# ------------------------------------------------- page-skip / cost
+
+
+def test_grid_pages_beyond_num_pages_never_read():
+    """NaN poison in cache slots >= num_pages*page: if the kernel
+    read them the output would be NaN; matching the clean reference
+    proves per-step reads are bounded by the dispatched page count,
+    not max_seq."""
+    b, s, page, num_pages = 2, 128, 32, 2
+    q, kc, vc, ksc, vsc, k_self, v_self = _inputs(b, s, 2, 2, seed=5)
+    live = num_pages * page
+    lengths = jnp.asarray([live - 5, live], jnp.int32)
+    valid = jnp.arange(s)[None, :] < lengths[:, None]
+    ref = inference._gqa_decode_attention(
+        q, kc[:, :live], vc[:, :live], valid[:, :live],
+        k_self=k_self, v_self=v_self)
+    poisoned_k = kc.at[:, live:].set(jnp.nan)
+    poisoned_v = vc.at[:, live:].set(jnp.nan)
+    got = da.paged_gqa_decode_attention(
+        q, poisoned_k, poisoned_v, valid, lengths,
+        k_self=k_self, v_self=v_self, page=page, num_pages=num_pages)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=1e-2, rtol=0)
+
+
+def test_row_pages_beyond_bound_never_fetched():
+    """Per-row early exit: poison every page at/beyond each row's
+    last live page. The clamped index maps must keep those blocks
+    out of the pipeline entirely (the pl.when skip alone would not
+    save the DMA)."""
+    b, s, page = 2, 128, 32
+    q, kc, vc, ksc, vsc, k_self, v_self = _inputs(b, s, 2, 2, seed=6)
+    lengths = jnp.asarray([10, 64], jnp.int32)   # last pages 0 and 1
+    valid = jnp.arange(s)[None, :] < lengths[:, None]
+    ref = inference._gqa_decode_attention(
+        q, kc, vc, valid, k_self=k_self, v_self=v_self)
+    pk, pv = np.asarray(kc, np.float32), np.asarray(vc, np.float32)
+    for row, length in enumerate([10, 64]):
+        first_dead_page = -(-length // page)
+        pk[row, first_dead_page * page:] = np.nan
+        pv[row, first_dead_page * page:] = np.nan
+    got = da.paged_gqa_decode_attention(
+        q, jnp.asarray(pk, jnp.bfloat16), jnp.asarray(pv, jnp.bfloat16),
+        valid, lengths, k_self=k_self, v_self=v_self, page=page)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=1e-2, rtol=0)
+
+
+def test_num_pages_for_scales_with_occupancy_not_max_seq():
+    """The dispatch policy: page count tracks the live region
+    (page-granular, pow2 headroom), is monotonic, clamps at the
+    cache, and stays logarithmic in distinct values."""
+    page, total, base_pages = 128, 40, 8   # max_seq 5120, prompt 1024
+    low = da.num_pages_for(1024 + 16, page, total, base_pages)
+    mid = da.num_pages_for(1024 + 1024, page, total, base_pages)
+    high = da.num_pages_for(5120, page, total, base_pages)
+    assert low == base_pages + 1            # one headroom page live
+    assert low < mid <= high == total       # scales with occupancy
+    counts = {da.num_pages_for(1024 + s_, page, total, base_pages)
+              for s_ in range(0, 4097, 16)}
+    # pow2 headroom rounding: log2-bounded program count.
+    assert len(counts) <= 7, counts
+    # Degenerate cases.
+    assert da.num_pages_for(0, page, total, base_pages) == 1
+    assert da.num_pages_for(10**9, page, total, base_pages) == total
+
+
+def test_decode_step_paged_matches_lax_with_int8():
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0,
+                                cfg.vocab_size).astype(jnp.int32)
+    lengths = jnp.asarray([17, 9], jnp.int32)
+    _, cache = inference.prefill(params, tokens, lengths, cfg,
+                                 kv_quant=True)
+    nxt = jnp.zeros((2,), jnp.int32)
+    l_lax, _ = inference.decode_step(params, dict(cache), nxt, cfg,
+                                     attn_impl='lax')
+    l_paged, _ = inference.decode_step(params, dict(cache), nxt, cfg,
+                                       attn_impl='paged', page=32)
+    np.testing.assert_allclose(np.asarray(l_paged), np.asarray(l_lax),
+                               atol=1e-2, rtol=0)
+    # Length-aware dispatch (num_pages) changes nothing the mask
+    # already hides.
+    l_sliced, _ = inference.decode_step(params, dict(cache), nxt, cfg,
+                                        attn_impl='paged',
+                                        num_pages=1, page=32)
+    np.testing.assert_allclose(np.asarray(l_sliced),
+                               np.asarray(l_lax), atol=1e-2, rtol=0)
+
+
+def test_generate_paged_matches_oracle():
+    """End-to-end: the kernel inside the real decode loop reproduces
+    the cache-free oracle's greedy tokens."""
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0,
+                                cfg.vocab_size).astype(jnp.int32)
+    lengths = jnp.asarray([17, 9], jnp.int32)
+    want = inference.reference_generate(params, tokens, lengths, cfg,
+                                        max_new=6)
+    got = inference.generate(params, tokens, lengths, cfg, max_new=6,
+                             attn_impl='paged', page=32)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ------------------------------------------------------- microbench
+
+
+@pytest.mark.perf_smoke
+def test_interpret_kernel_microbench():
+    """Tier-1 sanity microbench: the interpret-mode kernel runs at a
+    couple of decode-shaped configs and stays finite. Timings are
+    printed for trend-watching, not asserted (CI boxes vary)."""
+    for (b, s, n_kv, rep, page, quant) in [
+            (2, 128, 2, 4, 32, False),
+            (2, 128, 2, 4, 32, True),
+    ]:
+        q, kc, vc, ksc, vsc, k_self, v_self = _inputs(
+            b, s, n_kv, rep, quant=quant, seed=7)
+        lengths = jnp.asarray([s // 4, s], jnp.int32)
+        valid = jnp.arange(s)[None, :] < lengths[:, None]
+        fn = jax.jit(lambda *a: da.paged_gqa_decode_attention(
+            *a, page=page))
+        out = fn(q, kc, vc, valid, lengths, k_self, v_self, ksc, vsc)
+        out.block_until_ready()               # compile outside timing
+        t0 = time.perf_counter()
+        out = fn(q, kc, vc, valid, lengths, k_self, v_self, ksc, vsc)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+        print(f'paged_decode interpret b={b} s={s} kv={n_kv} rep={rep}'
+              f' quant={quant}: {dt * 1e3:.2f} ms')
+
+
+@pytest.mark.slow
+def test_randomized_long_sequence_sweep():
+    """Randomized ragged sweeps at longer sequences; slow tier."""
+    rng = np.random.default_rng(0)
+    for seed in range(4):
+        n_kv = int(rng.choice([1, 2, 4]))
+        rep = int(rng.choice([1, 2, 8]))
+        page = int(rng.choice([64, 128]))
+        s = 512
+        b = 3
+        quant = bool(rng.integers(0, 2))
+        q, kc, vc, ksc, vsc, k_self, v_self = _inputs(
+            b, s, n_kv, rep, quant=quant, seed=seed + 10)
+        lengths = jnp.asarray(rng.integers(0, s + 1, b), jnp.int32)
+        valid = jnp.arange(s)[None, :] < lengths[:, None]
+        _compare(q, kc, vc, valid, lengths, ksc, vsc, k_self, v_self,
+                 page=page)
+
+
+# ------------------------------------------- engine length-aware dispatch
+
+
+def _prompt(cfg, n, seed):
+    key = jax.random.PRNGKey(seed)
+    return list(np.asarray(
+        jax.random.randint(key, (n,), 0, cfg.vocab_size)))
+
+
+def test_engine_paged_dispatch_matches_full_cache_reads():
+    """Length-aware decode dispatch (num_pages) must be invisible in
+    the tokens: an engine reading only live pages serves the same
+    results as one reading the whole cache — including across a slot
+    recycle (3 requests through 2 slots)."""
+    from skypilot_tpu.models.serving_engine import (Request,
+                                                   ServingEngine)
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [_prompt(cfg, 11, 3), _prompt(cfg, 29, 4),
+               _prompt(cfg, 5, 5)]
+    outs = []
+    for paged in (True, False):
+        engine = ServingEngine(params, cfg, batch_size=2,
+                               max_prompt=32, max_seq=128, page=32,
+                               paged_dispatch=paged)
+        reqs = [Request(i, p, max_new=4)
+                for i, p in enumerate(prompts)]
+        results = engine.run(reqs)
+        outs.append({i: results[i].tokens for i in results})
+    assert outs[0] == outs[1]
+
+
+def test_engine_page_count_tracks_occupancy():
+    """The dispatched page count scales with the live region, not
+    max_seq, and clamps at the cache size."""
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    cfg = models.LlamaConfig.tiny(max_seq=256)
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=256, page=32)
+    assert engine._total_pages == 8
+    fresh = engine._num_pages(4)         # live = 32 + 0 + 4 -> 2 pages
+    assert fresh == 2 < engine._total_pages
+    engine._steps_done = 128
+    grown = engine._num_pages(4)
+    assert fresh < grown <= engine._total_pages
+    engine._steps_done = 10**6
+    assert engine._num_pages(4) == engine._total_pages
+    engine._steps_done = 0
+    # Off switch restores full-cache reads.
+    engine.paged_dispatch = False
+    assert engine._num_pages(4) is None

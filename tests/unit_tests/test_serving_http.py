@@ -223,3 +223,61 @@ def test_weight_quant_flag_builds_quantized_engine():
     from skypilot_tpu.models.serving_engine import Request
     results = engine.run([Request(0, [5, 3, 2], max_new=4)])
     assert len(results[0].tokens) == 4
+
+
+def test_queue_full_returns_429_with_retry_after():
+    """A full pending queue must shed load (429 + Retry-After), not
+    grow unboundedly. Host-side check: no engine warmup needed."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    server = EngineServer(engine, max_pending=2)
+    from skypilot_tpu.models.serving_engine import Request as EngReq
+    engine.submit(EngReq('a', [1, 2, 3], 4))
+    engine.submit(EngReq('b', [1, 2, 3], 4))
+
+    async def scenario():
+        async with TestClient(TestServer(server.make_app())) as client:
+            full = await client.post(
+                '/generate', json={'tokens': [1, 2, 3], 'max_new': 4})
+            body = await full.json()
+            # Malformed bodies still 400 (not 429): validation first.
+            bad = await client.post('/generate', json={'tokens': []})
+            return full.status, full.headers.get('Retry-After'), \
+                body, bad.status
+
+    status, retry_after, body, bad_status = asyncio.run(scenario())
+    assert status == 429
+    assert retry_after is not None and int(retry_after) >= 1
+    assert body['pending'] == 2 and body['max_pending'] == 2
+    assert bad_status == 400
+    server.stop()
+
+
+def test_unbounded_queue_by_default():
+    """max_pending=None (default) keeps the legacy behavior: deep
+    queues are accepted, never 429ed."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    server = EngineServer(engine)
+    from skypilot_tpu.models.serving_engine import Request as EngReq
+    for i in range(50):
+        engine.submit(EngReq(i, [1, 2, 3], 4))
+
+    async def scenario():
+        async with TestClient(TestServer(server.make_app())) as client:
+            r = await client.post(
+                '/generate', json={'tokens': [1, 2, 3], 'max_new': 4})
+            return r.status
+
+    # 503 (warming) — the queue check never fires; the request is
+    # only rejected because the engine thread was never started.
+    assert asyncio.run(scenario()) == 503
+    server.stop()
