@@ -460,6 +460,7 @@ def serve_bench():
     results = engine.run(reqs)
     dt = time.perf_counter() - t0
     out_tokens = sum(len(r.tokens) for r in results.values())
+    from skypilot_tpu import metrics as metrics_lib
     result = {
         'metric': 'llama_serve_req_s',
         'value': round(n_requests / dt, 2),
@@ -482,6 +483,10 @@ def serve_bench():
             'n_params': n_params, 'param_bytes': param_bytes,
             'chip': gen,
             'backend': jax.default_backend(),
+            # The engine's own ops counters (tokens, TTFT histogram,
+            # cache resets) from THIS run: the perf trajectory and
+            # the serving metrics come from one source.
+            'metrics': metrics_lib.summary(),
         },
     }
     print(json.dumps(result))
@@ -579,6 +584,7 @@ def serve_stack_bench():
 
     dt, out_tokens, latencies = asyncio.run(run_bench())
     lat = sorted(latencies)
+    from skypilot_tpu import metrics as metrics_lib
     result = {
         'metric': 'llama_serve_stack_req_s',
         'value': round(n_requests / dt, 2),
@@ -597,6 +603,10 @@ def serve_stack_bench():
             'n_params': n_params, 'chip': gen,
             'backend': jax.default_backend(),
             'path': 'http client -> LB -> EngineServer -> engine',
+            # Engine + LB counters for the run (tokens, per-replica
+            # latency histogram, 429s): ops truth alongside the
+            # wall-clock numbers.
+            'metrics': metrics_lib.summary(),
         },
     }
     print(json.dumps(result))
@@ -648,10 +658,24 @@ def all_bench():
         raise SystemExit(
             f'Unknown BENCH_ALL_MODES entries {unknown}; valid: '
             f'{sorted(_ALL_MODES)}')
+    # Harness knobs that legitimately pass through to every child;
+    # any OTHER BENCH_* var in the shell is a leftover from a manual
+    # run and would silently change what a mode measured (a
+    # BENCH_SEQ=32768 export turns 'train' into longctx_train while
+    # the JSON still says 'train').
+    passthrough = ('BENCH_SMOKE', 'BENCH_DEVICE_TIMEOUT')
+    base = {k: v for k, v in os.environ.items()
+            if not k.startswith('BENCH_') or k in passthrough}
+    stripped = sorted(k for k in os.environ
+                      if k.startswith('BENCH_') and
+                      k not in passthrough and
+                      k not in ('BENCH_MODE', 'BENCH_ALL_MODES'))
+    if stripped:
+        print(f'# stripping stray BENCH_* env from child modes: '
+              f'{",".join(stripped)}', file=sys.stderr)
     detail = {}
     for name in names:
-        env = {**os.environ, 'BENCH_MODE': 'train',
-               **_ALL_MODES[name]}
+        env = {**base, 'BENCH_MODE': 'train', **_ALL_MODES[name]}
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
@@ -665,6 +689,12 @@ def all_bench():
                     'error': (proc.stderr or proc.stdout)[-500:]}
         except (subprocess.TimeoutExpired, OSError) as e:
             detail[name] = {'error': str(e)[:500]}
+        if isinstance(detail.get(name), dict):
+            # Record the EFFECTIVE bench env of the round: the audit
+            # trail that says what this mode actually measured.
+            detail[name]['bench_env'] = {
+                k: v for k, v in env.items()
+                if k.startswith('BENCH_')}
         print(f'# {name}: '
               f'{detail[name].get("value", "ERROR")}',
               file=sys.stderr)

@@ -59,7 +59,8 @@ _PROVISION_RETRY_POLICY = retry_lib.RetryPolicy(
     initial_backoff=_PROVISION_BACKOFF_INITIAL,
     max_backoff=300.0,
     multiplier=1.6,
-    jitter='none')
+    jitter='none',
+    site='provision.retry_until_up')
 
 
 def log_root() -> str:

@@ -19,6 +19,9 @@ class Nebius(neocloud.RestNeocloud):
     _REPR = 'Nebius'
     CATALOG_CLOUD = 'nebius'
     _PROVIDER = 'nebius'
+    # Preset names continue '<platform>_<count>gpu-<vcpu>-<ram>': the
+    # boundary after the accel prefix is '-' here, not '_'.
+    _ACCEL_BOUNDARY = '-'
     _CREDENTIAL_HINT = ('Set NEBIUS_IAM_TOKEN or write '
                         '~/.nebius/credentials.json '
                         '(\'{"token": "<iam token>"}\').')

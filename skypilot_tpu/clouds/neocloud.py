@@ -36,6 +36,13 @@ class RestNeocloud(cloud_lib.Cloud):
     _PROVIDER: str = ''
     _CREDENTIAL_HINT: str = ''
     MAX_CLUSTER_NAME_LEN_LIMIT = 50
+    # Characters that may FOLLOW the accelerator prefix in a catalog
+    # instance type for the ask to count as an exact token match.
+    # 'Nx_NAME[_FORMFACTOR]' catalogs separate with '_' (so an 'A100'
+    # ask matches '8x_A100_PCIE' but NOT '8x_A100-80GB_SECURE' — the
+    # 80GB variant is a different, pricier SKU the user must name);
+    # Nebius presets separate with '-' (see clouds/nebius.py).
+    _ACCEL_BOUNDARY: str = '_'
 
     # ---- subclass seams ----------------------------------------------
     @classmethod
@@ -98,6 +105,18 @@ class RestNeocloud(cloud_lib.Cloud):
                 continue
             yield (r.name, None)
 
+    def _accel_token_match(self, prefix: str,
+                           instance_type: str) -> bool:
+        """Exact-token match: the instance type is the prefix itself,
+        or continues with a declared boundary character. A bare
+        prefix-startswith would let an 'A100' ask silently select
+        '1x_A100-80GB_SECURE' (a pricier SKU than the plain A100)."""
+        it = instance_type.lower()
+        if it == prefix:
+            return True
+        return (it.startswith(prefix) and
+                it[len(prefix)] in self._ACCEL_BOUNDARY)
+
     def _instance_type_for_accelerator(
             self, accelerators: dict) -> Optional[str]:
         (name, count), = accelerators.items()
@@ -106,7 +125,7 @@ class RestNeocloud(cloud_lib.Cloud):
             o.instance_type
             for o in catalog.get_instance_offerings(
                 None, None, None, cloud=self.CATALOG_CLOUD)
-            if o.instance_type.lower().startswith(prefix)
+            if self._accel_token_match(prefix, o.instance_type)
         })
         return matches[0] if matches else None
 

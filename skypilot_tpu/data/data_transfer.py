@@ -60,12 +60,19 @@ def transfer(src: storage_lib.AbstractStore,
         # its endpoint is not AWS, gsutil can't reach it.
         _run(f'gsutil -m rsync -r {src.url()} {dst.url()}')
     elif (type(src) is type(dst) and
-          isinstance(src, storage_lib.S3Store)):
-        # Same-endpoint S3-family pair (S3->S3, R2->R2, COS->COS,
-        # OCI->OCI): bucket-to-bucket `s3 sync` issues SERVER-SIDE
-        # CopyObject — no object bytes stage through this host. This
-        # is the TB-scale path, the role the reference delegates to
-        # cloud-side transfer services (sky/data/data_transfer.py).
+          isinstance(src, storage_lib.S3Store) and
+          src._aws() == dst._aws()):  # pylint: disable=protected-access
+        # Same-endpoint S3-family pair (S3->S3, R2->R2, same-region
+        # COS->COS, OCI->OCI): bucket-to-bucket `s3 sync` issues
+        # SERVER-SIDE CopyObject — no object bytes stage through this
+        # host. This is the TB-scale path, the role the reference
+        # delegates to cloud-side transfer services
+        # (sky/data/data_transfer.py). The `_aws()` equality check is
+        # the endpoint check: one CLI invocation addresses both
+        # buckets, so same-type stores on DIFFERENT endpoints (e.g.
+        # cross-region COS, whose bucket lives behind a per-region
+        # endpoint) fall through to the staged generic path instead
+        # of syncing the destination against the source's endpoint.
         _run(f'{src._aws()} s3 sync {src.url()} {dst.url()}')  # pylint: disable=protected-access
     elif (isinstance(src, storage_lib.AzureBlobStore) and
           isinstance(dst, storage_lib.AzureBlobStore)):

@@ -19,6 +19,7 @@ from typing import Optional
 
 from skypilot_tpu import core
 from skypilot_tpu import exceptions
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as agent_job_lib
 from skypilot_tpu.backend import backend_utils
@@ -33,6 +34,18 @@ logger = sky_logging.init_logger(__name__)
 
 JOB_STATUS_CHECK_GAP_SECONDS = 20
 _MAX_RECOVERIES = 16
+
+# Ops counters (docs/metrics.md). The controller is a detached
+# process, so these reach scrapers via the snapshot spool
+# (SKYTPU_METRICS_DIR), dumped once per monitor tick.
+_M_RECOVERIES = metrics_lib.counter(
+    'skytpu_jobs_recoveries_total',
+    'Preemption recoveries (full relaunch) per managed job.',
+    labels=('job',))
+_M_RESTARTS = metrics_lib.counter(
+    'skytpu_jobs_restarts_total',
+    'Restarts after user failure on healthy infra per managed job.',
+    labels=('job',))
 
 
 class JobsController:
@@ -142,6 +155,7 @@ class JobsController:
         missing_streak = 0
         while True:
             time.sleep(self.check_gap)
+            metrics_lib.dump_snapshot(f'jobs.controller.{self.job_id}')
             if state.cancel_requested(self.job_id):
                 return state.ManagedJobStatus.CANCELLING
             job_status = self._job_status(cluster_job_id)
@@ -253,6 +267,7 @@ class JobsController:
                         self.strategy.max_restarts_on_errors)
                     result = state.ManagedJobStatus.RECOVERING
                     is_restart = True
+                    _M_RESTARTS.inc(1, job=str(self.job_id))
                 elif self.strategy.max_restarts_on_errors > 0:
                     state.set_status(
                         self.job_id, result,
@@ -271,6 +286,8 @@ class JobsController:
                 return result
             # Preemption: recover.
             n = state.bump_recovery(self.job_id)
+            if not is_restart:
+                _M_RECOVERIES.inc(1, job=str(self.job_id))
             state.set_status(self.job_id,
                              state.ManagedJobStatus.RECOVERING)
             if n > _MAX_RECOVERIES:
@@ -320,6 +337,9 @@ def main() -> None:
                          failure_reason=str(e))
         raise
     finally:
+        # Final spool dump: the terminal counter values survive the
+        # process (the monitor-tick dump may be a whole gap stale).
+        metrics_lib.dump_snapshot(f'jobs.controller.{args.job_id}')
         scheduler.job_done(args.job_id)
 
 

@@ -49,7 +49,8 @@ def _launch_retry_policy() -> retry_lib.RetryPolicy:
         # full jitter would allow ~0s relaunches.
         jitter='none',
         retryable=lambda e: not isinstance(
-            e, exceptions.ResourcesUnavailableError))
+            e, exceptions.ResourcesUnavailableError),
+        site='jobs.launch')
 
 
 class StrategyExecutor:

@@ -1,0 +1,88 @@
+"""Unified metrics subsystem: registry, Prometheus exposition,
+cross-process snapshots.
+
+The observability substrate the serving/jobs/provision stack writes
+to and operators scrape from (docs/metrics.md):
+
+- :mod:`registry` — thread-safe labeled ``Counter`` / ``Gauge`` /
+  ``Histogram`` primitives and the process-wide default
+  :data:`REGISTRY`. Zero dependencies, near-zero overhead: safe in
+  the engine's per-tick loop.
+- :mod:`exposition` — Prometheus text-format rendering
+  (``render_exposition()`` backs the ``/metrics`` endpoints on
+  ``serving_http.EngineServer``, ``server.server`` and the serve
+  load balancer).
+- :mod:`snapshot` — the spool-dir protocol (``SKYTPU_METRICS_DIR``)
+  that lets detached controllers/agents export their counters as
+  atomic JSON files, merged into any scrape.
+
+Register metrics at module scope with the get-or-create helpers::
+
+    from skypilot_tpu import metrics
+    _FAULTS = metrics.counter(
+        'skytpu_faults_injected_total',
+        'Faults injected by the chaos harness.',
+        labels=('site', 'kind'))
+    _FAULTS.inc(1, site='provision.local.run_instances',
+                kind='stockout')
+
+Every name must match ``skytpu_[a-z0-9_]+`` and carry a help string
+(enforced at registration and re-checked by the metrics lint test).
+"""
+from skypilot_tpu.metrics.exposition import CONTENT_TYPE
+from skypilot_tpu.metrics.exposition import render
+from skypilot_tpu.metrics.registry import Counter
+from skypilot_tpu.metrics.registry import DEFAULT_MAX_SERIES
+from skypilot_tpu.metrics.registry import FAST_LATENCY_BUCKETS
+from skypilot_tpu.metrics.registry import Gauge
+from skypilot_tpu.metrics.registry import Histogram
+from skypilot_tpu.metrics.registry import LATENCY_BUCKETS
+from skypilot_tpu.metrics.registry import Metric
+from skypilot_tpu.metrics.registry import OVERFLOW_LABEL
+from skypilot_tpu.metrics.registry import REGISTRY
+from skypilot_tpu.metrics.registry import Registry
+from skypilot_tpu.metrics.registry import merge_families
+from skypilot_tpu.metrics.snapshot import METRICS_DIR_ENV
+from skypilot_tpu.metrics.snapshot import dump as dump_snapshot
+from skypilot_tpu.metrics.snapshot import load as load_snapshots
+from skypilot_tpu.metrics.snapshot import merged_families
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+
+
+def render_exposition(registry=None, include_spool: bool = False) -> str:
+    """The default registry (or ``registry``) as Prometheus text;
+    ``include_spool=True`` merges every other process's spooled
+    snapshot (the aggregation-endpoint mode)."""
+    return render(merged_families(registry, include_spool=include_spool))
+
+
+def summary(registry=None) -> dict:
+    """Flat ``{'name{label="v"}': value}`` dict of counters/gauges
+    (histograms reduce to ``_count``/``_sum``) — the compact form
+    bench.py embeds in each round's JSON detail."""
+    registry = registry or REGISTRY
+    out = {}
+    for name, fam in registry.families().items():
+        for s in fam['series']:
+            labels = ','.join(f'{k}="{v}"'
+                              for k, v in sorted(s['labels'].items()))
+            series_name = f'{name}{{{labels}}}' if labels else name
+            if fam['kind'] == 'histogram':
+                out[f'{series_name}_count'] = s['count']
+                out[f'{series_name}_sum'] = round(s['sum'], 6)
+            else:
+                out[series_name] = s['value']
+    return out
+
+
+__all__ = [
+    'CONTENT_TYPE', 'Counter', 'DEFAULT_MAX_SERIES',
+    'FAST_LATENCY_BUCKETS', 'Gauge', 'Histogram', 'LATENCY_BUCKETS',
+    'METRICS_DIR_ENV', 'Metric', 'OVERFLOW_LABEL', 'REGISTRY',
+    'Registry', 'counter', 'dump_snapshot', 'gauge', 'histogram',
+    'load_snapshots', 'merge_families', 'merged_families', 'render',
+    'render_exposition', 'summary',
+]

@@ -1,0 +1,74 @@
+"""Prometheus text exposition (format version 0.0.4).
+
+Renders a families dict (registry.families() — optionally merged with
+cross-process snapshots, see snapshot.py) into the standard
+``# HELP`` / ``# TYPE`` / sample-line text that any Prometheus scraper,
+``curl | grep``, or dashboard agent reads. Histograms expose the
+conventional cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``
+triplet, so PromQL ``histogram_quantile`` works unmodified.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
+
+
+def _fmt(value: float) -> str:
+    """Prometheus-friendly number: integral floats print as ints."""
+    f = float(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_help(text: str) -> str:
+    return text.replace('\\', r'\\').replace('\n', r'\n')
+
+
+def _escape_label(text: str) -> str:
+    return (text.replace('\\', r'\\').replace('"', r'\"')
+            .replace('\n', r'\n'))
+
+
+def _label_str(labels: Dict[str, str],
+               extra: Dict[str, str] = None) -> str:
+    items = list(labels.items()) + list((extra or {}).items())
+    if not items:
+        return ''
+    body = ','.join(f'{k}="{_escape_label(str(v))}"'
+                    for k, v in items)
+    return '{' + body + '}'
+
+
+def render(families: Dict[str, Dict[str, Any]]) -> str:
+    """Families dict -> exposition text (trailing newline included)."""
+    lines = []
+    for name in sorted(families):
+        fam = families[name]
+        kind = fam.get('kind', 'untyped')
+        lines.append(f'# HELP {name} {_escape_help(fam["help"])}')
+        lines.append(f'# TYPE {name} {kind}')
+        for s in fam.get('series', ()):
+            labels = s.get('labels', {})
+            if kind == 'histogram':
+                acc = 0
+                for bound, count in zip(fam.get('buckets', ()),
+                                        s['counts']):
+                    acc += count
+                    lines.append(
+                        f'{name}_bucket'
+                        f'{_label_str(labels, {"le": _fmt(bound)})} '
+                        f'{acc}')
+                acc += s['counts'][-1]
+                lines.append(
+                    f'{name}_bucket{_label_str(labels, {"le": "+Inf"})}'
+                    f' {acc}')
+                lines.append(
+                    f'{name}_sum{_label_str(labels)} {_fmt(s["sum"])}')
+                lines.append(
+                    f'{name}_count{_label_str(labels)} {s["count"]}')
+            else:
+                lines.append(
+                    f'{name}{_label_str(labels)} {_fmt(s["value"])}')
+    return '\n'.join(lines) + ('\n' if lines else '')

@@ -1,0 +1,425 @@
+"""Labeled metric primitives + registry (the in-process substrate).
+
+Design constraints (docs/metrics.md):
+
+- **No dependencies.** The instrumented code spans every layer from
+  the serving engine's per-tick hot loop to provision retry sites —
+  a prometheus_client dependency (or anything pip-installed) is off
+  the table, and the primitives must be cheap enough that an
+  uninstrumented-feeling `inc()` can sit inside `engine.step()`.
+- **Thread-safe.** The engine driver thread, aiohttp event loops,
+  replica-manager probe threads and retry sites all write
+  concurrently; every mutation takes the metric's lock (one `dict`
+  op under a `threading.Lock` — no atomics games).
+- **Fixed-bucket histograms.** Latency histograms carry their bucket
+  bounds at registration; `observe()` is a bisect + two adds. No
+  quantile estimation, no decay — Prometheus-style cumulative
+  buckets that merge exactly across processes (snapshot protocol).
+- **Bounded cardinality.** A metric folds label sets beyond
+  ``max_series`` into a reserved ``_other`` series instead of growing
+  without bound (a load balancer fed hostile replica URLs must not
+  OOM the controller).
+
+Naming contract, enforced at registration: every metric name matches
+``skytpu_[a-z0-9_]+`` and carries a non-empty help string (the lint
+test in tests/unit_tests/test_metrics.py re-asserts this over every
+metric the production modules register).
+"""
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r'skytpu_[a-z0-9_]+\Z')
+
+# Label sets beyond this fold into one '_other' series per metric.
+DEFAULT_MAX_SERIES = 1000
+OVERFLOW_LABEL = '_other'
+
+# Default latency buckets (seconds): serving TTFT / request latency.
+LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                   2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+# Finer buckets for per-token decode latency (ms-scale).
+FAST_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                        0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class Metric:
+    """Base: a named family of label-keyed series."""
+
+    kind = ''
+
+    def __init__(self, name: str, help: str,
+                 label_names: Sequence[str] = (),
+                 max_series: int = DEFAULT_MAX_SERIES) -> None:
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self.max_series = max_series
+        self._series: Dict[Tuple[str, ...], Any] = {}
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------- internals
+    def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f'{self.name}: got labels {sorted(labels)}, declared '
+                f'{sorted(self.label_names)}')
+        return tuple(str(labels[k]) for k in self.label_names)
+
+    def _new_state(self) -> Any:
+        raise NotImplementedError
+
+    def _slot(self, key: Tuple[str, ...]) -> Any:
+        """Get-or-create a series state. Caller holds the lock."""
+        state = self._series.get(key)
+        if state is None:
+            if key and len(self._series) >= self.max_series:
+                # Cardinality guard: fold into the reserved series.
+                key = tuple(OVERFLOW_LABEL for _ in key)
+                state = self._series.get(key)
+            if state is None:
+                state = self._new_state()
+                self._series[key] = state
+        return state
+
+    def _read_slot(self, key: Tuple[str, ...]) -> Optional[Any]:
+        """Series state for a read, applying the SAME overflow fold
+        as writes: a label set folded into '_other' must read the
+        shared series, not a phantom 0 (a least-load pick that read
+        0 for every folded replica would route all traffic at them).
+        Caller holds the lock; never creates."""
+        state = self._series.get(key)
+        if state is None and key and \
+                len(self._series) >= self.max_series:
+            state = self._series.get(
+                tuple(OVERFLOW_LABEL for _ in key))
+        return state
+
+    # ---------------------------------------------------------- reading
+    def series(self) -> List[Tuple[Dict[str, str], Any]]:
+        """Consistent [(labels, state-copy)] snapshot of every series."""
+        with self._lock:
+            return [(dict(zip(self.label_names, key)),
+                     self._copy_state(state))
+                    for key, state in sorted(self._series.items())]
+
+    @staticmethod
+    def _copy_state(state: Any) -> Any:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        """Drop every series (registration survives). Test hook."""
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(Metric):
+    """Monotonic float counter. ``inc`` returns the new value so
+    callers that derive rates (the autoscaler's QPS) read the same
+    number operators scrape."""
+
+    kind = 'counter'
+
+    def _new_state(self) -> List[float]:
+        return [0.0]
+
+    @staticmethod
+    def _copy_state(state: List[float]) -> float:
+        return state[0]
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> float:
+        if amount < 0:
+            raise ValueError(
+                f'{self.name}: counters only go up (amount={amount})')
+        key = self._key(labels)
+        with self._lock:
+            state = self._slot(key)
+            state[0] += amount
+            return state[0]
+
+    def value(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            state = self._read_slot(key)
+            return state[0] if state is not None else 0.0
+
+
+class Gauge(Metric):
+    """Settable point value; supports inc/dec and series removal
+    (replicas come and go)."""
+
+    kind = 'gauge'
+
+    def _new_state(self) -> List[float]:
+        return [0.0]
+
+    @staticmethod
+    def _copy_state(state: List[float]) -> float:
+        return state[0]
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._slot(key)[0] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            state = self._slot(key)
+            state[0] += amount
+            return state[0]
+
+    def dec(self, amount: float = 1.0, floor: Optional[float] = None,
+            **labels: Any) -> float:
+        """Decrement; ``floor`` clamps (an in-flight gauge must never
+        go negative when a done() races a removal)."""
+        key = self._key(labels)
+        with self._lock:
+            state = self._slot(key)
+            state[0] -= amount
+            if floor is not None and state[0] < floor:
+                state[0] = floor
+            return state[0]
+
+    def value(self, **labels: Any) -> float:
+        key = self._key(labels)
+        with self._lock:
+            state = self._read_slot(key)
+            return state[0] if state is not None else 0.0
+
+    def has_series(self, **labels: Any) -> bool:
+        """Whether the EXACT label set has its own series (no
+        overflow fold) — series-lifecycle decisions (retire a
+        drained replica's gauge) must not act on the shared
+        '_other' value."""
+        key = self._key(labels)
+        with self._lock:
+            return key in self._series
+
+    def touch(self, **labels: Any) -> None:
+        """Ensure the series exists (exposed as 0 before first write)."""
+        key = self._key(labels)
+        with self._lock:
+            self._slot(key)
+
+    def remove(self, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series.pop(key, None)
+
+
+class Histogram(Metric):
+    """Fixed-bucket histogram: per-bin counts + sum + count.
+
+    Bounds are upper edges (no +Inf; the overflow bin is implicit as
+    the last slot). Cumulative counts are materialized only at
+    exposition, so ``observe`` is bisect + two adds.
+    """
+
+    kind = 'histogram'
+
+    def __init__(self, name: str, help: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = LATENCY_BUCKETS,
+                 max_series: int = DEFAULT_MAX_SERIES) -> None:
+        super().__init__(name, help, label_names, max_series)
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(
+                f'{name}: buckets must be non-empty and sorted, got '
+                f'{buckets!r}')
+        self.buckets = tuple(float(b) for b in buckets)
+
+    def _new_state(self) -> Dict[str, Any]:
+        return {'counts': [0] * (len(self.buckets) + 1),
+                'sum': 0.0, 'count': 0}
+
+    @staticmethod
+    def _copy_state(state: Dict[str, Any]) -> Dict[str, Any]:
+        return {'counts': list(state['counts']),
+                'sum': state['sum'], 'count': state['count']}
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            state = self._slot(key)
+            state['counts'][idx] += 1
+            state['sum'] += value
+            state['count'] += 1
+
+
+class Registry:
+    """Name -> metric map; registration is idempotent get-or-create
+    (modules re-registering the same (name, kind, labels) share one
+    metric; a conflicting re-registration raises)."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------- registration
+    def _register(self, cls, name: str, help: str,
+                  labels: Sequence[str], **kwargs: Any) -> Metric:
+        if not _NAME_RE.fullmatch(name):
+            raise ValueError(
+                f'metric name {name!r} must match skytpu_[a-z0-9_]+')
+        if not help or not help.strip():
+            raise ValueError(f'metric {name!r} needs a help string')
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls or
+                        existing.label_names != tuple(labels)):
+                    raise ValueError(
+                        f'metric {name!r} already registered as '
+                        f'{type(existing).__name__}'
+                        f'{existing.label_names}')
+                want_buckets = kwargs.get('buckets')
+                if (want_buckets is not None and
+                        isinstance(existing, Histogram) and
+                        existing.buckets != tuple(
+                            float(b) for b in want_buckets)):
+                    # Same name + different buckets would silently
+                    # collapse one caller's observations into the
+                    # other's bin edges.
+                    raise ValueError(
+                        f'metric {name!r} already registered with '
+                        f'buckets {existing.buckets}')
+                return existing
+            metric = cls(name, help, labels, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str,
+                labels: Sequence[str] = (),
+                max_series: int = DEFAULT_MAX_SERIES) -> Counter:
+        return self._register(Counter, name, help, labels,
+                              max_series=max_series)
+
+    def gauge(self, name: str, help: str,
+              labels: Sequence[str] = (),
+              max_series: int = DEFAULT_MAX_SERIES) -> Gauge:
+        return self._register(Gauge, name, help, labels,
+                              max_series=max_series)
+
+    def histogram(self, name: str, help: str,
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = LATENCY_BUCKETS,
+                  max_series: int = DEFAULT_MAX_SERIES) -> Histogram:
+        return self._register(Histogram, name, help, labels,
+                              buckets=buckets, max_series=max_series)
+
+    # --------------------------------------------------------- reading
+    def collect(self) -> List[Metric]:
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def families(self) -> Dict[str, Dict[str, Any]]:
+        """The interchange form (shared with the snapshot protocol):
+
+            {name: {'kind', 'help', 'label_names', 'buckets'?,
+                    'series': [{'labels': {...}, 'value': v} |
+                               {'labels': {...}, 'counts': [...],
+                                'sum': s, 'count': n}]}}
+        """
+        out: Dict[str, Dict[str, Any]] = {}
+        for metric in self.collect():
+            fam: Dict[str, Any] = {
+                'kind': metric.kind,
+                'help': metric.help,
+                'label_names': list(metric.label_names),
+                'series': [],
+            }
+            if isinstance(metric, Histogram):
+                fam['buckets'] = list(metric.buckets)
+            for labels, state in metric.series():
+                if isinstance(metric, Histogram):
+                    fam['series'].append({'labels': labels, **state})
+                else:
+                    fam['series'].append({'labels': labels,
+                                          'value': state})
+            out[metric.name] = fam
+        return out
+
+    def reset(self) -> None:
+        """Clear every metric's series (registrations survive) — the
+        hermetic-test hook (tests/conftest.py wipes the default
+        registry between tests so engines/LBs never see a previous
+        test's numbers)."""
+        for metric in self.collect():
+            metric.clear()
+
+
+def _series_ok(s: Any, kind: str) -> bool:
+    """Shape-check one incoming snapshot series (spool files are
+    outside-world input: a scrape must skip corruption, not crash on
+    it or silently merge truncated bucket lists)."""
+    if not isinstance(s, dict) or not isinstance(s.get('labels'), dict):
+        return False
+    if kind == 'histogram':
+        return (isinstance(s.get('counts'), list) and
+                isinstance(s.get('sum'), (int, float)) and
+                isinstance(s.get('count'), int))
+    return isinstance(s.get('value'), (int, float))
+
+
+def merge_families(base: Dict[str, Dict[str, Any]],
+                   other: Any) -> None:
+    """Merge ``other`` into ``base`` in place (the scrape-side union
+    of process snapshots): counters and gauges SUM per label set,
+    histograms sum bucket-wise (bounds must match). Malformed or
+    mismatched input — wrong kinds, different bucket bounds,
+    truncated counts lists — is SKIPPED, never merged partially and
+    never allowed to raise: one corrupt spool file must not take
+    down (or corrupt) the fleet /metrics endpoint."""
+    if not isinstance(other, dict):
+        return
+    for name, fam in other.items():
+        if not isinstance(fam, dict):
+            continue
+        kind = fam.get('kind')
+        series = [s for s in fam.get('series', ())
+                  if _series_ok(s, kind)]
+        if kind == 'histogram':
+            n_bins = len(fam.get('buckets', ())) + 1
+            series = [s for s in series if len(s['counts']) == n_bins]
+        mine = base.get(name)
+        if mine is None:
+            base[name] = {
+                **{k: v for k, v in fam.items() if k != 'series'},
+                'series': [dict(s) for s in series],
+            }
+            continue
+        if mine.get('kind') != kind:
+            continue
+        if (kind == 'histogram' and
+                list(mine.get('buckets', ())) !=
+                list(fam.get('buckets', ()))):
+            continue
+        index = {tuple(sorted(s['labels'].items())): s
+                 for s in mine['series']}
+        for s in series:
+            key = tuple(sorted(s['labels'].items()))
+            have = index.get(key)
+            if have is None:
+                new = dict(s)
+                mine['series'].append(new)
+                index[key] = new
+            elif 'counts' in s:
+                have['counts'] = [a + b for a, b in
+                                  zip(have['counts'], s['counts'])]
+                have['sum'] += s['sum']
+                have['count'] += s['count']
+            else:
+                have['value'] = have.get('value', 0.0) + s['value']
+
+
+# The process-wide default registry every production metric lives in.
+REGISTRY = Registry()

@@ -1,0 +1,134 @@
+"""Cross-process snapshot spool (the fault-injection record-file
+pattern, applied to metrics).
+
+The stack's metric writers span processes a scraper can't reach: the
+detached jobs controller (one process per managed job), the serve
+controller, agents. Instead of running an HTTP server in every one,
+each process periodically **dumps** its registry as one JSON file into
+a spool directory (``SKYTPU_METRICS_DIR``), atomically
+(write-tmp + rename — a scraper never reads a torn file). Any
+``/metrics`` endpoint then **merges** the spool into its own live
+registry at scrape time: counters and histograms sum exactly across
+processes, gauges sum (per-process gauges should carry a
+distinguishing label).
+
+File naming: ``<component>.<pid>.json`` — one file per process,
+overwritten in place, so the spool holds the LATEST snapshot of each
+writer, not a growing log. The scraping process's own file is skipped
+on load (its registry is already counted live). ``SKYTPU_METRICS_TTL``
+(seconds, default 900) ages out snapshots of dead processes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.metrics import registry as registry_lib
+
+METRICS_DIR_ENV = 'SKYTPU_METRICS_DIR'
+METRICS_TTL_ENV = 'SKYTPU_METRICS_TTL'
+_DEFAULT_TTL_SECONDS = 900.0
+
+_COMPONENT_RE = re.compile(r'[^A-Za-z0-9._-]+')
+
+
+def spool_dir() -> Optional[str]:
+    path = os.environ.get(METRICS_DIR_ENV)
+    return os.path.expanduser(path) if path else None
+
+
+def dump(component: str,
+         registry: Optional[registry_lib.Registry] = None,
+         dirpath: Optional[str] = None) -> Optional[str]:
+    """Write this process's registry as ``<component>.<pid>.json``.
+
+    No-op (returns None) when no spool dir is configured — production
+    code calls this unconditionally from control loops, and the
+    default must stay free. Never raises on I/O failure: losing one
+    snapshot beats crashing a controller mid-recovery.
+    """
+    dirpath = dirpath or spool_dir()
+    if not dirpath:
+        return None
+    registry = registry or registry_lib.REGISTRY
+    component = _COMPONENT_RE.sub('_', component) or 'unnamed'
+    path = os.path.join(dirpath, f'{component}.{os.getpid()}.json')
+    payload = {
+        'component': component,
+        'pid': os.getpid(),
+        'ts': time.time(),
+        'metrics': registry.families(),
+    }
+    tmp = f'{path}.tmp.{os.getpid()}'
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path
+
+
+def load(dirpath: Optional[str] = None,
+         exclude_pid: Optional[int] = None,
+         max_age: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Parse every snapshot in the spool (corrupt/stale files are
+    skipped — a scrape must degrade, not fail)."""
+    dirpath = dirpath or spool_dir()
+    if not dirpath or not os.path.isdir(dirpath):
+        return []
+    if max_age is None:
+        try:
+            max_age = float(os.environ.get(METRICS_TTL_ENV,
+                                           _DEFAULT_TTL_SECONDS))
+        except ValueError:
+            # 'a scrape must degrade, not fail': a typo'd TTL env
+            # (e.g. '15m') falls back to the default, it does not
+            # 500 every scrape until an operator fixes it.
+            max_age = _DEFAULT_TTL_SECONDS
+    now = time.time()
+    out = []
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith('.json'):
+            continue
+        try:
+            with open(os.path.join(dirpath, name),
+                      encoding='utf-8') as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (not isinstance(snap, dict) or
+                not isinstance(snap.get('metrics'), dict)):
+            continue
+        if exclude_pid is not None and snap.get('pid') == exclude_pid:
+            continue
+        try:
+            age = now - float(snap.get('ts', now))
+        except (TypeError, ValueError):
+            continue              # corrupt timestamp: skip the file
+        if max_age and age > max_age:
+            continue
+        out.append(snap)
+    return out
+
+
+def merged_families(
+        registry: Optional[registry_lib.Registry] = None,
+        include_spool: bool = True,
+        dirpath: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    """This process's live families, plus (optionally) every other
+    process's spooled snapshot merged in — the scrape-time view."""
+    registry = registry or registry_lib.REGISTRY
+    families = registry.families()
+    if include_spool:
+        for snap in load(dirpath, exclude_pid=os.getpid()):
+            registry_lib.merge_families(families, snap['metrics'])
+    return families

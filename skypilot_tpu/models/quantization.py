@@ -272,6 +272,18 @@ def quantize_checkpoint(in_path: str, out_path: str, cfg) -> Dict:
     import orbax.checkpoint as ocp
 
     from skypilot_tpu import models
+    from skypilot_tpu.models import gpt2 as gpt2_mod
+    if isinstance(cfg, gpt2_mod.GPT2Config):
+        # Same family gate as ServingEngine: the quantization scheme
+        # is structured around the Llama/MoE param tree (2-D+ matmul
+        # leaves with a contraction axis). GPT-2's tree carries 1-D
+        # leaves (e.g. biases) whose axis=-2 scale reduction crashes
+        # _quantize_leaf MID-RUN — after minutes of host restore work.
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            'int8 quantization supports the Llama and MoE families; '
+            'GPT-2 is a training family here (its 1-D param leaves '
+            'have no per-output-channel scale axis).')
     fam = models.family(cfg)
     cpu = jax.devices('cpu')[0]
     host = jax.sharding.SingleDeviceSharding(cpu)
@@ -312,8 +324,15 @@ def _main() -> None:
     # on restore and DOUBLE host peak RAM (an 8B tree: 32 GB instead
     # of 16). Checkpoints worth quantizing are bf16.
     import jax.numpy as _jnp
+
+    from skypilot_tpu import exceptions
     cfg = models.config_preset(args.model)(param_dtype=_jnp.bfloat16)
-    quantize_checkpoint(args.in_path, args.out_path, cfg)
+    try:
+        quantize_checkpoint(args.in_path, args.out_path, cfg)
+    except exceptions.NotSupportedError as e:
+        # Family gate (GPT-2 etc.): a clean one-line CLI error, not a
+        # traceback out of _quantize_leaf.
+        raise SystemExit(f'error: {e}') from None
     print(f'Quantized {args.in_path} -> {args.out_path}')
 
 

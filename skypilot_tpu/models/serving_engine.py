@@ -46,8 +46,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.models import inference
 from skypilot_tpu.models.llama import LlamaConfig
+
+# Serving metrics (docs/metrics.md): host-side only — nothing here
+# touches the jitted programs, and each update is one dict op under a
+# lock, negligible against a decode chunk's device time.
+_M_QUEUE_DEPTH = metrics_lib.gauge(
+    'skytpu_engine_queue_depth',
+    'Requests queued for admission (not yet in a decode slot).')
+_M_ACTIVE_SLOTS = metrics_lib.gauge(
+    'skytpu_engine_active_slots',
+    'Decode slots currently occupied by a live request.')
+_M_REQUESTS = metrics_lib.counter(
+    'skytpu_engine_requests_total',
+    'Requests accepted by submit().')
+_M_TOKENS = metrics_lib.counter(
+    'skytpu_engine_tokens_total',
+    'Output tokens emitted to requests (rate() of this is tokens/s).')
+_M_RESETS = metrics_lib.counter(
+    'skytpu_engine_cache_resets_total',
+    'KV-cache rebuilds after decode-region exhaustion.')
+_M_TTFT = metrics_lib.histogram(
+    'skytpu_engine_ttft_seconds',
+    'Submit-to-first-token latency (queue wait + prefill + sync).',
+    buckets=metrics_lib.LATENCY_BUCKETS)
+_M_TOKEN_LATENCY = metrics_lib.histogram(
+    'skytpu_engine_per_token_seconds',
+    'Decode latency per emitted token: engine tick interval over '
+    'tokens emitted that tick (chunk-granular; in steady state the '
+    'tick interval IS the device chunk time, thanks to the '
+    'double-buffered dispatch).',
+    buckets=metrics_lib.FAST_LATENCY_BUCKETS)
 
 
 @dataclasses.dataclass
@@ -322,6 +353,16 @@ class ServingEngine:
         # engine default; temperature is traced, so this never
         # recompiles).
         self._temps = np.full((batch_size,), temperature, np.float32)
+        # Gauges exist (as 0) from boot, so a scrape of an idle
+        # replica still sees the full metric surface.
+        _M_QUEUE_DEPTH.touch()
+        _M_ACTIVE_SLOTS.touch()
+        # Warmup's synthetic requests must not count: their "TTFT"
+        # is multi-second XLA compiles, which would sit in the
+        # cumulative histogram forever and poison every later p99.
+        self._warming = False
+        # Previous step() timestamp, the per-token latency anchor.
+        self._last_tick_at: Optional[float] = None
 
     # ------------------------------------------------------------------
     def warmup(self) -> None:
@@ -338,7 +379,11 @@ class ServingEngine:
                     list(rng.integers(0, self.cfg.vocab_size, b)),
                     max_new=2) for b in self.buckets
         ]
-        self.run(reqs)
+        self._warming = True
+        try:
+            self.run(reqs)
+        finally:
+            self._warming = False
         # Also compile every (chunk size, page count) static-arg pair
         # a run can dispatch, so no XLA compile ever lands inside a
         # live request's latency. Chunk sizes fold to powers of two
@@ -402,6 +447,9 @@ class ServingEngine:
                 f'capacity ({self.decode_capacity()}); raise max_seq.')
         self._submitted_at[request.request_id] = time.time()
         self.queue.append(request)
+        if not self._warming:
+            _M_REQUESTS.inc()
+            _M_QUEUE_DEPTH.set(len(self.queue))
 
     def decode_capacity(self) -> int:
         return self.max_seq - self.max_prompt
@@ -447,6 +495,7 @@ class ServingEngine:
                     self.cache = None
                     self.cache = self._make_empty()
                     self._steps_done = 0
+                    _M_RESETS.inc()
                 else:
                     break  # wait for running requests to drain
             admits.append((slot_idx, self.queue.popleft()))
@@ -499,12 +548,13 @@ class ServingEngine:
 
     def _finish(self, slot_idx: int) -> None:
         state = self.slots[slot_idx]
+        finished_at = time.time()
         self.results[state.request_id] = Result(
             request_id=state.request_id,
             tokens=state.generated,
             prompt_len=state.prompt_len,
             submitted_at=self._submitted_at.pop(state.request_id, 0.0),
-            finished_at=time.time())
+            finished_at=finished_at)
         self.slots[slot_idx] = None
 
     def _is_done(self, state: _SlotState) -> bool:
@@ -527,7 +577,21 @@ class ServingEngine:
         self._admit()
         new_entry = self._dispatch_chunk()
         prev, self._pending = self._pending, new_entry
-        return self._process_chunk(prev)
+        emitted = self._process_chunk(prev)
+        # Per-token latency at tick granularity: the interval between
+        # consecutive ticks over the tokens this tick surfaced. Host
+        # timestamps within one tick would be sync artifacts (a
+        # request finishing inside a single chunk shows ~0s/token);
+        # the tick interval is the real pipeline rate.
+        tick_at = time.perf_counter()
+        if (emitted and not self._warming and
+                self._last_tick_at is not None):
+            _M_TOKEN_LATENCY.observe(
+                (tick_at - self._last_tick_at) / emitted)
+        self._last_tick_at = tick_at
+        _M_QUEUE_DEPTH.set(len(self.queue))
+        _M_ACTIVE_SLOTS.set(self.num_active())
+        return emitted
 
     def flush(self) -> int:
         """Sync and process the in-flight chunk without dispatching a
@@ -582,6 +646,7 @@ class ServingEngine:
             return 0
         toks_host = np.asarray(entry['toks'])   # [n, B] — THE sync
         emitted = 0
+        now = time.time()
         firsts_cache: Dict[int, np.ndarray] = {}
         for slot_idx, epoch in entry['snapshot']:
             state = self.slots[slot_idx]
@@ -600,6 +665,9 @@ class ServingEngine:
                 state.generated.append(int(host[j]))
                 fresh.append(int(host[j]))
                 emitted += 1
+                if not self._warming:
+                    _M_TTFT.observe(now - self._submitted_at.get(
+                        state.request_id, now))
             if not self._is_done(state):
                 for t in range(entry['n']):
                     tok = int(toks_host[t, slot_idx])
@@ -614,6 +682,8 @@ class ServingEngine:
                 self.on_token(state.request_id, fresh)
             if self._is_done(state):
                 self._finish(slot_idx)
+        if emitted and not self._warming:
+            _M_TOKENS.inc(emitted)
         return emitted
 
     def drain_results(self) -> Dict[Any, Result]:

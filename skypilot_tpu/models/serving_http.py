@@ -36,9 +36,14 @@ from typing import Any, Dict, Optional
 
 from aiohttp import web
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
+
+_M_REJECTS = metrics_lib.counter(
+    'skytpu_engine_rejects_total',
+    'Generate requests shed with HTTP 429 (pending queue full).')
 
 
 class EngineServer:
@@ -158,6 +163,7 @@ class EngineServer:
         retry = max(1, min(30, depth //
                            max(1, getattr(self.engine, 'batch_size',
                                           1))))
+        _M_REJECTS.inc()
         return web.json_response(
             {'error': 'server overloaded: pending queue is full',
              'pending': depth, 'max_pending': self.max_pending},
@@ -306,10 +312,24 @@ class EngineServer:
             return web.json_response({'status': 'warming'}, status=503)
         return web.json_response({'status': 'ok'})
 
+    async def handle_metrics(self, request: web.Request
+                             ) -> web.Response:
+        """Prometheus exposition of the replica's engine metrics
+        (docs/metrics.md). Host-side only — safe during warmup and
+        after engine death (a dying replica's last counters are
+        exactly what an operator wants to scrape). This process's
+        registry only: spool merging belongs to ONE endpoint per
+        host (the API server) or scraping two endpoints would count
+        every spooled controller twice."""
+        text = metrics_lib.render_exposition()
+        return web.Response(
+            text=text, headers={'Content-Type': metrics_lib.CONTENT_TYPE})
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post('/generate', self.handle_generate)
         app.router.add_get('/health', self.handle_health)
+        app.router.add_get('/metrics', self.handle_metrics)
         return app
 
     async def start(self, port: int) -> web.AppRunner:

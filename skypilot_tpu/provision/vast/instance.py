@@ -163,8 +163,13 @@ def get_cluster_info(cluster_name_on_cloud: str, region: str,
         infos[label] = [
             common.InstanceInfo(
                 instance_id=str(inst.get('id', label)),
-                internal_ip=inst.get('local_ipaddrs') or
-                inst.get('public_ipaddr', ''),
+                # 'local_ipaddrs' is a SPACE-SEPARATED string of the
+                # rental's private addresses; take the first one (the
+                # raw field would embed every address in env contracts
+                # and ssh configs).
+                internal_ip=(
+                    (inst.get('local_ipaddrs') or '').split() +
+                    [inst.get('public_ipaddr', '')])[0],
                 external_ip=inst.get('public_ipaddr'),
                 # Vast exposes sshd on a mapped high port.
                 ssh_port=int(inst.get('ssh_port') or 22),

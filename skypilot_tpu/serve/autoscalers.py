@@ -13,11 +13,22 @@ import dataclasses
 import math
 import time
 from collections import deque
-from typing import Deque, Optional
+from typing import Deque, Optional, Tuple
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.serve.service_spec import ServiceSpec
 
 _QPS_WINDOW_SECONDS = 60.0
+
+# The scaling signal IS the scraped series (docs/metrics.md): every
+# record_request increments this counter, and current_qps derives
+# from its deltas — an operator graphing rate(skytpu_lb_requests_total)
+# sees the exact number the autoscaler acts on.
+_M_REQUESTS = metrics_lib.counter(
+    'skytpu_lb_requests_total',
+    'Requests observed by the service load balancer (the autoscaler '
+    'QPS signal).',
+    labels=('service',))
 
 
 @dataclasses.dataclass
@@ -32,11 +43,16 @@ class ScalingDecision:
 class FixedReplicaAutoscaler:
     """No target_qps: hold min_replicas."""
 
-    def __init__(self, spec: ServiceSpec) -> None:
+    def __init__(self, spec: ServiceSpec,
+                 service: str = 'default') -> None:
         self.spec = spec
+        self._service = service
 
     def record_request(self, now: Optional[float] = None) -> None:
-        pass
+        # No scaling decision reads it, but the traffic series still
+        # exists for dashboards.
+        del now
+        _M_REQUESTS.inc(1, service=self._service)
 
     def to_state(self) -> dict:
         return {}
@@ -85,11 +101,30 @@ def _with_spot_split(spec: ServiceSpec, decision: ScalingDecision,
 
 
 class RequestRateAutoscaler:
+    """QPS-derived scaling where the QPS signal comes from the
+    SCRAPED request counter: ``record_request`` increments
+    ``skytpu_lb_requests_total{service=...}`` and keeps a sliding
+    window of (timestamp, cumulative-count) samples; ``current_qps``
+    is the counter delta over the window — numerically identical to
+    the old private-timestamp-deque computation (equivalence-tested),
+    but now the dashboard and the scaling decision read one number."""
 
-    def __init__(self, spec: ServiceSpec) -> None:
+    def __init__(self, spec: ServiceSpec,
+                 service: str = 'default') -> None:
         assert spec.target_qps_per_replica is not None
         self.spec = spec
-        self._timestamps: Deque[float] = deque()
+        self._service = service
+        # (timestamp, cumulative count) per recorded request, where
+        # the cumulative count is the scraped counter plus a restore
+        # offset; _window_base is the cumulative count at the window
+        # start. The offset exists so restore() can rebuild the
+        # window WITHOUT re-incrementing the counter: the restored
+        # requests were already counted (by the previous process, or
+        # by this process before a rolling-update rebuild) — replay
+        # would show a phantom traffic spike on every scrape.
+        self._samples: Deque[Tuple[float, float]] = deque()
+        self._offset = 0.0
+        self._window_base = _M_REQUESTS.value(service=service)
         # The autoscaler owns its target (reference autoscalers.py
         # target_num_replicas): the target is what capacity SHOULD be,
         # so a preemption that shrinks the live pool does not lower
@@ -111,7 +146,7 @@ class RequestRateAutoscaler:
         so a restart under load does not forget demand and
         spuriously downscale."""
         return {
-            'timestamps': list(self._timestamps),
+            'timestamps': [t for t, _ in self._samples],
             'target': self._target,
             'desired': self._desired,
             'desire_since': self._desire_since,
@@ -120,8 +155,20 @@ class RequestRateAutoscaler:
     def restore(self, state: dict) -> None:
         now = time.time()
         cutoff = now - _QPS_WINDOW_SECONDS
-        self._timestamps = deque(
-            t for t in state.get('timestamps', ()) if t >= cutoff)
+        # Rebuild the window as synthetic cumulative samples on top
+        # of the counter's CURRENT value — the restored requests are
+        # window state, not new traffic, so the scraped counter is
+        # not touched (no phantom rate() spike on controller restart
+        # or rolling-update autoscaler rebuild). The offset keeps
+        # later record_request() samples monotonically above the
+        # replayed ones.
+        base = _M_REQUESTS.value(service=self._service)
+        kept = sorted(t for t in state.get('timestamps', ())
+                      if t >= cutoff)
+        self._samples = deque(
+            (t, base + i + 1) for i, t in enumerate(kept))
+        self._window_base = base
+        self._offset = float(len(kept))
         self._target = max(self.spec.min_replicas,
                            int(state.get('target',
                                          self.spec.min_replicas)))
@@ -133,14 +180,18 @@ class RequestRateAutoscaler:
 
     # ------------------------------------------------------------------
     def record_request(self, now: Optional[float] = None) -> None:
-        self._timestamps.append(now if now is not None else time.time())
+        t = now if now is not None else time.time()
+        cum = _M_REQUESTS.inc(1, service=self._service) + self._offset
+        self._samples.append((t, cum))
 
     def current_qps(self, now: Optional[float] = None) -> float:
         now = now if now is not None else time.time()
         cutoff = now - _QPS_WINDOW_SECONDS
-        while self._timestamps and self._timestamps[0] < cutoff:
-            self._timestamps.popleft()
-        return len(self._timestamps) / _QPS_WINDOW_SECONDS
+        while self._samples and self._samples[0][0] < cutoff:
+            self._window_base = self._samples.popleft()[1]
+        latest = (self._samples[-1][1] if self._samples
+                  else self._window_base)
+        return (latest - self._window_base) / _QPS_WINDOW_SECONDS
 
     def _raw_target(self, now: float) -> int:
         qps = self.current_qps(now)
@@ -190,9 +241,9 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
         return _with_spot_split(self.spec, decision, num_ready_spot)
 
 
-def make_autoscaler(spec: ServiceSpec):
+def make_autoscaler(spec: ServiceSpec, service: str = 'default'):
     if spec.target_qps_per_replica is None:
-        return FixedReplicaAutoscaler(spec)
+        return FixedReplicaAutoscaler(spec, service=service)
     if spec.use_spot:
-        return FallbackRequestRateAutoscaler(spec)
-    return RequestRateAutoscaler(spec)
+        return FallbackRequestRateAutoscaler(spec, service=service)
+    return RequestRateAutoscaler(spec, service=service)

@@ -18,6 +18,7 @@ import os
 import traceback
 from typing import Optional
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.load_balancer import LoadBalancer
@@ -41,7 +42,8 @@ class ServeController:
         self.name = service_name
         self._version = serve_state.get_current_version(service_name)
         self.spec = ServiceSpec.from_yaml_config(record['spec'])
-        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        self.autoscaler = autoscalers.make_autoscaler(
+            self.spec, service=service_name)
         # A restarted controller resumes the persisted QPS window +
         # hysteresis clocks instead of starting cold (which would
         # forget demand and downscale a loaded service).
@@ -89,7 +91,8 @@ class ServeController:
         self._version = version
         self.spec = ServiceSpec.from_yaml_config(record['spec'])
         self.replica_manager.spec = self.spec
-        self.autoscaler = autoscalers.make_autoscaler(self.spec)
+        self.autoscaler = autoscalers.make_autoscaler(
+            self.spec, service=self.name)
         # Demand does not reset because the policy changed: carry the
         # persisted QPS window into the new version's autoscaler.
         saved = serve_state.load_autoscaler_state(self.name)
@@ -138,6 +141,10 @@ class ServeController:
                 serve_state.set_service_status(
                     self.name, ServiceStatus.READY
                     if urls else ServiceStatus.REPLICA_INIT)
+                # Export this controller's counters to the metrics
+                # spool (no-op without SKYTPU_METRICS_DIR): any
+                # /metrics endpoint on this machine merges them in.
+                metrics_lib.dump_snapshot(f'serve.{self.name}')
             except Exception:  # pylint: disable=broad-except
                 logger.error('Control loop error:\n%s',
                              traceback.format_exc())

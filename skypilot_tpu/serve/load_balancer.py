@@ -23,11 +23,12 @@ import asyncio
 import itertools
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Set
+from typing import Callable, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -38,29 +39,76 @@ _HOP_HEADERS = {
     'upgrade', 'host',
 }
 
+# Per-replica serving signals (docs/metrics.md). The in-flight gauge
+# is the SINGLE store of per-replica load: LeastLoadPolicy routes on
+# it, drain() waits on it, and operators scrape it — no second
+# private count that can disagree with the dashboard.
+_M_INFLIGHT = metrics_lib.gauge(
+    'skytpu_lb_replica_inflight',
+    'Requests currently proxied to the replica.',
+    labels=('replica',))
+_M_LATENCY = metrics_lib.histogram(
+    'skytpu_lb_replica_request_seconds',
+    'End-to-end proxied request latency per replica.',
+    labels=('replica',), buckets=metrics_lib.LATENCY_BUCKETS)
+_M_ERRORS = metrics_lib.counter(
+    'skytpu_lb_replica_errors_total',
+    'Proxy failures per replica by kind (connect, disconnect, '
+    'mid_stream, upstream).',
+    labels=('replica', 'kind'))
+
 
 class LoadBalancingPolicy:
+    """Base: owns the replica URL set and the shared in-flight gauge
+    lifecycle (series appear/disappear with replicas). ``pick`` must
+    increment the gauge for the returned URL; ``done`` releases it."""
+
+    def __init__(self) -> None:
+        self._urls: List[str] = []
 
     def set_urls(self, urls: List[str]) -> None:
-        raise NotImplementedError
+        for gone in set(self._urls) - set(urls):
+            # Drop the series ONLY when idle: drain() waits on this
+            # gauge, and a rotation (scale-down marks the replica
+            # SHUTTING_DOWN before its in-flight requests finish)
+            # must not zero the count out from under it — the old
+            # private-dict implementation never pruned on set_urls
+            # either. done() removes the straggler series once it
+            # reaches zero.
+            if not _M_INFLIGHT.has_series(replica=gone) or \
+                    _M_INFLIGHT.value(replica=gone) <= 0:
+                _M_INFLIGHT.remove(replica=gone)
+        for url in urls:
+            _M_INFLIGHT.touch(replica=url)
+        self._on_set_urls(list(urls))
+        self._urls = list(urls)
+
+    def _on_set_urls(self, urls: List[str]) -> None:
+        pass
 
     def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         raise NotImplementedError
 
     def done(self, url: str) -> None:
-        pass
+        if url in self._urls:
+            _M_INFLIGHT.dec(floor=0.0, replica=url)
+        elif _M_INFLIGHT.has_series(replica=url):
+            # Rotated out while in flight: release, and retire the
+            # series once the last straggler finishes (drain() has
+            # nothing left to wait on).
+            if _M_INFLIGHT.dec(floor=0.0, replica=url) <= 0:
+                _M_INFLIGHT.remove(replica=url)
 
 
 class RoundRobinPolicy(LoadBalancingPolicy):
 
     def __init__(self) -> None:
-        self._urls: List[str] = []
+        super().__init__()
         self._it = itertools.cycle([])
 
-    def set_urls(self, urls: List[str]) -> None:
+    def _on_set_urls(self, urls: List[str]) -> None:
         if urls != self._urls:
-            self._urls = list(urls)
-            self._it = itertools.cycle(self._urls)
+            self._it = itertools.cycle(urls)
 
     def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         if not self._urls:
@@ -68,39 +116,32 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         for _ in range(len(self._urls)):
             url = next(self._it)
             if not exclude or url not in exclude:
+                _M_INFLIGHT.inc(1, replica=url)
                 return url
         return None
 
 
 class LeastLoadPolicy(LoadBalancingPolicy):
-    """Route to the replica with the fewest in-flight requests."""
+    """Route to the replica with the fewest in-flight requests.
+
+    The in-flight count IS the ``skytpu_lb_replica_inflight`` gauge:
+    the policy routes on exactly the series operators scrape, instead
+    of a private dict that could drift from the dashboard."""
 
     def __init__(self) -> None:
-        self._load: Dict[str, int] = {}
+        super().__init__()
         self._lock = threading.Lock()
-
-    def set_urls(self, urls: List[str]) -> None:
-        with self._lock:
-            for url in urls:
-                self._load.setdefault(url, 0)
-            for url in list(self._load):
-                if url not in urls:
-                    del self._load[url]
 
     def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
         with self._lock:
-            candidates = [u for u in self._load
+            candidates = [u for u in self._urls
                           if not exclude or u not in exclude]
             if not candidates:
                 return None
-            url = min(candidates, key=self._load.get)
-            self._load[url] += 1
+            url = min(candidates,
+                      key=lambda u: _M_INFLIGHT.value(replica=u))
+            _M_INFLIGHT.inc(1, replica=url)
             return url
-
-    def done(self, url: str) -> None:
-        with self._lock:
-            if url in self._load:
-                self._load[url] = max(0, self._load[url] - 1)
 
 
 POLICIES = {
@@ -124,9 +165,6 @@ class LoadBalancer:
         self.on_request = on_request
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
-        # Per-replica in-flight request counts (for drain()); kept
-        # apart from the policy, which is free to track its own load.
-        self._inflight: Dict[str, int] = {}
         self._draining: Set[str] = set()
 
     def set_replica_urls(self, urls: List[str]) -> None:
@@ -134,7 +172,9 @@ class LoadBalancer:
         self._draining &= set(urls)
 
     def inflight(self, url: str) -> int:
-        return self._inflight.get(url, 0)
+        # One store for in-flight load: the scraped gauge, maintained
+        # by policy.pick()/done().
+        return int(_M_INFLIGHT.value(replica=url))
 
     async def drain(self, url: str, timeout: float = 60.0) -> bool:
         """Stop routing new requests to ``url`` and wait for its
@@ -142,7 +182,7 @@ class LoadBalancer:
         replica down only after this returns). True = drained."""
         self._draining.add(url)
         deadline = time.time() + timeout
-        while self._inflight.get(url, 0) > 0:
+        while self.inflight(url) > 0:
             if time.time() > deadline:
                 return False
             await asyncio.sleep(0.05)
@@ -160,21 +200,26 @@ class LoadBalancer:
             if url is None:
                 break
             tried.add(url)
-            self._inflight[url] = self._inflight.get(url, 0) + 1
+            started_at = time.time()
             try:
-                return await self._proxy_once(request, url, body)
+                resp = await self._proxy_once(request, url, body)
+                _M_LATENCY.observe(time.time() - started_at,
+                                   replica=url)
+                return resp
             except aiohttp.ClientConnectorError as e:
                 # TCP connect failed: the replica NEVER received the
                 # request — safe to retry on another replica for any
                 # method.
                 logger.warning('Replica %s unreachable (%s); retrying '
                                'on another replica', url, e)
+                _M_ERRORS.inc(1, replica=url, kind='connect')
                 last_err = e
             except aiohttp.ClientConnectionError as e:
                 # Connection dropped after the request was sent (e.g.
                 # ServerDisconnectedError): the replica may have
                 # started executing it. Retrying would double-execute
                 # non-idempotent work, so only safe methods retry.
+                _M_ERRORS.inc(1, replica=url, kind='disconnect')
                 if request.method not in ('GET', 'HEAD', 'OPTIONS'):
                     logger.warning('Replica %s dropped mid-request '
                                    '(%s); not retrying %s', url, e,
@@ -188,9 +233,11 @@ class LoadBalancer:
                 # Bytes already reached the client: cannot retry.
                 logger.warning('Replica %s died mid-response: %s', url,
                                e.cause)
+                _M_ERRORS.inc(1, replica=url, kind='mid_stream')
                 return e.response
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 logger.warning('Proxy to %s failed: %s', url, e)
+                _M_ERRORS.inc(1, replica=url, kind='upstream')
                 last_err = e
                 if request.method not in ('GET', 'HEAD', 'OPTIONS'):
                     # Same double-execution risk as the dropped-
@@ -199,8 +246,6 @@ class LoadBalancer:
                     break
             finally:
                 self.policy.done(url)
-                self._inflight[url] = max(
-                    0, self._inflight.get(url, 1) - 1)
         if last_err is None:
             return web.Response(status=503,
                                 text='No ready replicas.\n')
@@ -248,9 +293,22 @@ class LoadBalancer:
                     raise _MidStreamError(out, e) from e
                 raise
 
+    async def _handle_metrics(self, request: web.Request
+                              ) -> web.Response:
+        """The controller-side scrape point: this process's LB +
+        autoscaler + replica-manager metrics (docs/metrics.md).
+        Registered before the catch-all proxy route, so /metrics is
+        served locally, not proxied. This process's registry only —
+        spool merging is the API server's job (one merger per host,
+        or multi-endpoint scrapes double-count the spool)."""
+        text = metrics_lib.render_exposition()
+        return web.Response(
+            text=text, headers={'Content-Type': metrics_lib.CONTENT_TYPE})
+
     # ------------------------------------------------------------------
     async def start(self) -> None:
         app = web.Application()
+        app.router.add_get('/metrics', self._handle_metrics)
         app.router.add_route('*', '/{tail:.*}', self._proxy)
         self._runner = web.AppRunner(app)
         await self._runner.setup()

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 import requests
 
 from skypilot_tpu import exceptions
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.serve import serve_state
@@ -56,6 +57,11 @@ _FAILED_ROW_TTL_SECONDS = 1800.0
 _PROBE_CONNECT_TIMEOUT_SECONDS = 5.0
 _DEFAULT_PROBE_TIMEOUT_SECONDS = 15.0
 
+_M_PROBE_FAILURES = metrics_lib.counter(
+    'skytpu_serve_probe_failures_total',
+    'Failed replica readiness probes (including injected faults).',
+    labels=('replica',))
+
 # Replica-cluster teardown goes through the shared RetryPolicy: cloud
 # teardown calls are flaky exactly when the cloud is having the bad
 # day that killed the replica. ClusterDoesNotExist is success.
@@ -64,7 +70,8 @@ _TERMINATE_RETRY_POLICY = retry_lib.RetryPolicy(
     initial_backoff=1.0,
     max_backoff=10.0,
     jitter='full',
-    retryable=lambda e: not isinstance(e, exceptions.ClusterDoesNotExist))
+    retryable=lambda e: not isinstance(e, exceptions.ClusterDoesNotExist),
+    site='serve.replica.terminate')
 
 
 class ReplicaManager:
@@ -277,6 +284,7 @@ class ReplicaManager:
         fault = fault_injection.poll('serve.replica.probe_ready',
                                      replica_id=replica_id, url=url)
         if fault is not None:
+            _M_PROBE_FAILURES.inc(1, replica=url)
             return False
         read_timeout = (_DEFAULT_PROBE_TIMEOUT_SECONDS
                         if spec.readiness_timeout_seconds is None
@@ -287,8 +295,12 @@ class ReplicaManager:
             resp = requests.get(
                 url.rstrip('/') + spec.readiness_path,
                 timeout=(connect_timeout, read_timeout))
-            return resp.status_code < 500
+            if resp.status_code >= 500:
+                _M_PROBE_FAILURES.inc(1, replica=url)
+                return False
+            return True
         except requests.RequestException:
+            _M_PROBE_FAILURES.inc(1, replica=url)
             return False
 
     def probe_all(self) -> None:

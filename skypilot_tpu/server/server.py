@@ -19,6 +19,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.server import ops
 from skypilot_tpu.server import requests as requests_db
 from skypilot_tpu.server.requests import RequestStatus, ScheduleType
@@ -172,6 +173,15 @@ async def handle_list(request: web.Request) -> web.Response:
     return web.json_response({'requests': requests_db.list_requests()})
 
 
+async def handle_metrics(request: web.Request) -> web.Response:
+    """Prometheus exposition (docs/metrics.md). The API server is the
+    fleet aggregation point: its own counters plus every snapshot the
+    detached controllers spooled into SKYTPU_METRICS_DIR."""
+    text = metrics_lib.render_exposition(include_spool=True)
+    return web.Response(
+        text=text, headers={'Content-Type': metrics_lib.CONTENT_TYPE})
+
+
 async def handle_health(request: web.Request) -> web.Response:
     try:
         with open('/etc/machine-id', encoding='utf-8') as f:
@@ -288,6 +298,7 @@ def make_app() -> web.Application:
     app = web.Application(client_max_size=4 * 1024**3)
     app.cleanup_ctx.append(_heartbeat_ctx)
     app.router.add_get('/api/health', handle_health)
+    app.router.add_get('/metrics', handle_metrics)
     app.router.add_get('/api/get', handle_get)
     app.router.add_get('/api/status', handle_status_poll)
     app.router.add_get('/api/stream', handle_stream)

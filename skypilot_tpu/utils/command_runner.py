@@ -663,7 +663,9 @@ class KubernetesPortForwardRunner(SSHCommandRunner):
                                        multiplier=1.0,
                                        jitter='none',
                                        deadline=timeout,
-                                       clock=self._clock)
+                                       clock=self._clock,
+                                       site='command_runner.'
+                                            'ensure_tunnel')
         state = policy.new_state()
         while True:
             if self._tunnel.poll() is not None:

@@ -165,10 +165,12 @@ def retry(fn: Optional[Callable] = None,
                                  exceptions_to_retry=exceptions_to_retry)
 
     from skypilot_tpu.utils import retry as retry_lib
-    policy = retry_lib.RetryPolicy(max_attempts=max_retries,
-                                   initial_backoff=initial_backoff,
-                                   jitter='none',
-                                   retryable=exceptions_to_retry)
+    policy = retry_lib.RetryPolicy(
+        max_attempts=max_retries,
+        initial_backoff=initial_backoff,
+        jitter='none',
+        retryable=exceptions_to_retry,
+        site=f'common_utils.{getattr(fn, "__name__", "fn")}')
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):

@@ -62,7 +62,17 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Union
 
+from skypilot_tpu import metrics as metrics_lib
+
 FAULT_PLAN_ENV = 'SKYTPU_FAULT_PLAN'
+
+# Chaos observability (docs/metrics.md): every injected fault counts
+# here, so chaos tests (and dashboards during a game day) can assert
+# the fault volume per site without parsing the record file.
+_M_FAULTS = metrics_lib.counter(
+    'skytpu_faults_injected_total',
+    'Faults injected by the chaos harness, by site and kind.',
+    labels=('site', 'kind'))
 
 
 class FaultKind(str, enum.Enum):
@@ -200,6 +210,7 @@ class FaultPlan:
 
     def _record(self, spec: FaultSpec, site: str,
                 context: Dict[str, Any]) -> None:
+        _M_FAULTS.inc(1, site=site, kind=spec.kind.value)
         entry = {
             'pid': os.getpid(),
             'site': site,

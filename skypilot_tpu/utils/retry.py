@@ -31,6 +31,20 @@ import random
 import time
 from typing import Any, Callable, Optional, Sequence, Tuple, Union
 
+from skypilot_tpu import metrics as metrics_lib
+
+# Per-site retry pressure (docs/metrics.md): policies constructed
+# with a ``site`` label report here; site-less policies stay silent.
+_M_ATTEMPTS = metrics_lib.counter(
+    'skytpu_retry_attempts_total',
+    'Retries scheduled (backoffs taken) per call site.',
+    labels=('site',))
+_M_GIVEUPS = metrics_lib.counter(
+    'skytpu_retry_giveups_total',
+    'Retry loops that exhausted their budget (attempts or deadline) '
+    'per call site.',
+    labels=('site',))
+
 
 class Clock:
     """Monotonic clock + sleep — the only time source retries use."""
@@ -84,17 +98,25 @@ class RetryState:
     def should_retry(self, exc: Optional[BaseException] = None) -> bool:
         """May another attempt be made (after the one that just failed)?"""
         if exc is not None and not self.policy.is_retryable(exc):
+            # Non-retryable errors are not budget exhaustion — no
+            # giveup count (that series means "ran out of retries").
             return False
         p = self.policy
         if p.max_attempts is not None and self.attempt + 1 >= p.max_attempts:
+            if p.site:
+                _M_GIVEUPS.inc(1, site=p.site)
             return False
         if p.deadline is not None and self.elapsed() >= p.deadline:
+            if p.site:
+                _M_GIVEUPS.inc(1, site=p.site)
             return False
         return True
 
     def next_backoff(self) -> float:
         """Backoff for the attempt that just failed; advances the state."""
         self.attempt += 1
+        if self.policy.site:
+            _M_ATTEMPTS.inc(1, site=self.policy.site)
         base = self._backoff
         self._backoff = min(self._backoff * self.policy.multiplier,
                             self.policy.max_backoff)
@@ -120,7 +142,8 @@ class RetryPolicy:
     max_attempts=None means unlimited (bounded only by ``deadline``,
     if any). ``retryable`` is a tuple of exception classes or a
     predicate ``exc -> bool``. ``seed`` pins the jitter RNG so a chaos
-    test replays the exact same schedule.
+    test replays the exact same schedule. ``site`` labels the
+    skytpu_retry_* counters (None = unmetered).
     """
 
     def __init__(self,
@@ -133,8 +156,10 @@ class RetryPolicy:
                  deadline: Optional[float] = None,
                  retryable: Retryable = (Exception,),
                  seed: Optional[int] = None,
-                 clock: Optional[Clock] = None) -> None:
+                 clock: Optional[Clock] = None,
+                 site: Optional[str] = None) -> None:
         assert jitter in ('full', 'none'), jitter
+        self.site = site
         self.max_attempts = max_attempts
         self.initial_backoff = initial_backoff
         self.max_backoff = max_backoff

@@ -41,7 +41,7 @@ import pytest  # noqa: E402
 # subprocesses follow the same discipline.
 _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
                'test_recovery_strategy.py', 'test_decode_attention.py',
-               'test_bench_smoke.py')
+               'test_bench_smoke.py', 'test_metrics.py')
 
 
 def pytest_collection_modifyitems(config, items):
@@ -55,6 +55,17 @@ def pytest_collection_modifyitems(config, items):
         return 0
 
     items.sort(key=weight)
+
+
+@pytest.fixture(autouse=True)
+def reset_metrics():
+    """Wipe the default metrics registry's series between tests
+    (registrations survive): engines, load balancers and autoscalers
+    all write process-global metrics, and a test must never see a
+    previous test's counters."""
+    from skypilot_tpu import metrics
+    metrics.REGISTRY.reset()
+    yield
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,614 @@
+"""Unified metrics subsystem (skypilot_tpu/metrics/): registry
+semantics, Prometheus exposition format, cross-process snapshot
+merge, /metrics endpoints, and the instrumented layers' contracts
+(autoscaler QPS == scraped counter; LeastLoadPolicy routes on the
+scraped gauge; faults and retries count).
+"""
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import metrics
+
+pytestmark = pytest.mark.metrics
+
+
+# ------------------------------------------------------------ registry
+
+def test_counter_semantics():
+    reg = metrics.Registry()
+    c = reg.counter('skytpu_t_total', 'T.', labels=('kind',))
+    assert c.inc(2, kind='a') == 2
+    assert c.inc(3, kind='a') == 5
+    assert c.value(kind='a') == 5
+    assert c.value(kind='never') == 0.0      # read never creates
+    with pytest.raises(ValueError):
+        c.inc(-1, kind='a')                  # counters only go up
+    # Re-registration is get-or-create for an identical shape...
+    assert reg.counter('skytpu_t_total', 'T.', labels=('kind',)) is c
+    # ...and a conflicting shape raises.
+    with pytest.raises(ValueError):
+        reg.counter('skytpu_t_total', 'T.', labels=('other',))
+    with pytest.raises(ValueError):
+        reg.gauge('skytpu_t_total', 'T.', labels=('kind',))
+
+
+def test_gauge_semantics():
+    reg = metrics.Registry()
+    g = reg.gauge('skytpu_t_depth', 'D.', labels=('url',))
+    g.set(3, url='a')
+    g.inc(2, url='a')
+    assert g.value(url='a') == 5
+    g.dec(100, floor=0.0, url='a')
+    assert g.value(url='a') == 0             # floored
+    g.touch(url='b')
+    assert {s[0]['url'] for s in g.series()} == {'a', 'b'}
+    g.remove(url='a')
+    assert {s[0]['url'] for s in g.series()} == {'b'}
+
+
+def test_label_validation():
+    reg = metrics.Registry()
+    c = reg.counter('skytpu_t_total', 'T.', labels=('site',))
+    with pytest.raises(ValueError):
+        c.inc(1)                             # missing label
+    with pytest.raises(ValueError):
+        c.inc(1, site='x', extra='y')        # undeclared label
+    with pytest.raises(ValueError):
+        reg.counter('not_skytpu_name', 'T.')  # name lint at source
+    with pytest.raises(ValueError):
+        reg.counter('skytpu_nohelp_total', '   ')  # help required
+
+
+def test_cardinality_folds_to_other():
+    reg = metrics.Registry()
+    c = reg.counter('skytpu_t_total', 'T.', labels=('url',),
+                    max_series=2)
+    c.inc(1, url='a')
+    c.inc(1, url='b')
+    c.inc(1, url='c')                        # over the cap
+    c.inc(1, url='d')
+    labels = {s[0]['url'] for s in c.series()}
+    assert labels == {'a', 'b', metrics.OVERFLOW_LABEL}
+    assert c.value(url=metrics.OVERFLOW_LABEL) == 2  # c + d folded
+    # Reads apply the same fold as writes: a folded label set reads
+    # the shared series, not a phantom 0 (least-load routing would
+    # otherwise see every overflowed replica as idle).
+    assert c.value(url='c') == 2
+    assert c.value(url='a') == 1                     # real series wins
+
+
+def test_histogram_buckets_and_boundaries():
+    reg = metrics.Registry()
+    h = reg.histogram('skytpu_t_seconds', 'H.', buckets=(0.1, 1.0))
+    h.observe(0.05)      # -> le=0.1
+    h.observe(0.1)       # le is INCLUSIVE -> le=0.1
+    h.observe(0.5)       # -> le=1.0
+    h.observe(7.0)       # -> +Inf overflow bin
+    ((_, state),) = h.series()
+    assert state['counts'] == [2, 1, 1]
+    assert state['count'] == 4
+    assert state['sum'] == pytest.approx(7.65)
+    with pytest.raises(ValueError):
+        reg.histogram('skytpu_t2_seconds', 'H.', buckets=(1.0, 0.1))
+    # Same name + same buckets = get-or-create; different buckets
+    # raise instead of silently collapsing into the first bin edges.
+    assert reg.histogram('skytpu_t_seconds', 'H.',
+                         buckets=(0.1, 1.0)) is h
+    with pytest.raises(ValueError):
+        reg.histogram('skytpu_t_seconds', 'H.', buckets=(5.0, 50.0))
+
+
+def test_concurrent_increments_exact():
+    reg = metrics.Registry()
+    c = reg.counter('skytpu_t_total', 'T.')
+    h = reg.histogram('skytpu_t_seconds', 'H.', buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 8000
+    ((_, state),) = h.series()
+    assert state['count'] == 8000 and state['counts'][0] == 8000
+
+
+# ---------------------------------------------------------- exposition
+
+def test_exposition_golden_format():
+    reg = metrics.Registry()
+    c = reg.counter('skytpu_t_total', 'Things counted.',
+                    labels=('kind',))
+    c.inc(2, kind='a')
+    g = reg.gauge('skytpu_t_depth', 'Queue "depth".')
+    g.set(3)
+    h = reg.histogram('skytpu_t_seconds', 'Latency.', buckets=(0.1, 1))
+    h.observe(0.05)
+    h.observe(5)
+    assert metrics.render(reg.families()) == (
+        '# HELP skytpu_t_depth Queue "depth".\n'
+        '# TYPE skytpu_t_depth gauge\n'
+        'skytpu_t_depth 3\n'
+        '# HELP skytpu_t_seconds Latency.\n'
+        '# TYPE skytpu_t_seconds histogram\n'
+        'skytpu_t_seconds_bucket{le="0.1"} 1\n'
+        'skytpu_t_seconds_bucket{le="1"} 1\n'
+        'skytpu_t_seconds_bucket{le="+Inf"} 2\n'
+        'skytpu_t_seconds_sum 5.05\n'
+        'skytpu_t_seconds_count 2\n'
+        '# HELP skytpu_t_total Things counted.\n'
+        '# TYPE skytpu_t_total counter\n'
+        'skytpu_t_total{kind="a"} 2\n')
+
+
+def test_exposition_escapes_label_values():
+    reg = metrics.Registry()
+    c = reg.counter('skytpu_t_total', 'T.', labels=('url',))
+    c.inc(1, url='he said "hi"\n')
+    text = metrics.render(reg.families())
+    assert r'url="he said \"hi\"\n"' in text
+
+
+# ------------------------------------------- cross-process snapshots
+
+_CHILD_CODE = r'''
+from skypilot_tpu import metrics
+c = metrics.counter('skytpu_t_child_total', 'Child counter.',
+                    labels=('who',))
+c.inc(5, who='child')
+metrics.histogram('skytpu_t_child_seconds', 'Child latency.',
+                  buckets=(1.0,)).observe(0.5)
+path = metrics.dump_snapshot('child')
+assert path, 'spool dir not picked up'
+print(path)
+'''
+
+
+def test_snapshot_merge_across_processes(tmp_path, monkeypatch):
+    """Two real child processes dump snapshots into the spool; the
+    parent's scrape merges them with its own live registry, summing
+    counters and histogram buckets exactly, and never double-counts
+    its own dumped snapshot."""
+    spool = tmp_path / 'metrics'
+    monkeypatch.setenv(metrics.METRICS_DIR_ENV, str(spool))
+    env = {**os.environ, metrics.METRICS_DIR_ENV: str(spool)}
+    for _ in range(2):
+        proc = subprocess.run([sys.executable, '-c', _CHILD_CODE],
+                              env=env, capture_output=True, text=True,
+                              timeout=60)
+        assert proc.returncode == 0, proc.stderr
+    # Parent registers the same shapes and contributes its own share.
+    c = metrics.counter('skytpu_t_child_total', 'Child counter.',
+                        labels=('who',))
+    c.inc(2, who='child')
+    metrics.histogram('skytpu_t_child_seconds', 'Child latency.',
+                      buckets=(1.0,)).observe(0.5)
+    # The parent also dumps — its own file must be excluded on scrape.
+    assert metrics.dump_snapshot('parent')
+    text = metrics.render_exposition(include_spool=True)
+    assert 'skytpu_t_child_total{who="child"} 12' in text  # 5+5+2
+    assert 'skytpu_t_child_seconds_count 3' in text
+    # Corrupt spool input degrades, never fails or merges partially:
+    # non-JSON, null metrics, bad timestamps, and a histogram series
+    # with a truncated counts list are all skipped.
+    (spool / 'garbage.json').write_text('{not json')
+    (spool / 'null.json').write_text(
+        json.dumps({'pid': 1, 'ts': time.time(), 'metrics': None}))
+    (spool / 'badts.json').write_text(
+        json.dumps({'pid': 2, 'ts': '2026-08-03', 'metrics': {}}))
+    (spool / 'trunc.json').write_text(json.dumps({
+        'pid': 3, 'ts': time.time(),
+        'metrics': {'skytpu_t_child_seconds': {
+            'kind': 'histogram', 'help': 'Child latency.',
+            'label_names': [], 'buckets': [1.0],
+            'series': [{'labels': {}, 'counts': [7],  # truncated
+                        'sum': 1.0, 'count': 7}]}}}))
+    text = metrics.render_exposition(include_spool=True)
+    assert 'skytpu_t_child_total{who="child"} 12' in text
+    assert 'skytpu_t_child_seconds_count 3' in text  # trunc skipped
+    # A malformed TTL env falls back to the default instead of
+    # crashing every scrape.
+    os.environ[metrics.snapshot.METRICS_TTL_ENV] = '15m'
+    try:
+        assert 'skytpu_t_child_total{who="child"} 12' in \
+            metrics.render_exposition(include_spool=True)
+    finally:
+        del os.environ[metrics.snapshot.METRICS_TTL_ENV]
+
+
+def test_snapshot_ttl_ages_out_dead_processes(tmp_path, monkeypatch):
+    spool = tmp_path / 'metrics'
+    monkeypatch.setenv(metrics.METRICS_DIR_ENV, str(spool))
+    metrics.counter('skytpu_t_total', 'T.').inc(4)
+    path = metrics.dump_snapshot('old')
+    # Rewrite the snapshot with an ancient timestamp and another pid.
+    snap = json.loads(open(path, encoding='utf-8').read())
+    snap['ts'] = time.time() - 86400
+    snap['pid'] = 999999999
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(snap, f)
+    assert metrics.load_snapshots() == []
+    assert metrics.load_snapshots(max_age=0) != []  # 0 disables TTL
+
+
+# ------------------------------------------------- metric-name lint
+
+def test_all_registered_metrics_pass_lint():
+    """Every metric the production modules register matches the
+    naming scheme and carries help (the registry enforces this at
+    registration — this test keeps it true as modules are added, by
+    importing every instrumented layer and sweeping the registry)."""
+    import skypilot_tpu.jobs.controller          # noqa: F401
+    import skypilot_tpu.models.serving_engine    # noqa: F401
+    import skypilot_tpu.models.serving_http      # noqa: F401
+    import skypilot_tpu.serve.autoscalers        # noqa: F401
+    import skypilot_tpu.serve.load_balancer      # noqa: F401
+    import skypilot_tpu.serve.replica_managers   # noqa: F401
+    import skypilot_tpu.server.server            # noqa: F401
+    import skypilot_tpu.utils.fault_injection    # noqa: F401
+    import skypilot_tpu.utils.retry              # noqa: F401
+    import re
+    collected = metrics.REGISTRY.collect()
+    assert len(collected) >= 15   # the instrumented surface exists
+    for m in collected:
+        assert re.fullmatch(r'skytpu_[a-z0-9_]+', m.name), m.name
+        assert m.help.strip(), m.name
+        assert m.kind in ('counter', 'gauge', 'histogram'), m.name
+
+
+# ------------------------------------- autoscaler-counter equivalence
+
+def _spec(**kw):
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    defaults = dict(min_replicas=1, max_replicas=10,
+                    target_qps_per_replica=1.0,
+                    upscale_delay_seconds=10,
+                    downscale_delay_seconds=100)
+    defaults.update(kw)
+    return ServiceSpec(**defaults)
+
+
+def test_autoscaler_qps_equals_scraped_counter_window():
+    """current_qps derived from the counter == the old private
+    timestamp-window computation, and the counter's absolute value is
+    exactly the number an operator scrapes."""
+    from collections import deque
+
+    from skypilot_tpu.serve import autoscalers
+    scaler = autoscalers.RequestRateAutoscaler(_spec(), service='svc')
+    counter = metrics.REGISTRY.get('skytpu_lb_requests_total')
+    old_style = deque()          # the pre-metrics implementation
+    t0 = 1000.0
+    for i in range(300):
+        t = t0 + i * 0.2
+        scaler.record_request(t)
+        old_style.append(t)
+    for probe in (t0 + 30, t0 + 60, t0 + 61, t0 + 90, t0 + 200):
+        cutoff = probe - 60.0
+        while old_style and old_style[0] < cutoff:
+            old_style.popleft()
+        assert scaler.current_qps(probe) == \
+            pytest.approx(len(old_style) / 60.0)
+    assert counter.value(service='svc') == 300
+
+
+def test_autoscaler_decisions_from_counter_match_reference():
+    """The hysteresis decisions on the counter-derived QPS replay the
+    documented schedule (same sequence the pre-metrics deque
+    produced, see test_serve.test_autoscaler_hysteresis)."""
+    from skypilot_tpu.serve import autoscalers
+    scaler = autoscalers.RequestRateAutoscaler(_spec(), service='eq')
+    t0 = 5000.0
+    for i in range(300):
+        scaler.record_request(t0 + i * 0.2)   # 5 qps sustained
+    now = t0 + 60
+    assert scaler.evaluate(1, now).target_replicas == 1
+    assert scaler.evaluate(1, now + 5).target_replicas == 1
+    assert scaler.evaluate(1, now + 11).target_replicas == 5
+    later = now + 200
+    assert scaler.evaluate(5, later).target_replicas == 5
+    assert scaler.evaluate(5, later + 101).target_replicas == 1
+
+
+def test_autoscaler_restore_keeps_window_without_counter_replay():
+    """restore() rebuilds the QPS window but must NOT re-increment
+    the scraped counter: the restored requests were already counted
+    (a rolling-update autoscaler rebuild would otherwise show a
+    phantom rate() spike of a full window on every 'serve update')."""
+    from skypilot_tpu.serve import autoscalers
+    scaler = autoscalers.RequestRateAutoscaler(_spec(), service='rs')
+    now = time.time()
+    for i in range(60):
+        scaler.record_request(now - 30 + i * 0.5)
+    counter = metrics.REGISTRY.get('skytpu_lb_requests_total')
+    assert counter.value(service='rs') == 60
+    state = scaler.to_state()
+    reborn = autoscalers.RequestRateAutoscaler(_spec(), service='rs')
+    reborn.restore(state)
+    assert reborn.current_qps(now) == pytest.approx(60 / 60.0)
+    assert counter.value(service='rs') == 60     # no phantom replay
+    # New traffic after a restore stays monotone above the replayed
+    # window: both count toward QPS, and only new traffic scrapes.
+    reborn.record_request(now + 1)
+    assert reborn.current_qps(now + 1) == pytest.approx(61 / 60.0)
+    assert counter.value(service='rs') == 61
+
+
+# --------------------------------------- LB policy reads the gauge
+
+def test_least_load_policy_routes_on_scraped_gauge():
+    from skypilot_tpu.serve.load_balancer import (LeastLoadPolicy,
+                                                  LoadBalancer)
+    gauge = metrics.REGISTRY.get('skytpu_lb_replica_inflight')
+    p = LeastLoadPolicy()
+    p.set_urls(['a', 'b'])
+    # Series exist from registration time (scrape shows idle replicas).
+    assert gauge.value(replica='a') == 0
+    u1 = p.pick()
+    assert gauge.value(replica=u1) == 1      # pick() IS the gauge inc
+    u2 = p.pick()
+    assert {u1, u2} == {'a', 'b'}
+    p.done(u1)
+    assert gauge.value(replica=u1) == 0
+    assert p.pick() == u1                    # routes on the gauge
+    p.done(u1)
+    p.done(u2)
+    # IDLE replica removal drops its series from the scrape.
+    p.set_urls(['b'])
+    assert {s[0]['replica'] for s in gauge.series()} == {'b'}
+    p.done('a')                              # late done: no re-create
+    assert {s[0]['replica'] for s in gauge.series()} == {'b'}
+    # LoadBalancer.inflight reads the same series the policy wrote.
+    lb = LoadBalancer(port=0)
+    lb.policy = p
+    assert lb.inflight('b') == gauge.value(replica='b')
+
+
+def test_rotated_out_replica_keeps_inflight_until_drained():
+    """Scale-down ordering: set_urls drops a replica while requests
+    are still proxied to it. The in-flight series must SURVIVE the
+    rotation (drain() waits on it — zeroing it would tear the
+    cluster down under live requests) and retire only when the last
+    straggler finishes."""
+    from skypilot_tpu.serve.load_balancer import LeastLoadPolicy
+    gauge = metrics.REGISTRY.get('skytpu_lb_replica_inflight')
+    p = LeastLoadPolicy()
+    p.set_urls(['a', 'b'])
+    assert p.pick(exclude={'b'}) == 'a'
+    assert p.pick(exclude={'b'}) == 'a'      # 2 in flight to 'a'
+    p.set_urls(['b'])                        # 'a' rotates out loaded
+    assert gauge.value(replica='a') == 2     # drain() still sees them
+    p.done('a')
+    assert gauge.value(replica='a') == 1
+    p.done('a')                              # last straggler finishes
+    assert not gauge.has_series(replica='a')  # series retired at 0
+
+
+# -------------------------------- chaos: fault + retry counters
+
+@pytest.mark.chaos
+def test_injected_faults_and_retries_appear_as_counters():
+    """The chaos-observability acceptance: injected faults and retry
+    attempts are scrapeable counters."""
+    from skypilot_tpu.utils import fault_injection as fi
+    from skypilot_tpu.utils import retry as retry_lib
+    faults = metrics.REGISTRY.get('skytpu_faults_injected_total')
+    attempts = metrics.REGISTRY.get('skytpu_retry_attempts_total')
+    giveups = metrics.REGISTRY.get('skytpu_retry_giveups_total')
+
+    with fi.fault_plan(faults=[{'site': 'serve.replica.probe_ready',
+                                'kind': 'probe_timeout',
+                                'times': 3}]):
+        for _ in range(5):
+            fi.poll('serve.replica.probe_ready')
+    assert faults.value(site='serve.replica.probe_ready',
+                        kind='probe_timeout') == 3
+
+    policy = retry_lib.RetryPolicy(max_attempts=3,
+                                   initial_backoff=0.0,
+                                   jitter='none',
+                                   clock=retry_lib.FakeClock(),
+                                   site='test.site')
+    with pytest.raises(RuntimeError):
+        policy.call(lambda: (_ for _ in ()).throw(RuntimeError('x')))
+    assert attempts.value(site='test.site') == 2   # 3 tries, 2 retries
+    assert giveups.value(site='test.site') == 1
+    # Both series render in one scrape.
+    text = metrics.render_exposition()
+    assert 'skytpu_faults_injected_total{' in text
+    assert 'skytpu_retry_attempts_total{site="test.site"} 2' in text
+
+
+# ------------------------------------------------ /metrics endpoints
+
+def test_engine_metrics_and_replica_endpoint():
+    """Drive the real (tiny) engine, then scrape the EngineServer's
+    /metrics handler: the TTFT histogram and queue-depth gauge of the
+    acceptance criteria are present with live values."""
+    import jax
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import Request, ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    reqs = [Request(i, [1 + i, 2, 3], max_new=4) for i in range(3)]
+    results = engine.run(reqs)
+    assert len(results) == 3
+
+    reg = metrics.REGISTRY
+    assert reg.get('skytpu_engine_requests_total').value() == 3
+    total_tokens = sum(len(r.tokens) for r in results.values())
+    assert reg.get('skytpu_engine_tokens_total').value() == total_tokens
+    ((_, ttft),) = reg.get('skytpu_engine_ttft_seconds').series()
+    assert ttft['count'] == 3
+    # Per-token latency observes once per emitting tick (tick
+    # interval / tokens), not per request.
+    ((_, tok_lat),) = \
+        reg.get('skytpu_engine_per_token_seconds').series()
+    assert tok_lat['count'] >= 1
+    assert tok_lat['sum'] > 0
+
+    server = EngineServer(engine)
+    resp = asyncio.run(server.handle_metrics(None))
+    assert resp.status == 200
+    assert resp.headers['Content-Type'] == metrics.CONTENT_TYPE
+    text = resp.text
+    assert 'skytpu_engine_ttft_seconds_bucket{le="+Inf"} 3' in text
+    assert '# TYPE skytpu_engine_queue_depth gauge' in text
+    assert 'skytpu_engine_queue_depth 0' in text
+    assert 'skytpu_engine_active_slots 0' in text
+
+
+def test_engine_rejects_counter_on_429():
+    """The 429 shed path counts: overloaded replicas are visible."""
+    import jax
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import Request, ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128)
+    server = EngineServer(engine, max_pending=1)
+    engine.queue.append(Request('q', [1], max_new=1))  # fill pending
+    resp = server._overloaded_response()
+    assert resp is not None and resp.status == 429
+    assert metrics.REGISTRY.get(
+        'skytpu_engine_rejects_total').value() == 1
+
+
+def test_api_server_metrics_endpoint(isolated_state, monkeypatch):
+    import requests as http
+
+    from aiohttp import web
+
+    from skypilot_tpu.server.server import make_app
+    monkeypatch.setenv('SKYTPU_REQUESTS_DB',
+                       str(isolated_state / 'requests.db'))
+    monkeypatch.setenv('SKYTPU_REQUESTS_LOG_DIR',
+                       str(isolated_state / 'req_logs'))
+    metrics.counter('skytpu_t_total', 'T.').inc(7)
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(make_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, '127.0.0.1', 0)
+        loop.run_until_complete(site.start())
+        holder['port'] = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    try:
+        resp = http.get(
+            f'http://127.0.0.1:{holder["port"]}/metrics', timeout=10)
+        assert resp.status_code == 200
+        assert resp.headers['Content-Type'].startswith('text/plain')
+        assert 'skytpu_t_total 7' in resp.text
+        assert '# TYPE skytpu_t_total counter' in resp.text
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.mark.slow
+def test_full_stack_metrics_under_live_requests():
+    """End-to-end acceptance: POST /generate through the LB, then
+    scrape BOTH /metrics endpoints (replica + LB) over HTTP — the
+    TTFT histogram and queue-depth gauge show live-request values,
+    and the LB's per-replica series carry the replica URL label."""
+    import aiohttp
+    import jax
+    import numpy as np
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    server = EngineServer(engine)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        replica = f'http://127.0.0.1:{port}'
+        lb.set_replica_urls([replica])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                try:
+                    async with session.get(base + '/health') as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError('engine never became ready')
+            rng = np.random.default_rng(0)
+            for n in (9, 6):
+                toks = [int(t) for t in
+                        rng.integers(0, cfg.vocab_size, n)]
+                async with session.post(
+                        base + '/generate',
+                        json={'tokens': toks, 'max_new': 4}) as r:
+                    assert r.status == 200
+            async with session.get(
+                    f'{replica}/metrics') as r:
+                replica_text = await r.text()
+                assert r.status == 200
+            async with session.get(base + '/metrics') as r:
+                lb_text = await r.text()
+                assert r.status == 200
+        await lb.stop()
+        await runner.cleanup()
+        return replica, replica_text, lb_text
+
+    replica, replica_text, lb_text = asyncio.run(scenario())
+    server.stop()
+    # Replica scrape: the acceptance metrics with live values (warmup
+    # itself serves bucket requests, so counts are >= the 2 posted).
+    assert 'skytpu_engine_ttft_seconds_bucket{le="+Inf"}' in replica_text
+    assert '# TYPE skytpu_engine_queue_depth gauge' in replica_text
+    assert 'skytpu_engine_tokens_total' in replica_text
+    # LB scrape (served locally, not proxied): per-replica series.
+    assert (f'skytpu_lb_replica_inflight{{replica="{replica}"}} 0'
+            in lb_text)
+    # Latency series carries the replica label; the count covers the
+    # 2 generates PLUS every proxied /health readiness poll.
+    import re
+    m = re.search(r'skytpu_lb_replica_request_seconds_count'
+                  r'\{replica="' + re.escape(replica) + r'"\} (\d+)',
+                  lb_text)
+    assert m is not None and int(m.group(1)) >= 2

@@ -406,6 +406,16 @@ def test_cloud_feasibility_and_registry(rp_http, fs_http, neb_http):
     assert Nebius().get_feasible_launchable_resources(
         Resources(accelerators='H100:8'))[0].instance_type == \
         'gpu-h100_8gpu-160vcpu-1600gb'
+    # Exact accelerator-token matching: a bare 'A100' ask must NOT
+    # prefix-match '8x_A100-80GB_SECURE' (a pricier, different SKU the
+    # user would have to name as 'A100-80GB')...
+    assert RunPod().get_feasible_launchable_resources(
+        Resources(accelerators='A100:8')) == []
+    # ...while form-factor suffixes after a '_' boundary still match
+    # (an A100 ask on FluidStack selects the plain A100 PCIE SKU).
+    assert Fluidstack().get_feasible_launchable_resources(
+        Resources(accelerators='A100:8'))[0].instance_type == \
+        '8x_A100_PCIE'
 
 
 def test_optimizer_failover_includes_neocloud(rp_http, fs_http,
@@ -488,7 +498,9 @@ class FakeVastHttp:
                 'id': iid, 'label': json['label'],
                 'actual_status': 'running',
                 'public_ipaddr': f'70.0.0.{self._n}',
-                'local_ipaddrs': f'10.4.0.{self._n}',
+                # Vast reports EVERY private address of the rental as
+                # one space-separated string.
+                'local_ipaddrs': f'10.4.0.{self._n} 172.17.0.2',
                 'ssh_port': 41000 + self._n,
             }
             return _Resp(200, {'success': True, 'new_contract': iid})
@@ -550,6 +562,14 @@ def test_vast_market_lifecycle(vast_http):
     head = info.instances['vc-0'][0]
     assert head.external_ip.startswith('70.')
     assert head.ssh_port > 40000        # marketplace-mapped sshd
+    # 'local_ipaddrs' is space-separated: internal_ip must be ONE
+    # address (the first), never the raw multi-address string.
+    assert head.internal_ip == '10.4.0.1'
+    # Rentals without a private address fall back to the public one.
+    vast_http.instances[7001]['local_ipaddrs'] = ''
+    info = vast.get_cluster_info('vc', None, None)
+    assert info.instances['vc-0'][0].internal_ip == \
+        info.instances['vc-0'][0].external_ip
 
     vast.stop_instances('vc', None, None)
     assert set(vast.query_instances('vc', None, None).values()) == \

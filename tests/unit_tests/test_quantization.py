@@ -274,3 +274,17 @@ def test_quantize_checkpoint_roundtrip(tmp_path):
     got = inference.generate(engine.params, tokens, lengths, cfg,
                              max_new=5)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quantize_checkpoint_rejects_gpt2(tmp_path):
+    """The quantize CLI/API gates on family BEFORE any restore work:
+    GPT-2's 1-D param leaves have no per-output-channel scale axis
+    and used to crash _quantize_leaf mid-run (mirrors ServingEngine's
+    GPT2Config rejection)."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.models.gpt2 import GPT2Config
+    cfg = GPT2Config(max_seq=64, dim=32, n_layers=1, n_heads=2)
+    with pytest.raises(exceptions.NotSupportedError,
+                       match='Llama and MoE'):
+        quantization.quantize_checkpoint(str(tmp_path / 'in'),
+                                         str(tmp_path / 'out'), cfg)

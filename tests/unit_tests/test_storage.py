@@ -382,3 +382,33 @@ def test_same_provider_transfer_is_server_side(monkeypatch):
     data_transfer.transfer(S3Store('srcb'), R2Store('b'),
                            verify=False)
     assert 'staged-upload' in cmds
+
+
+def test_cross_region_cos_transfer_stages(monkeypatch):
+    """Same STORE TYPE is not enough for the server-side sync: a
+    cross-region COS pair lives behind two different regional
+    endpoints, and one `aws --endpoint-url <src>` sync would address
+    the destination bucket at the WRONG endpoint. Endpoints differ ->
+    staged generic path; endpoints match -> server-side sync."""
+    from skypilot_tpu.data import data_transfer
+    import skypilot_tpu.data.storage as st
+    cmds = []
+    monkeypatch.setattr(data_transfer, '_run',
+                        lambda cmd: cmds.append(cmd))
+    monkeypatch.setattr(st.IbmCosStore, 'download_command',
+                        lambda self, dst: f'fake-download {dst}')
+    monkeypatch.setattr(
+        st.IbmCosStore, 'upload',
+        lambda self: cmds.append('staged-upload'), raising=False)
+    src = st.IbmCosStore('srcb', region='us-south')
+    dst = st.IbmCosStore('dstb', region='eu-de')
+    data_transfer.transfer(src, dst, verify=False)
+    assert 'staged-upload' in cmds
+    assert not any('s3 sync s3://srcb s3://dstb' in c for c in cmds)
+    # Same region = same endpoint: the one-command server-side path.
+    cmds.clear()
+    data_transfer.transfer(src, st.IbmCosStore('dstb',
+                                               region='us-south'),
+                           verify=False)
+    assert len(cmds) == 1 and 's3 sync s3://srcb s3://dstb' in cmds[0]
+    assert 'endpoint-url https://s3.us-south' in cmds[0]
