@@ -765,6 +765,8 @@ if __name__ == '__main__':
             import jax as _jax
             _jax.config.update('jax_platforms', 'cpu')
         except Exception:  # pragma: no cover - jax always importable
+            # skytpu-lint: disable=STL001 — best-effort CPU pin; smoke
+            # benches must start even if jax's backend is locked.
             pass
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the

@@ -1,0 +1,39 @@
+"""skytpu-lint: repo-native static analysis (docs/static_analysis.md).
+
+A dependency-free AST lint pass encoding this repo's cross-cutting
+invariants as rules STL001–STL008 (exception hygiene, RetryPolicy
+discipline, daemon-thread explicitness, a heuristic race detector,
+the SKYTPU_*/BENCH_* env registry, metric-registration hygiene,
+fault-injection site names, JAX recompile/tracer hazards), with
+per-line ``# skytpu-lint: disable=RULE`` suppressions and a
+committed JSON baseline so only *new* violations fail.
+
+CLI::
+
+    python -m skypilot_tpu.analysis             # full run vs baseline
+    python -m skypilot_tpu.analysis --changed   # git-diff-scoped
+    python -m skypilot_tpu.analysis --update-baseline
+    python -m skypilot_tpu.analysis --list-rules
+
+Library entry points: :func:`analyze_source` (snippets, used by the
+fixture tests) and :func:`analyze_files` (project runs).
+"""
+from skypilot_tpu.analysis.baseline import DEFAULT_BASELINE_PATH
+from skypilot_tpu.analysis.core import Project
+from skypilot_tpu.analysis.core import Rule
+from skypilot_tpu.analysis.core import Violation
+from skypilot_tpu.analysis.core import analyze_files
+from skypilot_tpu.analysis.core import analyze_source
+from skypilot_tpu.analysis.rules import RULE_IDS
+from skypilot_tpu.analysis.rules import default_rules
+
+__all__ = [
+    'DEFAULT_BASELINE_PATH',
+    'Project',
+    'Rule',
+    'RULE_IDS',
+    'Violation',
+    'analyze_files',
+    'analyze_source',
+    'default_rules',
+]

@@ -1,0 +1,6 @@
+"""``python -m skypilot_tpu.analysis`` entry point."""
+import sys
+
+from skypilot_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,98 @@
+"""Baseline gating: only *new* violations fail.
+
+The committed baseline (``skypilot_tpu/analysis/baseline.json``)
+records accepted legacy findings by fingerprint (rule + path +
+enclosing scope + source-line hash — see ``Violation.fingerprint``)
+with an occurrence count, so:
+
+- unrelated edits that shift line numbers don't churn the baseline;
+- editing the flagged line itself *does* invalidate the entry (the
+  finding must be re-fixed or re-accepted);
+- the same fingerprint appearing more times than baselined is a new
+  violation (a copy-pasted bad pattern doesn't hide behind its
+  original).
+
+``--update-baseline`` rewrites the file from the current run, which
+also prunes entries whose findings were fixed.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from skypilot_tpu.analysis.core import Violation
+
+BASELINE_VERSION = 1
+
+DEFAULT_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), 'baseline.json')
+
+
+def load(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry dict; missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or 'entries' not in data:
+        raise ValueError(
+            f'{path}: not a skytpu-lint baseline (no "entries" key)')
+    return dict(data['entries'])
+
+
+def save(path: str, violations: Sequence[Violation]) -> Dict[str, dict]:
+    """Write a fresh baseline accepting every current violation."""
+    entries: Dict[str, dict] = {}
+    for v in violations:
+        fp = v.fingerprint()
+        entry = entries.get(fp)
+        if entry is None:
+            entries[fp] = {
+                'count': 1,
+                'rule': v.rule,
+                'path': v.path,
+                'context': v.context,
+                'snippet': v.snippet,
+            }
+        else:
+            entry['count'] += 1
+    payload = {
+        'version': BASELINE_VERSION,
+        'generated_by': 'python -m skypilot_tpu.analysis '
+                        '--update-baseline',
+        'entries': {fp: entries[fp] for fp in sorted(entries)},
+    }
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write('\n')
+    return entries
+
+
+def partition(
+    violations: Sequence[Violation], baseline: Dict[str, dict]
+) -> Tuple[List[Violation], List[Violation], List[str]]:
+    """(new, baselined, stale-fingerprints).
+
+    Occurrences of one fingerprint beyond its baselined count are new
+    (stable order: the first N occurrences in file order are the
+    baselined ones). Stale fingerprints — baseline entries with no
+    matching finding — are surfaced so the baseline shrinks as debt
+    is paid down.
+    """
+    budget = {fp: int(entry.get('count', 1))
+              for fp, entry in baseline.items()}
+    seen: collections.Counter = collections.Counter()
+    new: List[Violation] = []
+    old: List[Violation] = []
+    for v in violations:
+        fp = v.fingerprint()
+        seen[fp] += 1
+        if seen[fp] <= budget.get(fp, 0):
+            old.append(v)
+        else:
+            new.append(v)
+    stale = sorted(fp for fp, count in budget.items()
+                   if seen[fp] < count)
+    return new, old, stale

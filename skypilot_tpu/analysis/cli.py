@@ -1,0 +1,236 @@
+"""skytpu-lint CLI: ``python -m skypilot_tpu.analysis``.
+
+Modes:
+
+- default: lint the whole package (plus ``bench.py`` at the repo
+  root) against the committed baseline; exit 1 on *new* violations.
+- ``--changed``: lint only files changed vs git HEAD (staged,
+  unstaged and untracked) — the fast pre-commit loop.
+- ``--update-baseline``: rewrite the baseline to accept every
+  current finding (also prunes fixed ones).
+- ``--format json``: machine-readable report (CI annotation feeds).
+- ``--list-rules``: the rule catalog with severities and rationale.
+
+Exit codes: 0 clean, 1 new violations, 2 usage/environment error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.analysis import baseline as baseline_mod
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import registries
+from skypilot_tpu.analysis import rules as rules_mod
+
+
+def repo_root() -> str:
+    return os.path.dirname(registries.package_root())
+
+
+def default_targets() -> List[str]:
+    """Package dir + repo-root bench.py (the BENCH_* env surface)."""
+    targets = [registries.package_root()]
+    bench = os.path.join(repo_root(), 'bench.py')
+    if os.path.exists(bench):
+        targets.append(bench)
+    return targets
+
+
+def _iter_py_files(targets: Sequence[str]) -> List[Tuple[str, str]]:
+    """[(repo-relative, absolute)] for every .py under the targets."""
+    root = repo_root()
+    out: List[Tuple[str, str]] = []
+    seen = set()
+    for target in targets:
+        abspath = os.path.abspath(target)
+        if os.path.isfile(abspath):
+            files = [abspath]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(abspath):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ('__pycache__',)]
+                files.extend(os.path.join(dirpath, f)
+                             for f in filenames if f.endswith('.py'))
+        for f in files:
+            if f in seen or not f.endswith('.py'):
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root).replace(os.sep, '/')
+            out.append((rel, f))
+    out.sort()
+    return out
+
+
+def changed_files() -> List[str]:
+    """Absolute paths of .py files changed vs HEAD (plus untracked),
+    limited to the default lint targets — test fixtures deliberately
+    contain rule-firing snippets and must not trip the pre-commit
+    loop."""
+    root = repo_root()
+    targets = [os.path.abspath(t) for t in default_targets()]
+
+    def in_scope(abspath: str) -> bool:
+        return any(abspath == t or
+                   abspath.startswith(t.rstrip(os.sep) + os.sep)
+                   for t in targets)
+
+    paths = set()
+    for cmd in (['git', 'diff', '--name-only', 'HEAD'],
+                ['git', 'ls-files', '--others', '--exclude-standard']):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, check=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            raise RuntimeError(
+                f'--changed needs git ({" ".join(cmd)} failed: {e})'
+            ) from e
+        paths.update(line.strip() for line in proc.stdout.splitlines()
+                     if line.strip().endswith('.py'))
+    return [os.path.join(root, p) for p in sorted(paths)
+            if os.path.exists(os.path.join(root, p)) and
+            in_scope(os.path.abspath(os.path.join(root, p)))]
+
+
+def run(paths: Sequence[str],
+        baseline_path: Optional[str],
+        update_baseline: bool = False) -> Tuple[List[core.Violation],
+                                                List[core.Violation],
+                                                List[str]]:
+    """(new, baselined, stale) over the given targets."""
+    project = core.Project(
+        declared_env=registries.declared_env_names(),
+        declared_sites=registries.declared_fault_sites())
+    violations = core.analyze_files(_iter_py_files(paths),
+                                    rules=rules_mod.default_rules(),
+                                    project=project)
+    if update_baseline:
+        assert baseline_path is not None
+        baseline_mod.save(baseline_path, violations)
+        return [], violations, []
+    baseline: Dict[str, dict] = {}
+    if baseline_path is not None:
+        baseline = baseline_mod.load(baseline_path)
+    return baseline_mod.partition(violations, baseline)
+
+
+def _print_text(new: List[core.Violation], old: List[core.Violation],
+                stale: List[str], verbose: bool) -> None:
+    for v in new:
+        print(f'{v.path}:{v.line}:{v.col}: {v.rule} {v.severity}: '
+              f'{v.message}')
+        if v.snippet:
+            print(f'    {v.snippet}')
+    if verbose:
+        for v in old:
+            print(f'{v.path}:{v.line}: {v.rule} [baselined]')
+    for fp in stale:
+        print(f'stale baseline entry (finding fixed — run '
+              f'--update-baseline to prune): {fp}')
+    per_rule: Dict[str, int] = {}
+    for v in new:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    summary = ', '.join(f'{r}={n}' for r, n in sorted(per_rule.items()))
+    print(f'skytpu-lint: {len(new)} new violation(s)'
+          f'{" (" + summary + ")" if summary else ""}, '
+          f'{len(old)} baselined, {len(stale)} stale baseline '
+          f'entr{"y" if len(stale) == 1 else "ies"}.')
+
+
+def _print_json(new: List[core.Violation], old: List[core.Violation],
+                stale: List[str]) -> None:
+    print(json.dumps({
+        'new': [v.to_dict() for v in new],
+        'baselined': [v.to_dict() for v in old],
+        'stale_baseline_entries': stale,
+    }, indent=1))
+
+
+def _list_rules() -> None:
+    for rule in rules_mod.default_rules():
+        scope = (' [' + ', '.join(rule.path_filter) + '/]'
+                 if rule.path_filter else '')
+        print(f'{rule.id} {rule.name} ({rule.severity}){scope}')
+        for line in rule.help.split('\n'):
+            print(f'    {line}')
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.analysis',
+        description='skytpu-lint: repo-native AST analysis '
+                    '(STL001-STL008), baseline-gated.')
+    parser.add_argument('paths', nargs='*',
+                        help='files/dirs to lint (default: the '
+                             'skypilot_tpu package + bench.py)')
+    parser.add_argument('--changed', action='store_true',
+                        help='lint only files changed vs git HEAD')
+    parser.add_argument('--update-baseline', action='store_true',
+                        help='accept all current findings into the '
+                             'baseline (prunes fixed ones)')
+    parser.add_argument('--baseline',
+                        default=baseline_mod.DEFAULT_BASELINE_PATH,
+                        help='baseline JSON path (default: '
+                             'skypilot_tpu/analysis/baseline.json)')
+    parser.add_argument('--no-baseline', action='store_true',
+                        help='report every finding (ignore baseline)')
+    parser.add_argument('--format', choices=('text', 'json'),
+                        default='text')
+    parser.add_argument('--verbose', action='store_true',
+                        help='also list baselined findings')
+    parser.add_argument('--list-rules', action='store_true')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        _list_rules()
+        return 0
+    if args.changed and args.paths:
+        parser.error('--changed and explicit paths are exclusive')
+    if args.update_baseline and (args.changed or args.paths):
+        parser.error('--update-baseline needs a full run, not '
+                     '--changed or explicit paths (a partial baseline '
+                     'would drop every unvisited entry)')
+    if args.update_baseline and args.no_baseline:
+        parser.error('--update-baseline and --no-baseline are '
+                     'contradictory')
+    if args.changed:
+        try:
+            targets: List[str] = changed_files()
+        except RuntimeError as e:
+            print(f'skytpu-lint: {e}', file=sys.stderr)
+            return 2
+        if not targets:
+            print('skytpu-lint: no changed .py files.')
+            return 0
+    else:
+        targets = list(args.paths) or default_targets()
+
+    baseline_path = None if args.no_baseline else args.baseline
+    try:
+        new, old, stale = run(targets, baseline_path,
+                              update_baseline=args.update_baseline)
+    except (OSError, ValueError) as e:
+        print(f'skytpu-lint: {e}', file=sys.stderr)
+        return 2
+    if args.changed or args.paths:
+        # Partial run: baseline entries for unvisited files are not
+        # stale, they just weren't checked.
+        stale = []
+    if args.update_baseline:
+        print(f'skytpu-lint: baseline rewritten with {len(old)} '
+              f'finding(s) at {args.baseline}.')
+        return 0
+    if args.format == 'json':
+        _print_json(new, old, stale)
+    else:
+        _print_text(new, old, stale, verbose=args.verbose)
+    return 1 if new else 0
+
+
+if __name__ == '__main__':  # pragma: no cover
+    sys.exit(main())

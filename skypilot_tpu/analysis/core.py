@@ -1,0 +1,413 @@
+"""skytpu-lint core: one parse, one walk, rules as plugins.
+
+The stack's cross-cutting invariants (the ``SKYTPU_*`` env contract,
+metric-name hygiene, fault-injection site names, the
+``utils/retry.RetryPolicy``-only rule, daemon-thread discipline) were
+all enforced at runtime or by convention — drift surfaced only when
+the bad path executed. This framework checks them *statically*:
+
+- **Single parse + single walk.** Each file is ``ast.parse``-d once
+  and visited once by :class:`LintVisitor`, which dispatches every
+  node to every rule that registered interest in its type. Full-repo
+  runtime stays well under the 10 s tier-1 budget.
+- **Rules as plugins.** A rule subclasses :class:`Rule`, declares the
+  node types it wants, and reports via ``ctx.report(...)``. Rules
+  needing cross-file facts (declared env names, metric registrations)
+  stash them on the shared :class:`Project` and emit from
+  ``finalize()``.
+- **Per-line suppressions.** ``# skytpu-lint: disable=STL001`` on any
+  line of the flagged node's span (or the line directly above it)
+  silences that rule there; ``disable`` with no ``=`` silences all.
+- **Baseline gating.** Violations are fingerprinted by
+  (rule, path, enclosing scope, source-line hash) — stable across
+  line-number drift — and compared against a committed JSON baseline
+  (:mod:`skypilot_tpu.analysis.baseline`): only *new* violations
+  fail, so the gate can land before the last legacy finding is fixed.
+
+No third-party dependencies; stdlib ``ast`` only.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEVERITIES = ('error', 'warning')
+
+# ``# skytpu-lint: disable=STL001,STL004`` / ``# skytpu-lint: disable``.
+_SUPPRESS_RE = re.compile(
+    r'#\s*skytpu-lint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\s]+?))?'
+    r'(?:\s*[—–-].*)?$')
+
+_ENV_NAME_RE = re.compile(r'\A(?:SKYTPU|BENCH)_[A-Z0-9_]+\Z')
+_METRIC_NAME_RE = re.compile(r'skytpu_[a-z0-9_]+\Z')
+_LABEL_NAME_RE = re.compile(r'[a-z_][a-z0-9_]*\Z')
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding: where, what rule, why."""
+    rule: str
+    severity: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    context: str  # enclosing Class.method qualname ('' at module scope)
+    snippet: str  # stripped source of the flagged line
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline: a finding
+        keeps its fingerprint when unrelated edits shift it up or
+        down the file, and changes it when the flagged code itself
+        (or its enclosing scope) changes."""
+        digest = hashlib.sha1(self.snippet.encode()).hexdigest()[:12]
+        return f'{self.rule}:{self.path}:{self.context}:{digest}'
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base rule plugin.
+
+    Subclasses set ``id`` (STLnnn), ``name`` (kebab slug), ``severity``
+    and ``help`` (one-paragraph rationale shown by ``--list-rules``),
+    declare ``node_types`` and implement ``check(ctx, node)``.
+    Project-scoped rules may also implement ``finalize(project)``,
+    which runs once after every file is walked.
+    """
+
+    id = ''
+    name = ''
+    severity = 'error'
+    help = ''
+    node_types: Tuple[type, ...] = ()
+    # Only lint files whose repo-relative path contains one of these
+    # directory names (empty = every file).
+    path_filter: Tuple[str, ...] = ()
+
+    def applies_to(self, path: str) -> bool:
+        if not self.path_filter:
+            return True
+        parts = path.replace('\\', '/').split('/')
+        return any(p in parts for p in self.path_filter)
+
+    def check(self, ctx: 'FileContext', node: ast.AST) -> None:
+        raise NotImplementedError
+
+    def finalize(self, project: 'Project') -> None:
+        pass
+
+
+class Project:
+    """Cross-file state shared by one analysis run.
+
+    Rules append per-file facts here during the walk and cross-check
+    them in ``finalize()``. The declared env-name and fault-site sets
+    are injected by the driver (parsed statically from the registry
+    modules) so the analyzer never imports production code.
+    """
+
+    def __init__(self,
+                 declared_env: Optional[Set[str]] = None,
+                 declared_sites: Optional[Sequence[str]] = None) -> None:
+        self.declared_env: Set[str] = set(declared_env or ())
+        self.declared_sites: List[str] = list(declared_sites or ())
+        # STL006: metric name -> (kind, labels, path, line) first seen.
+        self.metric_registrations: Dict[str, Tuple[str, Tuple[str, ...],
+                                                   str, int]] = {}
+        self.violations: List[Violation] = []
+        # Deferred (finalize-time) reports still honor suppressions:
+        # each file leaves its suppression map behind.
+        self._suppressions: Dict[str, Dict[int, Optional[Set[str]]]] = {}
+        self._sources: Dict[str, List[str]] = {}
+
+    # ---------------------------------------------------- finalize API
+    def report_at(self, rule: Rule, path: str, line: int, col: int,
+                  message: str, context: str = '') -> None:
+        """Report from ``finalize()`` against a previously-walked file
+        (suppression comments there still apply)."""
+        lines = self._sources.get(path, [])
+        snippet = lines[line - 1].strip() if 0 < line <= len(lines) else ''
+        if _is_suppressed(self._suppressions.get(path, {}), rule.id,
+                          line, line):
+            return
+        self.violations.append(Violation(
+            rule=rule.id, severity=rule.severity, path=path, line=line,
+            col=col, message=message, context=context, snippet=snippet))
+
+
+def _parse_suppressions(
+        lines: Sequence[str]) -> Dict[int, Optional[Set[str]]]:
+    """1-based line -> set of silenced rule ids (None = all rules).
+
+    A suppression on a comment-only line also applies to the next
+    code line (so a multi-line reason comment above the flagged
+    statement works): the marker line starts the comment block, any
+    further comment/blank lines are skipped.
+    """
+    out: Dict[int, Optional[Set[str]]] = {}
+
+    def _merge(line_no: int, rules: Optional[Set[str]]) -> None:
+        existing = out.get(line_no, 'absent')
+        if existing == 'absent':
+            out[line_no] = rules
+        elif existing is None or rules is None:
+            out[line_no] = None
+        else:
+            out[line_no] = existing | rules  # type: ignore[operator]
+
+    for i, line in enumerate(lines, start=1):
+        if 'skytpu-lint' not in line:
+            continue
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        raw = m.group('rules')
+        rules: Optional[Set[str]] = (
+            None if raw is None else
+            {r.strip().upper() for r in raw.split(',') if r.strip()})
+        _merge(i, rules)
+        if line.lstrip().startswith('#'):
+            # Comment-only marker: attach to the next code line too.
+            j = i + 1
+            while j <= len(lines) and (
+                    not lines[j - 1].strip() or
+                    lines[j - 1].lstrip().startswith('#')):
+                j += 1
+            if j <= len(lines):
+                _merge(j, rules)
+    return out
+
+
+def _is_suppressed(suppressions: Dict[int, Optional[Set[str]]],
+                   rule_id: str, start: int, end: int) -> bool:
+    """A suppression on any line of the node's span, or on the line
+    directly above it (comment-above style), silences the finding."""
+    for line in range(max(start - 1, 1), end + 1):
+        rules = suppressions.get(line, 'absent')
+        if rules == 'absent':
+            continue
+        if rules is None or rule_id in rules:
+            return True
+    return False
+
+
+class FileContext:
+    """Everything a rule may ask about the file being walked."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 project: Project) -> None:
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.project = project
+        self.suppressions = _parse_suppressions(self.lines)
+        project._suppressions[path] = self.suppressions
+        project._sources[path] = self.lines
+        # Maintained by the visitor:
+        self.scope_stack: List[ast.AST] = []  # ClassDef/FunctionDef
+        self.loop_stack: List[ast.AST] = []  # For/While
+        self.lock_depth = 0  # inside a `with <lock-like>` block
+        self._parents_linked = False
+
+    # -------------------------------------------------------- helpers
+    def qualname(self) -> str:
+        names = [getattr(n, 'name', '?') for n in self.scope_stack]
+        return '.'.join(names)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        if not self._parents_linked:
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    child._skytpu_parent = parent  # type: ignore
+            self._parents_linked = True
+        return getattr(node, '_skytpu_parent', None)
+
+    def enclosing_function(self) -> Optional[ast.AST]:
+        for node in reversed(self.scope_stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None
+
+    def enclosing_class(self) -> Optional[ast.ClassDef]:
+        for node in reversed(self.scope_stack):
+            if isinstance(node, ast.ClassDef):
+                return node
+        return None
+
+    # ------------------------------------------------------ reporting
+    def report(self, rule: Rule, node: ast.AST, message: str,
+               span: Optional[Tuple[int, int]] = None) -> None:
+        start = node.lineno
+        end = span[1] if span else getattr(node, 'end_lineno', start)
+        if span:
+            start = span[0]
+        if _is_suppressed(self.suppressions, rule.id, start, end):
+            return
+        line = node.lineno
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else '')
+        self.project.violations.append(Violation(
+            rule=rule.id, severity=rule.severity, path=self.path,
+            line=line, col=node.col_offset, message=message,
+            context=self.qualname(), snippet=snippet))
+
+
+class LintVisitor(ast.NodeVisitor):
+    """One walk per file; dispatches each node to interested rules and
+    maintains the scope/loop/lock context rules read."""
+
+    def __init__(self, ctx: FileContext, rules: Sequence[Rule]) -> None:
+        self.ctx = ctx
+        self._dispatch: Dict[type, List[Rule]] = {}
+        for rule in rules:
+            if not rule.applies_to(ctx.path):
+                continue
+            for node_type in rule.node_types:
+                self._dispatch.setdefault(node_type, []).append(rule)
+
+    def visit(self, node: ast.AST) -> None:
+        for rule in self._dispatch.get(type(node), ()):
+            rule.check(self.ctx, node)
+        ctx = self.ctx
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            ctx.scope_stack.append(node)
+            self.generic_visit(node)
+            ctx.scope_stack.pop()
+        elif isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            ctx.loop_stack.append(node)
+            self.generic_visit(node)
+            ctx.loop_stack.pop()
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            locked = any(_is_lock_like(item.context_expr)
+                         for item in node.items)
+            ctx.lock_depth += 1 if locked else 0
+            self.generic_visit(node)
+            ctx.lock_depth -= 1 if locked else 0
+        else:
+            self.generic_visit(node)
+
+
+def _is_lock_like(expr: ast.AST) -> bool:
+    """Heuristic: the with-context mentions an identifier containing
+    'lock', 'mutex' or 'cond' (``self._lock``, ``engine.lock``,
+    ``cv``-style condition variables spelled out)."""
+    for node in ast.walk(expr):
+        name = ''
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        lowered = name.lower()
+        if any(tok in lowered for tok in ('lock', 'mutex', 'cond')):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------- utils
+# Small AST predicates shared by several rules.
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of the called expression ('' if not a plain path).
+
+    ``threading.Thread(...)`` -> 'threading.Thread';
+    ``fi.poll(...)`` -> 'fi.poll'; ``(f())(x)`` -> ''.
+    """
+    parts: List[str] = []
+    cur: ast.AST = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return '.'.join(reversed(parts))
+    return ''
+
+
+def literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def arg_or_keyword(call: ast.Call, index: int,
+                   keyword: str) -> Optional[ast.AST]:
+    if len(call.args) > index:
+        return call.args[index]
+    for kw in call.keywords:
+        if kw.arg == keyword:
+            return kw.value
+    return None
+
+
+def env_name_re() -> 're.Pattern[str]':
+    return _ENV_NAME_RE
+
+
+def metric_name_re() -> 're.Pattern[str]':
+    return _METRIC_NAME_RE
+
+
+def label_name_re() -> 're.Pattern[str]':
+    return _LABEL_NAME_RE
+
+
+# ---------------------------------------------------------------- driver
+def analyze_source(source: str,
+                   path: str = '<memory>',
+                   rules: Optional[Sequence[Rule]] = None,
+                   project: Optional[Project] = None,
+                   finalize: bool = True) -> List[Violation]:
+    """Lint one source string (the unit-test entry point).
+
+    ``project`` carries declared env names / fault sites for the
+    registry-backed rules; a fresh empty one is used by default.
+    """
+    from skypilot_tpu.analysis import rules as rules_mod
+    if rules is None:
+        rules = rules_mod.default_rules()
+    if project is None:
+        project = Project()
+    tree = ast.parse(source, filename=path)
+    ctx = FileContext(path, source, tree, project)
+    LintVisitor(ctx, rules).visit(tree)
+    if finalize:
+        for rule in rules:
+            rule.finalize(project)
+    return project.violations
+
+
+def analyze_files(paths: Iterable[Tuple[str, str]],
+                  rules: Optional[Sequence[Rule]] = None,
+                  project: Optional[Project] = None) -> List[Violation]:
+    """Lint many (repo-relative path, absolute path) files into one
+    project; returns all violations (sorted by path/line)."""
+    from skypilot_tpu.analysis import rules as rules_mod
+    if rules is None:
+        rules = rules_mod.default_rules()
+    if project is None:
+        project = Project()
+    for rel, abspath in paths:
+        with open(abspath, encoding='utf-8') as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            # A file the interpreter can't parse is its own finding.
+            project.violations.append(Violation(
+                rule='STL000', severity='error', path=rel,
+                line=e.lineno or 1, col=e.offset or 0,
+                message=f'syntax error: {e.msg}', context='',
+                snippet=(e.text or '').strip()))
+            continue
+        ctx = FileContext(rel, source, tree, project)
+        LintVisitor(ctx, rules).visit(tree)
+    for rule in rules:
+        rule.finalize(project)
+    project.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return project.violations

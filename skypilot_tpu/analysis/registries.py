@@ -1,0 +1,76 @@
+"""Static extraction of the repo's declared-name registries.
+
+The analyzer never imports production modules (importing
+``skypilot_tpu.utils.fault_injection`` would drag in the metrics
+subsystem; importing models would drag in jax). Instead the two
+registries the rules cross-check against are read *statically*:
+
+- **Env names** (STL005): every string literal matching
+  ``(SKYTPU|BENCH)_[A-Z0-9_]+`` that appears in
+  ``utils/env_contract.py`` or ``utils/env_registry.py`` — a name
+  mentioned in a registry module IS a declaration (constants,
+  ``register(...)`` calls and alias maps all count).
+- **Fault sites** (STL007): the elements of the literal
+  ``KNOWN_SITES = (...)`` tuple in ``utils/fault_injection.py``,
+  order- and duplicate-preserving so the rule can flag double
+  declarations.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+ENV_REGISTRY_FILES = ('utils/env_contract.py', 'utils/env_registry.py')
+FAULT_SITE_FILE = 'utils/fault_injection.py'
+
+
+def package_root() -> str:
+    """Absolute path of the skypilot_tpu package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse(path: str) -> Optional[ast.Module]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        try:
+            return ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            return None
+
+
+def declared_env_names(root: Optional[str] = None) -> Set[str]:
+    root = root or package_root()
+    names: Set[str] = set()
+    pattern = core.env_name_re()
+    for rel in ENV_REGISTRY_FILES:
+        tree = _parse(os.path.join(root, *rel.split('/')))
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    pattern.fullmatch(node.value):
+                names.add(node.value)
+    return names
+
+
+def declared_fault_sites(root: Optional[str] = None) -> List[str]:
+    root = root or package_root()
+    tree = _parse(os.path.join(root, *FAULT_SITE_FILE.split('/')))
+    if tree is None:
+        return []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == 'KNOWN_SITES'
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return [elt.value for elt in node.value.elts
+                    if isinstance(elt, ast.Constant) and
+                    isinstance(elt.value, str)]
+    return []

@@ -1,0 +1,607 @@
+"""skytpu-lint rule catalog (STL001–STL008).
+
+Each rule encodes one repo invariant that used to be enforced only at
+runtime or by convention; docs/static_analysis.md carries the full
+rationale and fixture examples. Rules are deliberately heuristic
+where a sound analysis is impossible (STL004's race detector,
+STL008's tracer hazards): precision comes from the suppression +
+baseline workflow, not from pretending the heuristic is exact.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.core import FileContext
+from skypilot_tpu.analysis.core import Project
+from skypilot_tpu.analysis.core import Rule
+
+
+class SwallowedException(Rule):
+    """STL001: a bare/broad except whose body is only ``pass``.
+
+    ``except Exception: pass`` in serve/jobs control loops is how
+    replica failures and controller errors vanish without a log line.
+    Narrow typed excepts (``except OSError: pass``) are allowed —
+    swallowing a *specific* expected error is a decision; swallowing
+    everything is a bug magnet.
+    """
+
+    id = 'STL001'
+    name = 'swallowed-exception'
+    severity = 'error'
+    help = ('Bare `except:` / `except Exception:` with a pass-only '
+            'body silently swallows every error including bugs. Log '
+            'at warning with context, narrow the exception type, or '
+            'suppress with a reason comment.')
+    node_types = (ast.ExceptHandler,)
+
+    _BROAD = ('Exception', 'BaseException')
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.ExceptHandler)
+        if node.type is not None and not self._any_broad(node.type):
+            return
+        body = [stmt for stmt in node.body
+                if not (isinstance(stmt, ast.Expr) and
+                        core.literal_str(stmt.value) is not None)]
+        if not all(isinstance(stmt, ast.Pass) or
+                   (isinstance(stmt, ast.Expr) and
+                    isinstance(stmt.value, ast.Constant) and
+                    stmt.value.value is Ellipsis)
+                   for stmt in body):
+            return
+        what = ('bare except' if node.type is None else
+                'broad except')
+        ctx.report(self, node,
+                   f'{what} swallows all errors silently; log at '
+                   'warning with context or narrow the type',
+                   span=(node.lineno,
+                         getattr(node, 'end_lineno', node.lineno)))
+
+    @classmethod
+    def _any_broad(cls, type_expr: ast.AST) -> bool:
+        """Exception/BaseException, alone or anywhere in a tuple —
+        `except (Exception, ValueError):` is just as broad."""
+        exprs = (type_expr.elts if isinstance(type_expr, ast.Tuple)
+                 else [type_expr])
+        return any(isinstance(e, ast.Name) and e.id in cls._BROAD
+                   for e in exprs)
+
+
+class HandRolledRetry(Rule):
+    """STL002: a try/except + ``time.sleep`` loop outside RetryPolicy.
+
+    utils/retry.RetryPolicy is THE retry implementation (backoff cap,
+    full jitter, deadline, typed retryable predicate, FakeClock for
+    tests, per-site metrics). A hand-rolled sleep-in-a-loop retry
+    bypasses all of that and is invisible to chaos tests.
+    """
+
+    id = 'STL002'
+    name = 'hand-rolled-retry'
+    severity = 'error'
+    help = ('A loop containing both a try/except and time.sleep is a '
+            'hand-rolled retry loop. Use utils/retry.RetryPolicy '
+            '(seedable jitter, deadlines, retry metrics) instead.')
+    node_types = (ast.Call,)
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        if core.call_name(node) != 'time.sleep':
+            return
+        if not ctx.loop_stack:
+            return
+        loop = ctx.loop_stack[-1]
+        if not getattr(loop, '_skytpu_has_try', None):
+            loop._skytpu_has_try = any(  # type: ignore[attr-defined]
+                isinstance(n, ast.Try) for n in ast.walk(loop))
+        if loop._skytpu_has_try:  # type: ignore[attr-defined]
+            ctx.report(self, node,
+                       'time.sleep retry loop outside RetryPolicy; '
+                       'use utils/retry.RetryPolicy '
+                       '(state.should_retry()/state.sleep())')
+
+
+class ThreadWithoutDaemon(Rule):
+    """STL003: ``threading.Thread(...)`` without an explicit daemon=.
+
+    Python's default (inherit daemonness from the spawner) makes
+    process shutdown depend on *which thread* created the worker. The
+    reference orchestrator's hang-at-exit bugs all trace to this;
+    every Thread here states its intent.
+    """
+
+    id = 'STL003'
+    name = 'thread-daemon'
+    severity = 'error'
+    help = ('threading.Thread() without explicit daemon= inherits '
+            'daemonness from the creating thread — shutdown behavior '
+            'becomes spawn-site-dependent. Always pass daemon=True/'
+            'False explicitly.')
+    node_types = (ast.Call,)
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        if core.call_name(node) not in ('threading.Thread', 'Thread'):
+            return
+        for kw in node.keywords:
+            if kw.arg == 'daemon' or kw.arg is None:  # None = **kwargs
+                return
+        ctx.report(self, node,
+                   'threading.Thread without explicit daemon=; pass '
+                   'daemon=True (helper) or daemon=False (must join)',
+                   span=(node.lineno, node.lineno))
+
+
+class UnlockedSharedMutation(Rule):
+    """STL004: heuristic race detector for thread-spawning classes.
+
+    In a class that constructs ``threading.Thread`` anywhere, an
+    assignment to ``self.<attr>`` (or ``self.<attr>[...]``) outside a
+    ``with <lock>`` block — and outside ``__init__``, which runs
+    before the threads exist — is a candidate data race. Heuristic by
+    design: single-word flag flips are atomic-enough in CPython, so
+    intentional lock-free sites get a suppression with a reason.
+    """
+
+    id = 'STL004'
+    name = 'unlocked-shared-mutation'
+    severity = 'warning'
+    help = ('Mutation of instance state in a thread-spawning class '
+            'outside a `with <lock>` block. Take the lock, move the '
+            'write to __init__, or suppress with a reason if the '
+            'lock-free write is intentional (e.g. GIL-atomic flag).')
+    node_types = (ast.Assign, ast.AugAssign)
+
+    _SKIP_METHODS = ('__init__', '__new__', '__del__', '__enter__')
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        cls = ctx.enclosing_class()
+        if cls is None or ctx.lock_depth > 0:
+            return
+        fn = ctx.enclosing_function()
+        if fn is None or fn.name in self._SKIP_METHODS:
+            return
+        if not self._spawns_threads(cls):
+            return
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for target in targets:
+            attr = self._self_attr(target)
+            if attr is None:
+                continue
+            ctx.report(self, node,
+                       f'write to self.{attr} outside a lock in '
+                       f'thread-spawning class {cls.name}; guard with '
+                       'the instance lock or suppress with a reason',
+                       span=(node.lineno, node.lineno))
+            return
+
+    @staticmethod
+    def _self_attr(target: ast.AST) -> Optional[str]:
+        # self.x = ... / self.x[k] = ... / self.x += ...
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if (isinstance(target, ast.Attribute) and
+                isinstance(target.value, ast.Name) and
+                target.value.id == 'self'):
+            return target.attr
+        return None
+
+    @staticmethod
+    def _spawns_threads(cls: ast.ClassDef) -> bool:
+        cached = getattr(cls, '_skytpu_spawns_threads', None)
+        if cached is None:
+            cached = any(
+                isinstance(n, ast.Call) and
+                core.call_name(n) in ('threading.Thread', 'Thread')
+                for n in ast.walk(cls))
+            cls._skytpu_spawns_threads = cached  # type: ignore
+        return cached
+
+
+class UndeclaredEnvVar(Rule):
+    """STL005: a ``SKYTPU_*``/``BENCH_*`` literal not in the registry.
+
+    Every control-plane env knob must be declared exactly once, in
+    ``utils/env_contract.py`` (the rank contract) or
+    ``utils/env_registry.py`` (tunables) — that is what makes the env
+    surface auditable and lets conftest/docs enumerate it. A literal
+    anywhere else that the registry has never heard of is drift:
+    either a typo'd name (reads get a silent default) or a brand-new
+    knob smuggled in without declaration.
+    """
+
+    id = 'STL005'
+    name = 'undeclared-env-var'
+    severity = 'error'
+    help = ('String literal names a SKYTPU_*/BENCH_* env var that is '
+            'not declared in utils/env_contract.py or '
+            'utils/env_registry.py. Declare it centrally (and '
+            'preferably reference the registry constant).')
+    node_types = (ast.Constant,)
+
+    _ALLOWED_FILES = ('utils/env_contract.py', 'utils/env_registry.py')
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace('\\', '/')
+        return not any(norm.endswith(allowed)
+                       for allowed in self._ALLOWED_FILES)
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Constant)
+        value = node.value
+        if not isinstance(value, str) or \
+                not core.env_name_re().fullmatch(value):
+            return
+        if value in ctx.project.declared_env:
+            return
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Expr):  # docstring / bare string
+            return
+        ctx.report(self, node,
+                   f'env var {value!r} is not declared in the env '
+                   'registry (utils/env_registry.py) or env contract',
+                   span=(node.lineno, node.lineno))
+
+
+class MetricRegistrationLint(Rule):
+    """STL006: static mirror of the metrics registry's runtime lint.
+
+    ``metrics/registry.py`` rejects bad names/missing help at
+    registration — but only when the registering module is imported.
+    This rule applies the same checks (name matches
+    ``skytpu_[a-z0-9_]+``, non-empty help, sane label names) to every
+    literal ``counter/gauge/histogram`` registration at parse time,
+    and cross-checks that one metric name is never registered with
+    two different kinds or label sets across the repo (the runtime
+    conflict error, caught before both modules ever co-import).
+    """
+
+    id = 'STL006'
+    name = 'metric-registration'
+    severity = 'error'
+    help = ('Literal metric registration violating the registry '
+            'contract: name must match skytpu_[a-z0-9_]+, help must '
+            'be a non-empty string, label names must be lowercase '
+            'identifiers, and a name must keep one (kind, labels) '
+            'across the whole repo.')
+    node_types = (ast.Call,)
+
+    _METHODS = ('counter', 'gauge', 'histogram')
+    _RECEIVER_TOKENS = ('metric', 'registry')
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and
+                func.attr in self._METHODS):
+            return
+        receiver = ''
+        if isinstance(func.value, ast.Name):
+            receiver = func.value.id
+        elif isinstance(func.value, ast.Attribute):
+            receiver = func.value.attr
+        if not any(tok in receiver.lower()
+                   for tok in self._RECEIVER_TOKENS):
+            return
+        kind = func.attr
+        name_node = core.arg_or_keyword(node, 0, 'name')
+        name = core.literal_str(name_node)
+        if name is None:
+            return  # dynamic name: runtime lint still covers it
+        span = (node.lineno, node.lineno)
+        if not core.metric_name_re().fullmatch(name):
+            ctx.report(self, node,
+                       f'metric name {name!r} must match '
+                       'skytpu_[a-z0-9_]+', span=span)
+        help_node = core.arg_or_keyword(node, 1, 'help')
+        help_str = core.literal_str(help_node)
+        if help_node is None or (help_str is not None and
+                                 not help_str.strip()):
+            ctx.report(self, node,
+                       f'metric {name!r} needs a non-empty help string',
+                       span=span)
+        labels = self._literal_labels(node)
+        if labels is not None:
+            for label in labels:
+                if not core.label_name_re().fullmatch(label):
+                    ctx.report(self, node,
+                               f'metric {name!r} label {label!r} must '
+                               'be a lowercase identifier', span=span)
+        seen = ctx.project.metric_registrations.get(name)
+        signature = (kind, tuple(labels) if labels is not None else None)
+        if seen is None:
+            ctx.project.metric_registrations[name] = (
+                signature[0], signature[1], ctx.path, node.lineno)
+        else:
+            # Dynamic labels (None) are unknowable statically: only a
+            # kind mismatch is a definite conflict then; label sets
+            # are compared when both sides are literal.
+            kind_conflict = seen[0] != kind
+            label_conflict = (labels is not None and
+                              seen[1] is not None and
+                              seen[1] != signature[1])
+            if kind_conflict or label_conflict:
+                ctx.report(self, node,
+                           f'metric {name!r} re-registered as {kind}'
+                           f'{signature[1] or ()} but '
+                           f'{seen[2]}:{seen[3]} registered it as '
+                           f'{seen[0]}{seen[1] or ()}',
+                           span=span)
+
+    @staticmethod
+    def _literal_labels(node: ast.Call) -> Optional[Tuple[str, ...]]:
+        # labels is the registry helpers' third positional parameter
+        # (registry.py counter/gauge/histogram) or a keyword.
+        labels_node = core.arg_or_keyword(node, 2, 'labels')
+        if labels_node is None:
+            return ()  # unlabeled registration
+        if isinstance(labels_node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in labels_node.elts:
+                lit = core.literal_str(elt)
+                if lit is None:
+                    return None  # dynamic labels: skip
+                out.append(lit)
+            return tuple(out)
+        return None
+
+
+class UnknownFaultSite(Rule):
+    """STL007: fault-injection site literals vs the site registry.
+
+    Sites are just strings at ``fault_injection.poll/inject/pending``
+    call sites; a typo there means the chaos plan never fires and the
+    test silently stops testing anything. Every literal site must
+    match ``fault_injection.KNOWN_SITES`` (exact or fnmatch pattern);
+    the registry itself must not list a site twice.
+    """
+
+    id = 'STL007'
+    name = 'unknown-fault-site'
+    severity = 'error'
+    help = ('Literal fault-injection site not declared in '
+            'utils/fault_injection.KNOWN_SITES (or declared twice '
+            'there). A typo\'d site makes chaos plans silently inert.')
+    node_types = (ast.Call,)
+
+    _METHODS = ('poll', 'inject', 'pending')
+
+    def __init__(self) -> None:
+        self._uses: List[Tuple[str, str, int, str]] = []
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        dotted = core.call_name(node)
+        parts = dotted.split('.')
+        if len(parts) < 2 or parts[-1] not in self._METHODS:
+            return
+        receiver = parts[-2]
+        if receiver not in ('fault_injection', 'fi') and \
+                'fault' not in receiver:
+            return
+        site = core.literal_str(core.arg_or_keyword(node, 0, 'site'))
+        if site is None:
+            return  # dynamic site (the provision router's f-string)
+        self._uses.append((ctx.path, ctx.qualname(), node.lineno, site))
+
+    def finalize(self, project: Project) -> None:
+        declared = project.declared_sites
+        dupes = {s for s in declared if declared.count(s) > 1}
+        reported_dupes: Set[str] = set()
+        for dupe in dupes:
+            if dupe not in reported_dupes:
+                reported_dupes.add(dupe)
+                project.violations.append(core.Violation(
+                    rule=self.id, severity=self.severity,
+                    path='skypilot_tpu/utils/fault_injection.py',
+                    line=1, col=0,
+                    message=f'site {dupe!r} declared more than once '
+                            'in KNOWN_SITES',
+                    context='KNOWN_SITES', snippet=''))
+        for path, context, line, site in self._uses:
+            if any(site == pat or fnmatch.fnmatch(site, pat)
+                   for pat in declared):
+                continue
+            project.report_at(
+                self, path, line, 0,
+                f'fault-injection site {site!r} is not declared in '
+                'utils/fault_injection.KNOWN_SITES', context=context)
+        self._uses = []
+
+
+class JaxRecompileHazard(Rule):
+    """STL008: tracer/recompile hazards inside ``jax.jit`` functions.
+
+    Scoped to ``models/``, ``ops/``, ``parallel/``. Inside a function
+    decorated ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``:
+
+    - ``np.*`` calls force a host sync / constant-fold per trace
+      (use ``jnp`` or hoist out of the jit);
+    - a Python ``if`` on a *traced* (non-static) argument raises
+      ``TracerBoolConversionError`` at trace time or, worse, bakes
+      one branch in silently when the arg is concrete during warmup;
+    - ``int(arg)`` / ``range(arg)`` on a traced arg is the same
+      hazard spelled differently.
+
+    ``x is None`` checks, ``isinstance`` and ``.shape/.dtype/.ndim``
+    accesses are static and allowed.
+    """
+
+    id = 'STL008'
+    name = 'jax-recompile-hazard'
+    severity = 'error'
+    help = ('Inside a jax.jit-decorated function: np.* call, Python '
+            '`if` on a traced argument, or int()/range() on a traced '
+            'argument. Use jnp/lax.cond/static_argnames, or suppress '
+            'with a reason if the value is genuinely static.')
+    node_types = (ast.FunctionDef,)
+    path_filter = ('models', 'ops', 'parallel')
+
+    _NP_NAMES = ('np', 'numpy', '_np')
+    _STATIC_ATTRS = ('shape', 'ndim', 'dtype', 'size', 'sharding')
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.FunctionDef)
+        static = self._jit_static_args(node)
+        if static is None:
+            return
+        params = {a.arg for a in (node.args.posonlyargs + node.args.args +
+                                  node.args.kwonlyargs)} - static
+        params.discard('self')
+        for sub in self._walk_own_body(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(ctx, sub, params)
+            elif isinstance(sub, ast.If):
+                self._check_if(ctx, sub, params)
+
+    @staticmethod
+    def _walk_own_body(fn: ast.FunctionDef):
+        """Walk fn's body without descending into nested defs (those
+        get their own decorator treatment when the visitor reaches
+        them)."""
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            sub = stack.pop()
+            yield sub
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_call(self, ctx: FileContext, node: ast.Call,
+                    traced: Set[str]) -> None:
+        dotted = core.call_name(node)
+        root = dotted.split('.')[0] if dotted else ''
+        if root in self._NP_NAMES and '.' in dotted:
+            ctx.report(self, node,
+                       f'{dotted}() inside jax.jit traces to a host '
+                       'constant / sync; use jnp or hoist it out',
+                       span=(node.lineno, node.lineno))
+            return
+        if dotted in ('int', 'range') and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in traced:
+                ctx.report(self, node,
+                           f'{dotted}({arg.id}) on a traced argument '
+                           'inside jax.jit; mark it static_argnames '
+                           'or keep it on-device',
+                           span=(node.lineno, node.lineno))
+
+    def _check_if(self, ctx: FileContext, node: ast.If,
+                  traced: Set[str]) -> None:
+        offender = self._traced_value_use(ctx, node.test, traced)
+        if offender is not None:
+            ctx.report(self, node,
+                       f'Python `if` on traced argument {offender!r} '
+                       'inside jax.jit (TracerBoolConversionError or '
+                       'silently baked branch); use lax.cond/jnp.where '
+                       'or static_argnames',
+                       span=(node.lineno,
+                             getattr(node.test, 'end_lineno',
+                                     node.lineno)))
+
+    def _traced_value_use(self, ctx: FileContext, test: ast.AST,
+                          traced: Set[str]) -> Optional[str]:
+        for sub in ast.walk(test):
+            if not (isinstance(sub, ast.Name) and sub.id in traced):
+                continue
+            parent = ctx.parent(sub)
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in self._STATIC_ATTRS:
+                continue
+            if isinstance(parent, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in parent.ops):
+                continue
+            if isinstance(parent, ast.Call):
+                func = parent.func
+                if isinstance(func, ast.Name) and \
+                        func.id in ('isinstance', 'len', 'getattr',
+                                    'hasattr'):
+                    continue
+            return sub.id
+        return None
+
+    @staticmethod
+    def _jit_static_args(node: ast.FunctionDef) -> Optional[Set[str]]:
+        """None if not jit-decorated; else the static arg-name set."""
+        for dec in node.decorator_list:
+            dotted = ''
+            call = None
+            if isinstance(dec, ast.Call):
+                call = dec
+                dotted = core.call_name(dec)
+            elif isinstance(dec, (ast.Name, ast.Attribute)):
+                dotted = core.call_name(
+                    ast.Call(func=dec, args=[], keywords=[]))
+            if dotted in ('jax.jit', 'jit'):
+                static: Set[str] = set()
+                if call is not None:
+                    static = JaxRecompileHazard._static_from_call(
+                        call, node)
+                return static
+            if dotted in ('functools.partial', 'partial') and \
+                    call is not None and call.args:
+                inner = call.args[0]
+                inner_name = ''
+                if isinstance(inner, (ast.Name, ast.Attribute)):
+                    inner_name = core.call_name(
+                        ast.Call(func=inner, args=[], keywords=[]))
+                if inner_name in ('jax.jit', 'jit'):
+                    return JaxRecompileHazard._static_from_call(
+                        call, node)
+        return None
+
+    @staticmethod
+    def _static_from_call(call: ast.Call,
+                          fn: ast.FunctionDef) -> Set[str]:
+        static: Set[str] = set()
+        all_args = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+        for kw in call.keywords:
+            if kw.arg == 'static_argnames':
+                value = kw.value
+                lit = core.literal_str(value)
+                if lit is not None:
+                    static.add(lit)
+                elif isinstance(value, (ast.Tuple, ast.List)):
+                    for elt in value.elts:
+                        name = core.literal_str(elt)
+                        if name is not None:
+                            static.add(name)
+            elif kw.arg in ('static_argnums', 'donate_argnums'):
+                if kw.arg == 'donate_argnums':
+                    continue
+                nums: List[int] = []
+                value = kw.value
+                if isinstance(value, ast.Constant) and \
+                        isinstance(value.value, int):
+                    nums = [value.value]
+                elif isinstance(value, (ast.Tuple, ast.List)):
+                    nums = [elt.value for elt in value.elts
+                            if isinstance(elt, ast.Constant) and
+                            isinstance(elt.value, int)]
+                for num in nums:
+                    if 0 <= num < len(all_args):
+                        static.add(all_args[num])
+        return static
+
+
+def default_rules() -> List[Rule]:
+    """Fresh rule instances (STL007 keeps per-run state)."""
+    return [
+        SwallowedException(),
+        HandRolledRetry(),
+        ThreadWithoutDaemon(),
+        UnlockedSharedMutation(),
+        UndeclaredEnvVar(),
+        MetricRegistrationLint(),
+        UnknownFaultSite(),
+        JaxRecompileHazard(),
+    ]
+
+
+RULE_IDS = tuple(r.id for r in default_rules())

@@ -209,8 +209,14 @@ class RetryingProvisioner:
                     provision.terminate_instances(
                         to_provision.cloud.provider_name(),
                         self._cluster_name_on_cloud, region, zone)
-                except Exception:  # pylint: disable=broad-except
-                    pass
+                except Exception as e:  # pylint: disable=broad-except
+                    # Leaked partial resources cost money: make the
+                    # failed cleanup visible even though failover
+                    # continues regardless.
+                    logger.warning(
+                        'Cleanup of partially-provisioned resources '
+                        'for %s in %s failed: %s',
+                        self._cluster_name_on_cloud, where, e)
             if not self._retry_until_up:
                 raise exceptions.ResourcesUnavailableError(
                     f'Failed to provision {to_provision!r} in all '
@@ -374,8 +380,12 @@ class GangBackend(backend_lib.Backend[GangResourceHandle]):
                     global_user_state.set_cluster_owner(
                         cluster_name,
                         ','.join(identities[0]))
-            except Exception:  # pylint: disable=broad-except
-                pass  # identity is best-effort safety metadata
+            except Exception as e:  # pylint: disable=broad-except
+                # Identity is best-effort safety metadata; the launch
+                # succeeds without it, but say why it is missing.
+                logger.warning(
+                    'Could not record owner identity for cluster '
+                    '%s: %s', cluster_name, e)
             return handle
 
     @staticmethod

@@ -116,8 +116,10 @@ def collect_results(benchmark: str) -> List[Dict[str, Any]]:
                 cluster, [row['job_id']])[row['job_id']]
             if job_status is not None and job_status.is_terminal():
                 status = str(job_status.value)
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(
+                'Could not refresh job status for benchmark '
+                'candidate %s: %s', cluster, e)
         benchmark_state.update_candidate(
             benchmark, cluster, num_steps=steps,
             seconds_per_step=sec_per_step,
@@ -143,6 +145,9 @@ def down_benchmark(benchmark: str) -> None:
     for row in benchmark_state.get_candidates(benchmark):
         try:
             core.down(row['cluster_name'])
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            # A cluster left running after `benchmark down` keeps
+            # billing: surface it instead of silently moving on.
+            logger.warning('Failed to tear down benchmark cluster '
+                           '%s: %s', row['cluster_name'], e)
     benchmark_state.remove_benchmark(benchmark)

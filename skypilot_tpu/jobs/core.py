@@ -27,6 +27,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import subprocess_utils
 
@@ -34,7 +35,8 @@ logger = sky_logging.init_logger(__name__)
 
 def _log_dir() -> str:
     return os.path.expanduser(
-        os.environ.get('SKYTPU_JOBS_LOG_DIR', '~/.skytpu/managed_jobs'))
+        env_registry.get(env_registry.SKYTPU_JOBS_LOG_DIR,
+                         '~/.skytpu/managed_jobs'))
 
 
 def _controller_alive(pid: Optional[int], job_id: int) -> bool:
@@ -51,13 +53,18 @@ CONTROLLER_CLUSTER_NAME = 'skytpu-jobs-controller'
 # controller cluster these point at the same filesystem; a cloud
 # controller VM keeps its own copies rsynced at submission.
 _CONTROLLER_ENV_PASSTHROUGH = (
-    'SKYTPU_JOBS_DB', 'SKYTPU_STATE_DB', 'SKYTPU_DATA_DIR',
-    'SKYTPU_JOBS_LOG_DIR', 'SKYTPU_CONFIG', 'SKYTPU_USER_HASH',
-    'SKYTPU_JOBS_LAUNCH_PARALLELISM',
+    env_registry.SKYTPU_JOBS_DB,
+    env_registry.SKYTPU_STATE_DB,
+    env_registry.SKYTPU_DATA_DIR,
+    env_registry.SKYTPU_JOBS_LOG_DIR,
+    env_registry.SKYTPU_CONFIG,
+    env_registry.SKYTPU_USER_HASH,
+    env_registry.SKYTPU_JOBS_LAUNCH_PARALLELISM,
     # Chaos plans and their retry-schedule overrides must reach the
     # controller wherever it runs (utils/fault_injection.py).
-    'SKYTPU_FAULT_PLAN', 'SKYTPU_JOBS_LAUNCH_MAX_ATTEMPTS',
-    'SKYTPU_JOBS_LAUNCH_RETRY_GAP',
+    env_registry.SKYTPU_FAULT_PLAN,
+    env_registry.SKYTPU_JOBS_LAUNCH_MAX_ATTEMPTS,
+    env_registry.SKYTPU_JOBS_LAUNCH_RETRY_GAP,
 )
 
 

@@ -27,9 +27,10 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.metrics import registry as registry_lib
+from skypilot_tpu.utils import env_registry
 
-METRICS_DIR_ENV = 'SKYTPU_METRICS_DIR'
-METRICS_TTL_ENV = 'SKYTPU_METRICS_TTL'
+METRICS_DIR_ENV = env_registry.SKYTPU_METRICS_DIR
+METRICS_TTL_ENV = env_registry.SKYTPU_METRICS_TTL
 _DEFAULT_TTL_SECONDS = 900.0
 
 _COMPONENT_RE = re.compile(r'[^A-Za-z0-9._-]+')

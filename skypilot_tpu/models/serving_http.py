@@ -104,6 +104,9 @@ class EngineServer:
                         return
                     self._resolve_finished()
                     continue
+                # skytpu-lint: disable=STL002 — idle tick of the
+                # driver loop, not a retry: errors kill the driver
+                # (_die), they are never retried here.
                 time.sleep(0.002)
                 continue
             try:
@@ -130,6 +133,8 @@ class EngineServer:
                                           f.set_result(r)))
 
     def _die(self, reason: str) -> None:
+        # skytpu-lint: disable=STL004 — one-shot GIL-atomic str write;
+        # readers (health/generate) only compare against None.
         self._dead = reason
         self._ready.set()      # unblock anything waiting on readiness
         if self._loop is None:
@@ -229,6 +234,9 @@ class EngineServer:
             return await self._generate_stream(
                 request, rid, tokens, max_new, temperature)
         fut = asyncio.get_event_loop().create_future()
+        # skytpu-lint: disable=STL004 — _futures is mutated and
+        # iterated only on the event-loop thread (fail_all runs via
+        # call_soon_threadsafe); the driver thread does atomic pops.
         self._futures[rid] = fut
         try:
             with self._lock:
@@ -257,6 +265,8 @@ class EngineServer:
         """SSE: one ``data:`` event per decode chunk, then ``done``."""
         from skypilot_tpu.models.serving_engine import Request
         q: asyncio.Queue = asyncio.Queue()
+        # skytpu-lint: disable=STL004 — same discipline as _futures:
+        # loop-thread-only mutation/iteration, atomic cross-thread get.
         self._streams[rid] = q
         try:
             with self._lock:
@@ -333,6 +343,8 @@ class EngineServer:
         return app
 
     async def start(self, port: int) -> web.AppRunner:
+        # skytpu-lint: disable=STL004 — written once before the driver
+        # thread starts on the next line (Thread.start happens-before).
         self._loop = asyncio.get_event_loop()
         self._thread.start()
         runner = web.AppRunner(self.make_app())

@@ -81,8 +81,8 @@ def _force_cpu() -> None:
     import jax
     try:
         jax.config.update('jax_platforms', 'cpu')
-    except Exception:  # pylint: disable=broad-except
-        pass
+    except RuntimeError:
+        pass  # backend already initialized: the env pin above holds
 
 
 def _child(procs: int, local: int, out_path: str) -> None:

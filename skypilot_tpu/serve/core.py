@@ -116,6 +116,9 @@ def up(task: task_lib.Task,
         port = (record or {}).get('lb_port') or 0
         if port and _lb_reachable(port):
             break
+        # skytpu-lint: disable=STL002 — deadline-bounded readiness
+        # poll (controller exit / LB reachable / timeout), not a
+        # retried operation; the try above only reads the log tail.
         time.sleep(0.2)
     else:
         logger.warning(

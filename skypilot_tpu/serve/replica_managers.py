@@ -93,7 +93,6 @@ class ReplicaManager:
         # paths (preemption, failed probes) skip it — the replica is
         # already gone.
         self.drain_fn = drain_fn
-        self._launch_threads: Dict[int, threading.Thread] = {}
         self._lock = threading.Lock()
         self._failed_probes: Dict[int, int] = {}
         # Replica ids with a termination thread in flight (guards the
@@ -122,8 +121,11 @@ class ReplicaManager:
         if record is not None:
             try:
                 return ServiceSpec.from_yaml_config(record['spec'])
-            except Exception:  # pylint: disable=broad-except
-                pass
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(
+                    'Stored spec for %s v%d is unparsable (%s); '
+                    'falling back to the in-memory spec.',
+                    self.service_name, version, e)
         return self.spec
 
     def _make_task(self, replica_id: int, version: int,
@@ -157,12 +159,10 @@ class ReplicaManager:
             serve_state.add_replica(self.service_name, replica_id,
                                     cluster, version=version,
                                     is_spot=bool(is_spot))
-            thread = threading.Thread(
+            threading.Thread(
                 target=self._launch_replica,
                 args=(replica_id, cluster, version, is_spot),
-                daemon=True)
-            self._launch_threads[replica_id] = thread
-            thread.start()
+                daemon=True).start()
 
     def _launch_replica(self, replica_id: int, cluster: str,
                         version: int,
@@ -237,7 +237,7 @@ class ReplicaManager:
             if replica_id in self._terminating:
                 return
             self._terminating.add(replica_id)
-        self._failed_probes.pop(replica_id, None)
+            self._failed_probes.pop(replica_id, None)
 
         def work() -> None:
             try:
@@ -257,7 +257,7 @@ class ReplicaManager:
         threads = []
         for replica_id in ids:
             t = threading.Thread(target=self._terminate_replica,
-                                 args=(replica_id,))
+                                 args=(replica_id,), daemon=False)
             t.start()
             threads.append(t)
         for t in threads:
@@ -339,15 +339,17 @@ class ReplicaManager:
             ready = url is not None and self._probe_ready(
                 url, spec, replica_id=rid)
             if ready:
-                self._failed_probes[rid] = 0
+                with self._lock:
+                    self._failed_probes[rid] = 0
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.READY,
                                                url=url)
             elif status in (ReplicaStatus.READY,
                             ReplicaStatus.NOT_READY):
-                self._failed_probes[rid] = (
-                    self._failed_probes.get(rid, 0) + 1)
-                streak = self._failed_probes[rid]
+                with self._lock:
+                    self._failed_probes[rid] = (
+                        self._failed_probes.get(rid, 0) + 1)
+                    streak = self._failed_probes[rid]
                 if streak >= self.probe_failure_terminate_threshold:
                     # App is dead though the cluster is UP: tear the
                     # replica down so reconcile replaces it, instead

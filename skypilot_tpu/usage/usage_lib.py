@@ -189,7 +189,9 @@ def record_event(op: str, **fields: Any) -> None:
             with open(path, 'a', encoding='utf-8') as f:
                 f.write(line)
     except Exception:  # pylint: disable=broad-except
-        pass  # usage must never break the product
+        # skytpu-lint: disable=STL001 — telemetry is strictly
+        # best-effort: usage reporting must never break the product.
+        pass
 
 
 @contextlib.contextmanager

@@ -1,0 +1,251 @@
+"""Central ``SKYTPU_*`` / ``BENCH_*`` environment-variable registry.
+
+Every control-plane / bench tunable is declared here exactly once,
+with a help string — the single auditable surface of the env
+contract. The *rank* contract names (``SKYTPU_NODE_RANK`` etc.) live
+in :mod:`skypilot_tpu.utils.env_contract`; everything else lives
+here.
+
+The static analyzer (rule STL005, docs/static_analysis.md) flags any
+``SKYTPU_*``/``BENCH_*`` string literal elsewhere in the repo whose
+name is not declared in one of these two modules: a name the
+registry has never heard of is either a typo (reads silently fall
+back to the default) or an undeclared knob. Modules should reference
+the constants (``env_registry.SKYTPU_DEBUG``) rather than repeating
+the literal, so a rename stays one-line.
+
+Purely stdlib and import-light: this is imported by logging setup.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Mapping, Optional
+
+_NAME_RE = re.compile(r'\A(?:SKYTPU|BENCH)_[A-Z0-9_]+\Z')
+_DECLARED: Dict[str, str] = {}
+
+
+def register(name: str, help: str) -> str:
+    """Declare one env var; returns the name (assign it to a module
+    constant). Re-declaration and malformed names raise — the
+    registry is the one place where duplicates are a bug."""
+    if not _NAME_RE.fullmatch(name):
+        raise ValueError(f'env var {name!r} must match '
+                         '(SKYTPU|BENCH)_[A-Z0-9_]+')
+    if not help or not help.strip():
+        raise ValueError(f'env var {name!r} needs a help string')
+    if name in _DECLARED:
+        raise ValueError(f'env var {name!r} declared twice')
+    _DECLARED[name] = help
+    return name
+
+
+def declared() -> Mapping[str, str]:
+    """name -> help for every registered var (docs/tests enumerate)."""
+    return dict(_DECLARED)
+
+
+def get(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)
+
+
+def is_enabled(name: str) -> bool:
+    """The repo's boolean convention: set to '1' means on."""
+    return os.environ.get(name, '0') == '1'
+
+
+# ------------------------------------------------------------- logging
+SKYTPU_DEBUG = register(
+    'SKYTPU_DEBUG', 'Set to 1 for DEBUG-level logging.')
+SKYTPU_MINIMIZE_LOGGING = register(
+    'SKYTPU_MINIMIZE_LOGGING', 'Set to 1 to log WARNING and above only.')
+
+# ----------------------------------------------------- state / config
+SKYTPU_CONFIG = register(
+    'SKYTPU_CONFIG', 'Path to the user config YAML.')
+SKYTPU_STATE_DB = register(
+    'SKYTPU_STATE_DB', 'Path of the global cluster-state sqlite DB.')
+SKYTPU_DATA_DIR = register(
+    'SKYTPU_DATA_DIR', 'Root directory for local artifacts '
+    '(cluster dirs, logs, mounts).')
+SKYTPU_USER = register(
+    'SKYTPU_USER', 'Override the logical user name.')
+SKYTPU_USER_HASH = register(
+    'SKYTPU_USER_HASH', 'Override the stable per-user hash.')
+
+# -------------------------------------------------------- managed jobs
+SKYTPU_JOBS_DB = register(
+    'SKYTPU_JOBS_DB', 'Path of the managed-jobs sqlite DB.')
+SKYTPU_JOBS_LOG_DIR = register(
+    'SKYTPU_JOBS_LOG_DIR', 'Directory for managed-job controller logs.')
+SKYTPU_JOBS_LAUNCH_PARALLELISM = register(
+    'SKYTPU_JOBS_LAUNCH_PARALLELISM',
+    'Max concurrent managed-job launches (jobs/scheduler.py).')
+SKYTPU_JOBS_LAUNCH_MAX_ATTEMPTS = register(
+    'SKYTPU_JOBS_LAUNCH_MAX_ATTEMPTS',
+    'Retry budget for one managed-job launch (RetryPolicy attempts).')
+SKYTPU_JOBS_LAUNCH_RETRY_GAP = register(
+    'SKYTPU_JOBS_LAUNCH_RETRY_GAP',
+    'Initial backoff seconds between managed-job launch attempts.')
+SKYTPU_MAX_CONCURRENT_JOBS = register(
+    'SKYTPU_MAX_CONCURRENT_JOBS',
+    'Cap on simultaneously RUNNING managed jobs.')
+SKYTPU_HEARTBEAT_INTERVAL = register(
+    'SKYTPU_HEARTBEAT_INTERVAL',
+    'Seconds between jobs-controller liveness heartbeats.')
+
+# --------------------------------------------------------------- serve
+SKYTPU_SERVE_DB = register(
+    'SKYTPU_SERVE_DB', 'Path of the serve-state sqlite DB.')
+SKYTPU_SERVE_LOG_DIR = register(
+    'SKYTPU_SERVE_LOG_DIR', 'Directory for serve controller/LB logs.')
+SKYTPU_SERVE_PORT = register(
+    'SKYTPU_SERVE_PORT', 'Serve controller port override.')
+
+# ---------------------------------------------------------- API server
+SKYTPU_API_SERVER_ENDPOINT = register(
+    'SKYTPU_API_SERVER_ENDPOINT',
+    'URL of a remote API server; unset = local execution.')
+SKYTPU_REQUESTS_DB = register(
+    'SKYTPU_REQUESTS_DB', 'Path of the API-server requests sqlite DB.')
+SKYTPU_REQUESTS_LOG_DIR = register(
+    'SKYTPU_REQUESTS_LOG_DIR',
+    'Directory for per-request API-server logs.')
+
+# --------------------------------------------------------------- agent
+SKYTPU_AGENT_EVENT_INTERVAL = register(
+    'SKYTPU_AGENT_EVENT_INTERVAL',
+    'Seconds between agentd housekeeping events.')
+SKYTPU_WORKER_PROBE_INTERVAL = register(
+    'SKYTPU_WORKER_PROBE_INTERVAL',
+    'Seconds between gang-worker liveness probes (agent/driver.py).')
+SKYTPU_WORKER_PROBE_THRESHOLD = register(
+    'SKYTPU_WORKER_PROBE_THRESHOLD',
+    'Consecutive failed worker probes before a rank is declared lost.')
+SKYTPU_SETUP_NODE_RANK = register(
+    'SKYTPU_SETUP_NODE_RANK',
+    'Rank exposed to per-node setup commands.')
+
+# ----------------------------------------------------------- telemetry
+SKYTPU_TIMELINE_FILE_PATH = register(
+    'SKYTPU_TIMELINE_FILE_PATH',
+    'Write a Chrome-trace timeline of control-plane events here.')
+SKYTPU_PROFILER_PORT = register(
+    'SKYTPU_PROFILER_PORT',
+    'Start jax.profiler\'s gRPC server on every worker at this port.')
+SKYTPU_PROFILE_DIR = register(
+    'SKYTPU_PROFILE_DIR',
+    'Capture one jax.profiler trace of a train step into this dir.')
+SKYTPU_METRICS_DIR = register(
+    'SKYTPU_METRICS_DIR',
+    'Spool directory for cross-process metric snapshots '
+    '(docs/metrics.md).')
+SKYTPU_METRICS_TTL = register(
+    'SKYTPU_METRICS_TTL',
+    'Seconds before a spooled metrics snapshot ages out of scrapes.')
+SKYTPU_USAGE_COLLECTOR_URL = register(
+    'SKYTPU_USAGE_COLLECTOR_URL',
+    'Usage-report collector endpoint (unset = no reporting).')
+SKYTPU_USAGE_FLUSH_INTERVAL = register(
+    'SKYTPU_USAGE_FLUSH_INTERVAL',
+    'Seconds between usage-report flushes.')
+SKYTPU_DISABLE_USAGE = register(
+    'SKYTPU_DISABLE_USAGE', 'Set to 1 to disable usage reporting.')
+
+# ----------------------------------------------------------- benchmark
+SKYTPU_BENCHMARK_DB = register(
+    'SKYTPU_BENCHMARK_DB', 'Path of the benchmark sqlite DB.')
+SKYTPU_BENCHMARK_DIR = register(
+    'SKYTPU_BENCHMARK_DIR', 'Directory for benchmark artifacts.')
+
+# --------------------------------------------------------------- chaos
+SKYTPU_FAULT_PLAN = register(
+    'SKYTPU_FAULT_PLAN',
+    'Fault-injection plan: inline JSON or a path '
+    '(docs/fault_injection.md). Inherited by child processes.')
+
+# ------------------------------------------------------ docker / data
+SKYTPU_DOCKER_SERVER = register(
+    'SKYTPU_DOCKER_SERVER', 'Private registry server for task images.')
+SKYTPU_DOCKER_USERNAME = register(
+    'SKYTPU_DOCKER_USERNAME', 'Private registry login user.')
+SKYTPU_DOCKER_PASSWORD = register(
+    'SKYTPU_DOCKER_PASSWORD', 'Private registry login password.')
+SKYTPU_R2_MOUNT_TOOL = register(
+    'SKYTPU_R2_MOUNT_TOOL', 'Override the Cloudflare R2 mount binary.')
+
+# ------------------------------------------------------ kernels/models
+SKYTPU_FLASH_BLOCK_Q = register(
+    'SKYTPU_FLASH_BLOCK_Q', 'Flash-attention Q block size override.')
+SKYTPU_FLASH_BLOCK_K = register(
+    'SKYTPU_FLASH_BLOCK_K', 'Flash-attention K block size override.')
+SKYTPU_DECODE_ATTN = register(
+    'SKYTPU_DECODE_ATTN',
+    'Decode attention impl: paged | lax (models/inference.py).')
+SKYTPU_DECODE_PAGE = register(
+    'SKYTPU_DECODE_PAGE', 'Paged decode-attention page size (tokens).')
+
+# ------------------------------------------------- bench.py (BENCH_*)
+BENCH_SMOKE = register(
+    'BENCH_SMOKE',
+    'Set to 1: CPU backend + tiny configs so every bench mode '
+    'completes in seconds (CI smoke).')
+BENCH_MODE = register('BENCH_MODE', 'Bench mode to run (bench.py).')
+BENCH_ALL_MODES = register(
+    'BENCH_ALL_MODES', 'Comma-separated mode list for `bench.py all`.')
+BENCH_DEVICE_TIMEOUT = register(
+    'BENCH_DEVICE_TIMEOUT', 'Seconds to wait for TPU devices.')
+BENCH_MODEL = register('BENCH_MODEL', 'Train bench model preset.')
+BENCH_SEQ = register('BENCH_SEQ', 'Train bench sequence length.')
+BENCH_BATCH = register('BENCH_BATCH', 'Train bench global batch size.')
+BENCH_STEPS = register('BENCH_STEPS', 'Train bench step count.')
+BENCH_REMAT = register('BENCH_REMAT', 'Train bench remat policy.')
+BENCH_PARAM_DTYPE = register(
+    'BENCH_PARAM_DTYPE', 'Train bench parameter dtype.')
+BENCH_LOSS_CHUNK = register(
+    'BENCH_LOSS_CHUNK', 'Train bench chunked-loss vocab chunk size.')
+BENCH_CF = register(
+    'BENCH_CF', 'MoE capacity factor (MoE presets only).')
+BENCH_SERVE_MODEL = register(
+    'BENCH_SERVE_MODEL', 'Serve bench model preset.')
+BENCH_SERVE_BATCH = register(
+    'BENCH_SERVE_BATCH', 'Serve bench engine batch slots.')
+BENCH_SERVE_CHUNK = register(
+    'BENCH_SERVE_CHUNK', 'Serve bench prefill chunk size.')
+BENCH_SERVE_PROMPT = register(
+    'BENCH_SERVE_PROMPT', 'Serve bench prompt length.')
+BENCH_SERVE_MAX_NEW = register(
+    'BENCH_SERVE_MAX_NEW', 'Serve bench max new tokens per request.')
+BENCH_SERVE_REQUESTS = register(
+    'BENCH_SERVE_REQUESTS', 'Serve bench total request count.')
+BENCH_SERVE_CONCURRENCY = register(
+    'BENCH_SERVE_CONCURRENCY', 'Serve bench client concurrency.')
+BENCH_SERVE_QUANT = register(
+    'BENCH_SERVE_QUANT', 'Serve bench KV-cache quantization (int8).')
+BENCH_SERVE_WQUANT = register(
+    'BENCH_SERVE_WQUANT', 'Serve bench weight quantization (int8).')
+BENCH_SERVE_A8 = register(
+    'BENCH_SERVE_A8', 'Serve bench int8 activation matmuls.')
+BENCH_SERVE_MOE_DISPATCH = register(
+    'BENCH_SERVE_MOE_DISPATCH', 'Serve bench MoE dispatch impl.')
+BENCH_DECODE_MODEL = register(
+    'BENCH_DECODE_MODEL', 'Decode bench model preset.')
+BENCH_DECODE_BATCH = register(
+    'BENCH_DECODE_BATCH', 'Decode bench batch size.')
+BENCH_DECODE_CONTEXT = register(
+    'BENCH_DECODE_CONTEXT', 'Decode bench context length.')
+BENCH_DECODE_STEPS = register(
+    'BENCH_DECODE_STEPS', 'Decode bench decode-step count.')
+BENCH_DECODE_QUANT = register(
+    'BENCH_DECODE_QUANT', 'Decode bench KV quantization (int8).')
+BENCH_DECODE_WQUANT = register(
+    'BENCH_DECODE_WQUANT', 'Decode bench weight quantization (int8).')
+BENCH_DECODE_ATTN = register(
+    'BENCH_DECODE_ATTN', 'Decode bench attention impl: paged | lax.')
+BENCH_DECODE_PAGED = register(
+    'BENCH_DECODE_PAGED', 'Decode bench: force paged attention on/off.')
+BENCH_DECODE_PAGE = register(
+    'BENCH_DECODE_PAGE', 'Decode bench page size (tokens).')
+BENCH_DECODE_HEADROOM = register(
+    'BENCH_DECODE_HEADROOM', 'Decode bench extra page headroom.')

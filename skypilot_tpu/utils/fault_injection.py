@@ -63,8 +63,25 @@ import threading
 from typing import Any, Dict, List, Optional, Union
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.utils import env_registry
 
-FAULT_PLAN_ENV = 'SKYTPU_FAULT_PLAN'
+FAULT_PLAN_ENV = env_registry.SKYTPU_FAULT_PLAN
+
+# The site registry: every static site name (or fnmatch pattern, for
+# the provision router's generated ``provision.<cloud>.<op>`` names)
+# threaded through the stack. The static analyzer (rule STL007,
+# docs/static_analysis.md) cross-checks every literal poll/inject/
+# pending site against this tuple — a typo'd site would otherwise
+# make a chaos plan silently inert.
+KNOWN_SITES = (
+    'provision.*',  # provision/__init__.py router: <cloud>.<op>
+    'provisioner.post_provision_runtime_setup',
+    'command_runner.run',
+    'command_runner.ensure_tunnel',
+    'agent.worker_probe',
+    'jobs.controller.heartbeat',
+    'serve.replica.probe_ready',
+)
 
 # Chaos observability (docs/metrics.md): every injected fault counts
 # here, so chaos tests (and dashboards during a game day) can assert

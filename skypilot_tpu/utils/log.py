@@ -9,9 +9,10 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import sys
 import threading
+
+from skypilot_tpu.utils import env_registry
 
 _FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
 _DATE_FORMAT = '%m-%d %H:%M:%S'
@@ -21,9 +22,9 @@ _initialized = False
 
 
 def _env_level() -> int:
-    if os.environ.get('SKYTPU_DEBUG', '0') == '1':
+    if env_registry.is_enabled(env_registry.SKYTPU_DEBUG):
         return logging.DEBUG
-    if os.environ.get('SKYTPU_MINIMIZE_LOGGING', '0') == '1':
+    if env_registry.is_enabled(env_registry.SKYTPU_MINIMIZE_LOGGING):
         return logging.WARNING
     return logging.INFO
 

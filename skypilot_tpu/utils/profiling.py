@@ -18,12 +18,13 @@ import contextlib
 import os
 from typing import Iterator, Optional
 
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
 
-PROFILER_PORT_ENV = 'SKYTPU_PROFILER_PORT'
-PROFILE_DIR_ENV = 'SKYTPU_PROFILE_DIR'
+PROFILER_PORT_ENV = env_registry.SKYTPU_PROFILER_PORT
+PROFILE_DIR_ENV = env_registry.SKYTPU_PROFILE_DIR
 
 _server_started = False
 _traced_once = False

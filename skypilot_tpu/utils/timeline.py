@@ -15,7 +15,9 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
-_ENV = 'SKYTPU_TIMELINE_FILE_PATH'
+from skypilot_tpu.utils import env_registry
+
+_ENV = env_registry.SKYTPU_TIMELINE_FILE_PATH
 _events: List[dict] = []
 _lock = threading.Lock()
 _save_registered = False

@@ -1,0 +1,653 @@
+"""skytpu-lint tests (docs/static_analysis.md).
+
+Per rule: at least one fixture snippet that fires it and one that
+doesn't. Plus suppression semantics, baseline round-trip/partition,
+CLI behavior, the static registry extraction, and the tier-1 gate: a
+repo-wide run asserting zero non-baselined violations (with a <10 s
+runtime budget).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from skypilot_tpu.analysis import analyze_files
+from skypilot_tpu.analysis import analyze_source
+from skypilot_tpu.analysis import baseline as baseline_mod
+from skypilot_tpu.analysis import Project
+from skypilot_tpu.analysis import registries
+from skypilot_tpu.analysis.cli import default_targets
+from skypilot_tpu.analysis.cli import main as cli_main
+
+pytestmark = pytest.mark.analysis
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lint(src, path='skypilot_tpu/fixture.py', **project_kwargs):
+    return analyze_source(textwrap.dedent(src), path=path,
+                          project=Project(**project_kwargs))
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------- STL001
+class TestSwallowedException:
+
+    def test_fires_on_bare_except_pass(self):
+        vs = lint('''
+            try:
+                x = 1
+            except:
+                pass
+            ''')
+        assert rules_of(vs) == ['STL001']
+
+    def test_fires_on_except_exception_pass(self):
+        vs = lint('''
+            try:
+                x = 1
+            except Exception:
+                pass
+            ''')
+        assert rules_of(vs) == ['STL001']
+
+    def test_fires_on_broad_tuple(self):
+        # `except (Exception, ValueError): pass` is just as broad.
+        vs = lint('''
+            try:
+                x = 1
+            except (Exception, ValueError):
+                pass
+            ''')
+        assert rules_of(vs) == ['STL001']
+
+    def test_quiet_on_narrow_type(self):
+        assert lint('''
+            try:
+                x = 1
+            except OSError:
+                pass
+            ''') == []
+
+    def test_quiet_when_handled(self):
+        assert lint('''
+            try:
+                x = 1
+            except Exception as e:
+                print(e)
+            ''') == []
+
+
+# ---------------------------------------------------------------- STL002
+class TestHandRolledRetry:
+
+    def test_fires_on_sleep_try_loop(self):
+        vs = lint('''
+            import time
+            def f():
+                while True:
+                    try:
+                        return g()
+                    except ValueError:
+                        time.sleep(1)
+            ''')
+        assert rules_of(vs) == ['STL002']
+
+    def test_quiet_on_plain_poll_loop(self):
+        # sleep in a loop WITHOUT a try is a poll loop, not a retry.
+        assert lint('''
+            import time
+            def f():
+                while not ready():
+                    time.sleep(1)
+            ''') == []
+
+    def test_quiet_on_sleep_outside_loop(self):
+        assert lint('''
+            import time
+            def f():
+                try:
+                    g()
+                except ValueError:
+                    time.sleep(1)
+            ''') == []
+
+
+# ---------------------------------------------------------------- STL003
+class TestThreadDaemon:
+
+    def test_fires_without_daemon(self):
+        vs = lint('''
+            import threading
+            t = threading.Thread(target=print)
+            ''')
+        assert rules_of(vs) == ['STL003']
+
+    def test_quiet_with_daemon(self):
+        assert lint('''
+            import threading
+            a = threading.Thread(target=print, daemon=True)
+            b = threading.Thread(target=print, daemon=False)
+            ''') == []
+
+    def test_quiet_with_kwargs_splat(self):
+        assert lint('''
+            import threading
+            t = threading.Thread(**kw)
+            ''') == []
+
+
+# ---------------------------------------------------------------- STL004
+_THREADED_CLASS = '''
+    import threading
+    class Worker:
+        def __init__(self):
+            self.state = 0
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self.run, daemon=True)
+
+        def run(self):
+            {body}
+    '''
+
+
+class TestUnlockedSharedMutation:
+
+    def test_fires_on_unlocked_write(self):
+        vs = lint(_THREADED_CLASS.format(body='self.state = 1'))
+        assert rules_of(vs) == ['STL004']
+        assert 'self.state' in vs[0].message
+
+    def test_fires_on_subscript_and_augassign(self):
+        vs = lint(_THREADED_CLASS.format(body='self.state += 1'))
+        assert rules_of(vs) == ['STL004']
+
+    def test_quiet_under_lock(self):
+        assert lint(_THREADED_CLASS.format(
+            body='''
+            with self._lock:
+                self.state = 1''')) == []
+
+    def test_quiet_in_init(self):
+        # __init__ runs before any thread exists.
+        assert lint('''
+            import threading
+            class Worker:
+                def __init__(self):
+                    self.state = 0
+                    threading.Thread(target=print, daemon=True).start()
+            ''') == []
+
+    def test_quiet_in_threadless_class(self):
+        assert lint('''
+            class Plain:
+                def set(self):
+                    self.state = 1
+            ''') == []
+
+
+# ---------------------------------------------------------------- STL005
+class TestUndeclaredEnvVar:
+
+    def test_fires_on_undeclared_name(self):
+        vs = lint('''
+            import os
+            x = os.environ.get('SKYTPU_MYSTERY_KNOB')
+            ''', declared_env={'SKYTPU_KNOWN'})
+        assert rules_of(vs) == ['STL005']
+
+    def test_fires_on_bench_names_too(self):
+        vs = lint("import os\nos.getenv('BENCH_MYSTERY')\n",
+                  declared_env=set())
+        assert rules_of(vs) == ['STL005']
+
+    def test_quiet_on_declared_name(self):
+        assert lint('''
+            import os
+            x = os.environ.get('SKYTPU_KNOWN')
+            ''', declared_env={'SKYTPU_KNOWN'}) == []
+
+    def test_quiet_in_docstring(self):
+        assert lint('''
+            def f():
+                """Reads SKYTPU_UNDECLARED_BUT_ONLY_IN_PROSE."""
+                return 1
+            ''', declared_env=set()) == []
+
+    def test_quiet_in_registry_module_itself(self):
+        assert lint("X = 'SKYTPU_NEW_KNOB'\n",
+                    path='skypilot_tpu/utils/env_registry.py',
+                    declared_env=set()) == []
+
+    def test_repo_registries_declare_every_name_in_use(self):
+        # The real declared set covers conftest's isolation env vars
+        # (they must be real knobs, not typos).
+        declared = registries.declared_env_names()
+        for name in ('SKYTPU_STATE_DB', 'SKYTPU_JOBS_DB',
+                     'SKYTPU_FAULT_PLAN', 'SKYTPU_METRICS_DIR',
+                     'BENCH_SMOKE'):
+            assert name in declared, name
+
+    def test_runtime_registry_rejects_duplicates_and_bad_names(self):
+        from skypilot_tpu.utils import env_registry
+        with pytest.raises(ValueError):
+            env_registry.register('SKYTPU_DEBUG', 'dup')
+        with pytest.raises(ValueError):
+            env_registry.register('NOT_A_VALID_PREFIX', 'help')
+        with pytest.raises(ValueError):
+            env_registry.register('SKYTPU_NO_HELP', '  ')
+
+
+# ---------------------------------------------------------------- STL006
+class TestMetricRegistrationLint:
+
+    def test_fires_on_bad_name(self):
+        vs = lint('''
+            from skypilot_tpu import metrics as metrics_lib
+            _M = metrics_lib.counter('requests_total', 'help')
+            ''')
+        assert rules_of(vs) == ['STL006']
+
+    def test_fires_on_missing_help(self):
+        vs = lint('''
+            from skypilot_tpu import metrics as metrics_lib
+            _M = metrics_lib.gauge('skytpu_depth')
+            ''')
+        assert rules_of(vs) == ['STL006']
+
+    def test_fires_on_bad_label(self):
+        vs = lint('''
+            from skypilot_tpu import metrics as metrics_lib
+            _M = metrics_lib.histogram('skytpu_lat', 'help',
+                                       labels=('Replica-URL',))
+            ''')
+        assert rules_of(vs) == ['STL006']
+
+    def test_fires_on_cross_file_kind_conflict(self):
+        project = Project()
+        src1 = ('from skypilot_tpu import metrics as metrics_lib\n'
+                "_A = metrics_lib.counter('skytpu_x_total', 'help')\n")
+        src2 = ('from skypilot_tpu import metrics as metrics_lib\n'
+                "_B = metrics_lib.gauge('skytpu_x_total', 'help')\n")
+        analyze_source(src1, path='skypilot_tpu/a.py', project=project,
+                       finalize=False)
+        vs = analyze_source(src2, path='skypilot_tpu/b.py',
+                            project=project)
+        assert 'STL006' in rules_of(vs)
+
+    def test_positional_labels_match_keyword_labels(self):
+        # labels as the 3rd positional arg is the same registration
+        # as labels= — no false cross-file conflict, and label-name
+        # lint still applies.
+        project = Project()
+        analyze_source(
+            "from skypilot_tpu import metrics as metrics_lib\n"
+            "_A = metrics_lib.counter('skytpu_y_total', 'help', "
+            "('site',))\n",
+            path='skypilot_tpu/a.py', project=project, finalize=False)
+        vs = analyze_source(
+            "from skypilot_tpu import metrics as metrics_lib\n"
+            "_B = metrics_lib.counter('skytpu_y_total', 'help', "
+            "labels=('site',))\n",
+            path='skypilot_tpu/b.py', project=project)
+        assert vs == []
+        bad = lint('''
+            from skypilot_tpu import metrics as metrics_lib
+            _M = metrics_lib.counter('skytpu_z_total', 'help',
+                                     ('Bad-Label',))
+            ''')
+        assert rules_of(bad) == ['STL006']
+
+    def test_dynamic_labels_never_conflict_on_labels(self):
+        # A registration whose labels come from a variable is
+        # statically unknowable: no false conflict against the
+        # literal-labels form (kind mismatches still fire).
+        project = Project()
+        analyze_source(
+            "from skypilot_tpu import metrics as metrics_lib\n"
+            "_A = metrics_lib.counter('skytpu_d_total', 'help', "
+            "('service',))\n",
+            path='skypilot_tpu/a.py', project=project, finalize=False)
+        vs = analyze_source(
+            "from skypilot_tpu import metrics as metrics_lib\n"
+            "_LABELS = ('service',)\n"
+            "_B = metrics_lib.counter('skytpu_d_total', 'help', "
+            "labels=_LABELS)\n",
+            path='skypilot_tpu/b.py', project=project)
+        assert vs == []
+
+    def test_quiet_on_clean_registration(self):
+        assert lint('''
+            from skypilot_tpu import metrics as metrics_lib
+            _M = metrics_lib.counter(
+                'skytpu_requests_total', 'Requests served.',
+                labels=('service',))
+            ''') == []
+
+
+# ---------------------------------------------------------------- STL007
+class TestUnknownFaultSite:
+
+    def test_fires_on_unknown_site(self):
+        vs = lint('''
+            from skypilot_tpu.utils import fault_injection
+            fault_injection.inject('jobs.controller.hartbeat')
+            ''', declared_sites=['jobs.controller.heartbeat'])
+        assert rules_of(vs) == ['STL007']
+
+    def test_quiet_on_declared_site_and_pattern(self):
+        assert lint('''
+            from skypilot_tpu.utils import fault_injection
+            fault_injection.poll('jobs.controller.heartbeat')
+            fault_injection.inject('provision.gcp.run_instances')
+            ''', declared_sites=['jobs.controller.heartbeat',
+                                 'provision.*']) == []
+
+    def test_fires_on_duplicate_declaration(self):
+        vs = lint('x = 1\n', declared_sites=['a.site', 'a.site'])
+        assert rules_of(vs) == ['STL007']
+        assert 'declared more than once' in vs[0].message
+
+    def test_quiet_on_dynamic_site(self):
+        assert lint('''
+            from skypilot_tpu.utils import fault_injection
+            fault_injection.inject(f'provision.{cloud}.{op}')
+            ''', declared_sites=[]) == []
+
+    def test_repo_call_sites_match_registry(self):
+        # Every literal site in production code resolves against
+        # KNOWN_SITES as extracted statically.
+        sites = registries.declared_fault_sites()
+        assert 'jobs.controller.heartbeat' in sites
+        assert len(sites) == len(set(sites))
+
+    def test_known_sites_cover_runtime_plan_sites(self):
+        # The registry stays in sync with what the runtime docstring
+        # table promises (a canary for the next refactor).
+        from skypilot_tpu.utils import fault_injection
+        assert set(registries.declared_fault_sites()) == set(
+            fault_injection.KNOWN_SITES)
+
+
+# ---------------------------------------------------------------- STL008
+class TestJaxRecompileHazard:
+    PATH = 'skypilot_tpu/models/fixture.py'
+
+    def test_fires_on_np_call_in_jit(self):
+        vs = lint('''
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return np.sum(x)
+            ''', path=self.PATH)
+        assert rules_of(vs) == ['STL008']
+
+    def test_fires_on_if_on_traced_arg(self):
+        vs = lint('''
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            ''', path=self.PATH)
+        assert rules_of(vs) == ['STL008']
+
+    def test_fires_on_int_of_traced_arg(self):
+        vs = lint('''
+            import functools, jax
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def f(x, n):
+                return x[:int(n)]
+            ''', path=self.PATH)
+        assert rules_of(vs) == ['STL008']
+
+    def test_quiet_on_static_argnames(self):
+        assert lint('''
+            import functools, jax
+            @functools.partial(jax.jit, static_argnames=('n',))
+            def f(x, n):
+                if n > 2:
+                    return x
+                return range(n)
+            ''', path=self.PATH) == []
+
+    def test_quiet_on_static_argnums(self):
+        assert lint('''
+            import functools, jax
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, n):
+                if n:
+                    return x
+            ''', path=self.PATH) == []
+
+    def test_quiet_on_is_none_shape_isinstance(self):
+        assert lint('''
+            import jax
+            @jax.jit
+            def f(x, mask):
+                if mask is None:
+                    return x
+                if x.shape[0] > 2:
+                    return x
+                if isinstance(mask, tuple):
+                    return x
+                return x
+            ''', path=self.PATH) == []
+
+    def test_quiet_outside_scoped_dirs(self):
+        assert lint('''
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return np.sum(x)
+            ''', path='skypilot_tpu/serve/fixture.py') == []
+
+    def test_quiet_without_jit(self):
+        assert lint('''
+            import numpy as np
+            def f(x):
+                if x > 0:
+                    return np.sum(x)
+            ''', path=self.PATH) == []
+
+
+# ----------------------------------------------------------- suppression
+class TestSuppression:
+
+    def test_same_line(self):
+        assert lint('''
+            import threading
+            t = threading.Thread(target=print)  # skytpu-lint: disable=STL003
+            ''') == []
+
+    def test_comment_above_with_reason(self):
+        assert lint('''
+            import threading
+            # skytpu-lint: disable=STL003 — joined explicitly below.
+            t = threading.Thread(target=print)
+            ''') == []
+
+    def test_multiline_reason_comment(self):
+        assert lint('''
+            import threading
+            # skytpu-lint: disable=STL003 — a long justification that
+            # wraps across several comment lines before the statement.
+            t = threading.Thread(target=print)
+            ''') == []
+
+    def test_disable_all(self):
+        assert lint('''
+            import threading
+            t = threading.Thread(target=print)  # skytpu-lint: disable
+            ''') == []
+
+    def test_other_rule_not_suppressed(self):
+        vs = lint('''
+            import threading
+            t = threading.Thread(target=print)  # skytpu-lint: disable=STL001
+            ''')
+        assert rules_of(vs) == ['STL003']
+
+    def test_suppression_inside_except_body(self):
+        assert lint('''
+            try:
+                x = 1
+            except Exception:
+                # skytpu-lint: disable=STL001 — reason documented here.
+                pass
+            ''') == []
+
+
+# -------------------------------------------------------------- baseline
+class TestBaseline:
+
+    def _violations(self):
+        return lint('''
+            import threading
+            a = threading.Thread(target=print)
+            b = threading.Thread(target=print)
+            ''')
+
+    def test_round_trip(self, tmp_path):
+        vs = self._violations()
+        path = str(tmp_path / 'baseline.json')
+        baseline_mod.save(path, vs)
+        loaded = baseline_mod.load(path)
+        new, old, stale = baseline_mod.partition(vs, loaded)
+        assert new == [] and len(old) == 2 and stale == []
+
+    def test_extra_occurrence_is_new(self, tmp_path):
+        vs = self._violations()
+        path = str(tmp_path / 'baseline.json')
+        baseline_mod.save(path, vs[:1])
+        new, old, _ = baseline_mod.partition(vs, baseline_mod.load(path))
+        # Identical snippet at a second site exceeds the budget.
+        assert len(old) == 1 and len(new) == 1
+
+    def test_fixed_finding_goes_stale(self, tmp_path):
+        vs = self._violations()
+        path = str(tmp_path / 'baseline.json')
+        baseline_mod.save(path, vs)
+        new, old, stale = baseline_mod.partition(
+            vs[:1], baseline_mod.load(path))
+        assert new == [] and len(old) == 1 and len(stale) == 1
+
+    def test_fingerprint_survives_line_drift(self):
+        a = lint('import threading\n'
+                 't = threading.Thread(target=print)\n')
+        b = lint('import threading\n\n\n\n'
+                 't = threading.Thread(target=print)\n')
+        assert [v.fingerprint() for v in a] == \
+               [v.fingerprint() for v in b]
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert baseline_mod.load(str(tmp_path / 'nope.json')) == {}
+
+    def test_malformed_baseline_raises(self, tmp_path):
+        path = tmp_path / 'bad.json'
+        path.write_text('{"not_entries": 1}')
+        with pytest.raises(ValueError):
+            baseline_mod.load(str(path))
+
+
+# ------------------------------------------------------------------- CLI
+class TestCli:
+
+    def test_list_rules(self, capsys):
+        assert cli_main(['--list-rules']) == 0
+        out = capsys.readouterr().out
+        for rule_id in ('STL001', 'STL008'):
+            assert rule_id in out
+
+    def test_update_baseline_then_clean(self, tmp_path):
+        # Full-repo run against a scratch baseline: rewrite, then the
+        # follow-up run is clean against it.
+        baseline = str(tmp_path / 'b.json')
+        assert cli_main(['--baseline', baseline,
+                         '--update-baseline']) == 0
+        assert cli_main(['--baseline', baseline]) == 0
+
+    def test_update_baseline_rejects_partial_runs(self, tmp_path):
+        # A partial run must never rewrite the baseline: it would
+        # silently drop every entry for unvisited files.
+        target = tmp_path / 'mod.py'
+        target.write_text('import threading\n'
+                          't = threading.Thread(target=print)\n')
+        # Rejected up-front — even a clean tree must not exit 0 from
+        # `--changed --update-baseline` pretending it refreshed.
+        for argv in ([str(target), '--update-baseline'],
+                     ['--changed', '--update-baseline'],
+                     ['--no-baseline', '--update-baseline']):
+            with pytest.raises(SystemExit) as excinfo:
+                cli_main(argv)
+            assert excinfo.value.code == 2
+
+    def test_explicit_target_reports_without_baseline(self, tmp_path):
+        target = tmp_path / 'mod.py'
+        target.write_text('import threading\n'
+                          't = threading.Thread(target=print)\n')
+        assert cli_main([str(target), '--no-baseline']) == 1
+
+    def test_json_format(self, tmp_path, capsys):
+        target = tmp_path / 'mod.py'
+        target.write_text('import threading\n'
+                          't = threading.Thread(target=print)\n')
+        assert cli_main([str(target), '--no-baseline',
+                         '--format', 'json']) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert data['new'][0]['rule'] == 'STL003'
+
+    def test_module_invocation(self):
+        # `python -m skypilot_tpu.analysis --list-rules` (the
+        # documented entry point) works from the repo root.
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.analysis',
+             '--list-rules'],
+            cwd=_REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert 'STL001' in proc.stdout
+
+
+# ------------------------------------------------------- repo-wide gate
+class TestRepoGate:
+
+    def test_repo_runs_clean_against_baseline(self):
+        """Tier-1 gate: zero non-baselined violations, under budget."""
+        project = Project(
+            declared_env=registries.declared_env_names(),
+            declared_sites=registries.declared_fault_sites())
+        from skypilot_tpu.analysis.cli import _iter_py_files
+        start = time.monotonic()
+        violations = analyze_files(_iter_py_files(default_targets()),
+                                   project=project)
+        elapsed = time.monotonic() - start
+        baseline = baseline_mod.load(baseline_mod.DEFAULT_BASELINE_PATH)
+        new, _, stale = baseline_mod.partition(violations, baseline)
+        assert new == [], (
+            'new skytpu-lint violations (fix, suppress with a reason, '
+            'or re-baseline):\n' + '\n'.join(
+                f'{v.path}:{v.line}: {v.rule} {v.message}'
+                for v in new))
+        assert stale == [], (
+            f'stale baseline entries (run --update-baseline): {stale}')
+        assert elapsed < 10.0, (
+            f'analyzer took {elapsed:.1f}s (budget 10s)')
+
+    def test_baseline_entries_all_match_current_repo(self):
+        # Every committed baseline entry corresponds to a live,
+        # justified finding — the baseline never carries dead weight.
+        baseline = baseline_mod.load(baseline_mod.DEFAULT_BASELINE_PATH)
+        assert len(baseline) <= 8, (
+            'baseline grew beyond the justified set; fix or suppress '
+            'new findings instead of baselining them')
