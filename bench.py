@@ -29,6 +29,7 @@ decode tokens/s against the reference's JetStream serving baseline
 decode-MFU ratio (throughput x 2N flops/token, normalized by chip
 peak) so model size and chip generation cancel.
 """
+import contextlib
 import json
 import os
 import sys
@@ -44,6 +45,27 @@ _BASELINE_TOKENS_PER_SEC_PER_CHIP = 0.476 * 8192 / 8
 _BASELINE_FLOPS_PER_TOKEN = 6 * 8.03e9 + 6 * 32 * 8192 * 4096
 _BASELINE_MFU = (_BASELINE_TOKENS_PER_SEC_PER_CHIP *
                  _BASELINE_FLOPS_PER_TOKEN / 918e12)
+
+
+def _merged_trace_path():
+    """Merge this run's span spool into one Chrome-trace file and
+    return its path; None when SKYTPU_TRACE_DIR is unset. Bench
+    details carry it so a recorded round links straight to its
+    timeline (docs/tracing.md)."""
+    from skypilot_tpu import trace
+    if not trace.enabled():
+        return None
+    from skypilot_tpu.trace import export
+    return export.write_chrome()
+
+
+@contextlib.contextmanager
+def _bench_span(name, **attrs):
+    """Span around a bench's timed section (a no-op without
+    SKYTPU_TRACE_DIR)."""
+    from skypilot_tpu import trace
+    with trace.span(f'bench.{name}', slow_ok=True, **attrs):
+        yield
 
 
 def _count_params(cfg) -> int:
@@ -318,10 +340,12 @@ def decode_bench():
     cache, tok = run(params, cache, tok)
     _ = int(tok[0])
 
-    t0 = time.perf_counter()
-    cache, tok = run(params, cache, tok)
-    _ = int(tok[0])
-    dt = (time.perf_counter() - t0) / steps
+    with _bench_span('decode', batch=batch, context=context,
+                     steps=steps):
+        t0 = time.perf_counter()
+        cache, tok = run(params, cache, tok)
+        _ = int(tok[0])
+        dt = (time.perf_counter() - t0) / steps
 
     tok_s = batch / dt
     # MoE models normalize by ACTIVE params (same convention as the
@@ -357,6 +381,9 @@ def decode_bench():
             'baseline_decode_mfu_pct': round(base_mfu * 100, 2),
         },
     }
+    trace_file = _merged_trace_path()
+    if trace_file:
+        result['detail']['trace_file'] = trace_file
     print(json.dumps(result))
 
 
@@ -456,9 +483,11 @@ def serve_bench():
     # would double HBM, so warm the same one).
     engine.warmup()
 
-    t0 = time.perf_counter()
-    results = engine.run(reqs)
-    dt = time.perf_counter() - t0
+    with _bench_span('serve', requests=n_requests,
+                     batch_slots=batch):
+        t0 = time.perf_counter()
+        results = engine.run(reqs)
+        dt = time.perf_counter() - t0
     out_tokens = sum(len(r.tokens) for r in results.values())
     from skypilot_tpu import metrics as metrics_lib
     result = {
@@ -489,6 +518,9 @@ def serve_bench():
             'metrics': metrics_lib.summary(),
         },
     }
+    trace_file = _merged_trace_path()
+    if trace_file:
+        result['detail']['trace_file'] = trace_file
     print(json.dumps(result))
 
 
@@ -582,7 +614,9 @@ def serve_stack_bench():
         server.stop()
         return dt, sum(counts), latencies
 
-    dt, out_tokens, latencies = asyncio.run(run_bench())
+    with _bench_span('serve_stack', requests=n_requests,
+                     concurrency=concurrency):
+        dt, out_tokens, latencies = asyncio.run(run_bench())
     lat = sorted(latencies)
     from skypilot_tpu import metrics as metrics_lib
     result = {
@@ -609,6 +643,9 @@ def serve_stack_bench():
             'metrics': metrics_lib.summary(),
         },
     }
+    trace_file = _merged_trace_path()
+    if trace_file:
+        result['detail']['trace_file'] = trace_file
     print(json.dumps(result))
 
 
@@ -677,9 +714,15 @@ def all_bench():
     for name in names:
         env = {**base, 'BENCH_MODE': 'train', **_ALL_MODES[name]}
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=3000)
+            with _bench_span(name):
+                # The child bench continues this span's trace via
+                # SKYTPU_TRACE_CONTEXT (one merged trace per round).
+                from skypilot_tpu import trace as _trace
+                _trace.child_env(env)
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, capture_output=True, text=True,
+                    timeout=3000)
             lines = [ln for ln in proc.stdout.splitlines()
                      if ln.startswith('{')]
             if lines:
@@ -768,6 +811,10 @@ if __name__ == '__main__':
             # skytpu-lint: disable=STL001 — best-effort CPU pin; smoke
             # benches must start even if jax's backend is locked.
             pass
+    # Per-mode span-spool file names (bench.all children each get
+    # their own: spans-bench.<mode>-<pid>.jsonl).
+    from skypilot_tpu import trace as _trace_mod
+    _trace_mod.set_component(f'bench.{mode}')
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
     # same thing); other modes probe in-process.

@@ -27,6 +27,7 @@ from skypilot_tpu import authentication
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.agent import cli as agent_cli
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.backend import backend as backend_lib
@@ -150,10 +151,15 @@ class RetryingProvisioner:
                 continue
             yield region, zone
 
+    @trace_lib.span('provision.attempt', slow_ok=True)
     def _one_attempt(
             self, to_provision: 'resources_lib.Resources',
             num_nodes: int, region: str, zone: Optional[str]
     ) -> provision_common.ClusterInfo:
+        sp = trace_lib.current_span()
+        if sp is not None:
+            sp.set_attr(cluster=self._cluster_name, region=region,
+                        zone=zone or '*')
         cloud = to_provision.cloud
         deploy_vars = cloud.make_deploy_resources_variables(
             to_provision, self._cluster_name_on_cloud, region, zone)

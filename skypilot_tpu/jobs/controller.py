@@ -21,6 +21,7 @@ from skypilot_tpu import core
 from skypilot_tpu import exceptions
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import task as task_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.agent import job_lib as agent_job_lib
 from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.jobs import recovery_strategy
@@ -153,6 +154,26 @@ class JobsController:
         """Returns the terminal managed status for one launched attempt,
         or RECOVERING if the cluster was preempted."""
         missing_streak = 0
+        # Launch -> first-heartbeat span: the tail of the launch
+        # timeline a provision trace cannot see (agent boot, job
+        # pickup) — finished the first time the on-cluster job is
+        # visible at all (docs/tracing.md). The try/finally keeps the
+        # span in the trace even when cancellation or preemption
+        # strikes before the job is ever seen — exactly the case a
+        # recovery timeline needs.
+        hb_span = trace_lib.start_span(
+            'jobs.controller.first_heartbeat', slow_ok=True,
+            job=str(self.job_id))
+        try:
+            return self._monitor_loop(cluster_job_id, hb_span,
+                                      missing_streak)
+        finally:
+            if hb_span.end_time is None:
+                hb_span.finish(status='never_seen')
+
+    def _monitor_loop(self, cluster_job_id: int,
+                      hb_span: 'trace_lib.Span',
+                      missing_streak: int) -> state.ManagedJobStatus:
         while True:
             time.sleep(self.check_gap)
             metrics_lib.dump_snapshot(f'jobs.controller.{self.job_id}')
@@ -161,6 +182,8 @@ class JobsController:
             job_status = self._job_status(cluster_job_id)
             if job_status is not None:
                 missing_streak = 0
+                if hb_span.end_time is None:
+                    hb_span.finish(status=job_status.value)
             if job_status == agent_job_lib.JobStatus.RUNNING:
                 self._maybe_inject_chaos()
             if job_status == agent_job_lib.JobStatus.SUCCEEDED:
@@ -235,7 +258,10 @@ class JobsController:
                              state.ManagedJobStatus.CANCELLED)
             return state.ManagedJobStatus.CANCELLED
         try:
-            cluster_job_id = self.strategy.launch()
+            with trace_lib.span('jobs.controller.launch',
+                                slow_ok=True, job=str(self.job_id),
+                                cluster=self.cluster_name):
+                cluster_job_id = self.strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
             state.set_status(self.job_id,
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,
@@ -307,8 +333,13 @@ class JobsController:
             try:
                 # A restart follows a USER failure on healthy infra:
                 # relaunch without blocking the (healthy) region.
-                cluster_job_id = (self.strategy.restart() if is_restart
-                                  else self.strategy.recover())
+                with trace_lib.span(
+                        'jobs.controller.recover', slow_ok=True,
+                        job=str(self.job_id), attempt=n,
+                        kind='restart' if is_restart else 'preemption'):
+                    cluster_job_id = (self.strategy.restart()
+                                      if is_restart
+                                      else self.strategy.recover())
             except exceptions.ResourcesUnavailableError as e:
                 state.set_status(
                     self.job_id,
@@ -327,9 +358,15 @@ def main() -> None:
                         default=JOB_STATUS_CHECK_GAP_SECONDS)
     args = parser.parse_args()
     import os
+    trace_lib.set_component(f'jobs.controller.{args.job_id}')
     state.set_controller_pid(args.job_id, os.getpid())
     try:
-        JobsController(args.job_id, check_gap=args.check_gap).run()
+        # The controller's root span: parents under the submitting
+        # process's jobs.submit span via SKYTPU_TRACE_CONTEXT, so one
+        # trace id covers submit -> launch -> provision -> recovery.
+        with trace_lib.span('jobs.controller', slow_ok=True,
+                            job=str(args.job_id)):
+            JobsController(args.job_id, check_gap=args.check_gap).run()
     except Exception as e:  # pylint: disable=broad-except
         logger.error('Controller crashed:\n%s', traceback.format_exc())
         state.set_status(args.job_id,

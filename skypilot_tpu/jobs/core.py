@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Union
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import env_registry
@@ -126,6 +127,9 @@ def _submit_to_controller_cluster(job_id: int,
     for key in _CONTROLLER_ENV_PASSTHROUGH:
         if os.environ.get(key):
             envs[key] = os.environ[key]
+    # Continue the submit trace into the remote controller process
+    # (SKYTPU_TRACE_CONTEXT + the trace knobs, docs/tracing.md).
+    trace_lib.child_env(envs)
     controller_task = task_lib.Task(f'jobs-ctl-{job_id}', run=cmd,
                                     envs=envs)
     cluster_job_id, _ = execution.exec_(controller_task,
@@ -153,61 +157,76 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
         tasks = [entrypoint]
     task = tasks[0]
     job_name = name or task.name or 'managed'
-    cluster_name = (f'{job_name}-{common_utils.generate_run_id(4)}')
-    log_dir = _log_dir()
-    os.makedirs(log_dir, exist_ok=True)
+    # One span per submission; the spawned controller inherits its
+    # context via SKYTPU_TRACE_CONTEXT (trace.child_env below), so a
+    # managed job's whole launch -> provision -> recovery history
+    # shares this trace id (docs/tracing.md).
+    with trace_lib.span('jobs.submit', slow_ok=True,
+                        job_name=job_name) as submit_span:
+        cluster_name = (f'{job_name}-{common_utils.generate_run_id(4)}')
+        log_dir = _log_dir()
+        os.makedirs(log_dir, exist_ok=True)
 
-    from skypilot_tpu import usage
-    usage.record_event('jobs.launch',
-                       use_spot=any(r.use_spot for r in task.resources))
-    # dag_json is a LIST of task configs: one task = [config], a chain
-    # pipeline = its tasks in topological order, each run on its own
-    # cluster by the controller (reference jobs run chain dags the
-    # same way, sky/jobs/controller.py:371 iterating dag.tasks).
-    job_id = state.add_job(
-        name=job_name,
-        task_yaml='',
-        cluster_name=cluster_name,
-        log_path='',  # id-dependent; recorded just below
-        dag_json=json.dumps([t.to_yaml_config() for t in tasks]))
-    log_path = os.path.join(log_dir, f'{job_id}-{job_name}.log')
-    state.set_log_path(job_id, log_path)
-    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+        from skypilot_tpu import usage
+        usage.record_event(
+            'jobs.launch',
+            use_spot=any(r.use_spot for r in task.resources))
+        # dag_json is a LIST of task configs: one task = [config], a
+        # chain pipeline = its tasks in topological order, each run on
+        # its own cluster by the controller (reference jobs run chain
+        # dags the same way, sky/jobs/controller.py:371 iterating
+        # dag.tasks).
+        job_id = state.add_job(
+            name=job_name,
+            task_yaml='',
+            cluster_name=cluster_name,
+            log_path='',  # id-dependent; recorded just below
+            dag_json=json.dumps([t.to_yaml_config() for t in tasks]))
+        if submit_span is not None:
+            submit_span.set_attr(job=job_id)
+        log_path = os.path.join(log_dir, f'{job_id}-{job_name}.log')
+        state.set_log_path(job_id, log_path)
+        state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
 
-    if on_controller is None:
-        from skypilot_tpu import skypilot_config
-        on_controller = bool(
-            skypilot_config.get_nested(('jobs', 'controller', 'enabled'),
-                                       default_value=False))
-    if on_controller:
-        _submit_to_controller_cluster(job_id, controller_check_gap)
+        if on_controller is None:
+            from skypilot_tpu import skypilot_config
+            on_controller = bool(
+                skypilot_config.get_nested(
+                    ('jobs', 'controller', 'enabled'),
+                    default_value=False))
+        if on_controller:
+            _submit_to_controller_cluster(job_id, controller_check_gap)
+            return job_id
+
+        cmd = [
+            sys.executable, '-u', '-m', state.CONTROLLER_MODULE,
+            str(job_id)
+        ]
+        if controller_check_gap is not None:
+            cmd += ['--check-gap', str(controller_check_gap)]
+        env = dict(os.environ)
+        # The detached controller continues this trace: its root span
+        # parents under jobs.submit via SKYTPU_TRACE_CONTEXT.
+        trace_lib.child_env(env)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        existing = env.get('PYTHONPATH', '')
+        if repo_root not in existing.split(os.pathsep):
+            env['PYTHONPATH'] = repo_root + (os.pathsep + existing
+                                             if existing else '')
+        with open(log_path, 'ab') as log_f:
+            proc = subprocess.Popen(cmd,
+                                    stdout=log_f,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True,
+                                    env=env)
+        state.set_controller_pid(job_id, proc.pid)
+        logger.info(
+            'Managed job %d submitted (controller pid %d); logs: %s',
+            job_id, proc.pid, log_path)
+        if not detach:
+            proc.wait()
         return job_id
-
-    cmd = [
-        sys.executable, '-u', '-m', state.CONTROLLER_MODULE,
-        str(job_id)
-    ]
-    if controller_check_gap is not None:
-        cmd += ['--check-gap', str(controller_check_gap)]
-    env = dict(os.environ)
-    repo_root = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    existing = env.get('PYTHONPATH', '')
-    if repo_root not in existing.split(os.pathsep):
-        env['PYTHONPATH'] = repo_root + (os.pathsep + existing
-                                         if existing else '')
-    with open(log_path, 'ab') as log_f:
-        proc = subprocess.Popen(cmd,
-                                stdout=log_f,
-                                stderr=subprocess.STDOUT,
-                                start_new_session=True,
-                                env=env)
-    state.set_controller_pid(job_id, proc.pid)
-    logger.info('Managed job %d submitted (controller pid %d); logs: %s',
-                job_id, proc.pid, log_path)
-    if not detach:
-        proc.wait()
-    return job_id
 
 
 def queue(refresh: bool = True) -> List[Dict[str, Any]]:

@@ -238,10 +238,19 @@ class Histogram(Metric):
 
     @staticmethod
     def _copy_state(state: Dict[str, Any]) -> Dict[str, Any]:
-        return {'counts': list(state['counts']),
-                'sum': state['sum'], 'count': state['count']}
+        out = {'counts': list(state['counts']),
+               'sum': state['sum'], 'count': state['count']}
+        if 'exemplar' in state:
+            out['exemplar'] = dict(state['exemplar'])
+        return out
 
-    def observe(self, value: float, **labels: Any) -> None:
+    def observe(self, value: float, *, exemplar: Optional[str] = None,
+                **labels: Any) -> None:
+        """Record one observation. ``exemplar`` (a trace id, see
+        docs/tracing.md) links the series to a concrete trace:
+        last-write-wins per series, carried through families()/the
+        snapshot spool, and deliberately NOT rendered in the 0.0.4
+        text exposition (the format predates exemplars)."""
         key = self._key(labels)
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
@@ -249,6 +258,9 @@ class Histogram(Metric):
             state['counts'][idx] += 1
             state['sum'] += value
             state['count'] += 1
+            if exemplar:
+                state['exemplar'] = {'trace_id': str(exemplar),
+                                     'value': float(value)}
 
 
 class Registry:
@@ -417,6 +429,10 @@ def merge_families(base: Dict[str, Dict[str, Any]],
                                   zip(have['counts'], s['counts'])]
                 have['sum'] += s['sum']
                 have['count'] += s['count']
+                if isinstance(s.get('exemplar'), dict):
+                    # Exemplars are point samples, not additive:
+                    # latest merged snapshot wins.
+                    have['exemplar'] = dict(s['exemplar'])
             else:
                 have['value'] = have.get('value', 0.0) + s['value']
 

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.models import inference
 from skypilot_tpu.models.llama import LlamaConfig
 
@@ -236,6 +237,14 @@ class ServingEngine:
         self.slots: List[Optional[_SlotState]] = [None] * batch_size
         self.results: Dict[Any, Result] = {}
         self._submitted_at: Dict[Any, float] = {}
+        # Per-request span state (docs/tracing.md), populated only
+        # when tracing is enabled at submit() and the engine is not
+        # warming: {'request', 'queue', 'prefill', 'first_chunk'}
+        # spans keyed by request_id. These decompose TTFT —
+        # queue-wait, prefill dispatch, first-chunk decode — and the
+        # request span's start is the single timing source the TTFT
+        # histogram observes (with the trace id as exemplar).
+        self._req_spans: Dict[Any, Dict[str, Any]] = {}
         self._key = jax.random.PRNGKey(0)
         self._steps_done = 0
         self._epoch = 0
@@ -446,6 +455,21 @@ class ServingEngine:
                 f'max_new ({request.max_new}) exceeds the decode '
                 f'capacity ({self.decode_capacity()}); raise max_seq.')
         self._submitted_at[request.request_id] = time.time()
+        if not self._warming and trace_lib.enabled():
+            # Parent = the ambient span of the submitting thread (the
+            # HTTP handler's http.generate span) or the inherited
+            # process context; spans then live across driver-loop
+            # ticks keyed by request_id, since no call stack connects
+            # submit to the first decoded token.
+            req_span = trace_lib.start_span(
+                'engine.request', request_id=str(request.request_id),
+                prompt_len=len(request.tokens),
+                max_new=request.max_new)
+            self._req_spans[request.request_id] = {
+                'request': req_span,
+                'queue': trace_lib.start_span('engine.queue_wait',
+                                              parent=req_span),
+            }
         self.queue.append(request)
         if not self._warming:
             _M_REQUESTS.inc()
@@ -530,6 +554,17 @@ class ServingEngine:
                  else self.temperature) for _, req in padded
             ], np.float32)
             self._key, sub = jax.random.split(self._key)
+            # TTFT decomposition: queue-wait ends exactly where the
+            # prefill dispatch begins (no gap between the spans).
+            for _, req in items:
+                ts = self._req_spans.get(req.request_id)
+                if ts is not None:
+                    qs = ts.pop('queue', None)
+                    if qs is not None:
+                        qs.finish()
+                    ts['prefill'] = trace_lib.start_span(
+                        'engine.prefill', parent=ts['request'],
+                        bucket=bucket)
             # Fully async: the prefill-sampled first tokens land in
             # the device-resident token vector for the next decode
             # chunk; the host-side values (for emission) sync lazily
@@ -545,6 +580,17 @@ class ServingEngine:
                     generated=[], first_ref=(firsts, j),
                     prompt_len=len(req.tokens), epoch=self._epoch)
                 self._temps[slot_idx] = temps[j]
+                ts = self._req_spans.get(req.request_id)
+                if ts is not None:
+                    ps = ts.pop('prefill', None)
+                    if ps is not None:
+                        # Host-side dispatch window: the device-side
+                        # prefill completion is folded into the
+                        # first-chunk span that starts here.
+                        ps.finish(slot=slot_idx)
+                    ts['first_chunk'] = trace_lib.start_span(
+                        'engine.decode.first_chunk',
+                        parent=ts['request'], slot=slot_idx)
 
     def _finish(self, slot_idx: int) -> None:
         state = self.slots[slot_idx]
@@ -555,6 +601,16 @@ class ServingEngine:
             prompt_len=state.prompt_len,
             submitted_at=self._submitted_at.pop(state.request_id, 0.0),
             finished_at=finished_at)
+        ts = self._req_spans.pop(state.request_id, None)
+        if ts is not None:
+            # A request can finish without ever surfacing a first
+            # token through the normal path (e.g. max_new reached in
+            # the same chunk): close any stragglers before the root.
+            for name in ('queue', 'prefill', 'first_chunk'):
+                sp = ts.pop(name, None)
+                if sp is not None:
+                    sp.finish()
+            ts['request'].finish(tokens=len(state.generated))
         self.slots[slot_idx] = None
 
     def _is_done(self, state: _SlotState) -> bool:
@@ -666,8 +722,21 @@ class ServingEngine:
                 fresh.append(int(host[j]))
                 emitted += 1
                 if not self._warming:
-                    _M_TTFT.observe(now - self._submitted_at.get(
-                        state.request_id, now))
+                    # Single timing source: with tracing on, TTFT is
+                    # the request span's age at first token — exactly
+                    # what the span tree decomposes — and the trace
+                    # id rides on the histogram as an exemplar.
+                    ts = self._req_spans.get(state.request_id)
+                    if ts is not None:
+                        fc = ts.pop('first_chunk', None)
+                        if fc is not None:
+                            fc.finish()
+                        _M_TTFT.observe(
+                            now - ts['request'].start_time,
+                            exemplar=ts['request'].exemplar)
+                    else:
+                        _M_TTFT.observe(now - self._submitted_at.get(
+                            state.request_id, now))
             if not self._is_done(state):
                 for t in range(entry['n']):
                     tok = int(toks_host[t, slot_idx])

@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -44,6 +45,13 @@ logger = sky_logging.init_logger(__name__)
 _M_REJECTS = metrics_lib.counter(
     'skytpu_engine_rejects_total',
     'Generate requests shed with HTTP 429 (pending queue full).')
+
+
+def _rid_headers(req_id: str) -> Dict[str, str]:
+    """Echo headers: every /generate response — success, 400, 429,
+    503 — carries the request's X-Request-ID so clients and the LB
+    can correlate logs without parsing bodies."""
+    return {trace_lib.REQUEST_ID_HEADER: req_id}
 
 
 class EngineServer:
@@ -152,11 +160,13 @@ class EngineServer:
         self._loop.call_soon_threadsafe(fail_all)
 
     # ------------------------------------------------------------ http
-    def _overloaded_response(self) -> Optional[web.Response]:
+    def _overloaded_response(self, req_id: str
+                             ) -> Optional[web.Response]:
         """429 + Retry-After when the pending queue is full, else
         None. Host-side only (safe pre-warmup); checked before the
         readiness gate so a warming replica still sheds queue
-        overflow instead of 503-ing it ambiguously."""
+        overflow instead of 503-ing it ambiguously. The reject echoes
+        the request id so a shed request stays correlatable."""
         if self.max_pending is None:
             return None
         with self._lock:
@@ -169,10 +179,15 @@ class EngineServer:
                            max(1, getattr(self.engine, 'batch_size',
                                           1))))
         _M_REJECTS.inc()
+        logger.warning('Shedding /generate (pending=%d) request=%s '
+                       'trace=%s', depth, req_id,
+                       trace_lib.current_trace_id())
         return web.json_response(
             {'error': 'server overloaded: pending queue is full',
-             'pending': depth, 'max_pending': self.max_pending},
-            status=429, headers={'Retry-After': str(retry)})
+             'pending': depth, 'max_pending': self.max_pending,
+             'request_id': req_id},
+            status=429, headers={'Retry-After': str(retry),
+                                 **_rid_headers(req_id)})
 
     @staticmethod
     def _parse_generate(body: Any) -> tuple:
@@ -200,10 +215,25 @@ class EngineServer:
 
     async def handle_generate(self, request: web.Request
                               ) -> web.StreamResponse:
+        # Correlation surface (docs/tracing.md): accept (or mint) an
+        # X-Request-ID echoed on every response, and continue the
+        # caller's trace from its traceparent header — the request
+        # span parents under the LB's proxy span, and the engine's
+        # TTFT-decomposition spans parent under this one.
+        req_id = (request.headers.get(trace_lib.REQUEST_ID_HEADER) or
+                  trace_lib.new_request_id())
+        ctx = trace_lib.context_from_headers(request.headers)
+        with trace_lib.span('http.generate', parent=ctx,
+                            request_id=req_id):
+            return await self._handle_generate(request, req_id)
+
+    async def _handle_generate(self, request: web.Request,
+                               req_id: str) -> web.StreamResponse:
         from skypilot_tpu.models.serving_engine import Request
         if self._dead is not None:
             return web.json_response(
-                {'error': f'engine dead: {self._dead}'}, status=503)
+                {'error': f'engine dead: {self._dead}'}, status=503,
+                headers=_rid_headers(req_id))
         try:
             body = await request.json()
             tokens, max_new, temperature, stream = \
@@ -219,20 +249,22 @@ class EngineServer:
                     f'max_new ({max_new}) exceeds the decode '
                     f'capacity ({self.engine.decode_capacity()}).')
         except (ValueError, UnicodeDecodeError) as e:
-            return web.json_response({'error': str(e)}, status=400)
-        overloaded = self._overloaded_response()
+            return web.json_response({'error': str(e)}, status=400,
+                                     headers=_rid_headers(req_id))
+        overloaded = self._overloaded_response(req_id)
         if overloaded is not None:
             return overloaded
         if not self._ready.is_set():
             # Requests submitted during warmup would be drained by
             # warmup's own run() and silently lost.
-            return web.json_response({'status': 'warming'}, status=503)
+            return web.json_response({'status': 'warming'}, status=503,
+                                     headers=_rid_headers(req_id))
         with self._lock:
             rid = self._next_id
             self._next_id += 1
         if stream:
             return await self._generate_stream(
-                request, rid, tokens, max_new, temperature)
+                request, rid, req_id, tokens, max_new, temperature)
         fut = asyncio.get_event_loop().create_future()
         # skytpu-lint: disable=STL004 — _futures is mutated and
         # iterated only on the event-loop thread (fail_all runs via
@@ -244,7 +276,8 @@ class EngineServer:
                                            temperature=temperature))
         except ValueError as e:
             self._futures.pop(rid, None)
-            return web.json_response({'error': str(e)}, status=400)
+            return web.json_response({'error': str(e)}, status=400,
+                                     headers=_rid_headers(req_id))
         if self._dead is not None:
             # The engine died between the entry check and our future
             # registration (both on the loop thread, but the body
@@ -252,16 +285,19 @@ class EngineServer:
             # _futures, so this future would hang forever.
             self._futures.pop(rid, None)
             return web.json_response(
-                {'error': f'engine dead: {self._dead}'}, status=503)
+                {'error': f'engine dead: {self._dead}'}, status=503,
+                headers=_rid_headers(req_id))
         result = await fut
-        return web.json_response({
-            'tokens': result.tokens,
-            'latency_s': result.finished_at - result.submitted_at,
-        })
+        return web.json_response(
+            {
+                'tokens': result.tokens,
+                'latency_s': result.finished_at - result.submitted_at,
+            },
+            headers=_rid_headers(req_id))
 
     async def _generate_stream(self, request: web.Request, rid: Any,
-                               tokens, max_new, temperature
-                               ) -> web.StreamResponse:
+                               req_id: str, tokens, max_new,
+                               temperature) -> web.StreamResponse:
         """SSE: one ``data:`` event per decode chunk, then ``done``."""
         from skypilot_tpu.models.serving_engine import Request
         q: asyncio.Queue = asyncio.Queue()
@@ -274,17 +310,20 @@ class EngineServer:
                                            temperature=temperature))
         except ValueError as e:
             self._streams.pop(rid, None)
-            return web.json_response({'error': str(e)}, status=400)
+            return web.json_response({'error': str(e)}, status=400,
+                                     headers=_rid_headers(req_id))
         if self._dead is not None:
             # Same race as the non-streaming path: registered after
             # fail_all swept the stream registry -> would hang.
             self._streams.pop(rid, None)
             return web.json_response(
-                {'error': f'engine dead: {self._dead}'}, status=503)
+                {'error': f'engine dead: {self._dead}'}, status=503,
+                headers=_rid_headers(req_id))
         resp = web.StreamResponse(headers={
             'Content-Type': 'text/event-stream',
             'Cache-Control': 'no-cache',
             'X-Accel-Buffering': 'no',
+            **_rid_headers(req_id),
         })
         await resp.prepare(request)
         try:
@@ -485,6 +524,8 @@ def main() -> None:
                         '<= 0 means unbounded.')
     args = parser.parse_args()
 
+    # Name this replica's span-spool file (docs/tracing.md).
+    trace_lib.set_component(f'engine.{args.port}')
     server = EngineServer(
         _build_engine(args),
         max_pending=(args.max_pending if args.max_pending > 0

@@ -12,10 +12,10 @@ import functools
 import importlib
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
-from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
 
@@ -26,23 +26,29 @@ def _route(op_name: str):
     def decorator(stub):
 
         @functools.wraps(stub)
-        @timeline.event(name=f'provision.{op_name}')
         def wrapper(provider_name: str, *args, **kwargs):
-            # Chaos site for every provider op, e.g.
-            # `provision.local.run_instances` — a fired fault raises
-            # the typed error (quota/stockout/...) the failover
-            # machinery dispatches on.
-            fault_injection.inject(
-                f'provision.{provider_name}.{op_name}',
-                provider=provider_name)
-            module = importlib.import_module(
-                f'skypilot_tpu.provision.{provider_name}.instance')
-            impl = getattr(module, op_name, None)
-            if impl is None:
-                raise NotImplementedError(
-                    f'Provider {provider_name!r} does not implement '
-                    f'{op_name}()')
-            return impl(*args, **kwargs)
+            # One span per provider op, named exactly like the chaos
+            # site (`provision.local.run_instances`): a launch trace
+            # decomposes into the same vocabulary fault plans and
+            # docs already use, and an injected fault's record
+            # carries this span's trace id.
+            with trace_lib.span(
+                    f'provision.{provider_name}.{op_name}',
+                    slow_ok=True):
+                # Chaos site for every provider op — a fired fault
+                # raises the typed error (quota/stockout/...) the
+                # failover machinery dispatches on.
+                fault_injection.inject(
+                    f'provision.{provider_name}.{op_name}',
+                    provider=provider_name)
+                module = importlib.import_module(
+                    f'skypilot_tpu.provision.{provider_name}.instance')
+                impl = getattr(module, op_name, None)
+                if impl is None:
+                    raise NotImplementedError(
+                        f'Provider {provider_name!r} does not '
+                        f'implement {op_name}()')
+                return impl(*args, **kwargs)
 
         return wrapper
 

@@ -18,18 +18,18 @@ from typing import Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.agent import constants as agent_constants
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import subprocess_utils
-from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
 
 
-@timeline.event
+@trace_lib.span('provisioner.bulk_provision', slow_ok=True)
 def bulk_provision(config: common.ProvisionConfig
                    ) -> common.ProvisionRecord:
     """One provisioning attempt against one (region, zone)."""
@@ -225,7 +225,8 @@ def start_agent_on_head(head_runner: runner_lib.CommandRunner,
                     check=True)
 
 
-@timeline.event
+@trace_lib.span('provisioner.post_provision_runtime_setup',
+                slow_ok=True)
 def post_provision_runtime_setup(
         cluster_info: common.ClusterInfo,
         ssh_private_key: Optional[str],

@@ -176,6 +176,8 @@ def main() -> None:
                         help='Preferred LB port; 0 = OS-assigned. The '
                         'bound port is written back to serve_state.')
     args = parser.parse_args()
+    from skypilot_tpu import trace as trace_lib
+    trace_lib.set_component(f'serve.{args.service_name}')
     serve_state.set_service_controller_pid(args.service_name,
                                            os.getpid())
     controller = ServeController(args.service_name,

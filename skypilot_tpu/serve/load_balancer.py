@@ -29,6 +29,7 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -190,28 +191,46 @@ class LoadBalancer:
 
     # ------------------------------------------------------------------
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        # One request span per proxied call, continuing the client's
+        # trace when it sent a traceparent header (docs/tracing.md);
+        # each replica attempt is a child span whose duration IS the
+        # per-replica latency observation (single timing source), and
+        # whose trace id rides on the histogram as an exemplar.
+        ctx = trace_lib.context_from_headers(request.headers)
+        with trace_lib.span('lb.request', parent=ctx,
+                            method=request.method,
+                            path=request.rel_url.path):
+            return await self._proxy_attempts(request)
+
+    async def _proxy_attempts(self, request: web.Request
+                              ) -> web.StreamResponse:
         if self.on_request is not None:
             self.on_request()
         body = await request.read()
         tried: Set[str] = set()
         last_err: Optional[BaseException] = None
+        trace_id = trace_lib.current_trace_id()
         for _ in range(self.MAX_ATTEMPTS):
             url = self.policy.pick(exclude=tried | self._draining)
             if url is None:
                 break
             tried.add(url)
-            started_at = time.time()
+            sp = trace_lib.start_span('lb.proxy', replica=url)
             try:
-                resp = await self._proxy_once(request, url, body)
-                _M_LATENCY.observe(time.time() - started_at,
+                with trace_lib.activate(sp):
+                    resp = await self._proxy_once(request, url, body)
+                sp.finish(status=resp.status)
+                _M_LATENCY.observe(sp.duration, exemplar=sp.exemplar,
                                    replica=url)
                 return resp
             except aiohttp.ClientConnectorError as e:
                 # TCP connect failed: the replica NEVER received the
                 # request — safe to retry on another replica for any
                 # method.
+                sp.finish(error='connect')
                 logger.warning('Replica %s unreachable (%s); retrying '
-                               'on another replica', url, e)
+                               'on another replica (trace=%s)', url, e,
+                               trace_id)
                 _M_ERRORS.inc(1, replica=url, kind='connect')
                 last_err = e
             except aiohttp.ClientConnectionError as e:
@@ -219,24 +238,29 @@ class LoadBalancer:
                 # ServerDisconnectedError): the replica may have
                 # started executing it. Retrying would double-execute
                 # non-idempotent work, so only safe methods retry.
+                sp.finish(error='disconnect')
                 _M_ERRORS.inc(1, replica=url, kind='disconnect')
                 if request.method not in ('GET', 'HEAD', 'OPTIONS'):
                     logger.warning('Replica %s dropped mid-request '
-                                   '(%s); not retrying %s', url, e,
-                                   request.method)
+                                   '(%s); not retrying %s (trace=%s)',
+                                   url, e, request.method, trace_id)
                     last_err = e
                     break
-                logger.warning('Replica %s dropped %s (%s); retrying',
-                               url, request.method, e)
+                logger.warning('Replica %s dropped %s (%s); retrying '
+                               '(trace=%s)', url, request.method, e,
+                               trace_id)
                 last_err = e
             except _MidStreamError as e:
                 # Bytes already reached the client: cannot retry.
-                logger.warning('Replica %s died mid-response: %s', url,
-                               e.cause)
+                sp.finish(error='mid_stream')
+                logger.warning('Replica %s died mid-response: %s '
+                               '(trace=%s)', url, e.cause, trace_id)
                 _M_ERRORS.inc(1, replica=url, kind='mid_stream')
                 return e.response
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                logger.warning('Proxy to %s failed: %s', url, e)
+                sp.finish(error='upstream')
+                logger.warning('Proxy to %s failed: %s (trace=%s)',
+                               url, e, trace_id)
                 _M_ERRORS.inc(1, replica=url, kind='upstream')
                 last_err = e
                 if request.method not in ('GET', 'HEAD', 'OPTIONS'):
@@ -245,6 +269,13 @@ class LoadBalancer:
                     # request (e.g. 200 headers then a payload error).
                     break
             finally:
+                # An exception outside the enumerated arms — notably
+                # CancelledError when the client disconnects mid-
+                # proxy — must still land the attempt in the trace:
+                # aborted requests are exactly the ones worth
+                # reading. finish() is idempotent for the arms above.
+                if sp.end_time is None:
+                    sp.finish(error='aborted')
                 self.policy.done(url)
         if last_err is None:
             return web.Response(status=503,
@@ -261,6 +292,15 @@ class LoadBalancer:
             k: v for k, v in request.headers.items()
             if k.lower() not in _HOP_HEADERS
         }
+        # Continue the trace into the replica: the active lb.proxy
+        # span replaces any client-sent traceparent (the replica must
+        # parent under THIS hop, not skip it). When tracing is off
+        # this is {} and the client's own header passes through.
+        tp = trace_lib.traceparent_headers()
+        if tp:
+            headers = {k: v for k, v in headers.items()
+                       if k.lower() != trace_lib.TRACEPARENT_HEADER}
+            headers.update(tp)
         assert self._session is not None, 'start() not called'
         async with self._session.request(request.method, target,
                                          headers=headers,

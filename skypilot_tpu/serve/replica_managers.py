@@ -18,6 +18,7 @@ import requests
 from skypilot_tpu import exceptions
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import task as task_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -170,17 +171,23 @@ class ReplicaManager:
         from skypilot_tpu import execution
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.PROVISIONING)
-        try:
-            task = self._make_task(replica_id, version, is_spot)
-            execution.launch(task, cluster_name=cluster,
-                             detach_run=True, stream_logs=False)
-        except Exception:  # pylint: disable=broad-except
-            logger.error('Replica %d launch failed:\n%s', replica_id,
-                         traceback.format_exc())
-            serve_state.set_replica_status(
-                self.service_name, replica_id,
-                ReplicaStatus.FAILED_PROVISION)
-            return
+        # One span per replica launch: runs on a fresh thread (no
+        # inherited context), so this roots a launch trace whose
+        # children are the backend/provision spans (docs/tracing.md).
+        with trace_lib.span('serve.replica.launch', slow_ok=True,
+                            service=self.service_name,
+                            replica=replica_id, cluster=cluster):
+            try:
+                task = self._make_task(replica_id, version, is_spot)
+                execution.launch(task, cluster_name=cluster,
+                                 detach_run=True, stream_logs=False)
+            except Exception:  # pylint: disable=broad-except
+                logger.error('Replica %d launch failed:\n%s',
+                             replica_id, traceback.format_exc())
+                serve_state.set_replica_status(
+                    self.service_name, replica_id,
+                    ReplicaStatus.FAILED_PROVISION)
+                return
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.STARTING)
 
@@ -213,8 +220,12 @@ class ReplicaManager:
             remove: bool = False) -> None:
         from skypilot_tpu import core
         try:
-            _TERMINATE_RETRY_POLICY.call(core.down,
-                                         self._cluster_name(replica_id))
+            with trace_lib.span('serve.replica.terminate',
+                                slow_ok=True,
+                                service=self.service_name,
+                                replica=replica_id):
+                _TERMINATE_RETRY_POLICY.call(
+                    core.down, self._cluster_name(replica_id))
         except exceptions.ClusterDoesNotExist:
             pass
         except Exception:  # pylint: disable=broad-except

@@ -1,0 +1,56 @@
+"""Distributed span tracing for the skypilot_tpu stack.
+
+Public API (docs/tracing.md)::
+
+    from skypilot_tpu import trace
+
+    with trace.span('lb.proxy', replica=url) as sp:
+        ...                       # nested spans parent automatically
+
+    @trace.span('provisioner.bulk_provision')
+    def bulk_provision(...): ...
+
+    env = dict(os.environ)
+    trace.child_env(env)          # propagate across a process spawn
+    headers.update(trace.traceparent_headers())   # ... or over HTTP
+
+Spans spool as JSONL per process under ``SKYTPU_TRACE_DIR`` (unset =
+tracing off, near-zero overhead); ``python -m skypilot_tpu.trace``
+merges the spool into Chrome/Perfetto JSON or a text tree.
+"""
+from skypilot_tpu.trace.core import REQUEST_ID_HEADER
+from skypilot_tpu.trace.core import SLOW_SPAN_ENV
+from skypilot_tpu.trace.core import Span
+from skypilot_tpu.trace.core import SpanContext
+from skypilot_tpu.trace.core import TRACE_CONTEXT_ENV
+from skypilot_tpu.trace.core import TRACE_DIR_ENV
+from skypilot_tpu.trace.core import TRACEPARENT_HEADER
+from skypilot_tpu.trace.core import activate
+from skypilot_tpu.trace.core import child_env
+from skypilot_tpu.trace.core import context_from_headers
+from skypilot_tpu.trace.core import current_context
+from skypilot_tpu.trace.core import current_span
+from skypilot_tpu.trace.core import current_trace_id
+from skypilot_tpu.trace.core import enabled
+from skypilot_tpu.trace.core import format_traceparent
+from skypilot_tpu.trace.core import new_request_id
+from skypilot_tpu.trace.core import new_span_id
+from skypilot_tpu.trace.core import new_trace_id
+from skypilot_tpu.trace.core import parse_traceparent
+from skypilot_tpu.trace.core import seed_ids
+from skypilot_tpu.trace.core import set_clock
+from skypilot_tpu.trace.core import set_component
+from skypilot_tpu.trace.core import span
+from skypilot_tpu.trace.core import spool_path
+from skypilot_tpu.trace.core import start_span
+from skypilot_tpu.trace.core import traceparent_headers
+
+__all__ = [
+    'REQUEST_ID_HEADER', 'SLOW_SPAN_ENV', 'Span', 'SpanContext',
+    'TRACE_CONTEXT_ENV', 'TRACE_DIR_ENV', 'TRACEPARENT_HEADER',
+    'activate', 'child_env', 'context_from_headers', 'current_context',
+    'current_span', 'current_trace_id', 'enabled', 'format_traceparent',
+    'new_request_id', 'new_span_id', 'new_trace_id', 'parse_traceparent',
+    'seed_ids', 'set_clock', 'set_component', 'span', 'spool_path',
+    'start_span', 'traceparent_headers',
+]

@@ -1,0 +1,61 @@
+"""``python -m skypilot_tpu.trace``: merge a span spool into a
+Chrome/Perfetto trace or a text tree.
+
+    python -m skypilot_tpu.trace --format chrome -o trace.json
+    python -m skypilot_tpu.trace --format tree --trace <trace_id>
+
+``--dir`` defaults to ``SKYTPU_TRACE_DIR``. Exit 0 with an empty
+document when the spool holds no spans (an empty run is not an
+error); exit 2 when no spool directory is known at all.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from skypilot_tpu.trace import core, export
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.trace',
+        description='Merge span spool files into a Chrome/Perfetto '
+                    'trace or a text tree (docs/tracing.md).')
+    parser.add_argument('--dir', default=None,
+                        help='Span spool directory (default: '
+                        '$SKYTPU_TRACE_DIR).')
+    parser.add_argument('--format', choices=('chrome', 'tree'),
+                        default='chrome',
+                        help='chrome: trace-event JSON (loads in '
+                        'chrome://tracing and Perfetto); tree: '
+                        'per-trace text tree.')
+    parser.add_argument('-o', '--out', default=None,
+                        help='Write here instead of stdout.')
+    parser.add_argument('--trace', default=None,
+                        help='Restrict to one trace id (tree only).')
+    args = parser.parse_args(argv)
+
+    trace_dir = args.dir or os.environ.get(core.TRACE_DIR_ENV)
+    if not trace_dir:
+        print('No spool directory: pass --dir or set '
+              f'{core.TRACE_DIR_ENV}.', file=sys.stderr)
+        return 2
+    spans = export.read_spans(trace_dir)
+    if args.format == 'chrome':
+        out = json.dumps(export.to_chrome(spans))
+    else:
+        out = export.to_tree(spans, trace_id=args.trace)
+    if args.out:
+        with open(args.out, 'w', encoding='utf-8') as f:
+            f.write(out)
+        print(f'{args.out}: {len(spans)} span(s).', file=sys.stderr)
+    else:
+        sys.stdout.write(out if out.endswith('\n') or not out
+                         else out + '\n')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""Trace spool -> Chrome/Perfetto JSON and text tree views.
+
+The spool (``SKYTPU_TRACE_DIR``) holds one append-only
+``spans-<component>-<pid>.jsonl`` file per traced process.
+:func:`read_spans` merges them; :func:`to_chrome` renders Chrome
+trace-event JSON (complete 'X' events — loads directly in
+``chrome://tracing`` and https://ui.perfetto.dev); :func:`to_tree`
+renders a per-trace text tree with durations, the quick-look form for
+"where did this request/launch spend its time?".
+
+Corrupt lines are skipped, never fatal: spool files are concurrent
+append targets and a crashed writer may leave a torn tail.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.trace import core
+
+
+def read_spans(trace_dir: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All spans in the spool, sorted by start time."""
+    trace_dir = os.path.expanduser(
+        trace_dir or os.environ.get(core.TRACE_DIR_ENV) or '.')
+    spans: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir,
+                                              'spans-*.jsonl'))):
+        try:
+            with open(path, encoding='utf-8') as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail from a crashed writer
+            if (isinstance(rec, dict) and
+                    isinstance(rec.get('name'), str) and
+                    isinstance(rec.get('trace_id'), str) and
+                    isinstance(rec.get('start'), (int, float)) and
+                    isinstance(rec.get('end'), (int, float))):
+                spans.append(rec)
+    spans.sort(key=lambda r: (r['start'], r.get('end', 0.0)))
+    return spans
+
+
+def to_chrome(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON ('X' complete events, microseconds).
+
+    pid/tid carry the real process/thread so Perfetto's track view
+    shows one lane per process; the trace/span/parent ids ride in
+    ``args`` for click-through correlation.
+    """
+    events = []
+    for rec in spans:
+        args = dict(rec.get('attrs') or {})
+        args['trace_id'] = rec['trace_id']
+        args['span_id'] = rec.get('span_id')
+        if rec.get('parent_id'):
+            args['parent_id'] = rec['parent_id']
+        if rec.get('component'):
+            args['component'] = rec['component']
+        events.append({
+            'name': rec['name'],
+            'cat': 'skypilot_tpu',
+            'ph': 'X',
+            'ts': round(rec['start'] * 1e6, 3),
+            'dur': round((rec['end'] - rec['start']) * 1e6, 3),
+            'pid': rec.get('pid', 0),
+            'tid': rec.get('tid', 0),
+            'args': args,
+        })
+    return {'traceEvents': events, 'displayTimeUnit': 'ms'}
+
+
+def write_chrome(trace_dir: Optional[str] = None,
+                 out_path: Optional[str] = None) -> str:
+    """Merge the spool into one Chrome-trace file; returns its path
+    (default ``<trace_dir>/trace_merged.json``)."""
+    trace_dir = os.path.expanduser(
+        trace_dir or os.environ.get(core.TRACE_DIR_ENV) or '.')
+    out_path = out_path or os.path.join(trace_dir, 'trace_merged.json')
+    payload = to_chrome(read_spans(trace_dir))
+    with open(out_path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+    return out_path
+
+
+def _fmt_dur(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f'{seconds:.3f}s'
+    return f'{seconds * 1e3:.1f}ms'
+
+
+def to_tree(spans: List[Dict[str, Any]],
+            trace_id: Optional[str] = None) -> str:
+    """Text tree per trace: indentation = parentage, one line per
+    span with duration and attrs. Orphans (parent span never flushed,
+    e.g. a process killed mid-span) surface as roots rather than
+    disappearing."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in spans:
+        if trace_id is not None and rec['trace_id'] != trace_id:
+            continue
+        by_trace.setdefault(rec['trace_id'], []).append(rec)
+    lines: List[str] = []
+    for tid in sorted(by_trace,
+                      key=lambda t: by_trace[t][0]['start']):
+        group = by_trace[tid]
+        ids = {rec.get('span_id') for rec in group}
+        children: Dict[Any, List[Dict[str, Any]]] = {}
+        roots: List[Dict[str, Any]] = []
+        for rec in group:
+            parent = rec.get('parent_id')
+            if parent in ids and parent is not None:
+                children.setdefault(parent, []).append(rec)
+            else:
+                roots.append(rec)
+        lines.append(f'trace {tid}')
+
+        def walk(rec: Dict[str, Any], depth: int) -> None:
+            attrs = rec.get('attrs') or {}
+            attr_s = (' ' + ' '.join(f'{k}={v}'
+                                     for k, v in sorted(attrs.items()))
+                      if attrs else '')
+            dur = _fmt_dur(rec['end'] - rec['start'])
+            where = rec.get('component') or rec.get('pid', '')
+            lines.append(f'{"  " * (depth + 1)}{rec["name"]}  {dur}  '
+                         f'[{where}]{attr_s}')
+            for child in sorted(children.get(rec.get('span_id'), ()),
+                                key=lambda r: r['start']):
+                walk(child, depth + 1)
+
+        for root in roots:
+            walk(root, 0)
+    return '\n'.join(lines) + ('\n' if lines else '')

@@ -128,9 +128,27 @@ SKYTPU_SETUP_NODE_RANK = register(
     'Rank exposed to per-node setup commands.')
 
 # ----------------------------------------------------------- telemetry
+SKYTPU_TRACE_DIR = register(
+    'SKYTPU_TRACE_DIR',
+    'Span-spool directory for distributed traces (docs/tracing.md); '
+    'unset disables tracing entirely.')
+SKYTPU_TRACE_CONTEXT = register(
+    'SKYTPU_TRACE_CONTEXT',
+    'Inherited trace context (traceparent form 00-<trace>-<span>-01) '
+    'parenting this process\'s root spans; set for child processes by '
+    'trace.child_env().')
+SKYTPU_TRACE_SEED = register(
+    'SKYTPU_TRACE_SEED',
+    'Seed for deterministic trace/span id generation (tests, golden '
+    'files); unset = random ids.')
+SKYTPU_TRACE_SLOW_SPAN_SECONDS = register(
+    'SKYTPU_TRACE_SLOW_SPAN_SECONDS',
+    'Log a warning (with the trace id) for any span slower than this '
+    'many seconds; 0 disables (default 30).')
 SKYTPU_TIMELINE_FILE_PATH = register(
     'SKYTPU_TIMELINE_FILE_PATH',
-    'Write a Chrome-trace timeline of control-plane events here.')
+    'Write a Chrome-trace timeline of control-plane events here '
+    '(legacy single-file export; spans are the primary sink).')
 SKYTPU_PROFILER_PORT = register(
     'SKYTPU_PROFILER_PORT',
     'Start jax.profiler\'s gRPC server on every worker at this port.')

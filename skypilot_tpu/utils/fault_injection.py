@@ -63,6 +63,7 @@ import threading
 from typing import Any, Dict, List, Optional, Union
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.trace import core as trace_core
 from skypilot_tpu.utils import env_registry
 
 FAULT_PLAN_ENV = env_registry.SKYTPU_FAULT_PLAN
@@ -234,6 +235,11 @@ class FaultPlan:
             'kind': spec.kind.value,
             'fired': spec.fired,
             'context': {k: repr(v) for k, v in context.items()},
+            # Chaos <-> trace correlation (docs/tracing.md): the fault
+            # record names the trace it fired inside, so a game-day
+            # injected failure links straight to the launch/request
+            # span tree it perturbed. None when tracing is off.
+            'trace': trace_core.current_trace_id(),
         }
         self.log.append(entry)
         if self.record_path:

@@ -14,7 +14,8 @@ import threading
 
 from skypilot_tpu.utils import env_registry
 
-_FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+_FORMAT = ('%(levelname).1s %(asctime)s %(filename)s:%(lineno)d]'
+           '%(traceid)s %(message)s')
 _DATE_FORMAT = '%m-%d %H:%M:%S'
 
 _setup_lock = threading.Lock()
@@ -36,6 +37,23 @@ class NoPrefixFormatter(logging.Formatter):
         return record.getMessage()
 
 
+class TraceIdFilter(logging.Filter):
+    """Stamps ``%(traceid)s``: ``' [trace:<id>]'`` while a span (or
+    an inherited ``SKYTPU_TRACE_CONTEXT``) is active and tracing is
+    on, else '' — request/launch logs correlate with their trace
+    (docs/tracing.md) at zero cost when tracing is disabled. Looks
+    the tracer up via sys.modules so logging setup never forces the
+    import."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tid = None
+        mod = sys.modules.get('skypilot_tpu.trace.core')
+        if mod is not None:
+            tid = mod.current_trace_id()
+        record.traceid = f' [trace:{tid}]' if tid else ''
+        return True
+
+
 def _setup() -> None:
     global _initialized
     with _setup_lock:
@@ -46,6 +64,7 @@ def _setup() -> None:
         handler = logging.StreamHandler(sys.stdout)
         handler.setLevel(_env_level())
         handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+        handler.addFilter(TraceIdFilter())
         root.addHandler(handler)
         root.propagate = False
         _initialized = True

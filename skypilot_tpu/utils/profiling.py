@@ -1,4 +1,12 @@
-"""jax.profiler hooks for pod workers (SURVEY.md §5 tracing).
+"""jax.profiler hooks for pod workers — span-emitting wrappers.
+
+Host-side timing in this repo has exactly one primitive: the span
+tracer (:mod:`skypilot_tpu.trace`, docs/tracing.md). What remains
+here is the DEVICE-level capture that spans cannot express — XLA/HLO
+traces via jax.profiler — wrapped so each capture also emits a span
+(``jax.profiler.capture``): the merged trace shows *when* in the run
+the TensorBoard capture happened, and the capture dir rides on the
+span for correlation.
 
 Two knobs, both env-driven so recipes need no code changes:
 
@@ -6,8 +14,7 @@ Two knobs, both env-driven so recipes need no code changes:
   worker at init (``initialize_from_env`` calls
   ``maybe_start_profiler_server``); attach TensorBoard's profile
   capture to ``<worker_ip>:<port>`` for on-demand traces of a live
-  job — the TPU counterpart of the reference's timeline tracing
-  (sky/utils/timeline.py), but at the XLA/HLO level.
+  job.
 - ``SKYTPU_PROFILE_DIR``: bounded automatic capture — ``maybe_trace``
   wraps a region (e.g. one train step) in ``jax.profiler.trace``
   writing a TensorBoard-loadable trace there, once.
@@ -18,6 +25,7 @@ import contextlib
 import os
 from typing import Iterator, Optional
 
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log as sky_logging
 
@@ -47,7 +55,9 @@ def maybe_start_profiler_server() -> Optional[int]:
 def maybe_trace(step: Optional[int] = None,
                 capture_step: int = 2) -> Iterator[None]:
     """Trace this region to $SKYTPU_PROFILE_DIR (once, at
-    ``capture_step`` so compilation noise from step 0/1 is skipped)."""
+    ``capture_step`` so compilation noise from step 0/1 is skipped).
+    The capture region is also a span, so merged distributed traces
+    mark where the device profile sits in the run."""
     global _traced_once
     log_dir = os.environ.get(PROFILE_DIR_ENV)
     should = (log_dir and not _traced_once and
@@ -59,5 +69,7 @@ def maybe_trace(step: Optional[int] = None,
     _traced_once = True
     os.makedirs(os.path.expanduser(log_dir), exist_ok=True)
     logger.info('Capturing jax.profiler trace to %s.', log_dir)
-    with jax.profiler.trace(os.path.expanduser(log_dir)):
-        yield
+    with trace_lib.span('jax.profiler.capture', log_dir=log_dir,
+                        step=step):
+        with jax.profiler.trace(os.path.expanduser(log_dir)):
+            yield

@@ -1,9 +1,21 @@
-"""Chrome-trace timeline tracing.
+"""Chrome-trace timeline: a thin exporter over the span tracer.
 
-Re-design of reference ``sky/utils/timeline.py:22-121``: an
-``@timeline.event`` decorator and ``Event`` context manager that append
-Chrome trace events (phase B/E) to the file named by
-``SKYTPU_TIMELINE_FILE_PATH``. Zero overhead when the env var is unset.
+Historically this module was its own timing primitive (an in-memory
+Chrome-event buffer behind ``SKYTPU_TIMELINE_FILE_PATH``, the
+re-design of reference ``sky/utils/timeline.py:22-121``). The repo's
+single timing primitive is now :mod:`skypilot_tpu.trace`; this module
+keeps the legacy surface — ``Event``, ``@timeline.event``,
+``save_timeline()`` — as span wrappers:
+
+- ``Event``/``@event`` open a real span, so the instrumented
+  control-plane paths (locks, backend ops, ``execution.launch``)
+  appear in distributed traces whenever ``SKYTPU_TRACE_DIR`` is set;
+- when ``SKYTPU_TIMELINE_FILE_PATH`` is set, every finished span —
+  from any instrumented site, not just this module's — is ALSO
+  rendered into the legacy single-file Chrome trace (balanced B/E
+  pairs), written by ``save_timeline()`` at exit.
+
+Zero overhead when both knobs are unset.
 """
 from __future__ import annotations
 
@@ -12,55 +24,81 @@ import functools
 import json
 import os
 import threading
-import time
 from typing import Any, Callable, List, Optional
 
+from skypilot_tpu.trace import core as trace_core
 from skypilot_tpu.utils import env_registry
 
 _ENV = env_registry.SKYTPU_TIMELINE_FILE_PATH
 _events: List[dict] = []
 _lock = threading.Lock()
 _save_registered = False
+# The legacy export is an in-memory buffer flushed at exit; now that
+# EVERY span feeds it (per-request serve spans included), a
+# long-running server with the knob set would grow without bound.
+# Cap it: beyond this many events the earliest-armed capture is
+# complete and further spans are counted, not stored.
+_MAX_EVENTS = 50_000
+_dropped = 0
 
 
 def enabled() -> bool:
+    """Legacy single-file export armed (the span tracer has its own
+    ``trace.enabled()``)."""
     return bool(os.environ.get(_ENV))
 
 
+def record_span(span: 'trace_core.Span') -> None:
+    """Render one finished span into the legacy buffer as a balanced
+    B/E pair. Called by the tracer for EVERY finished span while
+    ``SKYTPU_TIMELINE_FILE_PATH`` is set. Bounded: past
+    ``_MAX_EVENTS`` spans are counted as dropped (the spool under
+    ``SKYTPU_TRACE_DIR`` is the unbounded sink)."""
+    global _save_registered, _dropped
+    base = {
+        'name': span.name,
+        'cat': 'skypilot_tpu',
+        'pid': str(os.getpid()),
+        'tid': str(threading.get_ident()),
+    }
+    if span.attrs:
+        base['args'] = {k: str(v) for k, v in span.attrs.items()}
+    end_us = (span.end_time
+              if span.end_time is not None else span.start_time) * 1e6
+    begin = dict(base, ph='B', ts=f'{span.start_time * 1e6: .3f}')
+    end = dict(base, ph='E', ts=f'{end_us: .3f}')
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append(begin)
+        _events.append(end)
+        if not _save_registered:
+            atexit.register(save_timeline)
+            _save_registered = True
+
+
 class Event:
-    """Context manager emitting a begin/end trace-event pair."""
+    """Legacy begin/end pair — now a span under the hood."""
 
     def __init__(self, name: str, message: Optional[str] = None) -> None:
         self._name = name
         self._message = message
+        self._cm: Optional[trace_core.span] = None
 
     def begin(self) -> None:
-        if not enabled():
-            return
-        self._record('B')
+        attrs = ({'message': self._message}
+                 if self._message is not None else {})
+        # Control-plane events (launch stages, provisioning, lock
+        # waits) are minutes-long by nature: exempt from the
+        # slow-span warning, which watches the request path.
+        self._cm = trace_core.span(self._name, slow_ok=True, **attrs)
+        self._cm.__enter__()
 
     def end(self) -> None:
-        if not enabled():
-            return
-        self._record('E')
-
-    def _record(self, phase: str) -> None:
-        global _save_registered
-        event = {
-            'name': self._name,
-            'cat': 'skypilot_tpu',
-            'ph': phase,
-            'pid': str(os.getpid()),
-            'tid': str(threading.get_ident()),
-            'ts': f'{time.time() * 10 ** 6: .3f}',
-        }
-        if self._message is not None:
-            event['args'] = {'message': self._message}
-        with _lock:
-            _events.append(event)
-            if not _save_registered:
-                atexit.register(save_timeline)
-                _save_registered = True
+        if self._cm is not None:
+            self._cm.__exit__(None, None, None)
+            self._cm = None
 
     def __enter__(self) -> 'Event':
         self.begin()
@@ -71,7 +109,7 @@ class Event:
 
 
 def event(fn: Callable = None, *, name: Optional[str] = None) -> Callable:
-    """Decorator tracing a function call as a timeline event."""
+    """Decorator tracing a function call as a span (legacy API)."""
     if fn is None:
         return functools.partial(event, name=name)
 
@@ -88,13 +126,17 @@ def save_timeline() -> None:
     path = os.environ.get(_ENV)
     if not path or not _events:
         return
+    global _dropped
     with _lock:
         payload = {
             'traceEvents': list(_events),
             'displayTimeUnit': 'ms',
             'otherData': {'pid': os.getpid()},
         }
+        if _dropped:
+            payload['otherData']['dropped_spans'] = _dropped
         _events.clear()
+        _dropped = 0
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, 'w', encoding='utf-8') as f:
         json.dump(payload, f)

@@ -489,8 +489,10 @@ def test_engine_rejects_counter_on_429():
                            max_seq=128)
     server = EngineServer(engine, max_pending=1)
     engine.queue.append(Request('q', [1], max_new=1))  # fill pending
-    resp = server._overloaded_response()
+    resp = server._overloaded_response('req-test')
     assert resp is not None and resp.status == 429
+    # The shed response stays correlatable (docs/tracing.md).
+    assert resp.headers['X-Request-ID'] == 'req-test'
     assert metrics.REGISTRY.get(
         'skytpu_engine_rejects_total').value() == 1
 

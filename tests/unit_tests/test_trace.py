@@ -1,0 +1,523 @@
+"""Distributed tracing (skypilot_tpu/trace/, docs/tracing.md):
+span semantics, cross-process/HTTP context propagation, Chrome
+export, metrics exemplar linkage, and the instrumented serve path."""
+import asyncio
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import metrics
+from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.trace import core as trace_core
+from skypilot_tpu.trace import export
+
+pytestmark = pytest.mark.trace
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def trace_dir(tmp_path, monkeypatch):
+    spool = tmp_path / 'spool'
+    monkeypatch.setenv(trace_core.TRACE_DIR_ENV, str(spool))
+    monkeypatch.delenv(trace_core.TRACE_CONTEXT_ENV, raising=False)
+    yield str(spool)
+
+
+@pytest.fixture
+def seeded(monkeypatch):
+    trace_lib.seed_ids(0)
+    trace_lib.set_clock(None)
+    yield
+    trace_lib.seed_ids(None)
+    trace_lib.set_clock(None)
+
+
+# ------------------------------------------------------------ core
+def test_span_nesting_and_attrs(trace_dir):
+    with trace_lib.span('outer', kind='test') as outer:
+        assert outer is not None and outer.recorded
+        assert trace_lib.current_span() is outer
+        with trace_lib.span('inner') as inner:
+            inner.set_attr(extra=7)
+        with trace_lib.span('inner2'):
+            pass
+    assert trace_lib.current_span() is None
+    spans = {s['name']: s for s in export.read_spans(trace_dir)}
+    assert set(spans) == {'outer', 'inner', 'inner2'}
+    assert spans['outer']['attrs'] == {'kind': 'test'}
+    assert spans['inner']['attrs'] == {'extra': 7}
+    for name in ('inner', 'inner2'):
+        assert spans[name]['trace_id'] == spans['outer']['trace_id']
+        assert spans[name]['parent_id'] == spans['outer']['span_id']
+    assert spans['outer']['parent_id'] is None
+    assert spans['outer']['start'] <= spans['inner']['start']
+    assert spans['inner']['end'] <= spans['outer']['end']
+
+
+def test_span_decorator_and_error_attr(trace_dir):
+
+    @trace_lib.span('decorated.fn', layer='x')
+    def fn():
+        return 41
+
+    assert fn() == 41
+    with pytest.raises(ValueError):
+        with trace_lib.span('failing.op'):
+            raise ValueError('boom')
+    spans = {s['name']: s for s in export.read_spans(trace_dir)}
+    assert spans['decorated.fn']['attrs'] == {'layer': 'x'}
+    assert 'ValueError: boom' in spans['failing.op']['attrs']['error']
+
+
+def test_disabled_mode_no_file_io(monkeypatch):
+    """Zero overhead off: no ids on the contextvar path, no record
+    emission, no spool writes — asserted by making emission fatal."""
+    monkeypatch.delenv(trace_core.TRACE_DIR_ENV, raising=False)
+    monkeypatch.delenv('SKYTPU_TIMELINE_FILE_PATH', raising=False)
+    monkeypatch.delenv(trace_core.TRACE_CONTEXT_ENV, raising=False)
+
+    def boom(_):
+        raise AssertionError('span emission in disabled mode')
+
+    monkeypatch.setattr(trace_core, '_emit', boom)
+    with trace_lib.span('nothing') as sp:
+        assert sp is None
+        assert trace_lib.current_span() is None
+    manual = trace_lib.start_span('manual.timer')
+    assert not manual.recorded
+    assert manual.exemplar is None
+    manual.finish()  # must not emit
+    assert manual.duration >= 0.0
+    assert trace_lib.current_trace_id() is None
+    assert trace_lib.traceparent_headers() == {}
+    assert trace_lib.child_env() == {}
+
+
+def test_thread_isolation(trace_dir):
+    """Worker threads start clean: no inherited contextvar parent,
+    fresh trace ids."""
+    got = {}
+    with trace_lib.span('main.op') as main_span:
+
+        def worker():
+            assert trace_lib.current_span() is None
+            sp = trace_lib.start_span('worker.op')
+            got['trace'] = sp.trace_id
+            got['parent'] = sp.parent_id
+            sp.finish()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        t.join()
+        assert got['trace'] != main_span.trace_id
+        assert got['parent'] is None
+
+
+def test_traceparent_round_trip(seeded):
+    ctx = trace_core.SpanContext('ab' * 16, 'cd' * 8)
+    assert trace_lib.parse_traceparent(
+        trace_lib.format_traceparent(ctx)) == ctx
+    for bad in (None, '', 'nonsense', '00-xyz-123-01',
+                '00-' + 'ab' * 16 + '-short-01'):
+        assert trace_lib.parse_traceparent(bad) is None
+    # Case-insensitive header lookup.
+    hdr = {'TraceParent': trace_lib.format_traceparent(ctx)}
+    assert trace_lib.context_from_headers(hdr) == ctx
+
+
+def test_subprocess_propagation_round_trip(trace_dir):
+    """SKYTPU_TRACE_CONTEXT: a child process's span parents under
+    the launching process's active span — one trace id across the
+    process boundary (the jobs-controller / bench-child shape)."""
+    code = ('from skypilot_tpu import trace\n'
+            "with trace.span('child.work'):\n"
+            '    pass\n')
+    with trace_lib.span('parent.op') as parent:
+        env = dict(os.environ)
+        block = trace_lib.child_env(env)
+        assert trace_core.TRACE_CONTEXT_ENV in block
+        env['PYTHONPATH'] = (_REPO_ROOT + os.pathsep +
+                             env.get('PYTHONPATH', ''))
+        subprocess.run([sys.executable, '-c', code], env=env,
+                       check=True, timeout=120)
+    spans = {s['name']: s for s in export.read_spans(trace_dir)}
+    child, par = spans['child.work'], spans['parent.op']
+    assert child['trace_id'] == par['trace_id']
+    assert child['parent_id'] == par['span_id']
+    assert child['pid'] != par['pid']
+
+
+def test_slow_span_logged(trace_dir, monkeypatch):
+    monkeypatch.setenv(trace_core.SLOW_SPAN_ENV, '0.001')
+    records = []
+
+    class Capture(logging.Handler):
+
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logging.getLogger('skypilot_tpu').addHandler(handler)
+    try:
+        with trace_lib.span('slowpoke') as sp:
+            time.sleep(0.01)
+    finally:
+        logging.getLogger('skypilot_tpu').removeHandler(handler)
+    hits = [m for m in records if 'slow span' in m]
+    assert hits and 'slowpoke' in hits[0] and sp.trace_id in hits[0]
+
+
+# ---------------------------------------------------------- export
+def test_chrome_export_golden(trace_dir, seeded):
+    """Deterministic ids + clock -> byte-stable Chrome trace (the
+    format contract tools load; pid/tid are process-real)."""
+    now = [1000.0]
+
+    def clock():
+        now[0] += 1.0
+        return now[0]
+
+    trace_lib.set_clock(clock)
+    with trace_lib.span('launch', cloud='local'):
+        with trace_lib.span('provision.local.run_instances'):
+            pass
+    trace_lib.set_clock(None)
+    got = export.to_chrome(export.read_spans(trace_dir))
+    pid, tid = os.getpid(), threading.get_ident()
+    want = {
+        'traceEvents': [
+            {
+                'name': 'launch',
+                'cat': 'skypilot_tpu',
+                'ph': 'X',
+                'ts': 1001000000.0,
+                'dur': 3000000.0,
+                'pid': pid,
+                'tid': tid,
+                'args': {
+                    'cloud': 'local',
+                    'trace_id': 'e3e70682c2094cac629f6fbed82c07cd',
+                    'span_id': '0a5d2f346baa9455',
+                },
+            },
+            {
+                'name': 'provision.local.run_instances',
+                'cat': 'skypilot_tpu',
+                'ph': 'X',
+                'ts': 1002000000.0,
+                'dur': 1000000.0,
+                'pid': pid,
+                'tid': tid,
+                'args': {
+                    'trace_id': 'e3e70682c2094cac629f6fbed82c07cd',
+                    'span_id': 'f728b4fa42485e3a',
+                    'parent_id': '0a5d2f346baa9455',
+                },
+            },
+        ],
+        'displayTimeUnit': 'ms',
+    }
+    assert got == want
+    # And the payload is valid Chrome-trace JSON end to end.
+    assert json.loads(json.dumps(got))['traceEvents'][0]['ph'] == 'X'
+
+
+def test_cli_chrome_and_tree(trace_dir):
+    with trace_lib.span('cli.root'):
+        with trace_lib.span('cli.child', n=1):
+            pass
+    env = dict(os.environ)
+    env['PYTHONPATH'] = (_REPO_ROOT + os.pathsep +
+                         env.get('PYTHONPATH', ''))
+    out = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.trace', '--dir',
+         trace_dir, '--format', 'chrome'],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    payload = json.loads(out.stdout)
+    names = [e['name'] for e in payload['traceEvents']]
+    assert names == ['cli.root', 'cli.child']
+    assert all(e['ph'] == 'X' for e in payload['traceEvents'])
+
+    tree = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.trace', '--dir',
+         trace_dir, '--format', 'tree'],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert tree.returncode == 0, tree.stderr
+    assert 'cli.root' in tree.stdout
+    # The child renders deeper than its parent.
+    root_line = next(l for l in tree.stdout.splitlines()
+                     if 'cli.root' in l)
+    child_line = next(l for l in tree.stdout.splitlines()
+                      if 'cli.child' in l)
+    indent = lambda s: len(s) - len(s.lstrip())  # noqa: E731
+    assert indent(child_line) > indent(root_line)
+    assert 'n=1' in child_line
+
+
+def test_export_skips_corrupt_lines(trace_dir):
+    with trace_lib.span('good'):
+        pass
+    path = trace_lib.spool_path(trace_dir)
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('{"torn": \n')
+        f.write('not json at all\n')
+    spans = export.read_spans(trace_dir)
+    assert [s['name'] for s in spans] == ['good']
+
+
+# ------------------------------------------------------- exemplars
+def test_histogram_exemplar_linkage():
+    reg = metrics.Registry()
+    h = reg.histogram('skytpu_test_linked_seconds', 'test hist',
+                      buckets=(1.0,))
+    h.observe(0.5, exemplar='ab' * 16)
+    h.observe(0.7)  # exemplar-less observation keeps the last one
+    series = reg.families()['skytpu_test_linked_seconds']['series'][0]
+    assert series['exemplar'] == {'trace_id': 'ab' * 16, 'value': 0.5}
+    # 0.0.4 text exposition ignores exemplars (format predates them).
+    text = metrics.render(reg.families())
+    assert 'exemplar' not in text and 'ab' * 16 not in text
+    # Snapshot-merge carries the exemplar through (JSON round trip =
+    # the spool protocol's transport).
+    base = reg.families()
+    other = json.loads(json.dumps(reg.families()))
+    other['skytpu_test_linked_seconds']['series'][0]['exemplar'] = {
+        'trace_id': 'cd' * 16, 'value': 0.9}
+    metrics.merge_families(base, other)
+    merged = base['skytpu_test_linked_seconds']['series'][0]
+    assert merged['exemplar']['trace_id'] == 'cd' * 16
+    assert merged['count'] == 4
+
+
+# ------------------------------------------------- serve-path wiring
+def test_lb_propagates_trace_headers(trace_dir):
+    """LB -> replica: the proxied request carries a traceparent
+    continuing the CLIENT's trace re-parented under the lb.proxy
+    span, and a client X-Request-ID passes through untouched."""
+    import aiohttp
+    from aiohttp import web
+
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+
+    client_trace = 'ab' * 16
+    client_tp = f'00-{client_trace}-{"cd" * 8}-01'
+    seen = {}
+
+    async def scenario():
+
+        async def handler(request):
+            seen['traceparent'] = request.headers.get('traceparent')
+            seen['request_id'] = request.headers.get('X-Request-ID')
+            return web.json_response({'ok': True})
+
+        app = web.Application()
+        app.router.add_post('/generate', handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, '127.0.0.1', 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{port}'])
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}/generate',
+                    json={'tokens': [1]},
+                    headers={'traceparent': client_tp,
+                             'X-Request-ID': 'req-42'}) as resp:
+                status = resp.status
+                await resp.read()
+        await lb.stop()
+        await runner.cleanup()
+        return status
+
+    assert asyncio.run(scenario()) == 200
+    got = trace_lib.parse_traceparent(seen['traceparent'])
+    assert got is not None
+    assert got.trace_id == client_trace        # trace continues
+    assert got.span_id != 'cd' * 8             # re-parented at the LB
+    assert seen['request_id'] == 'req-42'
+    spans = export.read_spans(trace_dir)
+    mine = {s['name']: s for s in spans
+            if s['trace_id'] == client_trace}
+    assert {'lb.request', 'lb.proxy'} <= set(mine)
+    assert mine['lb.request']['parent_id'] == 'cd' * 8
+    assert mine['lb.proxy']['parent_id'] == \
+        mine['lb.request']['span_id']
+    # The replica saw exactly the lb.proxy span as its parent.
+    assert got.span_id == mine['lb.proxy']['span_id']
+    # Span duration fed the latency histogram, trace id as exemplar.
+    fam = metrics.REGISTRY.families()[
+        'skytpu_lb_replica_request_seconds']
+    assert fam['series'][0]['exemplar']['trace_id'] == client_trace
+
+
+def test_serving_http_request_id_and_429(trace_dir):
+    """X-Request-ID: echoed when given (including on 429 rejects),
+    generated when absent; the http.generate span continues the
+    caller's trace and records the request id."""
+    import jax
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import Request as EngReq
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    server = EngineServer(engine, max_pending=2)
+    engine.submit(EngReq('a', [1, 2, 3], 4))
+    engine.submit(EngReq('b', [1, 2, 3], 4))
+    client_trace = 'ef' * 16
+    client_tp = f'00-{client_trace}-{"12" * 8}-01'
+
+    async def scenario():
+        async with TestClient(TestServer(server.make_app())) as client:
+            full = await client.post(
+                '/generate', json={'tokens': [1, 2, 3], 'max_new': 4},
+                headers={'X-Request-ID': 'my-req',
+                         'traceparent': client_tp})
+            body = await full.json()
+            bad = await client.post('/generate', json={'tokens': []})
+            return (full.status, full.headers.get('X-Request-ID'),
+                    body, bad.status, bad.headers.get('X-Request-ID'))
+
+    status, echoed, body, bad_status, minted = asyncio.run(scenario())
+    server.stop()
+    assert status == 429 and echoed == 'my-req'
+    assert body['request_id'] == 'my-req'
+    assert bad_status == 400
+    assert minted  # absent header -> generated id, still echoed
+    assert minted != 'my-req'
+    spans = [s for s in export.read_spans(trace_dir)
+             if s['name'] == 'http.generate']
+    mine = [s for s in spans if s['trace_id'] == client_trace]
+    assert mine and mine[0]['attrs']['request_id'] == 'my-req'
+    assert mine[0]['parent_id'] == '12' * 8
+
+
+def test_engine_ttft_span_breakdown(trace_dir):
+    """One engine request yields a span tree decomposing TTFT:
+    engine.request -> queue_wait / prefill / decode.first_chunk, all
+    one trace id, contiguous in time; the TTFT histogram carries the
+    trace id as exemplar (single timing source)."""
+    import jax
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import Request, ServingEngine
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    results = engine.run([Request('r1', [5, 3, 2, 7], max_new=4)])
+    assert len(results['r1'].tokens) == 4
+    spans = export.read_spans(trace_dir)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s['name'], []).append(s)
+    req = by_name['engine.request'][0]
+    assert req['attrs'] == {'request_id': 'r1', 'prompt_len': 4,
+                            'max_new': 4, 'tokens': 4}
+    children = {}
+    for name in ('engine.queue_wait', 'engine.prefill',
+                 'engine.decode.first_chunk'):
+        child = by_name[name][0]
+        assert child['trace_id'] == req['trace_id']
+        assert child['parent_id'] == req['span_id']
+        children[name] = child
+    # The decomposition is contiguous: queue-wait ends where prefill
+    # begins; first-chunk decode starts when the prefill dispatch
+    # returns; everything nests inside the request span.
+    assert (req['start'] <= children['engine.queue_wait']['start'])
+    assert (children['engine.queue_wait']['end'] <=
+            children['engine.prefill']['start'] + 1e-6)
+    assert (children['engine.prefill']['end'] <=
+            children['engine.decode.first_chunk']['start'] + 1e-6)
+    assert children['engine.decode.first_chunk']['end'] <= req['end']
+    fam = metrics.REGISTRY.families()['skytpu_engine_ttft_seconds']
+    assert fam['series'][0]['exemplar']['trace_id'] == req['trace_id']
+    # Engine span state fully drained (no leak across requests).
+    assert not engine._req_spans  # pylint: disable=protected-access
+
+
+@pytest.mark.slow
+def test_full_stack_single_trace(trace_dir):
+    """Acceptance shape: client -> LB -> replica HTTP -> engine is
+    ONE trace id whose tree is lb.request -> lb.proxy ->
+    http.generate -> engine.request -> {queue_wait, prefill,
+    first_chunk}."""
+    import aiohttp
+    import jax
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=128, decode_chunk=4)
+    server = EngineServer(engine)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{port}'])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                try:
+                    async with session.get(base + '/health') as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.1)
+            else:
+                raise TimeoutError('engine never became ready')
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': [5, 3, 2], 'max_new': 3}) as r:
+                assert r.status == 200
+                rid = r.headers.get('X-Request-ID')
+                await r.json()
+        await lb.stop()
+        await runner.cleanup()
+        return rid
+
+    rid = asyncio.run(scenario())
+    server.stop()
+    assert rid
+    spans = export.read_spans(trace_dir)
+    # Health probes proxy through the LB too — pick the /generate one.
+    lb_req = [s for s in spans if s['name'] == 'lb.request' and
+              s['attrs'].get('path') == '/generate'][0]
+    tid = lb_req['trace_id']
+    tree = {s['name']: s for s in spans if s['trace_id'] == tid}
+    assert {'lb.request', 'lb.proxy', 'http.generate',
+            'engine.request', 'engine.queue_wait', 'engine.prefill',
+            'engine.decode.first_chunk'} <= set(tree)
+    assert tree['lb.proxy']['parent_id'] == \
+        tree['lb.request']['span_id']
+    assert tree['http.generate']['parent_id'] == \
+        tree['lb.proxy']['span_id']
+    assert tree['engine.request']['parent_id'] == \
+        tree['http.generate']['span_id']
+    assert tree['http.generate']['attrs']['request_id'] == rid
