@@ -424,6 +424,16 @@ def serve_bench():
     max_new = int(os.environ.get('BENCH_SERVE_MAX_NEW', '128'))
     kv_quant = os.environ.get('BENCH_SERVE_QUANT', '1') == '1'
     chunk = int(os.environ.get('BENCH_SERVE_CHUNK', '16'))
+    # Chunked-prefill knobs (None -> the engine's SKYTPU_PREFILL_*
+    # defaults): the budget bounds how many prompt tokens one tick
+    # may prefill, which is what bounds decode ITL under admission
+    # churn.
+    prefill_chunk = (int(os.environ['BENCH_SERVE_PREFILL_CHUNK'])
+                     if os.environ.get('BENCH_SERVE_PREFILL_CHUNK')
+                     else None)
+    prefill_budget = (int(os.environ['BENCH_SERVE_PREFILL_BUDGET'])
+                      if os.environ.get('BENCH_SERVE_PREFILL_BUDGET')
+                      else None)
     if not on_tpu:
         n_requests, batch, max_prompt, max_new = 6, 2, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
@@ -471,7 +481,9 @@ def serve_bench():
     engine = ServingEngine(params, cfg, batch_size=batch,
                            max_prompt=max_prompt, max_seq=max_seq,
                            kv_quant=kv_quant, weight_quant=wquant,
-                           decode_chunk=chunk)
+                           decode_chunk=chunk,
+                           prefill_chunk=prefill_chunk,
+                           prefill_budget=prefill_budget)
     rng = np.random.default_rng(0)
     reqs = []
     for i in range(n_requests):
@@ -483,12 +495,41 @@ def serve_bench():
     # would double HBM, so warm the same one).
     engine.warmup()
 
+    # Client-visible latency decomposition: first-burst time per
+    # request (TTFT) and the gaps between consecutive token bursts
+    # (ITL — the streaming stall; with chunked prefill its p99 is
+    # bounded by the tick budget, not co-admitted prompt lengths).
+    burst_at: dict = {}
+    ttft_samples, itl_samples = [], []
+
+    def _on_token(rid, toks_):
+        now = time.time()
+        prev = burst_at.get(rid)
+        if prev is None:
+            ttft_samples.append(now - results_submit.get(rid, now))
+        else:
+            itl_samples.append(now - prev)
+        burst_at[rid] = now
+
+    engine.on_token = _on_token
+    results_submit: dict = {}
+
     with _bench_span('serve', requests=n_requests,
                      batch_slots=batch):
         t0 = time.perf_counter()
+        t0_wall = time.time()
+        results_submit.update({r.request_id: t0_wall for r in reqs})
         results = engine.run(reqs)
         dt = time.perf_counter() - t0
     out_tokens = sum(len(r.tokens) for r in results.values())
+
+    def _pct(samples, q):
+        """Nearest-rank percentile: s[ceil(q*n) - 1]."""
+        import math
+        if not samples:
+            return None
+        s = sorted(samples)
+        return round(s[max(1, math.ceil(len(s) * q)) - 1], 4)
     from skypilot_tpu import metrics as metrics_lib
     result = {
         'metric': 'llama_serve_req_s',
@@ -512,9 +553,26 @@ def serve_bench():
             'n_params': n_params, 'param_bytes': param_bytes,
             'chip': gen,
             'backend': jax.default_backend(),
-            # The engine's own ops counters (tokens, TTFT histogram,
-            # cache resets) from THIS run: the perf trajectory and
-            # the serving metrics come from one source.
+            # Mixed-load latency decomposition (client-side exact
+            # samples, not histogram-bucket approximations).
+            'ttft_p50_s': _pct(ttft_samples, 0.50),
+            'ttft_p99_s': _pct(ttft_samples, 0.99),
+            'itl_p50_s': _pct(itl_samples, 0.50),
+            'itl_p99_s': _pct(itl_samples, 0.99),
+            # Per-tick prefill-token accounting: max_tick_tokens <=
+            # budget is the stall-free invariant; ticks * budget vs
+            # tokens_total shows how full the budget ran.
+            'prefill': {
+                'chunk': engine.prefill_chunk,
+                'budget': engine.prefill_budget,
+                'tokens_total': engine.prefill_tokens_total,
+                'ticks': engine.prefill_ticks,
+                'max_tick_tokens': engine.max_tick_prefill_tokens,
+            },
+            # The engine's own ops counters (tokens, TTFT + ITL
+            # histograms, prefill-token counter, cache resets) from
+            # THIS run: the perf trajectory and the serving metrics
+            # come from one source.
             'metrics': metrics_lib.summary(),
         },
     }
@@ -751,12 +809,11 @@ def all_bench():
     }))
 
 
-def _device_watchdog(timeout_s: float = 180.0) -> None:
-    """Bounded device probe before any bench work: when the TPU
-    tunnel is dead, every device op hangs FOREVER (observed when the
-    relay process died mid-round) — a bench that hangs records
-    nothing. A tiny matmul on a watchdog thread converts that into a
-    bounded, recorded error JSON."""
+def _probe_once(timeout_s: float) -> tuple:
+    """One bounded device probe (tiny matmul on a watchdog thread);
+    returns (ok, error_or_None). A dead TPU tunnel hangs device ops
+    FOREVER, so the thread is abandoned on timeout rather than
+    joined to completion."""
     import threading
     result: list = []
 
@@ -774,18 +831,68 @@ def _device_watchdog(timeout_s: float = 180.0) -> None:
     t.join(timeout_s)
     got = list(result)          # one snapshot: the probe thread may
     if got and not isinstance(got[0], Exception):   # land mid-check
+        return True, None
+    return False, (None if not got else got[0])
+
+
+def _probe_device(timeout_s: float, attempts: int,
+                  probe_fn=None) -> 'dict | None':
+    """Run the device probe under a bounded RetryPolicy; returns None
+    on success or the ``bench_error`` detail dict after exhausting
+    the budget. The r05 round died with a bare 'probe did not
+    complete in 180s' — the detail now records how many attempts
+    ran, how long each took, and the active trace id, so a recorded
+    failure distinguishes a flaky tunnel (later attempts differ)
+    from a dead one (every attempt times out flat)."""
+    from skypilot_tpu import trace as trace_mod
+    from skypilot_tpu.utils import retry as retry_lib
+    probe_fn = probe_fn or _probe_once
+    per_attempt = max(1.0, timeout_s / max(1, attempts))
+    policy = retry_lib.RetryPolicy(
+        max_attempts=attempts, initial_backoff=1.0, max_backoff=5.0,
+        jitter='none', site='bench.device_probe')
+    state = policy.new_state()
+    durations = []
+    last_err = None
+    while True:
+        t0 = time.perf_counter()
+        ok, err = probe_fn(per_attempt)
+        durations.append(round(time.perf_counter() - t0, 2))
+        if ok:
+            return None
+        last_err = err
+        if not state.should_retry():
+            break
+        state.sleep()
+    return {
+        'error': ('device unreachable: probe did not complete in '
+                  f'{per_attempt:.0f}s per attempt (TPU tunnel/relay '
+                  'dead?)' if last_err is None
+                  else repr(last_err)[:300]),
+        'attempts': len(durations),
+        'attempt_durations_s': durations,
+        'per_attempt_timeout_s': round(per_attempt, 1),
+        'trace_id': trace_mod.current_trace_id(),
+    }
+
+
+def _device_watchdog(timeout_s: float = 180.0) -> None:
+    """Bounded, retried device probe before any bench work: a bench
+    that hangs records nothing, so an unreachable device must become
+    a bounded, *detailed* error JSON (see _probe_device). The total
+    BENCH_DEVICE_TIMEOUT budget splits across BENCH_DEVICE_ATTEMPTS
+    attempts so a transient tunnel blip recovers instead of failing
+    the round."""
+    attempts = int(os.environ.get('BENCH_DEVICE_ATTEMPTS', '3'))
+    detail = _probe_device(timeout_s, attempts)
+    if detail is None:
         return
     print(json.dumps({
         'metric': 'bench_error',
         'value': 0.0,
         'unit': 'error',
         'vs_baseline': 0.0,
-        'detail': {
-            'error': ('device unreachable: probe did not '
-                      f'complete in {timeout_s:.0f}s (TPU '
-                      'tunnel/relay dead?)' if not got
-                      else repr(got[0])[:300]),
-        },
+        'detail': detail,
     }))
     sys.stdout.flush()
     # os._exit, NOT sys.exit: interpreter finalization would wait on

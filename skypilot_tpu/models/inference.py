@@ -112,10 +112,11 @@ def _mlp_delta(h: jax.Array, lp: Dict, cfg: LlamaConfig,
 # prefill, and each decode write flips its column on for the rows
 # that were active that step. The mask (B*S bits — negligible HBM)
 # is what makes *continuous batching* exact: when ServingEngine
-# recycles a batch slot for a new request (insert_prefill), clearing
-# the row's mask makes every stale decode slot of the previous
-# occupant unreadable, with no cache rewrite. Per-row raggedness
-# lives in the mask and the RoPE positions.
+# recycles a batch slot for a new request, the first prefill chunk
+# (prefill_chunk, start == 0) clearing the row's mask makes every
+# stale decode slot of the previous occupant unreadable, with no
+# cache rewrite. Per-row raggedness lives in the mask and the RoPE
+# positions.
 
 
 def _constrain(x, spec, mesh):
@@ -436,31 +437,208 @@ def decode_step(params: Dict,
     return logits, new_cache
 
 
-def insert_prefill(cache: Dict, one: Dict, slot: jax.Array) -> Dict:
-    """Insert a single-request prefill cache into batch slot ``slot``.
+def prefill_chunk(params: Dict,
+                  cache: Dict,
+                  tokens: jax.Array,
+                  starts: jax.Array,
+                  lens: jax.Array,
+                  live: jax.Array,
+                  slots: jax.Array,
+                  cfg: LlamaConfig,
+                  *,
+                  prompt_base: int,
+                  mesh=None) -> Tuple[jax.Array, Dict]:
+    """Process one prompt *chunk* per row directly into the batch
+    cache — the chunked-prefill primitive (Sarathi-style): instead of
+    a monolithic whole-prompt prefill + ``insert_prefill`` copy, the
+    serving engine streams each prompt through here ``C`` tokens at a
+    time, so prefill work coalesces with decode ticks under a token
+    budget and never stalls in-flight decodes.
 
-    The continuous-batching primitive (JetStream's insert): ``one`` is
-    a batch-1 cache from ``prefill`` whose max_seq (the padded prompt
-    bucket) must be <= the batch cache's prompt region ``base``. All
-    writes are dynamic_update_slice at a scalar batch index — in place
-    under donation. Clearing the row's dmask beyond the prompt makes
-    every decode slot of the slot's previous occupant unreadable.
+    tokens: [G, C] — row j holds prompt positions
+    [starts[j], starts[j] + lens[j]) of slot ``slots[j]``'s prompt,
+    right-padded to C. ``live``: [G] bool — padding rows (False) are
+    fully inert: their cache rows, dmask and length are bit-preserved
+    (rows may then safely repeat slot indices). ``prompt_base``
+    (static) is the cache's prompt region size (== engine
+    max_prompt); all chunk writes land below it.
+
+    Per layer the slot rows' prompt regions are gathered, the chunk's
+    K/V written at ``starts`` (quantized in place for int8 caches),
+    attention taken over [0, start + C) under the query-offset causal
+    rule (``ops.flash_attention.chunk_prefill_attention`` — Pallas
+    q-tiled kernel on TPU, exact einsum elsewhere/int8), and the
+    region scattered back. Positions past a partial chunk's ``len``
+    hold garbage K/V but stay dmask-false, and causality keeps them
+    out of every valid query's window — exactly the ragged-tail
+    discipline of monolithic ``prefill``.
+
+    Returns (logits [G, vocab] f32 at each row's last valid chunk
+    position — the next-token logits when the chunk completes its
+    prompt — and the updated cache). Like ``prefill``, activations
+    take the int8 path when ``cfg.prefill_a8``.
     """
-    p1 = one['k'].shape[2]
+    # Direct-from-module import: the ops package re-exports a
+    # ``flash_attention`` *function* under the module's name, so a
+    # ``from skypilot_tpu.ops import flash_attention`` would bind the
+    # function, not the module.
+    from skypilot_tpu.ops.flash_attention import chunk_prefill_attention
+    cdt = cfg.compute_dtype
+    g, c = tokens.shape
+    quant = 'k_scale' in cache
     s_max = cache['k'].shape[2]
-    new = dict(cache)
-    for f in ('k', 'v', 'k_scale', 'v_scale'):
-        if f in cache:
-            block = one[f].astype(cache[f].dtype)
-            start = (0, slot, 0) + (0,) * (cache[f].ndim - 3)
-            new[f] = lax.dynamic_update_slice(cache[f], block, start)
-    row_mask = jnp.pad(one['dmask'], ((0, 0), (0, s_max - p1)))
-    new['dmask'] = lax.dynamic_update_slice(cache['dmask'], row_mask,
-                                            (slot, 0))
-    new['length'] = lax.dynamic_update_slice(
-        cache['length'], one['length'].astype(cache['length'].dtype),
-        (slot,))
-    return new
+    base = prompt_base
+    assert 0 < base <= s_max, (base, s_max)
+    n_kv, hd = cfg.n_kv_heads, cfg.head_dim
+    positions = (starts[:, None] +
+                 jnp.arange(c, dtype=jnp.int32)[None, :])
+    starts = starts.astype(jnp.int32)
+    dot = qdot_a8 if cfg.prefill_a8 else qdot
+
+    x = qembed(params['tok_emb'], tokens, cdt)       # [G, C, D]
+
+    def _gather_rows(layer_cache):
+        """[B, S, ...] -> [G, base+C, ...] slot rows padded with
+        ``c`` slots of chunk headroom so a C-wide write at start <=
+        base-1 never clamps (clamping would silently overwrite
+        earlier prompt positions)."""
+        rows = jnp.take(layer_cache[:, :base], slots, axis=0)
+        pad = [(0, 0), (0, c)] + [(0, 0)] * (rows.ndim - 2)
+        return jnp.pad(rows, pad)
+
+    def _scatter_rows(layer_cache, rows):
+        """Write rows' [0:base] regions back at their slots. Static
+        unroll with a fresh read per row: dead (live=False) rows keep
+        the cache's CURRENT content even when they duplicate a live
+        row's slot index (a vector scatter with duplicate indices has
+        unspecified order and could revert a live write)."""
+        region = (1, base) + layer_cache.shape[2:]
+        for j in range(g):
+            start = (slots[j],) + (0,) * (layer_cache.ndim - 1)
+            cur = lax.dynamic_slice(layer_cache, start, region)
+            new = jnp.where(live[j], rows[j:j + 1, :base], cur)
+            layer_cache = lax.dynamic_update_slice(
+                layer_cache, new.astype(layer_cache.dtype), start)
+        return layer_cache
+
+    def layer(carry, inp):
+        if quant:
+            x, kc, vc, ksc, vsc = carry
+        else:
+            x, kc, vc = carry
+            ksc = vsc = None
+        lp, li = inp
+        h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
+        q = dot(h, lp['wq'], cdt).reshape(g, c, cfg.n_heads, hd)
+        k = dot(h, lp['wk'], cdt).reshape(g, c, n_kv, hd)
+        v = dot(h, lp['wv'], cdt).reshape(g, c, n_kv, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        kc_l = lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+        vc_l = lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+        rows_k = _gather_rows(kc_l)                  # [G, base+C, ...]
+        rows_v = _gather_rows(vc_l)
+        if quant:
+            ksc_l = lax.dynamic_index_in_dim(ksc, li, 0,
+                                             keepdims=False)
+            vsc_l = lax.dynamic_index_in_dim(vsc, li, 0,
+                                             keepdims=False)
+            rows_ks = _gather_rows(ksc_l)
+            rows_vs = _gather_rows(vsc_l)
+            wk, sk = _quantize_kv(k)
+            wv, sv = _quantize_kv(v)
+        else:
+            rows_ks = rows_vs = None
+            wk, wv, sk, sv = k, v, None, None
+        # Write this chunk's K/V at each row's start (scales too);
+        # the write is C wide, so a partial chunk leaves garbage in
+        # its tail — causally invisible, dmask-false.
+        wrt = jax.vmap(lambda row, blk, st: lax.dynamic_update_slice(
+            row, blk.astype(row.dtype), (st,) + (0,) * (row.ndim - 1)))
+        rows_k = wrt(rows_k, wk, starts)
+        rows_v = wrt(rows_v, wv, starts)
+        if quant:
+            rows_ks = wrt(rows_ks, sk, starts)
+            rows_vs = wrt(rows_vs, sv, starts)
+        o = chunk_prefill_attention(
+            q, rows_k, rows_v, starts, rows_ks, rows_vs,
+            impl=None if mesh is None else 'xla')
+        o = o.reshape(g, c, cfg.n_heads * hd).astype(cdt)
+        x = x + dot(o, lp['wo'], cdt)
+
+        h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
+        x = x + _mlp_delta(h, lp, cfg, dot=dot)
+
+        kc_l = _scatter_rows(kc_l, rows_k)
+        vc_l = _scatter_rows(vc_l, rows_v)
+        kc = lax.dynamic_update_slice(
+            kc, kc_l[None], (li,) + (0,) * (kc.ndim - 1))
+        vc = lax.dynamic_update_slice(
+            vc, vc_l[None], (li,) + (0,) * (vc.ndim - 1))
+        if quant:
+            ksc_l = _scatter_rows(ksc_l, rows_ks)
+            vsc_l = _scatter_rows(vsc_l, rows_vs)
+            ksc = lax.dynamic_update_slice(
+                ksc, ksc_l[None], (li,) + (0,) * (ksc.ndim - 1))
+            vsc = lax.dynamic_update_slice(
+                vsc, vsc_l[None], (li,) + (0,) * (vsc.ndim - 1))
+            return (x, kc, vc, ksc, vsc), None
+        return (x, kc, vc), None
+
+    if quant:
+        carry0 = (x, cache['k'], cache['v'], cache['k_scale'],
+                  cache['v_scale'])
+    else:
+        carry0 = (x, cache['k'], cache['v'])
+    out_carry, _ = lax.scan(
+        layer, carry0, (params['layers'], jnp.arange(cfg.n_layers)))
+    if quant:
+        x, ks, vs, sks, svs = out_carry
+    else:
+        (x, ks, vs), sks, svs = out_carry, None, None
+
+    x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(lens - 1, 0)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    logits = qdot(last, params['lm_head'], cdt, preferred=jnp.float32)
+
+    # dmask/length updates: unrolled with fresh reads per row for the
+    # same duplicate-slot safety as _scatter_rows. A dead row's
+    # ``newly`` mask is all-False and its length keeps the current
+    # value, so padding rows are exact no-ops. A prompt's FIRST chunk
+    # (start == 0) clears the whole row before setting its own
+    # positions — the slot-recycling guarantee ``insert_prefill``
+    # gave: every decode slot and prompt-tail position of the
+    # previous occupant becomes unreadable, with no cache rewrite.
+    dmask, lengths = cache['dmask'], cache['length']
+    pos_idx = jnp.arange(s_max, dtype=jnp.int32)
+    for j in range(g):
+        newly = (live[j] & (pos_idx >= starts[j]) &
+                 (pos_idx < starts[j] + lens[j]))
+        cur = lax.dynamic_slice(dmask, (slots[j], 0), (1, s_max))
+        cur = jnp.where(live[j] & (starts[j] == 0),
+                        jnp.zeros_like(cur), cur)
+        dmask = lax.dynamic_update_slice(dmask, cur | newly[None],
+                                         (slots[j], 0))
+        cur_len = lax.dynamic_slice(lengths, (slots[j],), (1,))
+        new_len = jnp.where(live[j],
+                            (starts[j] + lens[j]).astype(lengths.dtype),
+                            cur_len[0])
+        lengths = lax.dynamic_update_slice(lengths, new_len[None],
+                                           (slots[j],))
+
+    new_cache = {'k': _constrain(ks, CACHE_SPEC, mesh),
+                 'v': _constrain(vs, CACHE_SPEC, mesh),
+                 'length': lengths,
+                 'dmask': _constrain(dmask, P(('dp', 'fsdp'), None),
+                                     mesh),
+                 'base': cache['base'], 'steps': cache['steps']}
+    if quant:
+        new_cache['k_scale'] = _constrain(sks, SCALE_SPEC, mesh)
+        new_cache['v_scale'] = _constrain(svs, SCALE_SPEC, mesh)
+    return logits, new_cache
 
 
 def _sample(logits, key, temperature, top_k: int):

@@ -11,28 +11,41 @@ what the decode step sustains. This engine recycles slots:
 - a fixed decode batch of ``batch_size`` slots, one traced
   ``decode_step`` program regardless of which slots are live
   (``active`` mask — no recompiles as load varies);
-- per-request prefill at bucketed prompt lengths (powers of two up to
-  ``max_prompt``), inserted into a free slot with
-  ``inference.insert_prefill`` — dynamic_update_slice at the batch
-  index, in place under donation;
+- **chunked prefill with a token-budgeted mixed scheduler**
+  (Sarathi-Serve, Agrawal et al., OSDI '24): an admitted prompt is
+  not prefilled monolithically — it streams into its slot's
+  prompt-region KV ``prefill_chunk`` tokens per tick
+  (``inference.prefill_chunk``), coalesced INTO the same fused device
+  program as the decode chunk for active slots. Per tick at most
+  ``prefill_budget`` prompt tokens are processed across prefilling
+  slots, so inter-token latency of in-flight decodes is bounded by
+  the tick budget, never by a co-admitted prompt's length. This also
+  kills the old power-of-two prefill buckets: ONE chunk shape serves
+  any prompt length <= max_prompt with zero padding waste, instead
+  of log2(max_prompt) bucket programs padded up to 2x.
 - slot validity via the cache's dmask, so a recycled slot never reads
   its previous occupant's K/V;
 - optional int8 KV cache (``kv_quant=True``): half the decode
   bandwidth, which at fixed HBM doubles ``batch_size``;
 - double-buffered dispatch: the next-token vector lives on device, so
-  ``step()`` dispatches decode chunk N+1 before syncing chunk N —
-  host-side work (result attribution, admission grouping, HTTP
-  serving, streaming callbacks) overlaps device decode instead of
-  stalling it. Prefill-sampled first tokens flow into the decode
-  chain on device; their host values sync lazily for emission.
+  ``step()`` dispatches tick N+1 before syncing tick N — host-side
+  work (result attribution, admission grouping, HTTP serving,
+  streaming callbacks) overlaps device work instead of stalling it.
+  Prefill-sampled first tokens flow into the decode chain on device;
+  their host values sync lazily for emission.
 
 Decode capacity: every engine decode step consumes one shared cache
 slot (the scalar-write-slot design that keeps the step
-bandwidth-bound — see inference.decode_step). A request admitted when
-``remaining_slots() >= max_new`` is guaranteed to finish; when the
-region is exhausted and all slots are idle the engine resets the
-cache (steps=0) and keeps admitting. Size ``max_seq`` several times
-the typical ``max_new`` so resets are rare.
+bandwidth-bound — see inference.decode_step). Admission accounts for
+the decode steps other slots will burn while a prompt is still
+prefilling: a request is admitted only when
+``max_new + ceil(prompt/chunk) * decode_chunk`` fits the remaining
+region (or ``max_new`` alone when it would run solo — prefill-only
+ticks dispatch no decode steps), which preserves the old guarantee
+that every admitted request finishes. When the region is exhausted
+and all slots are idle the engine resets the cache (steps=0) and
+keeps admitting. Size ``max_seq`` several times the typical
+``max_new`` so resets are rare.
 """
 from __future__ import annotations
 
@@ -50,6 +63,7 @@ from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.models import inference
 from skypilot_tpu.models.llama import LlamaConfig
+from skypilot_tpu.utils import env_registry
 
 # Serving metrics (docs/metrics.md): host-side only — nothing here
 # touches the jitted programs, and each update is one dict op under a
@@ -66,12 +80,23 @@ _M_REQUESTS = metrics_lib.counter(
 _M_TOKENS = metrics_lib.counter(
     'skytpu_engine_tokens_total',
     'Output tokens emitted to requests (rate() of this is tokens/s).')
+_M_PREFILL_TOKENS = metrics_lib.counter(
+    'skytpu_engine_prefill_tokens_total',
+    'Prompt tokens prefilled into slot KV caches (chunked prefill; '
+    'per tick this never exceeds the prefill token budget).')
 _M_RESETS = metrics_lib.counter(
     'skytpu_engine_cache_resets_total',
     'KV-cache rebuilds after decode-region exhaustion.')
 _M_TTFT = metrics_lib.histogram(
     'skytpu_engine_ttft_seconds',
     'Submit-to-first-token latency (queue wait + prefill + sync).',
+    buckets=metrics_lib.LATENCY_BUCKETS)
+_M_ITL = metrics_lib.histogram(
+    'skytpu_engine_itl_seconds',
+    'Inter-token latency: gap between consecutive token batches '
+    'surfaced to one request (the streaming stall a client feels). '
+    'With chunked prefill its p99 is bounded by the tick budget, not '
+    'by co-admitted prompt lengths.',
     buckets=metrics_lib.LATENCY_BUCKETS)
 _M_TOKEN_LATENCY = metrics_lib.histogram(
     'skytpu_engine_per_token_seconds',
@@ -97,15 +122,21 @@ class _SlotState:
     request_id: Any
     max_new: int
     generated: List[int]
-    # Device ref (array, row) to the prefill-sampled first token;
-    # synced lazily when the slot's first decode chunk is processed,
-    # so admission never blocks the pipeline on a host round-trip.
-    first_ref: Optional[tuple]
+    # The request's prompt tokens: the chunked prefill feeds
+    # ``prefill_chunk``-sized slices of these per tick while
+    # ``phase == 'prefill'``; ``prefill_pos`` is the cursor.
+    prompt: List[int]
     prompt_len: int = 0
-    # Occupancy generation: a decode chunk snapshot only credits its
-    # tokens to a slot whose epoch still matches — a slot freed and
-    # re-admitted while the chunk was in flight discards them.
+    phase: str = 'prefill'         # 'prefill' -> 'decode'
+    prefill_pos: int = 0
+    # Admission order: prefill scheduling is FIFO across slots.
+    seq: int = 0
+    # Occupancy generation: a tick snapshot only credits its tokens
+    # to a slot whose epoch still matches — a slot freed and
+    # re-admitted while the tick was in flight discards them.
     epoch: int = 0
+    # perf_counter of the last host-side token emission (ITL anchor).
+    last_emit_at: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -115,15 +146,6 @@ class Result:
     prompt_len: int
     submitted_at: float
     finished_at: float
-
-
-def _buckets(max_prompt: int) -> List[int]:
-    out, b = [], 32
-    while b < max_prompt:
-        out.append(b)
-        b *= 2
-    out.append(max_prompt)
-    return out
 
 
 class ServingEngine:
@@ -144,7 +166,9 @@ class ServingEngine:
                  mesh=None,
                  page: Optional[int] = None,
                  decode_attn: Optional[str] = None,
-                 paged_dispatch: bool = True) -> None:
+                 paged_dispatch: bool = True,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_budget: Optional[int] = None) -> None:
         # ``mesh``: serve a model larger than one chip — params shard
         # Megatron-style (tp on heads/ffn/vocab) and the KV cache's
         # kv-head axis shards over 'tp' (inference.CACHE_SPEC), the
@@ -227,11 +251,22 @@ class ServingEngine:
         # steps per request), but host dispatch/transfer amortizes
         # chunk-fold. 8 balances the two for max_new ~100s.
         self.decode_chunk = max(1, decode_chunk)
-        self.buckets = _buckets(max_prompt)
-        # Admissions go to the device in fixed-size groups (padded by
-        # repetition) so each prompt bucket compiles exactly one
-        # prefill+insert program.
-        self.admit_group = min(8, batch_size)
+        # Chunked-prefill knobs (SKYTPU_PREFILL_CHUNK /
+        # SKYTPU_PREFILL_BUDGET): prompts stream into their slot's KV
+        # ``prefill_chunk`` tokens per tick; at most ``prefill_budget``
+        # prompt tokens are processed per tick across all prefilling
+        # slots. The budget folds to whole chunk rows
+        # (G = budget // chunk rows of fixed [G, chunk] shape), so
+        # exactly ONE prefill program shape exists — the pow2 bucket
+        # set is gone.
+        chunk = prefill_chunk or int(env_registry.get(
+            env_registry.SKYTPU_PREFILL_CHUNK, '128'))
+        budget = prefill_budget or int(env_registry.get(
+            env_registry.SKYTPU_PREFILL_BUDGET, '256'))
+        self.prefill_chunk = max(1, min(chunk, max_prompt))
+        self._prefill_rows = max(
+            1, min(budget // self.prefill_chunk, batch_size))
+        self.prefill_budget = self._prefill_rows * self.prefill_chunk
 
         self.queue: collections.deque = collections.deque()
         self.slots: List[Optional[_SlotState]] = [None] * batch_size
@@ -241,22 +276,24 @@ class ServingEngine:
         # when tracing is enabled at submit() and the engine is not
         # warming: {'request', 'queue', 'prefill', 'first_chunk'}
         # spans keyed by request_id. These decompose TTFT —
-        # queue-wait, prefill dispatch, first-chunk decode — and the
-        # request span's start is the single timing source the TTFT
-        # histogram observes (with the trace id as exemplar).
+        # queue-wait, chunked prefill (with one subspan per dispatched
+        # chunk), first-chunk decode — and the request span's start is
+        # the single timing source the TTFT histogram observes (with
+        # the trace id as exemplar).
         self._req_spans: Dict[Any, Dict[str, Any]] = {}
         self._key = jax.random.PRNGKey(0)
         self._steps_done = 0
         self._epoch = 0
-        # The in-flight decode chunk (double buffering): step()
-        # dispatches chunk N+1 to the device BEFORE syncing chunk N's
-        # tokens, so host work — result sync, admission grouping, HTTP
-        # handling between ticks — overlaps device decode instead of
-        # serializing with it.
+        self._seq = 0
+        # The in-flight tick (double buffering): step() dispatches
+        # tick N+1 to the device BEFORE syncing tick N's tokens, so
+        # host work — result sync, admission grouping, HTTP handling
+        # between ticks — overlaps device work instead of serializing
+        # with it.
         self._pending: Optional[Dict[str, Any]] = None
         # Optional streaming hook: called on the driving thread as
         # on_token(request_id, [new tokens]) every time a live
-        # request's tokens reach the host (per decode chunk).
+        # request's tokens reach the host (per tick).
         self.on_token: Optional[Callable[[Any, List[int]], None]] = None
 
         cdt = cfg.compute_dtype
@@ -293,49 +330,14 @@ class ServingEngine:
         self._make_empty = _make_empty
         self.cache = _make_empty()
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def _prefill_insert(params, cache, cur_tokens, tokens, lengths,
-                            slots, key, temperature):
-            """Prefill a group of same-bucket prompts and insert each
-            into its batch slot — ONE device call per admission group
-            (per-request calls would pay a host round-trip each, which
-            dominates serving latency on high-dispatch-cost links).
-            tokens: [m, bucket]; slots: [m]; cur_tokens: the
-            device-resident [B] next-token vector, updated in place so
-            the following decode chunk can consume the prefill-sampled
-            first tokens WITHOUT a host sync. Returns (cache,
-            cur_tokens, firsts).
-            """
-            logits, group = inference.prefill(
-                params, tokens, lengths, self.cfg, mesh=self.mesh,
-                max_seq=tokens.shape[1], kv_quant=self.kv_quant)
-            firsts = inference._sample(logits, key, temperature,
-                                       self.top_k)
-            m = tokens.shape[0]
-            for j in range(m):  # static unroll: m <= batch_size
-                # Batch axis is second for k/v/scales ([L, B, S, ...]),
-                # first for length/dmask.
-                one = {
-                    f: (group[f][:, j:j + 1]
-                        if f in ('k', 'v', 'k_scale', 'v_scale')
-                        else group[f][j:j + 1])
-                    for f in group if f not in ('base', 'steps')
-                }
-                one['base'] = group['base']
-                cache = inference.insert_prefill(cache, one, slots[j])
-            cur_tokens = cur_tokens.at[slots].set(firsts)
-            return cache, cur_tokens, firsts
-
-        self._prefill_insert = _prefill_insert
-
-        @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnames=('n', 'num_pages'))
-        def _decode(params, cache, tokens, active, key, temperature,
-                    *, n, num_pages=None):
+        def _decode_scan(params, cache, tokens, active, key,
+                         temperature, n, num_pages):
             """Scan ``n`` decode steps on device, feeding each sampled
-            token forward; one host sync per call, not per token.
-            ``num_pages`` (static) bounds the cache region attention
-            reads — the length-aware dispatch knob."""
+            token forward; shared by the decode-only and the mixed
+            tick programs. ``num_pages`` (static) bounds the cache
+            region attention reads — the length-aware dispatch knob.
+            ``n == 0`` (static) skips the scan entirely (prefill-only
+            ticks)."""
 
             def body(carry, _):
                 cache, tok, key = carry
@@ -346,17 +348,68 @@ class ServingEngine:
                     num_pages=num_pages, page=self._page)
                 nxt = inference._sample(logits, sub, temperature,
                                         self.top_k)
+                # Inactive rows FREEZE their token chain: a slot that
+                # completed its prefill this very tick holds its
+                # sampled first token in the vector and joins the
+                # active mask only next tick — the scan must not
+                # clobber it with garbage samples from its idle row.
+                nxt = jnp.where(active, nxt, tok)
                 return (cache, nxt, key), nxt
 
             (cache, last, _), toks = jax.lax.scan(
                 body, (cache, tokens, key), None, length=n)
             return cache, toks, last    # toks: [n, B]; last: [B]
 
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=('n', 'num_pages'))
+        def _decode(params, cache, tokens, active, key, temperature,
+                    *, n, num_pages=None):
+            """Decode-only tick: one host sync per ``n`` steps, not
+            per token."""
+            return _decode_scan(params, cache, tokens, active, key,
+                                temperature, n, num_pages)
+
         self._decode = _decode
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
+                           static_argnames=('n', 'num_pages'))
+        def _mixed(params, cache, cur_tokens, ctoks, cstarts, clens,
+                   clive, clast, cslots, active, key, temperature, *,
+                   n, num_pages=None):
+            """ONE fused mixed tick: up to G prefill chunk rows
+            (inference.prefill_chunk — [G, C] statically shaped, the
+            per-tick token budget) PLUS the ``n``-step decode scan
+            for active slots, one device round-trip total. Rows whose
+            chunk completes its prompt (``clast``) get a first token
+            sampled from the chunk's last-position logits, folded
+            into the device-resident next-token vector so the
+            following decode chunk consumes it WITHOUT a host sync;
+            host values sync lazily for emission. Prefilling slots
+            are decode-inactive, so chunk writes and decode
+            reads/writes never touch the same row."""
+            key_p, key_d = jax.random.split(key)
+            logits, cache = inference.prefill_chunk(
+                params, cache, ctoks, cstarts, clens, clive,
+                cslots, self.cfg, prompt_base=self.max_prompt,
+                mesh=self.mesh)
+            firsts = inference._sample(logits, key_p,
+                                       temperature[cslots], self.top_k)
+            take = clive & clast
+            for j in range(self._prefill_rows):  # static unroll
+                cur_tokens = jnp.where(
+                    take[j],
+                    cur_tokens.at[cslots[j]].set(firsts[j]),
+                    cur_tokens)
+            cache, toks, last = _decode_scan(
+                params, cache, cur_tokens, active, key_d, temperature,
+                n, num_pages)
+            return cache, toks, last, firsts
+
+        self._mixed = _mixed
         # Per-slot current token fed into the next decode step —
         # DEVICE-resident: the token chain between chunks (and from
         # prefill into the first chunk) resolves on device, which is
-        # what lets chunk N+1 dispatch before chunk N's host sync.
+        # what lets tick N+1 dispatch before tick N's host sync.
         self._tokens_dev = jnp.zeros((batch_size,), jnp.int32)
         # Per-slot sampling temperature (requests may override the
         # engine default; temperature is traced, so this never
@@ -372,37 +425,58 @@ class ServingEngine:
         self._warming = False
         # Previous step() timestamp, the per-token latency anchor.
         self._last_tick_at: Optional[float] = None
+        # Per-tick prefill-token accounting (bench serve reports
+        # these; the budget invariant is last <= prefill_budget).
+        self.last_tick_prefill_tokens = 0
+        self.max_tick_prefill_tokens = 0
+        self.prefill_tokens_total = 0
+        self.prefill_ticks = 0
 
     # ------------------------------------------------------------------
     def warmup(self) -> None:
-        """Compile every program a serving run can hit (one per prompt
-        bucket, plus the decode chunks), then reset. Without this the
-        first request of each shape pays multi-second XLA compiles
-        inside its serving latency."""
+        """Compile every program a serving run can hit, then reset.
+        Without this the first request of each shape pays
+        multi-second XLA compiles inside its serving latency.
+
+        Compile-count math: the chunked scheduler needs the
+        decode-only and the mixed program per reachable
+        (decode-steps, page-count) static pair, plus one prefill-only
+        mixed program — 2 * |pairs| + 1, where |pairs| is
+        log2-bounded exactly as before. The old monolithic admission
+        additionally compiled one prefill+insert program per
+        power-of-two prompt bucket; those are gone (one [G, C] chunk
+        shape serves every prompt length)."""
         import numpy as _np
         rng = _np.random.default_rng(0)
-        # Every admission call is padded to (admit_group, bucket), so
-        # one request per bucket compiles its whole program.
+        # One full-length and one sub-chunk prompt: exercises the
+        # host paths end to end (multi-chunk prefill, completion,
+        # decode handoff); every device program is then compiled
+        # explicitly below.
         reqs = [
-            Request(('warmup', b),
-                    list(rng.integers(0, self.cfg.vocab_size, b)),
-                    max_new=2) for b in self.buckets
+            Request(('warmup', 0),
+                    list(rng.integers(0, self.cfg.vocab_size,
+                                      self.max_prompt)), max_new=2),
+            Request(('warmup', 1),
+                    list(rng.integers(
+                        0, self.cfg.vocab_size,
+                        max(1, self.prefill_chunk // 2))), max_new=2),
         ]
         self._warming = True
         try:
             self.run(reqs)
         finally:
             self._warming = False
-        # Also compile every (chunk size, page count) static-arg pair
-        # a run can dispatch, so no XLA compile ever lands inside a
-        # live request's latency. Chunk sizes fold to powers of two
-        # exactly as step() does. The main chunk runs at any
-        # occupancy (page-stride enumeration — the page count only
-        # changes at page boundaries, and num_pages_for's pow2
-        # headroom rounding keeps the set log2-bounded); tail chunks
-        # fold only near region exhaustion, where remaining slots are
-        # in [n, 2n) — the count is monotone in occupancy, so that
-        # window's endpoints cover it.
+        # Compile every (chunk size, page count) static-arg pair a
+        # run can dispatch — for BOTH tick programs — so no XLA
+        # compile ever lands inside a live request's latency. Chunk
+        # sizes fold to powers of two exactly as step() does. The
+        # main chunk runs at any occupancy (page-stride enumeration —
+        # the page count only changes at page boundaries, and
+        # num_pages_for's pow2 headroom rounding keeps the set
+        # log2-bounded); tail chunks fold only near region
+        # exhaustion, where remaining slots are in [n, 2n) — the
+        # count is monotone in occupancy, so that window's endpoints
+        # cover it.
         n = self.decode_chunk
         while n & (n - 1):
             n &= n - 1
@@ -425,11 +499,31 @@ class ServingEngine:
             n //= 2
             pairs.add((n, count_for(max(0, cap - 2 * n + 1), n)))
             pairs.add((n, count_for(max(0, cap - n), n)))
+        # Prefill-only mixed ticks dispatch with (n=0, num_pages=None)
+        # — the canonical pair for "no decode scan this tick".
+        mixed_pairs = sorted(pairs, key=lambda t: (t[0], t[1] or 0))
+        mixed_pairs.insert(0, (0, None))
+        # One live single-token chunk row aimed at slot 0 (the cache
+        # is dirtied, then reset below): compiles the mixed program
+        # for every pair without touching real requests.
+        g, c = self._prefill_rows, self.prefill_chunk
+        chunk_args = (jnp.zeros((g, c), jnp.int32),
+                      jnp.zeros((g,), jnp.int32),
+                      jnp.ones((g,), jnp.int32),
+                      jnp.zeros((g,), bool).at[0].set(True),
+                      jnp.zeros((g,), bool),
+                      jnp.zeros((g,), jnp.int32))
+        no_active = jnp.zeros((self.batch_size,), bool)
         for n_, np_ in sorted(pairs, key=lambda t: (t[0], t[1] or 0)):
             self._key, sub = jax.random.split(self._key)
             self.cache, _, self._tokens_dev = self._decode(
+                self.params, self.cache, self._tokens_dev, no_active,
+                sub, jnp.asarray(self._temps), n=n_, num_pages=np_)
+        for n_, np_ in mixed_pairs:
+            self._key, sub = jax.random.split(self._key)
+            self.cache, _, self._tokens_dev, _ = self._mixed(
                 self.params, self.cache, self._tokens_dev,
-                jnp.zeros((self.batch_size,), bool), sub,
+                *chunk_args, no_active, sub,
                 jnp.asarray(self._temps), n=n_, num_pages=np_)
         self.reset()
 
@@ -446,10 +540,17 @@ class ServingEngine:
         self.results = {}
 
     def submit(self, request: Request) -> None:
+        if len(request.tokens) == 0:
+            raise ValueError(
+                'empty prompt: a request needs at least one token '
+                '(prefill has no position to sample from).')
         if len(request.tokens) > self.max_prompt:
             raise ValueError(
                 f'prompt ({len(request.tokens)}) exceeds max_prompt '
                 f'({self.max_prompt}).')
+        if request.max_new <= 0:
+            raise ValueError(
+                f'max_new ({request.max_new}) must be positive.')
         if request.max_new > self.decode_capacity():
             raise ValueError(
                 f'max_new ({request.max_new}) exceeds the decode '
@@ -497,100 +598,91 @@ class ServingEngine:
         return sum(s is not None for s in self.slots)
 
     # ------------------------------------------------------------------
-    def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        raise AssertionError(n)
+    def _prefill_ticks(self, tokens_left: int) -> int:
+        return -(-tokens_left // self.prefill_chunk)
+
+    def _fits(self, req: Request) -> bool:
+        """May ``req`` be admitted without breaking the finish
+        guarantee? Invariant: at every tick the remaining decode
+        region covers the worst-case steps any occupied slot still
+        needs — ``max_new`` left to decode plus ``decode_chunk``
+        region steps other slots may burn per remaining prefill tick
+        (the scheduler prefills every prefilling slot every tick, so
+        admission caps prefilling slots at the budget's row count and
+        the tick estimate is exact). Each tick consumes n <=
+        decode_chunk while every slot's outstanding drops by >= n, so
+        the invariant is preserved once established at admission.
+        Solo exception: with no co-resident slots, prefill ticks
+        dispatch no decode steps, so a lone request only needs
+        ``max_new`` — which keeps max_new == capacity admissible."""
+        remaining = self.remaining_slots()
+        occupied = [s for s in self.slots if s is not None]
+        if not occupied:
+            return req.max_new <= remaining
+        charge = (req.max_new + self._prefill_ticks(len(req.tokens)) *
+                  self.decode_chunk)
+        if charge > remaining:
+            return False
+        for s in occupied:
+            left = s.max_new - len(s.generated)
+            if s.phase == 'prefill':
+                left += (self._prefill_ticks(
+                    s.prompt_len - s.prefill_pos) * self.decode_chunk)
+            if left > remaining:
+                # An earlier solo admission's full (co-resident)
+                # charge no longer fits: adding a decoder now could
+                # strand it mid-prefill.
+                return False
+        return True
 
     def _admit(self) -> None:
-        """Fill free slots from the queue, grouped by prompt bucket so
-        each group costs one fused prefill+insert device call."""
-        admits = []
-        for slot_idx, state in enumerate(self.slots):
-            if state is not None or not self.queue:
-                continue
-            if self.queue[0].max_new > self.remaining_slots():
-                if (self.num_active() == 0 and not admits and
-                        self._pending is None):
-                    # Region exhausted, nothing running (and no chunk
+        """Move queued requests into free slots (FIFO, host-side only
+        — no device call: prefill happens chunk-by-chunk in the tick
+        loop). Prefilling slots are capped at the budget's row count
+        so every one of them is scheduled every tick."""
+        free = [i for i, s in enumerate(self.slots) if s is None]
+        n_prefilling = sum(1 for s in self.slots
+                           if s is not None and s.phase == 'prefill')
+        admitted = False
+        while (self.queue and free and
+               n_prefilling < self._prefill_rows):
+            req = self.queue[0]
+            if not self._fits(req):
+                if (self.num_active() == 0 and not admitted and
+                        self._pending is None and self._steps_done):
+                    # Region exhausted, nothing running (and no tick
                     # still in flight): fresh cache (old one dropped
-                    # first — see reset()).
+                    # first — see reset()), then re-check the fit.
                     self.cache = None
                     self.cache = self._make_empty()
                     self._steps_done = 0
                     _M_RESETS.inc()
-                else:
-                    break  # wait for running requests to drain
-            admits.append((slot_idx, self.queue.popleft()))
-        if not admits:
-            return
-
-        groups: Dict[int, list] = collections.defaultdict(list)
-        for slot_idx, req in admits:
-            groups[self._bucket_for(len(req.tokens))].append(
-                (slot_idx, req))
-        chunks = []
-        for bucket, items in groups.items():
-            for i in range(0, len(items), self.admit_group):
-                chunks.append((bucket, items[i:i + self.admit_group]))
-        for bucket, items in chunks:
-            m = len(items)
-            # Pad every group to the fixed admit_group size by
-            # repeating the first entry (a duplicate insert rewrites
-            # the same slot with the same content): exactly ONE
-            # compiled program per bucket, all covered by warmup().
-            m_pad = self.admit_group
-            padded = items + [items[0]] * (m_pad - m)
-            tokens = np.zeros((m_pad, bucket), np.int32)
-            lengths = np.zeros((m_pad,), np.int32)
-            slot_arr = np.zeros((m_pad,), np.int32)
-            for j, (slot_idx, req) in enumerate(padded):
-                tokens[j, :len(req.tokens)] = req.tokens
-                lengths[j] = len(req.tokens)
-                slot_arr[j] = slot_idx
-            temps = np.asarray([
-                (req.temperature if req.temperature is not None
-                 else self.temperature) for _, req in padded
-            ], np.float32)
-            self._key, sub = jax.random.split(self._key)
+                    continue
+                break  # wait for running requests to drain
+            self.queue.popleft()
+            slot_idx = free.pop(0)
+            self._epoch += 1
+            self._seq += 1
+            self.slots[slot_idx] = _SlotState(
+                request_id=req.request_id, max_new=req.max_new,
+                generated=[], prompt=list(req.tokens),
+                prompt_len=len(req.tokens), phase='prefill',
+                prefill_pos=0, seq=self._seq, epoch=self._epoch)
+            self._temps[slot_idx] = (
+                req.temperature if req.temperature is not None
+                else self.temperature)
+            n_prefilling += 1
+            admitted = True
             # TTFT decomposition: queue-wait ends exactly where the
-            # prefill dispatch begins (no gap between the spans).
-            for _, req in items:
-                ts = self._req_spans.get(req.request_id)
-                if ts is not None:
-                    qs = ts.pop('queue', None)
-                    if qs is not None:
-                        qs.finish()
-                    ts['prefill'] = trace_lib.start_span(
-                        'engine.prefill', parent=ts['request'],
-                        bucket=bucket)
-            # Fully async: the prefill-sampled first tokens land in
-            # the device-resident token vector for the next decode
-            # chunk; the host-side values (for emission) sync lazily
-            # when that chunk's results are processed.
-            self.cache, self._tokens_dev, firsts = self._prefill_insert(
-                self.params, self.cache, self._tokens_dev,
-                jnp.asarray(tokens), jnp.asarray(lengths),
-                jnp.asarray(slot_arr), sub, jnp.asarray(temps))
-            for j, (slot_idx, req) in enumerate(items):
-                self._epoch += 1
-                self.slots[slot_idx] = _SlotState(
-                    request_id=req.request_id, max_new=req.max_new,
-                    generated=[], first_ref=(firsts, j),
-                    prompt_len=len(req.tokens), epoch=self._epoch)
-                self._temps[slot_idx] = temps[j]
-                ts = self._req_spans.get(req.request_id)
-                if ts is not None:
-                    ps = ts.pop('prefill', None)
-                    if ps is not None:
-                        # Host-side dispatch window: the device-side
-                        # prefill completion is folded into the
-                        # first-chunk span that starts here.
-                        ps.finish(slot=slot_idx)
-                    ts['first_chunk'] = trace_lib.start_span(
-                        'engine.decode.first_chunk',
-                        parent=ts['request'], slot=slot_idx)
+            # prefill phase begins (no gap between the spans).
+            ts = self._req_spans.get(req.request_id)
+            if ts is not None:
+                qs = ts.pop('queue', None)
+                if qs is not None:
+                    qs.finish()
+                ts['prefill'] = trace_lib.start_span(
+                    'engine.prefill', parent=ts['request'],
+                    slot=slot_idx, prompt_len=len(req.tokens))
 
     def _finish(self, slot_idx: int) -> None:
         state = self.slots[slot_idx]
@@ -621,19 +713,21 @@ class ServingEngine:
     def step(self) -> int:
         """One pipelined engine tick.
 
-        Admit queued requests, DISPATCH decode chunk N+1 (device),
-        then sync and process chunk N. The device is already decoding
-        the next chunk while the host attributes tokens, finishes
-        requests, runs streaming callbacks and serves HTTP — decode
-        never waits on host work (double buffering).
+        Admit queued requests, DISPATCH tick N+1 (device: up to
+        ``prefill_budget`` prompt tokens across prefilling slots
+        fused with the decode chunk for active slots), then sync and
+        process tick N. The device is already working on the next
+        tick while the host attributes tokens, finishes requests,
+        runs streaming callbacks and serves HTTP — device work never
+        waits on host work (double buffering).
 
         Results therefore surface one tick after their final decode
         chunk. Returns the number of tokens emitted this tick.
         """
         self._admit()
-        new_entry = self._dispatch_chunk()
+        new_entry = self._dispatch_tick()
         prev, self._pending = self._pending, new_entry
-        emitted = self._process_chunk(prev)
+        emitted = self._process_tick(prev)
         # Per-token latency at tick granularity: the interval between
         # consecutive ticks over the tokens this tick surfaced. Host
         # timestamps within one tick would be sync artifacts (a
@@ -650,94 +744,192 @@ class ServingEngine:
         return emitted
 
     def flush(self) -> int:
-        """Sync and process the in-flight chunk without dispatching a
+        """Sync and process the in-flight tick without dispatching a
         new one (pipeline drain at shutdown / idle)."""
         prev, self._pending = self._pending, None
-        return self._process_chunk(prev)
+        return self._process_tick(prev)
 
     @property
     def has_pending(self) -> bool:
         return self._pending is not None
 
-    def _dispatch_chunk(self) -> Optional[Dict[str, Any]]:
-        active_list = [s is not None for s in self.slots]
-        if not any(active_list):
+    def _dispatch_tick(self) -> Optional[Dict[str, Any]]:
+        active_list = [s is not None and s.phase == 'decode'
+                       for s in self.slots]
+        prefilling = sorted(
+            ((i, s) for i, s in enumerate(self.slots)
+             if s is not None and s.phase == 'prefill'),
+            key=lambda t: t[1].seq)
+        any_active = any(active_list)
+        if not prefilling and not any_active:
             return None
-        # Chunk size: bounded by global capacity (admission guarantees
-        # every active request fits in the remaining region) and kept
-        # to power-of-two tails so at most log2(chunk) programs exist.
-        n = min(self.decode_chunk, self.remaining_slots())
-        if n < 1:
-            # Region exhausted while slots are still occupied. Because
-            # slots free one tick AFTER their final chunk (pipelining),
-            # this is the normal end state of a request whose max_new
-            # consumed the region exactly: every active slot has
-            # already decoded its full max_new in flight — admission
-            # guarantees capacity ≥ the largest outstanding need, and
-            # all slots advance together. Dispatch nothing; processing
-            # the pending chunk frees them.
-            if self._pending is None:
-                raise RuntimeError(
-                    'capacity accounting violated: region exhausted '
-                    'with active slots and no chunk in flight')
+        # Decode chunk size: bounded by global capacity (admission
+        # guarantees every active request fits in the remaining
+        # region) and kept to power-of-two tails so at most
+        # log2(chunk) programs exist per tick flavor. Prefill-only
+        # ticks (or region-exhausted pipelining tails) run n == 0.
+        n = 0
+        if any_active:
+            n = min(self.decode_chunk, self.remaining_slots())
+            if n < 1:
+                # Region exhausted while slots are still occupied.
+                # Because slots free one tick AFTER their final chunk
+                # (pipelining), this is the normal end state of a
+                # request whose max_new consumed the region exactly:
+                # every active slot has already decoded its full
+                # max_new in flight — admission guarantees capacity
+                # >= the largest outstanding need, and all slots
+                # advance together. Dispatch no decode steps;
+                # processing the pending tick frees them.
+                if self._pending is None and not prefilling:
+                    raise RuntimeError(
+                        'capacity accounting violated: region '
+                        'exhausted with active slots and no tick in '
+                        'flight')
+                n = 0
+            while n & (n - 1):
+                n &= n - 1
+        if not prefilling and n == 0:
             return None
-        while n & (n - 1):
-            n &= n - 1
         self._key, sub = jax.random.split(self._key)
-        self.cache, toks, self._tokens_dev = self._decode(
-            self.params, self.cache, self._tokens_dev,
-            jnp.asarray(active_list), sub, jnp.asarray(self._temps),
-            n=n, num_pages=self._num_pages(n))
+        num_pages = self._num_pages(n) if n else None
+
+        if not prefilling:
+            # Decode-only fast path: identical to the pre-chunking
+            # engine's tick.
+            self.cache, toks, self._tokens_dev = self._decode(
+                self.params, self.cache, self._tokens_dev,
+                jnp.asarray(active_list), sub,
+                jnp.asarray(self._temps), n=n, num_pages=num_pages)
+            firsts = None
+            chunk_meta: List[Dict[str, Any]] = []
+            self.last_tick_prefill_tokens = 0
+        else:
+            g, c = self._prefill_rows, self.prefill_chunk
+            ctoks = np.zeros((g, c), np.int32)
+            cstarts = np.zeros((g,), np.int32)
+            clens = np.ones((g,), np.int32)   # dead rows: len 1 slack
+            clive = np.zeros((g,), bool)
+            clast = np.zeros((g,), bool)
+            cslots = np.zeros((g,), np.int32)
+            chunk_meta = []
+            budget_used = 0
+            for j, (slot_idx, st) in enumerate(prefilling[:g]):
+                ln = min(c, st.prompt_len - st.prefill_pos)
+                ctoks[j, :ln] = st.prompt[st.prefill_pos:
+                                          st.prefill_pos + ln]
+                cstarts[j] = st.prefill_pos
+                clens[j] = ln
+                clive[j] = True
+                clast[j] = st.prefill_pos + ln == st.prompt_len
+                cslots[j] = slot_idx
+                budget_used += ln
+                chunk_meta.append({
+                    'row': j, 'slot': slot_idx, 'epoch': st.epoch,
+                    'n': ln, 'last': bool(clast[j]),
+                    'start': int(st.prefill_pos)})
+            self.cache, toks, self._tokens_dev, firsts = self._mixed(
+                self.params, self.cache, self._tokens_dev,
+                jnp.asarray(ctoks), jnp.asarray(cstarts),
+                jnp.asarray(clens), jnp.asarray(clive),
+                jnp.asarray(clast), jnp.asarray(cslots),
+                jnp.asarray(active_list), sub,
+                jnp.asarray(self._temps), n=n, num_pages=num_pages)
+            # Host bookkeeping: advance cursors, flip completed slots
+            # into the decode phase (they join the active mask next
+            # tick; their first token is already in the device token
+            # vector), record spans.
+            self.last_tick_prefill_tokens = budget_used
+            if not self._warming:
+                _M_PREFILL_TOKENS.inc(budget_used)
+                self.prefill_tokens_total += budget_used
+                self.prefill_ticks += 1
+                self.max_tick_prefill_tokens = max(
+                    self.max_tick_prefill_tokens, budget_used)
+            for m in chunk_meta:
+                st = self.slots[m['slot']]
+                st.prefill_pos += m['n']
+                ts = self._req_spans.get(st.request_id)
+                if ts is not None and 'prefill' in ts:
+                    # Host-side dispatch window per chunk; the
+                    # device-side completion folds into the
+                    # first-chunk span started below.
+                    trace_lib.start_span(
+                        'engine.prefill.chunk', parent=ts['prefill'],
+                        start=m['start'], tokens=m['n'],
+                        slot=m['slot']).finish()
+                if m['last']:
+                    st.phase = 'decode'
+                    if ts is not None:
+                        ps = ts.pop('prefill', None)
+                        if ps is not None:
+                            ps.finish(chunks=self._prefill_ticks(
+                                st.prompt_len))
+                        ts['first_chunk'] = trace_lib.start_span(
+                            'engine.decode.first_chunk',
+                            parent=ts['request'], slot=m['slot'])
         self._steps_done += n
         # Snapshot which occupant each decoded column belongs to: by
-        # the time this chunk is synced the slot may have finished and
-        # been recycled (its column decoded garbage — discarded by the
-        # epoch check).
+        # the time this tick is synced the slot may have finished and
+        # been recycled (its column decoded garbage — discarded by
+        # the epoch check).
         snapshot = [(i, s.epoch) for i, s in enumerate(self.slots)
-                    if s is not None]
-        return {'toks': toks, 'n': n, 'snapshot': snapshot}
+                    if s is not None and active_list[i]]
+        return {'toks': toks, 'n': n, 'snapshot': snapshot,
+                'chunks': chunk_meta, 'firsts': firsts}
 
-    def _process_chunk(self, entry: Optional[Dict[str, Any]]) -> int:
+    def _emit_first_token(self, state: _SlotState, tok: int,
+                          now: float) -> List[int]:
+        state.generated.append(tok)
+        if not self._warming:
+            # Single timing source: with tracing on, TTFT is the
+            # request span's age at first token — exactly what the
+            # span tree decomposes — and the trace id rides on the
+            # histogram as an exemplar.
+            ts = self._req_spans.get(state.request_id)
+            if ts is not None:
+                fc = ts.pop('first_chunk', None)
+                if fc is not None:
+                    fc.finish()
+                _M_TTFT.observe(
+                    now - ts['request'].start_time,
+                    exemplar=ts['request'].exemplar)
+            else:
+                _M_TTFT.observe(now - self._submitted_at.get(
+                    state.request_id, now))
+        return [tok]
+
+    def _process_tick(self, entry: Optional[Dict[str, Any]]) -> int:
         if entry is None:
             return 0
-        toks_host = np.asarray(entry['toks'])   # [n, B] — THE sync
         emitted = 0
         now = time.time()
-        firsts_cache: Dict[int, np.ndarray] = {}
-        for slot_idx, epoch in entry['snapshot']:
-            state = self.slots[slot_idx]
-            if state is None or state.epoch != epoch:
+        now_pc = time.perf_counter()
+        fresh_by_slot: Dict[int, List[int]] = {}
+        # Completed prefill chunks first: their sampled first token
+        # was computed strictly before this tick's decode scan on
+        # device, so the sync order matches generation order.
+        firsts_host: Optional[np.ndarray] = None
+        for m in entry['chunks']:
+            if not m['last']:
+                continue
+            state = self.slots[m['slot']]
+            if state is None or state.epoch != m['epoch']:
                 continue          # freed/recycled mid-flight
-            fresh: List[int] = []
-            if state.first_ref is not None:
-                # Prefill-sampled first token: computed strictly
-                # before this chunk on device, so this sync is free.
-                arr, j = state.first_ref
-                host = firsts_cache.get(id(arr))
-                if host is None:
-                    host = np.asarray(arr)
-                    firsts_cache[id(arr)] = host
-                state.first_ref = None
-                state.generated.append(int(host[j]))
-                fresh.append(int(host[j]))
-                emitted += 1
-                if not self._warming:
-                    # Single timing source: with tracing on, TTFT is
-                    # the request span's age at first token — exactly
-                    # what the span tree decomposes — and the trace
-                    # id rides on the histogram as an exemplar.
-                    ts = self._req_spans.get(state.request_id)
-                    if ts is not None:
-                        fc = ts.pop('first_chunk', None)
-                        if fc is not None:
-                            fc.finish()
-                        _M_TTFT.observe(
-                            now - ts['request'].start_time,
-                            exemplar=ts['request'].exemplar)
-                    else:
-                        _M_TTFT.observe(now - self._submitted_at.get(
-                            state.request_id, now))
-            if not self._is_done(state):
+            if firsts_host is None:
+                firsts_host = np.asarray(entry['firsts'])  # THE sync
+            fresh_by_slot[m['slot']] = self._emit_first_token(
+                state, int(firsts_host[m['row']]), now)
+            emitted += 1
+        if entry['n']:
+            toks_host = np.asarray(entry['toks'])   # [n, B] — THE sync
+            for slot_idx, epoch in entry['snapshot']:
+                state = self.slots[slot_idx]
+                if state is None or state.epoch != epoch:
+                    continue      # freed/recycled mid-flight
+                if self._is_done(state):
+                    continue
+                fresh = fresh_by_slot.setdefault(slot_idx, [])
                 for t in range(entry['n']):
                     tok = int(toks_host[t, slot_idx])
                     state.generated.append(tok)
@@ -747,7 +939,23 @@ class ServingEngine:
                         # Tokens past max_new/EOS within the chunk
                         # are discarded.
                         break
-            if fresh and self.on_token is not None:
+        for slot_idx, fresh in fresh_by_slot.items():
+            state = self.slots[slot_idx]
+            if state is None or not fresh:
+                continue
+            if (not self._warming and
+                    state.last_emit_at is not None):
+                # ITL: the gap a streaming client sees between
+                # consecutive token batches of one request. Bounded
+                # by the tick time — i.e. by the prefill token
+                # budget, not by co-admitted prompt lengths.
+                ts = self._req_spans.get(state.request_id)
+                _M_ITL.observe(
+                    now_pc - state.last_emit_at,
+                    exemplar=(ts['request'].exemplar
+                              if ts is not None else None))
+            state.last_emit_at = now_pc
+            if self.on_token is not None:
                 self.on_token(state.request_id, fresh)
             if self._is_done(state):
                 self._finish(slot_idx)

@@ -491,6 +491,10 @@ def _build_engine(args) -> 'Any':
                          weight_quant=getattr(args, 'weight_quant',
                                               False),
                          decode_chunk=args.decode_chunk,
+                         prefill_chunk=getattr(args, 'prefill_chunk',
+                                               None),
+                         prefill_budget=getattr(args, 'prefill_budget',
+                                                None),
                          mesh=mesh)
 
 
@@ -508,6 +512,15 @@ def main() -> None:
     parser.add_argument('--max-prompt', type=int, default=512)
     parser.add_argument('--max-seq', type=int, default=1024)
     parser.add_argument('--decode-chunk', type=int, default=16)
+    parser.add_argument('--prefill-chunk', type=int, default=None,
+                        help='Chunked-prefill slice size in prompt '
+                        'tokens (default: SKYTPU_PREFILL_CHUNK or '
+                        '128, clamped to --max-prompt).')
+    parser.add_argument('--prefill-budget', type=int, default=None,
+                        help='Per-tick prefill token budget across '
+                        'prefilling slots — bounds decode inter-token '
+                        'latency under admission churn (default: '
+                        'SKYTPU_PREFILL_BUDGET or 256).')
     parser.add_argument('--kv-quant', action='store_true')
     parser.add_argument('--weight-quant', action='store_true',
                         help='int8 weight-only quantization: serve '

@@ -436,3 +436,219 @@ def flash_attention(q: jax.Array,
     if block_k is None:
         block_k = int(os.environ.get('SKYTPU_FLASH_BLOCK_K', '1024'))
     return _flash(q, k, v, causal, scale, block_q, block_k)
+
+
+# ------------------------------------------- chunked-prefill attention
+#
+# The attention primitive behind Sarathi-style chunked prefill
+# (models.inference.prefill_chunk): a C-token slice of a prompt at
+# global positions [offset, offset + C) attends over the slot's
+# prompt-region KV cache — into which the chunk's own K/V have
+# already been written — under a *query-offset* causal rule
+# ``kv_pos <= offset + i``. ``offset`` is per-row (each row of the
+# chunk batch is a different serving slot at a different prefill
+# cursor), so the mask cannot be a static flash ``mask_offset``: the
+# Pallas variant scalar-prefetches the offsets, exactly as
+# ``ops.decode_attention`` prefetches its row bounds, and uses them
+# both to mask and to *early-exit* K blocks past a row's causal
+# frontier (index maps clamp to the last live block, so dead prompt
+# headroom is never fetched from HBM). Forward-only: prefill has no
+# backward pass.
+
+
+def _chunk_fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_scr,
+                      l_scr, acc_scr, *, scale, chunk, block_k,
+                      num_k_blocks):
+    """Grid (G, H, k-block); online softmax across the K axis.
+
+    off_ref: scalar-prefetched [G] int32 chunk start positions.
+    Blocks: q/o (1, chunk, 1, hd); k/v (1, block_k, 1, hd); scratch
+    m/l (chunk, LANES) and acc (chunk, hd) persist across K blocks
+    (the 'arbitrary' innermost axis). Fully-masked rows accumulate
+    exp(0)=1 garbage until their first live block, where the
+    corr-factor exp(-inf) washes it to zero — the standard flash
+    recurrence; every live row attends at least its own position.
+    """
+    g = pl.program_id(0)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # K blocks wholly past the row's causal frontier (offset + chunk)
+    # contribute nothing — and were never fetched (the index maps
+    # clamp to the last live block, eliding the copy).
+    @pl.when(ik * block_k < off_ref[g] + chunk)
+    def _compute():
+        q = q_ref[0, :, 0].astype(jnp.float32)        # [chunk, hd]
+        k = k_ref[0, :, 0].astype(jnp.float32)        # [block_k, hd]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        q_pos = off_ref[g] + lax.broadcasted_iota(
+            jnp.int32, (chunk, block_k), 0)
+        kv_pos = ik * block_k + lax.broadcasted_iota(
+            jnp.int32, (chunk, block_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # Explicitly zero masked probs (same hygiene as the paged
+        # decode kernel): a fully-masked q row would otherwise
+        # accumulate exp(0)=1 garbage, and NaN junk in masked K slots
+        # must not reach the accumulator.
+        p = jnp.where(q_pos >= kv_pos, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, :, 0].astype(jnp.float32)        # [block_k, hd]
+        acc_scr[...] = acc_scr[...] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, :, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def _chunk_fwd_pallas(q, k, v, q_offset, *, block_k, interpret):
+    """q: [G, C, H, D]; k/v: [G, S, H_kv, D]; q_offset: [G] int32."""
+    g, c, h, d = q.shape
+    s = k.shape[1]
+    n_kv = k.shape[2]
+    rep = h // n_kv
+    block_k = min(block_k, s)
+    assert s % block_k == 0, (s, block_k)
+    nk = s // block_k
+
+    def _last_block(off_ref, gi):
+        # Last K block any query of row gi can see (>= 0).
+        return jnp.maximum(off_ref[gi] + c - 1, 0) // block_k
+
+    def q_map(gi, hi, ik, off_ref):
+        del ik, off_ref
+        return gi, 0, hi, 0
+
+    def kv_map(gi, hi, ik, off_ref):
+        # GQA: query head hi reads shared KV head hi // rep; clamp to
+        # the row's last live block so skipped blocks repeat an index
+        # and the pipeline elides the fetch.
+        return gi, jnp.minimum(ik, _last_block(off_ref, gi)), \
+            hi // rep, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(g, h, nk),
+        in_specs=[
+            pl.BlockSpec((1, c, 1, d), q_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, c, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((c, _LANES), jnp.float32),
+            pltpu.VMEM((c, _LANES), jnp.float32),
+            pltpu.VMEM((c, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _chunk_fwd_kernel, scale=d**-0.5, chunk=c, block_k=block_k,
+        num_k_blocks=nk)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((g, c, h, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q_offset.astype(jnp.int32), q, k, v)
+
+
+def chunk_attention_reference(q, k, v, q_offset, k_scale=None,
+                              v_scale=None):
+    """Masked-einsum reference for the chunk kernel — and the real
+    path for int8 caches (per-vector scales applied on scores for K,
+    folded into probs for V, same discipline as the decode paths) and
+    off-TPU backends. GQA-native: K/V stay at n_kv heads.
+    """
+    g, c, h, d = q.shape
+    s, n_kv = k.shape[1], k.shape[2]
+    rep = h // n_kv
+    qf = q.reshape(g, c, n_kv, rep, d)
+    scores = jnp.einsum(
+        'gcnrd,gsnd->gcnrs', qf, k.astype(qf.dtype),
+        preferred_element_type=jnp.float32) * d**-0.5
+    if k_scale is not None:
+        # [G, S, n_kv] -> [G, 1, n_kv, 1, S]
+        scores = scores * jnp.transpose(
+            k_scale, (0, 2, 1))[:, None, :, None, :].astype(jnp.float32)
+    q_pos = q_offset[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    valid = (jnp.arange(s, dtype=jnp.int32)[None, None, :] <=
+             q_pos[:, :, None])                       # [G, C, S]
+    scores = jnp.where(valid[:, :, None, None, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    probs = e / jnp.sum(e, axis=-1, keepdims=True)
+    if v_scale is not None:
+        probs = probs * jnp.transpose(
+            v_scale, (0, 2, 1))[:, None, :, None, :].astype(probs.dtype)
+    out = jnp.einsum('gcnrs,gsnd->gcnrd', probs.astype(q.dtype),
+                     v.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(g, c, h, d).astype(q.dtype)
+
+
+def chunk_prefill_attention(q: jax.Array,
+                            k: jax.Array,
+                            v: jax.Array,
+                            q_offset: jax.Array,
+                            k_scale: Optional[jax.Array] = None,
+                            v_scale: Optional[jax.Array] = None,
+                            *,
+                            impl: Optional[str] = None,
+                            block_k: Optional[int] = None,
+                            interpret: Optional[bool] = None
+                            ) -> jax.Array:
+    """Query-offset causal attention for one prefill chunk.
+
+    q: [G, C, H, D] — C-token prompt slices, row g's queries sit at
+    global positions ``q_offset[g] + i``; k/v: [G, S, H_kv, D] — each
+    row's prompt-region KV with the chunk already written at
+    [offset, offset + C) (bf16/f32, or int8 with per-vector
+    k_scale/v_scale [G, S, H_kv]). Every position <= its query's is
+    attended (earlier chunks + causal-within-chunk); later positions
+    — including padding garbage past a partial chunk — are masked.
+    Returns [G, C, H, D].
+
+    ``impl``: 'pallas' | 'xla' | None (auto: Pallas on TPU for
+    non-quantized caches when S divides by block_k, the exact einsum
+    elsewhere — interpret-mode Pallas is orders slower on CPU, so
+    tests opt in explicitly).
+    """
+    s = k.shape[1]
+    if block_k is None:
+        block_k = min(_LANES, s)
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    if impl is None:
+        impl = ('pallas' if (_use_pallas() and k_scale is None and
+                             s % block_k == 0) else 'xla')
+    if impl not in ('pallas', 'xla'):
+        raise ValueError(f'chunk attention impl {impl!r} not in '
+                         "('pallas', 'xla')")
+    if impl == 'pallas':
+        if k_scale is not None:
+            raise ValueError('the Pallas chunk kernel reads bf16/f32 '
+                             'caches; int8 goes through the xla path')
+        if s % block_k != 0:
+            raise ValueError(f'cache region {s} is not a multiple of '
+                             f'block_k {block_k}')
+        return _chunk_fwd_pallas(q, k, v, q_offset, block_k=block_k,
+                                 interpret=interpret)
+    return chunk_attention_reference(q, k, v, q_offset, k_scale,
+                                     v_scale)

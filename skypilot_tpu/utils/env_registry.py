@@ -203,6 +203,16 @@ SKYTPU_DECODE_ATTN = register(
     'Decode attention impl: paged | lax (models/inference.py).')
 SKYTPU_DECODE_PAGE = register(
     'SKYTPU_DECODE_PAGE', 'Paged decode-attention page size (tokens).')
+SKYTPU_PREFILL_CHUNK = register(
+    'SKYTPU_PREFILL_CHUNK',
+    'Chunked-prefill slice size in prompt tokens (serving engine; '
+    'default 128, clamped to max_prompt).')
+SKYTPU_PREFILL_BUDGET = register(
+    'SKYTPU_PREFILL_BUDGET',
+    'Per-tick prompt-token budget across prefilling slots in the '
+    'serving engine\'s mixed scheduler (default 256; folds to whole '
+    'chunk rows, so the effective budget is '
+    'chunk * max(1, budget // chunk)).')
 
 # ------------------------------------------------- bench.py (BENCH_*)
 BENCH_SMOKE = register(
@@ -213,7 +223,12 @@ BENCH_MODE = register('BENCH_MODE', 'Bench mode to run (bench.py).')
 BENCH_ALL_MODES = register(
     'BENCH_ALL_MODES', 'Comma-separated mode list for `bench.py all`.')
 BENCH_DEVICE_TIMEOUT = register(
-    'BENCH_DEVICE_TIMEOUT', 'Seconds to wait for TPU devices.')
+    'BENCH_DEVICE_TIMEOUT',
+    'Total seconds to wait for TPU devices across all probe attempts.')
+BENCH_DEVICE_ATTEMPTS = register(
+    'BENCH_DEVICE_ATTEMPTS',
+    'Bounded attempts for the bench device probe (utils/retry.'
+    'RetryPolicy; the total BENCH_DEVICE_TIMEOUT splits across them).')
 BENCH_MODEL = register('BENCH_MODEL', 'Train bench model preset.')
 BENCH_SEQ = register('BENCH_SEQ', 'Train bench sequence length.')
 BENCH_BATCH = register('BENCH_BATCH', 'Train bench global batch size.')
@@ -230,7 +245,16 @@ BENCH_SERVE_MODEL = register(
 BENCH_SERVE_BATCH = register(
     'BENCH_SERVE_BATCH', 'Serve bench engine batch slots.')
 BENCH_SERVE_CHUNK = register(
-    'BENCH_SERVE_CHUNK', 'Serve bench prefill chunk size.')
+    'BENCH_SERVE_CHUNK', 'Serve bench decode chunk size (steps per '
+    'engine tick).')
+BENCH_SERVE_PREFILL_CHUNK = register(
+    'BENCH_SERVE_PREFILL_CHUNK',
+    'Serve bench chunked-prefill slice size (SKYTPU_PREFILL_CHUNK '
+    'analog).')
+BENCH_SERVE_PREFILL_BUDGET = register(
+    'BENCH_SERVE_PREFILL_BUDGET',
+    'Serve bench per-tick prefill token budget '
+    '(SKYTPU_PREFILL_BUDGET analog).')
 BENCH_SERVE_PROMPT = register(
     'BENCH_SERVE_PROMPT', 'Serve bench prompt length.')
 BENCH_SERVE_MAX_NEW = register(

@@ -49,3 +49,53 @@ def test_bench_smoke_train():
     # signal that the step actually ran.
     assert result['detail']['tokens_per_sec_per_chip'] > 0
     assert result['detail']['backend'] == 'cpu'
+
+
+def _load_bench_module():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location('_bench_mod', _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_probe_device_retries_with_bounded_attempts():
+    """r05 regression: the device probe now runs under
+    utils/retry.RetryPolicy and its bench_error detail carries the
+    attempt count, per-attempt durations and the active trace id —
+    enough to tell a flaky tunnel from a dead one."""
+    bench = _load_bench_module()
+    calls = []
+
+    def always_dead(timeout_s):
+        calls.append(timeout_s)
+        return False, None
+
+    detail = bench._probe_device(9.0, 3, probe_fn=always_dead)
+    assert detail is not None
+    assert detail['attempts'] == 3
+    assert len(calls) == 3
+    assert len(detail['attempt_durations_s']) == 3
+    assert detail['per_attempt_timeout_s'] == 3.0
+    assert 'device unreachable' in detail['error']
+    assert 'trace_id' in detail
+    # Retry pressure surfaced on the shared retry counters.
+    from skypilot_tpu import metrics
+    assert metrics.summary().get(
+        'skytpu_retry_attempts_total{site="bench.device_probe"}') == 2
+
+
+def test_probe_device_recovers_after_transient_failure():
+    bench = _load_bench_module()
+    outcomes = iter([(False, None), (True, None)])
+    assert bench._probe_device(
+        4.0, 2, probe_fn=lambda t: next(outcomes)) is None
+
+
+def test_probe_device_records_exception_detail():
+    bench = _load_bench_module()
+    boom = RuntimeError('PJRT plugin exploded')
+    detail = bench._probe_device(
+        4.0, 2, probe_fn=lambda t: (False, boom))
+    assert detail['attempts'] == 2
+    assert 'PJRT plugin exploded' in detail['error']

@@ -1,0 +1,419 @@
+"""Chunked prefill: numerical parity with monolithic prefill, the
+query-offset chunk kernel, and the token-budgeted mixed scheduler.
+
+Mirrors the PR-2 kernel-parity style: the Pallas chunk kernel runs in
+interpret mode on CPU (real grid logic, index-map clamping), and the
+engine-level tests pin the chunked path against the monolithic
+``inference.prefill`` oracle across chunk-boundary prompt lengths
+(k*chunk±1) and ragged admission mixes.
+"""
+import functools
+import importlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.models import quantization
+from skypilot_tpu.models.serving_engine import Request, ServingEngine
+
+# The ops package re-exports the ``flash_attention`` function under
+# the module's name; go through importlib for the module itself.
+flash_mod = importlib.import_module('skypilot_tpu.ops.flash_attention')
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg = models.LlamaConfig.tiny(**cfg_kw)
+    params = models.init_params(cfg, jax.random.PRNGKey(seed))
+    return cfg, params
+
+
+def _prompt(cfg, n, seed):
+    key = jax.random.PRNGKey(seed)
+    return list(np.asarray(
+        jax.random.randint(key, (n,), 0, cfg.vocab_size)))
+
+
+def _empty_cache(cfg, batch, max_prompt, max_seq, kv_quant=False):
+    kv_dtype = jnp.int8 if kv_quant else cfg.compute_dtype
+    shp = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    cache = {'k': jnp.zeros(shp, kv_dtype),
+             'v': jnp.zeros(shp, kv_dtype),
+             'length': jnp.zeros((batch,), jnp.int32),
+             'dmask': jnp.zeros((batch, max_seq), bool),
+             'base': jnp.asarray(max_prompt, jnp.int32),
+             'steps': jnp.zeros((), jnp.int32)}
+    if kv_quant:
+        cache['k_scale'] = jnp.ones(shp[:4], jnp.bfloat16)
+        cache['v_scale'] = jnp.ones(shp[:4], jnp.bfloat16)
+    return cache
+
+
+def _drive_chunks(params, cfg, cache, prompt, slot, chunk,
+                  max_prompt):
+    """Feed ``prompt`` through prefill_chunk C tokens at a time into
+    ``slot``; returns (last logits, cache)."""
+    step = jax.jit(functools.partial(
+        inference.prefill_chunk, cfg=cfg, prompt_base=max_prompt))
+    pos, logits = 0, None
+    while pos < len(prompt):
+        ln = min(chunk, len(prompt) - pos)
+        buf = np.zeros((1, chunk), np.int32)
+        buf[0, :ln] = prompt[pos:pos + ln]
+        logits, cache = step(
+            params, cache, jnp.asarray(buf),
+            jnp.asarray([pos], jnp.int32), jnp.asarray([ln], jnp.int32),
+            jnp.asarray([True]), jnp.asarray([slot], jnp.int32))
+        pos += ln
+    return logits, cache
+
+
+# ------------------------------------------------------- kernel parity
+
+
+@pytest.mark.perf_smoke
+@pytest.mark.parametrize('gqa', [(4, 4), (4, 1), (8, 2)])
+def test_chunk_kernel_matches_reference(gqa):
+    """Interpret-mode Pallas chunk kernel == masked-einsum reference
+    across GQA ratios and ragged per-row offsets."""
+    h, n_kv = gqa
+    rng = np.random.default_rng(0)
+    g, c, d, s = 3, 8, 16, 32
+    q = jnp.asarray(rng.standard_normal((g, c, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((g, s, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((g, s, n_kv, d)), jnp.float32)
+    off = jnp.asarray([0, 5, 17], jnp.int32)
+    ref = flash_mod.chunk_attention_reference(q, k, v, off)
+    pal = flash_mod.chunk_prefill_attention(
+        q, k, v, off, impl='pallas', block_k=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.perf_smoke
+def test_chunk_kernel_skips_dead_blocks():
+    """K blocks wholly past a row's causal frontier are never fetched:
+    NaN poison planted there must not reach the output (the
+    index-map clamp elides the DMA)."""
+    rng = np.random.default_rng(1)
+    g, c, h, n_kv, d, s = 2, 8, 4, 2, 16, 32
+    q = jnp.asarray(rng.standard_normal((g, c, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((g, s, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((g, s, n_kv, d)), jnp.float32)
+    off = jnp.asarray([0, 5], jnp.int32)
+    ref = flash_mod.chunk_attention_reference(q, k, v, off)
+    # Row 1 frontier = 5 + 8 = 13 -> with block_k=8 every block from
+    # 16 on is dead; row 0 is dead from block 8 on.
+    kp = k.at[1, 16:].set(jnp.nan).at[0, 8:].set(jnp.nan)
+    vp = v.at[1, 16:].set(jnp.nan).at[0, 8:].set(jnp.nan)
+    pal = flash_mod.chunk_prefill_attention(
+        q, kp, vp, off, impl='pallas', block_k=8, interpret=True)
+    assert bool(jnp.isfinite(pal).all())
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_chunk_kernel_int8_scales_match_dequantized():
+    """int8 path (scores * k_scale, probs * v_scale) == attention over
+    the dequantized cache."""
+    rng = np.random.default_rng(2)
+    g, c, h, n_kv, d, s = 2, 4, 4, 2, 16, 16
+    q = jnp.asarray(rng.standard_normal((g, c, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((g, s, n_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((g, s, n_kv, d)), jnp.float32)
+    off = jnp.asarray([3, 11], jnp.int32)
+    qk, sk = quantization.quantize_kv(k)
+    qv, sv = quantization.quantize_kv(v)
+    got = flash_mod.chunk_prefill_attention(q, qk, qv, off,
+                                            k_scale=sk, v_scale=sv)
+    want = flash_mod.chunk_attention_reference(
+        q, quantization.dequantize_kv(qk, sk, jnp.float32),
+        quantization.dequantize_kv(qv, sv, jnp.float32), off)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-2, rtol=2e-2)
+
+
+# ------------------------------------------- prefill primitive parity
+
+
+@pytest.mark.parametrize('plen', [1, 7, 8, 9, 15, 16, 17, 32])
+def test_prefill_chunk_matches_monolithic(plen):
+    """Chunk-boundary prompt lengths (k*chunk±1): the chunked path's
+    final logits AND written KV region equal monolithic prefill
+    (bitwise for <=2 chunks, float-tolerance beyond — accumulation
+    order differs)."""
+    cfg, params = _setup()
+    max_prompt, max_seq, chunk = 32, 64, 8
+    toks = jnp.asarray([_prompt(cfg, plen, 100 + plen)], jnp.int32)
+    logits_m, cache_m = inference.prefill(
+        params, toks, jnp.asarray([plen], jnp.int32), cfg,
+        max_seq=max_seq)
+    cache = _empty_cache(cfg, 2, max_prompt, max_seq)
+    logits_c, cache = _drive_chunks(params, cfg, cache,
+                                    list(np.asarray(toks[0])), 1,
+                                    chunk, max_prompt)
+    np.testing.assert_allclose(np.asarray(logits_c[0]),
+                               np.asarray(logits_m[0]),
+                               atol=1e-4, rtol=1e-4)
+    for f in ('k', 'v'):
+        np.testing.assert_allclose(
+            np.asarray(cache[f][:, 1, :plen]),
+            np.asarray(cache_m[f][:, 0, :plen]),
+            atol=1e-4, rtol=1e-4)
+    # dmask exact: the written prompt positions and nothing else.
+    want_mask = np.arange(max_seq) < plen
+    assert (np.asarray(cache['dmask'][1]) == want_mask).all()
+    assert int(cache['length'][1]) == plen
+    # The untouched slot is bit-clean (write isolation).
+    assert (np.asarray(cache['k'][:, 0]) == 0).all()
+    assert int(cache['length'][0]) == 0
+    assert not np.asarray(cache['dmask'][0]).any()
+
+
+def test_prefill_chunk_recycle_clears_previous_occupant():
+    """A first chunk (start == 0) must reset its row's dmask: the
+    previous occupant's decode slots and prompt tail become
+    unreadable — the insert_prefill recycling guarantee."""
+    cfg, params = _setup()
+    max_prompt, max_seq, chunk = 32, 64, 8
+    cache = _empty_cache(cfg, 2, max_prompt, max_seq)
+    # Previous occupant: long prompt + fake decode-region validity.
+    _, cache = _drive_chunks(params, cfg, cache,
+                             _prompt(cfg, 20, 7), 1, chunk, max_prompt)
+    cache['dmask'] = cache['dmask'].at[1, max_prompt:max_prompt + 5]\
+        .set(True)
+    # Recycle with a shorter prompt.
+    _, cache = _drive_chunks(params, cfg, cache,
+                             _prompt(cfg, 5, 8), 1, chunk, max_prompt)
+    want_mask = np.arange(max_seq) < 5
+    assert (np.asarray(cache['dmask'][1]) == want_mask).all()
+    assert int(cache['length'][1]) == 5
+
+
+def test_prefill_chunk_kv_quant_parity():
+    """int8 cache: chunked prefill attends the *quantized* KV of
+    earlier chunks (monolithic prefill attends exact K/V and
+    quantizes only at the write), so parity holds at the established
+    int8 tolerance (the same bar as
+    test_int8_kv_cache_close_to_bf16), not bitwise."""
+    cfg, params = _setup()
+    max_prompt, max_seq, chunk, plen = 32, 64, 8, 13
+    toks = jnp.asarray([_prompt(cfg, plen, 3)], jnp.int32)
+    logits_m, cache_m = inference.prefill(
+        params, toks, jnp.asarray([plen], jnp.int32), cfg,
+        max_seq=max_seq, kv_quant=True)
+    cache = _empty_cache(cfg, 2, max_prompt, max_seq, kv_quant=True)
+    logits_c, cache = _drive_chunks(params, cfg, cache,
+                                    list(np.asarray(toks[0])), 0,
+                                    chunk, max_prompt)
+    err = np.abs(np.asarray(logits_c[0]) -
+                 np.asarray(logits_m[0])).max()
+    scale = np.abs(np.asarray(logits_m[0])).max()
+    assert err < 0.05 * scale + 0.05, (err, scale)
+    assert cache['k'].dtype == jnp.int8
+    for f, sf in (('k', 'k_scale'), ('v', 'v_scale')):
+        got = np.asarray(quantization.dequantize_kv(
+            cache[f][:, 0, :plen], cache[sf][:, 0, :plen],
+            jnp.float32))
+        want = np.asarray(quantization.dequantize_kv(
+            cache_m[f][:, 0, :plen], cache_m[sf][:, 0, :plen],
+            jnp.float32))
+        kv_err = np.abs(got - want).max()
+        kv_scale = np.abs(want).max()
+        assert kv_err < 0.05 * kv_scale + 0.05, (f, kv_err, kv_scale)
+
+
+def test_prefill_chunk_a8_parity():
+    """cfg.prefill_a8 (int8 activation matmuls): per-token activation
+    quantization is chunking-invariant, so chunked == monolithic."""
+    cfg = models.LlamaConfig.tiny(prefill_a8=True)
+    params = quantization.init_quantized_params(
+        cfg, jax.random.PRNGKey(0))
+    max_prompt, max_seq, chunk, plen = 32, 64, 8, 11
+    toks = jnp.asarray([_prompt(cfg, plen, 5)], jnp.int32)
+    logits_m, cache_m = inference.prefill(
+        params, toks, jnp.asarray([plen], jnp.int32), cfg,
+        max_seq=max_seq)
+    cache = _empty_cache(cfg, 1, max_prompt, max_seq)
+    logits_c, cache = _drive_chunks(params, cfg, cache,
+                                    list(np.asarray(toks[0])), 0,
+                                    chunk, max_prompt)
+    np.testing.assert_allclose(np.asarray(logits_c[0]),
+                               np.asarray(logits_m[0]),
+                               atol=1e-4, rtol=1e-4)
+    for f in ('k', 'v'):
+        np.testing.assert_allclose(
+            np.asarray(cache[f][:, 0, :plen], np.float32),
+            np.asarray(cache_m[f][:, 0, :plen], np.float32),
+            atol=1e-4, rtol=1e-4)
+
+
+# --------------------------------------------------- engine-level
+
+
+def _solo_generate(params, cfg, prompt, max_new):
+    out = inference.generate(
+        params, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cfg, max_new=max_new)
+    return list(np.asarray(out[0]))
+
+
+def test_engine_chunked_boundary_lengths_match_solo():
+    """Ragged admission mix across chunk-boundary lengths through the
+    mixed scheduler: every request's greedy tokens equal its solo
+    decode."""
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=3, max_prompt=32,
+                           max_seq=160, decode_chunk=4,
+                           prefill_chunk=8, prefill_budget=16)
+    prompts = {f'p{n}': _prompt(cfg, n, 200 + n)
+               for n in (7, 8, 9, 15, 17, 1)}
+    reqs = [Request(rid, p, max_new=4) for rid, p in prompts.items()]
+    results = engine.run(reqs)
+    assert set(results) == set(prompts)
+    for rid, p in prompts.items():
+        want = _solo_generate(params, cfg, p, 4)
+        assert results[rid].tokens == want, (rid, results[rid].tokens,
+                                             want)
+
+
+@pytest.mark.perf_smoke
+def test_mixed_ticks_respect_budget_and_never_recompile():
+    """The scheduler invariants: (1) no tick prefills more than the
+    token budget; (2) prefill coalesces with decode (mixed ticks
+    happen); (3) after warmup() a ragged serving run compiles ZERO
+    new programs — the pow2 bucket set is gone and the chunk/budget
+    shapes are closed."""
+    cfg, params = _setup()
+    budget = 16
+    engine = ServingEngine(params, cfg, batch_size=4, max_prompt=16,
+                           max_seq=64, decode_chunk=4,
+                           prefill_chunk=8, prefill_budget=budget)
+    assert engine.prefill_budget == budget
+    engine.warmup()
+    compiled = (engine._decode._cache_size(),
+                engine._mixed._cache_size())
+
+    reqs = [Request(i, _prompt(cfg, 3 + (5 * i) % 14, 300 + i),
+                    max_new=3 + i % 4) for i in range(10)]
+    for r in reqs:
+        engine.submit(r)
+    max_tick_prefill = 0
+    mixed_ticks = 0
+    done = {}
+    while engine.queue or engine.num_active() or engine.has_pending:
+        decoding_before = sum(
+            1 for s in engine.slots
+            if s is not None and s.phase == 'decode')
+        engine.step()
+        assert engine.last_tick_prefill_tokens <= budget
+        max_tick_prefill = max(max_tick_prefill,
+                               engine.last_tick_prefill_tokens)
+        if engine.last_tick_prefill_tokens and decoding_before:
+            mixed_ticks += 1
+        done.update(engine.drain_results())
+    assert set(done) == {r.request_id for r in reqs}
+    assert max_tick_prefill > 0
+    # Prefill work really ran alongside in-flight decodes (the
+    # stall-free property under test).
+    assert mixed_ticks > 0
+    # p99 ITL is bounded by the tick budget, not by prompt length:
+    # with no recompiles and budget-bounded ticks every tick is
+    # uniform; the no-new-programs assert is the compile-side half.
+    assert (engine._decode._cache_size(),
+            engine._mixed._cache_size()) == compiled
+    # Budget accounting flowed to the metric surface.
+    summary = metrics_lib.summary()
+    total_prompt = sum(len(r.tokens) for r in reqs)
+    assert summary['skytpu_engine_prefill_tokens_total'] == \
+        total_prompt
+    assert engine.prefill_tokens_total == total_prompt
+    assert engine.max_tick_prefill_tokens == max_tick_prefill
+
+
+def test_engine_prefill_longer_than_budget_does_not_stall_decode():
+    """A max-length prompt admitted next to a running decode must not
+    spike the running request's inter-token gaps: every tick still
+    emits decode tokens while the long prompt prefills across
+    multiple budgeted chunks."""
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=160, decode_chunk=4,
+                           prefill_chunk=8, prefill_budget=8)
+    first = Request('running', _prompt(cfg, 4, 1), max_new=24)
+    engine.submit(first)
+    # Let the first request reach steady decode.
+    for _ in range(4):
+        engine.step()
+    long_req = Request('long', _prompt(cfg, 32, 2), max_new=4)
+    engine.submit(long_req)
+    emitted_during_prefill = []
+    while any(s is not None and s.phase == 'prefill'
+              for s in engine.slots) or engine.queue:
+        emitted = engine.step()
+        if engine.last_tick_prefill_tokens:
+            emitted_during_prefill.append(emitted)
+    done = {}
+    while engine.queue or engine.num_active() or engine.has_pending:
+        engine.step()
+        done.update(engine.drain_results())
+    # 32-token prompt at budget 8 -> 4 prefill ticks, each of which
+    # also surfaced decode tokens for the running request.
+    assert len(emitted_during_prefill) == 4
+    assert all(e > 0 for e in emitted_during_prefill)
+    assert done['running'].tokens == _solo_generate(
+        params, cfg, list(first.tokens), 24)
+    assert done['long'].tokens == _solo_generate(
+        params, cfg, list(long_req.tokens), 4)
+
+
+def test_itl_histogram_and_exposition():
+    """The new metric surface: ITL histogram + prefill-token counter
+    render in Prometheus exposition with the engine's observations."""
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=16,
+                           max_seq=64, decode_chunk=2,
+                           prefill_chunk=8, prefill_budget=8)
+    engine.run([Request('a', _prompt(cfg, 9, 4), max_new=6)])
+    text = metrics_lib.render_exposition()
+    assert '# TYPE skytpu_engine_itl_seconds histogram' in text
+    assert 'skytpu_engine_itl_seconds_bucket' in text
+    assert '# TYPE skytpu_engine_prefill_tokens_total counter' in text
+    assert '\nskytpu_engine_prefill_tokens_total 9\n' in text
+    # 6 tokens over >= 3 emissions (decode_chunk 2) -> >= 2 gaps.
+    summary = metrics_lib.summary()
+    assert summary['skytpu_engine_itl_seconds_count'] >= 2
+
+
+def test_prefill_chunk_trace_subspans(tmp_path, monkeypatch):
+    """engine.prefill parents one engine.prefill.chunk subspan per
+    dispatched chunk (docs/tracing.md)."""
+    monkeypatch.setenv('SKYTPU_TRACE_DIR', str(tmp_path))
+    from skypilot_tpu import trace as trace_lib
+    trace_lib.seed_ids(7)
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                           max_seq=96, decode_chunk=4,
+                           prefill_chunk=8, prefill_budget=8)
+    engine.run([Request('traced', _prompt(cfg, 20, 9), max_new=3)])
+    spans = []
+    for f in os.listdir(tmp_path):
+        with open(tmp_path / f) as fh:
+            spans += [json.loads(ln) for ln in fh if ln.strip()]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s['name'], []).append(s)
+    assert 'engine.prefill' in by_name
+    chunks = by_name.get('engine.prefill.chunk', [])
+    # 20-token prompt at chunk 8 -> 3 chunk subspans.
+    assert len(chunks) == 3
+    prefill_ids = {s['span_id'] for s in by_name['engine.prefill']}
+    assert all(c['parent_id'] in prefill_ids for c in chunks)
+    assert sorted(c['attrs']['start'] for c in chunks) == [0, 8, 16]
+    assert sum(c['attrs']['tokens'] for c in chunks) == 20

@@ -172,6 +172,25 @@ def test_engine_rejections():
                       max_seq=64)
 
 
+def test_submit_rejects_empty_prompt_and_nonpositive_max_new():
+    """Regression: an empty prompt used to reach prefill (no position
+    to sample from -> undefined downstream behavior), and max_new <= 0
+    admitted a request that could never emit or finish."""
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=1, max_prompt=32,
+                           max_seq=64)
+    with pytest.raises(ValueError, match='empty prompt'):
+        engine.submit(Request(0, [], max_new=4))
+    with pytest.raises(ValueError, match='must be positive'):
+        engine.submit(Request(1, [1, 2, 3], max_new=0))
+    with pytest.raises(ValueError, match='must be positive'):
+        engine.submit(Request(2, [1, 2, 3], max_new=-5))
+    # Nothing was queued; the engine still serves normally.
+    assert len(engine.queue) == 0
+    res = engine.run([Request(3, _prompt(cfg, 5, 1), max_new=2)])
+    assert len(res[3].tokens) == 2
+
+
 @pytest.mark.slow
 def test_max_new_equal_to_decode_capacity():
     """A request whose max_new consumes the decode region exactly must
