@@ -1,4 +1,4 @@
-"""skytpu-lint rule catalog (STL001–STL008).
+"""skytpu-lint rule catalog (STL001–STL009).
 
 Each rule encodes one repo invariant that used to be enforced only at
 runtime or by convention; docs/static_analysis.md carries the full
@@ -590,8 +590,101 @@ class JaxRecompileHazard(Rule):
         return static
 
 
+class BlockingSignalHandler(Rule):
+    """STL009: a ``signal.signal`` handler doing more than flag-flips.
+
+    A Python signal handler runs between bytecodes of whatever frame
+    the signal interrupted — possibly while that frame holds the very
+    lock the handler would need. Joins, sleeps, I/O, logging or any
+    blocking call inside the handler can therefore deadlock or crash
+    the process at the worst moment (the serving replica's graceful
+    drain depends on SIGTERM being handled instantly). Handlers in
+    package code may ONLY set flags/events (``event.set()``,
+    ``self._flag = True``); the actual shutdown work belongs on a
+    normal thread or task that watches the flag.
+    """
+
+    id = 'STL009'
+    name = 'blocking-signal-handler'
+    severity = 'error'
+    help = ('signal.signal handler bodies may only set flags/events '
+            '(event.set(), attribute assignment). Blocking calls, '
+            'joins, sleeps, logging or I/O in the handler run inside '
+            'an arbitrary interrupted frame and can deadlock; move '
+            'the work to a thread/task that watches the flag.')
+    node_types = (ast.Call,)
+
+    # Call names (last dotted component) a handler may make: event /
+    # flag setters and non-blocking flag reads (the second-signal
+    # escalation pattern checks is_set() before raising).
+    _ALLOWED_TAILS = ('set', 'is_set')
+
+    def __init__(self) -> None:
+        # One report per offending call even when the same handler is
+        # registered for several signals.
+        self._reported: Set[Tuple[str, int]] = set()
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        # Covers `signal.signal(...)` and the from-import alias
+        # `signal(...)`; the handler may be the second positional arg
+        # or the `handler=` keyword.
+        if core.call_name(node) not in ('signal.signal', 'signal'):
+            return
+        handler = core.arg_or_keyword(node, 1, 'handler')
+        if handler is None:
+            return
+        if isinstance(handler, ast.Lambda):
+            self._check_calls(ctx, handler.body, 'lambda handler')
+            return
+        # Bare names AND bound methods / attributes (`self._on_term`)
+        # resolve to a same-file FunctionDef by name; imported or
+        # dynamic handlers (and signal.SIG_IGN-style constants, which
+        # resolve to nothing) are not statically checkable.
+        name = None
+        if isinstance(handler, ast.Name):
+            name = handler.id
+        elif isinstance(handler, ast.Attribute):
+            name = handler.attr
+        if name is None:
+            return
+        fn = self._resolve(ctx, name)
+        if fn is None:
+            return
+        for stmt in fn.body:
+            self._check_calls(ctx, stmt, f'handler {fn.name!r}')
+
+    def _check_calls(self, ctx: FileContext, root: ast.AST,
+                     where: str) -> None:
+        for sub in ast.walk(root):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = core.call_name(sub)
+            tail = dotted.split('.')[-1] if dotted else ''
+            if tail in self._ALLOWED_TAILS:
+                continue
+            key = (ctx.path, sub.lineno)
+            if key in self._reported:
+                continue
+            self._reported.add(key)
+            ctx.report(self, sub,
+                       f'{dotted or "call"}() inside signal {where}: '
+                       'signal handlers may only set flags/events '
+                       '(.set() / assignment); do the work on a '
+                       'thread or task that watches the flag',
+                       span=(sub.lineno, sub.lineno))
+
+    @staticmethod
+    def _resolve(ctx: FileContext,
+                 name: str) -> Optional[ast.FunctionDef]:
+        for sub in ast.walk(ctx.tree):
+            if isinstance(sub, ast.FunctionDef) and sub.name == name:
+                return sub
+        return None
+
+
 def default_rules() -> List[Rule]:
-    """Fresh rule instances (STL007 keeps per-run state)."""
+    """Fresh rule instances (STL007/STL009 keep per-run state)."""
     return [
         SwallowedException(),
         HandRolledRetry(),
@@ -601,6 +694,7 @@ def default_rules() -> List[Rule]:
         MetricRegistrationLint(),
         UnknownFaultSite(),
         JaxRecompileHazard(),
+        BlockingSignalHandler(),
     ]
 
 

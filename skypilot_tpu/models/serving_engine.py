@@ -46,12 +46,24 @@ that every admitted request finishes. When the region is exhausted
 and all slots are idle the engine resets the cache (steps=0) and
 keeps admitting. Size ``max_seq`` several times the typical
 ``max_new`` so resets are rare.
+
+Request lifecycle (docs/request_lifecycle.md): no admitted request is
+immortal. ``Request.deadline`` bounds its lifetime — the tick loop
+expires past-deadline slots AND queued requests; ``cancel()`` (thread
+safe, applied at the tick boundary) frees a slot mid-prefill or
+mid-decode, recycling its KV row for the next admission and surfacing
+a partial ``Result`` (status='cancelled', tokens so far);
+``estimate_wait_s()`` turns queue depth + prefill backlog + decode
+width into the admission-time signal the HTTP front end sheds on; a
+tick watchdog flags device hangs (``SKYTPU_TICK_HANG_SECONDS``).
+Every terminal path produces exactly one ``Result``.
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
 import functools
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -64,6 +76,11 @@ from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.models import inference
 from skypilot_tpu.models.llama import LlamaConfig
 from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import lifecycle
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
 
 # Serving metrics (docs/metrics.md): host-side only — nothing here
 # touches the jitted programs, and each update is one dict op under a
@@ -98,6 +115,17 @@ _M_ITL = metrics_lib.histogram(
     'With chunked prefill its p99 is bounded by the tick budget, not '
     'by co-admitted prompt lengths.',
     buckets=metrics_lib.LATENCY_BUCKETS)
+_M_CANCELS = metrics_lib.counter(
+    'skytpu_engine_cancels_total',
+    'Requests removed before natural completion, by reason '
+    '(deadline, client_disconnect, shutdown, api, ...). The freed '
+    'slot is recycled for the next admission '
+    '(docs/request_lifecycle.md).',
+    labels=('reason',))
+_M_TICK_HANGS = metrics_lib.counter(
+    'skytpu_engine_tick_hangs_total',
+    'Engine ticks slower than SKYTPU_TICK_HANG_SECONDS (watchdog: a '
+    'wedged device tick must be visible, not a silent stall).')
 _M_TOKEN_LATENCY = metrics_lib.histogram(
     'skytpu_engine_per_token_seconds',
     'Decode latency per emitted token: engine tick interval over '
@@ -115,6 +143,10 @@ class Request:
     # None -> the engine's default temperature. Per-request values are
     # traced (a [B] vector), so mixing them never recompiles.
     temperature: Optional[float] = None
+    # Absolute ``time.time()`` deadline; the tick loop expires the
+    # request (queued or mid-decode) once it passes, surfacing a
+    # partial Result with status='expired'. None = immortal (legacy).
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -137,6 +169,9 @@ class _SlotState:
     epoch: int = 0
     # perf_counter of the last host-side token emission (ITL anchor).
     last_emit_at: Optional[float] = None
+    # The request's absolute deadline (copied from Request at
+    # admission; the tick loop expires past-deadline slots).
+    deadline: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -146,6 +181,13 @@ class Result:
     prompt_len: int
     submitted_at: float
     finished_at: float
+    # Terminal state (docs/request_lifecycle.md): 'finished' |
+    # 'cancelled' | 'expired'. Cancelled/expired results carry the
+    # tokens decoded so far — partial output is still output.
+    status: str = lifecycle.FINISHED
+    # Why a non-finished request ended ('deadline', 'shutdown',
+    # 'client_disconnect', ...). None for natural completion.
+    reason: Optional[str] = None
 
 
 class ServingEngine:
@@ -295,6 +337,19 @@ class ServingEngine:
         # on_token(request_id, [new tokens]) every time a live
         # request's tokens reach the host (per tick).
         self.on_token: Optional[Callable[[Any, List[int]], None]] = None
+        # Pending cancellations (request_id -> reason), recorded by
+        # cancel() from any thread and applied by the driver at the
+        # next tick boundary — the one place slot/queue state may be
+        # mutated without racing an in-flight device tick.
+        self._cancels: Dict[Any, str] = {}
+        self._cancel_lock = threading.Lock()
+        # EWMA of recent working-tick durations: the time base for
+        # estimate_wait_s()'s deadline-aware admission estimate.
+        # None until the first measured tick (no signal -> admit).
+        self._tick_ewma: Optional[float] = None
+        # Tick watchdog threshold, resolved at construction like the
+        # decode dispatch knobs (0 disables).
+        self._tick_hang_s = lifecycle.tick_hang_s()
 
         cdt = cfg.compute_dtype
         kv_dtype = jnp.int8 if kv_quant else cdt
@@ -667,7 +722,8 @@ class ServingEngine:
                 request_id=req.request_id, max_new=req.max_new,
                 generated=[], prompt=list(req.tokens),
                 prompt_len=len(req.tokens), phase='prefill',
-                prefill_pos=0, seq=self._seq, epoch=self._epoch)
+                prefill_pos=0, seq=self._seq, epoch=self._epoch,
+                deadline=req.deadline)
             self._temps[slot_idx] = (
                 req.temperature if req.temperature is not None
                 else self.temperature)
@@ -686,24 +742,177 @@ class ServingEngine:
 
     def _finish(self, slot_idx: int) -> None:
         state = self.slots[slot_idx]
-        finished_at = time.time()
-        self.results[state.request_id] = Result(
-            request_id=state.request_id,
-            tokens=state.generated,
-            prompt_len=state.prompt_len,
-            submitted_at=self._submitted_at.pop(state.request_id, 0.0),
-            finished_at=finished_at)
-        ts = self._req_spans.pop(state.request_id, None)
+        self._terminal(state.request_id, state.generated,
+                       state.prompt_len, lifecycle.FINISHED, None)
+        self.slots[slot_idx] = None
+
+    def _terminal(self, rid: Any, tokens: List[int], prompt_len: int,
+                  status: str, reason: Optional[str]) -> None:
+        """Record the request's ONE terminal Result (any status) and
+        close its span tree. Callers free the slot / queue entry."""
+        self.results[rid] = Result(
+            request_id=rid,
+            tokens=list(tokens),
+            prompt_len=prompt_len,
+            submitted_at=self._submitted_at.pop(rid, 0.0),
+            finished_at=time.time(),
+            status=status,
+            reason=reason)
+        ts = self._req_spans.pop(rid, None)
         if ts is not None:
-            # A request can finish without ever surfacing a first
-            # token through the normal path (e.g. max_new reached in
-            # the same chunk): close any stragglers before the root.
+            if status != lifecycle.FINISHED:
+                # The cancel event is its own span under the request
+                # span, so it carries the request's trace id — a
+                # cancelled request's trace shows WHERE in its
+                # lifecycle the cut landed.
+                trace_lib.start_span(
+                    'engine.cancel', parent=ts['request'],
+                    request_id=str(rid), status=status,
+                    reason=reason or '').finish()
+            # A request can end without ever surfacing a first token
+            # through the normal path (max_new reached in the same
+            # chunk, or cancelled mid-prefill): close any stragglers
+            # before the root.
             for name in ('queue', 'prefill', 'first_chunk'):
                 sp = ts.pop(name, None)
                 if sp is not None:
                     sp.finish()
-            ts['request'].finish(tokens=len(state.generated))
-        self.slots[slot_idx] = None
+            if status == lifecycle.FINISHED:
+                # Keep the legacy span shape for natural completion.
+                ts['request'].finish(tokens=len(tokens))
+            else:
+                ts['request'].finish(tokens=len(tokens), status=status)
+
+    # ------------------------------------------------- cancellation
+    def cancel(self, request_id: Any,
+               reason: str = 'api') -> bool:
+        """Request cancellation of a queued or in-flight request.
+
+        Thread-safe: the cancellation is recorded here and APPLIED at
+        the next tick boundary by the driving thread (the only place
+        slot state may change without racing an in-flight device
+        tick). The freed decode slot is recycled for the next
+        admission — the next occupant's first prefill chunk clears
+        the row's dmask, so no stale K/V is ever read. The terminal
+        ``Result`` (status='cancelled', tokens so far) surfaces
+        through ``drain_results()`` after that tick.
+
+        Returns True when the request was in flight at the time of
+        the call (best-effort: a race with natural completion still
+        yields exactly one terminal Result, whichever lands first).
+        """
+        try:
+            known = request_id in self._inflight_ids()
+        except RuntimeError:
+            # Queue mutated under the cross-thread membership scan:
+            # assume in flight; _apply_cancellations re-checks.
+            known = True
+        if not known:
+            return False
+        with self._cancel_lock:
+            self._cancels[request_id] = reason
+        return True
+
+    def _apply_cancellations(self) -> None:
+        if not self._cancels:
+            return
+        with self._cancel_lock:
+            cancels, self._cancels = self._cancels, {}
+        for rid, reason in cancels.items():
+            self._cancel_now(rid, reason, lifecycle.CANCELLED)
+
+    def _cancel_now(self, rid: Any, reason: str,
+                    status: str) -> bool:
+        """Driver-thread cancellation: remove the request wherever it
+        lives. A request already terminal (its natural completion
+        landed first, or a second cancel raced this one) is left
+        untouched — exactly one terminal Result per request."""
+        # Index-based queue scan: submit() may append from another
+        # thread mid-scan (appends keep existing indexes valid; this
+        # driver thread is the only popper), where iteration would
+        # raise "deque mutated during iteration".
+        for i in range(len(self.queue)):
+            req = self.queue[i]
+            if req.request_id == rid:
+                del self.queue[i]
+                self._terminal(rid, [], len(req.tokens), status, reason)
+                if not self._warming:
+                    _M_CANCELS.inc(1, reason=reason)
+                return True
+        for slot_idx, state in enumerate(self.slots):
+            if state is not None and state.request_id == rid:
+                # Row deactivated: the in-flight tick's tokens for
+                # this slot are discarded by the epoch check, and the
+                # next admission recycles the slot (its first prefill
+                # chunk clears the row dmask).
+                self._terminal(rid, state.generated, state.prompt_len,
+                               status, reason)
+                self.slots[slot_idx] = None
+                if not self._warming:
+                    _M_CANCELS.inc(1, reason=reason)
+                return True
+        return False
+
+    def cancel_all(self, reason: str = 'shutdown') -> List[Any]:
+        """Driver-thread bulk cancel (graceful drain): every queued
+        and in-slot request gets its terminal cancelled Result NOW.
+        Returns the cancelled request ids."""
+        self._apply_cancellations()
+        rids = [r.request_id for r in self.queue]
+        rids += [s.request_id for s in self.slots if s is not None]
+        for rid in rids:
+            self._cancel_now(rid, reason, lifecycle.CANCELLED)
+        return rids
+
+    def _expire_deadlines(self) -> None:
+        now = time.time()
+        expired = []
+        for i in range(len(self.queue)):   # index scan: see above
+            r = self.queue[i]
+            if r.deadline is not None and now >= r.deadline:
+                expired.append(r.request_id)
+        expired += [s.request_id for s in self.slots
+                    if s is not None and s.deadline is not None and
+                    now >= s.deadline]
+        for rid in expired:
+            self._cancel_now(rid, 'deadline', lifecycle.EXPIRED)
+
+    def estimate_wait_s(self, prompt_len: int, max_new: int) -> float:
+        """Estimated submit-to-finish seconds for a request arriving
+        NOW, from pending queue depth, prefill backlog and decode
+        capacity — the deadline-aware admission signal
+        (docs/request_lifecycle.md). Heuristic but monotone in load:
+        per-tick time is the measured EWMA; the request's own work is
+        its prefill ticks plus its decode ticks; everything already
+        queued or occupying a slot adds its remaining ticks divided
+        by the decode width (slots run batch_size-wide). Returns 0
+        before the first measured tick (no signal -> admit)."""
+        tick = self._tick_ewma
+        if tick is None:
+            return 0.0
+        own = (self._prefill_ticks(prompt_len) +
+               -(-max_new // self.decode_chunk))
+        backlog = 0
+        for s in list(self.slots):
+            if s is None:
+                continue
+            backlog += -(-max(0, s.max_new - len(s.generated)) //
+                         self.decode_chunk)
+            if s.phase == 'prefill':
+                backlog += self._prefill_ticks(
+                    max(0, s.prompt_len - s.prefill_pos))
+        # Index scan (not iteration): the driver thread pops from the
+        # left concurrently; a skipped/repeated element only perturbs
+        # an estimate that is heuristic anyway.
+        for i in range(len(self.queue)):
+            try:
+                r = self.queue[i]
+            except IndexError:
+                break
+            backlog += (self._prefill_ticks(len(r.tokens)) +
+                        -(-r.max_new // self.decode_chunk))
+        wait_ticks = own + backlog / max(1, self.batch_size)
+        return wait_ticks * tick
 
     def _is_done(self, state: _SlotState) -> bool:
         return (len(state.generated) >= state.max_new or
@@ -723,7 +932,28 @@ class ServingEngine:
 
         Results therefore surface one tick after their final decode
         chunk. Returns the number of tokens emitted this tick.
+
+        Lifecycle work happens at the tick boundary, before
+        admission: pending cancellations are applied (slots freed,
+        partial Results recorded) and past-deadline requests —
+        queued or mid-decode — are expired. A tick slower than
+        ``SKYTPU_TICK_HANG_SECONDS`` trips the watchdog (warning log
+        tagged with the active requests' trace ids + counter).
         """
+        t0 = time.perf_counter()
+        hang = None
+        if not self._warming:
+            # Warmup ticks never poll: compile-time ticks would burn
+            # a chaos plan's counters before serving even starts.
+            hang = fault_injection.poll(
+                'engine.tick.hang',
+                kinds=(fault_injection.FaultKind.HANG,))
+        if hang is not None:
+            # Act out a wedged device tick: the watchdog (below) must
+            # see the stall exactly as it would a real one.
+            time.sleep(float(hang.params.get('seconds', 0.05)))
+        self._apply_cancellations()
+        self._expire_deadlines()
         self._admit()
         new_entry = self._dispatch_tick()
         prev, self._pending = self._pending, new_entry
@@ -739,6 +969,34 @@ class ServingEngine:
             _M_TOKEN_LATENCY.observe(
                 (tick_at - self._last_tick_at) / emitted)
         self._last_tick_at = tick_at
+        dur = tick_at - t0
+        if (new_entry is not None or prev is not None) and \
+                not self._warming:
+            # Working ticks only: idle step() calls would drag the
+            # admission estimate toward zero. Warmup ticks are
+            # excluded for the same reason warmup is excluded from
+            # the TTFT histogram — their durations are XLA compiles,
+            # and an EWMA seeded with compile time would shed
+            # deadline'd requests from a completely idle engine.
+            self._tick_ewma = (dur if self._tick_ewma is None else
+                               0.8 * self._tick_ewma + 0.2 * dur)
+        if (self._tick_hang_s > 0 and dur > self._tick_hang_s and
+                not self._warming):
+            _M_TICK_HANGS.inc()
+            # Snapshot first (C-atomic): submit() inserts into
+            # _req_spans from the HTTP thread, and a comprehension
+            # iterating the live dict could die with 'dict changed
+            # size during iteration' — turning a slow tick into a
+            # dead replica.
+            traces = sorted({
+                ts['request'].trace_id
+                for ts in list(self._req_spans.values())
+                if 'request' in ts})
+            logger.warning(
+                'Engine tick took %.3fs (SKYTPU_TICK_HANG_SECONDS='
+                '%.1f): device hang or severe contention; active=%d '
+                'queued=%d traces=%s', dur, self._tick_hang_s,
+                self.num_active(), len(self.queue), traces[:4] or None)
         _M_QUEUE_DEPTH.set(len(self.queue))
         _M_ACTIVE_SLOTS.set(self.num_active())
         return emitted

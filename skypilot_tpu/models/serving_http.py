@@ -24,12 +24,23 @@ with a ``done`` event. The serve load balancer proxies response bodies
 chunk-by-chunk, so first tokens reach the client while the request is
 still decoding (reference analog: sky/serve/load_balancer.py:22
 proxies streaming responses).
+
+Request lifecycle (docs/request_lifecycle.md): /generate accepts a
+deadline (``X-Request-Deadline`` remaining-budget header stamped by
+the LB, or body ``timeout_s``) and sheds requests that cannot make it
+(429, reason='wont_make_deadline'); ``POST /cancel/<request_id>``
+cancels by X-Request-ID; a streaming client that hangs up cancels its
+engine request; SIGTERM/SIGINT (or ``POST /drain``) flip the server
+into draining mode — /health reports 'draining', new work is shed
+with 503 + Retry-After, and in-flight requests run to completion or
+cancellation under ``SKYTPU_DRAIN_TIMEOUT_SECONDS``.
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
 import json
+import signal
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -38,6 +49,8 @@ from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -45,6 +58,19 @@ logger = sky_logging.init_logger(__name__)
 _M_REJECTS = metrics_lib.counter(
     'skytpu_engine_rejects_total',
     'Generate requests shed with HTTP 429 (pending queue full).')
+_M_SHEDS = metrics_lib.counter(
+    'skytpu_http_sheds_total',
+    'Generate requests shed before admission, by reason: queue_full '
+    '(pending queue at max_pending), wont_make_deadline (estimated '
+    'queue wait exceeds the request deadline), draining (replica is '
+    'shutting down). See docs/request_lifecycle.md.',
+    labels=('reason',))
+_M_DRAIN = metrics_lib.histogram(
+    'skytpu_http_drain_seconds',
+    'Graceful-drain duration: SIGTERM/drain-request to every '
+    'in-flight request reaching a terminal state (bounded by '
+    'SKYTPU_DRAIN_TIMEOUT_SECONDS plus the force-cancel sweep).',
+    buckets=metrics_lib.LATENCY_BUCKETS)
 
 
 def _rid_headers(req_id: str) -> Dict[str, str]:
@@ -66,18 +92,31 @@ class EngineServer:
     keeps the legacy unbounded behavior (benches).
     """
 
-    def __init__(self, engine, max_pending: Optional[int] = None
-                 ) -> None:
+    def __init__(self, engine, max_pending: Optional[int] = None,
+                 warmup: bool = True) -> None:
         self.engine = engine
         self.max_pending = max_pending
+        self.warmup = warmup
         self._futures: Dict[Any, asyncio.Future] = {}
         # rid -> asyncio.Queue of token batches for streaming requests.
         self._streams: Dict[Any, asyncio.Queue] = {}
+        # External X-Request-ID -> engine rid, the POST /cancel lookup
+        # surface. skytpu-lint: disable=STL004 — same discipline as
+        # _futures: loop-thread-only mutation, atomic cross-thread get.
+        self._by_reqid: Dict[str, Any] = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop = threading.Event()
         self._ready = threading.Event()
+        # Flipped by the SIGTERM/SIGINT handler (flag-only: STL009)
+        # or POST /drain; the moment it is set, /health reports
+        # 'draining' and /generate sheds — drain() then runs the
+        # bounded wait + force-cancel sequence.
+        self._drain_requested = threading.Event()
+        # True once drain()/stop() ended with every in-flight request
+        # terminal and the driver thread joined.
+        self.clean_shutdown: Optional[bool] = None
         self._dead: Optional[str] = None
         self._thread = threading.Thread(target=self._drive, daemon=True)
 
@@ -89,7 +128,8 @@ class EngineServer:
 
     def _drive(self) -> None:
         try:
-            self.engine.warmup()
+            if self.warmup:
+                self.engine.warmup()
         except Exception as e:  # pylint: disable=broad-except
             logger.exception('Engine warmup failed')
             self._die(f'warmup failed: {e}')
@@ -159,6 +199,146 @@ class EngineServer:
 
         self._loop.call_soon_threadsafe(fail_all)
 
+    # ----------------------------------------------------------- drain
+    @property
+    def draining(self) -> bool:
+        return self._drain_requested.is_set()
+
+    def request_drain(self) -> None:
+        """Flip the server into draining mode (idempotent, safe from
+        any thread and from signal handlers): /health reports
+        'draining' so the LB and replica manager stop routing here,
+        and new /generate requests are shed with 503 + Retry-After.
+        The actual bounded wait + force-cancel runs in drain()."""
+        self._drain_requested.set()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT start a graceful drain. The handler body
+        only sets an event (STL009): the drain sequence itself —
+        waiting, cancelling, joining — runs on the main task, never
+        inside the signal frame. A SECOND signal while a drain is
+        already in progress escalates to an immediate exit — an
+        operator hammering Ctrl-C on a wedged drain must not be
+        ignored for the whole drain budget."""
+
+        def _handler(signum, frame):
+            del signum, frame
+            if self._drain_requested.is_set():
+                # Second signal: out NOW. A bare raise (no blocking
+                # work) unwinds the main task wherever it is.
+                raise KeyboardInterrupt
+            self._drain_requested.set()
+
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+
+    def _inflight_rids(self) -> set:
+        rids = set(self._futures) | set(self._streams)
+        try:
+            rids |= self.engine._inflight_ids()  # pylint: disable=protected-access
+        except RuntimeError:
+            pass  # queue mutated mid-scan; futures/streams cover it
+        return rids
+
+    def _engine_idle(self) -> bool:
+        return not (self.engine.queue or self.engine.num_active() or
+                    self.engine.has_pending)
+
+    async def drain(self) -> bool:
+        """Graceful drain (docs/request_lifecycle.md): let in-flight
+        requests run to completion for up to
+        ``SKYTPU_DRAIN_TIMEOUT_SECONDS``, then force-cancel the
+        stragglers (partial results, status='cancelled',
+        reason='shutdown'), stop the driver thread and report whether
+        shutdown was clean. Every in-flight request ends in exactly
+        one terminal state either way."""
+        self._drain_requested.set()
+        budget = max(0.0, lifecycle.drain_timeout_s())
+        t0 = time.perf_counter()
+        # A drain landing DURING warmup has no client work to wait
+        # for: /generate sheds 503 until _ready, so everything the
+        # engine holds is warmup's own synthetic requests — waiting
+        # the budget out (or force-cancelling them) would stall a
+        # perfectly normal startup-time termination and mis-report
+        # it as unclean.
+        warming = (self._thread.is_alive() and
+                   not self._ready.is_set() and self._dead is None)
+        # Chaos site: a fired 'hang' fault acts out in-flight work
+        # that refuses to finish for params['seconds'] — the
+        # force-cancel path must bound it exactly like a real stall.
+        # Not polled while warming: the warming branch skips the wait
+        # loop, and a one-shot spec must never be consumed without
+        # the stall being acted out.
+        fault = None
+        if not warming:
+            fault = fault_injection.poll(
+                'serve.replica.drain',
+                kinds=(fault_injection.FaultKind.HANG,))
+        stall_until = (t0 + float(fault.params.get('seconds', 0.0))
+                       if fault is not None else t0)
+        with trace_lib.span('http.drain', budget_s=budget,
+                            warming=warming) as sp:
+            deadline = t0 + budget
+            while not warming and time.perf_counter() < deadline:
+                busy = (self._inflight_rids() or
+                        not self._engine_idle() or
+                        time.perf_counter() < stall_until)
+                if not busy:
+                    break
+                await asyncio.sleep(0.02)
+            cancelled = ([] if warming else
+                         sorted(map(str, self._inflight_rids())))
+            if cancelled or (not warming and not self._engine_idle()):
+                logger.warning(
+                    'Drain budget (%.1fs) exhausted with %d request(s) '
+                    'in flight: force-cancelling (trace=%s).', budget,
+                    len(cancelled), trace_lib.current_trace_id())
+                if self._thread.is_alive():
+                    for rid in self._inflight_rids():
+                        self.engine.cancel(rid, reason='shutdown')
+                else:
+                    # No driver is ticking (never started / already
+                    # dead): nothing will apply deferred cancels, so
+                    # play the driver's role directly.
+                    self.engine.cancel_all(reason='shutdown')
+                    self._resolve_finished()
+                # The cancels surface as terminal Results within a
+                # tick; bound the sweep so a wedged device cannot
+                # hold the process hostage.
+                sweep = time.perf_counter() + max(2.0, budget or 1.0)
+                while time.perf_counter() < sweep:
+                    if not self._inflight_rids() and self._engine_idle():
+                        break
+                    await asyncio.sleep(0.02)
+            terminal = warming or (not self._inflight_rids() and
+                                   self._engine_idle())
+            joined = await asyncio.to_thread(self.stop)
+            if warming and not joined:
+                # The driver is still inside a warmup compile: no
+                # client work was ever in flight, and the daemon
+                # thread dies with the process exactly as it always
+                # did at exit — a startup-time SIGTERM is not an
+                # unclean shutdown.
+                logger.info('Driver still finishing warmup compiles '
+                            'at exit; no client work was in flight.')
+                joined = True
+            dur = time.perf_counter() - t0
+            _M_DRAIN.observe(dur, exemplar=sp.exemplar
+                             if sp is not None else None)
+            if sp is not None:
+                sp.set_attr(cancelled=len(cancelled),
+                            terminal=terminal, clean=joined)
+        # skytpu-lint: disable=STL004 — one-shot bool written after
+        # the driver thread has been joined (stop() above).
+        self.clean_shutdown = terminal and joined
+        if not self.clean_shutdown:
+            logger.warning(
+                'Drain finished NOT clean (terminal=%s joined=%s) '
+                'after %.2fs.', terminal, joined, dur)
+        else:
+            logger.info('Drained cleanly in %.2fs.', dur)
+        return self.clean_shutdown
+
     # ------------------------------------------------------------ http
     def _overloaded_response(self, req_id: str
                              ) -> Optional[web.Response]:
@@ -179,12 +359,59 @@ class EngineServer:
                            max(1, getattr(self.engine, 'batch_size',
                                           1))))
         _M_REJECTS.inc()
+        _M_SHEDS.inc(1, reason='queue_full')
         logger.warning('Shedding /generate (pending=%d) request=%s '
                        'trace=%s', depth, req_id,
                        trace_lib.current_trace_id())
         return web.json_response(
             {'error': 'server overloaded: pending queue is full',
+             'reason': 'queue_full',
              'pending': depth, 'max_pending': self.max_pending,
+             'request_id': req_id},
+            status=429, headers={'Retry-After': str(retry),
+                                 **_rid_headers(req_id)})
+
+    def _draining_response(self, req_id: str
+                           ) -> Optional[web.Response]:
+        """503 + Retry-After while draining: the LB should take its
+        retry to another replica; this one is going away."""
+        if not self.draining:
+            return None
+        _M_SHEDS.inc(1, reason='draining')
+        return web.json_response(
+            {'error': 'replica is draining', 'status': 'draining',
+             'reason': 'draining', 'request_id': req_id},
+            status=503, headers={'Retry-After': '1',
+                                 **_rid_headers(req_id)})
+
+    def _deadline_shed_response(self, req_id: str,
+                                deadline: Optional[float],
+                                prompt_len: int, max_new: int
+                                ) -> Optional[web.Response]:
+        """Deadline-aware admission (docs/request_lifecycle.md):
+        shed a request whose ESTIMATED queue wait already exceeds its
+        remaining budget — strictly better than the blind max_pending
+        bound, because a no-deadline request at the same queue depth
+        is still admitted, and a tight-deadline request is told
+        immediately instead of timing out after burning a slot."""
+        if deadline is None:
+            return None
+        left = deadline - time.time()
+        est = self.engine.estimate_wait_s(prompt_len, max_new)
+        if est <= left:
+            return None
+        _M_SHEDS.inc(1, reason='wont_make_deadline')
+        retry = max(1, min(30, int(est - max(left, 0.0)) + 1))
+        logger.warning(
+            'Shedding /generate (estimated wait %.2fs > remaining '
+            'budget %.2fs) request=%s trace=%s', est, left, req_id,
+            trace_lib.current_trace_id())
+        return web.json_response(
+            {'error': 'deadline cannot be met: estimated wait '
+                      f'{est:.2f}s exceeds remaining budget '
+                      f'{max(left, 0.0):.2f}s',
+             'reason': 'wont_make_deadline',
+             'estimated_wait_s': round(est, 3),
              'request_id': req_id},
             status=429, headers={'Retry-After': str(retry),
                                  **_rid_headers(req_id)})
@@ -211,7 +438,14 @@ class EngineServer:
         if temperature is not None and \
                 not isinstance(temperature, (int, float)):
             raise ValueError("'temperature' must be a number")
-        return tokens, max_new, temperature, bool(body.get('stream'))
+        timeout_s = body.get('timeout_s')
+        if timeout_s is not None:
+            if (not isinstance(timeout_s, (int, float)) or
+                    isinstance(timeout_s, bool) or timeout_s <= 0):
+                raise ValueError("'timeout_s' must be a positive "
+                                 'number of seconds')
+        return (tokens, max_new, temperature,
+                bool(body.get('stream')), timeout_s)
 
     async def handle_generate(self, request: web.Request
                               ) -> web.StreamResponse:
@@ -236,7 +470,7 @@ class EngineServer:
                 headers=_rid_headers(req_id))
         try:
             body = await request.json()
-            tokens, max_new, temperature, stream = \
+            tokens, max_new, temperature, stream, timeout_s = \
                 self._parse_generate(body)
             # Static-limit checks are host-side and safe pre-warmup;
             # rejecting here keeps them 400s even while warming.
@@ -251,9 +485,22 @@ class EngineServer:
         except (ValueError, UnicodeDecodeError) as e:
             return web.json_response({'error': str(e)}, status=400,
                                      headers=_rid_headers(req_id))
+        # Deadline resolution: the LB-stamped remaining-budget header
+        # wins (it reflects time already burned upstream); a direct
+        # client may send body timeout_s instead.
+        deadline = lifecycle.deadline_from_headers(request.headers)
+        if deadline is None and timeout_s is not None:
+            deadline = time.time() + timeout_s
+        draining = self._draining_response(req_id)
+        if draining is not None:
+            return draining
         overloaded = self._overloaded_response(req_id)
         if overloaded is not None:
             return overloaded
+        shed = self._deadline_shed_response(req_id, deadline,
+                                            len(tokens), max_new)
+        if shed is not None:
+            return shed
         if not self._ready.is_set():
             # Requests submitted during warmup would be drained by
             # warmup's own run() and silently lost.
@@ -262,43 +509,74 @@ class EngineServer:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
-        if stream:
-            return await self._generate_stream(
-                request, rid, req_id, tokens, max_new, temperature)
-        fut = asyncio.get_event_loop().create_future()
-        # skytpu-lint: disable=STL004 — _futures is mutated and
-        # iterated only on the event-loop thread (fail_all runs via
-        # call_soon_threadsafe); the driver thread does atomic pops.
-        self._futures[rid] = fut
+        # skytpu-lint: disable=STL004 — _by_reqid is mutated only on
+        # the event-loop thread; handle_cancel does an atomic get.
+        self._by_reqid[req_id] = rid
         try:
-            with self._lock:
-                self.engine.submit(Request(rid, tokens, max_new,
-                                           temperature=temperature))
-        except ValueError as e:
-            self._futures.pop(rid, None)
-            return web.json_response({'error': str(e)}, status=400,
-                                     headers=_rid_headers(req_id))
-        if self._dead is not None:
-            # The engine died between the entry check and our future
-            # registration (both on the loop thread, but the body
-            # await yields): _die's fail_all may already have swept
-            # _futures, so this future would hang forever.
-            self._futures.pop(rid, None)
+            if stream:
+                return await self._generate_stream(
+                    request, rid, req_id, tokens, max_new, temperature,
+                    deadline)
+            fut = asyncio.get_event_loop().create_future()
+            # skytpu-lint: disable=STL004 — _futures is mutated and
+            # iterated only on the event-loop thread (fail_all runs
+            # via call_soon_threadsafe); the driver thread does
+            # atomic pops.
+            self._futures[rid] = fut
+            try:
+                with self._lock:
+                    self.engine.submit(Request(rid, tokens, max_new,
+                                               temperature=temperature,
+                                               deadline=deadline))
+            except ValueError as e:
+                self._futures.pop(rid, None)
+                return web.json_response({'error': str(e)}, status=400,
+                                         headers=_rid_headers(req_id))
+            if self._dead is not None:
+                # The engine died between the entry check and our
+                # future registration (both on the loop thread, but
+                # the body await yields): _die's fail_all may already
+                # have swept _futures, so this future would hang
+                # forever.
+                self._futures.pop(rid, None)
+                return web.json_response(
+                    {'error': f'engine dead: {self._dead}'}, status=503,
+                    headers=_rid_headers(req_id))
+            try:
+                result = await fut
+            except asyncio.CancelledError:
+                # The client hung up while we awaited the engine:
+                # free the slot NOW instead of decoding tokens nobody
+                # will read.
+                self._futures.pop(rid, None)
+                self.engine.cancel(rid, reason='client_disconnect')
+                raise
             return web.json_response(
-                {'error': f'engine dead: {self._dead}'}, status=503,
+                {
+                    'tokens': result.tokens,
+                    'latency_s': (result.finished_at -
+                                  result.submitted_at),
+                    'status': result.status,
+                    'reason': result.reason,
+                },
                 headers=_rid_headers(req_id))
-        result = await fut
-        return web.json_response(
-            {
-                'tokens': result.tokens,
-                'latency_s': result.finished_at - result.submitted_at,
-            },
-            headers=_rid_headers(req_id))
+        finally:
+            if self._by_reqid.get(req_id) == rid:
+                self._by_reqid.pop(req_id, None)
 
     async def _generate_stream(self, request: web.Request, rid: Any,
                                req_id: str, tokens, max_new,
-                               temperature) -> web.StreamResponse:
-        """SSE: one ``data:`` event per decode chunk, then ``done``."""
+                               temperature,
+                               deadline: Optional[float] = None
+                               ) -> web.StreamResponse:
+        """SSE: one ``data:`` event per decode chunk, then ``done``.
+
+        A client that disconnects mid-stream cancels the engine
+        request (reason='client_disconnect'): its slot frees within a
+        tick instead of decoding to max_new for nobody. aiohttp
+        surfaces the disconnect either as ConnectionResetError from
+        ``write`` or by cancelling this handler task.
+        """
         from skypilot_tpu.models.serving_engine import Request
         q: asyncio.Queue = asyncio.Queue()
         # skytpu-lint: disable=STL004 — same discipline as _futures:
@@ -307,7 +585,8 @@ class EngineServer:
         try:
             with self._lock:
                 self.engine.submit(Request(rid, tokens, max_new,
-                                           temperature=temperature))
+                                           temperature=temperature,
+                                           deadline=deadline))
         except ValueError as e:
             self._streams.pop(rid, None)
             return web.json_response({'error': str(e)}, status=400,
@@ -325,8 +604,11 @@ class EngineServer:
             'X-Accel-Buffering': 'no',
             **_rid_headers(req_id),
         })
-        await resp.prepare(request)
         try:
+            # prepare() is INSIDE the guarded region: a client that
+            # hangs up this early cancels the handler right here, and
+            # the engine request + stream registration must not leak.
+            await resp.prepare(request)
             while True:
                 item = await q.get()
                 if isinstance(item, tuple) and item[0] == 'done':
@@ -336,6 +618,8 @@ class EngineServer:
                         'tokens': res.tokens,
                         'latency_s': (res.finished_at -
                                       res.submitted_at),
+                        'status': res.status,
+                        'reason': res.reason,
                     }
                     await resp.write(
                         f'data: {json.dumps(payload)}\n\n'.encode())
@@ -348,15 +632,58 @@ class EngineServer:
                 await resp.write(
                     f'data: {json.dumps({"tokens": item})}\n\n'
                     .encode())
+        except (asyncio.CancelledError, ConnectionResetError):
+            self.engine.cancel(rid, reason='client_disconnect')
+            logger.info('Client disconnected mid-stream; cancelled '
+                        'request=%s trace=%s', req_id,
+                        trace_lib.current_trace_id())
+            raise
         finally:
             self._streams.pop(rid, None)
+            if self._by_reqid.get(req_id) == rid:
+                self._by_reqid.pop(req_id, None)
         await resp.write_eof()
         return resp
+
+    async def handle_cancel(self, request: web.Request) -> web.Response:
+        """POST /cancel/<request_id>: cancel a live request by its
+        X-Request-ID. 202 when the cancel was accepted (the terminal
+        'cancelled' Result lands within a tick), 404 when no such
+        request is in flight (unknown id, or already terminal)."""
+        req_id = request.match_info['request_id']
+        rid = self._by_reqid.get(req_id)
+        if rid is None or not self.engine.cancel(rid, reason='api'):
+            return web.json_response(
+                {'error': f'no in-flight request {req_id!r}'},
+                status=404, headers=_rid_headers(req_id))
+        return web.json_response(
+            {'cancelling': True, 'request_id': req_id}, status=202,
+            headers=_rid_headers(req_id))
+
+    async def handle_drain(self, request: web.Request) -> web.Response:
+        """POST /drain: flip into draining mode (the replica manager's
+        drain-then-kill hook). Returns immediately; the process's main
+        task runs the bounded drain sequence. The body echoes THIS
+        replica's drain budget so the caller waits on the replica's
+        clock, not its own SKYTPU_DRAIN_TIMEOUT_SECONDS (env skew
+        between controller and replica hosts must not cut a drain
+        short)."""
+        del request
+        self.request_drain()
+        return web.json_response(
+            {'status': 'draining',
+             'budget_s': max(0.0, lifecycle.drain_timeout_s())},
+            status=202)
 
     async def handle_health(self, request: web.Request) -> web.Response:
         if self._dead is not None:
             return web.json_response(
                 {'status': 'dead', 'reason': self._dead}, status=503)
+        if self.draining:
+            # 503 so the LB and the replica manager's probe both stop
+            # routing here; the body names the reason so a deliberate
+            # drain is distinguishable from a crash.
+            return web.json_response({'status': 'draining'}, status=503)
         if not self._ready.is_set():
             return web.json_response({'status': 'warming'}, status=503)
         return web.json_response({'status': 'ok'})
@@ -377,6 +704,8 @@ class EngineServer:
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post('/generate', self.handle_generate)
+        app.router.add_post('/cancel/{request_id}', self.handle_cancel)
+        app.router.add_post('/drain', self.handle_drain)
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
         return app
@@ -393,14 +722,29 @@ class EngineServer:
         logger.info('Engine server on :%d', port)
         return runner
 
-    def stop(self) -> None:
+    def stop(self) -> bool:
+        """Stop the driver thread; True when it actually exited.
+
+        Join so interpreter teardown never kills the driver thread
+        mid-device-call (which aborts with an unraisable C++
+        exception). Bounded: warmup compiles can outlast it — and a
+        join timing out means the thread is STILL RUNNING, which the
+        old code silently ignored. Now the leak is checked
+        (is_alive after the join), logged with the active trace id,
+        and reported to the caller so the exit path can surface a
+        non-clean shutdown instead of pretending the join succeeded.
+        """
         self._stop.set()
-        # Join so interpreter teardown never kills the driver thread
-        # mid-device-call (which aborts with an unraisable C++
-        # exception). Bounded: warmup compiles can outlast it, and a
-        # daemon thread dying later is only unclean at exit.
-        if self._thread.ident is not None and self._thread.is_alive():
-            self._thread.join(timeout=10)
+        if self._thread.ident is None or not self._thread.is_alive():
+            return True
+        self._thread.join(timeout=10)
+        if self._thread.is_alive():
+            logger.warning(
+                'Engine driver thread still alive after a 10s join '
+                '(trace=%s): a device call is hung; shutdown is NOT '
+                'clean.', trace_lib.current_trace_id())
+            return False
+        return True
 
 
 def _build_engine(args) -> 'Any':
@@ -543,13 +887,36 @@ def main() -> None:
         _build_engine(args),
         max_pending=(args.max_pending if args.max_pending > 0
                      else None))
+    # SIGTERM/SIGINT flow into a graceful drain
+    # (docs/request_lifecycle.md): the handler only sets a flag; the
+    # main task below notices and runs the bounded drain sequence.
+    server.install_signal_handlers()
 
-    async def _run():
-        await server.start(args.port)
-        while True:
-            await asyncio.sleep(3600)
+    async def _run() -> bool:
+        runner = await server.start(args.port)
+        while not server.draining:
+            await asyncio.sleep(0.1)
+        logger.info('Drain requested (signal or /drain): shutting '
+                    'down gracefully.')
+        clean = await server.drain()
+        await runner.cleanup()
+        return clean
 
-    asyncio.run(_run())
+    try:
+        clean = asyncio.run(_run())
+    except KeyboardInterrupt:
+        # Second signal during the drain: the operator asked to skip
+        # the graceful path. 130 = killed by signal, by convention.
+        logger.warning('Second signal received: exiting immediately; '
+                       'in-flight work was abandoned.')
+        import sys
+        sys.exit(130)
+    if not clean:
+        # Non-clean shutdown (in-flight work never reached a terminal
+        # state, or the driver thread leaked past its join): exit
+        # non-zero so supervisors see it — never pretend.
+        import sys
+        sys.exit(1)
 
 
 if __name__ == '__main__':

@@ -16,20 +16,31 @@ connection failed, so the replica never saw it — is transparently
 retried on a different ready replica. Replica removal (rolling
 update, downscale) can ``drain()`` a URL: stop picking it, then wait
 for its in-flight requests to finish before teardown.
+
+Request lifecycle (docs/request_lifecycle.md): a client's
+``X-Request-Deadline`` remaining-budget header becomes an absolute
+deadline at arrival; every proxy attempt re-stamps the budget still
+left, a past-deadline request is answered 504 and never retried, and
+a replica's 429/503 shed is retried on another replica — with the
+last shed's Retry-After and reason forwarded when every candidate
+sheds.
 """
 from __future__ import annotations
 
 import asyncio
 import itertools
+import json
 import threading
 import time
-from typing import Callable, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -55,8 +66,13 @@ _M_LATENCY = metrics_lib.histogram(
 _M_ERRORS = metrics_lib.counter(
     'skytpu_lb_replica_errors_total',
     'Proxy failures per replica by kind (connect, disconnect, '
-    'mid_stream, upstream).',
+    'mid_stream, upstream, shed).',
     labels=('replica', 'kind'))
+_M_DEADLINE_REJECTS = metrics_lib.counter(
+    'skytpu_lb_deadline_rejects_total',
+    'Requests answered 504 at the LB because their deadline passed '
+    'before (or between) proxy attempts — a past-deadline request '
+    'is never retried (docs/request_lifecycle.md).')
 
 
 class LoadBalancingPolicy:
@@ -66,6 +82,9 @@ class LoadBalancingPolicy:
 
     def __init__(self) -> None:
         self._urls: List[str] = []
+
+    def urls(self) -> List[str]:
+        return list(self._urls)
 
     def set_urls(self, urls: List[str]) -> None:
         for gone in set(self._urls) - set(urls):
@@ -200,29 +219,109 @@ class LoadBalancer:
         with trace_lib.span('lb.request', parent=ctx,
                             method=request.method,
                             path=request.rel_url.path):
+            if (request.method == 'POST' and
+                    request.rel_url.path.startswith('/cancel/')):
+                return await self._cancel_broadcast(request)
             return await self._proxy_attempts(request)
+
+    async def _cancel_broadcast(self, request: web.Request
+                                ) -> web.Response:
+        """POST /cancel/<id> fans out to EVERY known replica —
+        draining ones included. The LB routed the original /generate
+        wherever it pleased, so a cancel-by-request-id cannot know
+        which replica holds the request; round-robining it would let
+        a wrong-replica 404 mask the right replica's 202
+        (docs/request_lifecycle.md)."""
+        urls = set(self.policy.urls()) | self._draining
+        if not urls:
+            return web.Response(status=503,
+                                text='No ready replicas.\n')
+        path = request.rel_url.path
+        assert self._session is not None, 'start() not called'
+
+        async def one(url: str):
+            try:
+                # Short per-call bound: one wedged replica must not
+                # hold the whole broadcast (and the client's cancel)
+                # hostage to the session's long sock_read.
+                async with self._session.post(
+                        url.rstrip('/') + path,
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    return (resp.status, await resp.read(),
+                            resp.headers.get('Content-Type',
+                                             'application/json'))
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                return None
+
+        results = [r for r in await asyncio.gather(
+            *(one(u) for u in sorted(urls))) if r is not None]
+        # One replica accepting wins; otherwise surface any answer
+        # (typically 404 unknown-id); only total unreachability 502s.
+        chosen = next((r for r in results if r[0] == 202),
+                      results[0] if results else None)
+        if chosen is None:
+            return web.Response(status=502,
+                                text='No replica reachable.\n')
+        return web.Response(status=chosen[0], body=chosen[1],
+                            content_type=chosen[2].split(';')[0])
 
     async def _proxy_attempts(self, request: web.Request
                               ) -> web.StreamResponse:
         if self.on_request is not None:
             self.on_request()
         body = await request.read()
+        # End-to-end deadline (docs/request_lifecycle.md): the
+        # client's remaining-budget header becomes an absolute
+        # deadline HERE; every proxy attempt re-stamps the budget
+        # still left, and a request whose deadline has passed is
+        # answered 504 — never retried onto another replica.
+        deadline = lifecycle.deadline_from_headers(request.headers)
         tried: Set[str] = set()
         last_err: Optional[BaseException] = None
+        last_shed: Optional[_ReplicaShedError] = None
+        # Set when an attempt failed AFTER the request reached a
+        # replica that may have executed it: that ambiguity must
+        # reach the client, never be masked by an earlier shed.
+        may_have_executed = False
         trace_id = trace_lib.current_trace_id()
         for _ in range(self.MAX_ATTEMPTS):
+            left = lifecycle.remaining(deadline)
+            if left is not None and left <= 0:
+                _M_DEADLINE_REJECTS.inc()
+                logger.warning('Deadline passed before attempt '
+                               '(trace=%s); answering 504.', trace_id)
+                return web.json_response(
+                    {'error': 'deadline exceeded before the request '
+                              'could be served',
+                     'reason': 'deadline_exceeded'}, status=504)
             url = self.policy.pick(exclude=tried | self._draining)
             if url is None:
                 break
             tried.add(url)
-            sp = trace_lib.start_span('lb.proxy', replica=url)
+            sp = trace_lib.start_span('lb.proxy', replica=url,
+                                      **({'budget_s': round(left, 3)}
+                                         if left is not None else {}))
             try:
                 with trace_lib.activate(sp):
-                    resp = await self._proxy_once(request, url, body)
+                    resp = await self._proxy_once(request, url, body,
+                                                  deadline)
                 sp.finish(status=resp.status)
                 _M_LATENCY.observe(sp.duration, exemplar=sp.exemplar,
                                    replica=url)
                 return resp
+            except _ReplicaShedError as e:
+                # The replica REFUSED the request (429 queue-full /
+                # deadline shed, 503 draining-or-warming) without
+                # executing it: safe to try another replica for any
+                # method. If every candidate sheds, the LAST shed
+                # response — Retry-After and reason included — is
+                # forwarded to the client instead of being swallowed.
+                sp.finish(status=e.status, error='shed')
+                logger.info('Replica %s shed the request (%d, '
+                            'reason=%s); trying another (trace=%s)',
+                            url, e.status, e.reason, trace_id)
+                _M_ERRORS.inc(1, replica=url, kind='shed')
+                last_shed = e
             except aiohttp.ClientConnectorError as e:
                 # TCP connect failed: the replica NEVER received the
                 # request — safe to retry on another replica for any
@@ -245,6 +344,7 @@ class LoadBalancer:
                                    '(%s); not retrying %s (trace=%s)',
                                    url, e, request.method, trace_id)
                     last_err = e
+                    may_have_executed = True
                     break
                 logger.warning('Replica %s dropped %s (%s); retrying '
                                '(trace=%s)', url, request.method, e,
@@ -267,6 +367,7 @@ class LoadBalancer:
                     # Same double-execution risk as the dropped-
                     # connection branch: the replica may have run the
                     # request (e.g. 200 headers then a payload error).
+                    may_have_executed = True
                     break
             finally:
                 # An exception outside the enumerated arms — notably
@@ -277,6 +378,15 @@ class LoadBalancer:
                 if sp.end_time is None:
                     sp.finish(error='aborted')
                 self.policy.done(url)
+        if last_shed is not None and not may_have_executed:
+            # Every candidate shed (or was unreachable without ever
+            # receiving the request): surface the last replica's own
+            # verdict (status, Retry-After, reason) so the client
+            # backs off intelligently instead of seeing a generic
+            # error with the hint stripped. A shed explicitly means
+            # "refused WITHOUT executing, safe to resubmit" — so it
+            # must never mask a later may-have-executed failure.
+            return last_shed.client_response()
         if last_err is None:
             return web.Response(status=503,
                                 text='No ready replicas.\n')
@@ -284,7 +394,9 @@ class LoadBalancer:
                             text=f'Replica unreachable: {last_err}\n')
 
     async def _proxy_once(self, request: web.Request, url: str,
-                          body: bytes) -> web.StreamResponse:
+                          body: bytes,
+                          deadline: Optional[float] = None
+                          ) -> web.StreamResponse:
         target = url.rstrip('/') + '/' + request.rel_url.path.lstrip('/')
         if request.rel_url.query_string:
             target += '?' + request.rel_url.query_string
@@ -301,10 +413,28 @@ class LoadBalancer:
             headers = {k: v for k, v in headers.items()
                        if k.lower() != trace_lib.TRACEPARENT_HEADER}
             headers.update(tp)
+        # Stamp the budget STILL LEFT for this attempt (a retry after
+        # a slow failure hands the replica less than the original):
+        # the replica turns it back into an absolute local deadline.
+        budget = lifecycle.budget_headers(deadline)
+        if budget:
+            headers = {k: v for k, v in headers.items()
+                       if k.lower() != lifecycle.DEADLINE_HEADER.lower()}
+            headers.update(budget)
         assert self._session is not None, 'start() not called'
         async with self._session.request(request.method, target,
                                          headers=headers,
                                          data=body) as resp:
+            if (resp.status in (429, 503) and
+                    request.rel_url.path != '/health'):
+                # A shed, not a result: the replica refused without
+                # executing (queue full, wont_make_deadline,
+                # draining, warming). Raise so the attempt loop can
+                # try a replica with capacity — and forward THIS
+                # response's Retry-After/reason if none has any.
+                raise _ReplicaShedError(
+                    resp.status, await resp.read(),
+                    dict(resp.headers))
             out_headers = {
                 k: v for k, v in resp.headers.items()
                 if k.lower() not in _HOP_HEADERS and
@@ -313,6 +443,7 @@ class LoadBalancer:
             out = web.StreamResponse(status=resp.status,
                                      headers=out_headers)
             started = False
+            disconnect = None
             try:
                 # Chunk-by-chunk passthrough: an SSE token stream (or
                 # any long body) reaches the client as the replica
@@ -321,7 +452,24 @@ class LoadBalancer:
                     if not started:
                         await out.prepare(request)
                         started = True
+                        # Chaos site (docs/fault_injection.md): act
+                        # out the client hanging up mid-response.
+                        # Polled only once a chunk really streamed —
+                        # a shed or connect-failure attempt must not
+                        # burn a one-shot disconnect spec without
+                        # acting it out.
+                        disconnect = fault_injection.poll(
+                            'lb.client_disconnect',
+                            kinds=(fault_injection.FaultKind
+                                   .CLIENT_DISCONNECT,),
+                            replica=url, path=request.rel_url.path)
                     await out.write(chunk)
+                    if disconnect is not None:
+                        resp.close()   # abort upstream: replica sees
+                        raise _MidStreamError(  # the hangup
+                            out, ConnectionResetError(
+                                '[fault-injection] client '
+                                'disconnect'))
                 if not started:
                     await out.prepare(request)
                 await out.write_eof()
@@ -385,3 +533,31 @@ class _MidStreamError(Exception):
         super().__init__(str(cause))
         self.response = response
         self.cause = cause
+
+
+class _ReplicaShedError(Exception):
+    """A replica answered 429/503 without executing the request
+    (queue full, wont_make_deadline, draining, warming): the attempt
+    loop may safely retry another replica, and must forward the shed
+    verdict — Retry-After included — if every candidate sheds."""
+
+    _FORWARD_HEADERS = ('retry-after', 'content-type', 'x-request-id')
+
+    def __init__(self, status: int, body: bytes,
+                 headers: Dict[str, str]) -> None:
+        self.status = status
+        self.body = body
+        self.headers = headers
+        self.reason = None
+        try:
+            self.reason = json.loads(body or b'{}').get('reason')
+        except (ValueError, AttributeError):
+            pass
+        super().__init__(f'replica shed ({status}, '
+                         f'reason={self.reason})')
+
+    def client_response(self) -> web.Response:
+        fwd = {k: v for k, v in self.headers.items()
+               if k.lower() in self._FORWARD_HEADERS}
+        return web.Response(status=self.status, body=self.body,
+                            headers=fwd)

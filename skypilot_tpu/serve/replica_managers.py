@@ -8,6 +8,7 @@ preemptions (cluster gone → PREEMPTED → replaced) and failures.
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 import traceback
@@ -24,6 +25,7 @@ from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import retry as retry_lib
 from skypilot_tpu.utils import status_lib
@@ -203,6 +205,12 @@ class ReplicaManager:
             url = (records.get(replica_id) or {}).get('url')
 
             def work(rid=replica_id, u=url):
+                # Voluntary teardown is drain-then-kill
+                # (docs/request_lifecycle.md): first the LB stops
+                # routing and waits out in-flight proxied requests,
+                # then the replica PROCESS drains (its own in-flight
+                # work finishes or is cancelled under the drain
+                # budget), and only then does the cluster go down.
                 if u and self.drain_fn is not None:
                     try:
                         self.drain_fn(u)
@@ -210,9 +218,62 @@ class ReplicaManager:
                         logger.warning(
                             'LB drain of %s failed:\n%s', u,
                             traceback.format_exc())
+                if u:
+                    self._drain_replica(u)
                 self._terminate_replica(rid)
 
             threading.Thread(target=work, daemon=True).start()
+
+    def _drain_replica(self, url: str) -> None:
+        """Ask the replica process to drain gracefully (POST /drain:
+        /health flips to 'draining', in-flight requests finish or are
+        cancelled under SKYTPU_DRAIN_TIMEOUT_SECONDS, the process
+        exits), then wait — bounded — for it to finish before the
+        hard cluster teardown. Best-effort: a replica that never
+        exposed the endpoint (or is already gone) just falls through
+        to the kill."""
+        base = url.rstrip('/')
+        budget = max(1.0, lifecycle.drain_timeout_s())
+        try:
+            resp = requests.post(
+                base + '/drain',
+                timeout=(_PROBE_CONNECT_TIMEOUT_SECONDS, 5))
+            if resp.status_code >= 400:
+                logger.info('Replica %s has no drain endpoint '
+                            '(HTTP %d); proceeding to teardown.',
+                            url, resp.status_code)
+                return
+            try:
+                # The REPLICA's budget governs how long its drain may
+                # take — its env may differ from this controller's.
+                # Finite only: an inf budget (JSON round-trips
+                # Infinity) would wedge this teardown thread forever.
+                echoed = float((resp.json() or {}).get('budget_s'))
+                if math.isfinite(echoed) and echoed >= 0:
+                    budget = max(1.0, echoed)
+            except (ValueError, TypeError):
+                pass
+        except requests.RequestException as e:
+            logger.info('Replica drain request to %s failed (%s); '
+                        'proceeding to teardown.', url, e)
+            return
+        deadline = time.time() + budget + 5.0
+        while time.time() < deadline:
+            try:
+                health = requests.get(base + '/health', timeout=(2, 5))
+            except requests.RequestException:
+                return      # process exited: drain complete
+            try:
+                if (health.json() or {}).get('status') != 'draining':
+                    return  # terminal (ok after abort, or dead)
+            except ValueError:
+                return
+            # skytpu-lint: disable=STL002 — bounded drain-completion
+            # poll, not a retry loop: nothing is re-attempted, the
+            # loop only waits for the replica's own drain to finish.
+            time.sleep(0.25)
+        logger.warning('Replica at %s still draining after the %.0fs '
+                       'budget; proceeding to teardown.', url, budget)
 
     def _terminate_replica(
             self, replica_id: int,
@@ -287,16 +348,22 @@ class ReplicaManager:
         return f'http://{ips[0]}:{self._replica_port(replica_id, spec)}'
 
     def _probe_ready(self, url: str, spec: ServiceSpec,
-                     replica_id: Optional[int] = None) -> bool:
+                     replica_id: Optional[int] = None) -> str:
         """One readiness probe with an explicit, always-bounded
-        per-request timeout. A single failed probe never declares a
-        replica dead — probe_all counts consecutive failures against
-        not_ready_threshold / probe_failure_terminate_threshold."""
+        per-request timeout; returns 'ready', 'draining' or 'down'.
+        A single failed probe never declares a replica dead —
+        probe_all counts consecutive failures against
+        not_ready_threshold / probe_failure_terminate_threshold. A
+        'draining' answer (the replica got SIGTERM and is finishing
+        its in-flight work, docs/request_lifecycle.md) is a
+        DELIBERATE state, not a failure: the replica leaves the
+        routable set immediately but is not counted toward the
+        failed-probe terminate streak."""
         fault = fault_injection.poll('serve.replica.probe_ready',
                                      replica_id=replica_id, url=url)
         if fault is not None:
             _M_PROBE_FAILURES.inc(1, replica=url)
-            return False
+            return 'down'
         read_timeout = (_DEFAULT_PROBE_TIMEOUT_SECONDS
                         if spec.readiness_timeout_seconds is None
                         else spec.readiness_timeout_seconds)
@@ -307,12 +374,17 @@ class ReplicaManager:
                 url.rstrip('/') + spec.readiness_path,
                 timeout=(connect_timeout, read_timeout))
             if resp.status_code >= 500:
+                try:
+                    if (resp.json() or {}).get('status') == 'draining':
+                        return 'draining'
+                except ValueError:
+                    pass
                 _M_PROBE_FAILURES.inc(1, replica=url)
-                return False
-            return True
+                return 'down'
+            return 'ready'
         except requests.RequestException:
             _M_PROBE_FAILURES.inc(1, replica=url)
-            return False
+            return 'down'
 
     def probe_all(self) -> None:
         """One probe pass: drive the FSM for every live replica."""
@@ -347,14 +419,25 @@ class ReplicaManager:
                 self._terminate_in_background(rid, remove=True)
                 continue
             url = self._replica_url(rid, cluster, spec)
-            ready = url is not None and self._probe_ready(
-                url, spec, replica_id=rid)
-            if ready:
+            probe = ('down' if url is None else
+                     self._probe_ready(url, spec, replica_id=rid))
+            if probe == 'ready':
                 with self._lock:
                     self._failed_probes[rid] = 0
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.READY,
                                                url=url)
+            elif probe == 'draining':
+                # Deliberate drain (SIGTERM'd replica finishing its
+                # in-flight work): leave the routable set NOW — the
+                # same exclusion a failed-probe demotion gets, but
+                # without waiting out the not-ready threshold and
+                # without feeding the terminate streak (the drain
+                # path owns this replica's teardown).
+                logger.info('Replica %d is draining: demoting to '
+                            'NOT_READY.', rid)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.NOT_READY)
             elif status in (ReplicaStatus.READY,
                             ReplicaStatus.NOT_READY):
                 with self._lock:

@@ -214,6 +214,18 @@ SKYTPU_PREFILL_BUDGET = register(
     'chunk rows, so the effective budget is '
     'chunk * max(1, budget // chunk)).')
 
+# --------------------------------------------------- request lifecycle
+SKYTPU_DRAIN_TIMEOUT_SECONDS = register(
+    'SKYTPU_DRAIN_TIMEOUT_SECONDS',
+    'Graceful-drain budget for a SIGTERM\'d serving replica: seconds '
+    'in-flight requests may run to completion before being cancelled '
+    'and the process exits (docs/request_lifecycle.md; default 30).')
+SKYTPU_TICK_HANG_SECONDS = register(
+    'SKYTPU_TICK_HANG_SECONDS',
+    'Serving-engine tick watchdog: a device tick slower than this '
+    'many seconds logs a trace-tagged warning and bumps '
+    'skytpu_engine_tick_hangs_total (0 disables; default 30).')
+
 # ------------------------------------------------- bench.py (BENCH_*)
 BENCH_SMOKE = register(
     'BENCH_SMOKE',

@@ -82,6 +82,12 @@ KNOWN_SITES = (
     'agent.worker_probe',
     'jobs.controller.heartbeat',
     'serve.replica.probe_ready',
+    # Request-lifecycle sites (docs/request_lifecycle.md): a wedged
+    # device tick, a replica whose in-flight work outlives its drain
+    # budget, and a client hanging up mid-stream at the LB.
+    'engine.tick.hang',
+    'serve.replica.drain',
+    'lb.client_disconnect',
 )
 
 # Chaos observability (docs/metrics.md): every injected fault counts
@@ -102,6 +108,10 @@ class FaultKind(str, enum.Enum):
     SSH_FAILURE = 'ssh_failure'
     TUNNEL_FAILURE = 'tunnel_failure'
     PROBE_TIMEOUT = 'probe_timeout'
+    # Lifecycle kinds: a stall at the site (the site sleeps for
+    # params['seconds']) and a client that hangs up mid-response.
+    HANG = 'hang'
+    CLIENT_DISCONNECT = 'client_disconnect'
 
 
 @dataclasses.dataclass
@@ -328,8 +338,10 @@ def make_exception(spec: FaultSpec, site: str) -> Exception:
         return exceptions.ProvisionError(msg)
     if spec.kind in (FaultKind.SSH_FAILURE, FaultKind.TUNNEL_FAILURE):
         return exceptions.CommandError(255, f'<{site}>', msg)
-    if spec.kind is FaultKind.PROBE_TIMEOUT:
+    if spec.kind in (FaultKind.PROBE_TIMEOUT, FaultKind.HANG):
         return TimeoutError(msg)
+    if spec.kind is FaultKind.CLIENT_DISCONNECT:
+        return ConnectionResetError(msg)
     return AssertionError(f'unmapped fault kind {spec.kind}')
 
 

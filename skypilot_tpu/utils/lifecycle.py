@@ -1,0 +1,115 @@
+"""Request-lifecycle contract shared across the serving stack.
+
+One place for the knobs and wire forms that give every admitted
+request a bounded lifetime (docs/request_lifecycle.md):
+
+- **Deadline header** (``X-Request-Deadline``): the *remaining* time
+  budget in seconds, re-stamped by every hop. The load balancer
+  computes an absolute deadline when the request arrives (from the
+  client's header or its own policy), and each proxy attempt stamps
+  the budget still left; the replica converts it back to an absolute
+  deadline against its own clock. Carrying a relative budget instead
+  of an absolute timestamp makes the contract immune to clock skew
+  between the controller and replica hosts.
+- **Drain budget** (``SKYTPU_DRAIN_TIMEOUT_SECONDS``): how long a
+  SIGTERM'd replica lets in-flight requests run before cancelling
+  them and exiting.
+- **Tick watchdog** (``SKYTPU_TICK_HANG_SECONDS``): an engine tick
+  slower than this logs a trace-tagged warning and bumps a counter —
+  a wedged device must be visible, not silent.
+
+Import-light on purpose: the load balancer and replica manager import
+this without dragging in jax.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from skypilot_tpu.utils import env_registry
+
+# Remaining-budget header (seconds, float as string). Stamped by the
+# LB on every proxy attempt; accepted from clients directly too.
+DEADLINE_HEADER = 'X-Request-Deadline'
+
+# Default drain budget when SKYTPU_DRAIN_TIMEOUT_SECONDS is unset.
+DEFAULT_DRAIN_TIMEOUT_SECONDS = 30.0
+# Default tick-hang threshold when SKYTPU_TICK_HANG_SECONDS is unset.
+DEFAULT_TICK_HANG_SECONDS = 30.0
+
+# Terminal request states (docs/request_lifecycle.md state diagram).
+FINISHED = 'finished'
+CANCELLED = 'cancelled'
+EXPIRED = 'expired'
+TERMINAL_STATES = (FINISHED, CANCELLED, EXPIRED)
+
+
+def _float_env(name: str, default: float) -> float:
+    raw = env_registry.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def drain_timeout_s() -> float:
+    """Seconds a draining replica lets in-flight requests run before
+    force-cancelling them (<= 0 cancels immediately)."""
+    return _float_env(env_registry.SKYTPU_DRAIN_TIMEOUT_SECONDS,
+                      DEFAULT_DRAIN_TIMEOUT_SECONDS)
+
+
+def tick_hang_s() -> float:
+    """Engine-tick watchdog threshold in seconds; 0 disables."""
+    return _float_env(env_registry.SKYTPU_TICK_HANG_SECONDS,
+                      DEFAULT_TICK_HANG_SECONDS)
+
+
+def parse_budget(value: Any) -> Optional[float]:
+    """A remaining-seconds budget from a header/body field; None when
+    absent or unusable (a malformed budget must degrade to 'no
+    deadline', never to a 500 on the serving path)."""
+    if value is None:
+        return None
+    try:
+        budget = float(value)
+    except (TypeError, ValueError):
+        return None
+    if budget != budget or budget in (float('inf'), float('-inf')):
+        return None
+    return budget
+
+
+def deadline_from_headers(headers: Any,
+                          now: Optional[float] = None) -> Optional[float]:
+    """Absolute local deadline from a request's remaining-budget
+    header (``X-Request-Deadline``), or None when not set."""
+    getter = getattr(headers, 'get', None)
+    if getter is None:
+        return None
+    budget = parse_budget(getter(DEADLINE_HEADER))
+    if budget is None:
+        return None
+    return (time.time() if now is None else now) + budget
+
+
+def remaining(deadline: Optional[float],
+              now: Optional[float] = None) -> Optional[float]:
+    """Seconds left before ``deadline`` (negative = already past);
+    None when there is no deadline."""
+    if deadline is None:
+        return None
+    return deadline - (time.time() if now is None else now)
+
+
+def budget_headers(deadline: Optional[float],
+                   now: Optional[float] = None) -> dict:
+    """The remaining-budget header for the next hop ({} without a
+    deadline). Clamped at 0 so a just-expired request still carries
+    an explicit empty budget rather than a negative one."""
+    left = remaining(deadline, now)
+    if left is None:
+        return {}
+    return {DEADLINE_HEADER: f'{max(0.0, left):.3f}'}

@@ -462,6 +462,124 @@ class TestJaxRecompileHazard:
             ''', path=self.PATH) == []
 
 
+# ---------------------------------------------------------------- STL009
+class TestBlockingSignalHandler:
+
+    def test_fires_on_join_in_handler(self):
+        vs = lint('''
+            import signal
+
+            def _handler(signum, frame):
+                worker.join(timeout=10)
+
+            signal.signal(signal.SIGTERM, _handler)
+            ''')
+        assert rules_of(vs) == ['STL009']
+        assert 'join' in vs[0].message
+
+    def test_fires_on_io_and_logging(self):
+        vs = lint('''
+            import signal
+
+            def _handler(signum, frame):
+                logger.warning('going down')
+                open('/tmp/x', 'w').write('bye')
+
+            signal.signal(signal.SIGTERM, _handler)
+            ''')
+        assert rules_of(vs) == ['STL009', 'STL009']
+
+    def test_fires_on_blocking_lambda(self):
+        vs = lint('''
+            import signal
+            import time
+            signal.signal(signal.SIGINT,
+                          lambda s, f: time.sleep(5))
+            ''')
+        assert rules_of(vs) == ['STL009']
+
+    def test_quiet_on_flag_only_handler(self):
+        assert lint('''
+            import signal
+
+            def _handler(signum, frame):
+                del signum, frame
+                if drain_requested.is_set():
+                    raise KeyboardInterrupt   # second-signal escape
+                drain_requested.set()
+                state.flag = True
+
+            signal.signal(signal.SIGTERM, _handler)
+            signal.signal(signal.SIGINT, _handler)
+            ''') == []
+
+    def test_quiet_on_event_set_lambda(self):
+        assert lint('''
+            import signal
+            signal.signal(signal.SIGTERM,
+                          lambda s, f: stop_event.set())
+            ''') == []
+
+    def test_one_report_per_call_across_registrations(self):
+        vs = lint('''
+            import signal
+            import time
+
+            def _handler(signum, frame):
+                time.sleep(1)
+
+            signal.signal(signal.SIGTERM, _handler)
+            signal.signal(signal.SIGINT, _handler)
+            ''')
+        assert rules_of(vs) == ['STL009']
+
+    def test_fires_on_bound_method_handler(self):
+        vs = lint('''
+            import signal
+
+            class Server:
+                def _on_term(self, signum, frame):
+                    self._thread.join()
+
+                def install(self):
+                    signal.signal(signal.SIGTERM, self._on_term)
+            ''')
+        assert rules_of(vs) == ['STL009']
+
+    def test_fires_on_keyword_handler_and_from_import(self):
+        vs = lint('''
+            from signal import SIGTERM, signal
+            import time
+
+            def _h(signum, frame):
+                time.sleep(1)
+
+            signal(SIGTERM, handler=_h)
+            ''')
+        assert rules_of(vs) == ['STL009']
+
+    def test_quiet_on_unresolvable_handler(self):
+        # Imported handlers can't be checked statically; no false
+        # positive, and SIG_IGN-style constants are ignored too.
+        assert lint('''
+            import signal
+            from somewhere import handler
+            signal.signal(signal.SIGTERM, handler)
+            signal.signal(signal.SIGINT, signal.SIG_IGN)
+            ''') == []
+
+    def test_serving_http_handlers_are_flag_only(self):
+        """The repo's own SIGTERM/SIGINT drain handlers must satisfy
+        the rule they motivated (the repo-wide gate enforces this;
+        this is the targeted canary)."""
+        path = os.path.join(_REPO_ROOT, 'skypilot_tpu', 'models',
+                            'serving_http.py')
+        with open(path, encoding='utf-8') as f:
+            vs = analyze_source(f.read(), path='skypilot_tpu/models/'
+                                'serving_http.py', project=Project())
+        assert [v for v in vs if v.rule == 'STL009'] == []
+
+
 # ----------------------------------------------------------- suppression
 class TestSuppression:
 

@@ -1,0 +1,1048 @@
+"""Request-lifecycle robustness (docs/request_lifecycle.md):
+end-to-end deadlines, cancellation, graceful drain, deadline-aware
+shedding.
+
+The chaos-backed guarantees proven here, tier-1:
+
+- cancelling (or deadline-expiring) a mid-decode request frees its
+  slot for a subsequently admitted request in the SAME engine
+  instance (capacity reuse), with ``skytpu_engine_cancels_total``
+  and an ``engine.cancel`` span carrying the request's trace id;
+- ``drain_results()`` vs concurrent ``submit()``/``step()`` loses
+  nothing and double-drains nothing; a cancel racing natural
+  completion yields exactly one terminal Result;
+- deadline-aware shedding rejects a request whose estimated wait
+  exceeds its deadline while admitting a no-deadline request at the
+  same queue depth;
+- SIGTERM with in-flight requests exits within
+  ``SKYTPU_DRAIN_TIMEOUT_SECONDS``, every in-flight request ends in
+  exactly one terminal state, and /health reported 'draining' first
+  (real subprocess + real signal);
+- the LB forwards a replica's Retry-After/shed reason, retries sheds
+  on other replicas, never retries a past-deadline request, and the
+  ``lb.client_disconnect`` chaos site cancels the replica-side
+  request end to end.
+"""
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import models
+from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.models.serving_engine import Request, ServingEngine
+from skypilot_tpu.models.serving_http import EngineServer
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.trace import export as trace_export
+from skypilot_tpu.utils import fault_injection as fi
+from skypilot_tpu.utils import lifecycle
+
+pytestmark = pytest.mark.lifecycle
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _setup(seed=0):
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(seed))
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_prompt', 16)
+    kw.setdefault('max_seq', 64)
+    kw.setdefault('decode_chunk', 4)
+    kw.setdefault('prefill_chunk', 8)
+    kw.setdefault('prefill_budget', 16)
+    return ServingEngine(params, cfg, **kw)
+
+
+@pytest.fixture
+def trace_dir(tmp_path, monkeypatch):
+    spool = tmp_path / 'spool'
+    monkeypatch.setenv(trace_lib.TRACE_DIR_ENV, str(spool))
+    monkeypatch.delenv(trace_lib.TRACE_CONTEXT_ENV, raising=False)
+    yield str(spool)
+
+
+def _counter(name, **labels):
+    from skypilot_tpu import metrics
+    metric = metrics.REGISTRY.get(name)
+    return 0.0 if metric is None else metric.value(**labels)
+
+
+# =================================================== engine lifecycle
+def test_cancel_mid_decode_frees_slot_for_next_request(trace_dir):
+    """Acceptance (b): cancel a mid-decode request -> partial Result,
+    slot recycled for a subsequently admitted request in the SAME
+    engine (batch_size=1 makes reuse unambiguous), cancel counter
+    bumped, engine.cancel span carrying the request's trace id."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+    engine.submit(Request('victim', [1, 2, 3], max_new=40))
+    for _ in range(4):
+        engine.step()
+    assert engine.num_active() == 1
+    assert engine.cancel('victim', reason='api')
+    engine.step()          # cancel applies at the tick boundary
+    res = engine.drain_results()
+    assert set(res) == {'victim'}
+    assert res['victim'].status == 'cancelled'
+    assert res['victim'].reason == 'api'
+    assert 0 < len(res['victim'].tokens) < 40  # tokens-so-far
+    assert engine.num_active() == 0
+
+    # Capacity reuse: the freed slot serves the next request fully.
+    res2 = engine.run([Request('next', [4, 5], max_new=6)])
+    assert res2['next'].status == 'finished'
+    assert len(res2['next'].tokens) == 6
+
+    assert _counter('skytpu_engine_cancels_total', reason='api') == 1
+
+    spans = trace_export.read_spans(trace_dir)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s['name'], []).append(s)
+    victim_req = next(s for s in by_name['engine.request']
+                      if s['attrs'].get('request_id') == 'victim')
+    cancels = by_name['engine.cancel']
+    assert len(cancels) == 1
+    assert cancels[0]['trace_id'] == victim_req['trace_id']
+    assert cancels[0]['attrs']['reason'] == 'api'
+    assert victim_req['attrs']['status'] == 'cancelled'
+
+
+def test_cancel_queued_and_unknown():
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+    # Occupy the only slot so 'queued' stays queued.
+    engine.submit(Request('running', [1, 2], max_new=30))
+    for _ in range(2):
+        engine.step()
+    engine.submit(Request('queued', [3] * 12, max_new=20))
+    assert engine.cancel('queued', reason='api')
+    assert not engine.cancel('never-submitted')
+    engine.step()
+    res = engine.drain_results()
+    assert res['queued'].status == 'cancelled'
+    assert res['queued'].tokens == []        # never reached a slot
+    assert res['queued'].prompt_len == 12
+    # The running request is untouched and finishes normally.
+    engine.cancel('running', reason='shutdown')
+    while 'running' not in res:
+        engine.step()
+        res.update(engine.drain_results())
+    assert res['running'].status == 'cancelled'
+
+
+def test_deadline_expiry_mid_decode_and_queued():
+    """The tick loop expires past-deadline slots AND queued requests:
+    status='expired', reason='deadline', partial tokens kept."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+    engine.submit(Request('slow', [1, 2], max_new=40,
+                          deadline=time.time() + 0.15))
+    results = {}
+    t0 = time.time()
+    while 'slow' not in results:
+        engine.step()
+        results.update(engine.drain_results())
+        assert time.time() - t0 < 60
+    assert results['slow'].status == 'expired'
+    assert results['slow'].reason == 'deadline'
+    assert len(results['slow'].tokens) < 40
+
+    # Queued expiry: a request whose deadline passed before it ever
+    # reached a slot.
+    engine.submit(Request('hold', [1], max_new=30))
+    engine.step()
+    engine.submit(Request('late', [2], max_new=4,
+                          deadline=time.time() - 1.0))
+    engine.step()
+    results.update(engine.drain_results())
+    assert results['late'].status == 'expired'
+    assert results['late'].tokens == []
+    assert _counter('skytpu_engine_cancels_total',
+                    reason='deadline') == 2
+    # Slot freed by expiry admits follow-up work (finish the engine).
+    engine.cancel('hold')
+    while engine.queue or engine.num_active() or engine.has_pending:
+        engine.step()
+        engine.drain_results()
+
+
+def test_cancel_racing_natural_completion_single_terminal():
+    """Satellite: a cancel landing in the same tick as natural
+    completion yields exactly ONE terminal Result (whichever wins),
+    never two and never zero."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1, decode_chunk=2)
+    engine.submit(Request('r', [1, 2], max_new=2))
+    # Drive until the FINAL chunk is in flight: the request's natural
+    # completion sits in the pending tick.
+    for _ in range(2):
+        engine.step()
+    assert engine.has_pending
+    engine.cancel('r', reason='api')
+    # One more tick applies the cancel BEFORE processing the pending
+    # completion; then drain everything.
+    terminals = []
+    for _ in range(4):
+        engine.step()
+        terminals += list(engine.drain_results().values())
+    terminals += list(engine.drain_results().values())
+    mine = [t for t in terminals if t.request_id == 'r']
+    assert len(mine) == 1
+    assert mine[0].status in ('finished', 'cancelled')
+
+    # And the reverse order: completion strictly first, cancel after.
+    res = engine.run([Request('r2', [3], max_new=2)])
+    assert res['r2'].status == 'finished'
+    assert not engine.cancel('r2')      # already terminal: no-op
+    engine.step()
+    assert engine.drain_results() == {}  # no second terminal result
+
+
+def test_drain_results_vs_concurrent_submit_step_races():
+    """Satellite: threaded regression — a driver thread stepping and
+    draining while another thread submits (and cancels some): no
+    result lost, none double-drained, every request exactly one
+    terminal state."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=2, max_seq=128)
+    n_requests = 14
+    collected = []
+    stop = threading.Event()
+    errors = []
+
+    def drive():
+        try:
+            while not stop.is_set() or engine.queue or \
+                    engine.num_active() or engine.has_pending:
+                engine.step()
+                collected.extend(engine.drain_results().values())
+        except Exception as e:  # pylint: disable=broad-except
+            errors.append(e)
+
+    driver = threading.Thread(target=drive, daemon=True)
+    driver.start()
+    for i in range(n_requests):
+        engine.submit(Request(('r', i), [1 + i % 5, 2], max_new=6))
+        if i % 3 == 0:
+            engine.cancel(('r', i), reason='api')
+        time.sleep(0.003)
+    stop.set()
+    driver.join(timeout=120)
+    assert not driver.is_alive() and not errors
+    collected.extend(engine.drain_results().values())
+    ids = [r.request_id for r in collected]
+    assert sorted(ids) == sorted(('r', i) for i in range(n_requests))
+    assert len(set(ids)) == n_requests          # no double-drain
+    for r in collected:
+        assert r.status in ('finished', 'cancelled')
+
+
+def test_estimate_wait_monotone_in_load():
+    cfg, params = _setup()
+    engine = _engine(cfg, params)
+    assert engine.estimate_wait_s(8, 8) == 0.0   # no tick signal yet
+    engine._tick_ewma = 0.1
+    idle = engine.estimate_wait_s(8, 8)
+    assert idle > 0
+    for i in range(10):
+        engine.submit(Request(('q', i), [1] * 8, max_new=8))
+    deep = engine.estimate_wait_s(8, 8)
+    assert deep > idle * 2
+
+
+def test_warmup_ticks_never_seed_wait_estimate():
+    """Regression: warmup's compile-laden ticks must not seed the
+    admission EWMA — an idle just-warmed engine would otherwise shed
+    deadline'd requests on pure XLA compile time."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+    engine._warming = True
+    try:
+        engine.run([Request(('warmup', 0), [1, 2], max_new=2)])
+    finally:
+        engine._warming = False
+    assert engine._tick_ewma is None
+    assert engine.estimate_wait_s(8, 8) == 0.0   # idle engine admits
+
+
+def test_tick_watchdog_fires_on_injected_hang(monkeypatch):
+    """Chaos: an injected engine.tick.hang stall trips the watchdog
+    (counter + trace-tagged warning) without harming the request."""
+    import logging
+
+    from skypilot_tpu.models import serving_engine as se
+    monkeypatch.setenv('SKYTPU_TICK_HANG_SECONDS', '0.01')
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    se.logger.addHandler(handler)
+    try:
+        with fi.fault_plan(faults=[{'site': 'engine.tick.hang',
+                                    'kind': 'hang', 'times': 1,
+                                    'params': {'seconds': 0.05}}]):
+            res = engine.run([Request('ok', [1, 2], max_new=4)])
+    finally:
+        se.logger.removeHandler(handler)
+    assert res['ok'].status == 'finished'
+    assert _counter('skytpu_engine_tick_hangs_total') >= 1
+    assert _counter('skytpu_faults_injected_total',
+                    site='engine.tick.hang', kind='hang') == 1
+    assert any('Engine tick took' in r.getMessage() for r in records)
+
+
+# ================================================= http shed + cancel
+def test_http_deadline_shed_vs_no_deadline_same_depth():
+    """Acceptance (c): at the SAME queue depth, a request whose
+    estimated wait exceeds its deadline is shed (429,
+    reason='wont_make_deadline', Retry-After set) while a no-deadline
+    request is still admitted past the shed gate."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params)
+    server = EngineServer(engine, max_pending=64, warmup=False)
+    engine._tick_ewma = 0.5           # deterministic time base
+    for i in range(10):
+        engine.submit(Request(('q', i), [1] * 8, max_new=8))
+
+    async def scenario():
+        async with TestClient(TestServer(server.make_app())) as client:
+            shed = await client.post(
+                '/generate', json={'tokens': [1, 2, 3], 'max_new': 8,
+                                   'timeout_s': 0.5})
+            shed_body = await shed.json()
+            hdr = await client.post(
+                '/generate', json={'tokens': [1, 2, 3], 'max_new': 8},
+                headers={lifecycle.DEADLINE_HEADER: '0.25'})
+            # No deadline, same depth: passes the shed gate and only
+            # stops at the readiness gate (driver never started).
+            admitted = await client.post(
+                '/generate', json={'tokens': [1, 2, 3], 'max_new': 8})
+            admitted_body = await admitted.json()
+            return (shed.status, shed_body,
+                    shed.headers.get('Retry-After'), hdr.status,
+                    admitted.status, admitted_body)
+
+    (shed_status, shed_body, retry_after, hdr_status, admitted_status,
+     admitted_body) = asyncio.run(scenario())
+    server.stop()
+    assert shed_status == 429
+    assert shed_body['reason'] == 'wont_make_deadline'
+    assert shed_body['estimated_wait_s'] > 0.5
+    assert retry_after is not None and int(retry_after) >= 1
+    assert hdr_status == 429          # LB-stamped header is honored
+    assert admitted_status == 503 and admitted_body['status'] == 'warming'
+    assert _counter('skytpu_http_sheds_total',
+                    reason='wont_make_deadline') == 2
+
+
+def test_http_cancel_endpoint_mid_stream():
+    """POST /cancel/<X-Request-ID> cuts a mid-decode streaming
+    request: the SSE ends with done + status='cancelled' and partial
+    tokens. An injected per-tick hang keeps the request in flight
+    long enough to cancel deterministically."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1, max_seq=128,
+                     decode_chunk=2)
+    server = EngineServer(engine, warmup=False)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        base = f'http://127.0.0.1:{port}'
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                async with session.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(0.05)
+            events = []
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': [1, 2, 3], 'max_new': 100,
+                          'stream': True}) as r:
+                assert r.status == 200
+                req_id = r.headers[trace_lib.REQUEST_ID_HEADER]
+                cancelled = False
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith('data: '):
+                        continue
+                    events.append(json.loads(line[6:]))
+                    if events[-1].get('done'):
+                        break
+                    if not cancelled:
+                        cancelled = True
+                        async with session.post(
+                                base + f'/cancel/{req_id}') as c:
+                            assert c.status == 202
+            # Cancelling a finished request 404s.
+            async with session.post(base + f'/cancel/{req_id}') as c:
+                second = c.status
+        await runner.cleanup()
+        return events, second
+
+    with fi.fault_plan(faults=[{'site': 'engine.tick.hang',
+                                'kind': 'hang', 'times': None,
+                                'params': {'seconds': 0.02}}]):
+        events, second_cancel = asyncio.run(scenario())
+    server.stop()
+    done = events[-1]
+    assert done['done'] and done['status'] == 'cancelled'
+    assert done['reason'] == 'api'
+    assert 0 < len(done['tokens']) < 100
+    assert second_cancel == 404
+    assert _counter('skytpu_engine_cancels_total', reason='api') == 1
+
+
+# ======================================================== http drain
+def test_http_drain_graceful_completion(trace_dir):
+    """Acceptance (a), in-process: drain lets an in-flight request
+    FINISH inside the budget, /health reports 'draining' the moment
+    drain is requested, new /generate is shed 503 + Retry-After, the
+    drain histogram observes once and shutdown is clean."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+    server = EngineServer(engine, warmup=False)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        base = f'http://127.0.0.1:{port}'
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                async with session.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(0.05)
+            inflight = asyncio.create_task(session.post(
+                base + '/generate',
+                json={'tokens': [1, 2, 3], 'max_new': 20}))
+            await asyncio.sleep(0.05)
+            server.request_drain()
+            async with session.get(base + '/health') as r:
+                health = (r.status, await r.json())
+            async with session.post(
+                    base + '/generate',
+                    json={'tokens': [4], 'max_new': 2}) as r:
+                shed = (r.status, r.headers.get('Retry-After'),
+                        await r.json())
+            clean = await server.drain()
+            resp = await inflight
+            body = await resp.json()
+        await runner.cleanup()
+        return health, shed, clean, resp.status, body
+
+    health, shed, clean, status, body = asyncio.run(scenario())
+    assert health == (503, {'status': 'draining'})
+    assert shed[0] == 503 and shed[1] is not None
+    assert shed[2]['reason'] == 'draining'
+    assert clean is True and server.clean_shutdown is True
+    assert status == 200 and body['status'] == 'finished'
+    assert len(body['tokens']) == 20      # ran to completion
+    from skypilot_tpu import metrics
+    fam = metrics.REGISTRY.families()['skytpu_http_drain_seconds']
+    assert fam['series'] and fam['series'][0]['count'] == 1
+    assert any(s['name'] == 'http.drain'
+               for s in trace_export.read_spans(trace_dir))
+
+
+def test_http_drain_force_cancels_past_budget(monkeypatch):
+    """Acceptance (a): an in-flight request that outlives the drain
+    budget is force-cancelled — it still ends in exactly one terminal
+    state (cancelled, partial tokens) and the process state is clean.
+    The injected serve.replica.drain stall plus a per-tick hang act
+    out work that will not finish in time."""
+    monkeypatch.setenv('SKYTPU_DRAIN_TIMEOUT_SECONDS', '0.3')
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1, max_seq=256,
+                     decode_chunk=2)
+    server = EngineServer(engine, warmup=False)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        base = f'http://127.0.0.1:{port}'
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                async with session.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(0.05)
+            events = []
+
+            async def stream():
+                async with session.post(
+                        base + '/generate',
+                        json={'tokens': [1, 2], 'max_new': 200,
+                              'stream': True}) as r:
+                    async for raw in r.content:
+                        line = raw.decode().strip()
+                        if line.startswith('data: '):
+                            events.append(json.loads(line[6:]))
+                            if events[-1].get('done'):
+                                return
+
+            task = asyncio.create_task(stream())
+            while not events:          # request is visibly decoding
+                await asyncio.sleep(0.01)
+            t0 = time.perf_counter()
+            clean = await server.drain()
+            drain_s = time.perf_counter() - t0
+            await asyncio.wait_for(task, timeout=10)
+        await runner.cleanup()
+        return events, clean, drain_s
+
+    with fi.fault_plan(faults=[
+            {'site': 'engine.tick.hang', 'kind': 'hang',
+             'times': None, 'params': {'seconds': 0.02}},
+            {'site': 'serve.replica.drain', 'kind': 'hang',
+             'times': 1, 'params': {'seconds': 10.0}}]):
+        events, clean, drain_s = asyncio.run(scenario())
+    done = events[-1]
+    assert done['done'] and done['status'] == 'cancelled'
+    assert done['reason'] == 'shutdown'
+    assert 0 < len(done['tokens']) < 200
+    assert clean is True
+    # Budget (0.3s) + bounded force-cancel sweep, NOT the injected
+    # 10s stall: the drain is bounded by the budget, not the work.
+    assert drain_s < 8.0
+    assert _counter('skytpu_faults_injected_total',
+                    site='serve.replica.drain', kind='hang') == 1
+
+
+def test_drain_during_warmup_skips_budget(monkeypatch):
+    """Regression: a drain landing DURING warmup has no client work
+    — it must not wait out SKYTPU_DRAIN_TIMEOUT_SECONDS on warmup's
+    synthetic requests, and a startup-time SIGTERM is not an unclean
+    shutdown."""
+    monkeypatch.setenv('SKYTPU_DRAIN_TIMEOUT_SECONDS', '30')
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1)
+
+    def slow_warmup():
+        engine._warming = True
+        try:
+            engine.submit(Request(('warmup', 0), [1, 2], max_new=2))
+            time.sleep(1.2)           # a long compile
+            while engine.queue or engine.num_active() or \
+                    engine.has_pending:
+                engine.step()
+            engine.drain_results()
+        finally:
+            engine._warming = False
+
+    monkeypatch.setattr(engine, 'warmup', slow_warmup)
+    server = EngineServer(engine)     # warmup enabled
+
+    async def scenario():
+        runner = await server.start(0)
+        await asyncio.sleep(0.1)      # drain lands mid-warmup
+        assert not server._ready.is_set()
+        t0 = time.perf_counter()
+        clean = await server.drain()
+        dur = time.perf_counter() - t0
+        await runner.cleanup()
+        return clean, dur
+
+    clean, dur = asyncio.run(scenario())
+    assert clean is True
+    assert dur < 15                   # nowhere near the 30s budget
+    # Warmup's synthetic requests were NOT force-cancelled.
+    assert _counter('skytpu_engine_cancels_total',
+                    reason='shutdown') == 0
+
+
+def test_sigterm_subprocess_drains_and_exits(tmp_path):
+    """Acceptance (a), the real thing: a SIGTERM'd replica process
+    with an in-flight streaming request reports 'draining' on
+    /health, lets the request reach a terminal state, and exits 0
+    within the drain budget."""
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['SKYTPU_DRAIN_TIMEOUT_SECONDS'] = '5'
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    port = 18972
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.models.serving_http',
+         '--port', str(port), '--model', 'tiny', '--batch', '2',
+         '--max-prompt', '16', '--max-seq', '64',
+         '--decode-chunk', '4', '--prefill-chunk', '8',
+         '--prefill-budget', '16'],
+        env=env, cwd=_REPO_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    base = f'http://127.0.0.1:{port}'
+    try:
+        t0 = time.time()
+        while True:
+            assert time.time() - t0 < 180, 'replica never became ready'
+            try:
+                with urllib.request.urlopen(base + '/health',
+                                            timeout=1) as r:
+                    if r.status == 200:
+                        break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.2)
+
+        events = []
+
+        def stream():
+            req = urllib.request.Request(
+                base + '/generate',
+                data=json.dumps({'tokens': [1, 2, 3], 'max_new': 40,
+                                 'stream': True}).encode(),
+                headers={'Content-Type': 'application/json'})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    for raw in r:
+                        line = raw.decode().strip()
+                        if line.startswith('data: '):
+                            events.append(json.loads(line[6:]))
+            except (urllib.error.URLError, OSError):
+                pass
+
+        th = threading.Thread(target=stream, daemon=True)
+        th.start()
+        time.sleep(0.4)                # request is in flight
+        sent_at = time.time()
+        proc.send_signal(signal.SIGTERM)
+        # /health flips to draining before the process exits.
+        draining_seen = False
+        try:
+            urllib.request.urlopen(base + '/health', timeout=2)
+        except urllib.error.HTTPError as e:
+            draining_seen = (json.loads(e.read()).get('status') ==
+                             'draining')
+        except (urllib.error.URLError, OSError):
+            pass                       # already gone: checked below
+        rc = proc.wait(timeout=30)
+        elapsed = time.time() - sent_at
+        th.join(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    assert rc == 0, proc.stdout.read().decode()[-2000:]
+    # Exit within the drain budget (+ startup/teardown slack).
+    assert elapsed < 5 + 10
+    assert draining_seen
+    # The in-flight request ended in exactly one terminal state.
+    done = [e for e in events if e.get('done')]
+    assert len(done) == 1
+    assert done[0]['status'] in ('finished', 'cancelled')
+
+
+# ========================================================== lb layer
+def _shed_app(status, retry_after, reason, calls):
+    async def generate(request):
+        calls.append(dict(request.headers))
+        return web.json_response(
+            {'error': 'shed', 'reason': reason},
+            status=status, headers={'Retry-After': retry_after})
+
+    app = web.Application()
+    app.router.add_post('/generate', generate)
+    return app
+
+
+def _ok_app(calls):
+    async def generate(request):
+        calls.append(dict(request.headers))
+        return web.json_response({'ok': True})
+
+    app = web.Application()
+    app.router.add_post('/generate', generate)
+    return app
+
+
+def test_lb_retries_sheds_and_forwards_retry_after():
+    """Satellite: a replica's 429/503 shed is retried on another
+    replica; when EVERY candidate sheds, the last replica's
+    Retry-After and reason reach the client instead of being
+    swallowed."""
+    shed_calls, ok_calls = [], []
+
+    async def scenario():
+        shed_server = TestServer(
+            _shed_app(429, '7', 'queue_full', shed_calls))
+        ok_server = TestServer(_ok_app(ok_calls))
+        await shed_server.start_server()
+        await ok_server.start_server()
+        lb = LoadBalancer(port=0, policy='round_robin')
+        await lb.start()
+        shed_url = f'http://127.0.0.1:{shed_server.port}'
+        ok_url = f'http://127.0.0.1:{ok_server.port}'
+        lb.set_replica_urls([shed_url, ok_url])
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            outcomes = []
+            for _ in range(2):      # round robin: both lead replicas
+                async with session.post(
+                        f'http://127.0.0.1:{lb.bound_port}/generate',
+                        json={'tokens': [1]}) as r:
+                    outcomes.append((r.status, await r.json()))
+            # Only the shedding replica left: the shed is forwarded.
+            lb.set_replica_urls([shed_url])
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}/generate',
+                    json={'tokens': [1]}) as r:
+                forwarded = (r.status, r.headers.get('Retry-After'),
+                             await r.json())
+        await lb.stop()
+        await shed_server.close()
+        await ok_server.close()
+        return outcomes, forwarded
+
+    outcomes, forwarded = asyncio.run(scenario())
+    # Every attempt ended 200 at the healthy replica, wherever the
+    # round robin started.
+    assert [s for s, _ in outcomes] == [200, 200]
+    assert forwarded[0] == 429
+    assert forwarded[1] == '7'                    # Retry-After kept
+    assert forwarded[2]['reason'] == 'queue_full'  # reason kept
+    # The shedding replica was really attempted (and counted).
+    assert shed_calls
+    from skypilot_tpu import metrics
+    fams = metrics.REGISTRY.families()
+    shed_count = sum(
+        s['value']
+        for s in fams['skytpu_lb_replica_errors_total']['series']
+        if s['labels'].get('kind') == 'shed')
+    assert shed_count >= 2    # one per visit to the shedding replica
+
+
+def test_lb_shed_never_masks_may_have_executed_failure():
+    """A shed means 'refused WITHOUT executing, safe to resubmit'.
+    When a later attempt reaches a replica that may have executed the
+    request and then died mid-request, the ambiguous 502 must reach
+    the client — not the earlier replica's retryable 429."""
+    shed_calls = []
+
+    def drop_app():
+        async def generate(request):
+            await request.read()
+            request.transport.close()   # dies mid-request
+            return web.Response()
+
+        app = web.Application()
+        app.router.add_post('/generate', generate)
+        return app
+
+    async def scenario():
+        shed_server = TestServer(
+            _shed_app(429, '3', 'queue_full', shed_calls))
+        drop_server = TestServer(drop_app())
+        await shed_server.start_server()
+        await drop_server.start_server()
+        lb = LoadBalancer(port=0, policy='round_robin')
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{shed_server.port}',
+                             f'http://127.0.0.1:{drop_server.port}'])
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}/generate',
+                    json={'tokens': [1]}) as r:
+                status = r.status
+        await lb.stop()
+        await shed_server.close()
+        await drop_server.close()
+        return status
+
+    status = asyncio.run(scenario())
+    assert shed_calls                 # the shed really happened first
+    assert status == 502              # ambiguity surfaced, not 429
+
+
+def test_lb_cancel_broadcasts_to_all_replicas():
+    """POST /cancel/<id> through the LB must reach the replica that
+    actually holds the request: it fans out to every candidate, and
+    one replica's 202 wins over another's 404."""
+
+    def cancel_app(status, log):
+        async def cancel(request):
+            log.append(request.match_info['request_id'])
+            return web.json_response({}, status=status)
+
+        app = web.Application()
+        app.router.add_post('/cancel/{request_id}', cancel)
+        return app
+
+    a_log, b_log = [], []
+
+    async def scenario():
+        a = TestServer(cancel_app(404, a_log))     # wrong replica
+        b = TestServer(cancel_app(202, b_log))     # holds the request
+        await a.start_server()
+        await b.start_server()
+        lb = LoadBalancer(port=0, policy='round_robin')
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{a.port}',
+                             f'http://127.0.0.1:{b.port}'])
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}'
+                    '/cancel/some-id') as r:
+                accepted = r.status
+            # All replicas 404 -> 404 surfaces (not 502/503).
+            lb.set_replica_urls([f'http://127.0.0.1:{a.port}'])
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}'
+                    '/cancel/other-id') as r:
+                missing = r.status
+        await lb.stop()
+        await a.close()
+        await b.close()
+        return accepted, missing
+
+    accepted, missing = asyncio.run(scenario())
+    assert accepted == 202
+    assert a_log.count('some-id') == 1      # both replicas were asked
+    assert b_log.count('some-id') == 1
+    assert missing == 404
+
+
+def test_lb_deadline_504_and_budget_stamping():
+    """The LB never forwards (or retries) a past-deadline request —
+    504 without any replica attempt — and stamps the remaining
+    budget on the attempts it does make."""
+    calls = []
+
+    async def scenario():
+        ok_server = TestServer(_ok_app(calls))
+        await ok_server.start_server()
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{ok_server.port}'])
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}/generate',
+                    json={'tokens': [1]},
+                    headers={lifecycle.DEADLINE_HEADER: '0'}) as r:
+                expired = (r.status, await r.json())
+            async with session.post(
+                    f'http://127.0.0.1:{lb.bound_port}/generate',
+                    json={'tokens': [1]},
+                    headers={lifecycle.DEADLINE_HEADER: '30'}) as r:
+                ok = r.status
+        await lb.stop()
+        await ok_server.close()
+        return expired, ok
+
+    expired, ok = asyncio.run(scenario())
+    assert expired[0] == 504
+    assert expired[1]['reason'] == 'deadline_exceeded'
+    assert calls and len(calls) == 1          # expired never proxied
+    assert ok == 200
+    stamped = float(calls[0][lifecycle.DEADLINE_HEADER])
+    assert 0 < stamped <= 30
+    assert _counter('skytpu_lb_deadline_rejects_total') == 1
+
+
+def test_lb_client_disconnect_fault_cancels_replica_request():
+    """Chaos: the lb.client_disconnect site aborts the upstream
+    connection mid-stream, and the replica reacts exactly as to a
+    real hangup — the engine request is cancelled
+    (reason='client_disconnect') and its slot freed."""
+    cfg, params = _setup()
+    engine = _engine(cfg, params, batch_size=1, max_seq=256,
+                     decode_chunk=2)
+    server = EngineServer(engine, warmup=False)
+
+    async def scenario():
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls([f'http://127.0.0.1:{port}'])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            for _ in range(600):
+                async with session.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(0.05)
+            try:
+                async with session.post(
+                        base + '/generate',
+                        json={'tokens': [1, 2], 'max_new': 200,
+                              'stream': True}) as r:
+                    async for _ in r.content:
+                        pass
+            except aiohttp.ClientError:
+                pass                   # the simulated hangup
+            # The replica-side cancel lands within a tick or two.
+            for _ in range(400):
+                if _counter('skytpu_engine_cancels_total',
+                            reason='client_disconnect') >= 1:
+                    break
+                await asyncio.sleep(0.05)
+        await lb.stop()
+        await runner.cleanup()
+
+    with fi.fault_plan(faults=[
+            {'site': 'engine.tick.hang', 'kind': 'hang',
+             'times': None, 'params': {'seconds': 0.02}},
+            {'site': 'lb.client_disconnect',
+             'kind': 'client_disconnect', 'times': 1,
+             'match': {'path': '/generate'}}]):
+        asyncio.run(scenario())
+    server.stop()
+    assert _counter('skytpu_engine_cancels_total',
+                    reason='client_disconnect') == 1
+    assert _counter('skytpu_faults_injected_total',
+                    site='lb.client_disconnect',
+                    kind='client_disconnect') == 1
+    assert engine.num_active() == 0    # the slot was freed
+
+
+# ================================================== replica manager
+class _FakeResp:
+    def __init__(self, status, body):
+        self.status_code = status
+        self._body = body
+
+    def json(self):
+        return self._body
+
+
+def test_probe_ready_detects_draining(monkeypatch):
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    spec = ServiceSpec.from_yaml_config({
+        'replica_port': 9000,
+        'readiness_probe': {'path': '/health'}})
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+
+    answers = {}
+    monkeypatch.setattr(
+        replica_managers.requests, 'get',
+        lambda url, timeout: answers[url])
+    url = 'http://r1:9000'
+    answers[url + '/health'] = _FakeResp(503, {'status': 'draining'})
+    assert replica_managers.ReplicaManager._probe_ready(
+        mgr, url, spec) == 'draining'
+    answers[url + '/health'] = _FakeResp(503, {'status': 'dead'})
+    assert replica_managers.ReplicaManager._probe_ready(
+        mgr, url, spec) == 'down'
+    answers[url + '/health'] = _FakeResp(200, {'status': 'ok'})
+    assert replica_managers.ReplicaManager._probe_ready(
+        mgr, url, spec) == 'ready'
+
+
+def test_probe_all_draining_demotes_without_terminate_streak(
+        monkeypatch):
+    """Satellite: a draining replica leaves the routable set like a
+    failed-probe replica (NOT_READY -> out of ready_urls) but never
+    feeds the terminate streak — repeated draining probes must not
+    escalate to FAILED_PROBING."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    from skypilot_tpu.utils import status_lib
+
+    spec = ServiceSpec.from_yaml_config({
+        'replica_port': 9000,
+        'readiness_probe': {'path': '/health'}})
+    mgr = replica_managers.ReplicaManager(
+        'svc', spec, {}, probe_failure_terminate_threshold=2)
+
+    rows = [{'replica_id': 1, 'status': ReplicaStatus.READY,
+             'cluster_name': 'svc-replica-1', 'version': 1,
+             'url': 'http://r1:9001'}]
+    statuses = []
+    monkeypatch.setattr(serve_state, 'get_replicas',
+                        lambda name: [dict(r) for r in rows])
+    monkeypatch.setattr(
+        serve_state, 'set_replica_status',
+        lambda name, rid, st, url=None: statuses.append(st) or
+        rows[0].__setitem__('status', st))
+    monkeypatch.setattr(serve_state, 'get_version_spec',
+                        lambda name, version: None)
+    monkeypatch.setattr(
+        replica_managers.backend_utils, 'refresh_cluster_record',
+        lambda cluster, force_refresh=False: {
+            'status': status_lib.ClusterStatus.UP, 'handle': object()})
+    monkeypatch.setattr(replica_managers.ReplicaManager,
+                        '_replica_url',
+                        lambda self, rid, cluster, spec=None:
+                        'http://r1:9001')
+    monkeypatch.setattr(replica_managers.ReplicaManager,
+                        '_probe_ready',
+                        lambda self, url, spec, replica_id=None:
+                        'draining')
+    for _ in range(5):               # well past the streak threshold
+        mgr.probe_all()
+    assert statuses and set(statuses) == {ReplicaStatus.NOT_READY}
+    assert mgr._failed_probes.get(1, 0) == 0
+
+
+def test_drain_replica_posts_then_waits(monkeypatch):
+    """Drain-then-kill: teardown first POSTs /drain, then waits —
+    bounded — for the replica's own drain to finish (the health
+    endpoint stops answering 'draining')."""
+    from skypilot_tpu.serve import replica_managers
+
+    posts, gets = [], []
+    health = [_FakeResp(503, {'status': 'draining'}),
+              _FakeResp(503, {'status': 'draining'})]
+
+    def fake_post(url, timeout):
+        posts.append(url)
+        return _FakeResp(202, {'status': 'draining'})
+
+    def fake_get(url, timeout):
+        gets.append(url)
+        if health:
+            return health.pop(0)
+        import requests as req_lib
+        raise req_lib.ConnectionError('gone')    # process exited
+
+    monkeypatch.setattr(replica_managers.requests, 'post', fake_post)
+    monkeypatch.setattr(replica_managers.requests, 'get', fake_get)
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    t0 = time.time()
+    replica_managers.ReplicaManager._drain_replica(
+        mgr, 'http://r1:9001')
+    assert posts == ['http://r1:9001/drain']
+    assert len(gets) == 3            # draining, draining, gone
+    assert time.time() - t0 < 10
+
+    # A replica without the endpoint (404) falls straight through.
+    posts.clear()
+    gets.clear()
+    monkeypatch.setattr(
+        replica_managers.requests, 'post',
+        lambda url, timeout: _FakeResp(404, {}))
+    replica_managers.ReplicaManager._drain_replica(
+        mgr, 'http://r2:9001')
+    assert gets == []                # no wait when drain was refused
