@@ -28,6 +28,7 @@ import filelock
 
 from skypilot_tpu.agent import constants
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import status_lib
 from skypilot_tpu.utils import subprocess_utils
 
@@ -55,10 +56,10 @@ def _lock(state_dir: str) -> filelock.FileLock:
 
 
 def _connect(state_dir: str) -> sqlite3.Connection:
-    path = _db_path(state_dir)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10.0)
-    conn.execute('PRAGMA journal_mode=WAL')
+    # statedb.connect: shared WAL/busy_timeout/autocommit recipe
+    # (docs/crash_recovery.md); cross-process write ordering here is
+    # already serialized by the agent's file lock.
+    conn = statedb.connect(_db_path(state_dir), row_factory=False)
     conn.execute("""\
         CREATE TABLE IF NOT EXISTS jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,

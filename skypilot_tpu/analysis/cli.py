@@ -164,7 +164,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog='python -m skypilot_tpu.analysis',
         description='skytpu-lint: repo-native AST analysis '
-                    '(STL001-STL009), baseline-gated.')
+                    '(STL001-STL010), baseline-gated.')
     parser.add_argument('paths', nargs='*',
                         help='files/dirs to lint (default: the '
                              'skypilot_tpu package + bench.py)')

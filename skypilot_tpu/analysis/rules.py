@@ -1,4 +1,4 @@
-"""skytpu-lint rule catalog (STL001–STL009).
+"""skytpu-lint rule catalog (STL001–STL010).
 
 Each rule encodes one repo invariant that used to be enforced only at
 runtime or by convention; docs/static_analysis.md carries the full
@@ -369,7 +369,7 @@ class UnknownFaultSite(Rule):
             'there). A typo\'d site makes chaos plans silently inert.')
     node_types = (ast.Call,)
 
-    _METHODS = ('poll', 'inject', 'pending')
+    _METHODS = ('poll', 'inject', 'pending', 'crashpoint')
 
     def __init__(self) -> None:
         self._uses: List[Tuple[str, str, int, str]] = []
@@ -683,6 +683,125 @@ class BlockingSignalHandler(Rule):
         return None
 
 
+class RawSqliteOutsideStateDB(Rule):
+    """STL010: raw sqlite use outside ``utils/statedb``.
+
+    ``utils/statedb.connect`` is the ONE way control-plane code opens
+    sqlite (WAL journal mode, busy_timeout, synchronous=NORMAL,
+    explicit-transaction autocommit — docs/crash_recovery.md); a bare
+    ``sqlite3.connect`` silently loses all of that, and with it the
+    crash-safety story. Likewise, a function issuing two or more
+    write statements (INSERT/UPDATE/DELETE/REPLACE) outside a
+    ``transaction()`` block is a torn-write hazard: a crash between
+    the statements leaves the database half-mutated, which is exactly
+    what the intent journal exists to make impossible.
+    """
+
+    id = 'STL010'
+    name = 'raw-sqlite'
+    severity = 'error'
+    help = ('sqlite3.connect / executescript, or a function with 2+ '
+            'write statements not under a statedb transaction() '
+            'block, outside utils/statedb.py. Open connections with '
+            'statedb.connect and wrap multi-statement writes in '
+            'statedb.transaction() (or StateDB.transaction()) so '
+            'they commit atomically.')
+    node_types = (ast.Call, ast.FunctionDef)
+
+    _ALLOWED_FILES = ('utils/statedb.py',)
+    _WRITE_PREFIXES = ('insert', 'update', 'delete', 'replace')
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace('\\', '/')
+        return not any(norm.endswith(allowed)
+                       for allowed in self._ALLOWED_FILES)
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            self._check_call(ctx, node)
+        else:
+            assert isinstance(node, ast.FunctionDef)
+            self._check_multi_write(ctx, node)
+
+    def _check_call(self, ctx: FileContext, node: ast.Call) -> None:
+        dotted = core.call_name(node)
+        if dotted == 'sqlite3.connect':
+            ctx.report(self, node,
+                       'raw sqlite3.connect bypasses the statedb '
+                       'recipe (WAL, busy_timeout, synchronous='
+                       'NORMAL); use utils/statedb.connect',
+                       span=(node.lineno, node.lineno))
+        elif dotted.endswith('.executescript'):
+            ctx.report(self, node,
+                       'executescript runs multiple statements with '
+                       'implicit commits; use explicit statements '
+                       'under statedb.transaction()',
+                       span=(node.lineno, node.lineno))
+
+    def _check_multi_write(self, ctx: FileContext,
+                           fn: ast.FunctionDef) -> None:
+        writes: List[ast.Call] = []
+        unguarded: List[ast.Call] = []
+        for sub, guarded in self._walk_with_guard(fn):
+            if not (isinstance(sub, ast.Call) and
+                    isinstance(sub.func, ast.Attribute) and
+                    sub.func.attr == 'execute' and sub.args):
+                continue
+            sql = self._sql_head(sub.args[0])
+            if sql is None or not sql.lstrip().lower().startswith(
+                    self._WRITE_PREFIXES):
+                continue
+            writes.append(sub)
+            if not guarded:
+                unguarded.append(sub)
+        if len(writes) >= 2 and unguarded:
+            first = unguarded[0]
+            ctx.report(self, first,
+                       f'{len(writes)} write statements in '
+                       f'{fn.name}() with at least one outside a '
+                       'statedb transaction() block; a crash between '
+                       'them tears the state — wrap them in '
+                       'statedb.transaction()',
+                       span=(first.lineno, first.lineno))
+
+    @classmethod
+    def _walk_with_guard(cls, fn: ast.FunctionDef):
+        """Yield (node, under_transaction_with) for fn's own body,
+        without descending into nested defs."""
+        stack = [(n, False) for n in fn.body]
+        while stack:
+            node, guarded = stack.pop()
+            yield node, guarded
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            inner = guarded or (isinstance(node, (ast.With, ast.AsyncWith))
+                                and cls._is_transaction_with(node))
+            stack.extend((child, inner)
+                         for child in ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _is_transaction_with(node: ast.AST) -> bool:
+        for item in getattr(node, 'items', ()):
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                dotted = core.call_name(expr)
+                if dotted and 'transaction' in dotted.split('.')[-1]:
+                    return True
+        return False
+
+    @staticmethod
+    def _sql_head(arg: ast.AST) -> Optional[str]:
+        lit = core.literal_str(arg)
+        if lit is not None:
+            return lit
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            first = arg.values[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                return first.value
+        return None
+
+
 def default_rules() -> List[Rule]:
     """Fresh rule instances (STL007/STL009 keep per-run state)."""
     return [
@@ -695,6 +814,7 @@ def default_rules() -> List[Rule]:
         UnknownFaultSite(),
         JaxRecompileHazard(),
         BlockingSignalHandler(),
+        RawSqliteOutsideStateDB(),
     ]
 
 

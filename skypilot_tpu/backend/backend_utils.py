@@ -145,6 +145,14 @@ def refresh_cluster_record(
         if rec is None:
             return None
         handle = rec['handle']
+        if handle is None:
+            # Corrupt/truncated handle blob (global_user_state degraded
+            # the row rather than crashing the read): without a handle
+            # there is no cloud to ask — report the record as-is.
+            logger.warning(
+                'Cluster %s has no usable handle (corrupt record); '
+                'skipping cloud refresh.', cluster_name)
+            return rec
         _check_owner_identity(rec, handle)
         try:
             cloud_status = _query_cloud_status(handle)

@@ -4,21 +4,22 @@ from __future__ import annotations
 
 import json
 import os
-import pathlib
 import sqlite3
 import time
 from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import statedb
 
 _DB_PATH_ENV = 'SKYTPU_BENCHMARK_DB'
 _DEFAULT_DB = '~/.skytpu/benchmark.db'
 
 
 def _conn() -> sqlite3.Connection:
+    # statedb.connect: shared WAL/busy_timeout/autocommit recipe
+    # (docs/crash_recovery.md).
     path = os.path.expanduser(
         os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
-    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
+    conn = statedb.connect(path)
     conn.execute("""
         CREATE TABLE IF NOT EXISTS benchmarks (
             name TEXT PRIMARY KEY,
@@ -95,7 +96,7 @@ def get_benchmarks() -> List[Dict[str, Any]]:
 
 
 def remove_benchmark(name: str) -> None:
-    with _conn() as conn:
+    with statedb.transaction(_conn(), site='benchmark.state.write') as conn:
         conn.execute('DELETE FROM benchmarks WHERE name = ?', (name,))
         conn.execute('DELETE FROM candidates WHERE benchmark = ?',
                      (name,))

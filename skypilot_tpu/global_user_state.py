@@ -2,8 +2,11 @@
 
 Re-design of reference ``sky/global_user_state.py``: the ``clusters``
 table holds the pickled ResourceHandle, status, autostop settings; plus
-``cluster_history`` and a ``config`` kv table. WAL mode + a module lock
-make it safe for the multi-process executor (reference :40-52).
+``cluster_history`` and a ``config`` kv table. Connections and write
+transactions go through :mod:`skypilot_tpu.utils.statedb` (WAL,
+busy_timeout, synchronous=NORMAL, explicit transactions); a module
+lock keeps the multi-process executor's threads serialized (reference
+:40-52).
 
 DB path: ``~/.skytpu/state.db`` (override: SKYTPU_STATE_DB for tests).
 """
@@ -18,7 +21,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import status_lib
+
+logger = sky_logging.init_logger(__name__)
 
 _lock = threading.Lock()
 _conn_local = threading.local()
@@ -34,13 +41,17 @@ def _conn() -> sqlite3.Connection:
     cached = getattr(_conn_local, 'conn', None)
     if cached is not None and getattr(_conn_local, 'path', None) == path:
         return cached
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10.0)
-    conn.execute('PRAGMA journal_mode=WAL')
+    conn = statedb.connect(path, row_factory=False)
     _create_tables(conn)
     _conn_local.conn = conn
     _conn_local.path = path
     return conn
+
+
+def _transaction():
+    """One explicit write transaction on this thread's connection
+    (statedb crashpoints + retry; see docs/crash_recovery.md)."""
+    return statedb.transaction(_conn(), site='user.state.write')
 
 
 def _create_tables(conn: sqlite3.Connection) -> None:
@@ -54,8 +65,7 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             autostop INTEGER DEFAULT -1,
             to_down INTEGER DEFAULT 0,
             owner TEXT DEFAULT NULL,
-            cluster_hash TEXT DEFAULT NULL,
-            config_hash TEXT DEFAULT NULL)""")
+            cluster_hash TEXT DEFAULT NULL)""")
     conn.execute("""\
         CREATE TABLE IF NOT EXISTS cluster_history (
             cluster_hash TEXT PRIMARY KEY,
@@ -74,7 +84,25 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             handle BLOB,
             last_use TEXT,
             status TEXT)""")
-    conn.commit()
+
+
+def _safe_unpickle(blob: Optional[bytes], what: str,
+                   default: Any = None) -> Any:
+    """Tolerate corrupt/truncated pickle blobs (a torn write from a
+    crashed process, or a pre-WAL partial page): one bad row degrades
+    to a warning + ``default`` instead of taking every ``list()`` /
+    status call down with it."""
+    if blob is None:
+        return default
+    try:
+        return pickle.loads(blob)
+    except Exception as e:  # pylint: disable=broad-except
+        # Unpickling raises anything from UnpicklingError/EOFError to
+        # AttributeError/ImportError depending on where the blob tore.
+        logger.warning(
+            '%s is corrupt or truncated (%s: %s); treating as missing.',
+            what, type(e).__name__, e)
+        return default
 
 
 # ----------------------------------------------------------------------
@@ -94,8 +122,7 @@ def add_or_update_cluster(cluster_name: str,
     if is_launch and (not usage_intervals or
                       usage_intervals[-1][1] is not None):
         usage_intervals.append((now, None))
-    with _lock:
-        conn = _conn()
+    with _lock, _transaction() as conn:
         conn.execute(
             """INSERT INTO clusters
                (name, launched_at, handle, last_use, status, autostop,
@@ -135,7 +162,6 @@ def add_or_update_cluster(cluster_name: str,
                 'UPDATE cluster_history SET usage_intervals=? '
                 'WHERE cluster_hash=?',
                 (pickle.dumps(usage_intervals), cluster_hash))
-        conn.commit()
 
 
 def _command_for_last_use() -> str:
@@ -146,63 +172,58 @@ def _command_for_last_use() -> str:
 def update_cluster_status(cluster_name: str,
                           status: status_lib.ClusterStatus) -> None:
     with _lock:
-        conn = _conn()
-        conn.execute('UPDATE clusters SET status=? WHERE name=?',
-                     (status.value, cluster_name))
-        conn.commit()
+        _conn().execute('UPDATE clusters SET status=? WHERE name=?',
+                        (status.value, cluster_name))
 
 
 def set_cluster_owner(cluster_name: str, owner: str) -> None:
     """Record the cloud identity that launched the cluster (comma-
     joined; compared on every refresh for multi-identity safety)."""
     with _lock:
-        conn = _conn()
-        conn.execute('UPDATE clusters SET owner=? WHERE name=?',
-                     (owner, cluster_name))
-        conn.commit()
+        _conn().execute('UPDATE clusters SET owner=? WHERE name=?',
+                        (owner, cluster_name))
 
 
 def update_cluster_handle(cluster_name: str, cluster_handle: Any) -> None:
     with _lock:
-        conn = _conn()
-        conn.execute('UPDATE clusters SET handle=? WHERE name=?',
-                     (pickle.dumps(cluster_handle), cluster_name))
-        conn.commit()
+        _conn().execute('UPDATE clusters SET handle=? WHERE name=?',
+                        (pickle.dumps(cluster_handle), cluster_name))
 
 
 def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
                                to_down: bool) -> None:
     with _lock:
-        conn = _conn()
-        conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
-                     (idle_minutes, int(to_down), cluster_name))
-        conn.commit()
+        _conn().execute(
+            'UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+            (idle_minutes, int(to_down), cluster_name))
 
 
 def remove_cluster(cluster_name: str, terminate: bool) -> None:
     cluster_hash = _get_hash_for_existing_cluster(cluster_name)
     now = int(time.time())
-    with _lock:
-        conn = _conn()
-        if terminate:
-            conn.execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
-        else:
-            conn.execute(
-                'UPDATE clusters SET status=? WHERE name=?',
-                (status_lib.ClusterStatus.STOPPED.value, cluster_name))
-        conn.commit()
+    # Close out the open usage interval (billing truth) in the SAME
+    # transaction as the row removal: a crash between the two used to
+    # leave a terminated cluster accruing usage forever.
+    closed_intervals = None
     if cluster_hash is not None:
         usage_intervals = _get_usage_intervals(cluster_hash)
         if usage_intervals and usage_intervals[-1][1] is None:
             start, _ = usage_intervals.pop()
             usage_intervals.append((start, now))
-            with _lock:
-                conn = _conn()
-                conn.execute(
-                    'UPDATE cluster_history SET usage_intervals=? '
-                    'WHERE cluster_hash=?',
-                    (pickle.dumps(usage_intervals), cluster_hash))
-                conn.commit()
+            closed_intervals = usage_intervals
+    with _lock, _transaction() as conn:
+        if terminate:
+            conn.execute('DELETE FROM clusters WHERE name=?',
+                         (cluster_name,))
+        else:
+            conn.execute(
+                'UPDATE clusters SET status=? WHERE name=?',
+                (status_lib.ClusterStatus.STOPPED.value, cluster_name))
+        if closed_intervals is not None:
+            conn.execute(
+                'UPDATE cluster_history SET usage_intervals=? '
+                'WHERE cluster_hash=?',
+                (pickle.dumps(closed_intervals), cluster_hash))
 
 
 def get_cluster_from_name(
@@ -227,7 +248,8 @@ def _query_clusters(where: str, params: tuple) -> List[Dict[str, Any]]:
         rows.append({
             'name': name,
             'launched_at': launched_at,
-            'handle': pickle.loads(handle),
+            'handle': _safe_unpickle(handle,
+                                     f'Handle of cluster {name!r}'),
             'last_use': last_use,
             'status': status_lib.ClusterStatus(status),
             'autostop': autostop,
@@ -256,7 +278,9 @@ def _get_usage_intervals(cluster_hash: Optional[str]) -> list:
     row = cursor.fetchone()
     if row is None or row[0] is None:
         return []
-    return pickle.loads(row[0])
+    return _safe_unpickle(row[0],
+                          f'Usage intervals of cluster {cluster_hash!r}',
+                          default=[])
 
 
 def get_cluster_history() -> List[Dict[str, Any]]:
@@ -267,17 +291,18 @@ def get_cluster_history() -> List[Dict[str, Any]]:
     rows = []
     for (cluster_hash, name, num_nodes, requested, launched,
          usage_intervals) in cursor.fetchall():
-        intervals = pickle.loads(usage_intervals) if usage_intervals else []
+        intervals = _safe_unpickle(
+            usage_intervals, f'Usage intervals of {name!r}', default=[])
         duration = sum((end or int(time.time())) - start
                        for start, end in intervals)
         rows.append({
             'cluster_hash': cluster_hash,
             'name': name,
             'num_nodes': num_nodes,
-            'requested_resources':
-                pickle.loads(requested) if requested else None,
-            'launched_resources':
-                pickle.loads(launched) if launched else None,
+            'requested_resources': _safe_unpickle(
+                requested, f'Requested resources of {name!r}'),
+            'launched_resources': _safe_unpickle(
+                launched, f'Launched resources of {name!r}'),
             'usage_intervals': intervals,
             'duration': duration,
         })
@@ -289,8 +314,7 @@ def get_cluster_history() -> List[Dict[str, Any]]:
 def add_or_update_storage(storage_name: str, storage_handle: Any,
                           storage_status: str) -> None:
     with _lock:
-        conn = _conn()
-        conn.execute(
+        _conn().execute(
             """INSERT INTO storage (name, launched_at, handle, last_use,
                                     status)
                VALUES (?,?,?,?,?)
@@ -298,14 +322,12 @@ def add_or_update_storage(storage_name: str, storage_handle: Any,
                  status=excluded.status, last_use=excluded.last_use""",
             (storage_name, int(time.time()), pickle.dumps(storage_handle),
              _command_for_last_use(), storage_status))
-        conn.commit()
 
 
 def remove_storage(storage_name: str) -> None:
     with _lock:
-        conn = _conn()
-        conn.execute('DELETE FROM storage WHERE name=?', (storage_name,))
-        conn.commit()
+        _conn().execute('DELETE FROM storage WHERE name=?',
+                        (storage_name,))
 
 
 def get_storage() -> List[Dict[str, Any]]:
@@ -315,7 +337,7 @@ def get_storage() -> List[Dict[str, Any]]:
     return [{
         'name': name,
         'launched_at': launched_at,
-        'handle': pickle.loads(handle),
+        'handle': _safe_unpickle(handle, f'Handle of storage {name!r}'),
         'last_use': last_use,
         'status': status,
     } for name, launched_at, handle, last_use, status in cursor.fetchall()]
@@ -339,9 +361,7 @@ def get_config_value(key: str) -> Optional[Any]:
 
 def set_config_value(key: str, value: Any) -> None:
     with _lock:
-        conn = _conn()
-        conn.execute(
+        _conn().execute(
             """INSERT INTO config (key, value) VALUES (?,?)
                ON CONFLICT(key) DO UPDATE SET value=excluded.value""",
             (key, json.dumps(value)))
-        conn.commit()

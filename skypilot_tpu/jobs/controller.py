@@ -8,6 +8,14 @@ terminal failed state while the cluster is healthy) from PREEMPTION
 recovery strategy. On a TPU pod slice, losing any host kills the whole
 job, so recovery is always a full slice relaunch.
 
+Crash-only (docs/crash_recovery.md): every multi-step operation
+(launch, recover, terminate) journals a write-ahead intent record in
+the jobs DB, and every start begins with :meth:`reconcile_on_start`,
+which replays open intents against cloud truth — adopt a cluster+job
+the dead process already launched, roll a terminate forward, or roll a
+half-done launch back. ``kill -9`` at any instruction (exercised by
+the registered ``crash`` fault sites) leaves the job recoverable.
+
 Run: ``python -m skypilot_tpu.jobs.controller <managed_job_id>``.
 """
 from __future__ import annotations
@@ -29,6 +37,7 @@ from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import status_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -47,6 +56,11 @@ _M_RESTARTS = metrics_lib.counter(
     'skytpu_jobs_restarts_total',
     'Restarts after user failure on healthy infra per managed job.',
     labels=('job',))
+_M_RECONCILED = metrics_lib.counter(
+    'skytpu_jobs_reconciled_intents_total',
+    'Open intent records replayed at controller startup, by outcome '
+    '(adopt / roll_forward / roll_back / orphan).',
+    labels=('action',))
 
 
 class JobsController:
@@ -63,6 +77,7 @@ class JobsController:
         configs = dag if isinstance(dag, list) else [dag]
         self.tasks = [task_lib.Task.from_yaml_config(c) for c in configs]
         self.task = self.tasks[0]
+        self.task_index = 0
         self.strategy = recovery_strategy.StrategyExecutor.make(
             self.cluster_name, self.task)
         self.check_gap = check_gap
@@ -91,6 +106,185 @@ class JobsController:
             return statuses.get(cluster_job_id)
         except Exception:  # pylint: disable=broad-except
             return None
+
+    # ------------------------------------------------------------------
+    # Crash-only startup: intent replay (docs/crash_recovery.md).
+
+    def reconcile_on_start(self) -> Optional[int]:
+        """Replay this job's open intents against cloud truth before
+        doing ANYTHING else — recovery is the only startup path.
+
+        Returns the on-cluster (agent) job id to adopt when the dead
+        process's launch already succeeded (the monitor loop resumes
+        against it; no double-launch), else None (a fresh launch — or
+        nothing — is needed; the journal has been settled either way).
+        """
+        if not statedb.reconcile_enabled():
+            return None
+        record = state.get_job(self.job_id)
+        intents = state.open_intents(self.job_id)
+        resumable = (record is not None and
+                     not record['status'].is_terminal() and
+                     record.get('cluster_job_id') is not None and
+                     record['status'] in (state.ManagedJobStatus.STARTING,
+                                          state.ManagedJobStatus.RUNNING))
+        if not intents and not resumable:
+            return None
+        with trace_lib.span('jobs.reconcile', slow_ok=True,
+                            job=str(self.job_id),
+                            open_intents=len(intents)):
+            return self._reconcile(record, intents)
+
+    def _reconcile(self, record, intents) -> Optional[int]:
+        adopted: Optional[int] = None
+        if record['status'].is_terminal():
+            # The job already concluded; any open intent is leftover
+            # journal noise from the dying process.
+            for intent in intents:
+                state.complete_intent(intent['intent_id'])
+                _M_RECONCILED.inc(1, action='orphan')
+            return None
+        for intent in intents:
+            kind = intent['kind']
+            payload = intent['payload']
+            cluster = payload.get('cluster_name') or self.cluster_name
+            if kind == 'jobs.terminate':
+                # Past the point of no return: roll FORWARD. The
+                # teardown is idempotent, the final status comes from
+                # the journal, and both settle atomically.
+                logger.info('Reconcile: rolling forward terminate of '
+                            '%s.', cluster)
+                self._down_quiet(cluster)
+                final = payload.get('final_status')
+                if final is not None:
+                    state.set_status(
+                        self.job_id, state.ManagedJobStatus(final),
+                        failure_reason=payload.get('failure_reason'),
+                        complete_intent=intent['intent_id'])
+                elif payload.get('next_task_index') is not None:
+                    # Mid-pipeline success whose cursor write was lost
+                    # to the crash: advance it with the journal so the
+                    # finished task is not re-run.
+                    state.set_task_index(
+                        self.job_id, int(payload['next_task_index']),
+                        complete_intent=intent['intent_id'])
+                else:
+                    state.complete_intent(intent['intent_id'])
+                _M_RECONCILED.inc(1, action='roll_forward')
+            elif kind in ('jobs.launch', 'jobs.recover'):
+                found = self._find_cluster_job(cluster)
+                if found is not None:
+                    # The dead process finished provisioning and the
+                    # job runs: adopt it instead of double-launching.
+                    logger.info(
+                        'Reconcile: adopting live cluster %s '
+                        '(on-cluster job %d).', cluster, found)
+                    state.finish_launch_intent(intent['intent_id'],
+                                               self.job_id, found)
+                    adopted = found
+                    _M_RECONCILED.inc(1, action='adopt')
+                else:
+                    # Launch never reached its commit point and the
+                    # cluster is gone/half-provisioned: roll back
+                    # (terminate leftovers, clear the journal); the
+                    # normal run path relaunches.
+                    logger.info(
+                        'Reconcile: rolling back half-done launch of '
+                        '%s.', cluster)
+                    self._down_quiet(cluster)
+                    state.complete_intent(intent['intent_id'])
+                    _M_RECONCILED.inc(1, action='roll_back')
+            else:
+                logger.warning('Reconcile: unknown intent kind %r; '
+                               'dropping.', kind)
+                state.complete_intent(intent['intent_id'])
+                _M_RECONCILED.inc(1, action='orphan')
+        if adopted is None and record.get('cluster_job_id') is not None \
+                and record['status'] in (state.ManagedJobStatus.STARTING,
+                                         state.ManagedJobStatus.RUNNING):
+            # No journal entry (the crash hit the monitor phase, after
+            # the launch committed): the row itself is the recovery
+            # record.
+            cluster = self._task_cluster(
+                int(record.get('task_index') or 0))
+            found = self._find_cluster_job(
+                cluster, expect=record['cluster_job_id'])
+            if found is not None:
+                logger.info(
+                    'Reconcile: resuming monitor of cluster %s '
+                    '(on-cluster job %d).', cluster, found)
+                adopted = found
+                _M_RECONCILED.inc(1, action='adopt')
+        return adopted
+
+    def _find_cluster_job(self, cluster_name: str,
+                          expect: Optional[int] = None) -> Optional[int]:
+        """Cloud truth for adoption: is the cluster UP, and which
+        on-cluster job did the dead process submit? ``expect`` pins a
+        known job id; otherwise the newest job on the cluster is the
+        one (the controller is the only submitter)."""
+        try:
+            record = backend_utils.refresh_cluster_record(
+                cluster_name, force_refresh=True)
+        except Exception:  # pylint: disable=broad-except
+            record = None
+        if record is None or record['status'] != \
+                status_lib.ClusterStatus.UP:
+            return None
+        try:
+            rows = core.queue(cluster_name)
+        except Exception:  # pylint: disable=broad-except
+            return None
+        job_ids = [int(r['job_id']) for r in rows
+                   if r.get('job_id') is not None]
+        if expect is not None:
+            return expect if expect in job_ids else None
+        return max(job_ids) if job_ids else None
+
+    def _down_quiet(self, cluster_name: str) -> None:
+        try:
+            core.down(cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('Reconcile teardown of %s failed:\n%s',
+                           cluster_name, traceback.format_exc())
+
+    def _terminate_task_cluster(
+            self,
+            final_status: Optional[state.ManagedJobStatus] = None,
+            failure_reason: Optional[str] = None,
+            next_task_index: Optional[int] = None) -> None:
+        """Teardown bracketed by a ``jobs.terminate`` intent: once the
+        journal row exists the operation only rolls FORWARD — a crash
+        mid-teardown terminates again on restart and then applies the
+        journaled OUTCOME (final status, or the pipeline advance to
+        ``next_task_index`` for a mid-pipeline success), atomically
+        with the intent's completion. Journaling the outcome is what
+        keeps a finished task from re-running when the crash lands
+        between the teardown and the status/cursor write."""
+        payload = {
+            'job_id': self.job_id,
+            'cluster_name': self.cluster_name,
+            'task_index': self.task_index,
+        }
+        if final_status is not None:
+            payload['final_status'] = final_status.value
+            if failure_reason is not None:
+                payload['failure_reason'] = failure_reason
+        elif next_task_index is not None:
+            payload['next_task_index'] = next_task_index
+        intent_id = state.begin_intent('jobs.terminate', payload)
+        self.strategy.terminate_cluster()
+        if final_status is not None:
+            state.set_status(self.job_id, final_status,
+                             failure_reason=failure_reason,
+                             complete_intent=intent_id)
+        elif next_task_index is not None:
+            state.set_task_index(self.job_id, next_task_index,
+                                 complete_intent=intent_id)
+        else:
+            state.complete_intent(intent_id)
 
     # ------------------------------------------------------------------
     def _maybe_inject_chaos(self) -> None:
@@ -232,52 +426,93 @@ class JobsController:
     def run(self) -> state.ManagedJobStatus:
         """Run every task of the (chain) dag in order; the managed job
         succeeds only if all tasks do."""
+        adopt_job_id = self.reconcile_on_start()
+        record = state.get_job(self.job_id)
+        if record['status'].is_terminal():
+            # Reconcile rolled a terminate forward (or a previous run
+            # concluded): nothing left to execute.
+            return record['status']
+        start_index = int(record.get('task_index') or 0)
         result = state.ManagedJobStatus.SUCCEEDED
         for index, task in enumerate(self.tasks):
+            if index < start_index:
+                continue
             self.task = task
+            self.task_index = index
             self.strategy = recovery_strategy.StrategyExecutor.make(
                 self._task_cluster(index), task)
             self.cluster_name = self.strategy.cluster_name
+            state.set_task_index(self.job_id, index)
             if index > 0:
                 logger.info('Pipeline task %d/%d: %s.', index + 1,
                             len(self.tasks), task.name)
-            result = self._run_task()
+            result = self._run_task(
+                adopt_job_id if index == start_index else None)
             if result != state.ManagedJobStatus.SUCCEEDED:
                 return result
         state.set_status(self.job_id, state.ManagedJobStatus.SUCCEEDED)
         return result
 
-    def _run_task(self) -> state.ManagedJobStatus:
-        state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
-        # Launches are slot-limited (jobs/scheduler.py): a burst of
-        # submissions provisions at most launch_parallelism() clusters
-        # at once; the rest queue in WAITING. A cancel raised while
-        # queued aborts before any cluster exists.
-        if not scheduler.wait_for_launch_slot(self.job_id):
-            state.set_status(self.job_id,
-                             state.ManagedJobStatus.CANCELLED)
-            return state.ManagedJobStatus.CANCELLED
-        try:
-            with trace_lib.span('jobs.controller.launch',
-                                slow_ok=True, job=str(self.job_id),
-                                cluster=self.cluster_name):
-                cluster_job_id = self.strategy.launch()
-        except exceptions.ResourcesUnavailableError as e:
-            state.set_status(self.job_id,
-                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
-                             failure_reason=str(e))
-            return state.ManagedJobStatus.FAILED_NO_RESOURCE
-        finally:
-            scheduler.finish_launch(self.job_id)
-        assert cluster_job_id is not None
+    def _run_task(self,
+                  adopt_job_id: Optional[int] = None
+                  ) -> state.ManagedJobStatus:
+        if adopt_job_id is not None:
+            # reconcile_on_start adopted a cluster the dead controller
+            # already launched: resume monitoring, do NOT relaunch.
+            cluster_job_id: Optional[int] = adopt_job_id
+        else:
+            state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+            # Launches are slot-limited (jobs/scheduler.py): a burst of
+            # submissions provisions at most launch_parallelism()
+            # clusters at once; the rest queue in WAITING. A cancel
+            # raised while queued aborts before any cluster exists.
+            if not scheduler.wait_for_launch_slot(self.job_id):
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+                return state.ManagedJobStatus.CANCELLED
+            # Journal the launch BEFORE any cloud mutation: from here
+            # until finish_launch_intent, a crash leaves an open intent
+            # that reconcile resolves against cluster truth.
+            intent_id = state.begin_intent(
+                'jobs.launch', {
+                    'job_id': self.job_id,
+                    'cluster_name': self.cluster_name,
+                    'task_index': self.task_index,
+                })
+            fault_injection.crashpoint(
+                'jobs.controller.launch.pre_provision',
+                job_id=self.job_id)
+            try:
+                with trace_lib.span('jobs.controller.launch',
+                                    slow_ok=True, job=str(self.job_id),
+                                    cluster=self.cluster_name):
+                    cluster_job_id = self.strategy.launch()
+            except exceptions.ResourcesUnavailableError as e:
+                # Controlled failure in THIS process: the operation is
+                # over — settle status and journal atomically.
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                                 failure_reason=str(e),
+                                 complete_intent=intent_id)
+                return state.ManagedJobStatus.FAILED_NO_RESOURCE
+            finally:
+                scheduler.finish_launch(self.job_id)
+            assert cluster_job_id is not None
+            fault_injection.crashpoint(
+                'jobs.controller.launch.post_provision',
+                job_id=self.job_id)
+            # Commit point: on-cluster job id recorded + intent retired
+            # in one transaction — after this, restarts adopt via the
+            # row instead of the journal.
+            state.finish_launch_intent(intent_id, self.job_id,
+                                       cluster_job_id)
 
         while True:
             result = self._monitor_until_done(cluster_job_id)
             if result == state.ManagedJobStatus.CANCELLING:
                 logger.info('Cancel requested; terminating cluster.')
-                self.strategy.terminate_cluster()
-                state.set_status(self.job_id,
-                                 state.ManagedJobStatus.CANCELLED)
+                self._terminate_task_cluster(
+                    state.ManagedJobStatus.CANCELLED)
                 return state.ManagedJobStatus.CANCELLED
             is_restart = False
             if result in (state.ManagedJobStatus.FAILED,
@@ -295,20 +530,28 @@ class JobsController:
                     is_restart = True
                     _M_RESTARTS.inc(1, job=str(self.job_id))
                 elif self.strategy.max_restarts_on_errors > 0:
-                    state.set_status(
-                        self.job_id, result,
+                    self._terminate_task_cluster(
+                        result,
                         failure_reason=(
                             'exhausted max_restarts_on_errors='
                             f'{self.strategy.max_restarts_on_errors}'))
-                    self.strategy.terminate_cluster()
                     return result
             if result != state.ManagedJobStatus.RECOVERING:
-                self.strategy.terminate_cluster()
-                if result is not state.ManagedJobStatus.SUCCEEDED:
-                    state.set_status(self.job_id, result)
-                # SUCCEEDED is recorded by run() only after the LAST
-                # task — a watcher must never observe a terminal
-                # status mid-pipeline.
+                if result is state.ManagedJobStatus.SUCCEEDED:
+                    # A watcher must never observe a terminal status
+                    # mid-pipeline, so only the LAST task journals
+                    # SUCCEEDED; earlier tasks journal the pipeline
+                    # advance instead — either way the outcome commits
+                    # atomically with the teardown intent, so a crash
+                    # here can never re-run the finished task.
+                    last = self.task_index + 1 >= len(self.tasks)
+                    self._terminate_task_cluster(
+                        state.ManagedJobStatus.SUCCEEDED if last
+                        else None,
+                        next_task_index=(None if last
+                                         else self.task_index + 1))
+                else:
+                    self._terminate_task_cluster(result)
                 return result
             # Preemption: recover.
             n = state.bump_recovery(self.job_id)
@@ -326,10 +569,18 @@ class JobsController:
                         self.job_id)
             # Recovery relaunches a cluster — same slot discipline.
             if not scheduler.wait_for_launch_slot(self.job_id):
-                self.strategy.terminate_cluster()
-                state.set_status(self.job_id,
-                                 state.ManagedJobStatus.CANCELLED)
+                self._terminate_task_cluster(
+                    state.ManagedJobStatus.CANCELLED)
                 return state.ManagedJobStatus.CANCELLED
+            intent_id = state.begin_intent(
+                'jobs.recover', {
+                    'job_id': self.job_id,
+                    'cluster_name': self.cluster_name,
+                    'task_index': self.task_index,
+                    'attempt': n,
+                })
+            fault_injection.crashpoint('jobs.controller.recover.mid',
+                                       job_id=self.job_id)
             try:
                 # A restart follows a USER failure on healthy infra:
                 # relaunch without blocking the (healthy) region.
@@ -344,11 +595,35 @@ class JobsController:
                 state.set_status(
                     self.job_id,
                     state.ManagedJobStatus.FAILED_NO_RESOURCE,
-                    failure_reason=str(e))
+                    failure_reason=str(e),
+                    complete_intent=intent_id)
                 return state.ManagedJobStatus.FAILED_NO_RESOURCE
             finally:
                 scheduler.finish_launch(self.job_id)
+            state.finish_launch_intent(intent_id, self.job_id,
+                                       cluster_job_id)
             state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+
+
+def _settle_intents_on_failure(job_id: int) -> None:
+    """Conclude a FAILED_CONTROLLER job's open intents: tear down each
+    journaled cluster (roll back / finish the teardown in-process),
+    then retire the record. An intent is kept open if its teardown
+    fails, so a manual relaunch can still reconcile it."""
+    for intent in state.open_intents(job_id):
+        cluster = intent['payload'].get('cluster_name')
+        if cluster:
+            try:
+                core.down(cluster)
+            except exceptions.ClusterDoesNotExist:
+                pass
+            except Exception:  # pylint: disable=broad-except
+                logger.warning(
+                    'Could not settle intent %s (cluster %s); leaving '
+                    'it journaled:\n%s', intent['intent_id'], cluster,
+                    traceback.format_exc())
+                continue
+        state.complete_intent(intent['intent_id'])
 
 
 def main() -> None:
@@ -372,6 +647,12 @@ def main() -> None:
         state.set_status(args.job_id,
                          state.ManagedJobStatus.FAILED_CONTROLLER,
                          failure_reason=str(e))
+        # A controlled failure (exception, not a kill): settle this
+        # job's open intents NOW — terminate whatever cluster each one
+        # journaled (a half-provisioned launch would otherwise leak
+        # forever, since a terminal job is never reconciled again) and
+        # only then retire the records.
+        _settle_intents_on_failure(args.job_id)
         raise
     finally:
         # Final spool dump: the terminal counter values survive the

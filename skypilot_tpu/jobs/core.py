@@ -194,33 +194,16 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
                 skypilot_config.get_nested(
                     ('jobs', 'controller', 'enabled'),
                     default_value=False))
+        if controller_check_gap is not None:
+            # Persisted so an automatic controller relaunch
+            # (jobs/scheduler.maybe_relaunch_controller) keeps the
+            # submitter's monitor cadence.
+            state.set_check_gap(job_id, controller_check_gap)
         if on_controller:
             _submit_to_controller_cluster(job_id, controller_check_gap)
             return job_id
 
-        cmd = [
-            sys.executable, '-u', '-m', state.CONTROLLER_MODULE,
-            str(job_id)
-        ]
-        if controller_check_gap is not None:
-            cmd += ['--check-gap', str(controller_check_gap)]
-        env = dict(os.environ)
-        # The detached controller continues this trace: its root span
-        # parents under jobs.submit via SKYTPU_TRACE_CONTEXT.
-        trace_lib.child_env(env)
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        existing = env.get('PYTHONPATH', '')
-        if repo_root not in existing.split(os.pathsep):
-            env['PYTHONPATH'] = repo_root + (os.pathsep + existing
-                                             if existing else '')
-        with open(log_path, 'ab') as log_f:
-            proc = subprocess.Popen(cmd,
-                                    stdout=log_f,
-                                    stderr=subprocess.STDOUT,
-                                    start_new_session=True,
-                                    env=env)
-        state.set_controller_pid(job_id, proc.pid)
+        proc = spawn_controller(job_id)
         logger.info(
             'Managed job %d submitted (controller pid %d); logs: %s',
             job_id, proc.pid, log_path)
@@ -229,8 +212,49 @@ def launch(entrypoint: Union[task_lib.Task, 'dag_lib.Dag'],
         return job_id
 
 
+def spawn_controller(job_id: int) -> 'subprocess.Popen':
+    """Start (or restart) the detached controller process for a job.
+
+    Used by launch() and by the scheduler's dead-controller relaunch
+    (docs/crash_recovery.md): the controller's own reconcile_on_start
+    makes a restart safe at any point of the job's lifecycle.
+    """
+    job = state.get_job(job_id)
+    assert job is not None, job_id
+    log_path = job.get('log_path') or os.path.join(
+        _log_dir(), f'{job_id}-{job["name"]}.log')
+    cmd = [
+        sys.executable, '-u', '-m', state.CONTROLLER_MODULE,
+        str(job_id)
+    ]
+    if job.get('check_gap') is not None:
+        cmd += ['--check-gap', str(job['check_gap'])]
+    env = dict(os.environ)
+    # The detached controller continues this trace: its root span
+    # parents under jobs.submit via SKYTPU_TRACE_CONTEXT.
+    trace_lib.child_env(env)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    existing = env.get('PYTHONPATH', '')
+    if repo_root not in existing.split(os.pathsep):
+        env['PYTHONPATH'] = repo_root + (os.pathsep + existing
+                                         if existing else '')
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(cmd,
+                                stdout=log_f,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True,
+                                env=env)
+    state.set_controller_pid(job_id, proc.pid)
+    return proc
+
+
 def queue(refresh: bool = True) -> List[Dict[str, Any]]:
-    """All managed jobs; dead controllers are reconciled to failed."""
+    """All managed jobs; dead controllers are relaunched (crash-only
+    recovery, docs/crash_recovery.md) or — past the restart budget /
+    with reconcile disabled — reconciled to failed."""
+    from skypilot_tpu.jobs import scheduler
     jobs = state.get_jobs()
     if refresh:
         for job in jobs:
@@ -249,7 +273,11 @@ def queue(refresh: bool = True) -> List[Dict[str, Any]]:
                 continue
             if not _controller_alive(job['controller_pid'],
                                      job['job_id']):
-                _mark_controller_dead(job)
+                # Recovery is the startup path: respawn the controller
+                # and let its reconcile_on_start adopt or roll back
+                # whatever the dead process left in flight.
+                if not scheduler.maybe_relaunch_controller(job):
+                    _mark_controller_dead(job)
     return jobs
 
 

@@ -17,16 +17,21 @@ reference gets from its file lock + state table.
 from __future__ import annotations
 
 import os
+import threading
 import time
-from typing import Optional
+import traceback
+from typing import List, Optional
 
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import subprocess_utils
 
 logger = sky_logging.init_logger(__name__)
 
 _PARALLELISM_ENV = 'SKYTPU_JOBS_LAUNCH_PARALLELISM'
+_DEFAULT_RESTART_LIMIT = 3
 
 # States: INACTIVE -> WAITING -> LAUNCHING -> ALIVE -> DONE.
 WAITING = 'WAITING'
@@ -105,3 +110,89 @@ def finish_launch(job_id: int) -> None:
 
 def job_done(job_id: int) -> None:
     state.set_schedule_state(job_id, DONE)
+
+
+# ---------------------------------------------------------------------
+# Crash-only controllers (docs/crash_recovery.md): a controller whose
+# pid died while its job is non-terminal is RELAUNCHED — recovery is
+# the startup path (reconcile_on_start adopts/rolls back whatever the
+# dead process left) — instead of the job being declared lost.
+
+
+def restart_limit() -> int:
+    override = os.environ.get(env_registry.SKYTPU_CONTROLLER_RESTART_LIMIT)
+    if override:
+        return max(0, int(override))
+    return _DEFAULT_RESTART_LIMIT
+
+
+# Serializes relaunch decisions within this process (the API server's
+# thread pool can run several queue() refreshes at once). Cross-process
+# exclusion comes from the restart-claim CAS below: the claim names the
+# dead pid it observed, and spawn_controller overwrites the pid, so a
+# racing relauncher that reads state after a spawn loses its claim. A
+# second PROCESS racing inside the claim→spawn window can still
+# double-spawn in theory; reconcile_on_start makes that converge (both
+# adopt the same cluster; intent completion is idempotent).
+_relaunch_lock = threading.Lock()
+
+
+def maybe_relaunch_controller(job: dict) -> bool:
+    """Relaunch this job's controller if its process died while the job
+    is non-terminal. Returns True when the relaunch is handled (spawned
+    here, or owned by a concurrent relauncher); False when the
+    controller is alive, the job is terminal/unstarted, the restart
+    budget is exhausted, or reconcile-on-start is disabled (the caller
+    then falls back to marking the job failed)."""
+    if not statedb.reconcile_enabled():
+        return False
+    with _relaunch_lock:
+        # Re-read under the lock: a concurrent caller may have already
+        # respawned (new pid) or concluded the job.
+        job = state.get_job(job['job_id']) or job
+        if job['status'].is_terminal() or \
+                job['status'] == state.ManagedJobStatus.PENDING:
+            return False
+        pid = job.get('controller_pid')
+        if not pid:
+            return False  # never spawned locally (controller-cluster)
+        if subprocess_utils.process_alive(
+                pid, cmdline_tokens=(state.CONTROLLER_MODULE,
+                                     str(job['job_id']))):
+            return False
+        outcome, restarts = state.try_claim_controller_restart(
+            job['job_id'], pid, restart_limit())
+        if outcome == 'lost':
+            return True  # another relauncher owns this restart
+        if outcome == 'exhausted':
+            logger.warning(
+                'Managed job %d: controller died %d times; giving up.',
+                job['job_id'], restarts)
+            return False
+        logger.warning(
+            'Managed job %d: controller %s is gone with the job %s; '
+            'relaunching (restart %d/%d).', job['job_id'], pid,
+            job['status'].value, restarts, restart_limit())
+        # Release a leaked launch slot first: the dead process cannot
+        # call finish_launch, and the relaunched controller re-acquires.
+        if job.get('schedule_state') == LAUNCHING:
+            state.set_schedule_state(job['job_id'], WAITING)
+        from skypilot_tpu.jobs import core as jobs_core
+        try:
+            jobs_core.spawn_controller(job['job_id'])
+        except Exception:  # pylint: disable=broad-except
+            logger.error(
+                'Managed job %d: controller relaunch failed:\n%s',
+                job['job_id'], traceback.format_exc())
+            return False
+    return True
+
+
+def relaunch_dead_controllers() -> List[int]:
+    """Sweep every non-terminal job for a dead controller and relaunch
+    each (bounded by the per-job restart budget)."""
+    relaunched = []
+    for job in state.get_jobs():
+        if maybe_relaunch_controller(job):
+            relaunched.append(job['job_id'])
+    return relaunched

@@ -4,16 +4,22 @@ Re-design of reference ``sky/jobs/state.py:54,114`` (`spot` +
 `job_info` tables): one row per managed job task, with the
 RECOVERING-aware status machine documented in the reference's
 ``sky/jobs/README.md:30-60``.
+
+Durability goes through :mod:`skypilot_tpu.utils.statedb` (WAL, busy
+timeout, explicit transactions, intent journal): every multi-step
+controller operation brackets its state mutations with
+``begin_intent``/``complete_intent`` so a crashed controller can be
+restarted and reconciled (docs/crash_recovery.md).
 """
 from __future__ import annotations
 
 import json
 import os
-import pathlib
 import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils.status_lib import ManagedJobStatus
 
 _DB_PATH_ENV = 'SKYTPU_JOBS_DB'
@@ -30,16 +36,7 @@ def _db_path() -> str:
     return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
 
 
-# DB paths already migrated by this process.
-_migrated_paths: set = set()
-
-
-def _conn() -> sqlite3.Connection:
-    path = _db_path()
-    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
+def _init(conn: sqlite3.Connection) -> None:
     conn.execute("""
         CREATE TABLE IF NOT EXISTS jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -57,24 +54,32 @@ def _conn() -> sqlite3.Connection:
             log_path TEXT,
             dag_json TEXT,
             schedule_state TEXT DEFAULT 'INACTIVE',
-            controller_job_id INTEGER
+            controller_job_id INTEGER,
+            cluster_job_id INTEGER,
+            task_index INTEGER DEFAULT 0,
+            controller_restarts INTEGER DEFAULT 0,
+            check_gap REAL
         )""")
-    if path not in _migrated_paths:
-        # Migrate pre-schema DBs once per process, not on every
-        # connection (the scheduler polls this DB twice a second).
-        for decl in ("schedule_state TEXT DEFAULT 'INACTIVE'",
-                     'controller_job_id INTEGER'):
-            try:
-                conn.execute(f'ALTER TABLE jobs ADD COLUMN {decl}')
-            except sqlite3.OperationalError:
-                pass  # already present
-        _migrated_paths.add(path)
-    return conn
+    # Migrate pre-schema DBs (CREATE TABLE IF NOT EXISTS is a no-op on
+    # an old schema); statedb runs this once per process+path.
+    for decl in ("schedule_state TEXT DEFAULT 'INACTIVE'",
+                 'controller_job_id INTEGER',
+                 'cluster_job_id INTEGER',
+                 'task_index INTEGER DEFAULT 0',
+                 'controller_restarts INTEGER DEFAULT 0',
+                 'check_gap REAL'):
+        try:
+            conn.execute(f'ALTER TABLE jobs ADD COLUMN {decl}')
+        except sqlite3.OperationalError:
+            pass  # already present
+
+
+_DB = statedb.StateDB(_db_path, init_fn=_init, site='jobs.state.write')
 
 
 def add_job(name: Optional[str], task_yaml: str, cluster_name: str,
             log_path: str, dag_json: str) -> int:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (name, task_yaml, cluster_name, status, '
             'submitted_at, log_path, dag_json) VALUES (?,?,?,?,?,?,?)',
@@ -85,7 +90,11 @@ def add_job(name: Optional[str], task_yaml: str, cluster_name: str,
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
-               failure_reason: Optional[str] = None) -> None:
+               failure_reason: Optional[str] = None,
+               complete_intent: Optional[int] = None) -> None:
+    """Status write; when ``complete_intent`` is given the bracketing
+    intent record is completed in the SAME transaction — the
+    crash-atomicity contract of docs/crash_recovery.md."""
     sets = ['status = ?']
     args: List[Any] = [status.value]
     if status == ManagedJobStatus.RUNNING:
@@ -98,13 +107,15 @@ def set_status(job_id: int, status: ManagedJobStatus,
         sets.append('failure_reason = ?')
         args.append(failure_reason)
     args.append(job_id)
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
                      args)
+        if complete_intent is not None:
+            statedb.complete_intent(conn, complete_intent)
 
 
 def set_schedule_state(job_id: int, schedule_state: str) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'UPDATE jobs SET schedule_state = ? WHERE job_id = ?',
             (schedule_state, job_id))
@@ -115,26 +126,20 @@ def try_acquire_launch_slot(job_id: int, limit: int) -> bool:
     jobs are launching (the scheduler's one transactional primitive —
     reference sky/jobs/scheduler.py:80 does the equivalent count under
     a file lock)."""
-    conn = _conn()
-    try:
-        conn.execute('BEGIN IMMEDIATE')
+    with _DB.transaction() as conn:
         row = conn.execute(
             "SELECT COUNT(*) AS n FROM jobs "
             "WHERE schedule_state = 'LAUNCHING'").fetchone()
         if row['n'] >= limit:
-            conn.rollback()
             return False
         conn.execute(
             "UPDATE jobs SET schedule_state = 'LAUNCHING' "
             'WHERE job_id = ?', (job_id,))
-        conn.commit()
         return True
-    finally:
-        conn.close()
 
 
 def count_schedule_state(schedule_state: str) -> int:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute(
             'SELECT COUNT(*) AS n FROM jobs WHERE schedule_state = ?',
             (schedule_state,)).fetchone()
@@ -142,7 +147,7 @@ def count_schedule_state(schedule_state: str) -> int:
 
 
 def set_log_path(job_id: int, log_path: str) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute('UPDATE jobs SET log_path = ? WHERE job_id = ?',
                      (log_path, job_id))
 
@@ -151,20 +156,80 @@ def set_controller_job(job_id: int,
                        cluster_job_id: Optional[int]) -> None:
     """Agent-job id of the controller on the controller cluster
     (controller-cluster placement only)."""
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'UPDATE jobs SET controller_job_id = ? WHERE job_id = ?',
             (cluster_job_id, job_id))
 
 
 def set_controller_pid(job_id: int, pid: int) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute('UPDATE jobs SET controller_pid = ? WHERE job_id = ?',
                      (pid, job_id))
 
 
+def set_cluster_job_id(job_id: int,
+                       cluster_job_id: Optional[int]) -> None:
+    """On-cluster (agent) job id of the CURRENT attempt: the handle a
+    restarted controller needs to adopt a still-running launch instead
+    of double-launching."""
+    with _DB.transaction() as conn:
+        conn.execute(
+            'UPDATE jobs SET cluster_job_id = ? WHERE job_id = ?',
+            (cluster_job_id, job_id))
+
+
+def set_check_gap(job_id: int, check_gap: Optional[float]) -> None:
+    """Monitor-tick gap the controller was asked to run with, kept in
+    the row so an automatic controller RELAUNCH (jobs/scheduler.py)
+    preserves the submitter's cadence."""
+    with _DB.transaction() as conn:
+        conn.execute('UPDATE jobs SET check_gap = ? WHERE job_id = ?',
+                     (check_gap, job_id))
+
+
+def set_task_index(job_id: int, task_index: int,
+                   complete_intent: Optional[int] = None) -> None:
+    """Pipeline cursor: which task of the chain dag is in flight, so a
+    restarted controller resumes at the right stage. With
+    ``complete_intent``, the advance and the intent's retirement are
+    one transaction — a mid-pipeline task can never be re-run after a
+    crash that already retired its terminate intent."""
+    with _DB.transaction() as conn:
+        conn.execute('UPDATE jobs SET task_index = ? WHERE job_id = ?',
+                     (task_index, job_id))
+        if complete_intent is not None:
+            statedb.complete_intent(conn, complete_intent)
+
+
+def try_claim_controller_restart(job_id: int, dead_pid: Optional[int],
+                                 limit: int):
+    """Compare-and-swap claim of one controller relaunch.
+
+    One transaction: the claim succeeds only while the row still names
+    the dead pid the caller observed (a changed pid means another
+    relauncher already respawned) and the restart budget has room.
+    Returns ``('claimed', n)``, ``('lost', n)`` (someone else owns the
+    relaunch) or ``('exhausted', n)``.
+    """
+    with _DB.transaction() as conn:
+        row = conn.execute(
+            'SELECT controller_pid, controller_restarts FROM jobs '
+            'WHERE job_id = ?', (job_id,)).fetchone()
+        if row is None or row['controller_pid'] != dead_pid:
+            return ('lost', int((row or {'controller_restarts': 0})
+                                ['controller_restarts'] or 0))
+        restarts = int(row['controller_restarts'] or 0)
+        if restarts >= limit:
+            return ('exhausted', restarts)
+        conn.execute(
+            'UPDATE jobs SET controller_restarts = ? WHERE job_id = ?',
+            (restarts + 1, job_id))
+        return ('claimed', restarts + 1)
+
+
 def bump_recovery(job_id: int) -> int:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'UPDATE jobs SET recovery_count = recovery_count + 1 '
             'WHERE job_id = ?', (job_id,))
@@ -175,14 +240,14 @@ def bump_recovery(job_id: int) -> int:
 
 
 def request_cancel(job_id: int) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'UPDATE jobs SET cancel_requested = 1 WHERE job_id = ?',
             (job_id,))
 
 
 def cancel_requested(job_id: int) -> bool:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute(
             'SELECT cancel_requested FROM jobs WHERE job_id = ?',
             (job_id,)).fetchone()
@@ -190,7 +255,7 @@ def cancel_requested(job_id: int) -> bool:
 
 
 def get_job(job_id: int) -> Optional[Dict[str, Any]]:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
                            (job_id,)).fetchone()
         return _to_dict(row) if row else None
@@ -206,7 +271,7 @@ def get_jobs(
         query += f' WHERE status IN ({marks})'
         args = [s.value for s in statuses]
     query += ' ORDER BY job_id'
-    with _conn() as conn:
+    with _DB.reader() as conn:
         return [_to_dict(r) for r in conn.execute(query, args)]
 
 
@@ -216,3 +281,39 @@ def _to_dict(row: sqlite3.Row) -> Dict[str, Any]:
     if d.get('dag_json'):
         d['dag'] = json.loads(d['dag_json'])
     return d
+
+
+# ------------------------------------------------------ intent journal
+# Thin wrappers over the statedb intent API on the jobs DB; the
+# controller's multi-step operations (launch, recover, terminate)
+# bracket their state mutations with these (docs/crash_recovery.md).
+
+
+def begin_intent(kind: str, payload: Dict[str, Any]) -> int:
+    return _DB.begin_intent(kind, payload)
+
+
+def complete_intent(intent_id: int) -> None:
+    _DB.complete_intent(intent_id)
+
+
+def open_intents(job_id: Optional[int] = None) -> List[Dict[str, Any]]:
+    intents = _DB.open_intents('jobs.*')
+    if job_id is None:
+        return intents
+    return [i for i in intents
+            if i['payload'].get('job_id') == job_id]
+
+
+def finish_launch_intent(intent_id: int, job_id: int,
+                         cluster_job_id: Optional[int]) -> None:
+    """The launch reached its commit point: record the on-cluster job
+    id AND retire the intent atomically — after this transaction a
+    restarted controller adopts via the row, not the journal."""
+    with _DB.transaction() as conn:
+        conn.execute(
+            'UPDATE jobs SET cluster_job_id = ? WHERE job_id = ?',
+            (cluster_job_id, job_id))
+        statedb.complete_intent(conn, intent_id)
+
+

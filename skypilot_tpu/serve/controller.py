@@ -19,6 +19,7 @@ import traceback
 from typing import Optional
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.load_balancer import LoadBalancer
@@ -26,6 +27,7 @@ from skypilot_tpu.serve.replica_managers import ReplicaManager
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import statedb
 
 logger = sky_logging.init_logger(__name__)
 
@@ -102,6 +104,17 @@ class ServeController:
 
     # ------------------------------------------------------------------
     async def _control_loop(self) -> None:
+        # Crash-only startup (docs/crash_recovery.md): settle whatever
+        # a dead predecessor left mid-operation — adopt its live
+        # replicas, roll its scale-downs forward, roll half-launches
+        # back, sweep orphans — BEFORE the first scaling decision, so
+        # the autoscaler never counts (or double-launches over) ghost
+        # state.
+        if statedb.reconcile_enabled():
+            with trace_lib.span('serve.reconcile', slow_ok=True,
+                                service=self.name):
+                await asyncio.to_thread(
+                    self.replica_manager.reconcile_on_start)
         # Initial scale-out honors the spot split from the start.
         self.replica_manager.reconcile(self.autoscaler.initial())
         serve_state.set_service_status(self.name,
@@ -176,7 +189,6 @@ def main() -> None:
                         help='Preferred LB port; 0 = OS-assigned. The '
                         'bound port is written back to serve_state.')
     args = parser.parse_args()
-    from skypilot_tpu import trace as trace_lib
     trace_lib.set_component(f'serve.{args.service_name}')
     serve_state.set_service_controller_pid(args.service_name,
                                            os.getpid())

@@ -65,6 +65,12 @@ _M_PROBE_FAILURES = metrics_lib.counter(
     'Failed replica readiness probes (including injected faults).',
     labels=('replica',))
 
+_M_RECONCILED = metrics_lib.counter(
+    'skytpu_serve_reconciled_intents_total',
+    'Open scale-up/scale-down intent records replayed at controller '
+    'startup, by outcome (adopt / roll_forward / roll_back / orphan).',
+    labels=('action',))
+
 # Replica-cluster teardown goes through the shared RetryPolicy: cloud
 # teardown calls are flaky exactly when the cloud is having the bad
 # day that killed the replica. ClusterDoesNotExist is success.
@@ -159,17 +165,27 @@ class ReplicaManager:
         for _ in range(n):
             replica_id = serve_state.next_replica_id(self.service_name)
             cluster = self._cluster_name(replica_id)
-            serve_state.add_replica(self.service_name, replica_id,
-                                    cluster, version=version,
-                                    is_spot=bool(is_spot))
+            # Row + scale-up intent land in ONE transaction: from here
+            # until the launch thread's STARTING write, a controller
+            # crash leaves an open intent that reconcile_on_start
+            # resolves against cluster truth (adopt or roll back;
+            # docs/crash_recovery.md).
+            intent_id = serve_state.add_replica(
+                self.service_name, replica_id, cluster, version=version,
+                is_spot=bool(is_spot),
+                intent_payload={
+                    'service': self.service_name,
+                    'replica_id': replica_id,
+                    'cluster_name': cluster,
+                })
             threading.Thread(
                 target=self._launch_replica,
-                args=(replica_id, cluster, version, is_spot),
+                args=(replica_id, cluster, version, is_spot, intent_id),
                 daemon=True).start()
 
     def _launch_replica(self, replica_id: int, cluster: str,
-                        version: int,
-                        is_spot: Optional[bool]) -> None:
+                        version: int, is_spot: Optional[bool],
+                        intent_id: Optional[int] = None) -> None:
         from skypilot_tpu import execution
         serve_state.set_replica_status(self.service_name, replica_id,
                                        ReplicaStatus.PROVISIONING)
@@ -186,12 +202,19 @@ class ReplicaManager:
             except Exception:  # pylint: disable=broad-except
                 logger.error('Replica %d launch failed:\n%s',
                              replica_id, traceback.format_exc())
+                # Controlled failure: the operation concluded — settle
+                # row and journal atomically.
                 serve_state.set_replica_status(
                     self.service_name, replica_id,
-                    ReplicaStatus.FAILED_PROVISION)
+                    ReplicaStatus.FAILED_PROVISION,
+                    complete_intent=intent_id)
                 return
+        fault_injection.crashpoint('serve.scale_up.post_launch',
+                                   service=self.service_name,
+                                   replica_id=replica_id)
         serve_state.set_replica_status(self.service_name, replica_id,
-                                       ReplicaStatus.STARTING)
+                                       ReplicaStatus.STARTING,
+                                       complete_intent=intent_id)
 
     # ------------------------------------------------------------------
     def scale_down(self, replica_ids: List[int]) -> None:
@@ -200,11 +223,20 @@ class ReplicaManager:
             for r in serve_state.get_replicas(self.service_name)
         }
         for replica_id in replica_ids:
-            serve_state.set_replica_status(self.service_name, replica_id,
-                                           ReplicaStatus.SHUTTING_DOWN)
+            # SHUTTING_DOWN + the scale-down intent in one transaction:
+            # the announcement IS the point of no return — a crash
+            # anywhere in the drain/terminate below rolls FORWARD on
+            # restart (reconcile re-runs the teardown, skipping the
+            # drain; docs/crash_recovery.md).
+            intent_id = serve_state.mark_shutting_down(
+                self.service_name, replica_id, {
+                    'service': self.service_name,
+                    'replica_id': replica_id,
+                    'cluster_name': self._cluster_name(replica_id),
+                })
             url = (records.get(replica_id) or {}).get('url')
 
-            def work(rid=replica_id, u=url):
+            def work(rid=replica_id, u=url, iid=intent_id):
                 # Voluntary teardown is drain-then-kill
                 # (docs/request_lifecycle.md): first the LB stops
                 # routing and waits out in-flight proxied requests,
@@ -220,7 +252,17 @@ class ReplicaManager:
                             traceback.format_exc())
                 if u:
                     self._drain_replica(u)
-                self._terminate_replica(rid)
+                    # Distinct crash window from pre_terminate below:
+                    # the replica PROCESS has drained (in-flight work
+                    # concluded) but the LB/url bookkeeping of this
+                    # thread is gone with the crash.
+                    fault_injection.crashpoint(
+                        'serve.scale_down.post_drain',
+                        service=self.service_name, replica_id=rid)
+                fault_injection.crashpoint(
+                    'serve.scale_down.pre_terminate',
+                    service=self.service_name, replica_id=rid)
+                self._terminate_replica(rid, complete_intent=iid)
 
             threading.Thread(target=work, daemon=True).start()
 
@@ -278,7 +320,8 @@ class ReplicaManager:
     def _terminate_replica(
             self, replica_id: int,
             final_status: Optional[ReplicaStatus] = ReplicaStatus.SHUTDOWN,
-            remove: bool = False) -> None:
+            remove: bool = False,
+            complete_intent: Optional[int] = None) -> None:
         from skypilot_tpu import core
         try:
             with trace_lib.span('serve.replica.terminate',
@@ -293,15 +336,20 @@ class ReplicaManager:
             logger.warning('Replica %d teardown error:\n%s', replica_id,
                            traceback.format_exc())
         if remove:
-            serve_state.remove_replica(self.service_name, replica_id)
+            serve_state.remove_replica(self.service_name, replica_id,
+                                       complete_intent=complete_intent)
         elif final_status is not None:
             serve_state.set_replica_status(self.service_name, replica_id,
-                                           final_status)
+                                           final_status,
+                                           complete_intent=complete_intent)
+        elif complete_intent is not None:
+            serve_state.complete_intent(complete_intent)
 
     def _terminate_in_background(
             self, replica_id: int,
             final_status: Optional[ReplicaStatus] = ReplicaStatus.SHUTDOWN,
-            remove: bool = False) -> None:
+            remove: bool = False,
+            complete_intent: Optional[int] = None) -> None:
         """Cluster teardown takes seconds-to-minutes; never block the
         probe loop on it (advisor finding: the synchronous PREEMPTED
         path stalled probing for the whole teardown)."""
@@ -313,12 +361,164 @@ class ReplicaManager:
 
         def work() -> None:
             try:
-                self._terminate_replica(replica_id, final_status, remove)
+                self._terminate_replica(replica_id, final_status, remove,
+                                        complete_intent=complete_intent)
             finally:
                 with self._lock:
                     self._terminating.discard(replica_id)
 
         threading.Thread(target=work, daemon=True).start()
+
+    # ------------------------------------------------------------------
+    # Crash-only startup (docs/crash_recovery.md).
+
+    def reconcile_on_start(self) -> Dict[str, int]:
+        """Replay open scale-up/scale-down intents against cluster
+        truth, then sweep orphans — the first thing a (re)started
+        controller does, so a `kill -9` at any instruction of a
+        scale operation leaves the service convergent:
+
+        - open ``serve.scale_up`` + live cluster  -> **adopt** (mark
+          STARTING; the probe loop takes it to READY — no relaunch,
+          no duplicate cluster for the replica id);
+        - open ``serve.scale_up`` + no/dead cluster -> **roll back**
+          (drop the row, terminate leftovers; the autoscaler launches
+          a fresh replica id);
+        - open ``serve.scale_down``               -> **roll forward**
+          (the announcement was the point of no return: terminate and
+          drop the row; the drain is skipped — its requests died with
+          the dead controller's LB anyway);
+        - rows/clusters with no journal entry     -> **orphan** sweep
+          (SHUTTING_DOWN rows re-enter teardown; replica-named
+          clusters without a row are terminated).
+
+        Returns action -> count (also exported via
+        ``skytpu_serve_reconciled_intents_total``).
+        """
+        from skypilot_tpu import global_user_state
+        actions: Dict[str, int] = {}
+
+        def count(action: str) -> None:
+            actions[action] = actions.get(action, 0) + 1
+            _M_RECONCILED.inc(1, action=action)
+
+        rows = {r['replica_id']: r
+                for r in serve_state.get_replicas(self.service_name)}
+        journaled = set()
+        for intent in serve_state.open_intents(self.service_name):
+            payload = intent['payload']
+            rid = payload.get('replica_id')
+            cluster = payload.get('cluster_name')
+            journaled.add(rid)
+            if intent['kind'] == 'serve.scale_up':
+                if self._cluster_is_up(cluster):
+                    logger.info(
+                        'Reconcile: adopting replica %s (cluster %s '
+                        'launched by the previous controller).', rid,
+                        cluster)
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.STARTING,
+                        complete_intent=intent['intent_id'])
+                    count('adopt')
+                else:
+                    logger.info(
+                        'Reconcile: rolling back half-launched replica '
+                        '%s (cluster %s not up).', rid, cluster)
+                    serve_state.remove_replica(
+                        self.service_name, rid,
+                        complete_intent=intent['intent_id'])
+                    rows.pop(rid, None)
+                    # A partially-provisioned cluster may still hold
+                    # resources; the teardown is a no-op when nothing
+                    # exists.
+                    self._terminate_in_background(rid, final_status=None,
+                                                  remove=False)
+                    count('roll_back')
+            elif intent['kind'] == 'serve.scale_down':
+                logger.info(
+                    'Reconcile: rolling forward scale-down of replica '
+                    '%s.', rid)
+                self._terminate_in_background(
+                    rid, remove=True,
+                    complete_intent=intent['intent_id'])
+                count('roll_forward')
+            else:
+                logger.warning('Reconcile: unknown intent kind %r; '
+                               'dropping.', intent['kind'])
+                serve_state.complete_intent(intent['intent_id'])
+                count('orphan')
+        # Journal-less leftovers. SHUTTING_DOWN rows re-enter teardown;
+        # PENDING/PROVISIONING rows without an intent can only be
+        # pre-migration debris — their launch thread died with the old
+        # process and nothing will ever advance them.
+        for rid, row in list(rows.items()):
+            if rid in journaled:
+                continue
+            if row['status'] is ReplicaStatus.SHUTTING_DOWN:
+                logger.info('Reconcile: resuming teardown of replica '
+                            '%d.', rid)
+                self._terminate_in_background(rid, remove=True)
+                count('roll_forward')
+            elif row['status'] in (ReplicaStatus.PENDING,
+                                   ReplicaStatus.PROVISIONING):
+                if self._cluster_is_up(row['cluster_name']):
+                    # The cluster made it up: adopt rather than waste.
+                    logger.info(
+                        'Reconcile: replica %d stuck %s with no '
+                        'intent record but a live cluster; adopting.',
+                        rid, row['status'].value)
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.STARTING)
+                    count('adopt')
+                else:
+                    logger.warning(
+                        'Reconcile: replica %d stuck %s with no '
+                        'intent record (orphan row); removing.', rid,
+                        row['status'].value)
+                    serve_state.remove_replica(self.service_name, rid)
+                    self._terminate_in_background(rid, final_status=None,
+                                                  remove=False)
+                    count('orphan')
+        # Orphan clusters: a cluster named like one of OUR replicas
+        # with no row to account for it (e.g. a rolled-back row whose
+        # teardown crashed) must not keep burning money.
+        prefix = f'{self.service_name}-replica-'
+        known = set(rows) | journaled
+        try:
+            clusters = global_user_state.get_clusters()
+        except Exception:  # pylint: disable=broad-except
+            clusters = []
+        for record in clusters:
+            name = record.get('name') or ''
+            if not name.startswith(prefix):
+                continue
+            try:
+                rid = int(name[len(prefix):])
+            except ValueError:
+                continue
+            if rid in known:
+                continue
+            logger.warning(
+                'Reconcile: orphan replica cluster %s (no replica '
+                'row); terminating.', name)
+            self._terminate_in_background(rid, final_status=None,
+                                          remove=False)
+            count('orphan')
+        if actions:
+            logger.info('Reconcile on start for %s: %s.',
+                        self.service_name, actions)
+        return actions
+
+    def _cluster_is_up(self, cluster: Optional[str]) -> bool:
+        if not cluster:
+            return False
+        try:
+            record = backend_utils.refresh_cluster_record(
+                cluster, force_refresh=True)
+        except Exception:  # pylint: disable=broad-except
+            return False
+        return (record is not None and
+                record['status'] is status_lib.ClusterStatus.UP)
 
     def terminate_all(self) -> None:
         replicas = serve_state.get_replicas(self.service_name)

@@ -1,16 +1,22 @@
 """Serve SQLite state: services + replicas.
 
 Re-design of reference ``sky/serve/serve_state.py:40-57``.
+
+Durability goes through :mod:`skypilot_tpu.utils.statedb`: replica
+scale-up/scale-down are multi-step operations (row write -> cluster
+launch/teardown -> row write) bracketed by intent records in the same
+transactions as the row writes, so a controller killed mid-operation
+is reconciled on restart (docs/crash_recovery.md).
 """
 from __future__ import annotations
 
 import json
 import os
-import pathlib
 import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils.status_lib import ReplicaStatus, ServiceStatus
 
 _DB_PATH_ENV = 'SKYTPU_SERVE_DB'
@@ -21,19 +27,7 @@ def _db_path() -> str:
     return os.path.expanduser(os.environ.get(_DB_PATH_ENV, _DEFAULT_DB))
 
 
-# DB paths already created+migrated by this process (avoids re-running
-# DDL on every connection).
-_initialized_paths: set = set()
-
-
-def _conn() -> sqlite3.Connection:
-    path = _db_path()
-    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    if path in _initialized_paths:
-        return conn
-    conn.execute('PRAGMA journal_mode=WAL')
+def _init(conn: sqlite3.Connection) -> None:
     conn.execute("""
         CREATE TABLE IF NOT EXISTS services (
             name TEXT PRIMARY KEY,
@@ -105,8 +99,9 @@ def _conn() -> sqlite3.Connection:
                 """)
         except sqlite3.OperationalError:
             pass  # already present
-    _initialized_paths.add(path)
-    return conn
+
+
+_DB = statedb.StateDB(_db_path, init_fn=_init, site='serve.state.write')
 
 
 # ------------------------------------------------------------- services
@@ -114,7 +109,7 @@ def _conn() -> sqlite3.Connection:
 
 def add_service(name: str, spec_json: str, task_json: str,
                 lb_port: int) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO services (name, status, spec_json, '
             'task_json, lb_port, created_at, current_version) '
@@ -134,7 +129,7 @@ def add_version(name: str, spec_json: str, task_json: str) -> int:
     The controller notices current_version changed on its next loop and
     rolls replicas forward (launch new, drain old once new are READY).
     """
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         row = conn.execute(
             'SELECT MAX(version) AS v FROM version_specs '
             'WHERE service_name = ?', (name,)).fetchone()
@@ -153,7 +148,7 @@ def add_version(name: str, spec_json: str, task_json: str) -> int:
 
 
 def get_current_version(name: str) -> int:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute(
             'SELECT current_version FROM services WHERE name = ?',
             (name,)).fetchone()
@@ -161,7 +156,7 @@ def get_current_version(name: str) -> int:
 
 
 def get_version_spec(name: str, version: int) -> Optional[Dict[str, Any]]:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute(
             'SELECT * FROM version_specs WHERE service_name = ? AND '
             'version = ?', (name, version)).fetchone()
@@ -174,13 +169,13 @@ def get_version_spec(name: str, version: int) -> Optional[Dict[str, Any]]:
 
 
 def set_service_status(name: str, status: ServiceStatus) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute('UPDATE services SET status = ? WHERE name = ?',
                      (status.value, name))
 
 
 def set_service_controller_pid(name: str, pid: int) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'UPDATE services SET controller_pid = ? WHERE name = ?',
             (pid, name))
@@ -190,13 +185,13 @@ def set_service_lb_port(name: str, port: int) -> None:
     """The controller binds the LB port itself (port 0 = pick free) and
     records the bound port here; `up` polls for it (no bind-ahead
     TOCTOU)."""
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute('UPDATE services SET lb_port = ? WHERE name = ?',
                      (port, name))
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute('SELECT * FROM services WHERE name = ?',
                            (name,)).fetchone()
     if row is None:
@@ -209,7 +204,7 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
 
 
 def get_services() -> List[Dict[str, Any]]:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         names = [
             r['name']
             for r in conn.execute('SELECT name FROM services ORDER BY name')
@@ -218,7 +213,7 @@ def get_services() -> List[Dict[str, Any]]:
 
 
 def remove_service(name: str) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute('DELETE FROM services WHERE name = ?', (name,))
         conn.execute('DELETE FROM replicas WHERE service_name = ?',
                      (name,))
@@ -230,7 +225,7 @@ def remove_service(name: str) -> None:
 
 
 def save_autoscaler_state(name: str, state: Dict[str, Any]) -> None:
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO autoscaler_state '
             '(service_name, state_json, updated_at) VALUES (?, ?, ?)',
@@ -238,7 +233,7 @@ def save_autoscaler_state(name: str, state: Dict[str, Any]) -> None:
 
 
 def load_autoscaler_state(name: str) -> Optional[Dict[str, Any]]:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         row = conn.execute(
             'SELECT state_json FROM autoscaler_state '
             'WHERE service_name = ?', (name,)).fetchone()
@@ -249,8 +244,13 @@ def load_autoscaler_state(name: str) -> Optional[Dict[str, Any]]:
 
 
 def add_replica(service_name: str, replica_id: int, cluster_name: str,
-                version: int = 1, is_spot: bool = False) -> None:
-    with _conn() as conn:
+                version: int = 1, is_spot: bool = False,
+                intent_payload: Optional[Dict[str, Any]] = None
+                ) -> Optional[int]:
+    """Insert the replica row; when ``intent_payload`` is given, journal
+    the scale-up intent in the SAME transaction (crash between row and
+    journal is impossible) and return the intent id."""
+    with _DB.transaction() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id, '
             'cluster_name, status, launched_at, version, is_spot) '
@@ -258,11 +258,16 @@ def add_replica(service_name: str, replica_id: int, cluster_name: str,
             (service_name, replica_id, cluster_name,
              ReplicaStatus.PENDING.value, time.time(), version,
              int(is_spot)))
+        if intent_payload is not None:
+            return statedb.begin_intent(conn, 'serve.scale_up',
+                                        intent_payload)
+    return None
 
 
 def set_replica_status(service_name: str, replica_id: int,
                        status: ReplicaStatus,
-                       url: Optional[str] = None) -> None:
+                       url: Optional[str] = None,
+                       complete_intent: Optional[int] = None) -> None:
     # The readiness budget (initial_delay_seconds) is measured from the
     # STARTING transition — i.e. after provisioning — not from
     # submission (reference replica_managers.py:1105 counts from the
@@ -283,14 +288,32 @@ def set_replica_status(service_name: str, replica_id: int,
         sets.append('url = ?')
         args.append(url)
     args += [service_name, replica_id]
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             f'UPDATE replicas SET {", ".join(sets)} '
             'WHERE service_name = ? AND replica_id = ?', args)
+        if complete_intent is not None:
+            statedb.complete_intent(conn, complete_intent)
+
+
+def mark_shutting_down(service_name: str, replica_id: int,
+                       intent_payload: Dict[str, Any]) -> int:
+    """Scale-down announcement: SHUTTING_DOWN + the scale-down intent
+    in one transaction. From here the operation only rolls FORWARD —
+    a crash before the cluster teardown finishes is resumed by
+    reconcile_on_start, never undone."""
+    with _DB.transaction() as conn:
+        conn.execute(
+            'UPDATE replicas SET status = ? '
+            'WHERE service_name = ? AND replica_id = ?',
+            (ReplicaStatus.SHUTTING_DOWN.value, service_name,
+             replica_id))
+        return statedb.begin_intent(conn, 'serve.scale_down',
+                                    intent_payload)
 
 
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:
-    with _conn() as conn:
+    with _DB.reader() as conn:
         rows = conn.execute(
             'SELECT * FROM replicas WHERE service_name = ? '
             'ORDER BY replica_id', (service_name,)).fetchall()
@@ -306,7 +329,7 @@ def next_replica_id(service_name: str) -> int:
     # Monotonic counter in the service row (NOT max(replica_id):
     # terminated rows are garbage-collected, and a reused id would
     # collide with a cluster still being torn down asynchronously).
-    with _conn() as conn:
+    with _DB.transaction() as conn:
         conn.execute(
             'UPDATE services SET next_replica_id = next_replica_id + 1 '
             'WHERE name = ?', (service_name,))
@@ -316,8 +339,31 @@ def next_replica_id(service_name: str) -> int:
     return int(row['next_replica_id']) if row else 1
 
 
-def remove_replica(service_name: str, replica_id: int) -> None:
-    with _conn() as conn:
+def remove_replica(service_name: str, replica_id: int,
+                   complete_intent: Optional[int] = None) -> None:
+    with _DB.transaction() as conn:
         conn.execute(
             'DELETE FROM replicas WHERE service_name = ? AND '
             'replica_id = ?', (service_name, replica_id))
+        if complete_intent is not None:
+            statedb.complete_intent(conn, complete_intent)
+
+
+# ------------------------------------------------------ intent journal
+
+
+def begin_intent(kind: str, payload: Dict[str, Any]) -> int:
+    return _DB.begin_intent(kind, payload)
+
+
+def complete_intent(intent_id: int) -> None:
+    _DB.complete_intent(intent_id)
+
+
+def open_intents(
+        service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    intents = _DB.open_intents('serve.*')
+    if service_name is None:
+        return intents
+    return [i for i in intents
+            if i['payload'].get('service') == service_name]

@@ -11,7 +11,6 @@ from __future__ import annotations
 import enum
 import json
 import os
-import pathlib
 import sqlite3
 import subprocess
 import sys
@@ -23,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import statedb
 
 logger = sky_logging.init_logger(__name__)
 
@@ -61,11 +61,10 @@ def log_dir() -> str:
 
 
 def _conn() -> sqlite3.Connection:
-    path = _db_path()
-    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
-    conn = sqlite3.connect(path, timeout=10)
-    conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
+    # statedb.connect: the one connection recipe (WAL + busy_timeout +
+    # synchronous=NORMAL + autocommit; docs/crash_recovery.md). All
+    # writes here are single statements, so no explicit transactions.
+    conn = statedb.connect(_db_path())
     conn.execute("""
         CREATE TABLE IF NOT EXISTS requests (
             request_id TEXT PRIMARY KEY,

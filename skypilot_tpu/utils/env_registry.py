@@ -177,6 +177,19 @@ SKYTPU_BENCHMARK_DB = register(
 SKYTPU_BENCHMARK_DIR = register(
     'SKYTPU_BENCHMARK_DIR', 'Directory for benchmark artifacts.')
 
+# ------------------------------------------------------ crash recovery
+SKYTPU_RECONCILE_ON_START = register(
+    'SKYTPU_RECONCILE_ON_START',
+    'Crash-only startup for the jobs/serve controllers: replay open '
+    'intent records against cloud truth on every start (adopt / roll '
+    'forward / roll back; docs/crash_recovery.md). Default on; set 0 '
+    'to disable.')
+SKYTPU_CONTROLLER_RESTART_LIMIT = register(
+    'SKYTPU_CONTROLLER_RESTART_LIMIT',
+    'Max automatic relaunches of a managed-job controller process '
+    'whose pid died while the job was non-terminal (jobs/scheduler.'
+    'py); beyond it the job is marked FAILED_CONTROLLER. Default 3.')
+
 # --------------------------------------------------------------- chaos
 SKYTPU_FAULT_PLAN = register(
     'SKYTPU_FAULT_PLAN',

@@ -88,7 +88,23 @@ KNOWN_SITES = (
     'engine.tick.hang',
     'serve.replica.drain',
     'lb.client_disconnect',
+    # Crashpoints (docs/crash_recovery.md): named instructions inside
+    # the controllers' multi-step operations where a `crash` fault
+    # os._exit()s the process — the chaos analogue of `kill -9` at
+    # that exact line. Recovery-as-startup must survive every one.
+    'jobs.controller.launch.pre_provision',
+    'jobs.controller.launch.post_provision',
+    'jobs.controller.recover.mid',
+    'serve.scale_up.post_launch',
+    'serve.scale_down.pre_terminate',
+    'serve.scale_down.post_drain',
+    'statedb.commit.pre',
+    'statedb.commit.post',
 )
+
+# Default exit code for `crash` faults: distinctive in wait statuses,
+# so a chaos test can tell an injected crash from an organic failure.
+CRASH_EXIT_CODE = 13
 
 # Chaos observability (docs/metrics.md): every injected fault counts
 # here, so chaos tests (and dashboards during a game day) can assert
@@ -112,6 +128,10 @@ class FaultKind(str, enum.Enum):
     # params['seconds']) and a client that hangs up mid-response.
     HANG = 'hang'
     CLIENT_DISCONNECT = 'client_disconnect'
+    # Crash-only-software kind: the process os._exit()s at the site —
+    # no excepts run, no finallys, no atexit — indistinguishable from
+    # `kill -9` at that instruction (docs/crash_recovery.md).
+    CRASH = 'crash'
 
 
 @dataclasses.dataclass
@@ -324,6 +344,20 @@ def inject(site: str, **context: Any) -> None:
         raise make_exception(spec, site)
 
 
+def crashpoint(site: str, **context: Any) -> None:
+    """A named crash site: if a ``crash`` fault is armed here, the
+    process dies NOW via ``os._exit`` — no exception propagation, no
+    cleanup handlers — exactly the at-any-instruction `kill -9` the
+    crash-only recovery design must survive. Only CRASH-kind specs are
+    consumed; other kinds armed at overlapping patterns keep their
+    budgets. The fault record (and its metrics line) is written by
+    poll() before the exit, so the record file proves WHERE the
+    process died."""
+    spec = poll(site, kinds=(FaultKind.CRASH,), **context)
+    if spec is not None:
+        os._exit(int(spec.params.get('exit_code', CRASH_EXIT_CODE)))
+
+
 def make_exception(spec: FaultSpec, site: str) -> Exception:
     """The exception a fired fault manifests as (typed: the failover
     machinery dispatches on these classes)."""
@@ -342,6 +376,10 @@ def make_exception(spec: FaultSpec, site: str) -> Exception:
         return TimeoutError(msg)
     if spec.kind is FaultKind.CLIENT_DISCONNECT:
         return ConnectionResetError(msg)
+    if spec.kind is FaultKind.CRASH:
+        # CRASH is meant for crashpoint() (which never raises); via
+        # inject() it manifests as the exit it would have been.
+        return SystemExit(CRASH_EXIT_CODE)
     return AssertionError(f'unmapped fault kind {spec.kind}')
 
 

@@ -1,0 +1,270 @@
+"""Shared durable-state layer: ONE way to open and mutate control-plane
+sqlite databases (docs/crash_recovery.md).
+
+Every control-plane store (managed-jobs state, serve state, the global
+cluster DB, the API-server request table, the agent job table, the
+benchmark DB) used to roll its own ``sqlite3.connect`` with ad-hoc
+pragmas and ad-hoc ``OperationalError`` handling. This module replaces
+them (lint rule STL010 keeps it that way) with:
+
+- :func:`connect` — one connection recipe: WAL journal mode (readers
+  never block the writer, a torn process never corrupts the file),
+  ``busy_timeout`` so concurrent writers queue instead of raising,
+  ``synchronous=NORMAL`` (safe with WAL: a power cut may lose the last
+  transactions but never corrupts), autocommit isolation so
+  transactions are always *explicit*;
+- :func:`transaction` — ``BEGIN IMMEDIATE`` … ``COMMIT`` as a context
+  manager, with lock-acquisition retries on the shared
+  :class:`~skypilot_tpu.utils.retry.RetryPolicy` (per-site attempt/
+  giveup metrics) and deterministic crashpoints
+  (``statedb.commit.pre`` / ``statedb.commit.post``) bracketing the
+  commit so chaos tests can kill a process at the exact instruction
+  where atomicity matters;
+- an **intent journal** (ARIES-style write-ahead intent records): a
+  multi-step operation calls :func:`begin_intent` in the same
+  transaction as its first state mutation and :func:`complete_intent`
+  in the same transaction as its last. A crash at ANY instruction in
+  between leaves an open intent row; recovery-as-startup
+  (``reconcile_on_start`` in the jobs and serve controllers) replays
+  open intents against cloud/cluster truth — adopt, roll forward, or
+  roll back — so the operation is never half-done forever.
+
+Import-light: stdlib + utils.retry + utils.fault_injection only.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pathlib
+import sqlite3
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import retry as retry_lib
+
+# Writers queue behind the WAL write lock for this long before the
+# sqlite driver raises SQLITE_BUSY (which transaction() then retries
+# through RetryPolicy, so contention also shows up in retry metrics).
+BUSY_TIMEOUT_MS = 10_000
+
+_INTENT_DDL = """
+    CREATE TABLE IF NOT EXISTS intents (
+        intent_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        kind TEXT NOT NULL,
+        payload TEXT,
+        created_at REAL,
+        pid INTEGER
+    )"""
+
+# One RetryPolicy per site label (jobs.state.write / serve.state.write
+# / ...): BEGIN IMMEDIATE contention lands in the shared
+# skytpu_retry_attempts_total / _giveups_total series.
+_retry_policies: Dict[str, retry_lib.RetryPolicy] = {}
+_retry_lock = threading.Lock()
+
+
+def reconcile_enabled() -> bool:
+    """Crash-only startup switch: controllers replay open intents on
+    every start unless SKYTPU_RECONCILE_ON_START=0."""
+    return os.environ.get(env_registry.SKYTPU_RECONCILE_ON_START,
+                          '1') != '0'
+
+
+def _retry_policy(site: str) -> retry_lib.RetryPolicy:
+    with _retry_lock:
+        policy = _retry_policies.get(site)
+        if policy is None:
+            policy = retry_lib.RetryPolicy(
+                max_attempts=6,
+                initial_backoff=0.05,
+                max_backoff=2.0,
+                jitter='full',
+                retryable=(sqlite3.OperationalError,),
+                site=site)
+            _retry_policies[site] = policy
+        return policy
+
+
+def connect(path: str, *, row_factory: bool = True) -> sqlite3.Connection:
+    """The ONE sqlite connection recipe (see module docstring).
+
+    ``isolation_level=None`` puts the connection in true autocommit:
+    single statements commit immediately; multi-statement writes must
+    go through :func:`transaction` (lint rule STL010 enforces this
+    outside this module).
+    """
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(path, timeout=BUSY_TIMEOUT_MS / 1000.0,
+                           isolation_level=None)
+    if row_factory:
+        conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute(f'PRAGMA busy_timeout={BUSY_TIMEOUT_MS}')
+    conn.execute('PRAGMA synchronous=NORMAL')
+    return conn
+
+
+@contextlib.contextmanager
+def transaction(conn: sqlite3.Connection, site: str = 'statedb.write'):
+    """Explicit write transaction on an existing connection.
+
+    BEGIN IMMEDIATE takes the write lock up front (no deferred-lock
+    upgrade deadlocks); SQLITE_BUSY on acquisition is retried through
+    the site's RetryPolicy. The body's mutations commit atomically —
+    the ``statedb.commit.pre`` / ``.post`` crashpoints let chaos tests
+    prove it (a crash at ``pre`` loses the whole transaction, never
+    half of it).
+    """
+    _retry_policy(site).call(conn.execute, 'BEGIN IMMEDIATE')
+    try:
+        yield conn
+    except BaseException:
+        _rollback_quiet(conn)
+        raise
+    fault_injection.crashpoint('statedb.commit.pre', db=site)
+    try:
+        conn.commit()
+    except BaseException:
+        # A failed COMMIT (disk full, I/O error) must not strand a
+        # cached connection inside the open transaction — every later
+        # BEGIN on it would fail with 'cannot start a transaction
+        # within a transaction'.
+        _rollback_quiet(conn)
+        raise
+    fault_injection.crashpoint('statedb.commit.post', db=site)
+
+
+def _rollback_quiet(conn: sqlite3.Connection) -> None:
+    try:
+        conn.rollback()
+    except sqlite3.Error:
+        pass  # connection unusable anyway; keep the original error
+
+
+# ------------------------------------------------------ intent journal
+
+
+def ensure_intent_table(conn: sqlite3.Connection) -> None:
+    conn.execute(_INTENT_DDL)
+
+
+def begin_intent(conn: sqlite3.Connection, kind: str,
+                 payload: Optional[Dict[str, Any]] = None) -> int:
+    """Journal the *intention* to perform a multi-step operation.
+
+    Call inside the same :func:`transaction` as the operation's first
+    state mutation; keep the returned id and
+    :func:`complete_intent` it in the same transaction as the LAST
+    mutation. Payload must carry everything recovery needs to decide
+    adopt / roll forward / roll back (cluster name, replica id, …).
+    """
+    cur = conn.execute(
+        'INSERT INTO intents (kind, payload, created_at, pid) '
+        'VALUES (?,?,?,?)',
+        (kind, json.dumps(payload or {}), time.time(), os.getpid()))
+    return int(cur.lastrowid)
+
+
+def complete_intent(conn: sqlite3.Connection, intent_id: int) -> None:
+    conn.execute('DELETE FROM intents WHERE intent_id = ?', (intent_id,))
+
+
+def open_intents(conn: sqlite3.Connection,
+                 kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Open (= not completed) intents, oldest first — exactly the
+    operations a dead process left in flight. ``kind`` may end with
+    ``*`` to prefix-match (``'jobs.*'``)."""
+    query = 'SELECT * FROM intents'
+    args: List[Any] = []
+    if kind is not None:
+        if kind.endswith('*'):
+            query += ' WHERE kind LIKE ?'
+            args.append(kind[:-1] + '%')
+        else:
+            query += ' WHERE kind = ?'
+            args.append(kind)
+    query += ' ORDER BY intent_id'
+    out = []
+    for row in conn.execute(query, args):
+        d = dict(row)
+        try:
+            d['payload'] = json.loads(d.get('payload') or '{}')
+        except ValueError:
+            # A torn payload must not wedge recovery of OTHER intents.
+            d['payload'] = {}
+        out.append(d)
+    return out
+
+
+# ------------------------------------------------------------- StateDB
+
+
+class StateDB:
+    """One control-plane database: path resolution, once-per-path DDL
+    (schema creation + in-place migrations), transactions, intents.
+
+    ``path_fn`` re-resolves the path on every connection so tests that
+    point the env var at a fresh tmp dir get a fresh DB; the DDL
+    ``init_fn(conn)`` runs once per (process, path).
+    """
+
+    def __init__(self, path_fn: Callable[[], str],
+                 init_fn: Optional[Callable[[sqlite3.Connection],
+                                            None]] = None,
+                 site: str = 'statedb.write') -> None:
+        self._path_fn = path_fn
+        self._init_fn = init_fn
+        self.site = site
+        self._initialized_paths: set = set()
+        self._init_lock = threading.Lock()
+
+    def connection(self) -> sqlite3.Connection:
+        path = self._path_fn()
+        conn = connect(path)
+        if path not in self._initialized_paths:
+            with self._init_lock:
+                if path not in self._initialized_paths:
+                    ensure_intent_table(conn)
+                    if self._init_fn is not None:
+                        self._init_fn(conn)
+                    self._initialized_paths.add(path)
+        return conn
+
+    @contextlib.contextmanager
+    def reader(self):
+        """Read-only use; closes the connection on exit."""
+        conn = self.connection()
+        try:
+            yield conn
+        finally:
+            conn.close()
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Fresh connection, one explicit transaction, closed after."""
+        conn = self.connection()
+        try:
+            with transaction(conn, site=self.site) as txn:
+                yield txn
+        finally:
+            conn.close()
+
+    # Convenience single-op intent helpers (own transaction each) for
+    # callers that are not already inside one.
+    def begin_intent(self, kind: str,
+                     payload: Optional[Dict[str, Any]] = None) -> int:
+        with self.transaction() as conn:
+            return begin_intent(conn, kind, payload)
+
+    def complete_intent(self, intent_id: int) -> None:
+        with self.transaction() as conn:
+            complete_intent(conn, intent_id)
+
+    def open_intents(self,
+                     kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self.reader() as conn:
+            return open_intents(conn, kind)

@@ -43,7 +43,12 @@ _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
                'test_recovery_strategy.py', 'test_decode_attention.py',
                'test_chunked_prefill.py', 'test_bench_smoke.py',
                'test_metrics.py', 'test_analysis.py', 'test_trace.py',
-               'test_request_lifecycle.py')
+               'test_request_lifecycle.py', 'test_statedb.py')
+
+# Crash-recovery round trips (test_crash_recovery.py subprocess cases)
+# drive real local clusters through kill+restart cycles — priced like
+# the chaos suite, at the very end of the fast tier.
+_LATEST_FILES = ('test_crash_recovery.py',)
 
 
 def pytest_collection_modifyitems(config, items):
@@ -51,6 +56,8 @@ def pytest_collection_modifyitems(config, items):
 
     def weight(item):
         if item.get_closest_marker('chaos'):
+            return 2
+        if os.path.basename(str(item.fspath)) in _LATEST_FILES:
             return 2
         if os.path.basename(str(item.fspath)) in _LATE_FILES:
             return 1

@@ -580,6 +580,97 @@ class TestBlockingSignalHandler:
         assert [v for v in vs if v.rule == 'STL009'] == []
 
 
+# ---------------------------------------------------------------- STL010
+class TestRawSqliteOutsideStateDB:
+
+    def test_fires_on_sqlite3_connect(self):
+        vs = lint('''
+            import sqlite3
+            conn = sqlite3.connect('/tmp/x.db', timeout=10)
+            ''')
+        assert rules_of(vs) == ['STL010']
+        assert 'statedb.connect' in vs[0].message
+
+    def test_fires_on_executescript(self):
+        vs = lint('''
+            def wipe(conn):
+                conn.executescript('DELETE FROM a; DELETE FROM b;')
+            ''')
+        assert rules_of(vs) == ['STL010']
+
+    def test_fires_on_unguarded_multi_statement_write(self):
+        vs = lint('''
+            def remove(conn, name):
+                conn.execute('DELETE FROM services WHERE name=?', (name,))
+                conn.execute('DELETE FROM replicas WHERE svc=?', (name,))
+            ''')
+        assert rules_of(vs) == ['STL010']
+        assert 'write statements' in vs[0].message
+
+    def test_fires_on_fstring_write_sql(self):
+        vs = lint('''
+            def update(conn, sets, job_id):
+                conn.execute(f'UPDATE jobs SET {sets} WHERE id=?', (job_id,))
+                conn.execute('DELETE FROM intents WHERE id=?', (job_id,))
+            ''')
+        assert rules_of(vs) == ['STL010']
+
+    def test_quiet_under_transaction_block(self):
+        assert lint('''
+            def remove(db, name):
+                with db.transaction() as conn:
+                    conn.execute('DELETE FROM services WHERE name=?',
+                                 (name,))
+                    conn.execute('DELETE FROM replicas WHERE svc=?',
+                                 (name,))
+            ''') == []
+
+    def test_quiet_on_module_level_transaction_helper(self):
+        assert lint('''
+            from skypilot_tpu.utils import statedb
+
+            def remove(conn, name):
+                with statedb.transaction(conn, site='x.write') as c:
+                    c.execute('DELETE FROM a WHERE name=?', (name,))
+                    c.execute('DELETE FROM b WHERE name=?', (name,))
+            ''') == []
+
+    def test_quiet_on_single_write_and_reads(self):
+        assert lint('''
+            def set_status(conn, job_id, status):
+                conn.execute('UPDATE jobs SET status=? WHERE id=?',
+                             (status, job_id))
+
+            def get(conn, job_id):
+                a = conn.execute('SELECT * FROM jobs WHERE id=?',
+                                 (job_id,)).fetchone()
+                b = conn.execute('SELECT COUNT(*) FROM jobs').fetchone()
+                return a, b
+            ''') == []
+
+    def test_statedb_module_is_exempt(self):
+        assert lint('''
+            import sqlite3
+
+            def connect(path):
+                return sqlite3.connect(path, isolation_level=None)
+            ''', path='skypilot_tpu/utils/statedb.py') == []
+
+    def test_repo_state_modules_are_clean(self):
+        """The migrated state layers themselves are the rule's
+        motivating examples — targeted canary on top of the repo
+        gate."""
+        for rel in ('jobs/state.py', 'serve/serve_state.py',
+                    'global_user_state.py'):
+            path = os.path.join(_REPO_ROOT, 'skypilot_tpu',
+                                *rel.split('/'))
+            with open(path, encoding='utf-8') as f:
+                vs = analyze_source(f.read(),
+                                    path=f'skypilot_tpu/{rel}',
+                                    project=Project())
+            assert [v for v in vs if v.rule == 'STL010'] == [], rel
+
+
 # ----------------------------------------------------------- suppression
 class TestSuppression:
 

@@ -1,0 +1,453 @@
+"""Crash-safe control plane (docs/crash_recovery.md).
+
+Two layers of coverage:
+
+- **Hermetic reconcile units**: construct the exact DB states a
+  ``kill -9`` at each crashpoint leaves behind (open intent + absent/
+  half-built cluster, SHUTTING_DOWN rows, orphans) and assert the
+  reconcile pass settles them — no clusters needed.
+- **Real subprocess round trips**: arm a ``crash`` fault at a
+  registered crashpoint, let the real controller process die there
+  mid-operation against real local-cloud clusters, restart it, and
+  assert the recovery invariants: the job/service reaches a terminal
+  or READY state, the task ran exactly once (no double-launch),
+  exactly one cluster per replica id, no orphan rows/clusters, and
+  the intent table is empty at quiesce.
+
+Deterministic per-site cases are tier-1 (``crashrec`` marker); the
+randomized multi-site sweep is ``slow``.
+"""
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import psutil
+import pytest
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import controller as jobs_controller
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import fault_injection
+
+pytestmark = pytest.mark.crashrec
+
+
+def _wait(predicate, timeout, what='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.3)
+    raise TimeoutError(f'timed out waiting for {what}')
+
+
+def _pid_dead(pid):
+    try:
+        return psutil.Process(pid).status() == psutil.STATUS_ZOMBIE
+    except psutil.NoSuchProcess:
+        return True
+
+
+# ================================================== hermetic reconcile
+
+
+def _add_job(run='true', name='rjob'):
+    config = {'name': name, 'run': run,
+              'resources': {'cloud': 'local'}}
+    job_id = state.add_job(name=name, task_yaml='',
+                           cluster_name=f'{name}-cl',
+                           log_path='', dag_json=json.dumps([config]))
+    return job_id
+
+
+class TestJobsReconcileUnits:
+
+    def test_launch_intent_no_cluster_rolls_back(self, isolated_state):
+        job_id = _add_job()
+        state.set_status(job_id, state.ManagedJobStatus.STARTING)
+        state.begin_intent('jobs.launch', {
+            'job_id': job_id, 'cluster_name': 'rjob-cl', 'task_index': 0})
+        ctrl = jobs_controller.JobsController(job_id, check_gap=0.1)
+        adopted = ctrl.reconcile_on_start()
+        # Nothing to adopt (the crash hit before any cluster existed):
+        # the journal is settled and a fresh launch may proceed.
+        assert adopted is None
+        assert state.open_intents() == []
+
+    def test_terminate_intent_rolls_forward_to_final_status(
+            self, isolated_state):
+        job_id = _add_job()
+        state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+        state.begin_intent('jobs.terminate', {
+            'job_id': job_id, 'cluster_name': 'rjob-cl',
+            'final_status': 'CANCELLED'})
+        ctrl = jobs_controller.JobsController(job_id, check_gap=0.1)
+        assert ctrl.reconcile_on_start() is None
+        job = state.get_job(job_id)
+        # The journaled final status lands even though the process that
+        # decided it is gone.
+        assert job['status'] is state.ManagedJobStatus.CANCELLED
+        assert state.open_intents() == []
+
+    def test_terminal_job_drops_stale_intents(self, isolated_state):
+        job_id = _add_job()
+        state.set_status(job_id, state.ManagedJobStatus.SUCCEEDED)
+        state.begin_intent('jobs.launch', {
+            'job_id': job_id, 'cluster_name': 'rjob-cl'})
+        ctrl = jobs_controller.JobsController(job_id, check_gap=0.1)
+        assert ctrl.reconcile_on_start() is None
+        assert state.open_intents() == []
+
+    def test_reconcile_disabled_leaves_journal(self, isolated_state,
+                                               monkeypatch):
+        monkeypatch.setenv('SKYTPU_RECONCILE_ON_START', '0')
+        job_id = _add_job()
+        state.set_status(job_id, state.ManagedJobStatus.STARTING)
+        state.begin_intent('jobs.launch', {
+            'job_id': job_id, 'cluster_name': 'rjob-cl'})
+        ctrl = jobs_controller.JobsController(job_id, check_gap=0.1)
+        assert ctrl.reconcile_on_start() is None
+        assert len(state.open_intents()) == 1
+
+
+def _serve_fixture(tmp_path, monkeypatch, name='rsvc'):
+    monkeypatch.setenv('SKYTPU_SERVE_DB', str(tmp_path / 'serve.db'))
+    spec = ServiceSpec(min_replicas=1, replica_port=19080)
+    task_config = {'name': name, 'run': 'true',
+                   'resources': {'cloud': 'local'}}
+    serve_state.add_service(name, spec_json=json.dumps(
+        spec.to_yaml_config()), task_json=json.dumps(task_config),
+        lb_port=0)
+    return ReplicaManager(name, spec, task_config)
+
+
+class TestServeReconcileUnits:
+
+    def test_scale_up_intent_no_cluster_rolls_back(self, isolated_state,
+                                                   monkeypatch):
+        manager = _serve_fixture(isolated_state, monkeypatch)
+        rid = serve_state.next_replica_id('rsvc')
+        serve_state.add_replica(
+            'rsvc', rid, f'rsvc-replica-{rid}', intent_payload={
+                'service': 'rsvc', 'replica_id': rid,
+                'cluster_name': f'rsvc-replica-{rid}'})
+        actions = manager.reconcile_on_start()
+        assert actions == {'roll_back': 1}
+        # Row released; the autoscaler will mint a FRESH replica id —
+        # the dead launch's id is never reused against a half-built
+        # cluster.
+        assert serve_state.get_replicas('rsvc') == []
+        assert serve_state.open_intents() == []
+
+    def test_scale_down_intent_rolls_forward(self, isolated_state,
+                                             monkeypatch):
+        manager = _serve_fixture(isolated_state, monkeypatch)
+        rid = serve_state.next_replica_id('rsvc')
+        serve_state.add_replica('rsvc', rid, f'rsvc-replica-{rid}')
+        serve_state.mark_shutting_down('rsvc', rid, {
+            'service': 'rsvc', 'replica_id': rid,
+            'cluster_name': f'rsvc-replica-{rid}'})
+        actions = manager.reconcile_on_start()
+        assert actions == {'roll_forward': 1}
+        # Teardown resumes in the background; at quiesce the row and
+        # the journal are both gone.
+        _wait(lambda: serve_state.get_replicas('rsvc') == [], 30,
+              'replica row removal')
+        _wait(lambda: serve_state.open_intents() == [], 10,
+              'intent completion')
+
+    def test_orphan_shutting_down_row_resumes_teardown(
+            self, isolated_state, monkeypatch):
+        manager = _serve_fixture(isolated_state, monkeypatch)
+        rid = serve_state.next_replica_id('rsvc')
+        serve_state.add_replica('rsvc', rid, f'rsvc-replica-{rid}')
+        serve_state.set_replica_status('rsvc', rid,
+                                       ReplicaStatus.SHUTTING_DOWN)
+        actions = manager.reconcile_on_start()
+        assert actions == {'roll_forward': 1}
+        _wait(lambda: serve_state.get_replicas('rsvc') == [], 30,
+              'replica row removal')
+
+    def test_orphan_provisioning_row_removed(self, isolated_state,
+                                             monkeypatch):
+        manager = _serve_fixture(isolated_state, monkeypatch)
+        rid = serve_state.next_replica_id('rsvc')
+        serve_state.add_replica('rsvc', rid, f'rsvc-replica-{rid}')
+        serve_state.set_replica_status('rsvc', rid,
+                                       ReplicaStatus.PROVISIONING)
+        actions = manager.reconcile_on_start()
+        assert actions == {'orphan': 1}
+        assert serve_state.get_replicas('rsvc') == []
+
+    def test_ready_rows_untouched(self, isolated_state, monkeypatch):
+        manager = _serve_fixture(isolated_state, monkeypatch)
+        rid = serve_state.next_replica_id('rsvc')
+        serve_state.add_replica('rsvc', rid, f'rsvc-replica-{rid}')
+        serve_state.set_replica_status('rsvc', rid, ReplicaStatus.READY,
+                                       url='http://127.0.0.1:1')
+        assert manager.reconcile_on_start() == {}
+        assert serve_state.get_replicas('rsvc')[0]['status'] is \
+            ReplicaStatus.READY
+
+
+# ============================================ subprocess round trips
+
+
+def _local_task(name, run):
+    task = task_lib.Task(name, run=run)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    return task
+
+
+def _wait_terminal(job_id, timeout=120):
+    return _wait(
+        lambda: (state.get_job(job_id)
+                 if state.get_job(job_id)['status'].is_terminal()
+                 else None),
+        timeout, f'job {job_id} terminal')
+
+
+def _crash_then_recover_job(tmp_path, site, *, restart_via_queue=True):
+    """Arm one crash fault at ``site``, submit a job whose run command
+    counts its executions, wait for the controller to die there,
+    restart, and return the finished job record."""
+    marker = tmp_path / 'runs'
+    task = _local_task('cjob', f'echo x >> {marker}')
+    with fault_injection.fault_plan(
+            faults=[{'site': site, 'kind': 'crash'}],
+            record=str(tmp_path / 'faults.jsonl')):
+        job_id = jobs_core.launch(task, controller_check_gap=0.4)
+        pid = _wait(
+            lambda: state.get_job(job_id).get('controller_pid'), 30,
+            'controller pid')
+        _wait(lambda: _pid_dead(pid), 90, f'controller crash at {site}')
+    # The crash really happened at the armed site.
+    records = [json.loads(line) for line in
+               (tmp_path / 'faults.jsonl').read_text().splitlines()]
+    assert [r['site'] for r in records] == [site]
+    # Restart — the fault plan env is gone (fault_plan() restored it),
+    # so the relaunched controller runs clean.
+    if restart_via_queue:
+        jobs_core.queue(refresh=True)
+    else:
+        jobs_core.spawn_controller(job_id)
+    job = _wait_terminal(job_id)
+    runs = (marker.read_text().count('x')
+            if marker.exists() else 0)
+    return job, runs
+
+
+@pytest.mark.parametrize('site', [
+    'jobs.controller.launch.pre_provision',
+    'jobs.controller.launch.post_provision',
+])
+def test_jobs_controller_killed_mid_launch_recovers(
+        isolated_state, site):
+    """SIGKILL-at-instruction on either side of provisioning, restart
+    via the scheduler's dead-controller relaunch: the job must reach
+    SUCCEEDED having run EXACTLY once (pre: roll back + relaunch;
+    post: adopt the live cluster — no double-launch), with an empty
+    intent journal and no leftover cluster."""
+    job, runs = _crash_then_recover_job(isolated_state, site)
+    assert job['status'] is state.ManagedJobStatus.SUCCEEDED, job
+    assert runs == 1
+    assert state.open_intents() == []
+    assert global_user_state.get_clusters() == []
+    assert job['controller_restarts'] == 1
+
+
+def test_serve_controller_killed_post_launch_adopts(
+        isolated_state, monkeypatch):
+    """Kill the serve controller right after a replica cluster launch
+    (before the STARTING commit); a restarted controller must ADOPT
+    the live cluster — same replica id, exactly one cluster, READY
+    service, empty journal."""
+    from skypilot_tpu.serve import core as serve_core
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_LOG_DIR',
+                       str(isolated_state / 'serve_logs'))
+    task = _local_task(
+        'csvc',
+        'python -c "import http.server, os; '
+        "http.server.HTTPServer(('127.0.0.1', "
+        "int(os.environ['SKYTPU_SERVE_PORT'])), "
+        'http.server.SimpleHTTPRequestHandler).serve_forever()"')
+    task.service = ServiceSpec(min_replicas=1, replica_port=19180,
+                               initial_delay_seconds=120,
+                               readiness_timeout_seconds=3)
+    with fault_injection.fault_plan(
+            faults=[{'site': 'serve.scale_up.post_launch',
+                     'kind': 'crash'}],
+            record=str(isolated_state / 'faults.jsonl')):
+        serve_core.up(task, 'csvc', controller_loop_gap=0.5)
+        pid = serve_state.get_service('csvc')['controller_pid']
+        _wait(lambda: _pid_dead(pid), 90, 'serve controller crash')
+    assert len(serve_state.open_intents('csvc')) == 1
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, '-u', '-m', 'skypilot_tpu.serve.controller',
+         'csvc', '--loop-gap', '0.5'],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+    try:
+        _wait(
+            lambda: any(r['status'] is ReplicaStatus.READY
+                        for r in serve_state.get_replicas('csvc')),
+            90, 'adopted replica READY')
+        replicas = serve_state.get_replicas('csvc')
+        assert [r['replica_id'] for r in replicas] == [1]
+        clusters = [c['name'] for c in global_user_state.get_clusters()]
+        assert clusters == ['csvc-replica-1']
+        assert serve_state.open_intents() == []
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+        serve_core.down('csvc', purge=True)
+    assert global_user_state.get_clusters() == []
+
+
+# -------------------------------------------------------- slow sweeps
+
+
+@pytest.mark.slow
+def test_jobs_controller_killed_mid_recovery(isolated_state):
+    """Preempt the cluster, then kill the controller mid-recovery
+    (after the recover intent, before the relaunch); the restarted
+    controller rolls the half-done recovery back and relaunches."""
+    from skypilot_tpu.provision.local import instance as local_instance
+    from skypilot_tpu.utils import common_utils
+    marker = isolated_state / 'second'
+    task = _local_task(
+        'precrash',
+        f'if [ -f {marker} ]; then echo done; else sleep 120; fi')
+    task.set_resources(
+        resources_lib.Resources(cloud='local', use_spot=True))
+    with fault_injection.fault_plan(
+            faults=[{'site': 'jobs.controller.recover.mid',
+                     'kind': 'crash'}],
+            record=str(isolated_state / 'faults.jsonl')):
+        job_id = jobs_core.launch(task, controller_check_gap=0.4)
+        job = _wait(
+            lambda: (state.get_job(job_id) if state.get_job(job_id)
+                     ['status'] is state.ManagedJobStatus.RUNNING
+                     else None), 90, 'job RUNNING')
+        marker.write_text('x')
+        pid = job['controller_pid']
+        local_instance.preempt(
+            common_utils.make_cluster_name_on_cloud(
+                job['cluster_name']))
+        _wait(lambda: _pid_dead(pid), 120, 'crash at recover.mid')
+    jobs_core.queue(refresh=True)
+    job = _wait_terminal(job_id, timeout=180)
+    assert job['status'] is state.ManagedJobStatus.SUCCEEDED, job
+    assert job['recovery_count'] >= 1
+    assert state.open_intents() == []
+    assert global_user_state.get_clusters() == []
+
+
+@pytest.mark.slow
+def test_serve_controller_killed_mid_scale_down_rolls_forward(
+        isolated_state, monkeypatch):
+    """Bring up 2 replicas, downscale to 1 with a crash armed inside
+    the scale-down (post-drain / pre-terminate), restart: the
+    announced teardown must roll FORWARD — exactly one replica and one
+    cluster remain, journal empty."""
+    from skypilot_tpu.serve import core as serve_core
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_LOG_DIR',
+                       str(isolated_state / 'serve_logs'))
+
+    def make_task(replicas):
+        task = _local_task(
+            'dsvc',
+            'python -c "import http.server, os; '
+            "http.server.HTTPServer(('127.0.0.1', "
+            "int(os.environ['SKYTPU_SERVE_PORT'])), "
+            'http.server.SimpleHTTPRequestHandler).serve_forever()"')
+        task.service = ServiceSpec(min_replicas=replicas,
+                                   replica_port=19280,
+                                   initial_delay_seconds=120,
+                                   readiness_timeout_seconds=3)
+        return task
+
+    try:
+        # The fault plan must be in the CONTROLLER's environment at
+        # spawn; the spec stays dormant until a scale-down happens.
+        with fault_injection.fault_plan(
+                faults=[{'site': 'serve.scale_down.pre_terminate',
+                         'kind': 'crash'}],
+                record=str(isolated_state / 'faults.jsonl')):
+            serve_core.up(make_task(2), 'dsvc',
+                          controller_loop_gap=0.5)
+            _wait(
+                lambda: sum(1 for r in serve_state.get_replicas('dsvc')
+                            if r['status'] is ReplicaStatus.READY) >= 2,
+                120, 'both replicas READY')
+            pid = serve_state.get_service('dsvc')['controller_pid']
+            # Trigger the downscale via a rolling update to
+            # min_replicas=1.
+            serve_core.update(make_task(1), 'dsvc')
+            _wait(lambda: _pid_dead(pid), 180,
+                  'crash at scale_down.pre_terminate')
+        assert len(serve_state.open_intents('dsvc')) >= 1
+        proc = subprocess.Popen(
+            [sys.executable, '-u', '-m',
+             'skypilot_tpu.serve.controller', 'dsvc',
+             '--loop-gap', '0.5'],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=dict(os.environ))
+        try:
+            _wait(
+                lambda: (serve_state.open_intents() == [] and
+                         len(serve_state.get_replicas('dsvc')) == 1 and
+                         len(global_user_state.get_clusters()) == 1),
+                180, 'roll-forward convergence to 1 replica')
+            replicas = serve_state.get_replicas('dsvc')
+            clusters = [c['name']
+                        for c in global_user_state.get_clusters()]
+            assert clusters == [
+                f'dsvc-replica-{replicas[0]["replica_id"]}']
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+    finally:
+        serve_core.down('dsvc', purge=True)
+    assert global_user_state.get_clusters() == []
+
+
+@pytest.mark.slow
+def test_randomized_crash_sweep(isolated_state):
+    """Randomized full sweep of the jobs-flow crashpoints: seeded-
+    random site order, check gaps, and restart paths — every round
+    trip must land on the same invariants (terminal job, exactly one
+    run, empty journal, zero clusters). The serve-flow and
+    statedb-commit crashpoints get the same treatment in their own
+    round-trip tests above / in test_statedb.py."""
+    rng = random.Random(int(os.environ.get('PYTEST_SEED', '7')))
+    sites = [
+        'jobs.controller.launch.pre_provision',
+        'jobs.controller.launch.post_provision',
+    ] * 2
+    rng.shuffle(sites)
+    for index, site in enumerate(sites):
+        tmp = isolated_state / f'sweep{index}'
+        tmp.mkdir()
+        job, runs = _crash_then_recover_job(
+            tmp, site, restart_via_queue=bool(rng.getrandbits(1)))
+        assert job['status'] is state.ManagedJobStatus.SUCCEEDED, (site,
+                                                                   job)
+        assert runs == 1, site
+        assert state.open_intents() == []
+        assert global_user_state.get_clusters() == [], site

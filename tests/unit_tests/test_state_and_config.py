@@ -39,6 +39,42 @@ def test_cluster_crud():
                for r in global_user_state.get_cluster_history())
 
 
+def test_corrupt_handle_blob_degrades_not_crashes():
+    """A torn write (crashed process / partial page before the WAL
+    migration) can truncate a pickled handle; every list()/status call
+    must keep working with that row degraded to handle=None instead of
+    raising (docs/crash_recovery.md)."""
+    import pickle
+    global_user_state.add_or_update_cluster('good', FakeHandle('good'),
+                                            requested_resources=set())
+    global_user_state.add_or_update_cluster('torn', FakeHandle('torn'),
+                                            requested_resources=set())
+    blob = pickle.dumps(FakeHandle('torn'))
+    conn = global_user_state._conn()
+    conn.execute('UPDATE clusters SET handle=? WHERE name=?',
+                 (blob[:len(blob) // 2], 'torn'))
+    rows = {r['name']: r for r in global_user_state.get_clusters()}
+    assert rows['torn']['handle'] is None
+    assert rows['torn']['status'] is status_lib.ClusterStatus.INIT
+    assert rows['good']['handle'].cluster_name == 'good'
+    # Status refresh degrades too (no cloud to ask without a handle).
+    from skypilot_tpu.backend import backend_utils
+    rec = backend_utils.refresh_cluster_record('torn',
+                                               force_refresh=True)
+    assert rec is not None and rec['handle'] is None
+
+
+def test_corrupt_usage_intervals_degrade():
+    global_user_state.add_or_update_cluster('c9', FakeHandle('c9'),
+                                            requested_resources=set())
+    conn = global_user_state._conn()
+    conn.execute('UPDATE cluster_history SET usage_intervals=? '
+                 'WHERE name=?', (b'\x80garbage', 'c9'))
+    history = global_user_state.get_cluster_history()
+    row = next(r for r in history if r['name'] == 'c9')
+    assert row['usage_intervals'] == [] and row['duration'] == 0
+
+
 def test_autostop_preserved_across_update():
     h = FakeHandle('c2')
     global_user_state.add_or_update_cluster('c2', h)

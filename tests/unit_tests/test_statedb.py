@@ -1,0 +1,212 @@
+"""statedb unit suite (docs/crash_recovery.md): connection recipe,
+transaction atomicity (including under a crash at the commit
+crashpoints, in a real subprocess), and intent-journal semantics."""
+import json
+import os
+import sqlite3
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from skypilot_tpu.utils import statedb
+
+pytestmark = pytest.mark.crashrec
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ----------------------------------------------------------- connection
+
+
+def test_connect_applies_the_recipe(tmp_path):
+    conn = statedb.connect(str(tmp_path / 'x.db'))
+    assert conn.execute('PRAGMA journal_mode').fetchone()[0] == 'wal'
+    assert conn.execute('PRAGMA busy_timeout').fetchone()[0] == \
+        statedb.BUSY_TIMEOUT_MS
+    # synchronous=NORMAL is 1.
+    assert conn.execute('PRAGMA synchronous').fetchone()[0] == 1
+    # Autocommit: single statements are durable immediately, no
+    # implicit transaction is ever open.
+    conn.execute('CREATE TABLE t (x)')
+    conn.execute("INSERT INTO t VALUES (1)")
+    assert not conn.in_transaction
+    other = statedb.connect(str(tmp_path / 'x.db'))
+    assert other.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 1
+
+
+def test_connect_creates_parent_dirs(tmp_path):
+    path = str(tmp_path / 'deep' / 'er' / 'x.db')
+    statedb.connect(path).execute('CREATE TABLE t (x)')
+    assert os.path.exists(path)
+
+
+# ---------------------------------------------------------- transaction
+
+
+def test_transaction_commits_atomically(tmp_path):
+    conn = statedb.connect(str(tmp_path / 'x.db'))
+    conn.execute('CREATE TABLE t (x)')
+    with statedb.transaction(conn) as c:
+        c.execute("INSERT INTO t VALUES (1)")
+        c.execute("INSERT INTO t VALUES (2)")
+        # Not yet visible to a second connection mid-transaction.
+        other = statedb.connect(str(tmp_path / 'x.db'))
+        assert other.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 0
+    assert other.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 2
+
+
+def test_transaction_rolls_back_on_exception(tmp_path):
+    conn = statedb.connect(str(tmp_path / 'x.db'))
+    conn.execute('CREATE TABLE t (x)')
+    with pytest.raises(RuntimeError):
+        with statedb.transaction(conn) as c:
+            c.execute("INSERT INTO t VALUES (1)")
+            raise RuntimeError('boom')
+    assert conn.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 0
+    assert not conn.in_transaction  # connection reusable after rollback
+    with statedb.transaction(conn) as c:
+        c.execute("INSERT INTO t VALUES (3)")
+    assert conn.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 1
+
+
+_CRASH_CHILD = textwrap.dedent('''
+    import sys
+    sys.path.insert(0, sys.argv[2])
+    from skypilot_tpu.utils import statedb
+    conn = statedb.connect(sys.argv[1])
+    conn.execute('CREATE TABLE IF NOT EXISTS t (k TEXT)')
+    statedb.ensure_intent_table(conn)
+    with statedb.transaction(conn, site='test.write') as c:
+        c.execute("INSERT INTO t VALUES ('a')")
+        statedb.begin_intent(c, 'test.op', {'x': 1})
+        c.execute("INSERT INTO t VALUES ('b')")
+''')
+
+
+@pytest.mark.parametrize('site,rows,intents', [
+    # kill -9 one instruction BEFORE the commit: the whole transaction
+    # (state rows AND intent record) vanishes — never half of it.
+    ('statedb.commit.pre', 0, 0),
+    # one instruction AFTER: everything is durable, including the
+    # intent a restarted process will reconcile.
+    ('statedb.commit.post', 2, 1),
+])
+def test_commit_crashpoint_atomicity(tmp_path, site, rows, intents):
+    db = str(tmp_path / 'atomic.db')
+    env = dict(os.environ)
+    env['SKYTPU_FAULT_PLAN'] = json.dumps({'faults': [{
+        'site': site, 'kind': 'crash', 'match': {'db': 'test.write'}}]})
+    proc = subprocess.run(
+        [sys.executable, '-c', _CRASH_CHILD, db, _REPO_ROOT],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 13, (proc.returncode, proc.stderr)
+    conn = sqlite3.connect(db)
+    assert conn.execute('SELECT COUNT(*) FROM t').fetchone()[0] == rows
+    assert conn.execute(
+        'SELECT COUNT(*) FROM intents').fetchone()[0] == intents
+    conn.close()
+    # Restart: a clean process against the hard-killed database (its
+    # WAL may still hold the crashed writer's frames) must open and
+    # transact normally — crash recovery IS sqlite's startup path too.
+    env.pop('SKYTPU_FAULT_PLAN')
+    proc = subprocess.run(
+        [sys.executable, '-c', _CRASH_CHILD, db, _REPO_ROOT],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    conn = sqlite3.connect(db)
+    assert conn.execute(
+        'SELECT COUNT(*) FROM t').fetchone()[0] == rows + 2
+    assert conn.execute(
+        'SELECT COUNT(*) FROM intents').fetchone()[0] == intents + 1
+
+
+# --------------------------------------------------------------- intents
+
+
+def test_intent_begin_complete_replay_ordering(tmp_path):
+    conn = statedb.connect(str(tmp_path / 'x.db'))
+    statedb.ensure_intent_table(conn)
+    with statedb.transaction(conn) as c:
+        first = statedb.begin_intent(c, 'jobs.launch', {'job_id': 1})
+        second = statedb.begin_intent(c, 'jobs.recover', {'job_id': 2})
+        third = statedb.begin_intent(c, 'serve.scale_up', {'r': 3})
+    opened = statedb.open_intents(conn)
+    # Replay order is begin order (oldest first): recovery re-applies
+    # operations in the order the dead process attempted them.
+    assert [i['intent_id'] for i in opened] == [first, second, third]
+    assert [i['kind'] for i in opened] == [
+        'jobs.launch', 'jobs.recover', 'serve.scale_up']
+    assert opened[0]['payload'] == {'job_id': 1}
+    assert opened[0]['pid'] == os.getpid()
+    # Prefix filtering selects one controller family's journal.
+    assert [i['kind'] for i in statedb.open_intents(conn, 'jobs.*')] == \
+        ['jobs.launch', 'jobs.recover']
+    assert [i['kind'] for i in statedb.open_intents(conn,
+                                                    'serve.scale_up')] == \
+        ['serve.scale_up']
+    with statedb.transaction(conn) as c:
+        statedb.complete_intent(c, second)
+    assert [i['intent_id'] for i in statedb.open_intents(conn)] == \
+        [first, third]
+
+
+def test_intent_torn_payload_degrades(tmp_path):
+    conn = statedb.connect(str(tmp_path / 'x.db'))
+    statedb.ensure_intent_table(conn)
+    conn.execute(
+        "INSERT INTO intents (kind, payload, created_at, pid) "
+        "VALUES ('jobs.launch', '{\"job', 0, 0)")
+    opened = statedb.open_intents(conn)
+    assert len(opened) == 1
+    assert opened[0]['payload'] == {}  # degraded, not crashed
+
+
+# --------------------------------------------------------------- StateDB
+
+
+def test_statedb_init_runs_once_and_tracks_env(tmp_path, monkeypatch):
+    calls = []
+
+    def init(conn):
+        calls.append(1)
+        conn.execute('CREATE TABLE IF NOT EXISTS t (x)')
+
+    monkeypatch.setenv('SKYTPU_TEST_DB', str(tmp_path / 'a.db'))
+    db = statedb.StateDB(
+        lambda: os.environ['SKYTPU_TEST_DB'], init_fn=init,
+        site='test.write')
+    with db.transaction() as conn:
+        conn.execute("INSERT INTO t VALUES (1)")
+    with db.reader() as conn:
+        assert conn.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 1
+    assert calls == [1]
+    # A re-pointed env var (fresh test DB) re-runs DDL for the new
+    # path; the old path stays initialized.
+    monkeypatch.setenv('SKYTPU_TEST_DB', str(tmp_path / 'b.db'))
+    with db.reader() as conn:
+        assert conn.execute('SELECT COUNT(*) FROM t').fetchone()[0] == 0
+    assert calls == [1, 1]
+
+
+def test_statedb_intent_convenience_roundtrip(tmp_path):
+    db = statedb.StateDB(lambda: str(tmp_path / 'a.db'),
+                         site='test.write')
+    intent_id = db.begin_intent('serve.scale_up', {'replica_id': 7})
+    assert [i['payload'] for i in db.open_intents()] == \
+        [{'replica_id': 7}]
+    db.complete_intent(intent_id)
+    assert db.open_intents() == []
+
+
+def test_busy_writer_retried_through_retry_policy(tmp_path, monkeypatch):
+    """A held write lock surfaces as SQLITE_BUSY on BEGIN IMMEDIATE;
+    the transaction() path must classify it retryable (the site's
+    RetryPolicy owns backoff + metrics)."""
+    policy = statedb._retry_policy('test.retry.write')
+    assert policy.is_retryable(sqlite3.OperationalError('locked'))
+    assert not policy.is_retryable(ValueError('nope'))
+    # Same site -> same policy instance (metrics series stay stable).
+    assert statedb._retry_policy('test.retry.write') is policy
