@@ -434,11 +434,34 @@ def serve_bench():
     prefill_budget = (int(os.environ['BENCH_SERVE_PREFILL_BUDGET'])
                       if os.environ.get('BENCH_SERVE_PREFILL_BUDGET')
                       else None)
+    # Engine page size (decode paged dispatch AND prefix-cache block
+    # granularity); None -> the engine's SKYTPU_DECODE_PAGE default.
+    page = (int(os.environ['BENCH_SERVE_PAGE'])
+            if os.environ.get('BENCH_SERVE_PAGE') else None)
+    # Shared-prefix workload (ROADMAP item 5's first brick): Zipf-
+    # distributed prefix reuse over a configurable prefix pool, with
+    # the engine's automatic prefix cache enabled — the traffic shape
+    # real chat/agent load has. Default on under BENCH_SMOKE (the
+    # subprocess smoke tests guard the flags), off otherwise until a
+    # round opts in (bench.py all runs the serve_prefix mode).
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    prefix_on = os.environ.get(
+        'BENCH_SERVE_PREFIX', '1' if smoke else '0') == '1'
     if not on_tpu:
         n_requests, batch, max_prompt, max_new = 6, 2, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
         max_seq = 128
         wquant = False
+        if prefix_on:
+            # Tiny-shape knob floors so the prefix workload really
+            # hits: the default 128-token page/chunk exceed the whole
+            # 64-token smoke prompt (every lookup would round to zero
+            # reuse). Scoped to the prefix workload — with
+            # BENCH_SERVE_PREFIX=0 the smoke serve config stays
+            # exactly what earlier rounds measured.
+            page = page or 16
+            prefill_chunk = prefill_chunk or 16
+            prefill_budget = prefill_budget or 32
     else:
         # Decode region = 4x max_new: slots recycle ~4 requests per
         # cache round before a reset.
@@ -483,13 +506,48 @@ def serve_bench():
                            kv_quant=kv_quant, weight_quant=wquant,
                            decode_chunk=chunk,
                            prefill_chunk=prefill_chunk,
-                           prefill_budget=prefill_budget)
+                           prefill_budget=prefill_budget,
+                           page=page,
+                           prefix_cache=True if prefix_on else None,
+                           prefix_pool_pages=(
+                               int(os.environ['BENCH_SERVE_PREFIX_PAGES'])
+                               if os.environ.get('BENCH_SERVE_PREFIX_PAGES')
+                               else None))
     rng = np.random.default_rng(0)
     reqs = []
-    for i in range(n_requests):
-        plen = int(rng.integers(max_prompt // 4, max_prompt))
-        toks = list(rng.integers(0, cfg.vocab_size, plen))
-        reqs.append(Request(i, toks, max_new=max_new))
+    if prefix_on:
+        # Zipf-ranked prefix popularity: request i draws one of
+        # n_prefixes shared prefixes with p(rank) ~ rank^-s, then a
+        # fresh random suffix — multi-turn/system-prompt traffic in
+        # miniature. The first request per prefix misses and
+        # publishes; the rest hit.
+        n_prefixes = max(1, int(os.environ.get(
+            'BENCH_SERVE_PREFIX_POOL', '2' if smoke else '8')))
+        plen_prefix = int(os.environ.get(
+            'BENCH_SERVE_PREFIX_LEN',
+            str(max(1, (3 * max_prompt) // 4))))
+        plen_prefix = max(1, min(plen_prefix, max_prompt - 1))
+        zipf_s = float(os.environ.get('BENCH_SERVE_PREFIX_ZIPF',
+                                      '1.1'))
+        prefixes = [
+            [int(t) for t in rng.integers(0, cfg.vocab_size,
+                                          plen_prefix)]
+            for _ in range(n_prefixes)]
+        weights = np.arange(1, n_prefixes + 1,
+                            dtype=np.float64) ** -zipf_s
+        weights /= weights.sum()
+        for i in range(n_requests):
+            pfx = prefixes[int(rng.choice(n_prefixes, p=weights))]
+            slen = int(rng.integers(
+                1, max(2, max_prompt - plen_prefix)))
+            toks = pfx + [int(t) for t in
+                          rng.integers(0, cfg.vocab_size, slen)]
+            reqs.append(Request(i, toks, max_new=max_new))
+    else:
+        for i in range(n_requests):
+            plen = int(rng.integers(max_prompt // 4, max_prompt))
+            toks = list(rng.integers(0, cfg.vocab_size, plen))
+            reqs.append(Request(i, toks, max_new=max_new))
 
     # Compile all programs outside the timed window (a second engine
     # would double HBM, so warm the same one).
@@ -569,6 +627,12 @@ def serve_bench():
                 'ticks': engine.prefill_ticks,
                 'max_tick_tokens': engine.max_tick_prefill_tokens,
             },
+            # Prefix-cache accounting (PERFORMANCE.md "Prefix-reuse
+            # KV cache"): hit_rate * tokens_saved is the prefill the
+            # pool is absorbing; occupied/pool_pages is occupancy.
+            'prefix': ({'enabled': True, **engine.prefix.stats()}
+                       if engine.prefix is not None
+                       else {'enabled': False}),
             # The engine's own ops counters (tokens, TTFT + ITL
             # histograms, prefill-token counter, cache resets) from
             # THIS run: the perf trajectory and the serving metrics
@@ -734,6 +798,9 @@ _ALL_MODES = {
     'serve_moe_w8': {'BENCH_MODE': 'serve',
                      'BENCH_SERVE_MODEL': 'tpu_moe_1b',
                      'BENCH_SERVE_WQUANT': '1'},
+    # Shared-prefix (Zipf) workload with the prefix cache on: the
+    # hit-rate / tokens-saved / pool-occupancy numbers for the round.
+    'serve_prefix': {'BENCH_MODE': 'serve', 'BENCH_SERVE_PREFIX': '1'},
     'serve_stack': {'BENCH_MODE': 'serve_stack'},
 }
 

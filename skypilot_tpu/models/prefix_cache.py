@@ -1,0 +1,439 @@
+"""Automatic prefix caching for the serving engine: block-hash page
+pool with copy-on-write sharing (vLLM's automatic prefix caching /
+SGLang's RadixAttention, on our paged KV substrate).
+
+Real chat/agent traffic is dominated by shared system prompts and
+multi-turn history, yet without this every request re-prefills from
+token 0 and admission charges the full ``ceil(prompt/chunk)`` ticks.
+This module keeps a device-resident pool of **KV pages** keyed by a
+**chain hash** of the prompt token blocks they were computed from: a
+page's key commits to its WHOLE prefix (hash(page_i) folds in
+hash(page_{i-1})), so a hash hit means the page's K/V are exactly
+what this request's own prefill would compute for those positions.
+
+Sharing model (the copy-on-write discipline):
+
+- Pool pages are **immutable once published**. An admission hit
+  copies the matched pages into the slot's private prompt-region KV
+  (fixed-shape jitted copy — never a new traced shape); the slot's
+  chunked prefill then resumes at the cached boundary. The writer
+  only ever touches its own row, so a sharer's pages can never be
+  corrupted — the "copy" IS the write barrier, taken eagerly at the
+  first divergent token (the page where the chain hash stops
+  matching).
+- Pages a slot copied in stay **pinned** (refcounted) until the
+  request reaches a terminal state, so eviction can never recycle a
+  page an in-flight request may still need republished.
+- A completed (or cancelled/expired) slot **publishes** its now-final
+  full prompt pages back to the pool and releases its pins; pages
+  already present are deduplicated by hash.
+- Eviction is LRU over **unpinned** pages only; when every page is
+  pinned, publishing degrades gracefully (the pool just misses).
+
+Bitwise-parity discipline: the reuse boundary is rounded DOWN to a
+multiple of the engine's ``prefill_chunk``, so the uncached suffix
+prefills with exactly the chunk starts a cache-off run would use.
+Published pages were themselves computed at those canonical chunk
+starts (inductively: a publisher's own reuse boundary was aligned
+too), so greedy decode over a cache-hit prompt is bit-identical to
+the cache-off path. The last token of a prompt is never served from
+the pool — at least one suffix token always prefills, producing the
+first-token logits through the already-warmed chunk program.
+
+Threading: all mutation (acquire/publish/evict) happens on the
+engine's driver thread; ``reusable_tokens`` is a pure read safe to
+call from HTTP threads (the deadline-shed estimate).
+
+Knobs: ``SKYTPU_PREFIX_CACHE`` (set to 1 to enable; off means the
+engine is bit-identical to a build without this module) and
+``SKYTPU_PREFIX_POOL_PAGES`` (pool size; at the engine's page size).
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# Default pool size in pages (SKYTPU_PREFIX_POOL_PAGES overrides): at
+# the default 128-token page and an 8B int8 KV shape this is ~100 MB
+# of HBM — roughly 4 slots' worth of prompt region buying unbounded
+# cross-request reuse.
+DEFAULT_POOL_PAGES = 512
+
+_M_HITS = metrics_lib.counter(
+    'skytpu_engine_prefix_hits_total',
+    'Admissions that reused at least one cached prompt page from the '
+    'prefix pool (docs/metrics.md; PERFORMANCE.md "Prefix-reuse KV '
+    'cache").')
+_M_SAVED = metrics_lib.counter(
+    'skytpu_engine_prefix_tokens_saved_total',
+    'Prompt tokens served from the prefix pool instead of being '
+    'prefilled (chunk-aligned reuse boundary; rate() of this is the '
+    'prefill compute the cache is saving).')
+_M_POOL = metrics_lib.gauge(
+    'skytpu_engine_prefix_pool_pages',
+    'Occupied pages in the shared prefix pool (capacity is '
+    'SKYTPU_PREFIX_POOL_PAGES).')
+_M_EVICTIONS = metrics_lib.counter(
+    'skytpu_engine_prefix_evictions_total',
+    'Cold (unpinned) prefix pages evicted LRU to make room for a '
+    'newly published page.')
+
+
+def page_hashes(tokens: Sequence[int], page: int) -> List[bytes]:
+    """Chain hash per FULL page of ``tokens``: digest i commits to
+    tokens[0 : (i+1)*page], so equal hashes mean equal whole
+    prefixes — a lookup can never alias two prompts that share a
+    block but diverge earlier."""
+    out: List[bytes] = []
+    prev = b''
+    n_full = len(tokens) // page
+    if not n_full:
+        return out
+    # One fixed-width int32 buffer for the whole hashable region:
+    # ~10x cheaper than per-token str() encoding on the driver's hot
+    # admission path (host-side only — never inside a jit).
+    buf = np.asarray(tokens[:n_full * page], np.int32).tobytes()
+    stride = 4 * page
+    for i in range(n_full):
+        d = hashlib.blake2b(prev, digest_size=16)
+        d.update(buf[i * stride:(i + 1) * stride])
+        prev = d.digest()
+        out.append(prev)
+    return out
+
+
+class PrefixCache:
+    """Device-resident shared page pool + host-side hash directory.
+
+    The pool holds ``pool_pages`` pages of ``page`` token positions
+    each, laid out ``[n_layers, pool_pages, page, n_kv, head_dim]``
+    (+ per-vector scale planes for int8 KV caches, so
+    ``quantization.quantize_kv`` composes — pages are copied in the
+    cache's native dtype, never dequantized). All device work is
+    three fixed-shape jitted programs (page copy-in, page copy-out,
+    dmask/length fix) whose indices are traced scalars: warmed once,
+    they serve every slot/page combination with zero recompiles.
+    """
+
+    def __init__(self, cfg, *, page: int, pool_pages: int,
+                 kv_quant: bool = False) -> None:
+        if page < 1:
+            raise ValueError(f'page ({page}) must be positive')
+        if pool_pages < 1:
+            raise ValueError(
+                f'pool_pages ({pool_pages}) must be positive')
+        self.page = int(page)
+        self.pool_pages = int(pool_pages)
+        kv_dtype = jnp.int8 if kv_quant else cfg.compute_dtype
+        shape = (cfg.n_layers, self.pool_pages, self.page,
+                 cfg.n_kv_heads, cfg.head_dim)
+        self._fields: Tuple[str, ...] = ('k', 'v')
+        pool = {'k': jnp.zeros(shape, kv_dtype),
+                'v': jnp.zeros(shape, kv_dtype)}
+        if kv_quant:
+            self._fields += ('k_scale', 'v_scale')
+            pool['k_scale'] = jnp.ones(shape[:4], jnp.bfloat16)
+            pool['v_scale'] = jnp.ones(shape[:4], jnp.bfloat16)
+        self.pool = pool
+
+        # Host directory: hash -> pool page index, plus per-page
+        # refcounts (pins), LRU stamps and the free list. Mutated only
+        # on the engine driver thread; read-only lookups
+        # (reusable_tokens) are safe from other threads.
+        self._by_hash: Dict[bytes, int] = {}
+        self._hash_of: List[Optional[bytes]] = [None] * self.pool_pages
+        self._refs: List[int] = [0] * self.pool_pages
+        self._stamp: List[int] = [0] * self.pool_pages
+        self._tick = 0
+        # pop() hands out low indices first (cosmetic determinism).
+        self._free: List[int] = list(range(self.pool_pages - 1, -1, -1))
+        self._pins: Dict[Any, List[int]] = {}
+        # Host-side stats for bench detail (the metric counters carry
+        # the same numbers to scrapes).
+        self.hits = 0
+        self.lookups = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+        # Directory version: bumped whenever the hash->page mapping
+        # changes (publish insertions, evictions). Lookup results are
+        # a pure function of (tokens, version), which is what lets
+        # the engine memoize its per-tick _fits lookup.
+        self.version = 0
+        _M_POOL.touch()
+
+        n_layers = cfg.n_layers
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _copy_in(kv, pool, slot, dst_off, src):
+            """Pool page ``src`` -> cache row ``slot`` at position
+            ``dst_off``. All indices traced: one compiled program
+            serves every (slot, page) pair."""
+            out = dict(kv)
+            for f in self._fields:
+                sizes = (n_layers, 1) + pool[f].shape[2:]
+                blk = lax.dynamic_slice(
+                    pool[f], (0, src) + (0,) * (pool[f].ndim - 2),
+                    sizes)
+                out[f] = lax.dynamic_update_slice(
+                    kv[f], blk,
+                    (0, slot, dst_off) + (0,) * (kv[f].ndim - 3))
+            return out
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _copy_out(kv, pool, slot, src_off, dst):
+            """Cache row ``slot`` page at ``src_off`` -> pool page
+            ``dst`` (publish)."""
+            out = dict(pool)
+            for f in self._fields:
+                sizes = (n_layers, 1) + pool[f].shape[2:]
+                blk = lax.dynamic_slice(
+                    kv[f],
+                    (0, slot, src_off) + (0,) * (kv[f].ndim - 3),
+                    sizes)
+                out[f] = lax.dynamic_update_slice(
+                    pool[f], blk, (0, dst) + (0,) * (pool[f].ndim - 2))
+            return out
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _mask_fix(dmask, length, slot, cached):
+            """After a copy-in: row ``slot`` reads exactly [0, cached)
+            — everything else (the previous occupant's prompt tail and
+            decode slots) becomes unreadable, the same recycling
+            guarantee a first prefill chunk (start == 0) gives, taken
+            over here because a cache-hit prompt's first chunk starts
+            at the cached boundary instead."""
+            s_max = dmask.shape[1]
+            row = (jnp.arange(s_max, dtype=jnp.int32) <
+                   jnp.asarray(cached, jnp.int32))[None]
+            dmask = lax.dynamic_update_slice(dmask, row, (slot, 0))
+            length = length.at[slot].set(
+                jnp.asarray(cached, length.dtype))
+            return dmask, length
+
+        self._copy_in = _copy_in
+        self._copy_out = _copy_out
+        self._mask_fix = _mask_fix
+
+    # --------------------------------------------------------- lookup
+    def _hashes_of(self, tokens: Sequence[int],
+                   holder: Optional[Any] = None) -> List[bytes]:
+        """Chain hashes of ``tokens``, cached on ``holder`` (the
+        engine's Request object) when one is given — estimates and
+        per-tick _fits re-checks then never re-hash a prompt. The
+        cache key is the token list's identity (prompts are immutable
+        after submit); a benign cross-thread race at worst recomputes
+        once."""
+        if holder is not None:
+            cached = getattr(holder, '_prefix_hashes', None)
+            if cached is not None and cached[0] is tokens:
+                return cached[1]
+        out = page_hashes(tokens, self.page)
+        if holder is not None:
+            try:
+                holder._prefix_hashes = (tokens, out)
+            except (AttributeError, TypeError):
+                pass               # slotted/frozen holder: no cache
+        return out
+
+    def match_pages(self, tokens: Sequence[int],
+                    holder: Optional[Any] = None) -> List[int]:
+        """Pool page indices of the longest cached prefix (pure read,
+        cross-thread safe)."""
+        ids: List[int] = []
+        for h in self._hashes_of(tokens, holder):
+            idx = self._by_hash.get(h)
+            if idx is None:
+                break
+            ids.append(idx)
+        return ids
+
+    def _reuse_len(self, n_pages: int, prompt_len: int,
+                   chunk: int) -> int:
+        """Reusable prompt tokens given ``n_pages`` matched pages:
+        capped at prompt_len - 1 (the last token always prefills, so
+        first-token logits come from the warmed chunk program) and
+        rounded DOWN to a ``chunk`` multiple (suffix chunk starts land
+        exactly where a cache-off prefill would put them — the bitwise
+        parity discipline; see the module docstring)."""
+        cap = min(n_pages * self.page, prompt_len - 1)
+        return max(0, (cap // max(1, chunk)) * chunk)
+
+    def reusable_tokens(self, tokens: Sequence[int], chunk: int,
+                        holder: Optional[Any] = None) -> int:
+        """How many prompt tokens a lookup NOW would serve from the
+        pool. Pure read: the admission estimate (estimate_wait_s, the
+        deadline shed) calls this from HTTP threads."""
+        return self._reuse_len(len(self.match_pages(tokens, holder)),
+                               len(tokens), chunk)
+
+    # ----------------------------------------------------- admission
+    def acquire(self, request_id: Any, tokens: Sequence[int],
+                chunk: int, holder: Optional[Any] = None
+                ) -> Tuple[int, List[int], List[bytes]]:
+        """Look up the longest cached prefix for an admission and PIN
+        the pages to copy. Returns (reuse_tokens, page_ids,
+        prompt_hashes); reuse of 0 means a miss (no pins held). The
+        hash list covers every full page of the prompt — callers keep
+        it so the terminal ``publish`` never re-hashes. Pins release
+        at the request's terminal state (``release``)."""
+        self.lookups += 1
+        # _hashes_of memoizes on the holder, so the match walk below
+        # reuses the same digests it returns — ONE matching
+        # implementation (match_pages) for _fits, estimates and the
+        # admission itself.
+        hashes = self._hashes_of(tokens, holder)
+        ids = self.match_pages(tokens, holder)
+        reuse = self._reuse_len(len(ids), len(tokens), chunk)
+        if reuse == 0:
+            return 0, [], hashes
+        ids = ids[:-(-reuse // self.page)]
+        for i in ids:
+            self._refs[i] += 1
+            self._touch(i)
+        self._pins[request_id] = list(ids)
+        self.hits += 1
+        self.tokens_saved += reuse
+        _M_HITS.inc()
+        _M_SAVED.inc(reuse)
+        return reuse, ids, hashes
+
+    def release(self, request_id: Any) -> None:
+        """Drop a terminal request's pins (idempotent; misses and
+        queued-only requests hold none)."""
+        for i in self._pins.pop(request_id, ()):
+            self._refs[i] -= 1
+
+    def pinned_pages(self) -> int:
+        return sum(1 for r in self._refs if r > 0)
+
+    def copy_into(self, cache: Dict, slot: int, page_ids: List[int],
+                  cached_len: int) -> Dict:
+        """Copy the acquired pages into ``slot``'s prompt-region KV
+        and mark exactly [0, cached_len) readable. One fixed-shape
+        dispatch per page + the mask fix — all programs warmed by
+        ``warm()``, so a hit never compiles."""
+        sub = {f: cache[f] for f in self._fields}
+        for j, src in enumerate(page_ids):
+            sub = self._copy_in(sub, self.pool, slot, j * self.page,
+                                src)
+        dmask, length = self._mask_fix(cache['dmask'], cache['length'],
+                                       slot, cached_len)
+        out = dict(cache)
+        out.update(sub)
+        out['dmask'] = dmask
+        out['length'] = length
+        return out
+
+    # ------------------------------------------------------- publish
+    def publish(self, tokens: Sequence[int], final_len: int,
+                cache: Dict, slot: int,
+                hashes: Optional[List[bytes]] = None) -> None:
+        """Copy a terminal slot's finalized full prompt pages into the
+        pool (dedup by hash). ``final_len`` is the slot's prefill
+        cursor at the end — a cancel mid-prefill publishes only the
+        pages it actually finished. ``hashes`` (the admission
+        lookup's chain hashes, when the caller kept them) skips
+        re-hashing the prompt on the driver's tick loop. Publishing
+        stops at the first allocation failure (every page in the pool
+        pinned): a chain with a missing link is unreachable anyway."""
+        n_full = min(final_len, len(tokens)) // self.page
+        if n_full == 0:
+            return
+        if hashes is None or len(hashes) < n_full:
+            hashes = page_hashes(tokens[:n_full * self.page],
+                                 self.page)
+        sub = {f: cache[f] for f in self._fields}
+        for i, h in enumerate(hashes[:n_full]):
+            cur = self._by_hash.get(h)
+            if cur is not None:
+                self._touch(cur)
+                continue
+            dst = self._alloc()
+            if dst is None:
+                logger.debug(
+                    'Prefix pool exhausted (all %d pages pinned): '
+                    'skipping publish of %d page(s).', self.pool_pages,
+                    n_full - i)
+                break
+            self.pool = self._copy_out(sub, self.pool, slot,
+                                       i * self.page, dst)
+            self._by_hash[h] = dst
+            self._hash_of[dst] = h
+            self._refs[dst] = 0
+            self._touch(dst)
+            self.version += 1
+        _M_POOL.set(len(self._by_hash))
+
+    def _alloc(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim, best = None, None
+        for i, h in enumerate(self._hash_of):
+            if h is None or self._refs[i] > 0:
+                continue
+            if best is None or self._stamp[i] < best:
+                victim, best = i, self._stamp[i]
+        if victim is None:
+            return None            # every occupied page is pinned
+        del self._by_hash[self._hash_of[victim]]
+        self._hash_of[victim] = None
+        self.evictions += 1
+        self.version += 1
+        _M_EVICTIONS.inc()
+        return victim
+
+    def _touch(self, idx: int) -> None:
+        self._tick += 1
+        self._stamp[idx] = self._tick
+
+    # ------------------------------------------------------ plumbing
+    def warm(self, cache: Dict) -> Dict:
+        """Compile all three programs with dummy indices (engine
+        warmup calls this before its cache reset, so no XLA compile
+        ever lands inside a live admission). Directory state is
+        untouched — page 0 receives garbage the first real publish
+        overwrites before it is ever mapped."""
+        sub = {f: cache[f] for f in self._fields}
+        sub = self._copy_in(sub, self.pool, 0, 0, 0)
+        self.pool = self._copy_out(sub, self.pool, 0, 0, 0)
+        dmask, length = self._mask_fix(cache['dmask'], cache['length'],
+                                       0, 0)
+        out = dict(cache)
+        out.update(sub)
+        out['dmask'] = dmask
+        out['length'] = length
+        return out
+
+    def compile_cache_sizes(self) -> Tuple[int, int, int]:
+        """Compiled-program counts of the three jitted ops (the
+        no-recompile-after-warmup assertion reads these)."""
+        return (self._copy_in._cache_size(),
+                self._copy_out._cache_size(),
+                self._mask_fix._cache_size())
+
+    def stats(self) -> Dict[str, Any]:
+        """Flat summary for bench detail (same numbers the metric
+        counters expose to scrapes)."""
+        return {
+            'page': self.page,
+            'pool_pages': self.pool_pages,
+            'occupied': len(self._by_hash),
+            'pinned': self.pinned_pages(),
+            'lookups': self.lookups,
+            'hits': self.hits,
+            'hit_rate': (round(self.hits / self.lookups, 4)
+                         if self.lookups else None),
+            'tokens_saved': self.tokens_saved,
+            'evictions': self.evictions,
+        }

@@ -25,6 +25,16 @@ what the decode step sustains. This engine recycles slots:
   of log2(max_prompt) bucket programs padded up to 2x.
 - slot validity via the cache's dmask, so a recycled slot never reads
   its previous occupant's K/V;
+- **automatic prefix caching** (``SKYTPU_PREFIX_CACHE=1``;
+  models/prefix_cache.py): prompt token blocks are chain-hashed at
+  page granularity against a device-resident shared page pool. An
+  admission hit copies the longest cached prefix into the slot's
+  prompt-region KV (fixed-shape warmed copy programs — no new traced
+  shapes), starts the prefill cursor at the cached boundary, and
+  charges admission only for the uncached suffix — hits raise
+  effective capacity, not just TTFT. Terminal slots publish their
+  final prompt pages back and release their pins. Off (default) the
+  engine is bit-identical to a build without the cache.
 - optional int8 KV cache (``kv_quant=True``): half the decode
   bandwidth, which at fixed HBM doubles ``batch_size``;
 - double-buffered dispatch: the next-token vector lives on device, so
@@ -135,6 +145,16 @@ _M_TOKEN_LATENCY = metrics_lib.histogram(
     buckets=metrics_lib.FAST_LATENCY_BUCKETS)
 
 
+class DuplicateRequestError(ValueError):
+    """``submit()`` with a request_id already queued or in a slot.
+
+    Admitting the duplicate would clobber the first request's
+    ``_submitted_at``/``_req_spans`` tracking (leaking its open span
+    and corrupting its TTFT) and make result attribution ambiguous —
+    a typed reject lets HTTP front ends map it to a clean 400/409.
+    """
+
+
 @dataclasses.dataclass
 class Request:
     request_id: Any
@@ -172,6 +192,13 @@ class _SlotState:
     # The request's absolute deadline (copied from Request at
     # admission; the tick loop expires past-deadline slots).
     deadline: Optional[float] = None
+    # Prompt tokens served from the prefix pool at admission (0
+    # without the cache / on a miss): the prefill span's chunk count
+    # and the publish path read these instead of recomputing.
+    reused: int = 0
+    # Chain hashes of the prompt's full pages, carried over from the
+    # admission lookup so publish() never re-hashes the prompt.
+    prompt_hashes: Optional[List[bytes]] = None
 
 
 @dataclasses.dataclass
@@ -210,7 +237,9 @@ class ServingEngine:
                  decode_attn: Optional[str] = None,
                  paged_dispatch: bool = True,
                  prefill_chunk: Optional[int] = None,
-                 prefill_budget: Optional[int] = None) -> None:
+                 prefill_budget: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_pool_pages: Optional[int] = None) -> None:
         # ``mesh``: serve a model larger than one chip — params shard
         # Megatron-style (tp on heads/ffn/vocab) and the KV cache's
         # kv-head axis shards over 'tp' (inference.CACHE_SPEC), the
@@ -309,6 +338,33 @@ class ServingEngine:
         self._prefill_rows = max(
             1, min(budget // self.prefill_chunk, batch_size))
         self.prefill_budget = self._prefill_rows * self.prefill_chunk
+        # Automatic prefix caching (SKYTPU_PREFIX_CACHE /
+        # SKYTPU_PREFIX_POOL_PAGES; models/prefix_cache.py): pages are
+        # hashed at the decode-dispatch page size, so the cache unit
+        # and the paged-attention unit stay one concept. Off by
+        # default — disabled, every path below is bit-identical to
+        # the pre-cache engine.
+        enable_prefix = prefix_cache
+        if enable_prefix is None:
+            enable_prefix = env_registry.is_enabled(
+                env_registry.SKYTPU_PREFIX_CACHE)
+        if enable_prefix and mesh is not None:
+            # The pool copy programs are single-device (a sharded
+            # cache would need shard_map plumbing, like the paged
+            # decode kernel) — serve correctness over the feature.
+            logger.warning(
+                'Prefix caching is single-chip only for now: '
+                'disabling it for this mesh-sharded engine.')
+            enable_prefix = False
+        self.prefix = None
+        if enable_prefix:
+            from skypilot_tpu.models import prefix_cache as prefix_mod
+            pool_pages = prefix_pool_pages or int(env_registry.get(
+                env_registry.SKYTPU_PREFIX_POOL_PAGES,
+                str(prefix_mod.DEFAULT_POOL_PAGES)))
+            self.prefix = prefix_mod.PrefixCache(
+                cfg, page=self._page, pool_pages=pool_pages,
+                kv_quant=kv_quant)
 
         self.queue: collections.deque = collections.deque()
         self.slots: List[Optional[_SlotState]] = [None] * batch_size
@@ -343,6 +399,14 @@ class ServingEngine:
         # mutated without racing an in-flight device tick.
         self._cancels: Dict[Any, str] = {}
         self._cancel_lock = threading.Lock()
+        # Serializes concurrent submit() callers so the duplicate-id
+        # check and the queue append are one atomic step.
+        self._submit_lock = threading.Lock()
+        # Single-entry memo of the queue head's _fits suffix lookup:
+        # (Request object, pool directory version, suffix).
+        # Driver-thread only; the strong reference is what makes the
+        # identity key collision-proof.
+        self._fits_memo: Optional[tuple] = None
         # EWMA of recent working-tick durations: the time base for
         # estimate_wait_s()'s deadline-aware admission estimate.
         # None until the first measured tick (no signal -> admit).
@@ -580,6 +644,12 @@ class ServingEngine:
                 self.params, self.cache, self._tokens_dev,
                 *chunk_args, no_active, sub,
                 jnp.asarray(self._temps), n=n_, num_pages=np_)
+        if self.prefix is not None:
+            # Prefix-cache copy programs (page copy-in/out + the
+            # dmask/length fix): fixed shapes with traced indices —
+            # ONE program each, compiled here so a cache hit never
+            # pays an XLA compile inside admission.
+            self.cache = self.prefix.warm(self.cache)
         self.reset()
 
     def reset(self) -> None:
@@ -610,23 +680,43 @@ class ServingEngine:
             raise ValueError(
                 f'max_new ({request.max_new}) exceeds the decode '
                 f'capacity ({self.decode_capacity()}); raise max_seq.')
-        self._submitted_at[request.request_id] = time.time()
-        if not self._warming and trace_lib.enabled():
-            # Parent = the ambient span of the submitting thread (the
-            # HTTP handler's http.generate span) or the inherited
-            # process context; spans then live across driver-loop
-            # ticks keyed by request_id, since no call stack connects
-            # submit to the first decoded token.
-            req_span = trace_lib.start_span(
-                'engine.request', request_id=str(request.request_id),
-                prompt_len=len(request.tokens),
-                max_new=request.max_new)
-            self._req_spans[request.request_id] = {
-                'request': req_span,
-                'queue': trace_lib.start_span('engine.queue_wait',
-                                              parent=req_span),
-            }
-        self.queue.append(request)
+        # Duplicate check + tracking writes + append under one lock:
+        # check-then-append without it lets two concurrent submitters
+        # of the same id both pass the membership test — exactly the
+        # span-leak/TTFT clobbering the typed reject exists to
+        # prevent. Only submitters contend here; the driver's popleft
+        # cannot mint a duplicate, so it stays lock-free.
+        with self._submit_lock:
+            # Exact O(1) in-flight test: _submitted_at gains the id
+            # right below (under this lock) and loses it only when
+            # the request's ONE terminal Result is recorded
+            # (_terminal) — no queue/slot scan needed.
+            if request.request_id in self._submitted_at:
+                # Admitting the duplicate would clobber the first
+                # request's _submitted_at/_req_spans entries and leak
+                # its open span (regression-tested).
+                raise DuplicateRequestError(
+                    f'duplicate request_id {request.request_id!r}: a '
+                    'request with this id is already in flight.')
+            self._submitted_at[request.request_id] = time.time()
+            if not self._warming and trace_lib.enabled():
+                # Parent = the ambient span of the submitting thread
+                # (the HTTP handler's http.generate span) or the
+                # inherited process context; spans then live across
+                # driver-loop ticks keyed by request_id, since no
+                # call stack connects submit to the first decoded
+                # token.
+                req_span = trace_lib.start_span(
+                    'engine.request',
+                    request_id=str(request.request_id),
+                    prompt_len=len(request.tokens),
+                    max_new=request.max_new)
+                self._req_spans[request.request_id] = {
+                    'request': req_span,
+                    'queue': trace_lib.start_span('engine.queue_wait',
+                                                  parent=req_span),
+                }
+            self.queue.append(request)
         if not self._warming:
             _M_REQUESTS.inc()
             _M_QUEUE_DEPTH.set(len(self.queue))
@@ -656,6 +746,21 @@ class ServingEngine:
     def _prefill_ticks(self, tokens_left: int) -> int:
         return -(-tokens_left // self.prefill_chunk)
 
+    def _suffix_len(self, prompt_len: int,
+                    tokens: Optional[Sequence[int]] = None,
+                    holder: Optional[Any] = None) -> int:
+        """Prompt tokens that must actually be prefilled: with the
+        prefix cache enabled and the token ids known, the cached
+        prefix is served from the pool, so only the uncached suffix
+        costs prefill ticks. Pure read — safe from HTTP threads (the
+        deadline-shed estimate passes tokens through here).
+        ``holder`` (the Request object, when there is one) caches the
+        chain hashes so repeated estimates never re-hash a prompt."""
+        if self.prefix is None or tokens is None:
+            return prompt_len
+        return prompt_len - self.prefix.reusable_tokens(
+            tokens, self.prefill_chunk, holder=holder)
+
     def _fits(self, req: Request) -> bool:
         """May ``req`` be admitted without breaking the finish
         guarantee? Invariant: at every tick the remaining decode
@@ -674,7 +779,30 @@ class ServingEngine:
         occupied = [s for s in self.slots if s is not None]
         if not occupied:
             return req.max_new <= remaining
-        charge = (req.max_new + self._prefill_ticks(len(req.tokens)) *
+        # Prefix-cache hits charge only the UNCACHED suffix: the
+        # cached pages copy in without burning prefill ticks, so a
+        # hit raises effective capacity, not just TTFT. (Consistent
+        # with _admit: the same lookup runs there in the same tick,
+        # and pages pinned at acquire cannot evict in between.)
+        # Memoized on (Request IDENTITY, pool directory version) —
+        # _fits re-runs for the queue head every tick it fails to
+        # admit, and the lookup answer only changes when a page is
+        # published or evicted. Object identity (not request_id):
+        # ids may legally be reused across requests with different
+        # tokens, and the held reference keeps the id() from being
+        # recycled.
+        if self.prefix is None or self._warming:
+            suffix = len(req.tokens)
+        else:
+            memo = self._fits_memo
+            if (memo is not None and memo[0] is req and
+                    memo[1] == self.prefix.version):
+                suffix = memo[2]
+            else:
+                suffix = self._suffix_len(len(req.tokens), req.tokens,
+                                          holder=req)
+                self._fits_memo = (req, self.prefix.version, suffix)
+        charge = (req.max_new + self._prefill_ticks(suffix) *
                   self.decode_chunk)
         if charge > remaining:
             return False
@@ -714,7 +842,11 @@ class ServingEngine:
                     _M_RESETS.inc()
                     continue
                 break  # wait for running requests to drain
-            self.queue.popleft()
+            # Slot assignment BEFORE popleft: the request must never
+            # be in neither container, or a concurrent submit() of
+            # the same id passes the duplicate check in that window
+            # (briefly being in BOTH is harmless — _inflight_ids is a
+            # set, and only this driver thread pops or cancels).
             slot_idx = free.pop(0)
             self._epoch += 1
             self._seq += 1
@@ -724,6 +856,7 @@ class ServingEngine:
                 prompt_len=len(req.tokens), phase='prefill',
                 prefill_pos=0, seq=self._seq, epoch=self._epoch,
                 deadline=req.deadline)
+            self.queue.popleft()
             self._temps[slot_idx] = (
                 req.temperature if req.temperature is not None
                 else self.temperature)
@@ -739,9 +872,50 @@ class ServingEngine:
                 ts['prefill'] = trace_lib.start_span(
                     'engine.prefill', parent=ts['request'],
                     slot=slot_idx, prompt_len=len(req.tokens))
+            if self.prefix is not None and not self._warming:
+                # Longest-cached-prefix lookup + page copy-in: the
+                # matched pages land in the slot's prompt-region KV
+                # through warmed fixed-shape programs, and the chunk
+                # cursor starts at the cached boundary — the uncached
+                # suffix is all that prefills.
+                sp = trace_lib.start_span(
+                    'engine.prefix_lookup',
+                    parent=None if ts is None else ts.get('prefill'))
+                reuse, pages, hashes = self.prefix.acquire(
+                    req.request_id, req.tokens, self.prefill_chunk,
+                    holder=req)
+                st = self.slots[slot_idx]
+                # The admission lookup's chain hashes ride on the
+                # slot so the terminal publish never re-hashes the
+                # prompt.
+                st.prompt_hashes = hashes
+                if reuse:
+                    self.cache = self.prefix.copy_into(
+                        self.cache, slot_idx, pages, reuse)
+                    st.prefill_pos = reuse
+                    st.reused = reuse
+                sp.finish(matched_pages=len(pages),
+                          reuse_tokens=reuse, hit=bool(reuse))
+
+    def _retire_prefix(self, state: _SlotState,
+                       slot_idx: Optional[int]) -> None:
+        """Terminal-slot prefix bookkeeping: publish the slot's
+        finalized prompt pages to the shared pool (only pages its
+        prefill cursor actually passed — a cancel mid-prefill
+        publishes the finished prefix) and release its pins. No-op
+        without the cache; queued-only requests (slot_idx None) hold
+        no pins and have no finalized pages."""
+        if self.prefix is None:
+            return
+        if slot_idx is not None and not self._warming:
+            self.prefix.publish(state.prompt, state.prefill_pos,
+                                self.cache, slot_idx,
+                                hashes=state.prompt_hashes)
+        self.prefix.release(state.request_id)
 
     def _finish(self, slot_idx: int) -> None:
         state = self.slots[slot_idx]
+        self._retire_prefix(state, slot_idx)
         self._terminal(state.request_id, state.generated,
                        state.prompt_len, lifecycle.FINISHED, None)
         self.slots[slot_idx] = None
@@ -801,13 +975,11 @@ class ServingEngine:
         the call (best-effort: a race with natural completion still
         yields exactly one terminal Result, whichever lands first).
         """
-        try:
-            known = request_id in self._inflight_ids()
-        except RuntimeError:
-            # Queue mutated under the cross-thread membership scan:
-            # assume in flight; _apply_cancellations re-checks.
-            known = True
-        if not known:
+        # Exact O(1) in-flight test (see submit): membership in
+        # _submitted_at is GIL-atomic and holds from submit until the
+        # terminal Result is recorded — no queue/slot scan, no race
+        # with the driver's pops.
+        if request_id not in self._submitted_at:
             return False
         with self._cancel_lock:
             self._cancels[request_id] = reason
@@ -845,6 +1017,7 @@ class ServingEngine:
                 # this slot are discarded by the epoch check, and the
                 # next admission recycles the slot (its first prefill
                 # chunk clears the row dmask).
+                self._retire_prefix(state, slot_idx)
                 self._terminal(rid, state.generated, state.prompt_len,
                                status, reason)
                 self.slots[slot_idx] = None
@@ -877,7 +1050,9 @@ class ServingEngine:
         for rid in expired:
             self._cancel_now(rid, 'deadline', lifecycle.EXPIRED)
 
-    def estimate_wait_s(self, prompt_len: int, max_new: int) -> float:
+    def estimate_wait_s(self, prompt_len: int, max_new: int,
+                        tokens: Optional[Sequence[int]] = None
+                        ) -> float:
         """Estimated submit-to-finish seconds for a request arriving
         NOW, from pending queue depth, prefill backlog and decode
         capacity — the deadline-aware admission signal
@@ -886,16 +1061,25 @@ class ServingEngine:
         its prefill ticks plus its decode ticks; everything already
         queued or occupying a slot adds its remaining ticks divided
         by the decode width (slots run batch_size-wide). Returns 0
-        before the first measured tick (no signal -> admit)."""
+        before the first measured tick (no signal -> admit).
+
+        With the prefix cache enabled and ``tokens`` provided, the
+        request's (and each queued request's) prefill work is charged
+        over the post-lookup UNCACHED suffix — high-hit-rate traffic
+        must not be spuriously shed with ``wont_make_deadline`` for
+        prefill it will never run."""
         tick = self._tick_ewma
         if tick is None:
             return 0.0
-        own = (self._prefill_ticks(prompt_len) +
+        own = (self._prefill_ticks(self._suffix_len(prompt_len,
+                                                    tokens)) +
                -(-max_new // self.decode_chunk))
         backlog = 0
+        slot_ids = set()
         for s in list(self.slots):
             if s is None:
                 continue
+            slot_ids.add(s.request_id)
             backlog += -(-max(0, s.max_new - len(s.generated)) //
                          self.decode_chunk)
             if s.phase == 'prefill':
@@ -909,7 +1093,14 @@ class ServingEngine:
                 r = self.queue[i]
             except IndexError:
                 break
-            backlog += (self._prefill_ticks(len(r.tokens)) +
+            if r.request_id in slot_ids:
+                # _admit assigns the slot BEFORE popping the queue, so
+                # a request being admitted right now is briefly in
+                # both containers — counting it twice would inflate
+                # the estimate and spuriously shed deadline'd work.
+                continue
+            backlog += (self._prefill_ticks(
+                self._suffix_len(len(r.tokens), r.tokens, holder=r)) +
                         -(-r.max_new // self.decode_chunk))
         wait_ticks = own + backlog / max(1, self.batch_size)
         return wait_ticks * tick
@@ -1121,8 +1312,15 @@ class ServingEngine:
                     if ts is not None:
                         ps = ts.pop('prefill', None)
                         if ps is not None:
+                            # Chunks that actually RAN: a prefix-
+                            # cache hit starts at the cached
+                            # boundary, so the count excludes the
+                            # reused region (the cache-off count
+                            # would overstate per-chunk math 4x for
+                            # exactly the traffic the cache serves).
                             ps.finish(chunks=self._prefill_ticks(
-                                st.prompt_len))
+                                st.prompt_len - st.reused),
+                                reused_tokens=st.reused)
                         ts['first_chunk'] = trace_lib.start_span(
                             'engine.decode.first_chunk',
                             parent=ts['request'], slot=m['slot'])
@@ -1230,7 +1428,29 @@ class ServingEngine:
         return out
 
     def _inflight_ids(self) -> set:
-        ids = {r.request_id for r in self.queue}
+        """Best-effort in-flight id set for bulk introspection (run()
+        prechecks, the HTTP drain sweep). Exactness-critical checks
+        (submit's duplicate reject, cancel) use the O(1)
+        ``_submitted_at`` map instead. A plain set comprehension is a
+        consistent snapshot when it completes (deque iteration raises
+        on ANY concurrent mutation) — retry a few times; under
+        pathological churn fall back to a right-anchored scan, which
+        driver poplefts cannot shift (popped requests are already in
+        their slot — _admit assigns before popping) though a
+        concurrent append can shadow one deep element per append."""
+        for _ in range(4):
+            try:
+                return ({r.request_id for r in self.queue} |
+                        {s.request_id for s in self.slots
+                         if s is not None})
+            except RuntimeError:
+                continue        # deque mutated mid-iteration: retry
+        ids = set()
+        for k in range(1, len(self.queue) + 1):
+            try:
+                ids.add(self.queue[-k].request_id)
+            except IndexError:
+                break
         ids.update(s.request_id for s in self.slots if s is not None)
         return ids
 

@@ -386,18 +386,22 @@ class EngineServer:
 
     def _deadline_shed_response(self, req_id: str,
                                 deadline: Optional[float],
-                                prompt_len: int, max_new: int
+                                tokens, max_new: int
                                 ) -> Optional[web.Response]:
         """Deadline-aware admission (docs/request_lifecycle.md):
         shed a request whose ESTIMATED queue wait already exceeds its
         remaining budget — strictly better than the blind max_pending
         bound, because a no-deadline request at the same queue depth
         is still admitted, and a tight-deadline request is told
-        immediately instead of timing out after burning a slot."""
+        immediately instead of timing out after burning a slot. The
+        token ids flow into the estimate so a prefix-cache hit is
+        charged only its uncached suffix — high-hit-rate traffic must
+        not be shed for prefill it will never run."""
         if deadline is None:
             return None
         left = deadline - time.time()
-        est = self.engine.estimate_wait_s(prompt_len, max_new)
+        est = self.engine.estimate_wait_s(len(tokens), max_new,
+                                          tokens=tokens)
         if est <= left:
             return None
         _M_SHEDS.inc(1, reason='wont_make_deadline')
@@ -498,7 +502,7 @@ class EngineServer:
         if overloaded is not None:
             return overloaded
         shed = self._deadline_shed_response(req_id, deadline,
-                                            len(tokens), max_new)
+                                            tokens, max_new)
         if shed is not None:
             return shed
         if not self._ready.is_set():
@@ -839,6 +843,10 @@ def _build_engine(args) -> 'Any':
                                                None),
                          prefill_budget=getattr(args, 'prefill_budget',
                                                 None),
+                         prefix_cache=getattr(args, 'prefix_cache',
+                                              None),
+                         prefix_pool_pages=getattr(
+                             args, 'prefix_pool_pages', None),
                          mesh=mesh)
 
 
@@ -865,6 +873,16 @@ def main() -> None:
                         'prefilling slots — bounds decode inter-token '
                         'latency under admission churn (default: '
                         'SKYTPU_PREFILL_BUDGET or 256).')
+    parser.add_argument('--prefix-cache', action='store_true',
+                        default=None,
+                        help='Enable automatic prefix caching '
+                        '(block-hash shared page pool; hits skip the '
+                        'cached prefill and charge admission only '
+                        'the uncached suffix). Default: '
+                        'SKYTPU_PREFIX_CACHE.')
+    parser.add_argument('--prefix-pool-pages', type=int, default=None,
+                        help='Prefix-pool capacity in pages '
+                        '(default: SKYTPU_PREFIX_POOL_PAGES or 512).')
     parser.add_argument('--kv-quant', action='store_true')
     parser.add_argument('--weight-quant', action='store_true',
                         help='int8 weight-only quantization: serve '

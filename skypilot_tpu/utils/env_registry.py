@@ -226,6 +226,16 @@ SKYTPU_PREFILL_BUDGET = register(
     'serving engine\'s mixed scheduler (default 256; folds to whole '
     'chunk rows, so the effective budget is '
     'chunk * max(1, budget // chunk)).')
+SKYTPU_PREFIX_CACHE = register(
+    'SKYTPU_PREFIX_CACHE',
+    'Set to 1 to enable automatic prefix caching in the serving '
+    'engine (block-hash shared page pool, models/prefix_cache.py; '
+    'PERFORMANCE.md "Prefix-reuse KV cache"). Off (default) keeps '
+    'engine behavior bit-identical to a build without the cache.')
+SKYTPU_PREFIX_POOL_PAGES = register(
+    'SKYTPU_PREFIX_POOL_PAGES',
+    'Shared prefix-pool capacity in pages (at the engine page size; '
+    'default 512). Cold unpinned pages evict LRU beyond it.')
 
 # --------------------------------------------------- request lifecycle
 SKYTPU_DRAIN_TIMEOUT_SECONDS = register(
@@ -282,6 +292,32 @@ BENCH_SERVE_PREFILL_BUDGET = register(
     '(SKYTPU_PREFILL_BUDGET analog).')
 BENCH_SERVE_PROMPT = register(
     'BENCH_SERVE_PROMPT', 'Serve bench prompt length.')
+BENCH_SERVE_PAGE = register(
+    'BENCH_SERVE_PAGE',
+    'Serve bench engine page size in tokens (decode paged dispatch '
+    'AND prefix-cache block granularity).')
+BENCH_SERVE_PREFIX = register(
+    'BENCH_SERVE_PREFIX',
+    'Set to 1: serve bench generates a shared-prefix workload '
+    '(Zipf-distributed reuse over a prefix pool) and enables the '
+    'engine prefix cache. Default on under BENCH_SMOKE, off '
+    'otherwise.')
+BENCH_SERVE_PREFIX_POOL = register(
+    'BENCH_SERVE_PREFIX_POOL',
+    'Serve bench: number of distinct shared prefixes in the '
+    'workload (Zipf-ranked; default 8, 2 under BENCH_SMOKE).')
+BENCH_SERVE_PREFIX_LEN = register(
+    'BENCH_SERVE_PREFIX_LEN',
+    'Serve bench: shared-prefix length in tokens (default 3/4 of '
+    'the max prompt).')
+BENCH_SERVE_PREFIX_ZIPF = register(
+    'BENCH_SERVE_PREFIX_ZIPF',
+    'Serve bench: Zipf exponent of the prefix popularity '
+    'distribution (default 1.1; higher = more head-heavy reuse).')
+BENCH_SERVE_PREFIX_PAGES = register(
+    'BENCH_SERVE_PREFIX_PAGES',
+    'Serve bench: engine prefix-pool capacity in pages '
+    '(SKYTPU_PREFIX_POOL_PAGES analog).')
 BENCH_SERVE_MAX_NEW = register(
     'BENCH_SERVE_MAX_NEW', 'Serve bench max new tokens per request.')
 BENCH_SERVE_REQUESTS = register(

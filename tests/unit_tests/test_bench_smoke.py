@@ -42,6 +42,28 @@ def test_bench_smoke_decode():
     assert detail['num_pages'] <= detail['total_pages']
 
 
+def test_bench_smoke_serve():
+    """The serve smoke path runs the shared-prefix workload (on by
+    default under BENCH_SMOKE), guarding the BENCH_SERVE_PREFIX_*
+    flags and the prefix detail the round artifacts record."""
+    result = _run_smoke('serve')
+    assert result['metric'] == 'llama_serve_req_s'
+    assert result['value'] > 0
+    detail = result['detail']
+    assert detail['backend'] == 'cpu'
+    prefix = detail['prefix']
+    assert prefix['enabled'] is True
+    # 6 requests over 2 Zipf-ranked prefixes: everything after each
+    # prefix's first request hits.
+    assert prefix['hits'] > 0
+    assert prefix['tokens_saved'] > 0
+    assert prefix['hit_rate'] > 0
+    assert 0 < prefix['occupied'] <= prefix['pool_pages']
+    # The budget invariant still holds with copy-in admissions.
+    pf = detail['prefill']
+    assert pf['max_tick_tokens'] <= pf['budget']
+
+
 def test_bench_smoke_train():
     result = _run_smoke('train')
     assert result['metric'] == 'llama_train_mfu'

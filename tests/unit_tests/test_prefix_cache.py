@@ -1,0 +1,472 @@
+"""Prefix-reuse KV cache: block-hash lookup semantics, bitwise greedy
+parity cache-on vs cache-off, copy-on-write isolation, pinned-page
+eviction discipline, pin release on cancel/expiry, admission charging
+of the uncached suffix, and the no-recompile-after-warmup invariant.
+
+Engine tests use small page/chunk sizes (page=8, chunk=8) so tiny
+prompts span several pages; every greedy output is pinned against the
+solo ``inference.generate`` oracle — the same bar the continuous-
+batching and chunked-prefill suites set.
+"""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.models import prefix_cache as prefix_mod
+from skypilot_tpu.models.serving_engine import Request, ServingEngine
+
+pytestmark = pytest.mark.prefixcache
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg = models.LlamaConfig.tiny(**cfg_kw)
+    params = models.init_params(cfg, jax.random.PRNGKey(seed))
+    return cfg, params
+
+
+def _prompt(cfg, n, seed):
+    key = jax.random.PRNGKey(seed)
+    return list(np.asarray(
+        jax.random.randint(key, (n,), 0, cfg.vocab_size)))
+
+
+def _solo_generate(params, cfg, prompt, max_new):
+    out = inference.generate(
+        params, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cfg, max_new=max_new)
+    return list(np.asarray(out[0]))
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_prompt', 32)
+    kw.setdefault('max_seq', 160)
+    kw.setdefault('decode_chunk', 4)
+    kw.setdefault('prefill_chunk', 8)
+    kw.setdefault('prefill_budget', 16)
+    kw.setdefault('page', 8)
+    kw.setdefault('prefix_cache', True)
+    kw.setdefault('prefix_pool_pages', 16)
+    return ServingEngine(params, cfg, **kw)
+
+
+# ------------------------------------------------------ hash semantics
+
+
+def test_page_hashes_chain_commits_to_whole_prefix():
+    """Equal blocks under different prefixes must hash differently —
+    the chain property that makes a hash hit mean an exact whole-
+    prefix match."""
+    a = [1, 2, 3, 4, 5, 6, 7, 8]
+    b = [9, 9, 9, 9, 9, 9, 9, 9]
+    ha = prefix_mod.page_hashes(a + b, 4)
+    hb = prefix_mod.page_hashes(b + b, 4)
+    assert len(ha) == len(hb) == 4
+    # Page 2/3 hold identical tokens (b) but different prefixes.
+    assert ha[2] != hb[2] and ha[3] != hb[3]
+    # Identical prefixes hash identically, partial pages never hash.
+    assert prefix_mod.page_hashes(a + b[:3], 4) == ha[:2]
+    assert prefix_mod.page_hashes([1, 2, 3], 4) == []
+
+
+# ---------------------------------------------- parity: hit/miss/edge
+
+
+def test_hit_miss_partial_and_divergence_parity():
+    """Greedy parity vs the solo oracle across lookup outcomes:
+    full hit, miss, and divergence at k*page-1 / k*page / k*page+1
+    (page 8). The cache-off engine is the second oracle — outputs
+    must agree bitwise with it through the cache-on engine."""
+    cfg, params = _setup()
+    eng_on = _engine(params, cfg)
+    eng_off = _engine(params, cfg, prefix_cache=False)
+    assert eng_off.prefix is None
+
+    base = _prompt(cfg, 32, 1)
+    # Publisher: its full pages (4 at page 8) land in the pool.
+    first = eng_on.run([Request('pub', list(base), max_new=4)])
+    assert first['pub'].tokens == _solo_generate(params, cfg, base, 4)
+    assert eng_on.prefix.stats()['occupied'] == 4
+
+    cases = {
+        'full_hit': list(base),                        # identical
+        'miss': _prompt(cfg, 20, 99),                  # no shared page
+        'div_15': base[:15] + _prompt(cfg, 12, 50),    # k*page - 1
+        'div_16': base[:16] + _prompt(cfg, 12, 51),    # k*page
+        'div_17': base[:17] + _prompt(cfg, 12, 52),    # k*page + 1
+    }
+    expect_reuse = {'full_hit': 24, 'miss': 0, 'div_15': 8,
+                    'div_16': 16, 'div_17': 16}
+    for rid, toks in cases.items():
+        before = eng_on.prefix.tokens_saved
+        got = eng_on.run([Request(rid, list(toks), max_new=5)])
+        want = _solo_generate(params, cfg, toks, 5)
+        assert got[rid].tokens == want, (rid, got[rid].tokens, want)
+        assert (eng_on.prefix.tokens_saved - before ==
+                expect_reuse[rid]), rid
+        off = eng_off.run([Request(rid, list(toks), max_new=5)])
+        assert off[rid].tokens == want, (rid, 'cache-off')
+
+
+def test_shared_prefix_batch_saves_page_rounded_tokens():
+    """Acceptance: a 100%-shared-prefix batch after the first request
+    reports prefill-tokens-saved == shared-prefix tokens
+    (page-rounded) per request, and the counter agrees."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=3, prefill_budget=24)
+    shared = _prompt(cfg, 19, 7)      # 2 full pages -> 16 reusable
+    eng.run([Request('first', shared + _prompt(cfg, 4, 8), max_new=3)])
+    assert eng.prefix.tokens_saved == 0
+    reqs = [Request(f'r{i}', shared + _prompt(cfg, 3 + i, 20 + i),
+                    max_new=3) for i in range(3)]
+    res = eng.run(reqs)
+    for r in reqs:
+        want = _solo_generate(params, cfg, list(r.tokens), 3)
+        assert res[r.request_id].tokens == want, r.request_id
+    assert eng.prefix.tokens_saved == 3 * 16
+    assert eng.prefix.hits == 3
+    summary = metrics_lib.summary()
+    assert summary['skytpu_engine_prefix_tokens_saved_total'] == 3 * 16
+    assert summary['skytpu_engine_prefix_hits_total'] == 3
+
+
+def test_cow_isolation_writers_never_corrupt_sharers():
+    """Two concurrent requests share pinned pool pages while each
+    writes its own divergent suffix + decode tokens: both must match
+    their solo decode, and the pool pages must stay byte-stable (a
+    later request still hits and matches)."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg)
+    shared = _prompt(cfg, 16, 3)
+    eng.run([Request('pub', shared + _prompt(cfg, 3, 4), max_new=3)])
+
+    a = shared + _prompt(cfg, 9, 5)
+    b = shared + _prompt(cfg, 6, 6)
+    res = eng.run([Request('a', a, max_new=8),
+                   Request('b', b, max_new=8)])
+    assert res['a'].tokens == _solo_generate(params, cfg, a, 8)
+    assert res['b'].tokens == _solo_generate(params, cfg, b, 8)
+    # Both hits ran concurrently against the same 2 pages.
+    assert eng.prefix.hits == 2
+    assert eng.prefix.pinned_pages() == 0          # pins released
+    # The shared pages survived both writers: a third request still
+    # hits them and still matches its oracle.
+    c = shared + _prompt(cfg, 4, 9)
+    got = eng.run([Request('c', c, max_new=5)])
+    assert got['c'].tokens == _solo_generate(params, cfg, c, 5)
+    assert eng.prefix.hits == 3
+
+
+def test_slot_recycling_dmask_interplay():
+    """A cache-hit admission into a recycled slot starts its first
+    chunk at the cached boundary (start != 0), so the usual
+    first-chunk dmask clear never runs — the copy-in's mask fix must
+    make the previous occupant's prompt tail AND decode slots
+    unreadable, or the new request attends stale K/V."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=1, max_seq=96)
+    shared = _prompt(cfg, 16, 11)
+    # Previous occupant: longer prompt than the successor and a long
+    # decode (dirty dmask deep into the decode region).
+    prev = shared + _prompt(cfg, 15, 12)
+    eng.run([Request('prev', prev, max_new=12)])
+    nxt = shared + _prompt(cfg, 3, 13)
+    got = eng.run([Request('next', nxt, max_new=6)])
+    assert eng.prefix.hits == 1
+    assert got['next'].tokens == _solo_generate(params, cfg, nxt, 6)
+
+
+def test_copy_into_mask_fix_clears_previous_occupant():
+    """Unit: copy_into marks exactly [0, cached) readable — the
+    recycled row's old prompt tail and decode columns go dark."""
+    cfg, _ = _setup()
+    pc = prefix_mod.PrefixCache(cfg, page=8, pool_pages=4)
+    s_max, batch = 64, 2
+    shp = (cfg.n_layers, batch, s_max, cfg.n_kv_heads, cfg.head_dim)
+    cache = {'k': jnp.zeros(shp, cfg.compute_dtype),
+             'v': jnp.zeros(shp, cfg.compute_dtype),
+             'dmask': jnp.ones((batch, s_max), bool),
+             'length': jnp.full((batch,), 50, jnp.int32)}
+    out = pc.copy_into(cache, 0, [0, 1], 16)
+    assert (np.asarray(out['dmask'][0]) ==
+            (np.arange(s_max) < 16)).all()
+    assert int(out['length'][0]) == 16
+    # The other row is untouched.
+    assert np.asarray(out['dmask'][1]).all()
+    assert int(out['length'][1]) == 50
+
+
+# -------------------------------------------------- eviction and pins
+
+
+def test_eviction_lru_and_pinned_pages_never_evicted():
+    cfg, _ = _setup()
+    pc = prefix_mod.PrefixCache(cfg, page=8, pool_pages=2)
+    shp = (cfg.n_layers, 1, 64, cfg.n_kv_heads, cfg.head_dim)
+    cache = {'k': jnp.zeros(shp, cfg.compute_dtype),
+             'v': jnp.zeros(shp, cfg.compute_dtype)}
+    tok_a = list(range(100, 108))
+    tok_b = list(range(200, 208))
+    tok_c = list(range(300, 308))
+    tok_d = list(range(400, 408))
+    pc.publish(tok_a, 8, cache, 0)
+    pc.publish(tok_b, 8, cache, 0)
+    assert pc.stats()['occupied'] == 2
+
+    # Pin A's page via an admission hit (9th token forces a suffix).
+    reuse, pages, hashes = pc.acquire('r1', tok_a + [1], chunk=8)
+    assert reuse == 8 and len(pages) == 1 and len(hashes) == 1
+    assert pc.pinned_pages() == 1
+
+    # C needs a page: B (unpinned) is the only candidate.
+    pc.publish(tok_c, 8, cache, 0)
+    assert pc.evictions == 1
+    assert pc.match_pages(tok_a + [1]), 'pinned page was evicted'
+    assert not pc.match_pages(tok_b + [1])
+    assert pc.match_pages(tok_c + [1])
+
+    # Pin C too: now every page is pinned — publish degrades to a
+    # no-op instead of evicting a page an in-flight request needs.
+    pc.acquire('r2', tok_c + [2], chunk=8)
+    pc.publish(tok_d, 8, cache, 0)
+    assert pc.evictions == 1 and pc.stats()['occupied'] == 2
+    assert not pc.match_pages(tok_d + [1])
+
+    # Releasing r1 unpins A; D can now evict it (LRU: A is older).
+    pc.release('r1')
+    pc.publish(tok_d, 8, cache, 0)
+    assert pc.evictions == 2
+    assert not pc.match_pages(tok_a + [1])
+    assert pc.match_pages(tok_d + [1])
+    assert metrics_lib.summary()[
+        'skytpu_engine_prefix_evictions_total'] == 2
+
+
+def test_cancel_mid_prefill_releases_pins_and_publishes_final_pages():
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=1, max_seq=96)
+    shared = _prompt(cfg, 8, 21)
+    eng.run([Request('pub', shared + _prompt(cfg, 2, 22), max_new=2)])
+    occupied0 = eng.prefix.stats()['occupied']
+
+    # 8 cached + 24 uncached tokens = 3 more prefill ticks: cancel
+    # lands mid-prefill with the pin still held.
+    long = shared + _prompt(cfg, 24, 23)
+    eng.submit(Request('victim', long, max_new=4))
+    eng.step()
+    assert eng.prefix.pinned_pages() == 1
+    assert eng.cancel('victim', reason='api')
+    eng.step()
+    res = eng.drain_results()
+    assert res['victim'].status == 'cancelled'
+    assert eng.prefix.pinned_pages() == 0
+    # The finished page beyond the cached prefix was published: the
+    # pool grew past the publisher's pages.
+    assert eng.prefix.stats()['occupied'] > occupied0
+    # The engine still serves (the freed slot recycles cleanly).
+    again = eng.run([Request('after', shared + _prompt(cfg, 3, 24),
+                             max_new=3)])
+    assert again['after'].tokens == _solo_generate(
+        params, cfg, shared + _prompt(cfg, 3, 24), 3)
+
+
+def test_expired_deadline_releases_pins():
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=1, max_seq=96)
+    shared = _prompt(cfg, 8, 31)
+    eng.run([Request('pub', shared + _prompt(cfg, 2, 32), max_new=2)])
+    long = shared + _prompt(cfg, 24, 33)
+    eng.submit(Request('late', long, max_new=4,
+                       deadline=time.time() + 0.25))
+    eng.step()
+    assert eng.prefix.pinned_pages() == 1
+    time.sleep(0.3)
+    eng.step()                    # expiry applies at the tick boundary
+    eng.step()
+    res = eng.drain_results()
+    assert res['late'].status == 'expired'
+    assert eng.prefix.pinned_pages() == 0
+
+
+# ------------------------------------------- admission and estimation
+
+
+def test_admission_charges_uncached_suffix_only():
+    """The finish-guarantee charge drops to the uncached suffix: a
+    request that does NOT fit next to a running decode without the
+    cache fits WITH it (its cached prefix burns no prefill ticks) —
+    hits raise effective capacity, not just TTFT."""
+    cfg, params = _setup()
+    kw = dict(batch_size=2, max_prompt=32, max_seq=48, decode_chunk=4,
+              prefill_chunk=8, prefill_budget=16, page=8,
+              prefix_pool_pages=16)
+    eng_on = ServingEngine(params, cfg, prefix_cache=True, **kw)
+    eng_off = ServingEngine(params, cfg, prefix_cache=False, **kw)
+    big = _prompt(cfg, 32, 41)
+    eng_on.run([Request('pub', list(big), max_new=2)])
+    eng_on.reset()                 # full decode region back, pool kept
+
+    for eng in (eng_on, eng_off):
+        eng.submit(Request('occ', _prompt(cfg, 4, 42), max_new=6))
+        eng.step()                 # occupant admitted + prefilled
+    req = Request('tight', list(big), max_new=8)
+    # Full charge: 8 + ceil(32/8)*4 = 24 > 16 remaining. Suffix
+    # charge after the 24-token reuse: 8 + ceil(8/8)*4 = 12 <= 16.
+    assert not eng_off._fits(req)
+    assert eng_on._fits(req)
+
+    # estimate_wait_s (the deadline-shed signal) shrinks the same
+    # way when the token ids are supplied for the lookup.
+    for _ in range(3):
+        eng_on.step()
+    assert eng_on._tick_ewma is not None
+    est_blind = eng_on.estimate_wait_s(len(big), 8)
+    est_informed = eng_on.estimate_wait_s(len(big), 8, tokens=big)
+    assert est_informed < est_blind
+    # Both engines drain clean afterwards.
+    for eng in (eng_on, eng_off):
+        while eng.queue or eng.num_active() or eng.has_pending:
+            eng.step()
+
+
+def test_fits_memo_is_request_identity_keyed():
+    """Regression: the _fits suffix memo must key on the Request
+    OBJECT, not its request_id — ids may legally be reused for a
+    different prompt, and a stale cached-suffix would admit a request
+    whose real prefill work breaks the finish guarantee."""
+    cfg, params = _setup()
+    eng = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                        max_seq=48, decode_chunk=4, prefill_chunk=8,
+                        prefill_budget=16, page=8, prefix_cache=True,
+                        prefix_pool_pages=16)
+    big = _prompt(cfg, 32, 45)
+    eng.run([Request('pub', list(big), max_new=2)])
+    eng.reset()
+    eng.submit(Request('occ', _prompt(cfg, 4, 46), max_new=6))
+    eng.step()
+    # Same id 'x', cached prompt: fits via the 24-token reuse.
+    assert eng._fits(Request('x', list(big), max_new=8))
+    # Same id 'x', totally uncached prompt: the memo must NOT serve
+    # the cached request's 8-token suffix (full charge 24 > 16).
+    assert not eng._fits(Request('x', _prompt(cfg, 32, 47),
+                                 max_new=8))
+    while eng.queue or eng.num_active() or eng.has_pending:
+        eng.step()
+
+
+def test_http_deadline_shed_passes_tokens_to_estimate():
+    """The HTTP shed path must hand the token ids to the engine so
+    the estimate charges the post-lookup suffix."""
+    from skypilot_tpu.models.serving_http import EngineServer
+
+    class _StubEngine:
+        max_prompt = 64
+        queue = []
+
+        def decode_capacity(self):
+            return 64
+
+        def estimate_wait_s(self, prompt_len, max_new, tokens=None):
+            self.seen = (prompt_len, max_new, tokens)
+            return 0.0
+
+    stub = _StubEngine()
+    server = EngineServer.__new__(EngineServer)
+    server.engine = stub
+    resp = server._deadline_shed_response(
+        'rid', time.time() + 30.0, [1, 2, 3], 8)
+    assert resp is None
+    assert stub.seen == (3, 8, [1, 2, 3])
+
+
+# ----------------------------------------------- programs and metrics
+
+
+@pytest.mark.perf_smoke
+def test_no_recompile_after_warmup_with_cache_enabled():
+    """PR-6's invariant survives the cache: after warmup() a run full
+    of hits, misses and publishes compiles ZERO new programs — the
+    copy ops are fixed-shape with traced indices."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=4, max_prompt=24,
+                  max_seq=72, prefill_budget=16, prefix_pool_pages=4)
+    eng.warmup()
+    sizes = (eng._decode._cache_size(), eng._mixed._cache_size(),
+             *eng.prefix.compile_cache_sizes())
+    shared = _prompt(cfg, 8, 61)
+    # Every prompt spans 2+ full pages with a distinct second page:
+    # 8 distinct pages through a 4-page pool forces LRU churn while
+    # the shared first page keeps hitting.
+    reqs = [Request(i, shared + _prompt(cfg, 9 + i % 3, 70 + i),
+                    max_new=2 + i % 3) for i in range(8)]
+    res = eng.run(reqs)
+    assert set(res) == {r.request_id for r in reqs}
+    assert eng.prefix.hits > 0
+    assert eng.prefix.evictions > 0      # pool of 4 pages churned
+    after = (eng._decode._cache_size(), eng._mixed._cache_size(),
+             *eng.prefix.compile_cache_sizes())
+    assert after == sizes, (sizes, after)
+
+
+def test_prefix_metrics_and_lookup_span(tmp_path, monkeypatch):
+    """skytpu_engine_prefix_* reach the exposition and the lookup is
+    one engine.prefix_lookup span under engine.prefill
+    (docs/tracing.md)."""
+    monkeypatch.setenv('SKYTPU_TRACE_DIR', str(tmp_path))
+    from skypilot_tpu import trace as trace_lib
+    trace_lib.seed_ids(13)
+    cfg, params = _setup()
+    eng = _engine(params, cfg)
+    shared = _prompt(cfg, 16, 81)
+    eng.run([Request('pub', shared + _prompt(cfg, 3, 82), max_new=2)])
+    eng.run([Request('hit', shared + _prompt(cfg, 5, 83), max_new=2)])
+
+    text = metrics_lib.render_exposition()
+    assert '# TYPE skytpu_engine_prefix_hits_total counter' in text
+    assert '\nskytpu_engine_prefix_hits_total 1\n' in text
+    assert '\nskytpu_engine_prefix_tokens_saved_total 16\n' in text
+    assert '# TYPE skytpu_engine_prefix_pool_pages gauge' in text
+    occupied = eng.prefix.stats()['occupied']
+    assert f'\nskytpu_engine_prefix_pool_pages {occupied}\n' in text
+    assert 'skytpu_engine_prefix_evictions_total' in text
+
+    spans = []
+    for f in os.listdir(tmp_path):
+        with open(tmp_path / f) as fh:
+            spans += [json.loads(ln) for ln in fh if ln.strip()]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s['name'], []).append(s)
+    lookups = by_name.get('engine.prefix_lookup', [])
+    assert len(lookups) == 2
+    prefill_ids = {s['span_id'] for s in by_name['engine.prefill']}
+    assert all(s['parent_id'] in prefill_ids for s in lookups)
+    hits = sorted(bool(s['attrs']['hit']) for s in lookups)
+    assert hits == [False, True]
+    hit_span = [s for s in lookups if s['attrs']['hit']][0]
+    assert hit_span['attrs']['reuse_tokens'] == 16
+    assert hit_span['attrs']['matched_pages'] == 2
+
+
+def test_cache_disabled_is_default_and_bit_identical():
+    """Default-off: no pool exists, no prefix metrics move, and the
+    engine's outputs match the solo oracle exactly as before."""
+    cfg, params = _setup()
+    eng = ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                        max_seq=128)
+    assert eng.prefix is None
+    p = _prompt(cfg, 11, 91)
+    res = eng.run([Request('r', p, max_new=4)])
+    assert res['r'].tokens == _solo_generate(params, cfg, p, 4)
+    summary = metrics_lib.summary()
+    assert summary.get('skytpu_engine_prefix_hits_total', 0) == 0
+    assert summary.get(
+        'skytpu_engine_prefix_tokens_saved_total', 0) == 0

@@ -172,6 +172,50 @@ def test_engine_rejections():
                       max_seq=64)
 
 
+def test_submit_rejects_duplicate_inflight_request_id(tmp_path,
+                                                      monkeypatch):
+    """Regression: submit() silently accepted a duplicate in-flight
+    request_id, clobbering the first request's _submitted_at and
+    _req_spans entries (leaking its open engine.request span and
+    corrupting its TTFT). It now rejects with a typed error and
+    leaves the original request untouched."""
+    import json
+    import os as _os
+
+    from skypilot_tpu import trace as trace_lib
+    from skypilot_tpu.models.serving_engine import DuplicateRequestError
+    monkeypatch.setenv('SKYTPU_TRACE_DIR', str(tmp_path))
+    trace_lib.seed_ids(3)
+    cfg, params = _setup()
+    engine = ServingEngine(params, cfg, batch_size=1, max_prompt=32,
+                           max_seq=64)
+    p1, p2 = _prompt(cfg, 6, 1), _prompt(cfg, 9, 2)
+    engine.submit(Request('dup', p1, max_new=3))
+    submitted_at = engine._submitted_at['dup']
+    span = engine._req_spans['dup']['request']
+    with pytest.raises(DuplicateRequestError,
+                       match='duplicate request_id'):
+        engine.submit(Request('dup', p2, max_new=3))
+    # The typed error is still a ValueError (HTTP 400 mapping).
+    assert issubclass(DuplicateRequestError, ValueError)
+    # Original tracking state untouched — same span, same timestamp.
+    assert engine._submitted_at['dup'] == submitted_at
+    assert engine._req_spans['dup']['request'] is span
+    assert len(engine.queue) == 1
+    while engine.queue or engine.num_active() or engine.has_pending:
+        engine.step()
+    res = engine.drain_results()
+    assert res['dup'].tokens == _solo_generate(params, cfg, p1, 3)
+    # Exactly ONE engine.request span was opened and it closed.
+    spans = []
+    for f in _os.listdir(tmp_path):
+        with open(tmp_path / f) as fh:
+            spans += [json.loads(ln) for ln in fh if ln.strip()]
+    reqs = [s for s in spans if s['name'] == 'engine.request']
+    assert len(reqs) == 1
+    assert engine._req_spans == {}
+
+
 def test_submit_rejects_empty_prompt_and_nonpositive_max_new():
     """Regression: an empty prompt used to reach prefill (no position
     to sample from -> undefined downstream behavior), and max_new <= 0
