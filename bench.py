@@ -348,6 +348,156 @@ def decode_bench():
         dt = (time.perf_counter() - t0) / steps
 
     tok_s = batch / dt
+
+    # ------------------------------------------------- spec phase
+    # Speculative draft-and-verify (BENCH_SPEC_K > 0; default on
+    # under BENCH_SMOKE): a repetitive-suffix workload — regeneration
+    # traffic, where the drafter's lookup corpus holds a previous
+    # completion of the SAME prompt (dedup/retry/replay traffic, the
+    # prefix-cache-era hot path). Greedy decoding is deterministic,
+    # so the regenerated suffix repeats the remembered one and the
+    # real prompt-lookup proposer drafts it from the corpus — the
+    # measured acceptance is organic n-gram matching, not an oracle
+    # bypass. Reports acceptance_rate / tokens_per_step /
+    # draft_time_s and the speedup against the plain phase above
+    # (CPU smoke proves the mechanism — parity + acceptance; the
+    # verify step is compute-amplified V-fold on CPU, so only a TPU
+    # run, where decode is bandwidth-bound, proves the >1.5x).
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    spec_k = int(os.environ.get('BENCH_SPEC_K',
+                                '4' if smoke else '0'))
+    spec_detail = None
+    if spec_k > 0 and (max_seq - context) < spec_k + 1:
+        # Not even ONE verify segment fits the cache headroom: a
+        # forced tick would clamp the segment write into live prompt
+        # columns and silently corrupt the measurement — skip, loudly.
+        spec_detail = {
+            'skipped': (f'headroom ({max_seq - context}) < verify '
+                        f'segment ({spec_k + 1}); raise '
+                        'BENCH_DECODE_HEADROOM or lower BENCH_SPEC_K')}
+        spec_k = 0
+    if spec_k > 0:
+        import functools as _ft
+
+        import numpy as np
+
+        from skypilot_tpu.models.serving_engine import _prompt_lookup
+        v_seg = spec_k + 1
+        # The verify frontier advances V columns per step regardless
+        # of acceptance: bound the phase so an all-reject worst case
+        # still fits the cache headroom (>= 1 by the guard above).
+        spec_steps = min(steps, (max_seq - context) // v_seg)
+        logits0, cache_s = jax.jit(
+            lambda p, t, n: inference.prefill(p, t, n, cfg,
+                                              kv_quant=kv_quant),
+        )(params, prompt, lengths)
+        tok0 = jnp.argmax(logits0, -1).astype(jnp.int32)
+        num_pages_spec = (da.num_pages_for(
+            context + spec_steps * v_seg, page, total_pages)
+            if num_pages is not None else None)
+
+        def collect(params, cache, tok):
+            def body(carry, _):
+                cache, tok = carry
+                logits, cache = inference.decode_step(
+                    params, cache, tok, cfg, attn_impl=effective_attn,
+                    num_pages=num_pages_spec, page=page)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (cache, nxt), nxt
+            (cache, tok), toks = lax.scan(body, (cache, tok), None,
+                                          length=spec_steps)
+            return tok, toks                    # toks [steps, B]
+
+        # The remembered completion (NOT donated: the spec run below
+        # regenerates from the same prefilled cache).
+        _, prev = jax.jit(collect)(params, cache_s, tok0)
+        prev = np.asarray(prev).T               # [B, steps]
+
+        vstep = jax.jit(
+            _ft.partial(inference.verify_step, cfg=cfg,
+                        num_pages=num_pages_spec, page=page),
+            donate_argnums=(1,))
+        temps = jnp.zeros((batch,), jnp.float32)
+        vkey = jax.random.PRNGKey(3)
+        prompt_host = np.asarray(prompt)
+        corpus = [list(prompt_host[b]) + [int(tok0[b])] +
+                  [int(t) for t in prev[b]] for b in range(batch)]
+        gen = [[int(tok0[b])] for b in range(batch)]
+        ngram = int(os.environ.get('SKYTPU_SPEC_NGRAM', '3'))
+        # Warm the verify program outside the timed window.
+        drafts0 = jnp.zeros((batch, spec_k), jnp.int32)
+        slen0 = jnp.zeros((batch,), jnp.int32)
+        _e, _c, _t, warm_cache = vstep(params, cache_s, tok0, drafts0,
+                                       slen0, key=vkey,
+                                       temperature=temps, top_k=0)
+        _ = int(_c[0])
+        del warm_cache
+        logits0, cache_s = jax.jit(
+            lambda p, t, n: inference.prefill(p, t, n, cfg,
+                                              kv_quant=kv_quant),
+        )(params, prompt, lengths)
+        tok = jnp.argmax(logits0, -1).astype(jnp.int32)
+
+        proposed = accepted = ticks = 0
+        draft_t = 0.0
+        with _bench_span('decode_spec', batch=batch, k=spec_k,
+                         steps=spec_steps):
+            t0 = time.perf_counter()
+            while min(len(g) for g in gen) < spec_steps + 1:
+                td = time.perf_counter()
+                drafts = np.zeros((batch, spec_k), np.int32)
+                slen = np.zeros((batch,), np.int32)
+                for b in range(batch):
+                    if len(gen[b]) > spec_steps:
+                        continue
+                    # Lookup chain = remembered turn + the current
+                    # regeneration (ends at the current token).
+                    d = _prompt_lookup(corpus[b] + gen[b],
+                                       spec_k, ngram)
+                    drafts[b, :len(d)] = d
+                    slen[b] = len(d)
+                    proposed += len(d)
+                draft_t += time.perf_counter() - td
+                emit, counts, tok, cache_s = vstep(
+                    params, cache_s, tok, jnp.asarray(drafts),
+                    jnp.asarray(slen), key=vkey, temperature=temps,
+                    top_k=0)
+                emit_h = np.asarray(emit)
+                counts_h = np.asarray(counts)
+                ticks += 1
+                for b in range(batch):
+                    e = int(counts_h[b])
+                    accepted += max(0, e - 1)
+                    gen[b].extend(int(t) for t in emit_h[b, :e])
+            dt_spec = time.perf_counter() - t0
+        spec_tokens = sum(min(len(g) - 1, spec_steps) for g in gen)
+        spec_tok_s = spec_tokens / dt_spec
+        parity = all(
+            gen[b][1:spec_steps + 1] == [int(t) for t in
+                                         prev[b][:spec_steps]]
+            for b in range(batch))
+        spec_detail = {
+            'k': spec_k,
+            'steps': spec_steps,
+            'verify_ticks': ticks,
+            'proposed': proposed,
+            'accepted': accepted,
+            'acceptance_rate': (round(accepted / proposed, 4)
+                                if proposed else None),
+            # Same spec_steps clamp as spec_tokens: rows that were
+            # already done keep riding the remaining vsteps, and
+            # their overshoot tokens must not inflate per-step yield.
+            'tokens_per_step': round(
+                spec_tokens / max(1, ticks * batch), 3),
+            'draft_time_s': round(draft_t, 4),
+            'spec_tok_s': round(spec_tok_s, 1),
+            'speedup_vs_plain': round(spec_tok_s / tok_s, 3),
+            'greedy_parity': parity,
+            'workload': 'repetitive-suffix (regeneration: lookup '
+                        'corpus holds a previous completion of the '
+                        'same prompt)',
+        }
+
     # MoE models normalize by ACTIVE params (same convention as the
     # train bench) — a served token is only "worth" its top-k
     # experts' flops, whatever the dispatch actually computes.
@@ -379,6 +529,9 @@ def decode_bench():
             'backend': jax.default_backend(),
             'decode_mfu_pct': round(decode_mfu * 100, 2),
             'baseline_decode_mfu_pct': round(base_mfu * 100, 2),
+            # Speculative draft-and-verify phase (BENCH_SPEC_K;
+            # PERFORMANCE.md "Speculative decoding"): None when off.
+            'spec': spec_detail,
         },
     }
     trace_file = _merged_trace_path()
@@ -447,6 +600,14 @@ def serve_bench():
     smoke = os.environ.get('BENCH_SMOKE') == '1'
     prefix_on = os.environ.get(
         'BENCH_SERVE_PREFIX', '1' if smoke else '0') == '1'
+    # Speculative decoding (BENCH_SPEC_K; default on under
+    # BENCH_SMOKE so the smoke subprocess guards the spec flags and
+    # the verify/rollback machinery under real serving load): the
+    # engine's prompt-lookup proposer drafts from each request's own
+    # chain, so acceptance here is whatever the workload's repetition
+    # organically sustains — greedy parity holds regardless.
+    spec_k = int(os.environ.get('BENCH_SPEC_K',
+                                '4' if smoke else '0'))
     if not on_tpu:
         n_requests, batch, max_prompt, max_new = 6, 2, 64, 8
         cfg = models.LlamaConfig.tiny(max_seq=256)
@@ -512,7 +673,13 @@ def serve_bench():
                            prefix_pool_pages=(
                                int(os.environ['BENCH_SERVE_PREFIX_PAGES'])
                                if os.environ.get('BENCH_SERVE_PREFIX_PAGES')
-                               else None))
+                               else None),
+                           # An explicit BENCH_SPEC_K=0 must yield a
+                           # spec-OFF baseline even under ambient
+                           # SKYTPU_SPEC_DECODE=1 (A/B integrity), so
+                           # pass False, never None, when disabled.
+                           spec_decode=spec_k > 0,
+                           spec_k=spec_k if spec_k > 0 else None)
     rng = np.random.default_rng(0)
     reqs = []
     if prefix_on:
@@ -633,6 +800,10 @@ def serve_bench():
             'prefix': ({'enabled': True, **engine.prefix.stats()}
                        if engine.prefix is not None
                        else {'enabled': False}),
+            # Speculation accounting (acceptance_rate is organic
+            # prompt-lookup matching on this workload; greedy parity
+            # is engine-guaranteed whatever it reads).
+            'spec': engine.spec_stats(),
             # The engine's own ops counters (tokens, TTFT + ITL
             # histograms, prefill-token counter, cache resets) from
             # THIS run: the perf trajectory and the serving metrics
@@ -801,6 +972,12 @@ _ALL_MODES = {
     # Shared-prefix (Zipf) workload with the prefix cache on: the
     # hit-rate / tokens-saved / pool-occupancy numbers for the round.
     'serve_prefix': {'BENCH_MODE': 'serve', 'BENCH_SERVE_PREFIX': '1'},
+    # Speculative draft-and-verify: the decode spec phase measures
+    # tokens/step + speedup on the repetitive-suffix (regeneration)
+    # workload; the serve mode exercises the engine's verify ticks
+    # under real continuous-batching load.
+    'decode_spec': {'BENCH_MODE': 'decode', 'BENCH_SPEC_K': '4'},
+    'serve_spec': {'BENCH_MODE': 'serve', 'BENCH_SPEC_K': '4'},
     'serve_stack': {'BENCH_MODE': 'serve_stack'},
 }
 

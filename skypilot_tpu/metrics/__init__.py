@@ -62,7 +62,14 @@ def render_exposition(registry=None, include_spool: bool = False) -> str:
 def summary(registry=None) -> dict:
     """Flat ``{'name{label="v"}': value}`` dict of counters/gauges
     (histograms reduce to ``_count``/``_sum``) — the compact form
-    bench.py embeds in each round's JSON detail."""
+    bench.py embeds in each round's JSON detail.
+
+    One derived line: when the serving engine's speculative-decoding
+    counters have moved, ``skytpu_engine_spec_acceptance_rate`` =
+    accepted/proposed is added (a ratio of counters is not a metric
+    the registry stores, but it is THE number an operator reads the
+    spec counters for — rendering it here keeps every bench detail
+    and scrape summary self-interpreting)."""
     registry = registry or REGISTRY
     out = {}
     for name, fam in registry.families().items():
@@ -75,6 +82,11 @@ def summary(registry=None) -> dict:
                 out[f'{series_name}_sum'] = round(s['sum'], 6)
             else:
                 out[series_name] = s['value']
+    proposed = out.get('skytpu_engine_spec_proposed_tokens_total', 0)
+    if proposed:
+        out['skytpu_engine_spec_acceptance_rate'] = round(
+            out.get('skytpu_engine_spec_accepted_tokens_total', 0) /
+            proposed, 4)
     return out
 
 

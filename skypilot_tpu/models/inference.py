@@ -641,14 +641,246 @@ def prefill_chunk(params: Dict,
     return logits, new_cache
 
 
-def _sample(logits, key, temperature, top_k: int):
+def verify_step(params: Dict,
+                cache: Dict,
+                tokens: jax.Array,
+                drafts: jax.Array,
+                spec_len: jax.Array,
+                cfg: LlamaConfig,
+                key: jax.Array,
+                temperature: jax.Array,
+                top_k,
+                mesh=None,
+                active: Optional[jax.Array] = None,
+                *,
+                num_pages: Optional[int] = None,
+                page: Optional[int] = None
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, Dict]:
+    """One draft-and-verify speculative step (Leviathan et al. 2023):
+    score every drafted candidate in a single forward and accept the
+    longest prefix the model itself would have produced.
+
+    tokens: [B] — each row's current (sampled, not-yet-fed) token;
+    drafts: [B, K] — up to K proposed continuation tokens per row
+    (host-side n-gram/prompt-lookup proposer); spec_len: [B] int32 in
+    [0, K] — how many of the K are real (0 = the row runs a plain
+    one-token step inside the same program). The row's verify segment
+    f_0..f_V-1 = [token, d_1..d_K] (V = K+1) is fed at positions
+    length..length+V-1, its K/V written at the shared write-frontier
+    columns [base+steps, base+steps+V), and every position's
+    next-token distribution computed in ONE forward — attention runs
+    ``ops.flash_attention.verify_attention`` (dmask-valid +
+    segment-causal into the paged cache; int8 scales via the
+    reference path, same discipline as decode).
+
+    Acceptance is exact greedy/sampling equivalence per position:
+    sample m_i from position i's logits (per-row temperature, traced
+    top_k); accept the longest prefix with d_{i+1} == m_i (i <
+    spec_len); the first rejected position falls back to m_a — the
+    model's own sample for that position, which is bitwise what the
+    sequential path would have emitted. Rows therefore always advance
+    >= 1 token and greedy outputs are bitwise identical to
+    speculation-off. K/V written for rejected candidates are rolled
+    back through the existing dmask/length machinery: only columns
+    base+steps+i with i <= accepted become readable, lengths advance
+    by accepted+1, and the dead columns stay dmask-false forever
+    (the shared frontier still advances V — capacity accounting is
+    the engine's spec guard).
+
+    Returns (emit [B, V] — tokens to surface, valid up to counts;
+    counts [B] — accepted+1 for active rows, 0 otherwise;
+    next_tok [B] — the new current token (frozen for inactive rows);
+    updated cache). ``num_pages`` bounds the attention read region
+    exactly as in decode_step and must cover base+steps+V.
+    """
+    # Direct-from-module import (see prefill_chunk): the ops package
+    # re-exports a ``flash_attention`` function under the module name.
+    from skypilot_tpu.ops.flash_attention import verify_attention
+    cdt = cfg.compute_dtype
+    b, k_max = drafts.shape
+    v = k_max + 1
+    quant = 'k_scale' in cache
+    pos = cache['length']                       # [B] logical position
+    base, steps = cache['base'], cache['steps']
+    slot = base + steps                         # scalar segment start
+    valid = cache['dmask']
+    if active is None:
+        active = jnp.ones((b,), bool)
+
+    s_max = cache['k'].shape[2]
+    page = page or decode_attn.default_page()
+    n_slots = None
+    if num_pages is not None:
+        n_slots = min(num_pages * page, s_max)
+        if n_slots >= s_max:
+            n_slots = None                   # full cache; no slicing
+    # int8 caches and sharded meshes verify through the exact einsum
+    # reference (same rule as chunk prefill); bf16 single-chip TPU
+    # runs the Pallas verify kernel.
+    impl = 'xla' if (mesh is not None or quant) else None
+
+    fed = jnp.concatenate(
+        [tokens[:, None], drafts.astype(jnp.int32)], axis=1)  # [B, V]
+    positions = pos[:, None] + jnp.arange(v, dtype=jnp.int32)[None, :]
+
+    x = qembed(params['tok_emb'], fed, cdt)     # [B, V, D]
+    x = _constrain(x, P(('dp', 'fsdp'), None, None), mesh)
+
+    def layer(carry, inp):
+        if quant:
+            x, kc, vc, ksc, vsc = carry
+        else:
+            x, kc, vc = carry
+            ksc = vsc = None
+        lp, li = inp
+        h = _rmsnorm(x, lp['attn_norm'], cfg.norm_eps)
+        q = qdot(h, lp['wq'], cdt).reshape(b, v, cfg.n_heads,
+                                           cfg.head_dim)
+        k = qdot(h, lp['wk'], cdt).reshape(b, v, cfg.n_kv_heads,
+                                           cfg.head_dim)
+        vv = qdot(h, lp['wv'], cdt).reshape(b, v, cfg.n_kv_heads,
+                                            cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        kc_l = lax.dynamic_index_in_dim(kc, li, 0, keepdims=False)
+        vc_l = lax.dynamic_index_in_dim(vc, li, 0, keepdims=False)
+        if quant:
+            ksc_l = lax.dynamic_index_in_dim(ksc, li, 0,
+                                             keepdims=False)
+            vsc_l = lax.dynamic_index_in_dim(vsc, li, 0,
+                                             keepdims=False)
+            wk, sk = _quantize_kv(k)
+            wv, sv = _quantize_kv(vv)
+        else:
+            ksc_l = vsc_l = None
+            wk, wv, sk, sv = k, vv, None, None
+        # Write the whole V-token segment BEFORE attending (like
+        # prefill_chunk): query i then reads f_0..f_i through the
+        # segment-causal term; rejected candidates' columns are
+        # rolled back below via dmask, never via a cache rewrite.
+        kc_l = lax.dynamic_update_slice(
+            kc_l, wk.astype(kc_l.dtype), (0, slot, 0, 0))
+        vc_l = lax.dynamic_update_slice(
+            vc_l, wv.astype(vc_l.dtype), (0, slot, 0, 0))
+        if quant:
+            ksc_l = lax.dynamic_update_slice(
+                ksc_l, sk.astype(ksc_l.dtype), (0, slot, 0))
+            vsc_l = lax.dynamic_update_slice(
+                vsc_l, sv.astype(vsc_l.dtype), (0, slot, 0))
+        pk, pv, vd = kc_l, vc_l, valid
+        pks, pvs = ksc_l, vsc_l
+        if n_slots is not None:
+            # Length-aware slice: only the live region is read.
+            pk, pv = pk[:, :n_slots], pv[:, :n_slots]
+            vd = valid[:, :n_slots]
+            if quant:
+                pks = pks[:, :n_slots]
+                pvs = pvs[:, :n_slots]
+        o = verify_attention(q, pk, pv, vd, slot,
+                             k_scale=pks, v_scale=pvs, impl=impl)
+        o = o.reshape(b, v, cfg.n_heads * cfg.head_dim).astype(cdt)
+        x = x + qdot(o, lp['wo'], cdt)
+
+        h = _rmsnorm(x, lp['mlp_norm'], cfg.norm_eps)
+        x = x + _mlp_delta(h, lp, cfg)
+
+        kc = lax.dynamic_update_slice(
+            kc, kc_l[None], (li,) + (0,) * (kc.ndim - 1))
+        vc = lax.dynamic_update_slice(
+            vc, vc_l[None], (li,) + (0,) * (vc.ndim - 1))
+        if quant:
+            ksc = lax.dynamic_update_slice(
+                ksc, ksc_l[None], (li,) + (0,) * (ksc.ndim - 1))
+            vsc = lax.dynamic_update_slice(
+                vsc, vsc_l[None], (li,) + (0,) * (vsc.ndim - 1))
+            return (x, kc, vc, ksc, vsc), None
+        return (x, kc, vc), None
+
+    if quant:
+        carry0 = (x, cache['k'], cache['v'], cache['k_scale'],
+                  cache['v_scale'])
+    else:
+        carry0 = (x, cache['k'], cache['v'])
+    out_carry, _ = lax.scan(
+        layer, carry0, (params['layers'], jnp.arange(cfg.n_layers)))
+    if quant:
+        x, ks, vs, sks, svs = out_carry
+    else:
+        (x, ks, vs), sks, svs = out_carry, None, None
+    x = _rmsnorm(x, params['final_norm'], cfg.norm_eps)
+    logits = qdot(x, params['lm_head'], cdt,
+                  preferred=jnp.float32)        # [B, V, vocab]
+
+    # Per-position sampling (greedy rows: argmax; the RNG split only
+    # matters for temperature > 0 rows, whose spec_len is 0 — they
+    # just draw their one sample from position 0's logits).
+    keys = jax.random.split(key, v)
+    m = jnp.stack([
+        _sample(logits[:, i], keys[i], temperature, top_k)
+        for i in range(v)], axis=1)             # [B, V]
+
+    # Longest accepted prefix: d_{i+1} == m_i, i < spec_len.
+    cmp = (drafts.astype(jnp.int32) == m[:, :-1])          # [B, K]
+    within = (jnp.arange(k_max, dtype=jnp.int32)[None, :] <
+              spec_len[:, None])
+    acc = jnp.cumprod((cmp & within).astype(jnp.int32), axis=1)
+    a = jnp.sum(acc, axis=1)                    # [B] accepted drafts
+
+    # Emission: d_1..d_a then m_a (the model's own token for the
+    # first rejected position — or the bonus token when all accept).
+    jidx = jnp.arange(v, dtype=jnp.int32)[None, :]
+    drafts_pad = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+        axis=1)
+    emit = jnp.where(jidx < a[:, None], drafts_pad, m)
+    counts = jnp.where(active, a + 1, 0)
+    next_tok = jnp.take_along_axis(m, a[:, None], axis=1)[:, 0]
+    # Inactive rows freeze their token chain (same rule as the decode
+    # scan): a just-prefilled slot's first token must survive.
+    next_tok = jnp.where(active, next_tok, tokens)
+
+    # dmask rollback: within the segment columns, exactly f_0..f_a
+    # become readable for active rows; rejected candidates' K/V stay
+    # dark forever. Columns outside the segment keep their mask.
+    cols = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+    seg = (cols >= slot) & (cols < slot + v)
+    keep = active[:, None] & ((cols - slot) <= a[:, None])
+    dmask = jnp.where(seg, keep, cache['dmask'])
+    new_cache = {'k': _constrain(ks, CACHE_SPEC, mesh),
+                 'v': _constrain(vs, CACHE_SPEC, mesh),
+                 'length': jnp.where(active, pos + a + 1, pos),
+                 'dmask': _constrain(dmask, P(('dp', 'fsdp'), None),
+                                     mesh),
+                 'base': base, 'steps': steps + v}
+    if quant:
+        new_cache['k_scale'] = _constrain(sks, SCALE_SPEC, mesh)
+        new_cache['v_scale'] = _constrain(svs, SCALE_SPEC, mesh)
+    return emit, counts, next_tok, new_cache
+
+
+def _sample(logits, key, temperature, top_k):
     """temperature is a *traced* value (<= 0 means greedy) — a scalar,
     or a [B] vector for per-request temperatures in one batch — so a
-    server can vary it per request without recompiling; top_k is
-    static (it shapes the threshold computation)."""
-    if top_k > 0 and top_k < logits.shape[-1]:
-        thresh = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    server can vary it per request without recompiling. top_k is
+    traced too (<= 0 or >= vocab disables the filter): varying it per
+    call reuses the compiled program. The filter branch lives under
+    ``lax.cond`` so the unfiltered/greedy path never pays the vocab
+    sort it used to skip statically."""
+    vocab = logits.shape[-1]
+    tk = jnp.asarray(top_k, jnp.int32)
+
+    def _filtered(lg):
+        # Threshold at the top_k-th largest logit: ascending sort,
+        # element vocab - top_k (the old static ``[:, -top_k]``),
+        # fetched at a traced index.
+        srt = jnp.sort(lg, axis=-1)
+        idx = jnp.clip(vocab - tk, 0, vocab - 1)
+        thresh = lax.dynamic_slice_in_dim(srt, idx, 1, axis=-1)
+        return jnp.where(lg < thresh, -jnp.inf, lg)
+
+    logits = lax.cond((tk > 0) & (tk < vocab), _filtered,
+                      lambda lg: lg, logits)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temp = jnp.asarray(temperature, jnp.float32)
     t = jnp.maximum(temp, 1e-6)
@@ -687,9 +919,11 @@ def generate(params: Dict,
                          page or decode_attn.default_page())
 
 
+# top_k is deliberately NOT in the static set: _sample traces it, so
+# a server varying top_k per request (or a bench sweeping it) reuses
+# the compiled program exactly like temperature always has.
 @functools.partial(jax.jit, static_argnames=(
-    'cfg', 'max_new', 'top_k', 'max_seq', 'kv_quant', 'attn_impl',
-    'page'))
+    'cfg', 'max_new', 'max_seq', 'kv_quant', 'attn_impl', 'page'))
 def _generate_jit(params: Dict,
                   tokens: jax.Array,
                   lengths: jax.Array,

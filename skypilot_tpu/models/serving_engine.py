@@ -35,6 +35,24 @@ what the decode step sustains. This engine recycles slots:
   effective capacity, not just TTFT. Terminal slots publish their
   final prompt pages back and release their pins. Off (default) the
   engine is bit-identical to a build without the cache.
+- **speculative multi-token decoding** (``SKYTPU_SPEC_DECODE=1``;
+  Leviathan et al. 2023, proposer in the spirit of prompt-lookup /
+  n-gram decoding, Saxena 2023): decode MFU is pinned by one token
+  per model step — the MXU idles while HBM streams the same weights
+  every step. A host-side prompt-lookup proposer drafts up to
+  ``SKYTPU_SPEC_K`` candidate tokens per greedy decode slot from the
+  slot's own token chain; the tick's batched verify pass
+  (``inference.verify_step`` over
+  ``ops.flash_attention.verify_attention``) scores all of them in ONE
+  forward and accepts the longest prefix matching the model's own
+  samples, falling back to the model's token at the first rejection —
+  greedy outputs stay bitwise identical to speculation-off. Rejected
+  candidates' K/V roll back through the existing dmask/length
+  machinery; sampling (temperature>0) slots transparently bypass
+  speculation; a capacity guard falls back to the plain decode chunk
+  near region exhaustion so the finish guarantee is untouched. Spec
+  tick shapes are keyed on ``(spec_k,)`` and compiled in
+  ``warmup()`` — no recompiles after warmup, speculation on or off.
 - optional int8 KV cache (``kv_quant=True``): half the decode
   bandwidth, which at fixed HBM doubles ``batch_size``;
 - double-buffered dispatch: the next-token vector lives on device, so
@@ -123,7 +141,10 @@ _M_ITL = metrics_lib.histogram(
     'Inter-token latency: gap between consecutive token batches '
     'surfaced to one request (the streaming stall a client feels). '
     'With chunked prefill its p99 is bounded by the tick budget, not '
-    'by co-admitted prompt lengths.',
+    'by co-admitted prompt lengths. Acceptance-aware by '
+    'construction: a speculative burst observes its full gap ONCE '
+    '(never gap/burst-size) — accepted drafts widen bursts, they '
+    'never shrink the reported stall.',
     buckets=metrics_lib.LATENCY_BUCKETS)
 _M_CANCELS = metrics_lib.counter(
     'skytpu_engine_cancels_total',
@@ -138,11 +159,64 @@ _M_TICK_HANGS = metrics_lib.counter(
     'wedged device tick must be visible, not a silent stall).')
 _M_TOKEN_LATENCY = metrics_lib.histogram(
     'skytpu_engine_per_token_seconds',
-    'Decode latency per emitted token: engine tick interval over '
-    'tokens emitted that tick (chunk-granular; in steady state the '
-    'tick interval IS the device chunk time, thanks to the '
-    'double-buffered dispatch).',
+    'Decode latency per MODEL-STEP token: engine tick interval over '
+    'tokens emitted that tick MINUS speculatively accepted draft '
+    'tokens (chunk-granular; in steady state the tick interval IS '
+    'the device chunk time, thanks to the double-buffered dispatch). '
+    'Acceptance-aware: a 4-token accepted burst rides along free in '
+    'wall-time and must not deflate the reported per-token latency '
+    '4x — speculative throughput shows up in tokens_total and the '
+    'spec counters instead.',
     buckets=metrics_lib.FAST_LATENCY_BUCKETS)
+_M_SPEC_PROPOSED = metrics_lib.counter(
+    'skytpu_engine_spec_proposed_tokens_total',
+    'Draft tokens proposed to verify ticks by the prompt-lookup '
+    'proposer (SKYTPU_SPEC_DECODE; accepted/proposed is the '
+    'acceptance rate metrics.summary() derives).')
+_M_SPEC_ACCEPTED = metrics_lib.counter(
+    'skytpu_engine_spec_accepted_tokens_total',
+    'Drafted tokens the batched verify pass accepted (each one is an '
+    'output token that skipped a sequential decode step).')
+
+# Consecutive no-draft proposal rounds before the engine goes "dry":
+# while dry, ticks stay fully pipelined (no flush) and proposals only
+# probe for a re-arm — never-matching traffic pays a bounded number
+# of flushes for speculation being enabled.
+_SPEC_DRY_AFTER = 4
+# Cap on the doubling re-arm cooldown (dry probe-hit rounds): keeps a
+# reject-latched engine retrying speculation eventually — workloads
+# shift as slots turn over — while bounding the steady-state waste.
+_SPEC_COOLDOWN_MAX = 256
+
+
+def _prompt_lookup(chain: Sequence[int], k: int,
+                   max_ngram: int) -> List[int]:
+    """Model-free n-gram draft proposer (prompt-lookup decoding,
+    Saxena 2023): find the most recent EARLIER occurrence of the
+    chain's trailing n-gram (longest n first, n = max_ngram..1) and
+    propose the up-to-``k`` tokens that followed it. Pure host-side
+    numpy — sliding-window equality, no device work, no model. Hot
+    traffic that repeats prompt text (the prefix-cache workloads)
+    is exactly where this hits. Returns [] when nothing matches.
+    """
+    n_total = len(chain)
+    if n_total < 2 or k <= 0:
+        return []
+    arr = np.asarray(chain, np.int64)
+    for n in range(min(max_ngram, n_total - 1), 0, -1):
+        pat = arr[n_total - n:]
+        # Windows at start positions [0, n_total - n): every strictly
+        # earlier occurrence of the trailing n-gram (the window AT
+        # n_total - n is the pattern itself).
+        win = np.lib.stride_tricks.sliding_window_view(
+            arr, n)[:n_total - n]
+        hits = np.nonzero((win == pat).all(axis=1))[0]
+        if hits.size:
+            s = int(hits[-1])          # most recent match wins
+            cont = chain[s + n:s + n + k]
+            if len(cont):       # len(): chain may be a numpy view
+                return [int(t) for t in cont]
+    return []
 
 
 class DuplicateRequestError(ValueError):
@@ -199,6 +273,18 @@ class _SlotState:
     # Chain hashes of the prompt's full pages, carried over from the
     # admission lookup so publish() never re-hashes the prompt.
     prompt_hashes: Optional[List[bytes]] = None
+    # Speculative-decode draft for the NEXT tick (SKYTPU_SPEC_DECODE):
+    # up to spec_k candidate tokens the prompt-lookup proposer
+    # predicts follow the chain's current token. Re-proposed every
+    # tick from the fresh chain; None = no match / sampling slot.
+    draft: Optional[List[int]] = None
+    # Incremental token-chain buffer for the proposer (int64 numpy,
+    # doubling capacity): rebuilding prompt+generated as a fresh list
+    # + array every tick would put O(chain) host work per slot on the
+    # (unpipelined) spec critical path. chain_len tracks the filled
+    # region; only newly generated tokens append per tick.
+    chain_buf: Optional[np.ndarray] = None
+    chain_len: int = 0
 
 
 @dataclasses.dataclass
@@ -239,7 +325,10 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  prefill_budget: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 prefix_pool_pages: Optional[int] = None) -> None:
+                 prefix_pool_pages: Optional[int] = None,
+                 spec_decode: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None) -> None:
         # ``mesh``: serve a model larger than one chip — params shard
         # Megatron-style (tp on heads/ffn/vocab) and the KV cache's
         # kv-head axis shards over 'tp' (inference.CACHE_SPEC), the
@@ -365,6 +454,66 @@ class ServingEngine:
             self.prefix = prefix_mod.PrefixCache(
                 cfg, page=self._page, pool_pages=pool_pages,
                 kv_quant=kv_quant)
+        # Speculative multi-token decoding (SKYTPU_SPEC_DECODE /
+        # SKYTPU_SPEC_K / SKYTPU_SPEC_NGRAM; PERFORMANCE.md
+        # "Speculative decoding"): a host-side prompt-lookup proposer
+        # drafts up to spec_k tokens per greedy decode slot; the tick
+        # verifies all of them in ONE forward and accepts the longest
+        # prefix matching the model's own samples. Off by default —
+        # disabled, every tick below is bit-identical to the
+        # pre-speculation engine.
+        enable_spec = spec_decode
+        if enable_spec is None:
+            enable_spec = env_registry.is_enabled(
+                env_registry.SKYTPU_SPEC_DECODE)
+        k_req = spec_k if spec_k is not None else int(env_registry.get(
+            env_registry.SKYTPU_SPEC_K, '4'))
+        if enable_spec and k_req < 1:
+            # An explicit 0 (ctor, --spec-k, SKYTPU_SPEC_K) means "no
+            # draft tokens" — honor it as spec-off rather than
+            # silently substituting the default.
+            logger.warning(
+                'Speculative decoding disabled: spec_k=%d requests '
+                'no draft tokens.', k_req)
+            enable_spec = False
+        self.spec_k = max(1, k_req)
+        self._spec_ngram = max(1, spec_ngram or int(env_registry.get(
+            env_registry.SKYTPU_SPEC_NGRAM, '3')))
+        self._spec_v = self.spec_k + 1      # fed segment width
+        if enable_spec and self._spec_v > self.decode_capacity():
+            logger.warning(
+                'Speculative decoding disabled: the verify segment '
+                '(%d columns) exceeds the decode region (%d); raise '
+                'max_seq or lower SKYTPU_SPEC_K.', self._spec_v,
+                self.decode_capacity())
+            enable_spec = False
+        self.spec_decode = bool(enable_spec)
+        # Host-side speculation accounting (bench.py spec detail).
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_emitted_total = 0
+        self.spec_ticks = 0
+        self.spec_row_steps = 0
+        self.spec_draft_s = 0.0
+        # Accepted drafts surfaced by the tick being processed: the
+        # acceptance-aware divisor for skytpu_engine_per_token_seconds.
+        self._tick_accepted = 0
+        # Dry-spell latch with hysteresis: after _SPEC_DRY_AFTER
+        # consecutive eligible proposal rounds matched nothing,
+        # step() keeps the pipelined dispatch (no flush) and only
+        # PROBES the chain for a re-arm — steady no-match traffic
+        # pays a bounded number of flushes, then nothing, for
+        # speculation being on.
+        self._spec_dry = False
+        self._spec_misses = 0
+        # Re-arm cooldown, in dry probe-hit rounds: doubles each time
+        # the latch re-arms without an accepted draft since, so a
+        # proposer whose matches the model never confirms (spurious
+        # short n-grams) decays to a vanishing fraction of verify
+        # ticks instead of oscillating at the hysteresis period; any
+        # accepted draft resets it to re-arm-immediately.
+        self._spec_cooldown = 0
+        self._spec_dry_rounds = 0
 
         self.queue: collections.deque = collections.deque()
         self.slots: List[Optional[_SlotState]] = [None] * batch_size
@@ -491,10 +640,10 @@ class ServingEngine:
         self._decode = _decode
 
         @functools.partial(jax.jit, donate_argnums=(1, 2),
-                           static_argnames=('n', 'num_pages'))
+                           static_argnames=('n', 'num_pages', 'spec'))
         def _mixed(params, cache, cur_tokens, ctoks, cstarts, clens,
-                   clive, clast, cslots, active, key, temperature, *,
-                   n, num_pages=None):
+                   clive, clast, cslots, active, key, temperature,
+                   drafts, spec_len, *, n, num_pages=None, spec=0):
             """ONE fused mixed tick: up to G prefill chunk rows
             (inference.prefill_chunk — [G, C] statically shaped, the
             per-tick token budget) PLUS the ``n``-step decode scan
@@ -505,7 +654,16 @@ class ServingEngine:
             following decode chunk consumes it WITHOUT a host sync;
             host values sync lazily for emission. Prefilling slots
             are decode-inactive, so chunk writes and decode
-            reads/writes never touch the same row."""
+            reads/writes never touch the same row.
+
+            ``spec`` (static, the verify segment width V = spec_k+1;
+            0 = off) swaps the decode scan for the batched
+            draft-and-verify pass (inference.verify_step): every
+            active slot feeds its current token plus its drafted
+            candidates, one forward scores them all, and each row
+            advances by its accepted prefix + 1. Shapes are keyed on
+            spec alone, so spec ticks compile once per page count in
+            warmup() exactly like decode chunks."""
             key_p, key_d = jax.random.split(key)
             logits, cache = inference.prefill_chunk(
                 params, cache, ctoks, cstarts, clens, clive,
@@ -519,12 +677,33 @@ class ServingEngine:
                     take[j],
                     cur_tokens.at[cslots[j]].set(firsts[j]),
                     cur_tokens)
+            if spec:
+                emit, counts, cur_tokens, cache = inference.verify_step(
+                    params, cache, cur_tokens, drafts, spec_len,
+                    self.cfg, key_d, temperature, self.top_k,
+                    mesh=self.mesh, active=active,
+                    num_pages=num_pages, page=self._page)
+                return cache, emit, cur_tokens, firsts, counts
             cache, toks, last = _decode_scan(
                 params, cache, cur_tokens, active, key_d, temperature,
                 n, num_pages)
-            return cache, toks, last, firsts
+            return cache, toks, last, firsts, None
 
         self._mixed = _mixed
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=('num_pages',))
+        def _spec_tick(params, cache, cur_tokens, drafts, spec_len,
+                       active, key, temperature, *, num_pages=None):
+            """Verify-only tick (no prefilling slot this tick): the
+            batched draft-and-verify pass alone — the speculative
+            counterpart of the decode-only fast path."""
+            return inference.verify_step(
+                params, cache, cur_tokens, drafts, spec_len, self.cfg,
+                key, temperature, self.top_k, mesh=self.mesh,
+                active=active, num_pages=num_pages, page=self._page)
+
+        self._spec = _spec_tick
         # Per-slot current token fed into the next decode step —
         # DEVICE-resident: the token chain between chunks (and from
         # prefill into the first chunk) resolves on device, which is
@@ -534,10 +713,21 @@ class ServingEngine:
         # engine default; temperature is traced, so this never
         # recompiles).
         self._temps = np.full((batch_size,), temperature, np.float32)
+        # All-zero draft arrays for non-speculative mixed ticks (the
+        # traced args exist either way; only spec ticks fill them).
+        self._drafts0 = jnp.zeros((batch_size, self.spec_k), jnp.int32)
+        self._slen0 = jnp.zeros((batch_size,), jnp.int32)
         # Gauges exist (as 0) from boot, so a scrape of an idle
         # replica still sees the full metric surface.
         _M_QUEUE_DEPTH.touch()
         _M_ACTIVE_SLOTS.touch()
+        if self.spec_decode:
+            # Spec counters exist (as 0) the moment speculation is
+            # on: an all-reject workload must still scrape a 0
+            # accepted series, not a missing one (inc(0) is the
+            # counter's touch()).
+            _M_SPEC_PROPOSED.inc(0)
+            _M_SPEC_ACCEPTED.inc(0)
         # Warmup's synthetic requests must not count: their "TTFT"
         # is multi-second XLA compiles, which would sit in the
         # cumulative histogram forever and poison every later p99.
@@ -609,15 +799,26 @@ class ServingEngine:
                 self._total_pages, base_pages=self._base_pages)
 
         cap = self.decode_capacity()
+        # With speculation on, verify ticks advance the frontier by V
+        # columns, so steps_done is no longer chunk-granular: the
+        # page-count enumeration walks EVERY reachable steps value
+        # (host-side integer math — a few thousand adds into a small
+        # set) instead of the page stride that suffices when all
+        # ticks advance by chunk multiples.
+        stride = 1 if self.spec_decode else max(1, self._page)
         pairs = set()
-        for s in range(0, max(cap - chunk, 0) + 1,
-                       max(1, self._page)):
+        for s in range(0, max(cap - chunk, 0) + 1, stride):
             pairs.add((chunk, count_for(s, chunk)))
         pairs.add((chunk, count_for(max(cap - chunk, 0), chunk)))
         while n > 1:
             n //= 2
-            pairs.add((n, count_for(max(0, cap - 2 * n + 1), n)))
-            pairs.add((n, count_for(max(0, cap - n), n)))
+            lo, hi = max(0, cap - 2 * n + 1), max(0, cap - n)
+            if self.spec_decode:
+                for s in range(lo, hi + 1):
+                    pairs.add((n, count_for(s, n)))
+            else:
+                pairs.add((n, count_for(lo, n)))
+                pairs.add((n, count_for(hi, n)))
         # Prefill-only mixed ticks dispatch with (n=0, num_pages=None)
         # — the canonical pair for "no decode scan this tick".
         mixed_pairs = sorted(pairs, key=lambda t: (t[0], t[1] or 0))
@@ -633,6 +834,9 @@ class ServingEngine:
                       jnp.zeros((g,), bool),
                       jnp.zeros((g,), jnp.int32))
         no_active = jnp.zeros((self.batch_size,), bool)
+        # The SAME zero-draft arrays runtime dispatch passes: warmup
+        # must compile against the exact shapes ticks will use.
+        drafts0, slen0 = self._drafts0, self._slen0
         for n_, np_ in sorted(pairs, key=lambda t: (t[0], t[1] or 0)):
             self._key, sub = jax.random.split(self._key)
             self.cache, _, self._tokens_dev = self._decode(
@@ -640,10 +844,32 @@ class ServingEngine:
                 sub, jnp.asarray(self._temps), n=n_, num_pages=np_)
         for n_, np_ in mixed_pairs:
             self._key, sub = jax.random.split(self._key)
-            self.cache, _, self._tokens_dev, _ = self._mixed(
+            self.cache, _, self._tokens_dev, _, _ = self._mixed(
                 self.params, self.cache, self._tokens_dev,
                 *chunk_args, no_active, sub,
-                jnp.asarray(self._temps), n=n_, num_pages=np_)
+                jnp.asarray(self._temps), drafts0, slen0,
+                n=n_, num_pages=np_)
+        if self.spec_decode:
+            # Verify-tick programs: one _spec and one mixed-spec
+            # variant per page count a verify segment can dispatch
+            # with (steps in [0, cap - V], exhaustively enumerated —
+            # spec ticks land at arbitrary steps values).
+            v = self._spec_v
+            spec_counts = set()
+            for s in range(0, max(cap - v, 0) + 1):
+                spec_counts.add(count_for(s, v))
+            for np_ in sorted(spec_counts, key=lambda t: t or 0):
+                self._key, sub = jax.random.split(self._key)
+                _, _, self._tokens_dev, self.cache = self._spec(
+                    self.params, self.cache, self._tokens_dev,
+                    drafts0, slen0, no_active, sub,
+                    jnp.asarray(self._temps), num_pages=np_)
+                self._key, sub = jax.random.split(self._key)
+                self.cache, _, self._tokens_dev, _, _ = self._mixed(
+                    self.params, self.cache, self._tokens_dev,
+                    *chunk_args, no_active, sub,
+                    jnp.asarray(self._temps), drafts0, slen0,
+                    n=0, num_pages=np_, spec=v)
         if self.prefix is not None:
             # Prefix-cache copy programs (page copy-in/out + the
             # dmask/length fix): fixed shapes with traced indices —
@@ -1110,6 +1336,139 @@ class ServingEngine:
                 (self.eos_id is not None and state.generated and
                  state.generated[-1] == self.eos_id))
 
+    # ------------------------------------------------- speculation
+    def _spec_candidates(self) -> bool:
+        """Any slot that could draft this tick? Greedy decode-phase
+        WITH draft budget left (a slot one token from done cannot
+        speculate) — sampling batches and short-output tails keep
+        the pipelined fast path. Generated counts may lag an
+        in-flight tick here, so the budget test can briefly
+        over-estimate near a slot's end: at most one spare flush,
+        never a sustained pipeline loss."""
+        return any(
+            s is not None and s.phase == 'decode' and
+            self._temps[i] <= 0.0 and
+            s.max_new - len(s.generated) > 1
+            for i, s in enumerate(self.slots))
+
+    def _lookup(self, chain: Sequence[int], k: int) -> List[int]:
+        """Draft proposer hook: up to ``k`` candidate continuations
+        of ``chain`` (prompt + generated as an int array, ending at
+        the current token). Prompt-lookup by default; tests override
+        this to drive deterministic acceptance patterns —
+        correctness never depends on draft quality (rejections fall
+        back to the model's own sample), only throughput does."""
+        return _prompt_lookup(chain, k, self._spec_ngram)
+
+    @staticmethod
+    def _slot_chain(st: _SlotState) -> np.ndarray:
+        """The slot's prompt+generated chain as an int64 view over an
+        incrementally maintained buffer — per tick only the freshly
+        generated tokens are appended (no full-chain list rebuild on
+        the spec critical path)."""
+        n = st.prompt_len + len(st.generated)
+        if st.chain_buf is None or st.chain_buf.shape[0] < n:
+            cap = max(64, st.chain_buf.shape[0] if st.chain_buf
+                      is not None else 0)
+            while cap < n:
+                cap *= 2
+            buf = np.empty((cap,), np.int64)
+            buf[:st.prompt_len] = st.prompt
+            buf[st.prompt_len:n] = st.generated
+            st.chain_buf = buf
+        elif st.chain_len < n:
+            st.chain_buf[st.chain_len:n] = \
+                st.generated[st.chain_len - st.prompt_len:]
+        st.chain_len = n
+        return st.chain_buf[:n]
+
+    def _propose_drafts(self) -> tuple:
+        """Refresh every greedy decode slot's draft from its token
+        chain (fresh when no tick is in flight — then the chain's
+        last element IS the device-resident current token; a stale
+        chain is only ever PROBED, for the dry-spell re-arm). Draft
+        length is clipped to the slot's remaining need minus one —
+        the final token needs no speculation. Returns (eligible,
+        found): how many slots could draft, and whether any did."""
+        t0 = time.perf_counter()
+        eligible = 0
+        found = False
+        for i, st in enumerate(self.slots):
+            if st is None or st.phase != 'decode':
+                continue
+            st.draft = None
+            if self._temps[i] > 0.0:
+                continue            # sampling slots bypass speculation
+            budget = min(self.spec_k,
+                         st.max_new - len(st.generated) - 1)
+            if budget < 1:
+                continue
+            eligible += 1
+            drafts = self._lookup(self._slot_chain(st), budget)
+            st.draft = drafts or None
+            found = found or bool(drafts)
+        self.spec_draft_s += time.perf_counter() - t0
+        return eligible, found
+
+    def _spec_may_run(self) -> bool:
+        """May this tick run a verify segment without breaking the
+        finish guarantee? The segment consumes V shared columns while
+        its worst-case (all-reject) advance is ONE token per decode
+        row — so speculation only runs when the region left AFTER the
+        tick still covers every occupant's pessimistic remaining
+        need. When it cannot, the tick falls back to the plain decode
+        chunk, which preserves the admission invariant by
+        construction — speculation never strands an admitted
+        request."""
+        after = self.remaining_slots() - self._spec_v
+        if after < 0:
+            return False
+        for s in self.slots:
+            if s is None:
+                continue
+            left = s.max_new - len(s.generated)
+            if s.phase == 'prefill':
+                # Pessimistic: no credit for the prefill chunk this
+                # very tick may advance.
+                left += (self._prefill_ticks(
+                    s.prompt_len - s.prefill_pos) * self.decode_chunk)
+            else:
+                left -= 1           # every decode row advances >= 1
+            if left > after:
+                return False
+        return True
+
+    def _observe_per_token(self, interval: float,
+                           emitted: int) -> None:
+        """skytpu_engine_per_token_seconds, acceptance-aware: the
+        divisor is the tick's MODEL-STEP tokens — emitted minus the
+        speculatively accepted drafts that rode along free in
+        wall-time. Without it a 4-token accepted burst would report
+        a 4x-optimistic per-token latency; with it the histogram
+        keeps meaning "wall time per serial model step" and the
+        speculation win shows up where it belongs: tokens_total rate
+        and the spec counters. Bitwise-identical behavior with
+        speculation off (accepted is always 0)."""
+        _M_TOKEN_LATENCY.observe(
+            interval / max(1, emitted - self._tick_accepted))
+
+    def spec_stats(self) -> Dict[str, Any]:
+        """Speculation accounting for bench detail / introspection."""
+        prop, acc = self.spec_proposed_total, self.spec_accepted_total
+        return {
+            'enabled': self.spec_decode,
+            'k': self.spec_k if self.spec_decode else 0,
+            'proposed': prop,
+            'accepted': acc,
+            'acceptance_rate': (round(acc / prop, 4) if prop
+                                else None),
+            'spec_ticks': self.spec_ticks,
+            'tokens_per_step': (
+                round(self.spec_emitted_total / self.spec_row_steps, 3)
+                if self.spec_row_steps else None),
+            'draft_time_s': round(self.spec_draft_s, 4),
+        }
+
     def step(self) -> int:
         """One pipelined engine tick.
 
@@ -1146,19 +1505,95 @@ class ServingEngine:
         self._apply_cancellations()
         self._expire_deadlines()
         self._admit()
+        self._tick_accepted = 0
+        emitted = 0
+        # Capacity guard, checked BEFORE the flush with generated
+        # counts that may lag the in-flight tick — which only makes
+        # it more conservative (left is over-estimated). A workload
+        # whose guard cannot pass — e.g. one slot needing the whole
+        # decode region, where the verify segment has no column
+        # headroom — keeps the double-buffered fast path and skips
+        # the proposer outright instead of paying a useless flush
+        # plus O(chain) lookup work every tick for verify ticks that
+        # can never dispatch.
+        spec_may = (self.spec_decode and not self._warming and
+                    self._spec_may_run())
+        if (spec_may and self._pending is not None and
+                self._spec_candidates() and not self._spec_dry):
+            # Drafting needs the FRESH chain: the proposer matches the
+            # suffix ending at the device-resident current token,
+            # which only aligns with host state when no tick is in
+            # flight. Speculation therefore trades the double-buffered
+            # dispatch for bigger ticks — the host work hidden by
+            # pipelining is small against a verify tick's device time,
+            # and stale drafts (offset by an in-flight tick's tokens)
+            # would never be accepted anyway.
+            prev, self._pending = self._pending, None
+            emitted += self._process_tick(prev)
+            # The flush may have finished slots: admit into them now
+            # rather than burning a tick (spec mode has no pipeline
+            # overlap to preserve).
+            self._admit()
+        if spec_may:
+            eligible, found = self._propose_drafts()
+            if eligible:
+                if found:
+                    if self._spec_dry and self._pending is not None:
+                        # Dry spell: the flush above was skipped (the
+                        # pipelined fast path stays intact for
+                        # no-match traffic) and this round only
+                        # PROBED the stale chain. A hit re-arms
+                        # speculation — next tick flushes and
+                        # proposes fresh — but the stale drafts
+                        # themselves are unusable (offset by the
+                        # in-flight tick's tokens). Re-arming waits
+                        # out the cooldown: a reject-latched dry
+                        # spell (drafts found, never accepted) must
+                        # not oscillate back in at the hysteresis
+                        # period.
+                        for s in self.slots:
+                            if s is not None:
+                                s.draft = None
+                        self._spec_dry_rounds += 1
+                        if (self._spec_dry_rounds >=
+                                self._spec_cooldown):
+                            self._spec_misses = 0
+                            self._spec_dry_rounds = 0
+                            self._spec_cooldown = min(
+                                _SPEC_COOLDOWN_MAX,
+                                max(1, self._spec_cooldown * 2))
+                    # Armed rounds deliberately do NOT reset the
+                    # streak on mere draft presence: the reset
+                    # belongs to acceptance (_process_tick), so a
+                    # workload whose spurious n-gram matches the
+                    # model never confirms still latches dry instead
+                    # of paying 1-token-advance verify ticks forever.
+                else:
+                    self._spec_misses += 1
+                # Hysteresis, and only over rounds that HAD a
+                # draftable slot: a single fresh miss must not kill
+                # the armed window (organic matches are sparse), and
+                # rounds before any decode slot exists must not delay
+                # the first verify.
+                self._spec_dry = (self._spec_misses >=
+                                  _SPEC_DRY_AFTER)
         new_entry = self._dispatch_tick()
         prev, self._pending = self._pending, new_entry
-        emitted = self._process_tick(prev)
+        emitted += self._process_tick(prev)
         # Per-token latency at tick granularity: the interval between
         # consecutive ticks over the tokens this tick surfaced. Host
         # timestamps within one tick would be sync artifacts (a
         # request finishing inside a single chunk shows ~0s/token);
-        # the tick interval is the real pipeline rate.
+        # the tick interval is the real pipeline rate. Acceptance-
+        # aware: speculatively accepted drafts ride along free in
+        # wall-time, so they are excluded from the divisor — the
+        # histogram keeps reporting the serial model-step rate while
+        # the speedup shows in tokens_total and the spec counters.
         tick_at = time.perf_counter()
         if (emitted and not self._warming and
                 self._last_tick_at is not None):
-            _M_TOKEN_LATENCY.observe(
-                (tick_at - self._last_tick_at) / emitted)
+            self._observe_per_token(tick_at - self._last_tick_at,
+                                    emitted)
         self._last_tick_at = tick_at
         dur = tick_at - t0
         if (new_entry is not None or prev is not None) and \
@@ -1212,13 +1647,26 @@ class ServingEngine:
         any_active = any(active_list)
         if not prefilling and not any_active:
             return None
+        # Speculation: when any decode slot holds a draft (greedy
+        # slots only — the proposer skips sampling slots) and the
+        # capacity guard passes, the verify segment REPLACES the
+        # decode scan this tick: every active slot feeds its current
+        # token (+ drafts, when it has them) through ONE batched
+        # verify pass and advances by its accepted prefix + 1. No
+        # drafts -> the decode-only fast path below runs untouched.
+        spec_rows: List[tuple] = []
+        if self.spec_decode and any_active:
+            spec_rows = [(i, s) for i, s in enumerate(self.slots)
+                         if s is not None and s.phase == 'decode' and
+                         s.draft]
+        run_spec = bool(spec_rows) and self._spec_may_run()
         # Decode chunk size: bounded by global capacity (admission
         # guarantees every active request fits in the remaining
         # region) and kept to power-of-two tails so at most
         # log2(chunk) programs exist per tick flavor. Prefill-only
         # ticks (or region-exhausted pipelining tails) run n == 0.
         n = 0
-        if any_active:
+        if any_active and not run_spec:
             n = min(self.decode_chunk, self.remaining_slots())
             if n < 1:
                 # Region exhausted while slots are still occupied.
@@ -1238,12 +1686,41 @@ class ServingEngine:
                 n = 0
             while n & (n - 1):
                 n &= n - 1
-        if not prefilling and n == 0:
+        if not prefilling and n == 0 and not run_spec:
             return None
         self._key, sub = jax.random.split(self._key)
-        num_pages = self._num_pages(n) if n else None
+        if run_spec:
+            num_pages = self._num_pages(self._spec_v)
+        else:
+            num_pages = self._num_pages(n) if n else None
 
-        if not prefilling:
+        counts = None
+        drafts, slen = self._drafts0, self._slen0
+        proposed = 0
+        if run_spec:
+            drafts_np = np.zeros((self.batch_size, self.spec_k),
+                                 np.int32)
+            slen_np = np.zeros((self.batch_size,), np.int32)
+            for i, st in spec_rows:
+                d = st.draft[:self.spec_k]
+                drafts_np[i, :len(d)] = d
+                slen_np[i] = len(d)
+                proposed += len(d)
+                st.draft = None            # consumed by this tick
+            drafts = jnp.asarray(drafts_np)
+            slen = jnp.asarray(slen_np)
+            if not self._warming:
+                _M_SPEC_PROPOSED.inc(proposed)
+                self.spec_proposed_total += proposed
+                self.spec_ticks += 1
+                self.spec_row_steps += sum(active_list)
+                # Host-side dispatch window (docs/tracing.md): one
+                # span per verify tick, like engine.prefill.chunk.
+                trace_lib.start_span(
+                    'engine.spec_verify', rows=len(spec_rows),
+                    proposed=proposed, k=self.spec_k).finish()
+
+        if not prefilling and not run_spec:
             # Decode-only fast path: identical to the pre-chunking
             # engine's tick.
             self.cache, toks, self._tokens_dev = self._decode(
@@ -1252,6 +1729,16 @@ class ServingEngine:
                 jnp.asarray(self._temps), n=n, num_pages=num_pages)
             firsts = None
             chunk_meta: List[Dict[str, Any]] = []
+            self.last_tick_prefill_tokens = 0
+        elif not prefilling:
+            # Verify-only tick: the speculative counterpart of the
+            # decode-only fast path.
+            toks, counts, self._tokens_dev, self.cache = self._spec(
+                self.params, self.cache, self._tokens_dev, drafts,
+                slen, jnp.asarray(active_list), sub,
+                jnp.asarray(self._temps), num_pages=num_pages)
+            firsts = None
+            chunk_meta = []
             self.last_tick_prefill_tokens = 0
         else:
             g, c = self._prefill_rows, self.prefill_chunk
@@ -1277,13 +1764,20 @@ class ServingEngine:
                     'row': j, 'slot': slot_idx, 'epoch': st.epoch,
                     'n': ln, 'last': bool(clast[j]),
                     'start': int(st.prefill_pos)})
-            self.cache, toks, self._tokens_dev, firsts = self._mixed(
-                self.params, self.cache, self._tokens_dev,
-                jnp.asarray(ctoks), jnp.asarray(cstarts),
-                jnp.asarray(clens), jnp.asarray(clive),
-                jnp.asarray(clast), jnp.asarray(cslots),
-                jnp.asarray(active_list), sub,
-                jnp.asarray(self._temps), n=n, num_pages=num_pages)
+            # ``spec`` is only passed when a verify segment runs: an
+            # explicit spec=0 and the omitted default hash to
+            # DIFFERENT jit cache keys, and warmup compiled the
+            # non-spec programs with the kwarg omitted.
+            spec_kw = {'spec': self._spec_v} if run_spec else {}
+            self.cache, toks, self._tokens_dev, firsts, counts = \
+                self._mixed(
+                    self.params, self.cache, self._tokens_dev,
+                    jnp.asarray(ctoks), jnp.asarray(cstarts),
+                    jnp.asarray(clens), jnp.asarray(clive),
+                    jnp.asarray(clast), jnp.asarray(cslots),
+                    jnp.asarray(active_list), sub,
+                    jnp.asarray(self._temps), drafts, slen, n=n,
+                    num_pages=num_pages, **spec_kw)
             # Host bookkeeping: advance cursors, flip completed slots
             # into the decode phase (they join the active mask next
             # tick; their first token is already in the device token
@@ -1324,7 +1818,7 @@ class ServingEngine:
                         ts['first_chunk'] = trace_lib.start_span(
                             'engine.decode.first_chunk',
                             parent=ts['request'], slot=m['slot'])
-        self._steps_done += n
+        self._steps_done += self._spec_v if run_spec else n
         # Snapshot which occupant each decoded column belongs to: by
         # the time this tick is synced the slot may have finished and
         # been recycled (its column decoded garbage — discarded by
@@ -1332,7 +1826,9 @@ class ServingEngine:
         snapshot = [(i, s.epoch) for i, s in enumerate(self.slots)
                     if s is not None and active_list[i]]
         return {'toks': toks, 'n': n, 'snapshot': snapshot,
-                'chunks': chunk_meta, 'firsts': firsts}
+                'chunks': chunk_meta, 'firsts': firsts,
+                'spec': self._spec_v if run_spec else 0,
+                'counts': counts}
 
     def _emit_first_token(self, state: _SlotState, tok: int,
                           now: float) -> List[int]:
@@ -1377,7 +1873,62 @@ class ServingEngine:
             fresh_by_slot[m['slot']] = self._emit_first_token(
                 state, int(firsts_host[m['row']]), now)
             emitted += 1
-        if entry['n']:
+        if entry.get('spec'):
+            # Verify tick: each active row surfaced counts[b] tokens —
+            # its accepted drafts plus the model's own token for the
+            # first rejected (or bonus) position. Tokens beyond
+            # counts are rejected-candidate garbage; their K/V were
+            # rolled back on device via the dmask.
+            toks_host = np.asarray(entry['toks'])       # [B, V]
+            counts_host = np.asarray(entry['counts'])   # [B]
+            tick_acc = 0
+            for slot_idx, epoch in entry['snapshot']:
+                state = self.slots[slot_idx]
+                if state is None or state.epoch != epoch:
+                    continue      # freed/recycled mid-flight
+                if self._is_done(state):
+                    continue
+                fresh = fresh_by_slot.setdefault(slot_idx, [])
+                e = int(counts_host[slot_idx])
+                for t in range(e):
+                    tok = int(toks_host[slot_idx, t])
+                    state.generated.append(tok)
+                    fresh.append(tok)
+                    emitted += 1
+                    if self._is_done(state):
+                        # Tokens past max_new/EOS within the burst
+                        # are discarded.
+                        break
+                # Accepted drafts that actually SURFACED: burst
+                # positions 0..e-2 are drafts, e-1 is the model's own
+                # token — an EOS mid-burst truncates the emission, and
+                # discarded drafts must inflate neither the acceptance
+                # counters nor the per-token-latency divisor.
+                accepted = min(len(fresh), max(0, e - 1))
+                tick_acc += accepted
+                if not self._warming:
+                    if accepted:
+                        _M_SPEC_ACCEPTED.inc(accepted)
+                        self.spec_accepted_total += accepted
+                        self._tick_accepted += accepted
+                    self.spec_emitted_total += len(fresh)
+            if not self._warming:
+                # Acceptance feedback for the dry-spell latch: a
+                # verify tick whose drafts were ALL rejected is a
+                # miss exactly like a zero-draft proposal round — a
+                # proposer that keeps matching n-grams the model
+                # never confirms must latch dry rather than replace
+                # the n-step decode scan with 1-token-advance verify
+                # ticks forever. Any accepted draft re-arms fully.
+                if tick_acc:
+                    self._spec_misses = 0
+                    self._spec_cooldown = 0
+                    self._spec_dry_rounds = 0
+                else:
+                    self._spec_misses += 1
+                self._spec_dry = (self._spec_misses >=
+                                  _SPEC_DRY_AFTER)
+        elif entry['n']:
             toks_host = np.asarray(entry['toks'])   # [n, B] — THE sync
             for slot_idx, epoch in entry['snapshot']:
                 state = self.slots[slot_idx]

@@ -847,6 +847,10 @@ def _build_engine(args) -> 'Any':
                                               None),
                          prefix_pool_pages=getattr(
                              args, 'prefix_pool_pages', None),
+                         spec_decode=getattr(args, 'spec_decode',
+                                             None),
+                         spec_k=getattr(args, 'spec_k', None),
+                         spec_ngram=getattr(args, 'spec_ngram', None),
                          mesh=mesh)
 
 
@@ -883,6 +887,19 @@ def main() -> None:
     parser.add_argument('--prefix-pool-pages', type=int, default=None,
                         help='Prefix-pool capacity in pages '
                         '(default: SKYTPU_PREFIX_POOL_PAGES or 512).')
+    parser.add_argument('--spec-decode', action='store_true',
+                        default=None,
+                        help='Enable speculative multi-token decoding '
+                        '(prompt-lookup drafts + batched verify in '
+                        'the fused tick; greedy outputs stay bitwise '
+                        'identical to speculation-off). Default: '
+                        'SKYTPU_SPEC_DECODE.')
+    parser.add_argument('--spec-k', type=int, default=None,
+                        help='Max drafted tokens per decode slot per '
+                        'verify tick (default: SKYTPU_SPEC_K or 4).')
+    parser.add_argument('--spec-ngram', type=int, default=None,
+                        help='Max n-gram the prompt-lookup proposer '
+                        'matches (default: SKYTPU_SPEC_NGRAM or 3).')
     parser.add_argument('--kv-quant', action='store_true')
     parser.add_argument('--weight-quant', action='store_true',
                         help='int8 weight-only quantization: serve '

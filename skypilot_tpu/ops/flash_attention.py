@@ -569,28 +569,26 @@ def _chunk_fwd_pallas(q, k, v, q_offset, *, block_k, interpret):
     )(q_offset.astype(jnp.int32), q, k, v)
 
 
-def chunk_attention_reference(q, k, v, q_offset, k_scale=None,
-                              v_scale=None):
-    """Masked-einsum reference for the chunk kernel — and the real
-    path for int8 caches (per-vector scales applied on scores for K,
-    folded into probs for V, same discipline as the decode paths) and
-    off-TPU backends. GQA-native: K/V stay at n_kv heads.
-    """
-    g, c, h, d = q.shape
-    s, n_kv = k.shape[1], k.shape[2]
+def _masked_attention_reference(q, k, v, allow, k_scale=None,
+                                v_scale=None):
+    """Shared masked-einsum attention for the chunk-shaped reference
+    paths (chunk prefill + spec-decode verify): GQA-native (K/V stay
+    at n_kv heads), int8 per-vector scales applied on scores for K
+    and folded into probs for V — same discipline as the decode
+    paths. ``allow``: [B, C, S] bool — which cache columns each query
+    may attend; the callers own the mask semantics."""
+    b, c, h, d = q.shape
+    n_kv = k.shape[2]
     rep = h // n_kv
-    qf = q.reshape(g, c, n_kv, rep, d)
+    qf = q.reshape(b, c, n_kv, rep, d)
     scores = jnp.einsum(
         'gcnrd,gsnd->gcnrs', qf, k.astype(qf.dtype),
         preferred_element_type=jnp.float32) * d**-0.5
     if k_scale is not None:
-        # [G, S, n_kv] -> [G, 1, n_kv, 1, S]
+        # [B, S, n_kv] -> [B, 1, n_kv, 1, S]
         scores = scores * jnp.transpose(
             k_scale, (0, 2, 1))[:, None, :, None, :].astype(jnp.float32)
-    q_pos = q_offset[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
-    valid = (jnp.arange(s, dtype=jnp.int32)[None, None, :] <=
-             q_pos[:, :, None])                       # [G, C, S]
-    scores = jnp.where(valid[:, :, None, None, :], scores, _NEG_INF)
+    scores = jnp.where(allow[:, :, None, None, :], scores, _NEG_INF)
     m = jnp.max(scores, axis=-1, keepdims=True)
     e = jnp.exp(scores - m)
     probs = e / jnp.sum(e, axis=-1, keepdims=True)
@@ -600,7 +598,42 @@ def chunk_attention_reference(q, k, v, q_offset, k_scale=None,
     out = jnp.einsum('gcnrs,gsnd->gcnrd', probs.astype(q.dtype),
                      v.astype(q.dtype),
                      preferred_element_type=jnp.float32)
-    return out.reshape(g, c, h, d).astype(q.dtype)
+    return out.reshape(b, c, h, d).astype(q.dtype)
+
+
+def chunk_attention_reference(q, k, v, q_offset, k_scale=None,
+                              v_scale=None):
+    """Masked-einsum reference for the chunk kernel — and the real
+    path for int8 caches and off-TPU backends. Purely positional
+    causal mask: query i attends columns <= q_offset + i.
+    """
+    c = q.shape[1]
+    s = k.shape[1]
+    q_pos = q_offset[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    allow = (jnp.arange(s, dtype=jnp.int32)[None, None, :] <=
+             q_pos[:, :, None])                       # [G, C, S]
+    return _masked_attention_reference(q, k, v, allow, k_scale,
+                                       v_scale)
+
+
+def _chunk_impl(impl, s, block_k, k_scale):
+    """Shared impl resolution for the chunk-shaped kernels (chunk
+    prefill + spec-decode verify): Pallas on TPU for non-quantized
+    caches when the K axis tiles, the exact einsum elsewhere."""
+    if impl is None:
+        impl = ('pallas' if (_use_pallas() and k_scale is None and
+                             s % block_k == 0) else 'xla')
+    if impl not in ('pallas', 'xla'):
+        raise ValueError(f'chunk attention impl {impl!r} not in '
+                         "('pallas', 'xla')")
+    if impl == 'pallas':
+        if k_scale is not None:
+            raise ValueError('the Pallas chunk kernel reads bf16/f32 '
+                             'caches; int8 goes through the xla path')
+        if s % block_k != 0:
+            raise ValueError(f'cache region {s} is not a multiple of '
+                             f'block_k {block_k}')
+    return impl
 
 
 def chunk_prefill_attention(q: jax.Array,
@@ -635,20 +668,205 @@ def chunk_prefill_attention(q: jax.Array,
         block_k = min(_LANES, s)
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
-    if impl is None:
-        impl = ('pallas' if (_use_pallas() and k_scale is None and
-                             s % block_k == 0) else 'xla')
-    if impl not in ('pallas', 'xla'):
-        raise ValueError(f'chunk attention impl {impl!r} not in '
-                         "('pallas', 'xla')")
+    impl = _chunk_impl(impl, s, block_k, k_scale)
     if impl == 'pallas':
-        if k_scale is not None:
-            raise ValueError('the Pallas chunk kernel reads bf16/f32 '
-                             'caches; int8 goes through the xla path')
-        if s % block_k != 0:
-            raise ValueError(f'cache region {s} is not a multiple of '
-                             f'block_k {block_k}')
         return _chunk_fwd_pallas(q, k, v, q_offset, block_k=block_k,
                                  interpret=interpret)
     return chunk_attention_reference(q, k, v, q_offset, k_scale,
                                      v_scale)
+
+
+# --------------------------------------------- spec-decode verify
+#
+# The attention primitive behind draft-and-verify speculative decoding
+# (models.inference.verify_step): a V-token verify segment per decode
+# slot — the current token plus up to V-1 drafted candidates — has
+# already been written into the slot's cache row at columns
+# [seg_start, seg_start + V), and every candidate position must attend
+# causally into the paged KV cache. Unlike the prefill chunk, the
+# decode-region cache is POSITION != COLUMN: continuous batching
+# leaves dmask holes inside the live region (recycled slots, rejected
+# candidates from earlier verify ticks), so the mask cannot be the
+# chunk kernel's purely positional ``kv_pos <= offset + i`` rule. The
+# verify rule is the union of the two authorities:
+#
+#     attend(col, i) = dmask[b, col]                 (the live cache)
+#                    | seg_start <= col <= seg_start + i   (the
+#                      segment, causal within itself — query i sees
+#                      f_0..f_i, self-inclusive like decode's self
+#                      term)
+#
+# dmask is False at and beyond ``seg_start`` (the shared write
+# frontier is monotone and recycled rows are cleared), so the two
+# terms never overlap. The Pallas variant reuses the chunk kernel's
+# scalar-prefetched query-offset masking for the segment term — the
+# prefetched scalar here is ``seg_start`` — plus the paged decode
+# kernel's int8-mask input for the dmask term, and clamps its K-block
+# index maps to the last block any query can see (blocks past the
+# frontier are never fetched). Forward-only, like the chunk kernel.
+
+
+def _verify_fwd_kernel(seg_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, scale, v_len,
+                       block_k, num_k_blocks):
+    """Grid (B, H, k-block); online softmax across the K axis.
+
+    seg_ref: scalar-prefetched [1] int32 segment start column (the
+    shared write frontier — one scalar, every row writes the same
+    columns). mask_ref: (1, block_k) int8 dmask block. Same flash
+    recurrence and masked-prob hygiene as the chunk kernel."""
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Blocks wholly past the segment's end contribute nothing — and
+    # were never fetched (index maps clamp to the last live block).
+    @pl.when(ik * block_k < seg_ref[0] + v_len)
+    def _compute():
+        q = q_ref[0, :, 0].astype(jnp.float32)        # [v_len, hd]
+        k = k_ref[0, :, 0].astype(jnp.float32)        # [block_k, hd]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = ik * block_k + lax.broadcasted_iota(
+            jnp.int32, (v_len, block_k), 1)
+        qi = lax.broadcasted_iota(jnp.int32, (v_len, block_k), 0)
+        seg = (col >= seg_ref[0]) & (col <= seg_ref[0] + qi)
+        allow = (mask_ref[0, :] != 0)[None, :] | seg
+        s = jnp.where(allow, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(allow, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, :, 0].astype(jnp.float32)        # [block_k, hd]
+        acc_scr[...] = acc_scr[...] * corr + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, :, 0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def _verify_fwd_pallas(q, k, v, valid, seg_start, *, block_k,
+                       interpret):
+    """q: [B, V, H, D]; k/v: [B, S, H_kv, D]; valid: [B, S] bool;
+    seg_start: scalar int32."""
+    b, v_len, h, d = q.shape
+    s = k.shape[1]
+    n_kv = k.shape[2]
+    rep = h // n_kv
+    block_k = min(block_k, s)
+    assert s % block_k == 0, (s, block_k)
+    nk = s // block_k
+    seg = jnp.asarray(seg_start, jnp.int32).reshape(1)
+
+    def _last_block(seg_ref):
+        # Last K block any verify query can see (>= 0): the segment's
+        # final column seg_start + v_len - 1.
+        return jnp.maximum(seg_ref[0] + v_len - 1, 0) // block_k
+
+    def q_map(bi, hi, ik, seg_ref):
+        del ik, seg_ref
+        return bi, 0, hi, 0
+
+    def kv_map(bi, hi, ik, seg_ref):
+        return bi, jnp.minimum(ik, _last_block(seg_ref)), \
+            hi // rep, 0
+
+    def mask_map(bi, hi, ik, seg_ref):
+        del hi
+        return bi, jnp.minimum(ik, _last_block(seg_ref))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec((1, v_len, 1, d), q_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, block_k), mask_map),
+        ],
+        out_specs=pl.BlockSpec((1, v_len, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((v_len, _LANES), jnp.float32),
+            pltpu.VMEM((v_len, _LANES), jnp.float32),
+            pltpu.VMEM((v_len, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _verify_fwd_kernel, scale=d**-0.5, v_len=v_len,
+        block_k=block_k, num_k_blocks=nk)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, v_len, h, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(seg, q, k, v, valid.astype(jnp.int8))
+
+
+def verify_attention_reference(q, k, v, valid, seg_start,
+                               k_scale=None, v_scale=None):
+    """Masked-einsum reference for the verify kernel — and the real
+    path for int8 caches and off-TPU backends. Mask is the union of
+    the live-cache dmask and the segment-causal term (query i sees
+    segment columns seg_start..seg_start + i, self-inclusive)."""
+    vq = q.shape[1]
+    s = k.shape[1]
+    seg_start = jnp.asarray(seg_start, jnp.int32)
+    col = jnp.arange(s, dtype=jnp.int32)[None, None, :]
+    qi = jnp.arange(vq, dtype=jnp.int32)[None, :, None]
+    seg = (col >= seg_start) & (col <= seg_start + qi)
+    allow = valid[:, None, :] | seg                    # [B, V, S]
+    return _masked_attention_reference(q, k, v, allow, k_scale,
+                                       v_scale)
+
+
+def verify_attention(q: jax.Array,
+                     k: jax.Array,
+                     v: jax.Array,
+                     valid: jax.Array,
+                     seg_start: jax.Array,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
+                     *,
+                     impl: Optional[str] = None,
+                     block_k: Optional[int] = None,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """dmask-valid + segment-causal attention for one verify pass.
+
+    q: [B, V, H, D] — the V-token verify segment's queries (current
+    token + drafted candidates); k/v: [B, S, H_kv, D] — each row's
+    cache region with the segment K/V already written at columns
+    [seg_start, seg_start + V) (bf16/f32, or int8 with per-vector
+    k_scale/v_scale [B, S, H_kv]); valid: [B, S] bool — the cache
+    dmask (False at and beyond ``seg_start``); seg_start: traced
+    scalar column of the shared write frontier. Query i attends every
+    dmask-true column plus segment columns seg_start..seg_start + i
+    (self-inclusive). Returns [B, V, H, D].
+
+    ``impl``: 'pallas' | 'xla' | None — same auto rule as
+    ``chunk_prefill_attention``.
+    """
+    s = k.shape[1]
+    if block_k is None:
+        block_k = min(_LANES, s)
+    if interpret is None:
+        interpret = jax.default_backend() != 'tpu'
+    impl = _chunk_impl(impl, s, block_k, k_scale)
+    if impl == 'pallas':
+        return _verify_fwd_pallas(q, k, v, valid, seg_start,
+                                  block_k=block_k, interpret=interpret)
+    return verify_attention_reference(q, k, v, valid, seg_start,
+                                      k_scale, v_scale)

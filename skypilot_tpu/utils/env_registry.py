@@ -236,6 +236,25 @@ SKYTPU_PREFIX_POOL_PAGES = register(
     'SKYTPU_PREFIX_POOL_PAGES',
     'Shared prefix-pool capacity in pages (at the engine page size; '
     'default 512). Cold unpinned pages evict LRU beyond it.')
+SKYTPU_SPEC_DECODE = register(
+    'SKYTPU_SPEC_DECODE',
+    'Set to 1 to enable speculative multi-token decoding in the '
+    'serving engine (host-side prompt-lookup drafts, batched '
+    'draft-and-verify in the fused tick; PERFORMANCE.md '
+    '"Speculative decoding"). Off (default) keeps every tick '
+    'bit-identical to the pre-speculation engine.')
+SKYTPU_SPEC_K = register(
+    'SKYTPU_SPEC_K',
+    'Max drafted tokens per decode slot per verify tick (default 4; '
+    '0 disables speculation outright). Each verify tick feeds k+1 '
+    'tokens per slot and consumes k+1 shared cache columns; higher k '
+    'buys more tokens/step at the acceptance rate the workload '
+    'sustains.')
+SKYTPU_SPEC_NGRAM = register(
+    'SKYTPU_SPEC_NGRAM',
+    'Max n-gram length the prompt-lookup draft proposer matches '
+    'against the slot token chain (default 3; longer suffix matches '
+    'are tried first, most recent occurrence wins).')
 
 # --------------------------------------------------- request lifecycle
 SKYTPU_DRAIN_TIMEOUT_SECONDS = register(
@@ -352,3 +371,9 @@ BENCH_DECODE_PAGE = register(
     'BENCH_DECODE_PAGE', 'Decode bench page size (tokens).')
 BENCH_DECODE_HEADROOM = register(
     'BENCH_DECODE_HEADROOM', 'Decode bench extra page headroom.')
+BENCH_SPEC_K = register(
+    'BENCH_SPEC_K',
+    'Speculative-decoding draft length for the decode/serve benches '
+    '(SKYTPU_SPEC_K analog): 0 disables the spec phase. Default 4 '
+    'under BENCH_SMOKE, 0 otherwise (the decode_spec / serve_spec '
+    'modes of `bench.py all` opt in).')

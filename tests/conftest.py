@@ -42,7 +42,7 @@ import pytest  # noqa: E402
 _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
                'test_recovery_strategy.py', 'test_decode_attention.py',
                'test_chunked_prefill.py', 'test_prefix_cache.py',
-               'test_bench_smoke.py',
+               'test_spec_decode.py', 'test_bench_smoke.py',
                'test_metrics.py', 'test_analysis.py', 'test_trace.py',
                'test_request_lifecycle.py', 'test_statedb.py')
 

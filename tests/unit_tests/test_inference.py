@@ -124,6 +124,34 @@ def test_temperature_is_traced_not_static():
     assert inference.generate._cache_size() == misses
 
 
+def test_top_k_is_traced_not_static():
+    """Varying top_k must reuse the compiled program (it used to sit
+    in the jit static set, so per-request top_k recompiled), and
+    disabling values (0 / >= vocab) plus top_k=1 keep their exact
+    pre-trace semantics."""
+    cfg, params, tokens = _setup()
+    b, s = tokens.shape
+    lengths = jnp.full((b,), s, jnp.int32)
+    inference.generate(params, tokens, lengths, cfg, max_new=4,
+                       temperature=0.8, top_k=5,
+                       key=jax.random.PRNGKey(0))
+    misses = inference.generate._cache_size()
+    for tk in (9, 0, cfg.vocab_size + 3, 1):
+        inference.generate(params, tokens, lengths, cfg, max_new=4,
+                           temperature=0.8, top_k=tk,
+                           key=jax.random.PRNGKey(0))
+    assert inference.generate._cache_size() == misses
+    # top_k=1 sampling collapses to greedy at any temperature.
+    got = inference.generate(params, tokens, lengths, cfg, max_new=4,
+                             temperature=0.9, top_k=1,
+                             key=jax.random.PRNGKey(3))
+    want = inference.generate(params, tokens, lengths, cfg, max_new=4,
+                              temperature=0.0, top_k=0,
+                              key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert inference.generate._cache_size() == misses
+
+
 @pytest.mark.slow
 def test_moe_generate_matches_cache_free_oracle():
     """KV-cache inference for the MoE family: prefill + decode greedy

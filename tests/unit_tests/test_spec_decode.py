@@ -1,0 +1,638 @@
+"""Speculative multi-token decoding: draft-and-verify in the fused
+tick (PERFORMANCE.md "Speculative decoding").
+
+The one bar every case is pinned to: **greedy outputs bitwise
+identical spec-on vs spec-off** — including with the prefix cache
+enabled, across GQA ratios, int8 KV caches, and k in {1, 2, 4} at the
+acceptance edge cases (all-accept, all-reject, accept k-1). Draft
+quality never affects correctness (rejections fall back to the
+model's own sample), only throughput — so tests stub the proposer
+hook (``engine._lookup``) to drive deterministic acceptance patterns,
+with the organic n-gram proposer covered separately.
+"""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.models.serving_engine import (Request, ServingEngine,
+                                                _prompt_lookup)
+
+pytestmark = pytest.mark.specdecode
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg = models.LlamaConfig.tiny(**cfg_kw)
+    params = models.init_params(cfg, jax.random.PRNGKey(seed))
+    return cfg, params
+
+
+def _prompt(cfg, n, seed):
+    key = jax.random.PRNGKey(seed)
+    return list(np.asarray(
+        jax.random.randint(key, (n,), 0, cfg.vocab_size)))
+
+
+def _solo_generate(params, cfg, prompt, max_new):
+    out = inference.generate(
+        params, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cfg, max_new=max_new)
+    return list(np.asarray(out[0]))
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault('batch_size', 3)
+    kw.setdefault('max_prompt', 32)
+    kw.setdefault('max_seq', 160)
+    kw.setdefault('decode_chunk', 4)
+    kw.setdefault('prefill_chunk', 8)
+    kw.setdefault('prefill_budget', 16)
+    return ServingEngine(params, cfg, **kw)
+
+
+def _oracle_lookup(oracles):
+    """Proposer stub: drafts = the known greedy continuation of
+    whichever request owns the chain (identified by prompt prefix) —
+    the all-accept pattern. ``oracles``: {rid: (prompt, want)}.
+    ``chain`` arrives as the engine's int array view."""
+    def lookup(chain, k):
+        chain = [int(t) for t in chain]
+        for _, (p, w) in oracles.items():
+            if len(chain) >= len(p) and chain[:len(p)] == list(p):
+                g = len(chain) - len(p)
+                return w[g:g + k]
+        return []
+    return lookup
+
+
+# ------------------------------------------------- proposer semantics
+
+
+def test_prompt_lookup_longest_then_most_recent():
+    # Trailing 2-gram [5, 6] occurs twice; the MOST RECENT earlier
+    # occurrence (followed by [9, 9]) wins over the first ([7, 8]).
+    chain = [5, 6, 7, 8, 1, 5, 6, 9, 9, 2, 5, 6]
+    assert _prompt_lookup(chain, 2, max_ngram=3) == [9, 9]
+    # Longer n-grams are preferred: trailing 3-gram [2, 5, 6] has no
+    # earlier occurrence, so it falls to the 2-gram above.
+    assert _prompt_lookup(chain, 4, max_ngram=3) == [9, 9, 2, 5]
+    # k clips the continuation.
+    assert _prompt_lookup(chain, 1, max_ngram=3) == [9]
+
+
+def test_prompt_lookup_no_match_and_edges():
+    assert _prompt_lookup([1, 2, 3, 4], 4, max_ngram=3) == []
+    assert _prompt_lookup([7], 4, max_ngram=3) == []
+    assert _prompt_lookup([], 4, max_ngram=3) == []
+    # Period-1 repetition: the trailing token's earlier occurrence
+    # is followed by ... itself — a legitimate single-token draft.
+    assert _prompt_lookup([3, 3], 4, max_ngram=3) == [3]
+    # 1-gram fallback: last token seen earlier mid-chain.
+    assert _prompt_lookup([4, 9, 1, 4], 2, max_ngram=3) == [9, 1]
+
+
+# ---------------------------------------- verify_step unit semantics
+
+
+def test_verify_step_accept_reject_partial_and_rollback():
+    """Direct unit: oracle drafts fully accept (+bonus), garbage
+    drafts fully reject (emitting the model's own token), a partial
+    draft accepts its prefix — and rejected candidates' KV columns
+    are dmask-rolled-back so continued decoding stays bitwise equal
+    to the sequential path."""
+    cfg, params = _setup()
+    b, s = 2, 8
+    toks = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                              cfg.vocab_size)
+    lengths = jnp.full((b,), s, jnp.int32)
+    logits, cache0 = inference.prefill(params, toks, lengths, cfg)
+    first = jnp.argmax(logits, -1).astype(jnp.int32)
+
+    # Sequential greedy oracle via decode_step.
+    cache, cur = cache0, first
+    seq = [np.asarray(first)]
+    for _ in range(6):
+        lg, cache = inference.decode_step(params, cache, cur, cfg)
+        cur = jnp.argmax(lg, -1).astype(jnp.int32)
+        seq.append(np.asarray(cur))
+    seq = np.stack(seq, 1)                      # [B, 7]
+
+    k = 3
+    temps = jnp.zeros((b,), jnp.float32)
+    drafts = jnp.asarray(seq[:, 1:1 + k])
+    slen = jnp.full((b,), k, jnp.int32)
+    _, cache0 = inference.prefill(params, toks, lengths, cfg)
+
+    # All-accept: k drafts + the bonus token.
+    emit, counts, nxt, cache = inference.verify_step(
+        params, cache0, first, drafts, slen, cfg,
+        jax.random.PRNGKey(2), temps, 0)
+    assert (np.asarray(counts) == k + 1).all()
+    assert (np.asarray(emit)[:, :k + 1] == seq[:, 1:k + 2]).all()
+    assert (np.asarray(nxt) == seq[:, k + 1]).all()
+    lg, cache = inference.decode_step(params, cache,
+                                      jnp.asarray(nxt), cfg)
+    assert (np.asarray(jnp.argmax(lg, -1)) == seq[:, k + 2]).all()
+
+    # All-reject: 1 token (the model's own), rejected columns dark.
+    _, cache0 = inference.prefill(params, toks, lengths, cfg)
+    bad = (drafts + 1) % cfg.vocab_size
+    emit, counts, nxt, cache = inference.verify_step(
+        params, cache0, first, bad, slen, cfg,
+        jax.random.PRNGKey(2), temps, 0)
+    assert (np.asarray(counts) == 1).all()
+    assert (np.asarray(emit)[:, 0] == seq[:, 1]).all()
+    dm = np.asarray(cache['dmask'])
+    assert dm[:, s].all(), 'the fed current token stays readable'
+    assert not dm[:, s + 1:s + k + 1].any(), 'rejected KV rolled back'
+    lg, cache = inference.decode_step(params, cache,
+                                      jnp.asarray(nxt), cfg)
+    assert (np.asarray(jnp.argmax(lg, -1)) == seq[:, 2]).all()
+
+    # Accept k-1: corrupt only the last draft.
+    _, cache0 = inference.prefill(params, toks, lengths, cfg)
+    part = np.asarray(drafts).copy()
+    part[:, k - 1] = (part[:, k - 1] + 1) % cfg.vocab_size
+    emit, counts, nxt, _ = inference.verify_step(
+        params, cache0, first, jnp.asarray(part), slen, cfg,
+        jax.random.PRNGKey(2), temps, 0)
+    assert (np.asarray(counts) == k).all()
+    assert (np.asarray(nxt) == seq[:, k]).all()
+
+
+# ------------------------------------- engine parity: acceptance edges
+
+
+@pytest.mark.parametrize('k', [1, 2, 4])
+def test_engine_parity_all_accept(k):
+    cfg, params = _setup()
+    prompts = {'a': _prompt(cfg, 9, 1), 'b': _prompt(cfg, 17, 2),
+               'c': _prompt(cfg, 5, 3)}
+    new = {'a': 12, 'b': 8, 'c': 10}
+    want = {r: _solo_generate(params, cfg, p, new[r])
+            for r, p in prompts.items()}
+    eng = _engine(params, cfg, spec_decode=True, spec_k=k)
+    eng._lookup = _oracle_lookup(
+        {r: (prompts[r], want[r]) for r in prompts})
+    res = eng.run([Request(r, p, max_new=new[r])
+                   for r, p in prompts.items()])
+    for r in prompts:
+        assert res[r].tokens == want[r], (k, r)
+    st = eng.spec_stats()
+    assert st['proposed'] > 0
+    assert st['accepted'] == st['proposed'], st     # all accepted
+    assert st['acceptance_rate'] == 1.0
+    if k > 1:
+        assert st['tokens_per_step'] > 1.5
+
+
+@pytest.mark.parametrize('k', [1, 2, 4])
+def test_engine_parity_all_reject(k):
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 1)
+    want = _solo_generate(params, cfg, p, 12)
+    eng = _engine(params, cfg, spec_decode=True, spec_k=k)
+    # Off-by-one drafts: every candidate rejects; the verify's
+    # fallback token must keep the stream bitwise identical.
+    eng._lookup = (
+        lambda chain, kk: [(chain[-1] + 7) % cfg.vocab_size] * kk)
+    res = eng.run([Request('r', p, max_new=12)])
+    assert res['r'].tokens == want, k
+    st = eng.spec_stats()
+    assert st['proposed'] > 0 and st['accepted'] == 0, st
+    assert st['tokens_per_step'] == 1.0
+
+
+def test_engine_parity_accept_k_minus_1():
+    cfg, params = _setup()
+    k = 4
+    p = _prompt(cfg, 9, 1)
+    want = _solo_generate(params, cfg, p, 16)
+    eng = _engine(params, cfg, spec_decode=True, spec_k=k)
+    oracle = _oracle_lookup({'r': (p, want)})
+
+    def partial(chain, kk):
+        d = oracle(chain, kk)
+        if len(d) == kk and kk > 1:
+            d = list(d)
+            d[-1] = (d[-1] + 1) % cfg.vocab_size   # last draft rejects
+        return d
+    eng._lookup = partial
+    res = eng.run([Request('r', p, max_new=16)])
+    assert res['r'].tokens == want
+    st = eng.spec_stats()
+    assert 0 < st['accepted'] < st['proposed'], st
+
+
+@pytest.mark.parametrize('gqa', [(4, 4), (4, 2), (8, 1)])
+def test_engine_parity_across_gqa(gqa):
+    n_heads, n_kv = gqa
+    cfg, params = _setup(n_heads=n_heads, n_kv_heads=n_kv)
+    p = _prompt(cfg, 11, 5)
+    want = _solo_generate(params, cfg, p, 10)
+    eng = _engine(params, cfg, spec_decode=True, spec_k=3)
+    eng._lookup = _oracle_lookup({'r': (p, want)})
+    res = eng.run([Request('r', p, max_new=10)])
+    assert res['r'].tokens == want, gqa
+    assert eng.spec_stats()['accepted'] > 0
+
+
+def test_engine_parity_int8_kv():
+    cfg, params = _setup()
+    p = _prompt(cfg, 13, 6)
+    eng_off = _engine(params, cfg, kv_quant=True)
+    want = eng_off.run([Request('r', list(p),
+                                max_new=10)])['r'].tokens
+    eng = _engine(params, cfg, kv_quant=True, spec_decode=True,
+                  spec_k=3)
+    eng._lookup = _oracle_lookup({'r': (p, want)})
+    res = eng.run([Request('r', list(p), max_new=10)])
+    assert res['r'].tokens == want
+    assert eng.spec_stats()['accepted'] > 0
+
+
+def test_engine_organic_ngram_proposer_parity():
+    """The real prompt-lookup proposer on a repetitive prompt:
+    whatever it drafts (and whatever the model accepts), the greedy
+    stream equals the solo oracle and the spec-off engine."""
+    cfg, params = _setup()
+    pat = _prompt(cfg, 6, 9)
+    rep = (pat * 5)[:30]
+    want = _solo_generate(params, cfg, rep, 14)
+    eng_on = _engine(params, cfg, spec_decode=True, spec_k=4)
+    eng_off = _engine(params, cfg)
+    assert eng_on.run([Request('r', list(rep),
+                               max_new=14)])['r'].tokens == want
+    assert eng_off.run([Request('r', list(rep),
+                                max_new=14)])['r'].tokens == want
+    assert eng_on.spec_stats()['proposed'] > 0
+
+
+def test_sampling_slots_bypass_speculation():
+    """temperature>0 slots never draft (their per-position samples
+    would not follow the greedy acceptance rule) but keep correct
+    sampling semantics inside the same verify program — and their
+    greedy batchmates still speculate at full parity."""
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 1)
+    want = _solo_generate(params, cfg, p, 12)
+    eng = _engine(params, cfg, spec_decode=True, spec_k=2)
+    eng._lookup = _oracle_lookup({'a': (p, want)})
+    res = eng.run([Request('a', p, max_new=12),
+                   Request('s', _prompt(cfg, 7, 30), max_new=6,
+                           temperature=0.9)])
+    assert res['a'].tokens == want
+    assert len(res['s'].tokens) == 6
+    assert all(0 <= t < cfg.vocab_size for t in res['s'].tokens)
+    assert eng.spec_stats()['accepted'] > 0
+
+
+def test_eos_mid_burst_truncates_and_does_not_inflate_acceptance():
+    """An EOS landing inside an accepted burst truncates the emission
+    — and the discarded tail drafts must count toward NEITHER
+    skytpu_engine_spec_accepted_tokens_total nor the per-token
+    divisor: only drafts that actually surfaced are accepted."""
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 1)
+    want = _solo_generate(params, cfg, p, 10)   # eos-free oracle
+    assert len(set(want[:3])) == 3              # eos uniquely at idx 2
+    eos = want[2]
+    eng = _engine(params, cfg, batch_size=1, eos_id=eos,
+                  spec_decode=True, spec_k=4)
+    eng._lookup = _oracle_lookup({'r': (p, want)})
+    res = eng.run([Request('r', list(p), max_new=10)])
+    # Burst 0 is the prefill first token (want[0]); the verify burst
+    # drafts want[1:5], the device accepts all 4, the host surfaces
+    # want[1] then want[2] == eos and stops.
+    assert res['r'].tokens == want[:3]
+    assert res['r'].status == 'finished'
+    st = eng.spec_stats()
+    assert st['proposed'] == 4
+    assert st['accepted'] == 2, \
+        'only the two SURFACED drafts may count as accepted'
+    assert metrics_lib.summary()[
+        'skytpu_engine_spec_accepted_tokens_total'] == 2
+
+
+def test_spec_off_is_default_and_counters_stay_zero():
+    cfg, params = _setup()
+    eng = _engine(params, cfg)
+    assert not eng.spec_decode
+    p = _prompt(cfg, 11, 91)
+    res = eng.run([Request('r', p, max_new=4)])
+    assert res['r'].tokens == _solo_generate(params, cfg, p, 4)
+    summary = metrics_lib.summary()
+    assert summary.get(
+        'skytpu_engine_spec_proposed_tokens_total', 0) == 0
+    assert 'skytpu_engine_spec_acceptance_rate' not in summary
+
+
+# ------------------------------------------------------- composition
+
+
+def test_spec_with_prefix_cache_hit_parity_and_pins():
+    """Composition: a prefix-cache hit admission followed by
+    speculative decode — bitwise equal to the solo oracle, pins
+    released at the natural finish."""
+    cfg, params = _setup()
+    kw = dict(page=8, prefix_cache=True, prefix_pool_pages=16,
+              spec_decode=True, spec_k=3)
+    eng = _engine(params, cfg, **kw)
+    shared = _prompt(cfg, 16, 81)
+    pub = shared + _prompt(cfg, 3, 82)
+    assert eng.run([Request('pub', pub, max_new=4)])['pub'].tokens \
+        == _solo_generate(params, cfg, pub, 4)
+    hit = shared + _prompt(cfg, 5, 83)
+    want = _solo_generate(params, cfg, hit, 9)
+    eng._lookup = _oracle_lookup({'hit': (hit, want)})
+    res = eng.run([Request('hit', hit, max_new=9)])
+    assert eng.prefix.hits == 1
+    assert res['hit'].tokens == want
+    assert eng.prefix.pinned_pages() == 0
+    assert eng.spec_stats()['accepted'] > 0
+
+
+def test_cancel_mid_verify_rolls_back_and_recycles():
+    """A cancel landing while a verify tick is in flight: the partial
+    result is a bitwise PREFIX of the oracle, and the freed slot
+    serves the next request bitwise-correct (rolled-back candidate
+    KV must not leak into the recycled row)."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=2, max_prompt=16,
+                  max_seq=96, spec_decode=True, spec_k=3)
+    p = _prompt(cfg, 9, 77)
+    want = _solo_generate(params, cfg, p, 24)
+    eng._lookup = _oracle_lookup({'victim': (p, want)})
+    eng.submit(Request('victim', p, max_new=24))
+    for _ in range(4):
+        eng.step()
+    assert eng.cancel('victim', reason='api')
+    eng.step()
+    eng.step()
+    res = eng.drain_results()
+    assert res['victim'].status == 'cancelled'
+    got = res['victim'].tokens
+    assert 0 < len(got) < 24
+    assert got == want[:len(got)], 'partial must prefix the oracle'
+    # Recycled slot, fresh request, no speculation noise.
+    p2 = _prompt(cfg, 11, 78)
+    eng._lookup = lambda chain, kk: []
+    res2 = eng.run([Request('next', p2, max_new=8)])
+    assert res2['next'].tokens == _solo_generate(params, cfg, p2, 8)
+
+
+def test_expire_mid_verify_releases_prefix_pins():
+    """Deadline expiry mid-speculation with the prefix cache on: the
+    terminal path still publishes/releases exactly like non-spec."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, batch_size=1, max_seq=96, page=8,
+                  prefix_cache=True, prefix_pool_pages=16,
+                  spec_decode=True, spec_k=2)
+    shared = _prompt(cfg, 8, 21)
+    eng.run([Request('pub', shared + _prompt(cfg, 2, 22), max_new=2)])
+    long = shared + _prompt(cfg, 24, 23)
+    eng.submit(Request('late', long, max_new=20,
+                       deadline=time.time() + 0.35))
+    eng.step()
+    assert eng.prefix.pinned_pages() == 1
+    time.sleep(0.45)
+    eng.step()
+    eng.step()
+    res = eng.drain_results()
+    assert res['late'].status == 'expired'
+    assert eng.prefix.pinned_pages() == 0
+
+
+# ------------------------------------------- programs, guard, metrics
+
+
+@pytest.mark.perf_smoke
+def test_no_recompile_after_warmup_spec_on():
+    """The PR-6 invariant survives speculation: after warmup() a
+    ragged run mixing accepted and rejected drafts, prefill+verify
+    fused ticks, and plain decode ticks compiles ZERO new programs —
+    verify shapes are keyed on (k,) and page counts closed over in
+    warmup."""
+    cfg, params = _setup()
+    eng = ServingEngine(params, cfg, batch_size=4, max_prompt=16,
+                        max_seq=64, decode_chunk=4, prefill_chunk=8,
+                        prefill_budget=16, spec_decode=True, spec_k=3)
+    eng.warmup()
+    sizes = (eng._decode._cache_size(), eng._mixed._cache_size(),
+             eng._spec._cache_size())
+    oracles = {}
+    reqs = []
+    for i in range(8):
+        p = _prompt(cfg, 3 + (5 * i) % 12, 300 + i)
+        mn = 3 + i % 5
+        oracles[i] = (p, _solo_generate(params, cfg, p, mn))
+        reqs.append(Request(i, p, max_new=mn))
+    base = _oracle_lookup(oracles)
+    # Alternate right/wrong drafts so both accept and reject paths
+    # (and the decode fallback when nothing drafts) all run.
+    flip = {'n': 0}
+
+    def lookup(chain, kk):
+        flip['n'] += 1
+        if flip['n'] % 3 == 0:
+            return [(chain[-1] + 3) % cfg.vocab_size] * kk
+        if flip['n'] % 3 == 1:
+            return base(chain, kk)
+        return []
+    eng._lookup = lookup
+    res = eng.run(reqs)
+    for i, (p, w) in oracles.items():
+        assert res[i].tokens == w, i
+    st = eng.spec_stats()
+    assert st['proposed'] > 0 and st['accepted'] > 0
+    assert (eng._decode._cache_size(), eng._mixed._cache_size(),
+            eng._spec._cache_size()) == sizes
+
+
+def test_capacity_guard_falls_back_near_exhaustion():
+    """Speculation must never strand an admitted request: with a
+    region so tight the verify segment cannot fit after the
+    occupant's worst case, ticks fall back to plain decode — the
+    request still finishes, bitwise correct."""
+    cfg, params = _setup()
+    # capacity = 48 - 32 = 16 and max_new consumes it EXACTLY: after
+    # the prefill-sampled first token, every remaining column is
+    # spoken for, so burning k+1=4 columns for a possibly-1-token
+    # verify advance would strand the request. The guard must refuse
+    # every verify segment and fall back to plain decode chunks.
+    eng = ServingEngine(params, cfg, batch_size=1, max_prompt=32,
+                        max_seq=48, decode_chunk=4, prefill_chunk=8,
+                        prefill_budget=8, spec_decode=True, spec_k=3)
+    p = _prompt(cfg, 8, 41)
+    want = _solo_generate(params, cfg, p, 16)
+    oracle = _oracle_lookup({'r': (p, want)})
+    calls = {'n': 0}
+
+    def counting(chain, k):
+        calls['n'] += 1
+        return oracle(chain, k)
+
+    eng._lookup = counting
+    res = eng.run([Request('r', p, max_new=16)])
+    assert res['r'].tokens == want
+    assert eng.spec_stats()['spec_ticks'] == 0, \
+        'guard must refuse the segment when the region is exact'
+    # A permanently failing guard must not tax the request either:
+    # no pipeline-breaking flushes means no proposal rounds at all —
+    # the proposer is skipped outright, not consulted-and-wasted.
+    assert calls['n'] == 0, \
+        'proposer must be skipped when verify can never dispatch'
+
+
+def test_spec_k_zero_disables_speculation(monkeypatch):
+    """An explicit spec_k=0 (ctor, --spec-k, SKYTPU_SPEC_K) means "no
+    draft tokens" and must disable speculation — not be silently
+    coerced up to the default."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, spec_decode=True, spec_k=0)
+    assert eng.spec_decode is False
+    monkeypatch.setenv('SKYTPU_SPEC_DECODE', '1')
+    monkeypatch.setenv('SKYTPU_SPEC_K', '0')
+    eng = _engine(params, cfg)
+    assert eng.spec_decode is False
+    # Sanity: the default k survives untouched when left unset.
+    monkeypatch.delenv('SKYTPU_SPEC_K')
+    eng = _engine(params, cfg, spec_decode=True)
+    assert eng.spec_decode is True and eng.spec_k == 4
+
+
+def test_dry_spell_keeps_pipelining_and_rearms():
+    """No-match traffic must not pay for speculation being on: after
+    one fresh proposal round finds nothing the engine goes dry —
+    pipelined dispatch, probe-only proposals — and a later match
+    re-arms verify ticks (fresh drafts, full parity)."""
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 55)
+    want = _solo_generate(params, cfg, p, 60)
+    eng = _engine(params, cfg, batch_size=1, max_seq=256,
+                  spec_decode=True, spec_k=3)
+    oracle = _oracle_lookup({'r': (p, want)})
+    mode = {'match': False}
+    eng._lookup = (lambda chain, k:
+                   oracle(chain, k) if mode['match'] else [])
+    eng.submit(Request('r', p, max_new=60))
+    for _ in range(10):
+        eng.step()
+    # Enough eligible rounds matched nothing (hysteresis window
+    # exhausted): dry, zero verify ticks so far.
+    assert eng._spec_dry is True
+    assert eng.spec_stats()['spec_ticks'] == 0
+    # Matches appear: the probe re-arms, verify ticks resume, output
+    # still bitwise.
+    mode['match'] = True
+    done = {}
+    while eng.queue or eng.num_active() or eng.has_pending:
+        eng.step()
+        done.update(eng.drain_results())
+    assert eng._spec_dry is False
+    st = eng.spec_stats()
+    assert st['spec_ticks'] > 0 and st['accepted'] > 0, st
+    assert done['r'].tokens == want
+
+
+def test_reject_streak_latches_dry_with_backoff():
+    """Drafts the model never confirms must latch dry like no drafts
+    at all — and the dry probe's matches must NOT re-arm at the
+    hysteresis period (they carry no new information; the doubling
+    cooldown makes the verify-tick fraction decay). Without the
+    latch, spurious n-gram matches would replace the n-step decode
+    scan with 1-token-advance verify ticks for the request's whole
+    lifetime."""
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 7)
+    want = _solo_generate(params, cfg, p, 48)
+    eng = _engine(params, cfg, batch_size=1, max_seq=256,
+                  spec_decode=True, spec_k=3)
+    # Off-by-one drafts: found every round, accepted never.
+    eng._lookup = (
+        lambda chain, kk: [(chain[-1] + 7) % cfg.vocab_size] * kk)
+    done = eng.run([Request('r', p, max_new=48)])
+    assert done['r'].tokens == want
+    st = eng.spec_stats()
+    assert st['accepted'] == 0
+    # A non-latching engine would pay ~one 1-token verify tick per
+    # emitted token (~44 here); the latch + backoff bound it to a
+    # few hysteresis windows.
+    assert 0 < st['spec_ticks'] <= 24, st
+    assert eng._spec_cooldown > 1, 'backoff must have engaged'
+
+
+def test_spec_metrics_exposition_and_summary_rate():
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 1)
+    want = _solo_generate(params, cfg, p, 12)
+    eng = _engine(params, cfg, spec_decode=True, spec_k=4)
+    eng._lookup = _oracle_lookup({'r': (p, want)})
+    eng.run([Request('r', p, max_new=12)])
+    text = metrics_lib.render_exposition()
+    assert ('# TYPE skytpu_engine_spec_proposed_tokens_total counter'
+            in text)
+    assert ('# TYPE skytpu_engine_spec_accepted_tokens_total counter'
+            in text)
+    summary = metrics_lib.summary()
+    prop = summary['skytpu_engine_spec_proposed_tokens_total']
+    acc = summary['skytpu_engine_spec_accepted_tokens_total']
+    assert prop > 0 and acc == prop
+    # The derived acceptance-rate line bench details embed.
+    assert summary['skytpu_engine_spec_acceptance_rate'] == 1.0
+    st = eng.spec_stats()
+    assert st['proposed'] == prop and st['accepted'] == acc
+
+
+def test_per_token_latency_divisor_is_acceptance_aware():
+    """A 4-token accepted burst must NOT report a 4x-optimistic
+    per-token latency: the divisor excludes accepted drafts (and is
+    bitwise the old interval/emitted with speculation off)."""
+    from skypilot_tpu.models import serving_engine as se
+    cfg, params = _setup()
+    eng = _engine(params, cfg, spec_decode=True, spec_k=4)
+    seen = []
+    orig = se._M_TOKEN_LATENCY.observe
+    se._M_TOKEN_LATENCY.observe = lambda v, **kw: seen.append(v)
+    try:
+        eng._tick_accepted = 4
+        eng._observe_per_token(1.0, 5)      # burst: 5 emitted, 4 free
+        eng._tick_accepted = 0
+        eng._observe_per_token(1.0, 5)      # plain 5-token tick
+        eng._tick_accepted = 7
+        eng._observe_per_token(1.0, 5)      # clamp: never divide by <1
+    finally:
+        se._M_TOKEN_LATENCY.observe = orig
+    assert seen[0] == pytest.approx(1.0)    # 1 model-step token
+    assert seen[1] == pytest.approx(0.2)    # spec-off semantics kept
+    assert seen[2] == pytest.approx(1.0)
+
+
+def test_spec_verify_span_emitted(tmp_path, monkeypatch):
+    """One engine.spec_verify span per verify tick with rows/proposed
+    attrs (docs/tracing.md)."""
+    monkeypatch.setenv('SKYTPU_TRACE_DIR', str(tmp_path))
+    from skypilot_tpu import trace as trace_lib
+    trace_lib.seed_ids(11)
+    cfg, params = _setup()
+    p = _prompt(cfg, 9, 1)
+    want = _solo_generate(params, cfg, p, 8)
+    eng = _engine(params, cfg, spec_decode=True, spec_k=2)
+    eng._lookup = _oracle_lookup({'r': (p, want)})
+    eng.run([Request('r', p, max_new=8)])
+    spans = []
+    for f in os.listdir(tmp_path):
+        with open(tmp_path / f) as fh:
+            spans += [json.loads(ln) for ln in fh if ln.strip()]
+    verify = [s for s in spans if s['name'] == 'engine.spec_verify']
+    assert len(verify) == eng.spec_stats()['spec_ticks'] > 0
+    assert all(s['attrs']['k'] == 2 for s in verify)
+    assert sum(s['attrs']['proposed'] for s in verify) == \
+        eng.spec_stats()['proposed']
