@@ -1,0 +1,39 @@
+"""Trace-driven load generation + SLO-goodput scoring.
+
+The measurement backbone for "faster at scale" claims
+(docs/load_testing.md, ROADMAP item 5):
+
+- :mod:`workload` — seeded, deterministic production-shaped traces:
+  Poisson / bursty (Markov-modulated) / uniform arrivals, Zipf-shared
+  prefixes, log-normal mixed prompt/output lengths, per-request
+  deadlines; replayable JSONL artifacts with a sha256 determinism
+  digest.
+- :mod:`replay` — open-loop replayers: in-process against a
+  ``ServingEngine`` (hermetic tier-1 / ``bench.py serve_load``) or
+  over HTTP/SSE against a replica or the serve LB.
+- :mod:`score` — per-request SLO attainment (TTFT < a, per-request
+  ITL p99 < b, deadline met) folded into a goodput report with
+  attainment fractions, latency percentile tables and
+  shed/expired/cancelled breakdowns.
+"""
+from skypilot_tpu.loadgen.replay import replay_engine
+from skypilot_tpu.loadgen.replay import replay_http
+from skypilot_tpu.loadgen.replay import replay_http_async
+from skypilot_tpu.loadgen.score import RequestRecord
+from skypilot_tpu.loadgen.score import SLO
+from skypilot_tpu.loadgen.score import score
+from skypilot_tpu.loadgen.workload import TraceRequest
+from skypilot_tpu.loadgen.workload import WorkloadSpec
+from skypilot_tpu.loadgen.workload import digest
+from skypilot_tpu.loadgen.workload import dump_jsonl
+from skypilot_tpu.loadgen.workload import generate
+from skypilot_tpu.loadgen.workload import load_jsonl
+from skypilot_tpu.loadgen.workload import load_jsonl_path
+from skypilot_tpu.loadgen.workload import to_jsonl
+
+__all__ = [
+    'RequestRecord', 'SLO', 'TraceRequest', 'WorkloadSpec', 'digest',
+    'dump_jsonl', 'generate', 'load_jsonl', 'load_jsonl_path',
+    'replay_engine', 'replay_http', 'replay_http_async', 'score',
+    'to_jsonl',
+]

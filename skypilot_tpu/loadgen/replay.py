@@ -1,0 +1,230 @@
+"""Open-loop trace replay (docs/load_testing.md).
+
+Open-loop means arrivals follow the TRACE's clock, never the
+server's: a slow server does not slow the offered load down, it
+builds queue — which is exactly how overload happens in production,
+and exactly what closed-loop benchmarks (submit-next-on-completion)
+can never show. Two drivers share one record shape:
+
+- :func:`replay_engine` — straight into a ``ServingEngine`` on this
+  host (no HTTP): the hermetic tier-1 / ``bench.py serve_load`` path.
+  The engine's ``on_token`` hook times TTFT and inter-token gaps; the
+  driver thread steps the engine between admissions.
+- :func:`replay_http` — an aiohttp client fleet against a replica's
+  (or the LB's) ``/generate``, streaming SSE so TTFT is the first
+  token event, not the response tail. 429/503 sheds and 504
+  deadline rejects become scored statuses, not errors.
+
+Both return ``(records, wall_s)`` ready for :func:`loadgen.score.
+score`.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.loadgen.score import RequestRecord
+from skypilot_tpu.loadgen.workload import TraceRequest
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def replay_engine(engine: Any, trace: Sequence[TraceRequest]
+                  ) -> Tuple[List[RequestRecord], float]:
+    """Replay ``trace`` open-loop into a (warmed) ``ServingEngine``.
+
+    The loop interleaves trace-clock admissions with engine ticks:
+    every iteration submits whatever the schedule says has arrived,
+    then runs one tick if any work is live, else sleeps toward the
+    next arrival. Per-request deadlines become absolute engine
+    deadlines at submit — the engine's own expiry/shed machinery is
+    what gets measured, not a replayer re-implementation.
+    """
+    from skypilot_tpu.models.serving_engine import Request
+
+    ordered = sorted(trace, key=lambda r: (r.arrival_s, r.request_id))
+    records: Dict[int, RequestRecord] = {
+        r.request_id: RequestRecord(request_id=r.request_id,
+                                    scheduled_s=r.arrival_s,
+                                    deadline_s=r.deadline_s)
+        for r in ordered}
+    last_emit: Dict[Any, float] = {}
+
+    prev_hook = engine.on_token
+
+    def on_token(rid: Any, toks: List[int]) -> None:
+        now = time.perf_counter() - start
+        rec = records.get(rid)
+        if rec is not None:
+            prev = last_emit.get(rid)
+            if prev is None:
+                rec.ttft_s = (now - rec.submitted_s
+                              if rec.submitted_s is not None else None)
+            else:
+                rec.itls.append(now - prev)
+            last_emit[rid] = now
+        if prev_hook is not None:
+            prev_hook(rid, toks)
+
+    engine.on_token = on_token
+    start = time.perf_counter()
+    i = 0
+    try:
+        while (i < len(ordered) or engine.queue or
+               engine.num_active() or engine.has_pending):
+            now = time.perf_counter() - start
+            while i < len(ordered) and ordered[i].arrival_s <= now:
+                r = ordered[i]
+                i += 1
+                rec = records[r.request_id]
+                rec.submitted_s = time.perf_counter() - start
+                try:
+                    engine.submit(Request(
+                        r.request_id, list(r.tokens), r.max_new,
+                        deadline=(time.time() + r.deadline_s
+                                  if r.deadline_s is not None
+                                  else None)))
+                except ValueError as e:
+                    rec.status = 'error'
+                    rec.reason = str(e)
+            if engine.queue or engine.num_active() or \
+                    engine.has_pending:
+                engine.step()
+            elif i < len(ordered):
+                # Idle gap: sleep toward the next arrival (bounded,
+                # so a long lull still polls the trace clock).
+                now = time.perf_counter() - start
+                # skytpu-lint: disable=STL002 — schedule pacing, not
+                # a retry loop: the sleep tracks the trace's arrival
+                # clock, there is nothing to back off from.
+                time.sleep(min(0.05,
+                               max(0.0, ordered[i].arrival_s - now)))
+            for rid, res in engine.drain_results().items():
+                rec = records.get(rid)
+                if rec is None:
+                    continue
+                rec.status = res.status
+                rec.reason = res.reason
+                rec.finished_s = time.perf_counter() - start
+                rec.n_tokens = len(res.tokens)
+        wall = time.perf_counter() - start
+    finally:
+        engine.on_token = prev_hook
+    # Flush the throttled SLO gauges so a scrape right after a short
+    # run reflects THIS run's window (steady-state gauge updates ride
+    # the 4 Hz refresher, not the per-token path).
+    engine.refresh_slo_gauges(force=True)
+    return [records[r.request_id] for r in ordered], wall
+
+
+# ----------------------------------------------------------- HTTP
+async def _replay_one(session: Any, url: str, r: TraceRequest,
+                      rec: RequestRecord, start: float,
+                      timeout_s: float) -> None:
+    import aiohttp
+
+    loop = asyncio.get_event_loop()
+    await asyncio.sleep(max(0.0, r.arrival_s - (loop.time() - start)))
+    rec.submitted_s = loop.time() - start
+    body = {'tokens': list(r.tokens), 'max_new': r.max_new,
+            'stream': True}
+    if r.deadline_s is not None:
+        body['timeout_s'] = r.deadline_s
+    try:
+        async with session.post(
+                url.rstrip('/') + '/generate', json=body,
+                timeout=aiohttp.ClientTimeout(total=timeout_s)) as resp:
+            if resp.status in (429, 503):
+                rec.status = 'shed'
+                try:
+                    rec.reason = (await resp.json()).get('reason')
+                except (ValueError, aiohttp.ClientError):
+                    pass
+                return
+            if resp.status == 504:
+                rec.status = 'deadline_rejected'
+                rec.reason = 'deadline_exceeded'
+                return
+            if resp.status != 200:
+                rec.status = 'error'
+                rec.reason = f'http {resp.status}'
+                return
+            last: Optional[float] = None
+            async for raw in resp.content:
+                line = raw.decode('utf-8', 'replace').strip()
+                if not line.startswith('data:'):
+                    continue
+                try:
+                    event = json.loads(line[len('data:'):])
+                except ValueError:
+                    # Streamed bytes are outside-world input: a
+                    # truncated data: line (replica died mid-write)
+                    # fails THIS record, never the whole replay.
+                    rec.status = 'error'
+                    rec.reason = 'malformed SSE event'
+                    return
+                now = loop.time() - start
+                if event.get('done'):
+                    rec.status = event.get('status', 'finished')
+                    rec.reason = event.get('reason')
+                    rec.finished_s = now
+                    rec.n_tokens = len(event.get('tokens') or ())
+                    return
+                if 'error' in event:
+                    rec.status = 'error'
+                    rec.reason = str(event['error'])
+                    return
+                if last is None:
+                    rec.ttft_s = now - rec.submitted_s
+                else:
+                    rec.itls.append(now - last)
+                last = now
+            rec.status = 'error'
+            rec.reason = 'stream ended without a done event'
+    except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        rec.status = 'error'
+        rec.reason = type(e).__name__
+
+
+async def replay_http_async(url: str, trace: Sequence[TraceRequest],
+                            timeout_s: float = 600.0
+                            ) -> Tuple[List[RequestRecord], float]:
+    """Open-loop SSE replay against ``url`` (an EngineServer replica
+    or the serve LB — both speak the same /generate). One task per
+    request sleeps to its arrival offset, so concurrency is whatever
+    the schedule demands — never capped by a semaphore that would
+    quietly turn the benchmark closed-loop."""
+    import aiohttp
+
+    ordered = sorted(trace, key=lambda r: (r.arrival_s, r.request_id))
+    records = [RequestRecord(request_id=r.request_id,
+                             scheduled_s=r.arrival_s,
+                             deadline_s=r.deadline_s)
+               for r in ordered]
+    loop = asyncio.get_event_loop()
+    start = loop.time()
+    async with aiohttp.ClientSession() as session:
+        # return_exceptions: one request's unexpected failure becomes
+        # that record's 'error' status — never the loss of every
+        # other record in the run.
+        outcomes = await asyncio.gather(
+            *(_replay_one(session, url, r, rec, start, timeout_s)
+              for r, rec in zip(ordered, records)),
+            return_exceptions=True)
+    for rec, outcome in zip(records, outcomes):
+        if isinstance(outcome, BaseException):
+            rec.status = 'error'
+            rec.reason = rec.reason or type(outcome).__name__
+            logger.warning('replay_http request %s failed: %r',
+                           rec.request_id, outcome)
+    return records, loop.time() - start
+
+
+def replay_http(url: str, trace: Sequence[TraceRequest],
+                timeout_s: float = 600.0
+                ) -> Tuple[List[RequestRecord], float]:
+    return asyncio.run(replay_http_async(url, trace,
+                                         timeout_s=timeout_s))

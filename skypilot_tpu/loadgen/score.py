@@ -1,0 +1,150 @@
+"""SLO scoring: records -> goodput report (docs/load_testing.md).
+
+Goodput is the AlpaServe-style metric the north star asks every
+"faster at scale" claim to carry: not requests per second, but
+requests per second that MET their service-level objectives —
+TTFT under ``a``, per-request ITL p99 under ``b``, deadline met.
+A replayer (loadgen.replay) produces one :class:`RequestRecord` per
+trace request; :func:`score` folds them into the report ``bench.py
+serve_load`` emits.
+
+All percentile math is the shared :func:`skypilot_tpu.metrics.
+percentile` helper — the same nearest-rank estimate bench detail
+reports, so a goodput report and a bench line never disagree about
+what "p99" means.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence
+
+from skypilot_tpu.metrics import percentile
+
+# Percentiles every latency table in the report carries.
+REPORT_PERCENTILES = (0.50, 0.90, 0.95, 0.99)
+
+# Terminal statuses a record may carry. 'finished' is the engine's
+# natural completion; 'expired' its deadline expiry; 'cancelled' any
+# mid-flight cancel; 'shed' an admission refusal (HTTP 429/503);
+# 'deadline_rejected' an LB 504 for a request whose budget was gone
+# before any replica saw it; 'error' transport/engine failure.
+STATUSES = ('finished', 'expired', 'cancelled', 'shed',
+            'deadline_rejected', 'error')
+
+
+@dataclasses.dataclass
+class SLO:
+    """The objectives a request is scored against. None = that
+    objective is not part of the contract (always attained).
+    Deadlines are per-request (they ride on the trace), not here."""
+    ttft_s: Optional[float] = None
+    itl_p99_s: Optional[float] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """What actually happened to one trace request. Times are offsets
+    from replay start (the trace's own clock)."""
+    request_id: int
+    scheduled_s: float
+    submitted_s: Optional[float] = None
+    status: str = 'error'
+    reason: Optional[str] = None
+    ttft_s: Optional[float] = None
+    itls: List[float] = dataclasses.field(default_factory=list)
+    finished_s: Optional[float] = None
+    n_tokens: int = 0
+    deadline_s: Optional[float] = None
+
+    def itl_p99(self) -> Optional[float]:
+        return percentile(self.itls, 0.99)
+
+
+def _attained(rec: RequestRecord, slo: SLO) -> Dict[str, bool]:
+    """Per-objective attainment for ONE request. A request that never
+    finished attains nothing it was scored on: sheds and expiries are
+    exactly the failures goodput exists to count."""
+    finished = rec.status == 'finished'
+    ttft_ok = finished and (slo.ttft_s is None or
+                            (rec.ttft_s is not None and
+                             rec.ttft_s <= slo.ttft_s))
+    itl99 = rec.itl_p99()
+    itl_ok = finished and (slo.itl_p99_s is None or itl99 is None or
+                           itl99 <= slo.itl_p99_s)
+    deadline_ok = finished and (
+        rec.deadline_s is None or
+        (rec.finished_s is not None and rec.submitted_s is not None
+         and rec.finished_s - rec.submitted_s <= rec.deadline_s))
+    return {'ttft': ttft_ok, 'itl': itl_ok, 'deadline': deadline_ok,
+            'all': ttft_ok and itl_ok and deadline_ok}
+
+
+def _pct_table(samples: Sequence[float]) -> Dict[str, Optional[float]]:
+    s = sorted(samples)  # one O(n log n) sort; percentile's own re-sort
+    out: Dict[str, Optional[float]] = {}  # is O(n) on sorted input
+    for q in REPORT_PERCENTILES:
+        p = percentile(s, q)
+        out[f'p{int(q * 100)}'] = None if p is None else round(p, 4)
+    return out
+
+
+def score(records: Sequence[RequestRecord], slo: SLO,
+          wall_s: float) -> Dict[str, Any]:
+    """Fold replay records into the goodput report:
+
+    - ``goodput_req_s`` — SLO-attaining completions per wall second
+      (the headline), next to ``offered_req_s`` and
+      ``completed_req_s`` so degradation is attributable.
+    - ``attainment`` — fraction of ALL requests meeting each
+      objective (a shed request fails every objective: shedding is a
+      capacity decision, not an excuse).
+    - ``ttft`` / ``itl`` latency percentile tables over completed
+      requests (ITL pooled across requests; per-request p99 is what
+      the itl objective scores).
+    - ``breakdown`` — terminal-status counts, sheds and expiries
+      split out (the load-shedding story in one dict).
+    """
+    n = len(records)
+    breakdown = Counter(r.status for r in records)
+    att = {k: 0 for k in ('ttft', 'itl', 'deadline', 'all')}
+    good = 0
+    for r in records:
+        a = _attained(r, slo)
+        for k in att:
+            att[k] += a[k]
+        good += a['all']
+    finished = [r for r in records if r.status == 'finished']
+    ttfts = [r.ttft_s for r in finished if r.ttft_s is not None]
+    itls = [g for r in finished for g in r.itls]
+    itl99s = [p for p in (r.itl_p99() for r in finished)
+              if p is not None]
+    wall_s = max(wall_s, 1e-9)
+    # Offered load is a property of the TRACE, not the server: the
+    # schedule span, never the wall clock — a slow server's drain
+    # tail must not make the load it buckled under look lighter.
+    span = (max(r.scheduled_s for r in records) -
+            min(r.scheduled_s for r in records)) if records else 0.0
+    offered = n / span if span > 0 else n / wall_s
+    return {
+        'n_requests': n,
+        'wall_s': round(wall_s, 3),
+        'offered_req_s': round(offered, 3),
+        'completed_req_s': round(len(finished) / wall_s, 3),
+        'goodput_req_s': round(good / wall_s, 3),
+        'slo': slo.to_json(),
+        'attainment': {k: round(v / n, 4) if n else None
+                       for k, v in att.items()},
+        'ttft': _pct_table(ttfts),
+        'itl': _pct_table(itls),
+        'itl_p99_per_request': _pct_table(itl99s),
+        'output_tokens': sum(r.n_tokens for r in records),
+        'breakdown': {
+            **{s: breakdown.get(s, 0) for s in STATUSES},
+            **{f'_{s}': c for s, c in breakdown.items()
+               if s not in STATUSES},
+        },
+    }

@@ -1,0 +1,271 @@
+"""Seeded, production-shaped workload traces (docs/load_testing.md).
+
+Every serve number before PR 13 came from 192 uniform back-to-back
+requests. Real chat/agent traffic is nothing like that: arrivals are
+Poisson at best and bursty in practice, prompts share Zipf-popular
+prefixes (system prompts, multi-turn history), lengths are heavy-
+tailed, and requests carry deadlines. This module turns a
+:class:`WorkloadSpec` into a deterministic list of
+:class:`TraceRequest` — same seed, same trace, byte-for-byte (the
+``digest`` of the canonical JSONL is the determinism receipt
+``bench.py serve_load`` records) — plus JSONL round-tripping so a
+trace is a replayable artifact, not a transient.
+
+Arrival models:
+
+- ``uniform`` — fixed ``1/qps`` gaps (the legacy bench shape, kept as
+  the control arm).
+- ``poisson`` — i.i.d. exponential inter-arrivals at ``qps``.
+- ``bursty`` — a 2-state Markov-modulated Poisson process: a HI
+  state at ``qps * burst_factor`` and a LO state at
+  ``qps / burst_factor``, drawing exponential gaps at the current
+  state's rate, with asymmetric exponential dwell (mean
+  ``burst_dwell_s / burst_factor`` in HI vs ``burst_dwell_s`` in LO)
+  chosen so the time-weighted mean rate is exactly ``qps``. Same
+  long-run offered load as ``poisson``, far spikier short-run — the
+  traffic shape that makes p99-driven autoscaling earn its keep.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+TRACE_FORMAT_VERSION = 1
+
+ARRIVAL_MODELS = ('uniform', 'poisson', 'bursty')
+
+
+@dataclasses.dataclass
+class TraceRequest:
+    """One scheduled request: WHEN it arrives (offset seconds from
+    trace start — open-loop, independent of completions), WHAT it
+    asks (prompt token ids, output budget) and HOW LONG it may take
+    (relative deadline budget; None = immortal)."""
+    request_id: int
+    arrival_s: float
+    tokens: List[int]
+    max_new: int
+    deadline_s: Optional[float] = None
+    # Which shared prefix (Zipf rank, 0 = most popular) the prompt
+    # starts with; None = a unique prompt. Carried so replay reports
+    # can split hit/miss traffic without re-deriving prefixes.
+    prefix_rank: Optional[int] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            'id': self.request_id,
+            'arrival_s': round(self.arrival_s, 6),
+            'tokens': list(self.tokens),
+            'max_new': self.max_new,
+            'deadline_s': self.deadline_s,
+            'prefix_rank': self.prefix_rank,
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, Any]) -> 'TraceRequest':
+        return cls(request_id=int(d['id']),
+                   arrival_s=float(d['arrival_s']),
+                   tokens=[int(t) for t in d['tokens']],
+                   max_new=int(d['max_new']),
+                   deadline_s=(None if d.get('deadline_s') is None
+                               else float(d['deadline_s'])),
+                   prefix_rank=(None if d.get('prefix_rank') is None
+                                else int(d['prefix_rank'])))
+
+
+@dataclasses.dataclass
+class WorkloadSpec:
+    """Everything the generator needs — and nothing ambient: two
+    specs that compare equal generate identical traces."""
+    seed: int = 0
+    n_requests: int = 64
+    qps: float = 8.0
+    arrival: str = 'poisson'
+    # bursty (MMPP-2) knobs: HI rate = qps * burst_factor, LO rate =
+    # qps / burst_factor, exponential dwell with mean burst_dwell_s.
+    burst_factor: float = 4.0
+    burst_dwell_s: float = 2.0
+    vocab_size: int = 1000
+    # Log-normal prompt/output lengths (median ~ *_median, clipped):
+    # the mixed heavy-tailed shape of real traffic.
+    prompt_median: int = 64
+    prompt_sigma: float = 0.6
+    prompt_min: int = 4
+    prompt_max: int = 256
+    output_median: int = 16
+    output_sigma: float = 0.5
+    output_min: int = 1
+    output_max: int = 64
+    # Zipf-shared prefixes (composes with the engine prefix cache /
+    # BENCH_SERVE_PREFIX_* workloads): 0 prefixes = unique prompts.
+    n_prefixes: int = 0
+    prefix_len: int = 0
+    zipf_s: float = 1.1
+    # Relative per-request deadline budget; None = no deadlines.
+    deadline_s: Optional[float] = None
+
+    def validate(self) -> None:
+        if self.arrival not in ARRIVAL_MODELS:
+            raise ValueError(
+                f'arrival must be one of {ARRIVAL_MODELS}, got '
+                f'{self.arrival!r}')
+        if self.qps <= 0 or self.n_requests <= 0:
+            raise ValueError('qps and n_requests must be positive')
+        if self.n_prefixes and self.prefix_len <= 0:
+            raise ValueError(
+                'n_prefixes > 0 needs a positive prefix_len')
+        if self.n_prefixes and self.prefix_len >= self.prompt_max:
+            raise ValueError(
+                f'prefix_len ({self.prefix_len}) must leave room for '
+                f'a suffix under prompt_max ({self.prompt_max})')
+        if self.burst_factor < 1.0:
+            raise ValueError('burst_factor must be >= 1')
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _arrivals(spec: WorkloadSpec,
+              rng: np.random.Generator) -> List[float]:
+    n = spec.n_requests
+    if spec.arrival == 'uniform':
+        return [i / spec.qps for i in range(n)]
+    if spec.arrival == 'poisson':
+        gaps = rng.exponential(1.0 / spec.qps, n)
+        return list(np.cumsum(gaps) - gaps[0])
+    # bursty: 2-state MMPP with rates qps*f (HI) and qps/f (LO).
+    # Dwell means are ASYMMETRIC so the time-weighted mean rate is
+    # exactly qps: with mean dwells d_hi, d_lo the long-run rate is
+    # (d_hi*qps*f + d_lo*qps/f) / (d_hi + d_lo), which equals qps
+    # iff d_hi = d_lo / f — HI bursts are short and hot, LO valleys
+    # long and quiet, same offered load as the poisson arm (the
+    # comparison the p99 story rests on).
+    hi = spec.qps * spec.burst_factor
+    lo = spec.qps / spec.burst_factor
+    dwell = {True: spec.burst_dwell_s / spec.burst_factor,
+             False: spec.burst_dwell_s}
+    out: List[float] = []
+    t = 0.0
+    in_hi = bool(rng.integers(0, 2))
+    dwell_left = float(rng.exponential(dwell[in_hi]))
+    while len(out) < n:
+        rate = hi if in_hi else lo
+        gap = float(rng.exponential(1.0 / rate))
+        if gap >= dwell_left:
+            # State flips before the next arrival: burn the dwell and
+            # redraw in the new state (memorylessness makes the
+            # discard exact).
+            t += dwell_left
+            in_hi = not in_hi
+            dwell_left = float(rng.exponential(dwell[in_hi]))
+            continue
+        t += gap
+        dwell_left -= gap
+        out.append(t)
+    return [a - out[0] for a in out]
+
+
+def _lengths(rng: np.random.Generator, n: int, median: int,
+             sigma: float, lo: int, hi: int) -> np.ndarray:
+    raw = rng.lognormal(math.log(max(1, median)), sigma, n)
+    return np.clip(raw.astype(np.int64), lo, hi)
+
+
+def generate(spec: WorkloadSpec) -> List[TraceRequest]:
+    """Spec -> deterministic trace. One seeded RNG drives arrivals,
+    lengths, prefix picks and token draws in a fixed order, so the
+    whole trace — schedule included — is a pure function of the
+    spec."""
+    spec.validate()
+    rng = np.random.default_rng(spec.seed)
+    arrivals = _arrivals(spec, rng)
+    n = spec.n_requests
+    plens = _lengths(rng, n, spec.prompt_median, spec.prompt_sigma,
+                     spec.prompt_min, spec.prompt_max)
+    outs = _lengths(rng, n, spec.output_median, spec.output_sigma,
+                    spec.output_min, spec.output_max)
+    prefixes: List[List[int]] = []
+    weights: Optional[np.ndarray] = None
+    if spec.n_prefixes:
+        prefixes = [
+            [int(t) for t in rng.integers(0, spec.vocab_size,
+                                          spec.prefix_len)]
+            for _ in range(spec.n_prefixes)]
+        weights = np.arange(1, spec.n_prefixes + 1,
+                            dtype=np.float64) ** -spec.zipf_s
+        weights /= weights.sum()
+    trace: List[TraceRequest] = []
+    for i in range(n):
+        rank: Optional[int] = None
+        if prefixes:
+            rank = int(rng.choice(spec.n_prefixes, p=weights))
+            suffix_len = max(1, int(plens[i]) - spec.prefix_len)
+            tokens = prefixes[rank] + [
+                int(t) for t in rng.integers(0, spec.vocab_size,
+                                             suffix_len)]
+        else:
+            tokens = [int(t) for t in rng.integers(
+                0, spec.vocab_size, int(plens[i]))]
+        trace.append(TraceRequest(
+            request_id=i,
+            arrival_s=float(arrivals[i]),
+            tokens=tokens,
+            max_new=int(outs[i]),
+            deadline_s=spec.deadline_s,
+            prefix_rank=rank))
+    return trace
+
+
+# ------------------------------------------------------------ JSONL
+def to_jsonl(trace: Sequence[TraceRequest],
+             spec: Optional[WorkloadSpec] = None) -> str:
+    """Canonical JSONL text: an optional header line naming the
+    format version and generating spec, then one line per request.
+    Canonical (sorted keys, fixed rounding) so equal traces are equal
+    BYTES — the property :func:`digest` certifies."""
+    lines = []
+    if spec is not None:
+        lines.append(json.dumps(
+            {'loadgen_trace': TRACE_FORMAT_VERSION,
+             'spec': spec.to_json()}, sort_keys=True))
+    for r in trace:
+        lines.append(json.dumps(r.to_json(), sort_keys=True))
+    return '\n'.join(lines) + '\n'
+
+
+def dump_jsonl(trace: Sequence[TraceRequest], path: str,
+               spec: Optional[WorkloadSpec] = None) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(to_jsonl(trace, spec))
+
+
+def load_jsonl(source: Iterable[str]) -> List[TraceRequest]:
+    """Parse a trace from JSONL lines (a file object works); header
+    lines are recognized and skipped."""
+    out: List[TraceRequest] = []
+    for line in source:
+        line = line.strip()
+        if not line:
+            continue
+        d = json.loads(line)
+        if 'loadgen_trace' in d:
+            continue
+        out.append(TraceRequest.from_json(d))
+    return out
+
+
+def load_jsonl_path(path: str) -> List[TraceRequest]:
+    with open(path, encoding='utf-8') as f:
+        return load_jsonl(f)
+
+
+def digest(trace: Sequence[TraceRequest]) -> str:
+    """sha256 of the canonical JSONL (header excluded): the
+    determinism receipt — same seed, same digest, across processes
+    and platforms."""
+    return hashlib.sha256(to_jsonl(trace).encode()).hexdigest()

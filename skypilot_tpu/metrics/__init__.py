@@ -30,6 +30,7 @@ Every name must match ``skytpu_[a-z0-9_]+`` and carry a help string
 (enforced at registration and re-checked by the metrics lint test).
 """
 from skypilot_tpu.metrics.exposition import CONTENT_TYPE
+from skypilot_tpu.metrics.exposition import parse_values
 from skypilot_tpu.metrics.exposition import render
 from skypilot_tpu.metrics.registry import Counter
 from skypilot_tpu.metrics.registry import DEFAULT_MAX_SERIES
@@ -41,11 +42,14 @@ from skypilot_tpu.metrics.registry import Metric
 from skypilot_tpu.metrics.registry import OVERFLOW_LABEL
 from skypilot_tpu.metrics.registry import REGISTRY
 from skypilot_tpu.metrics.registry import Registry
+from skypilot_tpu.metrics.registry import bucket_quantile
 from skypilot_tpu.metrics.registry import merge_families
 from skypilot_tpu.metrics.snapshot import METRICS_DIR_ENV
 from skypilot_tpu.metrics.snapshot import dump as dump_snapshot
 from skypilot_tpu.metrics.snapshot import load as load_snapshots
 from skypilot_tpu.metrics.snapshot import merged_families
+from skypilot_tpu.metrics.window import SlidingWindowPercentile
+from skypilot_tpu.metrics.window import percentile
 
 counter = REGISTRY.counter
 gauge = REGISTRY.gauge
@@ -94,7 +98,9 @@ __all__ = [
     'CONTENT_TYPE', 'Counter', 'DEFAULT_MAX_SERIES',
     'FAST_LATENCY_BUCKETS', 'Gauge', 'Histogram', 'LATENCY_BUCKETS',
     'METRICS_DIR_ENV', 'Metric', 'OVERFLOW_LABEL', 'REGISTRY',
-    'Registry', 'counter', 'dump_snapshot', 'gauge', 'histogram',
-    'load_snapshots', 'merge_families', 'merged_families', 'render',
-    'render_exposition', 'summary',
+    'Registry', 'SlidingWindowPercentile', 'bucket_quantile',
+    'counter', 'dump_snapshot', 'gauge', 'histogram',
+    'load_snapshots', 'merge_families', 'merged_families',
+    'parse_values', 'percentile', 'render', 'render_exposition',
+    'summary',
 ]

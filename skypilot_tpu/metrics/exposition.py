@@ -14,6 +14,27 @@ from typing import Any, Dict
 CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 
 
+def parse_values(text: str) -> Dict[str, float]:
+    """Inverse of :func:`render` for SAMPLE lines:
+    ``{'name{label="v"}': value}`` (comment/blank lines skipped).
+
+    The scrape-side reader the SLO autoscaler uses on replica
+    ``/metrics`` bodies. Scraped text is outside-world input, so a
+    malformed line is skipped, never raised on — one mangled replica
+    response must not kill a control loop."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith('#'):
+            continue
+        name, _, value = line.rpartition(' ')
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
 def _fmt(value: float) -> str:
     """Prometheus-friendly number: integral floats print as ints."""
     f = float(value)

@@ -153,6 +153,19 @@ class Gauge(Metric):
 
     kind = 'gauge'
 
+    def __init__(self, name: str, help: str,
+                 label_names: Sequence[str] = (),
+                 max_series: int = DEFAULT_MAX_SERIES) -> None:
+        super().__init__(name, help, label_names, max_series)
+        # Per-series exemplar ({'trace_id', 'value'}), carried through
+        # families()/the snapshot spool exactly like histogram
+        # exemplars. Written only by set(exemplar=...): derived
+        # gauges (the p99 latency gauges) use it to pin the trace of
+        # the observation that made the gauge interesting — an
+        # SLO-violating request — so a dashboard alert resolves to a
+        # concrete span tree (docs/tracing.md).
+        self._exemplars: Dict[Tuple[str, ...], Dict[str, Any]] = {}
+
     def _new_state(self) -> List[float]:
         return [0.0]
 
@@ -160,10 +173,18 @@ class Gauge(Metric):
     def _copy_state(state: List[float]) -> float:
         return state[0]
 
-    def set(self, value: float, **labels: Any) -> None:
+    def set(self, value: float, *, exemplar: Optional[str] = None,
+            **labels: Any) -> None:
+        """Set the series value. ``exemplar`` (a trace id) is STICKY:
+        passing None keeps whatever exemplar a previous set pinned —
+        so a violation's trace survives later unremarkable updates of
+        the same gauge until the next violation replaces it."""
         key = self._key(labels)
         with self._lock:
             self._slot(key)[0] = float(value)
+            if exemplar:
+                self._exemplars[key] = {'trace_id': str(exemplar),
+                                        'value': float(value)}
 
     def inc(self, amount: float = 1.0, **labels: Any) -> float:
         key = self._key(labels)
@@ -209,6 +230,21 @@ class Gauge(Metric):
         key = self._key(labels)
         with self._lock:
             self._series.pop(key, None)
+            self._exemplars.pop(key, None)
+
+    def exemplar(self, **labels: Any) -> Optional[Dict[str, Any]]:
+        """The series' pinned exemplar ({'trace_id', 'value'}) or
+        None. Exact-key read: exemplars are point correlations, never
+        folded into '_other'."""
+        key = self._key(labels)
+        with self._lock:
+            e = self._exemplars.get(key)
+            return dict(e) if e else None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._exemplars.clear()
 
 
 class Histogram(Metric):
@@ -261,6 +297,50 @@ class Histogram(Metric):
             if exemplar:
                 state['exemplar'] = {'trace_id': str(exemplar),
                                      'value': float(value)}
+
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
+        """Quantile estimate from the series' cumulative buckets
+        (PromQL ``histogram_quantile`` semantics — see
+        :func:`bucket_quantile`). None when the series is empty or
+        absent. The in-process counterpart of a dashboard's p99
+        query: the SLO autoscaler and bench detail read exactly the
+        number an operator's PromQL would produce."""
+        key = self._key(labels)
+        with self._lock:
+            state = self._read_slot(key)
+            counts = None if state is None else list(state['counts'])
+        if counts is None:
+            return None
+        return bucket_quantile(self.buckets, counts, q)
+
+
+def bucket_quantile(bounds: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Quantile estimate from fixed-bucket counts — the ONE
+    bucket-quantile implementation (``Histogram.quantile`` and the
+    sliding-window estimator both call it).
+
+    ``counts`` has ``len(bounds) + 1`` bins, the last being the
+    implicit overflow bin. PromQL ``histogram_quantile`` semantics:
+    rank = q * total, find the bin whose cumulative count crosses it,
+    interpolate linearly between the bin's edges (the first bucket
+    interpolates from 0). A rank landing in the overflow bin returns
+    the highest finite bound — an estimate can never exceed what the
+    buckets resolve. Returns None for an empty series or q outside
+    [0, 1]."""
+    total = sum(counts)
+    if total <= 0 or not 0.0 <= q <= 1.0:
+        return None
+    rank = q * total
+    acc = 0
+    for i, c in enumerate(counts[:-1]):
+        prev = acc
+        acc += c
+        if c and acc >= rank:
+            lo = bounds[i - 1] if i else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * (rank - prev) / c
+    return float(bounds[-1])
 
 
 class Registry:
@@ -355,8 +435,13 @@ class Registry:
                 if isinstance(metric, Histogram):
                     fam['series'].append({'labels': labels, **state})
                 else:
-                    fam['series'].append({'labels': labels,
-                                          'value': state})
+                    entry: Dict[str, Any] = {'labels': labels,
+                                             'value': state}
+                    if isinstance(metric, Gauge):
+                        ex = metric.exemplar(**labels)
+                        if ex:
+                            entry['exemplar'] = ex
+                    fam['series'].append(entry)
             out[metric.name] = fam
         return out
 
@@ -435,6 +520,10 @@ def merge_families(base: Dict[str, Dict[str, Any]],
                     have['exemplar'] = dict(s['exemplar'])
             else:
                 have['value'] = have.get('value', 0.0) + s['value']
+                if isinstance(s.get('exemplar'), dict):
+                    # Same rule as histograms: exemplars are point
+                    # samples, latest merged snapshot wins.
+                    have['exemplar'] = dict(s['exemplar'])
 
 
 # The process-wide default registry every production metric lives in.

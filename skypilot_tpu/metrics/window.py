@@ -1,0 +1,145 @@
+"""Sliding-window percentiles + the shared exact-percentile helper.
+
+Two quantile surfaces feed the SLO machinery (docs/load_testing.md):
+
+- :func:`percentile` — nearest-rank percentile over EXACT samples.
+  The one sample-percentile implementation in the repo: bench.py's
+  latency detail and loadgen's SLO scoring both call it (bench.py
+  used to carry a private ``_pct`` copy).
+- :class:`SlidingWindowPercentile` — a bucket-based estimator over a
+  sliding time window, for signals that must FORGET: the cumulative
+  ``skytpu_engine_ttft_seconds`` histogram remembers every request
+  since process start, so its p99 cannot come back down after a
+  transient regression — useless as an autoscaler input. The window
+  splits into ``slices`` sub-windows of fixed-bucket counts
+  (histogram-shaped, so the estimate is the same
+  :func:`registry.bucket_quantile` math ``Histogram.quantile`` uses);
+  ``observe`` is one bisect + add, stale slices age out as time
+  advances, and ``to_state``/``restore`` round-trip across controller
+  restarts like the autoscaler's QPS window does.
+
+Thread-safe: the engine driver thread observes while HTTP scrape
+threads read quantiles.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from skypilot_tpu.metrics.registry import LATENCY_BUCKETS
+from skypilot_tpu.metrics.registry import bucket_quantile
+
+
+def percentile(samples: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile: ``sorted(s)[ceil(q * n) - 1]``
+    (clamped to the sample range). None on no samples."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(1, math.ceil(len(s) * q)) - 1)
+    return s[idx]
+
+
+class SlidingWindowPercentile:
+    """Quantile estimates over the last ``window_s`` seconds.
+
+    Internally a ring of ``slices`` sub-windows, each a fixed-bucket
+    count array; a sub-window older than the window is dropped on the
+    next touch. Granularity: an observation lingers up to one
+    sub-window length (window_s / slices) past the window edge —
+    acceptable for a scaling signal, free of per-sample memory.
+    """
+
+    def __init__(self, window_s: float = 60.0, slices: int = 6,
+                 buckets: Sequence[float] = LATENCY_BUCKETS) -> None:
+        if window_s <= 0 or slices <= 0:
+            raise ValueError(
+                f'window_s ({window_s}) and slices ({slices}) must '
+                'be positive')
+        self.window_s = float(window_s)
+        self.slices = int(slices)
+        self.buckets = tuple(float(b) for b in buckets)
+        self._slice_s = self.window_s / self.slices
+        # slice epoch (int(now / slice_s)) -> per-bucket counts
+        # (len(buckets) + 1, overflow last — the Histogram layout).
+        self._bins: Dict[int, List[int]] = {}
+        self._lock = threading.Lock()
+
+    def _epoch(self, now: float) -> int:
+        return int(now / self._slice_s)
+
+    def _prune(self, epoch: int) -> None:
+        """Drop slices outside the window. Caller holds the lock."""
+        cutoff = epoch - self.slices
+        for e in [e for e in self._bins if e <= cutoff]:
+            del self._bins[e]
+
+    def observe(self, value: float,
+                now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        epoch = self._epoch(now)
+        with self._lock:
+            self._prune(epoch)
+            bins = self._bins.get(epoch)
+            if bins is None:
+                bins = self._bins[epoch] = [0] * (len(self.buckets) + 1)
+            bins[bisect.bisect_left(self.buckets, value)] += 1
+
+    def _merged(self, now: float) -> List[int]:
+        epoch = self._epoch(now)
+        with self._lock:
+            self._prune(epoch)
+            merged = [0] * (len(self.buckets) + 1)
+            for bins in self._bins.values():
+                for i, c in enumerate(bins):
+                    merged[i] += c
+            return merged
+
+    def count(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        return sum(self._merged(now))
+
+    def quantile(self, q: float,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Bucket-quantile estimate over the live window; None while
+        the window is empty (callers keep their last value — an empty
+        window means no traffic, not zero latency)."""
+        now = time.time() if now is None else now
+        return bucket_quantile(self.buckets, self._merged(now), q)
+
+    # -------------------------------------------------- durability
+    def to_state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'window_s': self.window_s,
+                'slices': self.slices,
+                'buckets': list(self.buckets),
+                'bins': {str(e): list(b)
+                         for e, b in self._bins.items()},
+            }
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Rebuild the window from a snapshot. Mismatched bucket
+        bounds or malformed state restore to EMPTY (never a partial
+        merge of incompatible bins); slices outside the window age
+        out at the next touch, so a long-dead snapshot contributes
+        nothing."""
+        if not isinstance(state, dict):
+            return
+        if list(state.get('buckets', ())) != list(self.buckets):
+            return
+        n_bins = len(self.buckets) + 1
+        bins: Dict[int, List[int]] = {}
+        for e, b in (state.get('bins') or {}).items():
+            try:
+                epoch = int(e)
+            except (TypeError, ValueError):
+                continue
+            if isinstance(b, list) and len(b) == n_bins and \
+                    all(isinstance(c, int) and c >= 0 for c in b):
+                bins[epoch] = list(b)
+        with self._lock:
+            self._bins = bins

@@ -177,6 +177,39 @@ _M_SPEC_ACCEPTED = metrics_lib.counter(
     'skytpu_engine_spec_accepted_tokens_total',
     'Drafted tokens the batched verify pass accepted (each one is an '
     'output token that skipped a sequential decode step).')
+# SLO telemetry (docs/load_testing.md): sliding-window p99 gauges the
+# autoscaler scrapes. The cumulative TTFT/ITL histograms never forget,
+# so their quantiles cannot come back down after a transient
+# regression — these gauges re-estimate p99 over the last
+# SKYTPU_SLO_WINDOW_S seconds and carry the trace id of the latest
+# SLO-violating request as an exemplar.
+_M_TTFT_P99 = metrics_lib.gauge(
+    'skytpu_engine_ttft_p99_seconds',
+    'Sliding-window p99 of submit-to-first-token latency '
+    '(SKYTPU_SLO_WINDOW_S; exemplar = trace id of the latest request '
+    'over the SKYTPU_SLO_TTFT_S threshold). The TTFT signal the SLO '
+    'autoscaler scrapes.')
+_M_ITL_P99 = metrics_lib.gauge(
+    'skytpu_engine_itl_p99_seconds',
+    'Sliding-window p99 of inter-token latency (SKYTPU_SLO_WINDOW_S; '
+    'exemplar = trace id of the latest request over the '
+    'SKYTPU_SLO_ITL_S threshold). The ITL signal the SLO autoscaler '
+    'scrapes.')
+_M_EST_WAIT = metrics_lib.gauge(
+    'skytpu_engine_est_wait_seconds',
+    'estimate_wait_s(0, 1) refreshed every tick: the queue-wait a '
+    'minimal request arriving NOW would see, from queue depth + '
+    'prefill backlog + decode width over the measured tick EWMA. '
+    'The admission-pressure signal the SLO autoscaler scrapes — it '
+    'rises with a traffic spike ticks before the 60 s QPS window '
+    'does.')
+_M_SLO_VIOLATIONS = metrics_lib.counter(
+    'skytpu_engine_slo_violations_total',
+    'Latency observations over their configured SLO threshold, by '
+    'kind: one per request for ttft (SKYTPU_SLO_TTFT_S), one per '
+    'inter-token gap for itl (SKYTPU_SLO_ITL_S) — a long stream '
+    'with many slow gaps counts each stall it inflicted.',
+    labels=('kind',))
 
 # Consecutive no-draft proposal rounds before the engine goes "dry":
 # while dry, ticks stay fully pipelined (no flush) and proposals only
@@ -717,10 +750,32 @@ class ServingEngine:
         # traced args exist either way; only spec ticks fill them).
         self._drafts0 = jnp.zeros((batch_size, self.spec_k), jnp.int32)
         self._slen0 = jnp.zeros((batch_size,), jnp.int32)
+        # SLO telemetry (docs/load_testing.md): sliding p99 windows
+        # behind the cumulative histograms, and the violation
+        # thresholds. 0 = no threshold (windows/gauges update
+        # regardless; only violation accounting and exemplar pinning
+        # are gated).
+        window_s = float(env_registry.get(
+            env_registry.SKYTPU_SLO_WINDOW_S, '60'))
+        self._slo_ttft_s = float(env_registry.get(
+            env_registry.SKYTPU_SLO_TTFT_S, '0'))
+        self._slo_itl_s = float(env_registry.get(
+            env_registry.SKYTPU_SLO_ITL_S, '0'))
+        self._ttft_window = metrics_lib.SlidingWindowPercentile(
+            window_s)
+        self._itl_window = metrics_lib.SlidingWindowPercentile(
+            window_s)
+        # Next refresh_slo_gauges() deadline (perf_counter): bounds
+        # the est-wait O(queue) scan to 4 Hz however hot the tick
+        # loop runs.
+        self._slo_refresh_at = 0.0
         # Gauges exist (as 0) from boot, so a scrape of an idle
         # replica still sees the full metric surface.
         _M_QUEUE_DEPTH.touch()
         _M_ACTIVE_SLOTS.touch()
+        _M_TTFT_P99.touch()
+        _M_ITL_P99.touch()
+        _M_EST_WAIT.touch()
         if self.spec_decode:
             # Spec counters exist (as 0) the moment speculation is
             # on: an all-reject workload must still scrape a 0
@@ -1625,7 +1680,33 @@ class ServingEngine:
                 self.num_active(), len(self.queue), traces[:4] or None)
         _M_QUEUE_DEPTH.set(len(self.queue))
         _M_ACTIVE_SLOTS.set(self.num_active())
+        if not self._warming:
+            self.refresh_slo_gauges()
         return emitted
+
+    def refresh_slo_gauges(self, force: bool = False) -> None:
+        """Re-derive the scraped SLO gauges from live state, at most
+        4x/second: the sliding p99s (a quiet window must DECAY the
+        gauge to 0, never freeze it at the last violating value — the
+        SLO autoscaler keeps scraping, and a frozen breach would pin
+        the fleet at max_replicas on zero traffic) and the est-wait
+        admission-pressure estimate (throttled because its O(queue)
+        scan must not ride every tick of an overloaded engine — the
+        exact load the open-loop bench creates). Called per working
+        tick and from the HTTP driver's idle loop; ``force`` skips
+        the throttle (end-of-replay flush, so a scrape right after a
+        short run sees the run, not the previous refresh window)."""
+        now_pc = time.perf_counter()
+        if not force and now_pc < self._slo_refresh_at:
+            return
+        self._slo_refresh_at = now_pc + 0.25
+        p99 = self._ttft_window.quantile(0.99)
+        _M_TTFT_P99.set(p99 if p99 is not None else 0.0)
+        p99 = self._itl_window.quantile(0.99)
+        _M_ITL_P99.set(p99 if p99 is not None else 0.0)
+        # Rises with a burst the moment the queue does — ticks before
+        # the 60 s QPS window moves.
+        _M_EST_WAIT.set(self.estimate_wait_s(0, 1))
 
     def flush(self) -> int:
         """Sync and process the in-flight tick without dispatching a
@@ -1843,13 +1924,41 @@ class ServingEngine:
                 fc = ts.pop('first_chunk', None)
                 if fc is not None:
                     fc.finish()
-                _M_TTFT.observe(
-                    now - ts['request'].start_time,
-                    exemplar=ts['request'].exemplar)
+                ttft = now - ts['request'].start_time
+                _M_TTFT.observe(ttft, exemplar=ts['request'].exemplar)
+                self._observe_slo('ttft', ttft,
+                                  ts['request'].exemplar)
             else:
-                _M_TTFT.observe(now - self._submitted_at.get(
-                    state.request_id, now))
+                ttft = now - self._submitted_at.get(
+                    state.request_id, now)
+                _M_TTFT.observe(ttft)
+                self._observe_slo('ttft', ttft, None)
         return [tok]
+
+    def _observe_slo(self, kind: str, value: float,
+                     exemplar: Optional[str]) -> None:
+        """Feed the sliding p99 window behind the cumulative
+        histogram and refresh the scraped gauge. A value past the
+        configured threshold counts a violation and pins its trace id
+        on the gauge (sticky exemplar: Gauge.set keeps it across
+        unremarkable updates) — the number that trips an alert
+        carries the span tree that explains it."""
+        if kind == 'ttft':
+            win, gauge, thr = (self._ttft_window, _M_TTFT_P99,
+                               self._slo_ttft_s)
+        else:
+            win, gauge, thr = (self._itl_window, _M_ITL_P99,
+                               self._slo_itl_s)
+        win.observe(value)
+        violated = thr > 0 and value > thr
+        if not violated:
+            # Steady state leaves the gauge to the 4 Hz refresher:
+            # recomputing the window p99 per emitted token is pure
+            # overhead on the decode hot path.
+            return
+        _M_SLO_VIOLATIONS.inc(1, kind=kind)
+        p99 = win.quantile(0.99)
+        gauge.set(value if p99 is None else p99, exemplar=exemplar)
 
     def _process_tick(self, entry: Optional[Dict[str, Any]]) -> int:
         if entry is None:
@@ -1957,10 +2066,11 @@ class ServingEngine:
                 # by the tick time — i.e. by the prefill token
                 # budget, not by co-admitted prompt lengths.
                 ts = self._req_spans.get(state.request_id)
-                _M_ITL.observe(
-                    now_pc - state.last_emit_at,
-                    exemplar=(ts['request'].exemplar
-                              if ts is not None else None))
+                itl = now_pc - state.last_emit_at
+                itl_exemplar = (ts['request'].exemplar
+                                if ts is not None else None)
+                _M_ITL.observe(itl, exemplar=itl_exemplar)
+                self._observe_slo('itl', itl, itl_exemplar)
             state.last_emit_at = now_pc
             if self.on_token is not None:
                 self.on_token(state.request_id, fresh)

@@ -152,6 +152,11 @@ class EngineServer:
                         return
                     self._resolve_finished()
                     continue
+                # Idle ticks still decay the scraped SLO gauges: a
+                # p99 frozen at its last (violating) value after
+                # traffic stops would keep breaching the autoscaler
+                # forever (internally throttled to 4 Hz).
+                self.engine.refresh_slo_gauges()
                 # skytpu-lint: disable=STL002 — idle tick of the
                 # driver loop, not a retry: errors kill the driver
                 # (_die), they are never retried here.
@@ -690,7 +695,14 @@ class EngineServer:
             return web.json_response({'status': 'draining'}, status=503)
         if not self._ready.is_set():
             return web.json_response({'status': 'warming'}, status=503)
-        return web.json_response({'status': 'ok'})
+        # The admission-pressure estimate rides on /health so probes
+        # (and humans curling a replica) see queue pressure without a
+        # full /metrics parse; the scraped gauge form is
+        # skytpu_engine_est_wait_seconds.
+        return web.json_response(
+            {'status': 'ok',
+             'est_wait_s': round(self.engine.estimate_wait_s(0, 1),
+                                 4)})
 
     async def handle_metrics(self, request: web.Request
                              ) -> web.Response:

@@ -1,4 +1,4 @@
-"""Request-rate autoscaler with hysteresis.
+"""Request-rate + SLO autoscalers with hysteresis.
 
 Re-design of reference ``sky/serve/autoscalers.py:431``
 (RequestRateAutoscaler): target replica count = ceil(recent QPS /
@@ -6,19 +6,51 @@ target_qps_per_replica), clamped to [min, max]; scale decisions only
 fire after the signal persists for the upscale/downscale delay —
 upscale reacts fast (minutes), downscale slowly (tens of minutes) so
 bursts don't thrash TPU slices that take minutes to provision.
+
+:class:`SLOAutoscaler` layers latency objectives on top
+(docs/load_testing.md): it scrapes each replica's sliding-window p99
+TTFT/ITL gauges and the engine's ``skytpu_engine_est_wait_seconds``
+queue-wait estimate from ``/metrics``, and scales UP when any signal
+breaches its target for ``slo_upscale_delay_seconds`` — catching the
+two failure shapes QPS-derived scaling is blind to: a latency
+regression at flat request rate (a slow replica, a tick hang) and a
+burst whose queue builds ticks before the 60 s QPS window moves.
 """
 from __future__ import annotations
 
 import dataclasses
 import math
 import time
+import urllib.error
+import urllib.request
 from collections import deque
-from typing import Deque, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
 
 _QPS_WINDOW_SECONDS = 60.0
+
+# A replica sample older than this is ignored by breach detection: a
+# replica that stopped answering scrapes must not pin the fleet to
+# its last (possibly terrible) numbers forever.
+_SLO_SAMPLE_TTL_SECONDS = 120.0
+
+# (sample key, scraped gauge, ServiceSpec target attribute): the
+# scraped series ARE the scaling signal, exactly like the QPS
+# counter — an operator graphing the replica's p99 gauge sees the
+# number the autoscaler acts on.
+SLO_SIGNALS = (
+    ('ttft_p99', 'skytpu_engine_ttft_p99_seconds',
+     'target_ttft_p99_s'),
+    ('itl_p99', 'skytpu_engine_itl_p99_seconds',
+     'target_itl_p99_s'),
+    ('est_wait', 'skytpu_engine_est_wait_seconds',
+     'target_queue_wait_s'),
+)
 
 # The scaling signal IS the scraped series (docs/metrics.md): every
 # record_request increments this counter, and current_qps derives
@@ -111,7 +143,11 @@ class RequestRateAutoscaler:
 
     def __init__(self, spec: ServiceSpec,
                  service: str = 'default') -> None:
-        assert spec.target_qps_per_replica is not None
+        # The SLOAutoscaler subclass may run latency-only (no QPS
+        # target): the QPS path then holds min_replicas and only the
+        # SLO path moves the target.
+        assert (spec.target_qps_per_replica is not None or
+                spec.slo_targets()), spec
         self.spec = spec
         self._service = service
         # (timestamp, cumulative count) per recorded request, where
@@ -194,10 +230,15 @@ class RequestRateAutoscaler:
         return (latest - self._window_base) / _QPS_WINDOW_SECONDS
 
     def _raw_target(self, now: float) -> int:
-        qps = self.current_qps(now)
-        target = math.ceil(qps / self.spec.target_qps_per_replica)
         lo = self.spec.min_replicas
         hi = self.spec.max_replicas
+        if self.spec.target_qps_per_replica is None:
+            # SLO-only scaling: the QPS path's desire is the floor,
+            # so an SLO-raised target decays back once the breach
+            # clears and the downscale delay passes.
+            return lo
+        qps = self.current_qps(now)
+        target = math.ceil(qps / self.spec.target_qps_per_replica)
         return max(lo, min(hi, target) if hi is not None else target)
 
     def evaluate(self, current_replicas: Optional[int] = None,
@@ -228,6 +269,180 @@ class RequestRateAutoscaler:
         return ScalingDecision(self._target)
 
 
+class SLOAutoscaler(RequestRateAutoscaler):
+    """Scale on what users feel, not on how often they ask.
+
+    Signals (see :data:`SLO_SIGNALS`) come from replica ``/metrics``
+    scrapes: the engine's sliding-window p99 TTFT/ITL gauges and its
+    ``estimate_wait_s`` queue-pressure gauge. A breach — any fresh
+    sample over its target — that persists ``slo_upscale_delay_
+    seconds`` raises the owned target proportionally to the worst
+    breach ratio (clamped to one doubling per step), with the same
+    delay as a cooldown so consecutive scale-ups step rather than
+    run away. While breached, the QPS path's DOWNSCALE hysteresis is
+    frozen: demand math must never shrink a fleet that is visibly
+    missing its latency objectives. Recovery is the QPS path's job —
+    once no signal breaches, its raw target (or min_replicas,
+    latency-only) becomes the desire and the ordinary downscale
+    delay walks the fleet back down.
+    """
+
+    def __init__(self, spec: ServiceSpec,
+                 service: str = 'default') -> None:
+        super().__init__(spec, service=service)
+        # url -> {'at': ts, '<signal>': value}
+        self._slo_samples: Dict[str, Dict[str, float]] = {}
+        self._breach_since: Optional[float] = None
+        self._last_slo_scale_at: Optional[float] = None
+
+    # --------------------------------------------------- ingestion
+    def observe_replica(self, url: str, values: Dict[str, float],
+                        now: Optional[float] = None) -> None:
+        """Record one replica's scraped gauge values (``values`` is a
+        parse_values() dict, metric name -> value). Tests feed this
+        directly; production goes through scrape_replicas()."""
+        now = now if now is not None else time.time()
+        sample: Dict[str, float] = {'at': now}
+        for key, metric, _ in SLO_SIGNALS:
+            v = values.get(metric)
+            if v is not None:
+                sample[key] = float(v)
+        self._slo_samples[url] = sample
+
+    def scrape_replicas(self, urls: List[str],
+                        timeout: float = 2.0,
+                        now: Optional[float] = None) -> None:
+        """Best-effort scrape of every ready replica's ``/metrics``
+        (called off the event loop by the controller). Scrapes run
+        CONCURRENTLY so a pass is bounded by ~one timeout, not
+        timeout * fleet — a few wedged replicas must not delay the
+        very scale-up decision this loop exists to make. A replica
+        that fails to answer keeps its previous sample until the TTL
+        ages it out; replicas gone from ``urls`` are dropped."""
+        import concurrent.futures
+
+        def fetch(url: str) -> Optional[str]:
+            try:
+                with urllib.request.urlopen(
+                        url.rstrip('/') + '/metrics',
+                        timeout=timeout) as resp:
+                    return resp.read().decode('utf-8', 'replace')
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                logger.debug('SLO scrape of %s failed: %s', url, e)
+                return None
+        if urls:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(16, len(urls))) as pool:
+                for url, text in zip(urls, pool.map(fetch, urls)):
+                    if text is not None:
+                        self.observe_replica(
+                            url, metrics_lib.parse_values(text),
+                            now=now)
+        keep = set(urls)
+        self._slo_samples = {u: s
+                             for u, s in self._slo_samples.items()
+                             if u in keep}
+
+    # ------------------------------------------------------ breach
+    def _worst_breach(self, now: float
+                      ) -> Optional[Tuple[float, str, str]]:
+        """(ratio, signal, url) of the worst fresh signal relative to
+        its target, or None with no usable samples. ratio > 1 means
+        the objective is being missed."""
+        targets = self.spec.slo_targets()
+        worst: Optional[Tuple[float, str, str]] = None
+        for url, sample in self._slo_samples.items():
+            if now - sample['at'] > _SLO_SAMPLE_TTL_SECONDS:
+                continue
+            for key, target in targets.items():
+                value = sample.get(key)
+                if value is None:
+                    continue
+                ratio = value / target
+                if worst is None or ratio > worst[0]:
+                    worst = (ratio, key, url)
+        return worst
+
+    # -------------------------------------------------- durability
+    def to_state(self) -> dict:
+        state = super().to_state()
+        state['slo'] = {
+            'breach_since': self._breach_since,
+            'last_scale_at': self._last_slo_scale_at,
+            'samples': {u: dict(s)
+                        for u, s in self._slo_samples.items()},
+        }
+        return state
+
+    def restore(self, state: dict) -> None:
+        """Back-compat by construction: an old-format state dict
+        (pre-SLO fields) restores the QPS window exactly as the base
+        class does and leaves the SLO clocks cold — no error, no
+        phantom breach. The converse also holds: the base class
+        ignores the extra 'slo' key in a new-format dict."""
+        super().restore(state)
+        slo = state.get('slo') or {}
+        self._breach_since = slo.get('breach_since')
+        self._last_slo_scale_at = slo.get('last_scale_at')
+        samples = slo.get('samples') or {}
+        self._slo_samples = {
+            str(u): {k: float(v) for k, v in s.items()}
+            for u, s in samples.items()
+            if isinstance(s, dict) and 'at' in s}
+
+    # -------------------------------------------------- evaluation
+    def evaluate(self, current_replicas: Optional[int] = None,
+                 now: Optional[float] = None,
+                 num_ready_spot: int = 0) -> ScalingDecision:
+        now = now if now is not None else time.time()
+        breach = self._worst_breach(now)
+        breached = breach is not None and breach[0] > 1.0
+        if not breached:
+            self._breach_since = None
+            # Healthy: the QPS path owns the target (including the
+            # slow decay of an SLO-raised target back to demand).
+            decision = super().evaluate(current_replicas, now)
+        else:
+            # Freeze QPS hysteresis: a downscale desire built from
+            # demand math must not fire while latency objectives are
+            # being missed (the desire clock restarts clean after the
+            # breach clears).
+            self._desire_since = None
+            self._desired = None
+            # The QPS window still prunes while breached — breaches
+            # happen under heavy traffic, exactly when an unpruned
+            # sample deque (and the to_state() dump of it) would grow
+            # without bound.
+            self.current_qps(now)
+            if self._breach_since is None:
+                self._breach_since = now
+            ratio, signal, url = breach
+            delay = self.spec.slo_upscale_delay_seconds
+            sustained = now - self._breach_since >= delay
+            cooled = (self._last_slo_scale_at is None or
+                      now - self._last_slo_scale_at >= delay)
+            hi = self.spec.max_replicas
+            if sustained and cooled and \
+                    (hi is None or self._target < hi):
+                # Proportional step, one doubling max: a 1.3x breach
+                # adds ~30% capacity, a 10x breach doubles — enough
+                # to move p99 fast without slamming max_replicas on
+                # the first wobble.
+                step = max(1, math.ceil(
+                    self._target * (min(ratio, 2.0) - 1.0)))
+                new = self._target + step
+                if hi is not None:
+                    new = min(new, hi)
+                logger.info(
+                    'SLO scale-up %d -> %d: %s breached %.2fx at %s '
+                    '(sustained %.0fs).', self._target, new, signal,
+                    ratio, url, now - self._breach_since)
+                self._target = new
+                self._last_slo_scale_at = now
+            decision = ScalingDecision(self._target)
+        return _with_spot_split(self.spec, decision, num_ready_spot)
+
+
 class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     """QPS autoscaling on spot capacity with an on-demand safety net
     (reference sky/serve/autoscalers.py:546): the base target is
@@ -242,6 +457,11 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
 
 
 def make_autoscaler(spec: ServiceSpec, service: str = 'default'):
+    if spec.slo_targets():
+        # SLO targets win: the SLOAutoscaler keeps the QPS path as
+        # its demand floor (when configured) and applies the spot
+        # split itself.
+        return SLOAutoscaler(spec, service=service)
     if spec.target_qps_per_replica is None:
         return FixedReplicaAutoscaler(spec, service=service)
     if spec.use_spot:

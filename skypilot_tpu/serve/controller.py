@@ -143,6 +143,15 @@ class ServeController:
                 num_ready_spot = sum(
                     1 for r in latest if r.get('is_spot') and
                     r['status'] is ReplicaStatus.READY)
+                if isinstance(self.autoscaler,
+                              autoscalers.SLOAutoscaler):
+                    # Feed the latency loop: scrape each ready
+                    # replica's p99/est-wait gauges off the event
+                    # loop (bounded per-replica timeout) before the
+                    # scaling decision reads them.
+                    await asyncio.to_thread(
+                        self.autoscaler.scrape_replicas,
+                        self.replica_manager.ready_urls())
                 decision = self.autoscaler.evaluate(
                     len(pool), num_ready_spot=num_ready_spot)
                 serve_state.save_autoscaler_state(

@@ -39,6 +39,7 @@ from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
@@ -68,6 +69,13 @@ _M_ERRORS = metrics_lib.counter(
     'Proxy failures per replica by kind (connect, disconnect, '
     'mid_stream, upstream, shed).',
     labels=('replica', 'kind'))
+_M_LATENCY_P99 = metrics_lib.gauge(
+    'skytpu_lb_request_p99_seconds',
+    'Sliding-window p99 of end-to-end proxied request latency across '
+    'all replicas (SKYTPU_SLO_WINDOW_S, default 60 s). The '
+    'LB-level latency signal dashboards and the SLO autoscaler read '
+    'without a PromQL histogram_quantile over the cumulative '
+    'per-replica histograms.')
 _M_DEADLINE_REJECTS = metrics_lib.counter(
     'skytpu_lb_deadline_rejects_total',
     'Requests answered 504 at the LB because their deadline passed '
@@ -186,6 +194,13 @@ class LoadBalancer:
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._draining: Set[str] = set()
+        # Sliding p99 window behind the cumulative per-replica
+        # latency histograms (docs/load_testing.md): per-instance so
+        # a rebuilt LB starts a fresh window, feeding the
+        # skytpu_lb_request_p99_seconds gauge.
+        self._latency_window = metrics_lib.SlidingWindowPercentile(
+            float(env_registry.get(env_registry.SKYTPU_SLO_WINDOW_S,
+                                   '60')))
 
     def set_replica_urls(self, urls: List[str]) -> None:
         self.policy.set_urls(urls)
@@ -308,6 +323,10 @@ class LoadBalancer:
                 sp.finish(status=resp.status)
                 _M_LATENCY.observe(sp.duration, exemplar=sp.exemplar,
                                    replica=url)
+                self._latency_window.observe(sp.duration)
+                p99 = self._latency_window.quantile(0.99)
+                if p99 is not None:
+                    _M_LATENCY_P99.set(p99)
                 return resp
             except _ReplicaShedError as e:
                 # The replica REFUSED the request (429 queue-full /

@@ -44,6 +44,22 @@ class ServiceSpec:
     use_spot: bool = False
     base_ondemand_fallback_replicas: int = 0
     dynamic_ondemand_fallback: bool = False
+    # SLO-driven scaling (docs/load_testing.md): latency objectives
+    # the autoscaler holds by adding replicas — p99 TTFT / p99
+    # inter-token latency (scraped from each replica's sliding-window
+    # gauges) and the engine's estimated queue wait. Any of these set
+    # selects the SLOAutoscaler; QPS-derived scaling still applies
+    # underneath as the demand floor when target_qps_per_replica is
+    # also set.
+    target_ttft_p99_s: Optional[float] = None
+    target_itl_p99_s: Optional[float] = None
+    target_queue_wait_s: Optional[float] = None
+    # Breach persistence before an SLO scale-up fires (and the
+    # cooldown between consecutive SLO scale-ups). Deliberately much
+    # shorter than upscale_delay_seconds: a latency regression is
+    # user-visible NOW, while raw QPS growth tolerates minutes of
+    # confirmation.
+    slo_upscale_delay_seconds: int = 60
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -89,9 +105,36 @@ class ServiceSpec:
                 policy.get('base_ondemand_fallback_replicas', 0)),
             dynamic_ondemand_fallback=bool(
                 policy.get('dynamic_ondemand_fallback', False)),
+            target_ttft_p99_s=(
+                float(policy['target_ttft_p99_s'])
+                if policy.get('target_ttft_p99_s') is not None else
+                None),
+            target_itl_p99_s=(
+                float(policy['target_itl_p99_s'])
+                if policy.get('target_itl_p99_s') is not None else
+                None),
+            target_queue_wait_s=(
+                float(policy['target_queue_wait_s'])
+                if policy.get('target_queue_wait_s') is not None else
+                None),
+            slo_upscale_delay_seconds=int(
+                policy.get('slo_upscale_delay_seconds', 60)),
         )
         spec.validate()
         return spec
+
+    def slo_targets(self) -> Dict[str, float]:
+        """The configured SLO objectives, keyed by signal name
+        (``ttft_p99`` / ``itl_p99`` / ``est_wait``). Empty = no SLO
+        scaling."""
+        out = {}
+        if self.target_ttft_p99_s is not None:
+            out['ttft_p99'] = self.target_ttft_p99_s
+        if self.target_itl_p99_s is not None:
+            out['itl_p99'] = self.target_itl_p99_s
+        if self.target_queue_wait_s is not None:
+            out['est_wait'] = self.target_queue_wait_s
+        return out
 
     def validate(self) -> None:
         if self.min_replicas < 0:
@@ -109,6 +152,32 @@ class ServiceSpec:
             raise exceptions.InvalidTaskError(
                 'autoscaling (target_qps_per_replica) requires '
                 'max_replicas')
+        for name in ('target_ttft_p99_s', 'target_itl_p99_s',
+                     'target_queue_wait_s'):
+            value = getattr(self, name)
+            if value is not None and value <= 0:
+                raise exceptions.InvalidTaskError(
+                    f'{name} must be > 0')
+        if self.slo_targets() and self.max_replicas is None:
+            raise exceptions.InvalidTaskError(
+                'SLO autoscaling (target_ttft_p99_s / '
+                'target_itl_p99_s / target_queue_wait_s) requires '
+                'max_replicas')
+        if (self.slo_targets() and self.min_replicas < 1 and
+                self.target_qps_per_replica is None):
+            # Latency-only SLO scaling gets every signal from ready
+            # replicas' /metrics: at zero replicas there is nothing to
+            # scrape, so the service could never scale up from zero.
+            # A QPS target keeps scale-from-zero viable (LB-recorded
+            # demand exists without replicas).
+            raise exceptions.InvalidTaskError(
+                'SLO-only autoscaling requires min_replicas >= 1: '
+                'its signals come from replica /metrics, which do '
+                'not exist at zero replicas (add '
+                'target_qps_per_replica to allow scale-from-zero)')
+        if self.slo_upscale_delay_seconds < 0:
+            raise exceptions.InvalidTaskError(
+                'slo_upscale_delay_seconds must be >= 0')
         if self.base_ondemand_fallback_replicas < 0:
             raise exceptions.InvalidTaskError(
                 'base_ondemand_fallback_replicas must be >= 0')
@@ -132,6 +201,11 @@ class ServiceSpec:
                 'target_qps_per_replica': self.target_qps_per_replica,
                 'upscale_delay_seconds': self.upscale_delay_seconds,
                 'downscale_delay_seconds': self.downscale_delay_seconds,
+                'target_ttft_p99_s': self.target_ttft_p99_s,
+                'target_itl_p99_s': self.target_itl_p99_s,
+                'target_queue_wait_s': self.target_queue_wait_s,
+                'slo_upscale_delay_seconds':
+                    self.slo_upscale_delay_seconds,
                 'use_spot': self.use_spot,
                 'base_ondemand_fallback_replicas':
                     self.base_ondemand_fallback_replicas,

@@ -256,6 +256,27 @@ SKYTPU_SPEC_NGRAM = register(
     'against the slot token chain (default 3; longer suffix matches '
     'are tried first, most recent occurrence wins).')
 
+# ----------------------------------------------------------------- SLO
+SKYTPU_SLO_TTFT_S = register(
+    'SKYTPU_SLO_TTFT_S',
+    'TTFT SLO threshold in seconds for the serving engine: a first '
+    'token slower than this counts a violation '
+    '(skytpu_engine_slo_violations_total{kind=ttft}) and pins the '
+    'request\'s trace id on the p99 gauge as an exemplar '
+    '(docs/load_testing.md). 0 (default) disables violation '
+    'accounting; the p99 gauges update regardless.')
+SKYTPU_SLO_ITL_S = register(
+    'SKYTPU_SLO_ITL_S',
+    'Inter-token-latency SLO threshold in seconds (same semantics as '
+    'SKYTPU_SLO_TTFT_S, for the streaming stall between token '
+    'bursts). 0 (default) disables violation accounting.')
+SKYTPU_SLO_WINDOW_S = register(
+    'SKYTPU_SLO_WINDOW_S',
+    'Sliding-window length in seconds for the skytpu_*_p99 latency '
+    'gauges (engine TTFT/ITL, LB request latency; default 60). The '
+    'window forgets, unlike the cumulative histograms — it is the '
+    'signal the SLO autoscaler scales on.')
+
 # --------------------------------------------------- request lifecycle
 SKYTPU_DRAIN_TIMEOUT_SECONDS = register(
     'SKYTPU_DRAIN_TIMEOUT_SECONDS',
@@ -371,6 +392,50 @@ BENCH_DECODE_PAGE = register(
     'BENCH_DECODE_PAGE', 'Decode bench page size (tokens).')
 BENCH_DECODE_HEADROOM = register(
     'BENCH_DECODE_HEADROOM', 'Decode bench extra page headroom.')
+BENCH_LOAD_SEED = register(
+    'BENCH_LOAD_SEED',
+    'serve_load bench: workload-generator seed (same seed => '
+    'byte-identical trace and request schedule; the emitted '
+    'trace_sha256 is the receipt).')
+BENCH_LOAD_REQUESTS = register(
+    'BENCH_LOAD_REQUESTS', 'serve_load bench: total request count.')
+BENCH_LOAD_QPS = register(
+    'BENCH_LOAD_QPS',
+    'serve_load bench: mean offered load in requests/second (the '
+    'open-loop schedule follows this clock regardless of server '
+    'speed).')
+BENCH_LOAD_ARRIVAL = register(
+    'BENCH_LOAD_ARRIVAL',
+    'serve_load bench arrival model: poisson | bursty (Markov-'
+    'modulated, default) | uniform (the legacy back-to-back '
+    'control arm).')
+BENCH_LOAD_BURST = register(
+    'BENCH_LOAD_BURST',
+    'serve_load bench: bursty-arrival rate multiplier (HI state = '
+    'qps * factor, LO = qps / factor; default 4).')
+BENCH_LOAD_PREFIXES = register(
+    'BENCH_LOAD_PREFIXES',
+    'serve_load bench: number of Zipf-shared prompt prefixes (0 = '
+    'unique prompts). > 0 also enables the engine prefix cache, so '
+    'the goodput number includes the reuse the cache buys.')
+BENCH_LOAD_DEADLINE_S = register(
+    'BENCH_LOAD_DEADLINE_S',
+    'serve_load bench: per-request deadline budget in seconds '
+    '(unset = no deadlines; deadlines feed the engine expiry/shed '
+    'machinery and the deadline-attainment score).')
+BENCH_LOAD_SLO_TTFT = register(
+    'BENCH_LOAD_SLO_TTFT',
+    'serve_load bench: TTFT SLO in seconds a request must meet to '
+    'count toward goodput.')
+BENCH_LOAD_SLO_ITL = register(
+    'BENCH_LOAD_SLO_ITL',
+    'serve_load bench: per-request ITL p99 SLO in seconds for '
+    'goodput.')
+BENCH_LOAD_TRACE = register(
+    'BENCH_LOAD_TRACE',
+    'serve_load bench: also write the generated trace (with its '
+    'spec header) to this JSONL path — the replayable round '
+    'artifact.')
 BENCH_SPEC_K = register(
     'BENCH_SPEC_K',
     'Speculative-decoding draft length for the decode/serve benches '

@@ -44,7 +44,8 @@ _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
                'test_chunked_prefill.py', 'test_prefix_cache.py',
                'test_spec_decode.py', 'test_bench_smoke.py',
                'test_metrics.py', 'test_analysis.py', 'test_trace.py',
-               'test_request_lifecycle.py', 'test_statedb.py')
+               'test_request_lifecycle.py', 'test_statedb.py',
+               'test_loadgen.py')
 
 # Crash-recovery round trips (test_crash_recovery.py subprocess cases)
 # drive real local clusters through kill+restart cycles — priced like

@@ -146,3 +146,34 @@ def test_probe_device_records_exception_detail():
         4.0, 2, probe_fn=lambda t: (False, boom))
     assert detail['attempts'] == 2
     assert 'PJRT plugin exploded' in detail['error']
+
+
+def test_bench_smoke_serve_load():
+    """serve_load emits a deterministic goodput report: two runs of
+    the same seed produce an IDENTICAL trace digest and request
+    schedule, and the report carries goodput + per-objective
+    attainment + shed/expired breakdowns."""
+    first = _run_smoke('serve_load')
+    assert first['metric'] == 'llama_serve_goodput_req_s'
+    assert first['value'] > 0
+    d = first['detail']
+    assert d['backend'] == 'cpu'
+    assert d['arrival'] == 'bursty'
+    assert d['n_requests'] == 24
+    # Goodput never exceeds offered load; vs_baseline IS the
+    # attainment ratio.
+    assert d['goodput_req_s'] <= d['offered_req_s'] + 1e-9
+    assert 0 <= first['vs_baseline'] <= 1
+    for key in ('ttft', 'itl', 'attainment', 'breakdown',
+                'trace_sha256', 'schedule_head_s', 'slo'):
+        assert key in d, key
+    for objective in ('ttft', 'itl', 'deadline', 'all'):
+        assert 0 <= d['attainment'][objective] <= 1
+    for status in ('finished', 'shed', 'expired', 'cancelled'):
+        assert status in d['breakdown'], status
+    assert sum(v for k, v in d['breakdown'].items()
+               if not k.startswith('_')) == d['n_requests']
+    # Same seed => identical trace and schedule, across processes.
+    second = _run_smoke('serve_load')
+    assert second['detail']['trace_sha256'] == d['trace_sha256']
+    assert second['detail']['schedule_head_s'] == d['schedule_head_s']

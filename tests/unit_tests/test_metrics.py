@@ -614,3 +614,135 @@ def test_full_stack_metrics_under_live_requests():
                   r'\{replica="' + re.escape(replica) + r'"\} (\d+)',
                   lb_text)
     assert m is not None and int(m.group(1)) >= 2
+
+
+# --------------------------------------- quantiles + sliding windows
+
+def test_bucket_quantile_golden():
+    """Golden values for the one bucket-quantile implementation
+    (PromQL histogram_quantile semantics): bounds (1, 2, 4), counts
+    [10, 10, 0, 0] -> 20 samples, uniform within buckets."""
+    bounds = (1.0, 2.0, 4.0)
+    counts = [10, 10, 0, 0]
+    assert metrics.bucket_quantile(bounds, counts, 0.5) == 1.0
+    assert metrics.bucket_quantile(bounds, counts, 0.25) == 0.5
+    assert metrics.bucket_quantile(bounds, counts, 0.75) == 1.5
+    assert metrics.bucket_quantile(bounds, counts, 1.0) == 2.0
+    # Overflow bin: the estimate clamps to the highest finite bound.
+    assert metrics.bucket_quantile(bounds, [0, 0, 0, 5], 0.99) == 4.0
+    # Empty / out-of-range q.
+    assert metrics.bucket_quantile(bounds, [0, 0, 0, 0], 0.5) is None
+    assert metrics.bucket_quantile(bounds, counts, 1.5) is None
+
+
+def test_histogram_quantile_golden():
+    reg = metrics.Registry()
+    h = reg.histogram('skytpu_t_q_seconds', 'T.', buckets=(1, 2, 4))
+    assert h.quantile(0.5) is None               # empty series
+    for v in [0.5] * 10 + [1.5] * 10:
+        h.observe(v)
+    assert h.quantile(0.5) == 1.0
+    assert h.quantile(0.75) == 1.5
+    # Labeled series quantile matches the same math.
+    hl = reg.histogram('skytpu_t_ql_seconds', 'T.', labels=('k',),
+                       buckets=(1, 2, 4))
+    for v in (0.5, 8.0):
+        hl.observe(v, k='a')
+    assert hl.quantile(1.0, k='a') == 4.0        # overflow clamp
+    assert hl.quantile(0.5, k='missing') is None
+
+
+def test_percentile_nearest_rank_golden():
+    assert metrics.percentile([], 0.5) is None
+    assert metrics.percentile([7.0], 0.99) == 7.0
+    s = [5.0, 1.0, 3.0, 2.0, 4.0]
+    assert metrics.percentile(s, 0.5) == 3.0
+    assert metrics.percentile(s, 0.99) == 5.0
+    assert metrics.percentile(s, 1.0) == 5.0
+    # Matches the definition sorted(s)[ceil(q*n) - 1].
+    assert metrics.percentile(list(range(1, 101)), 0.95) == 95
+
+
+def test_sliding_window_percentile_forgets():
+    w = metrics.SlidingWindowPercentile(window_s=60, slices=6,
+                                        buckets=(0.1, 1.0, 10.0))
+    t0 = 1000.0
+    for _ in range(99):
+        w.observe(0.05, now=t0)
+    w.observe(5.0, now=t0 + 1)                   # one slow outlier
+    assert w.count(now=t0 + 2) == 100
+    assert w.quantile(0.5, now=t0 + 2) <= 0.1
+    assert w.quantile(0.999, now=t0 + 2) > 1.0
+    # The defining property vs the cumulative histogram: after the
+    # window passes, the regression is FORGOTTEN.
+    assert w.quantile(0.999, now=t0 + 120) is None
+    w.observe(0.05, now=t0 + 120)
+    assert w.quantile(0.999, now=t0 + 121) <= 0.1
+
+
+def test_sliding_window_state_roundtrip():
+    w = metrics.SlidingWindowPercentile(window_s=60, slices=6)
+    t0 = 5000.0
+    for i in range(50):
+        w.observe(0.2, now=t0 + i)
+    state = w.to_state()
+    back = metrics.SlidingWindowPercentile(window_s=60, slices=6)
+    back.restore(state)
+    assert back.count(now=t0 + 50) == w.count(now=t0 + 50)
+    assert back.quantile(0.99, now=t0 + 50) == \
+        w.quantile(0.99, now=t0 + 50)
+    # Mismatched bucket bounds restore EMPTY, never merge garbage.
+    other = metrics.SlidingWindowPercentile(window_s=60, slices=6,
+                                            buckets=(1, 2))
+    other.restore(state)
+    assert other.count(now=t0 + 50) == 0
+    other.restore('junk')                        # malformed: no-op
+    other.restore({'bins': {'x': [1]}})
+
+
+def test_gauge_exemplar_sticky_and_merge():
+    reg = metrics.Registry()
+    g = reg.gauge('skytpu_t_p99_seconds', 'T.')
+    g.set(0.5)
+    assert g.exemplar() is None
+    g.set(2.0, exemplar='trace-abc')
+    # Sticky: an exemplar-less update keeps the pinned trace.
+    g.set(0.4)
+    assert g.exemplar() == {'trace_id': 'trace-abc', 'value': 2.0}
+    fam = reg.families()['skytpu_t_p99_seconds']
+    assert fam['series'][0]['exemplar']['trace_id'] == 'trace-abc'
+    # A newer violation replaces it.
+    g.set(3.0, exemplar='trace-def')
+    assert g.exemplar()['trace_id'] == 'trace-def'
+    # merge_families: gauge exemplars ride along, latest wins.
+    base = reg.families()
+    metrics.merge_families(base, {
+        'skytpu_t_p99_seconds': {
+            'kind': 'gauge', 'help': 'T.', 'label_names': [],
+            'series': [{'labels': {}, 'value': 1.0,
+                        'exemplar': {'trace_id': 'trace-xyz',
+                                     'value': 9.0}}]}})
+    merged = base['skytpu_t_p99_seconds']['series'][0]
+    assert merged['value'] == 4.0                # summed
+    assert merged['exemplar']['trace_id'] == 'trace-xyz'
+    # clear() drops exemplars with the series.
+    g.clear()
+    assert g.exemplar() is None
+    # remove() on a labeled gauge prunes its exemplar too.
+    gl = reg.gauge('skytpu_t_lab_seconds', 'T.', labels=('r',))
+    gl.set(1.0, exemplar='t1', r='a')
+    gl.remove(r='a')
+    assert gl.exemplar(r='a') is None
+
+
+def test_parse_values_roundtrip():
+    reg = metrics.Registry()
+    reg.counter('skytpu_t_reqs_total', 'T.').inc(5)
+    g = reg.gauge('skytpu_t_wait_seconds', 'T.', labels=('svc',))
+    g.set(1.25, svc='a')
+    text = metrics.render(reg.families())
+    values = metrics.parse_values(text)
+    assert values['skytpu_t_reqs_total'] == 5
+    assert values['skytpu_t_wait_seconds{svc="a"}'] == 1.25
+    # Outside-world input: comments, blanks and garbage are skipped.
+    assert metrics.parse_values('# HELP x\n\nnot a number here\n') == {}
