@@ -1085,6 +1085,85 @@ def serve_stack_bench():
 # One subprocess per mode: every bench assumes a fresh chip (HBM
 # fragmentation from a previous mode would contaminate timings), and
 # a crash in one mode must not take down the rest.
+def fleet_bench():
+    """Control-plane scale bench (docs/control_plane.md): drive
+    BENCH_FLEET_JOBS managed jobs and BENCH_FLEET_SERVICES services
+    through launch->preempt->recover->terminate on the synthetic
+    cloud with BENCH_FLEET_WORKERS lease-claiming fleet workers,
+    killing BENCH_FLEET_KILLS of them mid-run. No devices, no real
+    clouds — the measured article is the control plane itself.
+
+    Headline: jobs/s settled. The detail block carries
+    time-to-reconcile after each worker kill, lease churn
+    (claims/takeovers/renewals), preemption/recovery counts, and the
+    invariants (zero orphaned clusters, zero fence violations, the
+    stale-write fencing probe, empty intent journals);
+    ``vs_baseline`` is settled/offered (1.0 = everything settled).
+    A seeded fault plan injects transient provision failures at the
+    ``fleet.synth.launch`` site so the launch retry path is part of
+    the measurement.
+    """
+    import tempfile
+
+    from skypilot_tpu.fleet import scale_harness
+    from skypilot_tpu.utils import fault_injection
+
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    seed = int(os.environ.get('BENCH_FLEET_SEED', '0'))
+    jobs = int(os.environ.get('BENCH_FLEET_JOBS',
+                              '24' if smoke else '1000'))
+    services = int(os.environ.get('BENCH_FLEET_SERVICES',
+                                  '3' if smoke else '100'))
+    workers = int(os.environ.get('BENCH_FLEET_WORKERS',
+                                 '3' if smoke else '4'))
+    kills = int(os.environ.get('BENCH_FLEET_KILLS', '1'))
+    replicas = int(os.environ.get('BENCH_FLEET_REPLICAS', '2'))
+    deadline = float(os.environ.get('BENCH_FLEET_DEADLINE_S',
+                                    '90' if smoke else '540'))
+    # Isolated control-plane state: a bench round must never touch
+    # (or inherit) the operator's real jobs/serve DBs.
+    state_dir = tempfile.mkdtemp(prefix='skytpu-fleet-bench-')
+    os.environ['SKYTPU_JOBS_DB'] = os.path.join(state_dir, 'jobs.db')
+    os.environ['SKYTPU_SERVE_DB'] = os.path.join(state_dir, 'serve.db')
+    os.environ['SKYTPU_STATE_DB'] = os.path.join(state_dir, 'state.db')
+    os.environ['SKYTPU_DATA_DIR'] = os.path.join(state_dir, 'data')
+    plan = scale_harness.FleetPlan(
+        jobs=jobs,
+        services=services,
+        replicas_per_service=replicas,
+        workers=workers,
+        kill_workers=kills,
+        kill_after_settled_jobs=max(3, jobs // 20),
+        # Small runs settle in seconds — the time fallback must fire
+        # while workers still hold leases or the kill is skipped; at
+        # scale the settled-jobs progress trigger stays primary.
+        kill_after_s=1.0 if jobs <= 100 else 10.0,
+        preempt_jobs=max(2, jobs // 100),
+        preempt_replicas=max(1, services // 20),
+        seed=seed,
+        deadline_s=deadline,
+    )
+    faults = [{
+        'site': 'fleet.synth.launch',
+        'kind': 'provision_failure',
+        'after': max(2, jobs // 10),
+        'times': max(2, jobs // 100),
+    }]
+    with _bench_span('fleet', jobs=jobs, services=services,
+                     workers=workers):
+        with fault_injection.fault_plan(faults, seed=seed):
+            report = scale_harness.run_fleet_harness(plan)
+    settled = report['jobs']['settled']
+    print(json.dumps({
+        'metric': 'fleet_jobs_per_s',
+        'value': report['jobs']['per_s'],
+        'unit': 'jobs/s',
+        'vs_baseline': round(settled / max(1, jobs), 4),
+        'detail': report,
+    }))
+    return 0 if report['ok'] else 1
+
+
 _ALL_MODES = {
     'train': {},
     'moe_train': {'BENCH_MODEL': 'tpu_moe_1b', 'BENCH_BATCH': '1',
@@ -1123,6 +1202,10 @@ _ALL_MODES = {
     # arrivals at ~capacity, scored against TTFT/ITL SLOs — the
     # round's SLO-attainment number next to its raw req/s.
     'serve_load': {'BENCH_MODE': 'serve_load'},
+    # Control-plane scale (docs/control_plane.md): lease-fleet
+    # throughput on the synthetic cloud — jobs/s settled,
+    # time-to-reconcile after a worker kill, lease churn. No device.
+    'fleet': {'BENCH_MODE': 'fleet'},
 }
 
 
@@ -1231,14 +1314,23 @@ def _probe_device(timeout_s: float, attempts: int,
     complete in 180s' — the detail now records how many attempts
     ran, how long each took, and the active trace id, so a recorded
     failure distinguishes a flaky tunnel (later attempts differ)
-    from a dead one (every attempt times out flat)."""
+    from a dead one (every attempt times out flat).
+
+    The policy carries BOTH exponential backoff (a TPU tunnel that
+    just dropped usually needs seconds, not milliseconds, to come
+    back — hammering it with back-to-back probes burns the attempt
+    budget inside the blip) and an overall ``deadline`` equal to
+    1.5x the probe budget, so backoff time can never stretch a dead
+    round past its bound (the BENCH_r05 failure mode: a single-mode
+    round killed by one transient drop)."""
     from skypilot_tpu import trace as trace_mod
     from skypilot_tpu.utils import retry as retry_lib
     probe_fn = probe_fn or _probe_once
     per_attempt = max(1.0, timeout_s / max(1, attempts))
     policy = retry_lib.RetryPolicy(
-        max_attempts=attempts, initial_backoff=1.0, max_backoff=5.0,
-        jitter='none', site='bench.device_probe')
+        max_attempts=attempts, initial_backoff=2.0, max_backoff=15.0,
+        multiplier=2.0, jitter='none', deadline=timeout_s * 1.5,
+        site='bench.device_probe')
     state = policy.new_state()
     durations = []
     last_err = None
@@ -1260,6 +1352,7 @@ def _probe_device(timeout_s: float, attempts: int,
         'attempts': len(durations),
         'attempt_durations_s': durations,
         'per_attempt_timeout_s': round(per_attempt, 1),
+        'deadline_s': round(timeout_s * 1.5, 1),
         'trace_id': trace_mod.current_trace_id(),
     }
 
@@ -1312,10 +1405,15 @@ if __name__ == '__main__':
     _trace_mod.set_component(f'bench.{mode}')
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
-    # same thing); other modes probe in-process.
-    _device_watchdog(float(os.environ.get(
-        'BENCH_DEVICE_TIMEOUT',
-        '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
+    # same thing); other modes probe in-process. 'fleet' never
+    # touches a device (pure control plane), so a dead TPU tunnel
+    # must not kill its round.
+    if mode != 'fleet':
+        _device_watchdog(float(os.environ.get(
+            'BENCH_DEVICE_TIMEOUT',
+            '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
+    if mode == 'fleet':
+        sys.exit(fleet_bench())
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':

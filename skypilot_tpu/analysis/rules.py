@@ -1,4 +1,4 @@
-"""skytpu-lint rule catalog (STL001–STL010).
+"""skytpu-lint rule catalog (STL001–STL011).
 
 Each rule encodes one repo invariant that used to be enforced only at
 runtime or by convention; docs/static_analysis.md carries the full
@@ -802,6 +802,50 @@ class RawSqliteOutsideStateDB(Rule):
         return None
 
 
+class DirectClockInControlPlane(Rule):
+    """STL011: direct wall-clock / raw-sqlite calls in the
+    fleet-shared control plane (``jobs/``, ``serve/``, ``fleet/``).
+
+    These layers are driven by the fleet scale harness and by tests
+    under *injectable* time (``statedb.wall_now()`` behind the
+    ``retry.Clock`` interface — a ``FakeClock`` deterministically
+    drives lease expiry, restart budgets and probe deadlines) and by
+    the ONE statedb connection recipe. A bare ``time.time()`` pins
+    the code to the real clock (untestable expiry races); a bare
+    ``sqlite3.connect`` bypasses the WAL/busy-timeout recipe (also
+    STL010, flagged here too so the control-plane sweep is
+    self-contained).
+    """
+
+    id = 'STL011'
+    name = 'injectable-clock'
+    severity = 'error'
+    help = ('time.time() or sqlite3.connect() inside jobs/, serve/ '
+            'or fleet/: use statedb.wall_now() (injectable clock) '
+            'and statedb.connect so lease expiry, timestamps and '
+            'durability stay testable under FakeClock and the WAL '
+            'recipe.')
+    node_types = (ast.Call,)
+    path_filter = ('jobs', 'serve', 'fleet')
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        dotted = core.call_name(node)
+        if dotted == 'time.time':
+            ctx.report(self, node,
+                       'direct time.time() in the control plane: '
+                       'timestamps and expiries here must share the '
+                       'injectable wall clock — call '
+                       'statedb.wall_now() instead',
+                       span=(node.lineno, node.lineno))
+        elif dotted == 'sqlite3.connect':
+            ctx.report(self, node,
+                       'raw sqlite3.connect in the control plane '
+                       'bypasses the statedb recipe; use '
+                       'statedb.connect',
+                       span=(node.lineno, node.lineno))
+
+
 def default_rules() -> List[Rule]:
     """Fresh rule instances (STL007/STL009 keep per-run state)."""
     return [
@@ -815,6 +859,7 @@ def default_rules() -> List[Rule]:
         JaxRecompileHazard(),
         BlockingSignalHandler(),
         RawSqliteOutsideStateDB(),
+        DirectClockInControlPlane(),
     ]
 
 

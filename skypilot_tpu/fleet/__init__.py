@@ -1,0 +1,22 @@
+"""Controller fleet: horizontally scaled jobs/serve control plane.
+
+The layer between the executor and the per-workload controllers
+(docs/control_plane.md): N :class:`~skypilot_tpu.fleet.worker.
+FleetWorker` processes share the managed-jobs and serve tables
+through lease-based ownership (``utils/statedb`` lease table —
+CAS claims, heartbeat renewal, fencing tokens). A dead worker's
+leases expire to survivors, whose controllers start with the same
+reconcile-on-start adoption path a crashed single controller uses
+(docs/crash_recovery.md).
+
+``fleet.scale_harness`` drives 1k+ jobs / 100+ services through
+launch→preempt→recover→terminate against the synthetic cloud
+(``fleet.synth_cloud`` — metadata only, no real clouds, fault
+injection at registered sites) while killing random workers;
+``bench.py fleet`` reports its throughput and time-to-reconcile
+numbers.
+"""
+from skypilot_tpu.fleet.worker import FleetWorker
+from skypilot_tpu.fleet.worker import WorkerKilled
+
+__all__ = ['FleetWorker', 'WorkerKilled']

@@ -1,0 +1,489 @@
+"""Synthetic-cloud control-plane scale harness.
+
+Drives a configurable fleet — N workers, J managed jobs, S services —
+through launch→preempt→recover→terminate against the synthetic cloud
+(:mod:`skypilot_tpu.fleet.synth_cloud`), killing workers mid-run, and
+reports the numbers PERFORMANCE.md's "Control-plane scale" section
+publishes the way it publishes MFU:
+
+- **jobs/s settled**: terminal managed jobs per wall second;
+- **time-to-reconcile**: wall seconds from a worker kill until every
+  lease it held was claimed by a survivor;
+- **lease churn**: claims / takeovers / renewals / releases, and
+  stale writes rejected by fencing.
+
+Invariants asserted every run (the ``invariants`` block of the
+report; ``bench.py fleet`` fails the round when any is violated):
+
+- zero orphaned synthetic clusters at quiesce (every job terminated
+  its cluster, every service tore its replicas down);
+- zero double-owned leases: per resource, claim fencing tokens are
+  strictly increasing across the whole run (two workers can never
+  both believe they own a resource at the same token);
+- fencing enforced: a killed worker's stale lease handle is used for
+  a deliberate guarded write after the takeover, which MUST raise
+  LeaseLostError;
+- the intent journals are empty (no half-done operation survived).
+
+The harness assumes isolated state DBs (SKYTPU_JOBS_DB /
+SKYTPU_SERVE_DB pointed at a fresh directory): ``bench.py fleet``
+and the tests both arrange that.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import random
+from typing import Dict, List, Optional
+
+from skypilot_tpu.fleet import synth_cloud
+from skypilot_tpu.fleet import worker as worker_lib
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import statedb
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class FleetPlan:
+    """One harness run. Defaults are smoke-sized; ``bench.py fleet``
+    scales them to 1000 jobs / 100 services / 4 workers."""
+    jobs: int = 24
+    services: int = 3
+    replicas_per_service: int = 2
+    workers: int = 3
+    worker_concurrency: Optional[int] = None  # None = derived
+    kill_workers: int = 1
+    kill_after_settled_jobs: int = 3
+    # Fallback trigger only: kill no later than this even if the
+    # settled-jobs threshold was never observed (a burst of jobs
+    # settling between polls must not skip the kill entirely). Kept
+    # well above typical time-to-threshold so the progress trigger
+    # stays primary.
+    kill_after_s: float = 10.0
+    # Renewal sweeps run at TTL/3 but serialize one UPDATE per held
+    # lease behind the WAL write lock; at 100+ concurrently held
+    # leases a 1 s TTL leaves no slack for commit latency and causes
+    # spurious expirations under load.
+    lease_ttl_s: float = 3.0
+    scan_gap_s: float = 0.1
+    job_check_gap_s: float = 0.05
+    service_loop_gap_s: float = 0.25
+    job_run_s: float = 0.15
+    replica_ready_s: float = 0.1
+    preempt_jobs: int = 2
+    preempt_replicas: int = 1
+    preempt_gap_s: float = 0.5
+    seed: int = 0
+    deadline_s: float = 120.0
+    debug: bool = False            # per-poll progress logging
+
+
+@dataclasses.dataclass
+class _KillRecord:
+    worker: str
+    owner: str
+    t_kill: float
+    pending: Dict[str, tuple]      # resource -> (kind, ident, Lease)
+    reclaimed_at: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    stale_write_rejected: Optional[bool] = None
+
+
+def _concurrency(plan: FleetPlan) -> int:
+    if plan.worker_concurrency is not None:
+        return plan.worker_concurrency
+    # Services hold their lease until teardown, so every worker needs
+    # enough slots for its share of services PLUS a job-burst quota —
+    # sized for the SURVIVORS (workers minus planned kills): a fleet
+    # without takeover headroom cannot adopt a dead peer's leases
+    # until its own work drains, and time-to-reconcile becomes a
+    # capacity number instead of a protocol number.
+    survivors = max(1, plan.workers - plan.kill_workers)
+    service_share = math.ceil(max(1, plan.services) / survivors)
+    return service_share + 8
+
+
+def _seed_jobs(plan: FleetPlan) -> List[int]:
+    job_ids = []
+    for i in range(plan.jobs):
+        config = {
+            'name': f'fleet-job-{i}',
+            'run': 'true',
+            'resources': {
+                'cloud': 'local',
+                'job_recovery': {'strategy': 'SYNTH'},
+            },
+        }
+        job_ids.append(
+            jobs_state.add_job(name=f'fleet-job-{i}', task_yaml='',
+                               cluster_name=f'fleet-job-{i}',
+                               log_path='',
+                               dag_json=json.dumps([config])))
+    return job_ids
+
+
+def _seed_services(plan: FleetPlan) -> List[str]:
+    names = []
+    for i in range(plan.services):
+        name = f'fleet-svc-{i}'
+        spec = {
+            'readiness_probe': {
+                'path': '/health',
+                'initial_delay_seconds': 300,
+            },
+            'replica_policy': {
+                'min_replicas': plan.replicas_per_service,
+                'max_replicas': plan.replicas_per_service,
+            },
+            'replica_port': 9000,
+        }
+        task = {
+            'name': name,
+            'run': 'true',
+            'resources': {'cloud': 'local'},
+        }
+        serve_state.add_service(name, spec_json=json.dumps(spec),
+                                task_json=json.dumps(task), lb_port=0)
+        names.append(name)
+    return names
+
+
+def run_fleet_harness(plan: FleetPlan) -> dict:
+    """Run one full fleet scenario; returns the report dict."""
+    clock = retry_lib.REAL_CLOCK
+    rng = random.Random(plan.seed)
+    cloud = synth_cloud.SyntheticCloud(
+        job_run_s=plan.job_run_s,
+        replica_ready_s=plan.replica_ready_s)
+    previous_cloud = synth_cloud.install(cloud)
+    # Launch slots must cover the fleet's concurrency, or slot-wait
+    # polling (0.5s quanta) dominates the measurement.
+    overrides = {
+        env_registry.SKYTPU_JOBS_LAUNCH_PARALLELISM: str(
+            max(16, plan.workers * _concurrency(plan))),
+        # Injected transient launch faults must retry on a
+        # harness-speed schedule, not the production 30s gap.
+        env_registry.SKYTPU_JOBS_LAUNCH_RETRY_GAP: '0.2',
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        return _run(plan, cloud, clock, rng)
+    finally:
+        synth_cloud.install(previous_cloud)
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def _run(plan: FleetPlan, cloud: synth_cloud.SyntheticCloud,
+         clock: retry_lib.Clock, rng: random.Random) -> dict:
+    events: List[statedb.LeaseEvent] = []
+    import threading
+    events_lock = threading.Lock()
+
+    def on_event(event: statedb.LeaseEvent) -> None:
+        with events_lock:
+            events.append(event)
+
+    _seed_jobs(plan)
+    service_names = _seed_services(plan)
+
+    workers: List[worker_lib.FleetWorker] = []
+    for i in range(plan.workers):
+        workers.append(worker_lib.FleetWorker(
+            f'w{i}',
+            lease_ttl=plan.lease_ttl_s,
+            scan_gap=plan.scan_gap_s,
+            concurrency=_concurrency(plan),
+            job_check_gap=plan.job_check_gap_s,
+            service_loop_gap=plan.service_loop_gap_s,
+            job_controller_factory=synth_cloud.job_controller_factory(
+                plan.job_check_gap_s),
+            service_manager_factory=synth_cloud.service_manager_factory(),
+            lease_event_hook=on_event))
+    # Default (wall) clock: these tables read rows the workers write
+    # with wall-time expiries.
+    jobs_leases = statedb.LeaseTable(jobs_state.db())
+    serve_leases = statedb.LeaseTable(serve_state.db())
+
+    t0 = clock.now()
+    for w in workers:
+        w.start()
+
+    kills: List[_KillRecord] = []
+    preempted = {'jobs': 0, 'replicas': 0}
+    last_preempt = t0
+    deadline = t0 + plan.deadline_s
+    timed_out = False
+
+    while True:
+        clock.sleep(0.1)
+        now = clock.now()
+        # ONE full-table scan per tick, reused by the settle count
+        # and every kill's takeover tracking below.
+        statuses_now = jobs_state.job_statuses()
+        n_settled = sum(1 for s in statuses_now.values()
+                        if s.is_terminal())
+        service_status = serve_state.service_statuses()
+        remaining_services = list(service_status)
+        if plan.debug:
+            logger.info(
+                '[harness] t=%.2fs settled=%d/%d services_left=%d '
+                'held=%s kills=%d', now - t0, n_settled, plan.jobs,
+                len(remaining_services),
+                [len(w.held()) for w in workers], len(kills))
+
+        # Teardown trigger: a service that reached READY has proven
+        # the scale-up path; mark it SHUTTING_DOWN so its worker
+        # drives the scale-down path too (launch -> READY -> gone).
+        for name, status in service_status.items():
+            if status is ServiceStatus.READY:
+                serve_state.set_service_status(
+                    name, ServiceStatus.SHUTTING_DOWN)
+
+        # Seeded preemption schedule: reclaim random live clusters so
+        # recovery (jobs) and replica replacement (serve) run for real.
+        if now - last_preempt >= plan.preempt_gap_s:
+            last_preempt = now
+            targets = []
+            if preempted['jobs'] < plan.preempt_jobs:
+                targets.append('jobs')
+            if preempted['replicas'] < plan.preempt_replicas:
+                targets.append('replicas')
+            if targets:
+                target = rng.choice(targets)
+                if target == 'jobs':
+                    live = cloud.live_clusters('fleet-job-')
+                else:
+                    live = [c for c in cloud.live_clusters('fleet-svc-')
+                            if '-replica-' in c]
+                if live and cloud.preempt(rng.choice(live)):
+                    preempted[target] += 1
+
+        # Worker-kill schedule: kill a lease-holding worker once the
+        # fleet has proven progress, then measure takeover latency.
+        kill_due = (
+            n_settled >= plan.kill_after_settled_jobs * (
+                len(kills) + 1) or
+            now - t0 >= plan.kill_after_s * (len(kills) + 1))
+        if len(kills) < plan.kill_workers and kill_due:
+            candidates = [w for w in workers if w.alive() and w.held()]
+            if candidates:
+                victim = rng.choice(candidates)
+                held = victim.held()
+                victim.kill()
+                kills.append(_KillRecord(victim.name, victim.owner,
+                                         clock.now(), dict(held)))
+                logger.warning('[harness] killed %s holding %d leases.',
+                               victim.name, len(held))
+
+        # Takeover tracking + the fencing probe: once a resource has
+        # been reclaimed, a guarded write with the victim's STALE
+        # lease handle must be rejected.
+        for kill in kills:
+            for resource, (kind, ident, lease) in list(
+                    kill.pending.items()):
+                if resource in kill.reclaimed_at:
+                    continue
+                table = jobs_leases if kind == 'job' else serve_leases
+                row = table.get(resource)
+                owner = row['owner'] if row else None
+                job_done = (kind == 'job' and
+                            statuses_now.get(ident) is not None and
+                            statuses_now[ident].is_terminal())
+                service_done = (kind == 'service' and
+                                ident not in remaining_services)
+                taken_over = owner is not None and owner != kill.owner
+                # The victim's handle is provably stale once the row
+                # moved past it: a successor owns it, OR it was
+                # claimed over and already released (fence bumped),
+                # OR the victim itself released it pre-kill (owner
+                # NULL). The one case to skip is a lease the victim
+                # still legitimately holds (it settled the work just
+                # before the kill landed and never released — owner
+                # and fence both unchanged): probing THAT would
+                # spuriously "fail" fencing.
+                handle_stale = (
+                    row is None or row['owner'] != kill.owner or
+                    int(row['fence']) != lease.fence)
+                if taken_over or job_done or service_done:
+                    kill.reclaimed_at[resource] = now
+                    if handle_stale and kill.stale_write_rejected \
+                            is None:
+                        db = (jobs_state.db() if kind == 'job'
+                              else serve_state.db())
+                        guard = statedb.FenceGuard(db, lease)
+                        try:
+                            with statedb.guarded(guard):
+                                with db.transaction():
+                                    pass
+                            kill.stale_write_rejected = False
+                        except statedb.LeaseLostError:
+                            kill.stale_write_rejected = True
+        if n_settled >= plan.jobs and not remaining_services:
+            break
+        if now > deadline:
+            timed_out = True
+            logger.error('[harness] deadline: %d/%d jobs settled, %d '
+                         'services left.', n_settled, plan.jobs,
+                         len(remaining_services))
+            break
+
+    for w in workers:
+        if w.alive():
+            w.stop()
+    elapsed = clock.now() - t0
+
+    # Fencing probe fallback: if no natural takeover window was
+    # observed for a kill (e.g. the victim's only item settled in the
+    # instant before the kill landed, so its handle stayed
+    # legitimately current), synthesize the successor — force-claim
+    # one of its resources (fence bump) and require the stale handle
+    # to be rejected. The mechanism under test is identical.
+    for kill in kills:
+        if kill.stale_write_rejected is not None or not kill.pending:
+            continue
+        resource, (kind, _ident, lease) = next(iter(
+            kill.pending.items()))
+        db = jobs_state.db() if kind == 'job' else serve_state.db()
+        with db.transaction() as conn:
+            statedb.lease_force_claim(conn, resource,
+                                      'harness-prober',
+                                      statedb.wall_now(), ttl=1.0)
+        guard = statedb.FenceGuard(db, lease)
+        try:
+            with statedb.guarded(guard):
+                with db.transaction():
+                    pass
+            kill.stale_write_rejected = False
+        except statedb.LeaseLostError:
+            kill.stale_write_rejected = True
+
+    return _report(plan, cloud, events, kills, preempted, elapsed,
+                   timed_out)
+
+
+def _audit_events(events: List[statedb.LeaseEvent]) -> dict:
+    """Fence audit + churn accounting from the event log.
+
+    Events are emitted AFTER each commit, so their append order is
+    not the commit order under thread contention — the audit
+    therefore orders each resource's claims by fence (the tokens the
+    DB actually handed out) and asserts the real invariant: fences
+    are UNIQUE per resource (the CAS can never hand the same token
+    out twice). A takeover is a claim whose fence-predecessor was
+    never released (it expired or was usurped).
+    """
+    per_resource: Dict[str, List[statedb.LeaseEvent]] = {}
+    for ev in events:
+        per_resource.setdefault(ev[1], []).append(ev)
+    claims = takeovers = renewals = releases = violations = 0
+    for resource, evs in per_resource.items():
+        claim_fences = sorted(e[3] for e in evs if e[0] == 'claim')
+        released_fences = {e[3] for e in evs if e[0] == 'release'}
+        claims += len(claim_fences)
+        renewals += sum(1 for e in evs if e[0] == 'renew')
+        releases += len(released_fences)
+        dupes = len(claim_fences) - len(set(claim_fences))
+        if dupes:
+            violations += dupes
+            logger.error(
+                '[harness] fence violation on %s: duplicate claim '
+                'fences in %s.', resource, claim_fences)
+        for prev, cur in zip(claim_fences, claim_fences[1:]):
+            if prev not in released_fences and cur != prev:
+                takeovers += 1  # predecessor expired/usurped
+    return {
+        'claims': claims,
+        'takeovers': takeovers,
+        'renewals': renewals,
+        'releases': releases,
+        'fence_violations': violations,
+    }
+
+
+def _report(plan: FleetPlan, cloud: synth_cloud.SyntheticCloud,
+            events: List[statedb.LeaseEvent],
+            kills: List[_KillRecord], preempted: dict,
+            elapsed: float, timed_out: bool) -> dict:
+    fence_probe_failures = sum(
+        1 for k in kills if k.stale_write_rejected is False)
+    statuses = jobs_state.job_statuses()
+    n_settled = sum(1 for s in statuses.values() if s.is_terminal())
+    by_status: Dict[str, int] = {}
+    for s in statuses.values():
+        by_status[s.value] = by_status.get(s.value, 0) + 1
+    services_left = serve_state.service_names()
+    orphans = cloud.live_clusters()
+    open_intents = (len(jobs_state.open_intents()) +
+                    len(serve_state.open_intents()))
+    lease_audit = _audit_events(events)
+    recoveries = jobs_state.sum_recoveries()
+
+    kill_reports = []
+    for kill in kills:
+        reclaim_times = [t - kill.t_kill
+                         for t in kill.reclaimed_at.values()]
+        kill_reports.append({
+            'worker': kill.worker,
+            'leases_held': len(kill.pending),
+            'leases_reclaimed': len(kill.reclaimed_at),
+            'time_to_reconcile_s': (round(max(reclaim_times), 3)
+                                    if reclaim_times else None),
+            'mean_reclaim_s': (round(sum(reclaim_times) /
+                                     len(reclaim_times), 3)
+                               if reclaim_times else None),
+            'stale_write_rejected': kill.stale_write_rejected,
+        })
+
+    invariants = {
+        'orphan_clusters': orphans,
+        'fence_violations': lease_audit['fence_violations'],
+        'fence_probe_failures': fence_probe_failures,
+        'open_intents': open_intents,
+        'unreclaimed_leases': sum(
+            len(k.pending) - len(k.reclaimed_at) for k in kills),
+    }
+    ok = (not timed_out and n_settled >= plan.jobs and
+          not services_left and not orphans and
+          lease_audit['fence_violations'] == 0 and
+          fence_probe_failures == 0 and open_intents == 0 and
+          invariants['unreclaimed_leases'] == 0)
+    return {
+        'ok': ok,
+        'timed_out': timed_out,
+        'elapsed_s': round(elapsed, 2),
+        'jobs': {
+            'total': plan.jobs,
+            'settled': n_settled,
+            'by_status': by_status,
+            'per_s': round(n_settled / elapsed, 2) if elapsed else 0.0,
+            'recoveries': recoveries,
+        },
+        'services': {
+            'total': plan.services,
+            'settled': plan.services - len(services_left),
+            'replicas_per_service': plan.replicas_per_service,
+        },
+        'workers': plan.workers,
+        'kills': kill_reports,
+        'preemptions': preempted,
+        'lease': lease_audit,
+        'cloud': {
+            'launches': cloud.launches,
+            'terminations': cloud.terminations,
+            'preemptions': cloud.preemptions,
+        },
+        'invariants': invariants,
+    }

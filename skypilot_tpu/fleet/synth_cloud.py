@@ -1,0 +1,380 @@
+"""Synthetic cloud for control-plane scale testing.
+
+A metadata-only cloud (no processes, no SSH, no real provisioning):
+clusters are dict entries behind a lock, on-cluster jobs advance from
+RUNNING to SUCCEEDED on the injectable clock, preemption deletes the
+cluster record. This is what lets ``bench.py fleet`` drive 1k+
+managed jobs and 100+ services through launch→preempt→recover→
+terminate in seconds while exercising the REAL controllers — the
+existing :class:`~skypilot_tpu.jobs.controller.JobsController` run
+loop, intent journaling, reconcile-on-start, scheduler slots and
+recovery strategies all run unmodified; only the cloud-truth seams
+(:meth:`JobsController._cluster_status` and friends) are overridden.
+
+Fault injection composes: ``fleet.synth.launch`` is a registered
+site (provision_failure => transient launch error the strategy
+retries; stockout/quota => ResourcesUnavailableError), and the
+``jobs.controller.heartbeat`` site's preemption kinds are acted out
+against this cloud exactly like the real provider path.
+
+Every mutating op calls :func:`statedb.validate_guards` first, so a
+fleet worker that lost its lease (or was killed) cannot launch or
+terminate synthetic clusters over its successor — the same fencing
+invariant the statedb writes get from :class:`statedb.FenceGuard`.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import controller as jobs_controller
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import statedb
+from skypilot_tpu.utils import status_lib
+
+logger = sky_logging.init_logger(__name__)
+
+REGIONS = ('synth-a', 'synth-b', 'synth-c')
+
+
+class SyntheticCloud:
+    """In-memory cluster + on-cluster-job truth, one per process."""
+
+    def __init__(self, *, clock: Optional[retry_lib.Clock] = None,
+                 job_run_s: float = 0.2,
+                 replica_ready_s: float = 0.1) -> None:
+        self.clock = clock or retry_lib.WALL_CLOCK
+        self.job_run_s = job_run_s
+        self.replica_ready_s = replica_ready_s
+        self._lock = threading.Lock()
+        # cluster name -> {region, launched_at, jobs: {id: submitted_at}}
+        self._clusters: Dict[str, dict] = {}
+        self._next_job_id = 0
+        self.launches = 0
+        self.terminations = 0
+        self.preemptions = 0
+
+    # ------------------------------------------------------- mutations
+    def launch(self, cluster: str,
+               blocked_regions: Optional[set] = None,
+               with_job: bool = True) -> Optional[int]:
+        """Provision a cluster (idempotently replacing a dead record)
+        and optionally submit one on-cluster job; returns its id."""
+        statedb.validate_guards()
+        fault = fault_injection.poll('fleet.synth.launch',
+                                     cluster_name=cluster)
+        if fault is not None:
+            kinds = fault_injection.FaultKind
+            if fault.kind in (kinds.STOCKOUT, kinds.QUOTA_EXCEEDED):
+                raise exceptions.ResourcesUnavailableError(
+                    f'[synthetic] no capacity for {cluster} '
+                    f'({fault.kind.value})')
+            raise exceptions.ProvisionError(
+                f'[synthetic] transient {fault.kind.value} launching '
+                f'{cluster}')
+        blocked = blocked_regions or set()
+        region = next((r for r in REGIONS if r not in blocked),
+                      REGIONS[0])
+        with self._lock:
+            self.launches += 1
+            record = {
+                'region': region,
+                'launched_at': self.clock.now(),
+                'jobs': {},
+            }
+            self._clusters[cluster] = record
+            if not with_job:
+                return None
+            self._next_job_id += 1
+            job_id = self._next_job_id
+            record['jobs'][job_id] = self.clock.now()
+            return job_id
+
+    def terminate(self, cluster: str) -> None:
+        statedb.validate_guards()
+        with self._lock:
+            if cluster in self._clusters:
+                self._clusters.pop(cluster)
+                self.terminations += 1
+
+    def preempt(self, cluster: str) -> bool:
+        """Reclaim a cluster (the record vanishes — controllers see a
+        missing cluster + missing job, the preemption signature)."""
+        with self._lock:
+            if cluster not in self._clusters:
+                return False
+            self._clusters.pop(cluster)
+            self.preemptions += 1
+            return True
+
+    # --------------------------------------------------------- queries
+    def cluster_status(
+            self, cluster: str) -> Optional[status_lib.ClusterStatus]:
+        with self._lock:
+            if cluster not in self._clusters:
+                return None
+            return status_lib.ClusterStatus.UP
+
+    def job_status(self, cluster: str, job_id: int
+                   ) -> Optional[status_lib.JobStatus]:
+        with self._lock:
+            record = self._clusters.get(cluster)
+            if record is None or job_id not in record['jobs']:
+                return None
+            age = self.clock.now() - record['jobs'][job_id]
+        return (status_lib.JobStatus.SUCCEEDED
+                if age >= self.job_run_s else
+                status_lib.JobStatus.RUNNING)
+
+    def job_ids(self, cluster: str) -> List[int]:
+        with self._lock:
+            record = self._clusters.get(cluster)
+            return sorted(record['jobs']) if record else []
+
+    def replica_ready(self, cluster: str) -> bool:
+        with self._lock:
+            record = self._clusters.get(cluster)
+            if record is None:
+                return False
+            age = self.clock.now() - record['launched_at']
+        return age >= self.replica_ready_s
+
+    def region_of(self, cluster: str) -> Optional[str]:
+        with self._lock:
+            record = self._clusters.get(cluster)
+            return record['region'] if record else None
+
+    def live_clusters(self, prefix: str = '') -> List[str]:
+        with self._lock:
+            return sorted(c for c in self._clusters
+                          if c.startswith(prefix))
+
+
+# Process singleton the SYNTH strategy and the synthetic controllers
+# resolve at call time (the harness installs a fresh cloud per run).
+_CLOUD: Optional[SyntheticCloud] = None
+
+
+def install(cloud: Optional[SyntheticCloud]) -> Optional[SyntheticCloud]:
+    """Install the process's synthetic cloud; returns the previous."""
+    global _CLOUD
+    previous = _CLOUD
+    _CLOUD = cloud
+    return previous
+
+
+def get() -> SyntheticCloud:
+    assert _CLOUD is not None, (
+        'no SyntheticCloud installed — call synth_cloud.install() '
+        'before running SYNTH-strategy jobs')
+    return _CLOUD
+
+
+@recovery_strategy.RECOVERY_STRATEGY_REGISTRY.register(name='SYNTH')
+class SynthStrategy(recovery_strategy.StrategyExecutor):
+    """Launch/recover against the synthetic cloud.
+
+    Selected per task via ``resources.job_recovery.strategy: SYNTH``,
+    so the REAL JobsController drives it through the normal registry
+    — no monkeypatching. Inherits the stock ``launch()`` retry loop
+    (transient fleet.synth.launch faults are retried on the shared
+    RetryPolicy; ResourcesUnavailableError and LeaseLostError stay
+    permanent).
+    """
+
+    def _do_launch(self, *, blocked_regions=None) -> Optional[int]:
+        cloud = get()
+        job_id = cloud.launch(self.cluster_name,
+                              blocked_regions=set(blocked_regions or ()))
+        self.last_region = cloud.region_of(self.cluster_name)
+        return job_id
+
+    def terminate_cluster(self) -> None:
+        get().terminate(self.cluster_name)
+
+    def recover(self) -> Optional[int]:
+        # EAGER_NEXT_REGION shape on the synthetic cloud: skip the
+        # preempted region first, fall back to anywhere.
+        self.terminate_cluster()
+        blocked = {self.last_region} if self.last_region else None
+        try:
+            return self._do_launch(blocked_regions=blocked)
+        except exceptions.ResourcesUnavailableError:
+            return self._do_launch()
+
+
+class SyntheticJobsController(jobs_controller.JobsController):
+    """The real controller with its cloud-truth seams pointed at the
+    synthetic cloud. Everything else — run loop, monitor FSM, intent
+    journaling, reconcile-on-start, scheduler slots — is inherited
+    unchanged, which is the point: the scale harness measures the
+    REAL control plane."""
+
+    def _cluster_status(self):
+        return get().cluster_status(self.cluster_name)
+
+    def _job_status(self, cluster_job_id: int):
+        return get().job_status(self.cluster_name, cluster_job_id)
+
+    def _find_cluster_job(self, cluster_name: str,
+                          expect: Optional[int] = None) -> Optional[int]:
+        cloud = get()
+        if cloud.cluster_status(cluster_name) is not \
+                status_lib.ClusterStatus.UP:
+            return None
+        job_ids = cloud.job_ids(cluster_name)
+        if expect is not None:
+            return expect if expect in job_ids else None
+        return max(job_ids) if job_ids else None
+
+    def _down_quiet(self, cluster_name: str) -> None:
+        get().terminate(cluster_name)
+
+    def _maybe_inject_chaos(self) -> None:
+        plan = fault_injection.active_plan()
+        kinds = fault_injection.FaultKind
+        actionable = (kinds.PREEMPTION, kinds.PARTIAL_GANG_LOSS)
+        if plan is None or not plan.pending('jobs.controller.heartbeat',
+                                            actionable):
+            return
+        fault = fault_injection.poll('jobs.controller.heartbeat',
+                                     kinds=actionable,
+                                     cluster_name=self.cluster_name)
+        if fault is None:
+            return
+        logger.warning('[fault-injection] acting %s on synthetic '
+                       'cluster %s.', fault.kind.value,
+                       self.cluster_name)
+        get().preempt(self.cluster_name)
+
+
+class SynthReplicaManager(ReplicaManager):
+    """ReplicaManager with synthetic cloud seams AND inline (same
+    thread) launch/teardown: the real manager backgrounds cloud work
+    on daemon threads, but a fleet worker's fence guard is a
+    contextvar — work must stay on the guarded thread so a stale
+    worker's replica launches are fenced too."""
+
+    def scale_up(self, n: int = 1, version: Optional[int] = None,
+                 is_spot: Optional[bool] = None) -> None:
+        if version is None:
+            version = serve_state.get_current_version(self.service_name)
+        for _ in range(n):
+            replica_id = serve_state.next_replica_id(self.service_name)
+            cluster = self._cluster_name(replica_id)
+            intent_id = serve_state.add_replica(
+                self.service_name, replica_id, cluster, version=version,
+                is_spot=bool(is_spot),
+                intent_payload={
+                    'service': self.service_name,
+                    'replica_id': replica_id,
+                    'cluster_name': cluster,
+                })
+            self._launch_replica(replica_id, cluster, version, is_spot,
+                                 intent_id)
+
+    def _launch_replica(self, replica_id: int, cluster: str,
+                        version: int, is_spot: Optional[bool],
+                        intent_id: Optional[int] = None) -> None:
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.PROVISIONING)
+        try:
+            get().launch(cluster, with_job=False)
+        except Exception:  # pylint: disable=broad-except
+            serve_state.set_replica_status(
+                self.service_name, replica_id,
+                ReplicaStatus.FAILED_PROVISION,
+                complete_intent=intent_id)
+            return
+        fault_injection.crashpoint('serve.scale_up.post_launch',
+                                   service=self.service_name,
+                                   replica_id=replica_id)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.STARTING,
+                                       complete_intent=intent_id)
+
+    def scale_down(self, replica_ids) -> None:
+        for replica_id in replica_ids:
+            intent_id = serve_state.mark_shutting_down(
+                self.service_name, replica_id, {
+                    'service': self.service_name,
+                    'replica_id': replica_id,
+                    'cluster_name': self._cluster_name(replica_id),
+                })
+            fault_injection.crashpoint(
+                'serve.scale_down.pre_terminate',
+                service=self.service_name, replica_id=replica_id)
+            self._terminate_replica(replica_id,
+                                    complete_intent=intent_id)
+
+    def _terminate_in_background(self, replica_id: int,
+                                 final_status=ReplicaStatus.SHUTDOWN,
+                                 remove: bool = False,
+                                 complete_intent: Optional[int] = None
+                                 ) -> None:
+        # Inline: keep the work under the calling thread's fence guard.
+        self._terminate_replica(replica_id, final_status, remove,
+                                complete_intent=complete_intent)
+
+    def terminate_all(self) -> None:
+        for r in serve_state.get_replicas(self.service_name):
+            if r['status'] is not ReplicaStatus.SHUTDOWN:
+                self._terminate_replica(r['replica_id'])
+
+    def _down_cluster(self, cluster: str) -> None:
+        get().terminate(cluster)
+
+    def _list_cluster_names(self) -> List[str]:
+        return get().live_clusters(f'{self.service_name}-replica-')
+
+    def _cluster_is_up(self, cluster: Optional[str]) -> bool:
+        if not cluster:
+            return False
+        return (get().cluster_status(cluster) is
+                status_lib.ClusterStatus.UP)
+
+    def _replica_url(self, replica_id: int, cluster: str,
+                     spec=None) -> Optional[str]:
+        if not self._cluster_is_up(cluster):
+            return None
+        return f'synth://{cluster}'
+
+    def _probe_ready(self, url: str, spec,
+                     replica_id: Optional[int] = None) -> str:
+        fault = fault_injection.poll('serve.replica.probe_ready',
+                                     replica_id=replica_id, url=url)
+        if fault is not None:
+            return 'down'
+        cluster = url[len('synth://'):]
+        return 'ready' if get().replica_ready(cluster) else 'down'
+
+    def _drain_replica(self, url: str) -> None:
+        pass  # synthetic replicas have no process to drain
+
+
+def job_controller_factory(check_gap: float = 0.5):
+    """Factory of factories: FleetWorker-compatible job controller
+    builder bound to the synthetic cloud."""
+    def make(job_id: int) -> SyntheticJobsController:
+        return SyntheticJobsController(job_id, check_gap=check_gap)
+    return make
+
+
+def service_manager_factory():
+    """FleetWorker-compatible (manager, spec) builder bound to the
+    synthetic cloud."""
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+
+    def make(name: str):
+        record = serve_state.get_service(name)
+        assert record is not None, name
+        spec = ServiceSpec.from_yaml_config(record['spec'])
+        return SynthReplicaManager(name, spec, record['task']), spec
+    return make

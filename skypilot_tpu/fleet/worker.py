@@ -1,0 +1,593 @@
+"""Fleet worker: one process's share of the control plane.
+
+A :class:`FleetWorker` scans the managed-jobs and serve tables for
+work whose controller lease (``utils/statedb`` lease table) is
+unowned or expired, CAS-claims it, and runs the EXISTING controller
+code under the lease:
+
+- a claimed job lease runs :class:`~skypilot_tpu.jobs.controller.
+  JobsController`'s ``run()`` — launch, monitor, recover, terminate,
+  intent journaling, reconcile-on-start adoption, all unchanged;
+- a claimed service lease runs the serve controller's reconcile loop
+  (``reconcile_on_start``, then probe → reconcile passes on a
+  :class:`~skypilot_tpu.serve.replica_managers.ReplicaManager`).
+
+A heartbeat thread renews every held lease at TTL/3 (renewal
+mid-operation is what lets one lease cover an arbitrarily long
+launch). Losing a renewal revokes the item's
+:class:`~skypilot_tpu.utils.statedb.FenceGuard`; independently, the
+guard re-checks the fencing token INSIDE every statedb transaction,
+so a worker that lost its lease abandons at its next write with zero
+mutations applied — a stale owner can never clobber a successor
+(docs/control_plane.md).
+
+``kill()`` simulates process death for the scale harness: the worker
+stops renewing and every subsequent operation raises — no releases,
+no cleanup — so its leases expire to surviving workers exactly as a
+``kill -9`` would leave them.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.jobs import controller as jobs_controller
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import statedb
+
+logger = sky_logging.init_logger(__name__)
+
+_M_WORKERS = metrics_lib.gauge(
+    'skytpu_fleet_workers',
+    'Fleet workers alive in this process.')
+_M_HELD = metrics_lib.gauge(
+    'skytpu_fleet_held_leases',
+    'Leases currently held, per fleet worker.',
+    labels=('worker',))
+_M_SETTLED = metrics_lib.counter(
+    'skytpu_fleet_settled_total',
+    'Work items driven to their terminal state by fleet workers, by '
+    'kind (job / service).',
+    labels=('kind',))
+_M_ABANDONS = metrics_lib.counter(
+    'skytpu_fleet_abandons_total',
+    'Work items abandoned mid-operation, by reason (lease_lost / '
+    'killed / error).',
+    labels=('reason',))
+
+_WORKER_COUNT = 0
+_WORKER_COUNT_LOCK = threading.Lock()
+
+
+def _bump_workers(delta: int) -> None:
+    global _WORKER_COUNT
+    with _WORKER_COUNT_LOCK:
+        _WORKER_COUNT = max(0, _WORKER_COUNT + delta)
+        _M_WORKERS.set(_WORKER_COUNT)
+
+
+class WorkerKilled(Exception):
+    """Raised by a killed worker's own operations: the simulation of
+    process death — every op after kill() fails, nothing cleans up."""
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class _Held:
+    kind: str              # 'job' | 'service'
+    ident: object          # job_id | service name
+    lease: statedb.Lease
+    guard: statedb.FenceGuard
+    table: statedb.LeaseTable
+
+
+class FleetWorker:
+    """One lease-claiming control-plane worker (N per fleet)."""
+
+    def __init__(self, name: str, *,
+                 lease_ttl: Optional[float] = None,
+                 scan_gap: Optional[float] = None,
+                 concurrency: Optional[int] = None,
+                 job_check_gap: float = 0.5,
+                 service_loop_gap: float = 0.5,
+                 clock: Optional[retry_lib.Clock] = None,
+                 job_controller_factory: Optional[
+                     Callable[[int], 'jobs_controller.JobsController']
+                 ] = None,
+                 service_manager_factory: Optional[
+                     Callable[[str], Tuple[ReplicaManager,
+                                           ServiceSpec]]] = None,
+                 jobs_enabled: bool = True,
+                 serve_enabled: bool = True,
+                 lease_event_hook: Optional[Callable] = None) -> None:
+        self.name = name
+        self.owner = f'worker:{name}:{os.getpid()}'
+        self.lease_ttl = (lease_ttl if lease_ttl is not None else
+                          _env_float(env_registry.SKYTPU_FLEET_LEASE_TTL,
+                                     10.0))
+        self.scan_gap = (scan_gap if scan_gap is not None else
+                         _env_float(env_registry.SKYTPU_FLEET_SCAN_GAP,
+                                    1.0))
+        self.concurrency = (concurrency if concurrency is not None else
+                            int(_env_float(
+                                env_registry.SKYTPU_FLEET_CONCURRENCY,
+                                8)))
+        self.job_check_gap = job_check_gap
+        self.service_loop_gap = service_loop_gap
+        # The statedb wall clock, not monotonic: lease expiries land
+        # in a table shared with wall-time writers
+        # (set_controller_pid, try_claim_controller_restart) and with
+        # other PROCESSES — monotonic timestamps are process-local
+        # and would make a live lease look decades expired (or vice
+        # versa). Going through statedb.wall_clock() keeps a
+        # set_wall_clock() test injection in force here too.
+        self.clock = clock or statedb.wall_clock()
+        self.job_controller_factory = (job_controller_factory or
+                                       self._default_job_controller)
+        self.service_manager_factory = (service_manager_factory or
+                                        self._default_service_manager)
+        self.jobs_enabled = jobs_enabled
+        self.serve_enabled = serve_enabled
+        self._jobs_leases = statedb.LeaseTable(
+            jobs_state.db(), clock=self.clock,
+            on_event=lease_event_hook)
+        self._serve_leases = statedb.LeaseTable(
+            serve_state.db(), clock=self.clock,
+            on_event=lease_event_hook)
+        self._lock = threading.Lock()
+        self._active: Dict[str, _Held] = {}
+        self._registered: set = set()
+        self._threads: List[threading.Thread] = []
+        self._killed = False
+        self._stopping = False
+        self._scan_thread: Optional[threading.Thread] = None
+        self._renew_thread: Optional[threading.Thread] = None
+        # Local tallies for the harness report (metrics are
+        # process-global; the harness runs several workers at once).
+        self.settled = {'job': 0, 'service': 0}
+        self.abandons = {'lease_lost': 0, 'killed': 0, 'error': 0}
+
+    # ------------------------------------------------ default factories
+    def _default_job_controller(self, job_id: int):
+        return jobs_controller.JobsController(
+            job_id, check_gap=self.job_check_gap)
+
+    def _default_service_manager(self, name: str):
+        record = serve_state.get_service(name)
+        assert record is not None, name
+        spec = ServiceSpec.from_yaml_config(record['spec'])
+        return ReplicaManager(name, spec, record['task']), spec
+
+    # -------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        _bump_workers(1)
+        with self._lock:
+            self._scan_thread = threading.Thread(
+                target=self._scan_loop, daemon=True,
+                name=f'fleet-scan-{self.name}')
+            self._renew_thread = threading.Thread(
+                target=self._renew_loop, daemon=True,
+                name=f'fleet-renew-{self.name}')
+        self._scan_thread.start()
+        self._renew_thread.start()
+        logger.info('Fleet worker %s up (ttl=%.2fs, scan=%.2fs, '
+                    'concurrency=%d).', self.name, self.lease_ttl,
+                    self.scan_gap, self.concurrency)
+
+    def kill(self) -> None:
+        """Simulate process death: stop renewing, fail every further
+        op, release NOTHING. Held leases expire to surviving workers
+        after at most ``lease_ttl``."""
+        # skytpu-lint: disable=STL004 — GIL-atomic flag flip; kill()
+        # models SIGKILL and must never block on the worker's lock.
+        self._killed = True
+        _bump_workers(-1)
+        logger.warning('Fleet worker %s KILLED (holding %d leases).',
+                       self.name, len(self._active))
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: stop claiming, wait for in-flight items,
+        release whatever is still held."""
+        if self._killed:
+            return
+        # skytpu-lint: disable=STL004 — GIL-atomic flag flip read by
+        # the loops; taking the lock here could deadlock with an item
+        # thread blocked on it.
+        self._stopping = True
+        deadline = self.clock.now() + timeout
+        for t in [self._scan_thread, self._renew_thread]:
+            if t is not None:
+                t.join(max(0.1, deadline - self.clock.now()))
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(max(0.1, deadline - self.clock.now()))
+        with self._lock:
+            leftovers = list(self._active.values())
+        for item in leftovers:
+            item.table.release(item.lease)
+        _bump_workers(-1)
+
+    def alive(self) -> bool:
+        return not self._killed and not self._stopping
+
+    def held(self) -> Dict[str, Tuple[str, object, statedb.Lease]]:
+        """Snapshot of held leases (the harness records this at kill
+        time to measure takeover latency per resource)."""
+        with self._lock:
+            return {res: (i.kind, i.ident, i.lease)
+                    for res, i in self._active.items()}
+
+    def _alive_check(self) -> None:
+        if self._killed:
+            raise WorkerKilled(self.name)
+
+    # ------------------------------------------------------------- scan
+    def _scan_loop(self) -> None:
+        while not self._stopping and not self._killed:
+            try:
+                self._scan_once()
+            except WorkerKilled:
+                return
+            except Exception:  # pylint: disable=broad-except
+                logger.error('Fleet worker %s scan error:\n%s',
+                             self.name, traceback.format_exc())
+            self.clock.sleep(self.scan_gap)
+
+    def _free_slots(self) -> int:
+        with self._lock:
+            return self.concurrency - len(self._active)
+
+    def _scan_once(self) -> None:
+        self._alive_check()
+        if self._free_slots() <= 0:
+            return
+        if self.serve_enabled:
+            # Services first: few and long-lived, so they must never
+            # starve behind a burst of short job claims.
+            resources = {
+                serve_state.controller_resource(n): n
+                for n in serve_state.service_names()
+            }
+            self._claim_batch('service', resources, self._serve_leases,
+                              'serve.controller:',
+                              serve_state.register_controller_leases)
+        if self.jobs_enabled:
+            resources = {
+                jobs_state.controller_resource(j): j
+                for j, s in jobs_state.job_statuses().items()
+                if not s.is_terminal()
+            }
+            self._claim_batch('job', resources, self._jobs_leases,
+                              'jobs.controller:',
+                              jobs_state.register_controller_leases)
+
+    def _claim_batch(self, kind: str, resources: Dict[str, object],
+                     table: statedb.LeaseTable, prefix: str,
+                     register_fn: Callable) -> None:
+        # Registration is liveness-gated IN the state transaction
+        # (register_controller_leases): a register from this (stale)
+        # snapshot must never resurrect a settled item's deleted row,
+        # which would restart its fence sequence.
+        fresh = [resources[r] for r in resources
+                 if r not in self._registered]
+        if fresh:
+            register_fn(fresh)
+            self._registered.update(resources)
+        # Iterate in lease_claimable's order: expired (abandoned by a
+        # dead peer) before never-claimed, oldest expiry first — a
+        # dead worker's in-flight work is adopted before fresh work.
+        for resource in table.claimable(prefix):
+            ident = resources.get(resource)
+            if ident is None:
+                # Not in this scan's snapshot: either the work went
+                # terminal since (dead peer settled it but never
+                # retired the row — delete it so scans stop iterating
+                # it forever), or a peer registered work NEWER than
+                # our snapshot (leave it alone). Re-check liveness
+                # fresh before retiring.
+                self._retire_if_gone(kind, resource, table)
+                continue
+            if self._free_slots() <= 0:
+                return
+            self._alive_check()
+            with self._lock:
+                if resource in self._active:
+                    continue
+            lease = table.try_claim(resource, self.owner,
+                                    self.lease_ttl)
+            if lease is None:
+                continue  # another worker won the CAS
+            self._dispatch(kind, ident, lease, table)
+
+    def _retire_if_gone(self, kind: str, resource: str,
+                        table: statedb.LeaseTable) -> None:
+        ident = resource.split(':', 1)[1]
+        if kind == 'job':
+            try:
+                status = jobs_state.job_status(int(ident))
+            except ValueError:
+                return
+            gone = status is None or status.is_terminal()
+        else:
+            gone = ident not in serve_state.service_names()
+        if not gone:
+            return
+        lease = table.try_claim(resource, self.owner, self.lease_ttl)
+        if lease is not None:
+            table.delete(lease)
+
+    def _dispatch(self, kind: str, ident, lease: statedb.Lease,
+                  table: statedb.LeaseTable) -> None:
+        guard = table.guard(lease, extra_check=self._alive_check)
+        item = _Held(kind, ident, lease, guard, table)
+        with self._lock:
+            self._active[lease.resource] = item
+            self._threads = [t for t in self._threads if t.is_alive()]
+            _M_HELD.set(len(self._active), worker=self.name)
+        with trace_lib.span('fleet.lease.claim', worker=self.name,
+                            resource=lease.resource, fence=lease.fence):
+            pass
+        fault_injection.crashpoint('fleet.worker.claim.post',
+                                   worker=self.name,
+                                   resource=lease.resource)
+        thread = threading.Thread(
+            target=self._run_item, args=(item,), daemon=True,
+            name=f'fleet-{self.name}-{kind}-{ident}')
+        with self._lock:
+            self._threads.append(thread)
+        thread.start()
+
+    # ------------------------------------------------------------ items
+    def _run_item(self, item: _Held) -> None:
+        try:
+            with statedb.guarded(item.guard):
+                if item.kind == 'job':
+                    outcome = self._run_job(item.ident)
+                else:
+                    outcome = self._run_service(item.ident)
+            if outcome in ('settled', 'stale'):
+                # Terminal work is never claimed again: retire the
+                # row so claim scans stay O(active work), not
+                # O(work ever). 'stale' = the work was ALREADY
+                # terminal/removed when we claimed (e.g. a peer died
+                # between settling it and retiring the row) — retire
+                # without counting it as settled by us.
+                item.table.delete(item.lease)
+                if outcome == 'settled':
+                    with self._lock:
+                        self.settled[item.kind] += 1
+                    _M_SETTLED.inc(1, kind=item.kind)
+            else:
+                item.table.release(item.lease)
+        except WorkerKilled:
+            # Simulated process death: NOTHING runs after this — the
+            # lease stays owned until it expires to a survivor.
+            with self._lock:
+                self.abandons['killed'] += 1
+            _M_ABANDONS.inc(1, reason='killed')
+            return
+        except statedb.LeaseLostError as e:
+            with self._lock:
+                self.abandons['lease_lost'] += 1
+            _M_ABANDONS.inc(1, reason='lease_lost')
+            with trace_lib.span('fleet.lease.abandon',
+                                worker=self.name,
+                                resource=item.lease.resource,
+                                fence=item.lease.fence,
+                                reason='lease_lost'):
+                pass
+            logger.warning('Fleet worker %s abandons %s: %s',
+                           self.name, item.lease.resource, e)
+        except Exception:  # pylint: disable=broad-except
+            with self._lock:
+                self.abandons['error'] += 1
+            _M_ABANDONS.inc(1, reason='error')
+            logger.error('Fleet worker %s: %s %s failed:\n%s',
+                         self.name, item.kind, item.ident,
+                         traceback.format_exc())
+            # A controlled failure: free the work for another worker
+            # now instead of waiting out the TTL.
+            item.table.release(item.lease)
+        finally:
+            if not self._killed:
+                with self._lock:
+                    self._active.pop(item.lease.resource, None)
+                    _M_HELD.set(len(self._active), worker=self.name)
+
+    def _run_job(self, job_id: int) -> str:
+        record = jobs_state.get_job(job_id)
+        if record is None or record['status'].is_terminal():
+            return 'stale'
+        if record.get('schedule_state') == scheduler.LAUNCHING:
+            # The dead previous owner leaked a launch slot; release it
+            # so the fleet's launch parallelism is not silently eroded.
+            jobs_state.set_schedule_state(job_id, scheduler.WAITING)
+        controller = self.job_controller_factory(job_id)
+        controller.run()
+        scheduler.job_done(job_id)
+        return 'settled'
+
+    def _run_service(self, name: str) -> str:
+        record = serve_state.get_service(name)
+        if record is None:
+            return 'stale'
+        manager, spec = self.service_manager_factory(name)
+        if statedb.reconcile_enabled():
+            with trace_lib.span('serve.reconcile', slow_ok=True,
+                                service=name, worker=self.name):
+                manager.reconcile_on_start()
+        target = max(int(spec.min_replicas), 0)
+        while True:
+            self._alive_check()
+            if self._stopping:
+                # Graceful stop: hand the (still-live) service back —
+                # the lease is released by _run_item, another worker
+                # picks it up. Not settled.
+                return 'live'
+            record = serve_state.get_service(name)
+            if record is None:
+                return 'settled'  # removed out from under us
+            status = record['status']
+            if status is ServiceStatus.SHUTTING_DOWN:
+                manager.terminate_all()
+                serve_state.remove_service(name)
+                return 'settled'
+            manager.probe_all()
+            manager.reconcile(target)
+            ready = len(manager.ready_urls())
+            # target == 0 (a scaled-to-zero spec) is trivially READY:
+            # REPLICA_INIT forever would wedge teardown triggers.
+            want = (ServiceStatus.READY if ready >= target
+                    else ServiceStatus.REPLICA_INIT)
+            if status is not want:
+                # Conditional write: a teardown request raced in
+                # between our read and now must win, not be clobbered
+                # by this stale read-modify-write.
+                serve_state.set_service_status_unless(
+                    name, want, unless=ServiceStatus.SHUTTING_DOWN)
+            self.clock.sleep(self.service_loop_gap)
+
+    # ------------------------------------------------------------ renew
+    def _renew_loop(self) -> None:
+        gap = max(0.05, self.lease_ttl / 3.0)
+        while not self._stopping and not self._killed:
+            self.clock.sleep(gap)
+            if self._stopping or self._killed:
+                return
+            with self._lock:
+                items = list(self._active.values())
+            # One renewal transaction per lease TABLE per sweep (not
+            # per lease): dozens of per-lease write-lock acquisitions
+            # are what make a sweep outlast the TTL under load.
+            batches: Dict[int, List[_Held]] = {}
+            for item in items:
+                batches.setdefault(id(item.table), []).append(item)
+            for group in batches.values():
+                if self._killed:
+                    return
+                fault_injection.crashpoint(
+                    'fleet.worker.renew.mid', worker=self.name,
+                    resource=group[0].lease.resource,
+                    batch=len(group))
+                results = group[0].table.renew_many(
+                    [i.lease for i in group], self.lease_ttl)
+                for item in group:
+                    renewed = results.get(item.lease.resource)
+                    with trace_lib.span('fleet.lease.renew',
+                                        worker=self.name,
+                                        resource=item.lease.resource,
+                                        fence=item.lease.fence,
+                                        ok=renewed is not None):
+                        pass
+                    if renewed is None:
+                        # A successor claimed over us (or a racing
+                        # path released us): fence the in-flight
+                        # item NOW.
+                        item.guard.revoke()
+                        logger.warning(
+                            'Fleet worker %s lost lease %s (fence '
+                            '%d); revoking its in-flight work.',
+                            self.name, item.lease.resource,
+                            item.lease.fence)
+
+
+# --------------------------------------------------------------- CLI
+
+
+def _all_settled() -> bool:
+    statuses = jobs_state.job_statuses()
+    jobs_done = all(s.is_terminal() for s in statuses.values())
+    return jobs_done and not serve_state.service_names()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description='Run one fleet worker against the jobs/serve DBs.')
+    parser.add_argument('--name', default=f'worker-{os.getpid()}')
+    parser.add_argument('--synth', action='store_true',
+                        help='Drive the synthetic cloud (scale/chaos '
+                        'testing) instead of real clouds.')
+    parser.add_argument('--ttl', type=float, default=None)
+    parser.add_argument('--scan-gap', type=float, default=None)
+    parser.add_argument('--concurrency', type=int, default=None)
+    parser.add_argument('--check-gap', type=float, default=0.5)
+    parser.add_argument('--service-gap', type=float, default=0.5)
+    parser.add_argument('--job-run-s', type=float, default=0.2)
+    parser.add_argument('--replica-ready-s', type=float, default=0.1)
+    parser.add_argument('--run-until-settled', action='store_true')
+    parser.add_argument('--deadline', type=float, default=120.0)
+    parser.add_argument('--report', default=None,
+                        help='Write a JSON report here on exit.')
+    args = parser.parse_args(argv)
+    trace_lib.set_component(f'fleet.{args.name}')
+    job_factory = None
+    service_factory = None
+    if args.synth:
+        from skypilot_tpu.fleet import synth_cloud
+        synth_cloud.install(synth_cloud.SyntheticCloud(
+            job_run_s=args.job_run_s,
+            replica_ready_s=args.replica_ready_s))
+        job_factory = synth_cloud.job_controller_factory(
+            args.check_gap)
+        service_factory = synth_cloud.service_manager_factory()
+    worker = FleetWorker(
+        args.name, lease_ttl=args.ttl, scan_gap=args.scan_gap,
+        concurrency=args.concurrency, job_check_gap=args.check_gap,
+        service_loop_gap=args.service_gap,
+        job_controller_factory=job_factory,
+        service_manager_factory=service_factory)
+    worker.start()
+    clock = retry_lib.REAL_CLOCK
+    deadline = clock.now() + args.deadline
+    rc = 0
+    while True:
+        clock.sleep(0.2)
+        if args.run_until_settled and _all_settled():
+            break
+        if clock.now() > deadline:
+            rc = 2
+            break
+        if not args.run_until_settled and not worker.alive():
+            break
+    worker.stop()
+    report = {
+        'worker': args.name,
+        'settled': worker.settled,
+        'abandons': worker.abandons,
+        'rc': rc,
+    }
+    line = json.dumps(report)
+    print(line)
+    if args.report:
+        with open(args.report, 'w', encoding='utf-8') as f:
+            f.write(line + '\n')
+    return rc
+
+
+if __name__ == '__main__':
+    sys.exit(main())

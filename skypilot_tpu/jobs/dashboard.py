@@ -17,6 +17,7 @@ import time
 from aiohttp import web
 
 from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.utils import statedb
 
 _PAGE = """<!doctype html>
 <html><head><title>skytpu jobs</title>
@@ -65,7 +66,7 @@ async def handle_index(request: web.Request) -> web.Response:
             f'<td>{_fmt_ts(j["submitted_at"])}</td>'
             f'<td>{html.escape(str(j.get("failure_reason") or ""))}'
             '</td></tr>')
-    page = _PAGE.format(now=_fmt_ts(time.time()), n=len(jobs),
+    page = _PAGE.format(now=_fmt_ts(statedb.wall_now()), n=len(jobs),
                         rows='\n'.join(rows))
     return web.Response(text=page, content_type='text/html')
 

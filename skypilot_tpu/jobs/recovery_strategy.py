@@ -17,6 +17,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.utils import log as sky_logging
 from skypilot_tpu.utils import registry
 from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import statedb
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
@@ -48,8 +49,12 @@ def _launch_retry_policy() -> retry_lib.RetryPolicy:
         # backend, so SKYTPU_JOBS_LAUNCH_RETRY_GAP must MEAN a gap —
         # full jitter would allow ~0s relaunches.
         jitter='none',
+        # LeaseLostError is permanent too: a fleet worker whose lease
+        # was claimed over must abandon NOW, not retry the launch
+        # into its successor's work (docs/control_plane.md).
         retryable=lambda e: not isinstance(
-            e, exceptions.ResourcesUnavailableError),
+            e, (exceptions.ResourcesUnavailableError,
+                statedb.LeaseLostError)),
         site='jobs.launch')
 
 
@@ -126,8 +131,11 @@ class StrategyExecutor:
         while True:
             try:
                 return self._do_launch()
-            except exceptions.ResourcesUnavailableError:
-                raise  # permanent: no capacity anywhere
+            except (exceptions.ResourcesUnavailableError,
+                    statedb.LeaseLostError):
+                # Permanent: no capacity anywhere / this worker lost
+                # ownership — either way, retrying cannot help.
+                raise
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning('Launch attempt %d failed: %s',
                                state.attempt + 1, e)

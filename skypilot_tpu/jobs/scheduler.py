@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 import traceback
 from typing import List, Optional
 
@@ -88,17 +87,20 @@ def wait_for_launch_slot(job_id: int,
     """
     state.set_schedule_state(job_id, WAITING)
     limit = launch_parallelism()
-    deadline = None if timeout is None else time.time() + timeout
+    deadline = None if timeout is None else statedb.wall_now() + timeout
     while not state.try_acquire_launch_slot(job_id, limit):
         if state.cancel_requested(job_id):
             state.set_schedule_state(job_id, DONE)
             return False
         _sweep_dead_launchers()
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and statedb.wall_now() > deadline:
             raise TimeoutError(
                 f'Managed job {job_id} waited {timeout}s for a launch '
                 f'slot ({limit} parallel launches).')
-        time.sleep(poll_seconds)
+        # Same injectable clock as the deadline above: under a
+        # FakeClock the sleep advances virtual time, so the timeout
+        # still fires.
+        statedb.wall_clock().sleep(poll_seconds)
     return True
 
 

@@ -16,7 +16,6 @@ from __future__ import annotations
 import json
 import os
 import sqlite3
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import statedb
@@ -77,6 +76,36 @@ def _init(conn: sqlite3.Connection) -> None:
 _DB = statedb.StateDB(_db_path, init_fn=_init, site='jobs.state.write')
 
 
+def db() -> statedb.StateDB:
+    """The jobs StateDB — the fleet layer builds its LeaseTable on it
+    so lease rows and job rows share one sqlite file (fence checks
+    and guarded writes commit in the same transaction)."""
+    return _DB
+
+
+def controller_resource(job_id: int) -> str:
+    """Lease resource name for ownership of one managed job's
+    controller loop (docs/control_plane.md)."""
+    return f'jobs.controller:{job_id}'
+
+
+def register_controller_leases(job_ids: List[int]) -> None:
+    """Create (unowned) controller-lease rows for these jobs — but
+    only while the job is still non-terminal, checked in the SAME
+    transaction. A plain register from a stale scan snapshot could
+    otherwise resurrect a just-deleted settled job's row at fence 0
+    and re-hand already-used fencing tokens."""
+    with _DB.transaction() as conn:
+        for job_id in job_ids:
+            row = conn.execute(
+                'SELECT status FROM jobs WHERE job_id = ?',
+                (job_id,)).fetchone()
+            if row is None or ManagedJobStatus(
+                    row['status']).is_terminal():
+                continue
+            statedb.lease_register(conn, controller_resource(job_id))
+
+
 def add_job(name: Optional[str], task_yaml: str, cluster_name: str,
             log_path: str, dag_json: str) -> int:
     with _DB.transaction() as conn:
@@ -84,8 +113,8 @@ def add_job(name: Optional[str], task_yaml: str, cluster_name: str,
             'INSERT INTO jobs (name, task_yaml, cluster_name, status, '
             'submitted_at, log_path, dag_json) VALUES (?,?,?,?,?,?,?)',
             (name, task_yaml, cluster_name,
-             ManagedJobStatus.PENDING.value, time.time(), log_path,
-             dag_json))
+             ManagedJobStatus.PENDING.value, statedb.wall_now(),
+             log_path, dag_json))
         return cur.lastrowid
 
 
@@ -99,10 +128,10 @@ def set_status(job_id: int, status: ManagedJobStatus,
     args: List[Any] = [status.value]
     if status == ManagedJobStatus.RUNNING:
         sets.append('started_at = COALESCE(started_at, ?)')
-        args.append(time.time())
+        args.append(statedb.wall_now())
     if status.is_terminal():
         sets.append('ended_at = ?')
-        args.append(time.time())
+        args.append(statedb.wall_now())
     if failure_reason is not None:
         sets.append('failure_reason = ?')
         args.append(failure_reason)
@@ -163,9 +192,21 @@ def set_controller_job(job_id: int,
 
 
 def set_controller_pid(job_id: int, pid: int) -> None:
+    """Record the controller process AND take the controller lease in
+    one transaction. The spawned process is by definition the current
+    owner (its spawner held the restart claim), so this is a force
+    claim — it bumps the fencing token over whatever relauncher or
+    dead predecessor held the row. No expiry: a classic one-process
+    controller does not heartbeat; death is observed via pid liveness
+    and usurped through :func:`try_claim_controller_restart`."""
     with _DB.transaction() as conn:
         conn.execute('UPDATE jobs SET controller_pid = ? WHERE job_id = ?',
                      (pid, job_id))
+        lease = statedb.lease_force_claim(conn,
+                                          controller_resource(job_id),
+                                          f'pid:{pid}',
+                                          statedb.wall_now())
+    statedb.record_lease_metric('claim', takeover=lease.takeover)
 
 
 def set_cluster_job_id(job_id: int,
@@ -202,30 +243,62 @@ def set_task_index(job_id: int, task_index: int,
             statedb.complete_intent(conn, complete_intent)
 
 
+# Relauncher claims expire: a relauncher that dies between claiming
+# and spawning must not wedge the job forever — after the TTL the
+# lease is claimable again (the restart budget was still consumed).
+_RELAUNCH_CLAIM_TTL_SECONDS = 120.0
+
+
 def try_claim_controller_restart(job_id: int, dead_pid: Optional[int],
                                  limit: int):
-    """Compare-and-swap claim of one controller relaunch.
+    """Claim one controller relaunch through the generic lease CAS
+    (:func:`statedb.lease_try_claim` with ``expect_owner``).
 
-    One transaction: the claim succeeds only while the row still names
-    the dead pid the caller observed (a changed pid means another
-    relauncher already respawned) and the restart budget has room.
-    Returns ``('claimed', n)``, ``('lost', n)`` (someone else owns the
-    relaunch) or ``('exhausted', n)``.
+    One transaction: the claim succeeds only while the controller
+    lease still names the dead pid the caller observed (a successor —
+    relauncher or respawned controller — bumps the fencing token, so
+    a racer loses even inside the claim→spawn window) and the restart
+    budget has room. Returns ``('claimed', n)``, ``('lost', n)``
+    (someone else owns the relaunch) or ``('exhausted', n)``.
     """
+    observed = f'pid:{dead_pid}'
     with _DB.transaction() as conn:
         row = conn.execute(
             'SELECT controller_pid, controller_restarts FROM jobs '
             'WHERE job_id = ?', (job_id,)).fetchone()
-        if row is None or row['controller_pid'] != dead_pid:
-            return ('lost', int((row or {'controller_restarts': 0})
-                                ['controller_restarts'] or 0))
+        if row is None:
+            return ('lost', 0)
         restarts = int(row['controller_restarts'] or 0)
+        lease_row = statedb.lease_get(conn,
+                                      controller_resource(job_id))
+        if lease_row is None:
+            # Pre-lease DB (the controller never ran under this code):
+            # fall back to the recorded pid, then seed the lease row so
+            # the CAS below owns the race from here on.
+            if row['controller_pid'] != dead_pid:
+                return ('lost', restarts)
+            statedb.lease_register(conn, controller_resource(job_id))
+        elif lease_row['owner'] is not None and \
+                lease_row['owner'] != observed:
+            expires = lease_row.get('expires_at')
+            if expires is None or float(expires) > statedb.wall_now():
+                return ('lost', restarts)
+            # Expired foreign claim (a relauncher died between claim
+            # and spawn): fall through — the CAS below takes it over.
         if restarts >= limit:
             return ('exhausted', restarts)
+        lease = statedb.lease_try_claim(
+            conn, controller_resource(job_id),
+            f'relauncher:{os.getpid()}',
+            ttl=_RELAUNCH_CLAIM_TTL_SECONDS, now=statedb.wall_now(),
+            expect_owner=observed)
+        if lease is None:
+            return ('lost', restarts)
         conn.execute(
             'UPDATE jobs SET controller_restarts = ? WHERE job_id = ?',
             (restarts + 1, job_id))
-        return ('claimed', restarts + 1)
+    statedb.record_lease_metric('claim', takeover=lease.takeover)
+    return ('claimed', restarts + 1)
 
 
 def bump_recovery(job_id: int) -> int:
@@ -259,6 +332,37 @@ def get_job(job_id: int) -> Optional[Dict[str, Any]]:
         row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
                            (job_id,)).fetchone()
         return _to_dict(row) if row else None
+
+
+def job_statuses() -> Dict[int, ManagedJobStatus]:
+    """Lean ``job_id -> status`` map (no dag parsing): the fleet
+    worker scans this every claim pass, so it must stay cheap at
+    thousands of rows."""
+    with _DB.reader() as conn:
+        return {
+            int(r['job_id']): ManagedJobStatus(r['status'])
+            for r in conn.execute('SELECT job_id, status FROM jobs')
+        }
+
+
+def job_status(job_id: int) -> Optional[ManagedJobStatus]:
+    """Single-row status read (no dag parsing) — O(1) freshness
+    checks in the fleet worker's stale-row retirement."""
+    with _DB.reader() as conn:
+        row = conn.execute('SELECT status FROM jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        return ManagedJobStatus(row['status']) if row else None
+
+
+def sum_recoveries() -> int:
+    """Aggregate recovery count across all jobs in one query (the
+    scale harness reports this; per-row get_job would re-parse every
+    dag_json)."""
+    with _DB.reader() as conn:
+        row = conn.execute(
+            'SELECT COALESCE(SUM(recovery_count), 0) AS n FROM jobs'
+        ).fetchone()
+        return int(row['n'])
 
 
 def get_jobs(

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import time
 import urllib.error
 import urllib.request
 from collections import deque
@@ -28,6 +27,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -189,7 +189,7 @@ class RequestRateAutoscaler:
         }
 
     def restore(self, state: dict) -> None:
-        now = time.time()
+        now = statedb.wall_now()
         cutoff = now - _QPS_WINDOW_SECONDS
         # Rebuild the window as synthetic cumulative samples on top
         # of the counter's CURRENT value — the restored requests are
@@ -216,12 +216,12 @@ class RequestRateAutoscaler:
 
     # ------------------------------------------------------------------
     def record_request(self, now: Optional[float] = None) -> None:
-        t = now if now is not None else time.time()
+        t = now if now is not None else statedb.wall_now()
         cum = _M_REQUESTS.inc(1, service=self._service) + self._offset
         self._samples.append((t, cum))
 
     def current_qps(self, now: Optional[float] = None) -> float:
-        now = now if now is not None else time.time()
+        now = now if now is not None else statedb.wall_now()
         cutoff = now - _QPS_WINDOW_SECONDS
         while self._samples and self._samples[0][0] < cutoff:
             self._window_base = self._samples.popleft()[1]
@@ -250,7 +250,7 @@ class RequestRateAutoscaler:
         targets track demand, not the (possibly preemption-shrunken)
         live pool.
         """
-        now = now if now is not None else time.time()
+        now = now if now is not None else statedb.wall_now()
         raw = self._raw_target(now)
         if raw == self._target:
             self._desire_since = None
@@ -301,7 +301,7 @@ class SLOAutoscaler(RequestRateAutoscaler):
         """Record one replica's scraped gauge values (``values`` is a
         parse_values() dict, metric name -> value). Tests feed this
         directly; production goes through scrape_replicas()."""
-        now = now if now is not None else time.time()
+        now = now if now is not None else statedb.wall_now()
         sample: Dict[str, float] = {'at': now}
         for key, metric, _ in SLO_SIGNALS:
             v = values.get(metric)
@@ -394,7 +394,7 @@ class SLOAutoscaler(RequestRateAutoscaler):
     def evaluate(self, current_replicas: Optional[int] = None,
                  now: Optional[float] = None,
                  num_ready_spot: int = 0) -> ScalingDecision:
-        now = now if now is not None else time.time()
+        now = now if now is not None else statedb.wall_now()
         breach = self._worst_breach(now)
         breached = breach is not None and breach[0] > 1.0
         if not breached:

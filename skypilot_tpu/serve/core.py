@@ -12,12 +12,12 @@ import signal
 import socket
 import subprocess
 import sys
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.serve import serve_state
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.serve.replica_managers import ReplicaManager
 from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
@@ -93,9 +93,9 @@ def up(task: task_lib.Task,
     serve_state.set_service_controller_pid(name, proc.pid)
     # Wait for the controller's LB to actually listen; surface startup
     # crashes here instead of handing back a dead endpoint.
-    deadline = time.time() + _CONTROLLER_START_TIMEOUT
+    deadline = statedb.wall_now() + _CONTROLLER_START_TIMEOUT
     port = 0
-    while time.time() < deadline:
+    while statedb.wall_now() < deadline:
         if proc.poll() is not None:
             tail = ''
             try:
@@ -119,7 +119,8 @@ def up(task: task_lib.Task,
         # skytpu-lint: disable=STL002 — deadline-bounded readiness
         # poll (controller exit / LB reachable / timeout), not a
         # retried operation; the try above only reads the log tail.
-        time.sleep(0.2)
+        # Sleeps ride the same injectable clock as the deadline.
+        statedb.wall_clock().sleep(0.2)
     else:
         logger.warning(
             'Load balancer for %s not reachable after %.0fs; '

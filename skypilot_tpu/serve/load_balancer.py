@@ -31,7 +31,6 @@ import asyncio
 import itertools
 import json
 import threading
-import time
 from typing import Callable, Dict, List, Optional, Set
 
 import aiohttp
@@ -42,6 +41,7 @@ from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
@@ -216,9 +216,9 @@ class LoadBalancer:
         in-flight ones to finish (rolling update / downscale: tear the
         replica down only after this returns). True = drained."""
         self._draining.add(url)
-        deadline = time.time() + timeout
+        deadline = statedb.wall_now() + timeout
         while self.inflight(url) > 0:
-            if time.time() > deadline:
+            if statedb.wall_now() > deadline:
                 return False
             await asyncio.sleep(0.05)
         return True

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import math
 import threading
-import time
 import traceback
 from typing import Callable, Dict, List, Optional
 
@@ -24,6 +23,7 @@ from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
@@ -299,8 +299,8 @@ class ReplicaManager:
             logger.info('Replica drain request to %s failed (%s); '
                         'proceeding to teardown.', url, e)
             return
-        deadline = time.time() + budget + 5.0
-        while time.time() < deadline:
+        deadline = statedb.wall_now() + budget + 5.0
+        while statedb.wall_now() < deadline:
             try:
                 health = requests.get(base + '/health', timeout=(2, 5))
             except requests.RequestException:
@@ -313,23 +313,28 @@ class ReplicaManager:
             # skytpu-lint: disable=STL002 — bounded drain-completion
             # poll, not a retry loop: nothing is re-attempted, the
             # loop only waits for the replica's own drain to finish.
-            time.sleep(0.25)
+            # Sleeps ride the same injectable clock as the deadline.
+            statedb.wall_clock().sleep(0.25)
         logger.warning('Replica at %s still draining after the %.0fs '
                        'budget; proceeding to teardown.', url, budget)
+
+    def _down_cluster(self, cluster: str) -> None:
+        """Cloud-teardown seam (the synthetic fleet manager overrides
+        this to reclaim from the synthetic cloud instead)."""
+        from skypilot_tpu import core
+        _TERMINATE_RETRY_POLICY.call(core.down, cluster)
 
     def _terminate_replica(
             self, replica_id: int,
             final_status: Optional[ReplicaStatus] = ReplicaStatus.SHUTDOWN,
             remove: bool = False,
             complete_intent: Optional[int] = None) -> None:
-        from skypilot_tpu import core
         try:
             with trace_lib.span('serve.replica.terminate',
                                 slow_ok=True,
                                 service=self.service_name,
                                 replica=replica_id):
-                _TERMINATE_RETRY_POLICY.call(
-                    core.down, self._cluster_name(replica_id))
+                self._down_cluster(self._cluster_name(replica_id))
         except exceptions.ClusterDoesNotExist:
             pass
         except Exception:  # pylint: disable=broad-except
@@ -395,7 +400,6 @@ class ReplicaManager:
         Returns action -> count (also exported via
         ``skytpu_serve_reconciled_intents_total``).
         """
-        from skypilot_tpu import global_user_state
         actions: Dict[str, int] = {}
 
         def count(action: str) -> None:
@@ -484,12 +488,7 @@ class ReplicaManager:
         # teardown crashed) must not keep burning money.
         prefix = f'{self.service_name}-replica-'
         known = set(rows) | journaled
-        try:
-            clusters = global_user_state.get_clusters()
-        except Exception:  # pylint: disable=broad-except
-            clusters = []
-        for record in clusters:
-            name = record.get('name') or ''
+        for name in self._list_cluster_names():
             if not name.startswith(prefix):
                 continue
             try:
@@ -508,6 +507,16 @@ class ReplicaManager:
             logger.info('Reconcile on start for %s: %s.',
                         self.service_name, actions)
         return actions
+
+    def _list_cluster_names(self) -> List[str]:
+        """All known cluster names — the orphan sweep's search space
+        (seam: the synthetic fleet manager lists its cloud instead)."""
+        from skypilot_tpu import global_user_state
+        try:
+            return [r.get('name') or ''
+                    for r in global_user_state.get_clusters()]
+        except Exception:  # pylint: disable=broad-except
+            return []
 
     def _cluster_is_up(self, cluster: Optional[str]) -> bool:
         if not cluster:
@@ -601,13 +610,7 @@ class ReplicaManager:
                               ReplicaStatus.NOT_READY):
                 continue
             cluster = replica['cluster_name']
-            try:
-                record = backend_utils.refresh_cluster_record(
-                    cluster, force_refresh=True)
-            except Exception:  # pylint: disable=broad-except
-                record = None
-            if (record is None or
-                    record['status'] != status_lib.ClusterStatus.UP):
+            if not self._cluster_is_up(cluster):
                 # Cluster died under us: preemption. Mark it (so
                 # reconcile immediately launches a replacement) and
                 # clean leftovers in the background; the cleanup
@@ -669,7 +672,7 @@ class ReplicaManager:
                 # must not eat the app's warm-up allowance.
                 starting_at = (replica.get('starting_at') or
                                replica.get('launched_at') or 0)
-                if (time.time() - starting_at >
+                if (statedb.wall_now() - starting_at >
                         spec.initial_delay_seconds):
                     logger.warning(
                         'Replica %d never became ready within '
@@ -724,7 +727,7 @@ class ReplicaManager:
         # cleanup thread in flight from probe_all; re-arm it here in
         # case a controller restart orphaned the row (the _terminating
         # guard makes this a no-op when one is already running).
-        now = time.time()
+        now = statedb.wall_now()
         for r in replicas:
             if r['status'] is ReplicaStatus.SHUTDOWN:
                 serve_state.remove_replica(self.service_name,

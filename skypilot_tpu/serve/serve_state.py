@@ -13,7 +13,6 @@ from __future__ import annotations
 import json
 import os
 import sqlite3
-import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import statedb
@@ -104,6 +103,32 @@ def _init(conn: sqlite3.Connection) -> None:
 _DB = statedb.StateDB(_db_path, init_fn=_init, site='serve.state.write')
 
 
+def db() -> statedb.StateDB:
+    """The serve StateDB — the fleet layer builds its LeaseTable on
+    it so service leases live next to the rows they guard."""
+    return _DB
+
+
+def controller_resource(service_name: str) -> str:
+    """Lease resource name for ownership of one service's control
+    loop (docs/control_plane.md)."""
+    return f'serve.controller:{service_name}'
+
+
+def register_controller_leases(names: List[str]) -> None:
+    """Create (unowned) controller-lease rows for these services,
+    gated on the service row still existing in the SAME transaction
+    (same fence-resurrection hazard as
+    ``jobs.state.register_controller_leases``)."""
+    with _DB.transaction() as conn:
+        for name in names:
+            row = conn.execute('SELECT 1 FROM services WHERE name = ?',
+                               (name,)).fetchone()
+            if row is None:
+                continue
+            statedb.lease_register(conn, controller_resource(name))
+
+
 # ------------------------------------------------------------- services
 
 
@@ -115,12 +140,12 @@ def add_service(name: str, spec_json: str, task_json: str,
             'task_json, lb_port, created_at, current_version) '
             'VALUES (?,?,?,?,?,?,1)',
             (name, ServiceStatus.CONTROLLER_INIT.value, spec_json,
-             task_json, lb_port, time.time()))
+             task_json, lb_port, statedb.wall_now()))
         conn.execute(
             'INSERT OR REPLACE INTO version_specs (service_name, '
             'version, spec_json, task_json, created_at) '
             'VALUES (?,1,?,?,?)', (name, spec_json, task_json,
-                                   time.time()))
+                                   statedb.wall_now()))
 
 
 def add_version(name: str, spec_json: str, task_json: str) -> int:
@@ -137,7 +162,7 @@ def add_version(name: str, spec_json: str, task_json: str) -> int:
         conn.execute(
             'INSERT INTO version_specs (service_name, version, '
             'spec_json, task_json, created_at) VALUES (?,?,?,?,?)',
-            (name, version, spec_json, task_json, time.time()))
+            (name, version, spec_json, task_json, statedb.wall_now()))
         # Keep the service row's spec/task mirroring the latest
         # version (what status/up readers see).
         conn.execute(
@@ -174,11 +199,33 @@ def set_service_status(name: str, status: ServiceStatus) -> None:
                      (status.value, name))
 
 
+def set_service_status_unless(name: str, status: ServiceStatus,
+                              unless: ServiceStatus) -> bool:
+    """Conditional status write: one UPDATE, so a concurrent
+    transition to ``unless`` (e.g. SHUTTING_DOWN from a teardown
+    request) can never be clobbered by a stale read-modify-write.
+    Returns True when the write applied."""
+    with _DB.transaction() as conn:
+        cur = conn.execute(
+            'UPDATE services SET status = ? WHERE name = ? AND '
+            'status != ?', (status.value, name, unless.value))
+        return cur.rowcount == 1
+
+
 def set_service_controller_pid(name: str, pid: int) -> None:
+    """Record the controller process AND force-claim the service's
+    controller lease in one transaction (same contract as
+    ``jobs.state.set_controller_pid``: the spawned process IS the
+    owner; the fence bump revokes any stale predecessor)."""
     with _DB.transaction() as conn:
         conn.execute(
             'UPDATE services SET controller_pid = ? WHERE name = ?',
             (pid, name))
+        lease = statedb.lease_force_claim(conn,
+                                          controller_resource(name),
+                                          f'pid:{pid}',
+                                          statedb.wall_now())
+    statedb.record_lease_metric('claim', takeover=lease.takeover)
 
 
 def set_service_lb_port(name: str, port: int) -> None:
@@ -201,6 +248,27 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
     d['spec'] = json.loads(d['spec_json'])
     d['task'] = json.loads(d['task_json'])
     return d
+
+
+def service_names() -> List[str]:
+    """Lean name list (no spec/task JSON parsing) for the fleet
+    worker's claim scans."""
+    with _DB.reader() as conn:
+        return [
+            r['name']
+            for r in conn.execute('SELECT name FROM services ORDER BY name')
+        ]
+
+
+def service_statuses() -> Dict[str, ServiceStatus]:
+    """Lean ``name -> status`` map — the scale harness polls this
+    every tick, so it must not pay get_service's spec/task JSON
+    parsing per service."""
+    with _DB.reader() as conn:
+        return {
+            r['name']: ServiceStatus(r['status'])
+            for r in conn.execute('SELECT name, status FROM services')
+        }
 
 
 def get_services() -> List[Dict[str, Any]]:
@@ -229,7 +297,7 @@ def save_autoscaler_state(name: str, state: Dict[str, Any]) -> None:
         conn.execute(
             'INSERT OR REPLACE INTO autoscaler_state '
             '(service_name, state_json, updated_at) VALUES (?, ?, ?)',
-            (name, json.dumps(state), time.time()))
+            (name, json.dumps(state), statedb.wall_now()))
 
 
 def load_autoscaler_state(name: str) -> Optional[Dict[str, Any]]:
@@ -256,7 +324,7 @@ def add_replica(service_name: str, replica_id: int, cluster_name: str,
             'cluster_name, status, launched_at, version, is_spot) '
             'VALUES (?,?,?,?,?,?,?)',
             (service_name, replica_id, cluster_name,
-             ReplicaStatus.PENDING.value, time.time(), version,
+             ReplicaStatus.PENDING.value, statedb.wall_now(), version,
              int(is_spot)))
         if intent_payload is not None:
             return statedb.begin_intent(conn, 'serve.scale_up',
@@ -277,13 +345,13 @@ def set_replica_status(service_name: str, replica_id: int,
     args: list = [status.value]
     if status is ReplicaStatus.STARTING:
         sets.append('starting_at = ?')
-        args.append(time.time())
+        args.append(statedb.wall_now())
     if status.is_failed():
         # The replacement cap counts failures by WHEN they failed, not
         # when the replica launched (a replica dying after an hour of
         # service is a fresh failure).
         sets.append('failed_at = ?')
-        args.append(time.time())
+        args.append(statedb.wall_now())
     if url is not None:
         sets.append('url = ?')
         args.append(url)

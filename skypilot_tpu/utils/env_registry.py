@@ -436,6 +436,46 @@ BENCH_LOAD_TRACE = register(
     'serve_load bench: also write the generated trace (with its '
     'spec header) to this JSONL path — the replayable round '
     'artifact.')
+# ------------------------------------------------------ controller fleet
+SKYTPU_FLEET_LEASE_TTL = register(
+    'SKYTPU_FLEET_LEASE_TTL',
+    'Fleet worker lease TTL in seconds (heartbeat renews at TTL/3; a '
+    'dead worker\'s leases expire to survivors after at most TTL).')
+SKYTPU_FLEET_SCAN_GAP = register(
+    'SKYTPU_FLEET_SCAN_GAP',
+    'Seconds between fleet-worker scans for claimable job/service '
+    'leases.')
+SKYTPU_FLEET_CONCURRENCY = register(
+    'SKYTPU_FLEET_CONCURRENCY',
+    'Max job/service work items one fleet worker runs concurrently.')
+BENCH_FLEET_JOBS = register(
+    'BENCH_FLEET_JOBS',
+    'fleet bench: managed jobs to drive through launch->recover->'
+    'terminate on the synthetic cloud (default 1000; 24 under '
+    'BENCH_SMOKE).')
+BENCH_FLEET_SERVICES = register(
+    'BENCH_FLEET_SERVICES',
+    'fleet bench: services to drive through scale-up->READY->teardown '
+    '(default 100; 3 under BENCH_SMOKE).')
+BENCH_FLEET_REPLICAS = register(
+    'BENCH_FLEET_REPLICAS',
+    'fleet bench: replicas per service (default 2).')
+BENCH_FLEET_WORKERS = register(
+    'BENCH_FLEET_WORKERS',
+    'fleet bench: fleet worker processes-worth of controller loops '
+    '(in-process workers; default 4, min 3 for the scale claim).')
+BENCH_FLEET_KILLS = register(
+    'BENCH_FLEET_KILLS',
+    'fleet bench: fleet workers to kill mid-run (lease takeover is '
+    'the measured path; default 1).')
+BENCH_FLEET_SEED = register(
+    'BENCH_FLEET_SEED',
+    'fleet bench: RNG seed for the preemption/kill schedule and the '
+    'synthetic cloud (same seed => same schedule).')
+BENCH_FLEET_DEADLINE_S = register(
+    'BENCH_FLEET_DEADLINE_S',
+    'fleet bench: overall settle deadline in seconds before the '
+    'round reports a timeout.')
 BENCH_SPEC_K = register(
     'BENCH_SPEC_K',
     'Speculative-decoding draft length for the decode/serve benches '

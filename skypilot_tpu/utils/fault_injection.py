@@ -100,6 +100,14 @@ KNOWN_SITES = (
     'serve.scale_down.post_drain',
     'statedb.commit.pre',
     'statedb.commit.post',
+    # Controller-fleet sites (docs/control_plane.md): the synthetic
+    # cloud's provision step, and crashpoints inside the fleet
+    # worker's lease lifecycle (just after a claim; mid-renewal in
+    # the heartbeat thread — the worst instruction to die at, since
+    # the lease looks healthy for almost a full TTL afterwards).
+    'fleet.synth.launch',
+    'fleet.worker.claim.post',
+    'fleet.worker.renew.mid',
 )
 
 # Default exit code for `crash` faults: distinctive in wait statuses,

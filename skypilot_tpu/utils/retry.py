@@ -60,6 +60,23 @@ class Clock:
 REAL_CLOCK = Clock()
 
 
+class WallClock(Clock):
+    """Wall-clock variant: ``now()`` is epoch seconds (``time.time``).
+
+    Monotonic time is process-local, so anything that WRITES
+    timestamps other processes compare against — lease expiries, row
+    timestamps in the shared state DBs — must use wall time. Kept
+    behind the same Clock interface so a :class:`FakeClock` can stand
+    in for it in tests (lease expiry is then driven by virtual time).
+    """
+
+    def now(self) -> float:
+        return time.time()
+
+
+WALL_CLOCK = WallClock()
+
+
 class FakeClock(Clock):
     """Virtual clock for tests: sleeping advances time instantly."""
 

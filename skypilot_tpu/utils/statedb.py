@@ -27,21 +27,39 @@ them (lint rule STL010 keeps it that way) with:
   between leaves an open intent row; recovery-as-startup
   (``reconcile_on_start`` in the jobs and serve controllers) replays
   open intents against cloud/cluster truth — adopt, roll forward, or
-  roll back — so the operation is never half-done forever.
+  roll back — so the operation is never half-done forever;
+- a **lease table** (docs/control_plane.md): generic expiring
+  ownership records with monotonically increasing *fencing tokens*.
+  ``lease_try_claim`` is one compare-and-swap transaction (claim
+  succeeds only while the row is unowned, expired, or — for restart
+  claims — still names the owner the caller observed dead), renewal
+  extends the expiry only while the claimant's ``(owner, fence)``
+  pair is still current, and :class:`FenceGuard` re-validates the
+  pair INSIDE every subsequent :meth:`StateDB.transaction` — in the
+  same BEGIN IMMEDIATE as the writes it guards, so a process that
+  lost its lease (GC pause, kill, partition) can never clobber the
+  successor that claimed over it. This is what lets N controller
+  processes (``skypilot_tpu/fleet``) share the jobs/services tables.
 
-Import-light: stdlib + utils.retry + utils.fault_injection only.
+Import-light: stdlib + utils.retry + utils.fault_injection +
+skypilot_tpu.metrics (already in utils.retry's closure — the lease
+layer counts claims/renewals/stale-write rejections).
 """
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import dataclasses
 import json
 import os
 import pathlib
 import sqlite3
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import (Any, Callable, Dict, Iterable, List, Optional,
+                    Tuple)
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import retry as retry_lib
@@ -60,6 +78,18 @@ _INTENT_DDL = """
         pid INTEGER
     )"""
 
+# Lease rows live NEXT TO the state they protect (same sqlite file),
+# so the fence check and the guarded writes share one BEGIN IMMEDIATE.
+_LEASE_DDL = """
+    CREATE TABLE IF NOT EXISTS leases (
+        resource TEXT PRIMARY KEY,
+        owner TEXT,
+        fence INTEGER NOT NULL DEFAULT 0,
+        acquired_at REAL,
+        expires_at REAL,
+        renewals INTEGER NOT NULL DEFAULT 0
+    )"""
+
 # One RetryPolicy per site label (jobs.state.write / serve.state.write
 # / ...): BEGIN IMMEDIATE contention lands in the shared
 # skytpu_retry_attempts_total / _giveups_total series.
@@ -72,6 +102,38 @@ def reconcile_enabled() -> bool:
     every start unless SKYTPU_RECONCILE_ON_START=0."""
     return os.environ.get(env_registry.SKYTPU_RECONCILE_ON_START,
                           '1') != '0'
+
+
+# ----------------------------------------------------------- wall clock
+# The ONE time source for timestamps written into shared state DBs
+# (row timestamps, lease expiries): wall time, because other processes
+# compare against it, behind the Clock interface so tests can swap a
+# FakeClock in (lint rule STL011 keeps jobs/, serve/ and fleet/ off
+# direct ``time.time()``).
+
+_wall_clock: retry_lib.Clock = retry_lib.WALL_CLOCK
+
+
+def wall_now() -> float:
+    """Epoch seconds on the injectable wall clock."""
+    return _wall_clock.now()
+
+
+def set_wall_clock(
+        clock: Optional[retry_lib.Clock]) -> retry_lib.Clock:
+    """Swap the process wall clock (None = real); returns the previous
+    clock so tests can restore it."""
+    global _wall_clock
+    previous = _wall_clock
+    _wall_clock = clock or retry_lib.WALL_CLOCK
+    return previous
+
+
+def wall_clock() -> retry_lib.Clock:
+    """The injectable wall clock itself — for components (fleet
+    workers, lease tables) that need ``sleep`` as well as ``now`` on
+    the SAME timeline the state DBs' timestamps use."""
+    return _wall_clock
 
 
 def _retry_policy(site: str) -> retry_lib.RetryPolicy:
@@ -200,6 +262,421 @@ def open_intents(conn: sqlite3.Connection,
     return out
 
 
+# -------------------------------------------------------------- leases
+# Generic expiring ownership with fencing tokens (docs/control_plane.md).
+# The conn-level functions compose inside an outer transaction()
+# (restart claims bundle a budget check with the ownership CAS); the
+# LeaseTable class wraps a StateDB for standalone use by fleet workers.
+
+_M_LEASE_CLAIMS = metrics_lib.counter(
+    'skytpu_lease_claims_total',
+    'Successful lease claims, by kind (fresh = unowned row, takeover '
+    '= expired or usurped from a dead owner).',
+    labels=('kind',))
+_M_LEASE_RENEWALS = metrics_lib.counter(
+    'skytpu_lease_renewals_total',
+    'Successful lease heartbeat renewals.')
+_M_LEASE_RELEASES = metrics_lib.counter(
+    'skytpu_lease_releases_total',
+    'Leases released voluntarily by their owner.')
+_M_LEASE_LOSSES = metrics_lib.counter(
+    'skytpu_lease_losses_total',
+    'Renewals/releases that found the lease already claimed over '
+    '(the caller lost ownership).')
+_M_LEASE_STALE_WRITES = metrics_lib.counter(
+    'skytpu_lease_stale_writes_total',
+    'Guarded state writes rejected because the writer\'s fencing '
+    'token was stale (a successor claimed the lease).')
+
+
+class LeaseLostError(RuntimeError):
+    """The caller's lease is no longer current: a successor holds a
+    higher fencing token (or the worker was revoked). Any in-flight
+    operation must abandon WITHOUT further state writes."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Lease:
+    """Immutable claim handle. ``fence`` is the fencing token: it
+    increases on every successful claim of the resource, so a write
+    guarded by an old fence can never land after a successor's.
+    ``takeover`` records whether this claim displaced an expired /
+    usurped owner (metrics only, not identity)."""
+    resource: str
+    owner: str
+    fence: int
+    expires_at: float
+    takeover: bool = False
+
+
+def record_lease_metric(action: str, *, takeover: bool = False) -> None:
+    """Count one lease event. Callers invoke this AFTER their
+    transaction commits: counting inside a still-open transaction
+    would leave phantom counts behind a rollback, and the counters
+    are documented to reconcile with the fencing-token audit."""
+    if action == 'claim':
+        _M_LEASE_CLAIMS.inc(1, kind='takeover' if takeover
+                            else 'fresh')
+    elif action == 'renew':
+        _M_LEASE_RENEWALS.inc(1)
+    elif action == 'release':
+        _M_LEASE_RELEASES.inc(1)
+    elif action == 'loss':
+        _M_LEASE_LOSSES.inc(1)
+
+
+def ensure_lease_table(conn: sqlite3.Connection) -> None:
+    conn.execute(_LEASE_DDL)
+
+
+def lease_register(conn: sqlite3.Connection, resource: str) -> None:
+    """Create the (unowned) lease row if absent — claimable at fence 1."""
+    conn.execute(
+        'INSERT OR IGNORE INTO leases (resource, owner, fence) '
+        'VALUES (?, NULL, 0)', (resource,))
+
+
+def lease_try_claim(conn: sqlite3.Connection, resource: str,
+                    owner: str, ttl: float, now: float,
+                    expect_owner: Optional[str] = None
+                    ) -> Optional[Lease]:
+    """One CAS claim attempt; call inside a transaction().
+
+    Succeeds when the row is unowned, expired at ``now``, or —
+    ``expect_owner`` given — still names exactly the owner the caller
+    observed to be dead (the restart-claim shape: a changed owner
+    means another claimant already took over). Bumps the fencing
+    token. Returns the claimed Lease or None (lost).
+
+    A MISSING row is a loss, not an implicit registration: settled
+    work's rows are deleted (:func:`lease_delete`), and a claim
+    racing that deletion must NOT resurrect the row — it would
+    restart the fence sequence and hand out an already-used token
+    (:func:`lease_register` / :func:`lease_force_claim` are the
+    explicit creation paths).
+    """
+    row = conn.execute(
+        'SELECT owner, fence, expires_at FROM leases '
+        'WHERE resource = ?', (resource,)).fetchone()
+    if row is None:
+        return None
+    cur_owner, fence = row['owner'], int(row['fence'])
+    expires = row['expires_at']
+    unowned = cur_owner is None
+    # NULL expiry on an OWNED row means "never expires" (classic
+    # one-process controllers own their lease without heartbeating;
+    # liveness is proven out-of-band and usurped via expect_owner).
+    expired = (not unowned and expires is not None and
+               float(expires) <= now)
+    usurped = expect_owner is not None and cur_owner == expect_owner
+    if not (unowned or expired or usurped):
+        return None
+    conn.execute(
+        'UPDATE leases SET owner = ?, fence = ?, acquired_at = ?, '
+        'expires_at = ?, renewals = 0 WHERE resource = ?',
+        (owner, fence + 1, now, now + ttl, resource))
+    return Lease(resource, owner, fence + 1, now + ttl,
+                 takeover=not unowned)
+
+
+def lease_force_claim(conn: sqlite3.Connection, resource: str,
+                      owner: str, now: float,
+                      ttl: Optional[float] = None) -> Lease:
+    """Unconditional takeover (still bumps the fence): for a process
+    whose ownership is proven out-of-band — the controller a
+    relauncher just spawned IS the owner, whoever held the row.
+    ``ttl=None`` = no expiry (ownership ends only by release or a
+    ``expect_owner`` usurp from a caller that observed death)."""
+    row = conn.execute(
+        'SELECT fence FROM leases WHERE resource = ?',
+        (resource,)).fetchone()
+    fence = (int(row['fence']) if row is not None else 0) + 1
+    expires = None if ttl is None else now + ttl
+    conn.execute(
+        'INSERT INTO leases (resource, owner, fence, acquired_at, '
+        'expires_at, renewals) VALUES (?,?,?,?,?,0) '
+        'ON CONFLICT(resource) DO UPDATE SET owner = ?, fence = ?, '
+        'acquired_at = ?, expires_at = ?, renewals = 0',
+        (resource, owner, fence, now, expires,
+         owner, fence, now, expires))
+    return Lease(resource, owner, fence,
+                 expires if expires is not None else float('inf'),
+                 takeover=row is not None)
+
+
+def lease_renew(conn: sqlite3.Connection, lease: Lease, ttl: float,
+                now: float) -> Optional[Lease]:
+    """Heartbeat: extend expiry iff (owner, fence) is still current.
+    Returns the refreshed Lease, or None — the lease was lost."""
+    cur = conn.execute(
+        'UPDATE leases SET expires_at = ?, renewals = renewals + 1 '
+        'WHERE resource = ? AND owner = ? AND fence = ?',
+        (now + ttl, lease.resource, lease.owner, lease.fence))
+    if cur.rowcount != 1:
+        return None
+    return dataclasses.replace(lease, expires_at=now + ttl)
+
+
+def lease_release(conn: sqlite3.Connection, lease: Lease) -> bool:
+    """Voluntary release: the row goes unowned (fence is KEPT — the
+    next claim must still fence above this one). False = already lost."""
+    cur = conn.execute(
+        'UPDATE leases SET owner = NULL, expires_at = NULL '
+        'WHERE resource = ? AND owner = ? AND fence = ?',
+        (lease.resource, lease.owner, lease.fence))
+    return cur.rowcount == 1
+
+
+def lease_delete(conn: sqlite3.Connection, lease: Lease) -> bool:
+    """Retire the row entirely — for work that reached a terminal
+    state and will never be claimed again (settled jobs, removed
+    services). CAS'd on (owner, fence) like release, so only the
+    current owner can retire it; without deletion, every claim scan
+    would iterate terminal work's released rows forever."""
+    cur = conn.execute(
+        'DELETE FROM leases '
+        'WHERE resource = ? AND owner = ? AND fence = ?',
+        (lease.resource, lease.owner, lease.fence))
+    return cur.rowcount == 1
+
+
+def lease_check(conn: sqlite3.Connection, lease: Lease) -> bool:
+    """Is the caller's (owner, fence) pair still the current claim?
+    Expiry alone does NOT fail this check: an expired-but-unclaimed
+    lease still belongs to its owner (classic fencing) — only a
+    successor's claim, which bumps the fence, revokes it."""
+    row = conn.execute(
+        'SELECT owner, fence FROM leases WHERE resource = ?',
+        (lease.resource,)).fetchone()
+    return (row is not None and row['owner'] == lease.owner and
+            int(row['fence']) == lease.fence)
+
+
+def lease_get(conn: sqlite3.Connection,
+              resource: str) -> Optional[Dict[str, Any]]:
+    row = conn.execute('SELECT * FROM leases WHERE resource = ?',
+                       (resource,)).fetchone()
+    return dict(row) if row is not None else None
+
+
+def lease_claimable(conn: sqlite3.Connection, prefix: str,
+                    now: float) -> List[str]:
+    """Resources under ``prefix`` that are unowned or expired at
+    ``now`` — the fleet scheduler's scan, oldest expiry first so a
+    dead worker's abandoned work is adopted before fresh work."""
+    rows = conn.execute(
+        'SELECT resource FROM leases WHERE resource LIKE ? AND '
+        '(owner IS NULL OR (expires_at IS NOT NULL AND '
+        'expires_at <= ?)) '
+        'ORDER BY (expires_at IS NULL), expires_at, resource',
+        (prefix + '%', now)).fetchall()
+    return [r['resource'] for r in rows]
+
+
+LeaseEvent = Tuple[str, str, str, int, float]  # action, resource, owner, fence, t
+
+
+class LeaseTable:
+    """Lease operations on one StateDB, each in its own transaction.
+
+    ``clock`` is injectable (:class:`~skypilot_tpu.utils.retry.
+    FakeClock` drives expiry deterministically in tests); ``on_event``
+    receives ``(action, resource, owner, fence, t)`` tuples — the
+    scale harness uses it to audit fence monotonicity across workers.
+    """
+
+    def __init__(self, db: 'StateDB',
+                 clock: Optional[retry_lib.Clock] = None,
+                 on_event: Optional[Callable[[LeaseEvent],
+                                             None]] = None) -> None:
+        self.db = db
+        self.clock = clock or _wall_clock
+        self.on_event = on_event
+
+    def _emit(self, action: str, resource: str, owner: str,
+              fence: int) -> None:
+        if self.on_event is not None:
+            self.on_event((action, resource, owner, fence,
+                           self.clock.now()))
+
+    def register(self, resources: Iterable[str]) -> None:
+        resources = list(resources)
+        if not resources:
+            return
+        with self.db.transaction() as conn:
+            for resource in resources:
+                lease_register(conn, resource)
+
+    def try_claim(self, resource: str, owner: str, ttl: float,
+                  expect_owner: Optional[str] = None
+                  ) -> Optional[Lease]:
+        with self.db.transaction() as conn:
+            lease = lease_try_claim(conn, resource, owner, ttl,
+                                    self.clock.now(),
+                                    expect_owner=expect_owner)
+        if lease is not None:
+            record_lease_metric('claim', takeover=lease.takeover)
+            self._emit('claim', resource, owner, lease.fence)
+        return lease
+
+    def renew(self, lease: Lease, ttl: float) -> Optional[Lease]:
+        with self.db.transaction() as conn:
+            renewed = lease_renew(conn, lease, ttl, self.clock.now())
+        record_lease_metric('renew' if renewed is not None else 'loss')
+        if renewed is not None:
+            self._emit('renew', lease.resource, lease.owner,
+                       lease.fence)
+        return renewed
+
+    def renew_many(self, leases: List[Lease],
+                   ttl: float) -> Dict[str, Optional[Lease]]:
+        """Heartbeat a whole held set in ONE transaction: a worker
+        holding dozens of leases must not pay (and contend for) one
+        write-lock acquisition per lease per sweep — at fleet scale
+        that is exactly what makes sweeps outlast the TTL and causes
+        spurious expirations."""
+        results: Dict[str, Optional[Lease]] = {}
+        if not leases:
+            return results
+        with self.db.transaction() as conn:
+            now = self.clock.now()
+            for lease in leases:
+                results[lease.resource] = lease_renew(conn, lease,
+                                                      ttl, now)
+        for lease in leases:
+            ok = results.get(lease.resource) is not None
+            record_lease_metric('renew' if ok else 'loss')
+            if ok:
+                self._emit('renew', lease.resource, lease.owner,
+                           lease.fence)
+        return results
+
+    def release(self, lease: Lease) -> bool:
+        with self.db.transaction() as conn:
+            ok = lease_release(conn, lease)
+        record_lease_metric('release' if ok else 'loss')
+        if ok:
+            self._emit('release', lease.resource, lease.owner,
+                       lease.fence)
+        return ok
+
+    def delete(self, lease: Lease) -> bool:
+        with self.db.transaction() as conn:
+            ok = lease_delete(conn, lease)
+        record_lease_metric('release' if ok else 'loss')
+        if ok:
+            self._emit('release', lease.resource, lease.owner,
+                       lease.fence)
+        return ok
+
+    def check(self, lease: Lease) -> bool:
+        with self.db.reader() as conn:
+            return lease_check(conn, lease)
+
+    def get(self, resource: str) -> Optional[Dict[str, Any]]:
+        with self.db.reader() as conn:
+            return lease_get(conn, resource)
+
+    def claimable(self, prefix: str = '') -> List[str]:
+        with self.db.reader() as conn:
+            return lease_claimable(conn, prefix, self.clock.now())
+
+    def snapshot(self, prefix: str = '') -> List[Dict[str, Any]]:
+        with self.db.reader() as conn:
+            rows = conn.execute(
+                'SELECT * FROM leases WHERE resource LIKE ? '
+                'ORDER BY resource', (prefix + '%',)).fetchall()
+        return [dict(r) for r in rows]
+
+    def guard(self, lease: Lease,
+              extra_check: Optional[Callable[[], None]] = None
+              ) -> 'FenceGuard':
+        return FenceGuard(self.db, lease, extra_check=extra_check)
+
+
+# -------------------------------------------------------- fence guards
+# While a FenceGuard is installed (contextvar — per thread/task),
+# EVERY StateDB.transaction() on the guarded database re-validates the
+# lease's (owner, fence) pair inside the same BEGIN IMMEDIATE as the
+# caller's writes, and raises LeaseLostError BEFORE any mutation runs
+# when the token is stale. This is the fencing invariant: a worker
+# that lost its lease mid-operation cannot clobber its successor,
+# without threading a lease handle through every state function.
+
+_GUARDS: 'contextvars.ContextVar[tuple]' = contextvars.ContextVar(
+    'statedb_fence_guards', default=())
+
+
+class FenceGuard:
+    """One installed lease check. ``extra_check`` runs first on every
+    validation (the fleet worker uses it to act out worker death:
+    a killed worker's every write raises immediately)."""
+
+    def __init__(self, db: 'StateDB', lease: Lease,
+                 extra_check: Optional[Callable[[], None]] = None
+                 ) -> None:
+        self.db = db
+        self.lease = lease
+        self.extra_check = extra_check
+        self.revoked = False
+
+    def revoke(self) -> None:
+        """Mark lost out-of-band (e.g. the renewal heartbeat failed):
+        the next guarded write raises without touching the DB."""
+        self.revoked = True
+
+    def validate(self, conn: Optional[sqlite3.Connection] = None,
+                 path: Optional[str] = None) -> None:
+        """Raise LeaseLostError if this guard's lease is stale.
+
+        When ``conn`` is a connection to the guard's own database the
+        check runs on it (atomic with the caller's transaction);
+        otherwise a fresh reader is used — still a hard fence, just
+        checked slightly before the write commits.
+        """
+        if self.extra_check is not None:
+            self.extra_check()
+        if self.revoked:
+            raise LeaseLostError(
+                f'lease {self.lease.resource} (fence '
+                f'{self.lease.fence}) was revoked')
+        own_path = self.db.path()
+        if conn is not None and path == own_path:
+            ok = lease_check(conn, self.lease)
+        else:
+            with self.db.reader() as reader:
+                ok = lease_check(reader, self.lease)
+        if not ok:
+            _M_LEASE_STALE_WRITES.inc(1)
+            raise LeaseLostError(
+                f'lease {self.lease.resource} (owner '
+                f'{self.lease.owner}, fence {self.lease.fence}) is '
+                'stale: a successor claimed it')
+
+
+@contextlib.contextmanager
+def guarded(guard: FenceGuard):
+    """Install a fence guard for the current thread/task."""
+    token = _GUARDS.set(_GUARDS.get() + (guard,))
+    try:
+        yield guard
+    finally:
+        _GUARDS.reset(token)
+
+
+def validate_guards() -> None:
+    """Explicit checkpoint for non-statedb side effects (the synthetic
+    cloud's launch/terminate call this): raises LeaseLostError when
+    any installed guard is stale."""
+    for guard in _GUARDS.get():
+        guard.validate()
+
+
+def _apply_guards(conn: sqlite3.Connection, path: str) -> None:
+    for guard in _GUARDS.get():
+        guard.validate(conn, path)
+
+
 # ------------------------------------------------------------- StateDB
 
 
@@ -222,6 +699,9 @@ class StateDB:
         self._initialized_paths: set = set()
         self._init_lock = threading.Lock()
 
+    def path(self) -> str:
+        return self._path_fn()
+
     def connection(self) -> sqlite3.Connection:
         path = self._path_fn()
         conn = connect(path)
@@ -229,6 +709,7 @@ class StateDB:
             with self._init_lock:
                 if path not in self._initialized_paths:
                     ensure_intent_table(conn)
+                    ensure_lease_table(conn)
                     if self._init_fn is not None:
                         self._init_fn(conn)
                     self._initialized_paths.add(path)
@@ -245,10 +726,16 @@ class StateDB:
 
     @contextlib.contextmanager
     def transaction(self):
-        """Fresh connection, one explicit transaction, closed after."""
+        """Fresh connection, one explicit transaction, closed after.
+
+        Installed fence guards (see :func:`guarded`) are validated
+        INSIDE the transaction, before the body runs: a stale fencing
+        token raises LeaseLostError with zero mutations applied."""
+        path = self._path_fn()
         conn = self.connection()
         try:
             with transaction(conn, site=self.site) as txn:
+                _apply_guards(txn, path)
                 yield txn
         finally:
             conn.close()

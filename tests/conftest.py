@@ -49,8 +49,10 @@ _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
 
 # Crash-recovery round trips (test_crash_recovery.py subprocess cases)
 # drive real local clusters through kill+restart cycles — priced like
-# the chaos suite, at the very end of the fast tier.
-_LATEST_FILES = ('test_crash_recovery.py',)
+# the chaos suite, at the very end of the fast tier. The fleet suite
+# (multi-worker harness runs + subprocess kill-at-crashpoint round
+# trips + the bench fleet smoke) is priced the same way.
+_LATEST_FILES = ('test_crash_recovery.py', 'test_fleet.py')
 
 
 def pytest_collection_modifyitems(config, items):

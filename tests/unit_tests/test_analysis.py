@@ -671,6 +671,63 @@ class TestRawSqliteOutsideStateDB:
             assert [v for v in vs if v.rule == 'STL010'] == [], rel
 
 
+# ---------------------------------------------------------------- STL011
+class TestDirectClockInControlPlane:
+
+    def test_fires_on_time_time_in_jobs(self):
+        vs = lint('''
+            import time
+            def stamp():
+                return time.time()
+            ''', path='skypilot_tpu/jobs/fixture.py')
+        assert rules_of(vs) == ['STL011']
+        assert 'statedb.wall_now' in vs[0].message
+
+    def test_fires_in_serve_and_fleet(self):
+        for pkg in ('serve', 'fleet'):
+            vs = lint('''
+                import time
+                deadline = time.time() + 5
+                ''', path=f'skypilot_tpu/{pkg}/fixture.py')
+            assert rules_of(vs) == ['STL011'], pkg
+
+    def test_fires_on_sqlite_connect_alongside_stl010(self):
+        vs = lint('''
+            import sqlite3
+            conn = sqlite3.connect('/tmp/x.db')
+            ''', path='skypilot_tpu/fleet/fixture.py')
+        assert sorted(rules_of(vs)) == ['STL010', 'STL011']
+
+    def test_quiet_outside_control_plane_dirs(self):
+        assert lint('''
+            import time
+            t0 = time.time()
+            ''', path='skypilot_tpu/models/fixture.py') == []
+
+    def test_quiet_on_wall_now_and_clock_calls(self):
+        assert lint('''
+            from skypilot_tpu.utils import statedb
+
+            def stamp(clock):
+                return statedb.wall_now() + clock.now()
+            ''', path='skypilot_tpu/jobs/fixture.py') == []
+
+    def test_repo_control_plane_is_clean(self):
+        """The converted layers are the rule's motivating examples —
+        targeted canary on top of the repo-wide gate."""
+        for rel in ('jobs/state.py', 'jobs/scheduler.py',
+                    'serve/serve_state.py', 'serve/autoscalers.py',
+                    'serve/replica_managers.py', 'fleet/worker.py',
+                    'fleet/scale_harness.py', 'fleet/synth_cloud.py'):
+            path = os.path.join(_REPO_ROOT, 'skypilot_tpu',
+                                *rel.split('/'))
+            with open(path, encoding='utf-8') as f:
+                vs = analyze_source(f.read(),
+                                    path=f'skypilot_tpu/{rel}',
+                                    project=Project())
+            assert [v for v in vs if v.rule == 'STL011'] == [], rel
+
+
 # ----------------------------------------------------------- suppression
 class TestSuppression:
 

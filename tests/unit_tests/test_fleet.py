@@ -1,0 +1,613 @@
+"""Controller fleet (docs/control_plane.md).
+
+Coverage layers:
+
+- **Lease semantics** on the generic statedb lease table under a
+  FakeClock: claim/renew/release round trips, expiry under clock
+  skew, the double-claim CAS race (two workers, one winner), fencing
+  (a stale owner's guarded write is rejected with ZERO mutations
+  applied), no-expiry controller leases, and the restart-claim paths
+  now implemented on the lease CAS.
+- **FleetWorker on the synthetic cloud**: settle jobs and services,
+  kill a worker mid-run and watch the survivors adopt its leases
+  through the existing reconcile-on-start machinery, preemption
+  recovery under a fleet worker.
+- **Kill-at-crashpoint**: a REAL subprocess worker dies at the
+  ``fleet.worker.renew.mid`` crashpoint (the heartbeat thread's
+  worst instruction), then a second subprocess worker takes over
+  after TTL expiry and settles everything.
+- **Scale harness + bench smoke**: the deterministic smoke variant
+  of ``bench.py fleet`` runs tier-1; the randomized 1k-job sweep is
+  ``slow``.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.fleet import scale_harness
+from skypilot_tpu.fleet import synth_cloud
+from skypilot_tpu.fleet import worker as worker_lib
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import statedb
+from skypilot_tpu.utils.status_lib import ManagedJobStatus
+
+pytestmark = pytest.mark.fleet
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def fleet_state(isolated_state, monkeypatch):
+    """Serve DB isolation on top of the shared isolated_state, plus a
+    guaranteed-clean synthetic cloud slot."""
+    monkeypatch.setenv('SKYTPU_SERVE_DB',
+                       str(isolated_state / 'serve.db'))
+    previous = synth_cloud.install(None)
+    yield isolated_state
+    synth_cloud.install(previous)
+
+
+def _wait(predicate, timeout=30.0, what='condition', gap=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(gap)
+    raise TimeoutError(f'timed out waiting for {what}')
+
+
+def _add_synth_job(name='fjob', run_s=None):
+    del run_s
+    config = {
+        'name': name,
+        'run': 'true',
+        'resources': {
+            'cloud': 'local',
+            'job_recovery': {'strategy': 'SYNTH'},
+        },
+    }
+    return jobs_state.add_job(name=name, task_yaml='',
+                              cluster_name=name, log_path='',
+                              dag_json=json.dumps([config]))
+
+
+def _add_synth_service(name='fsvc', replicas=1):
+    spec = {
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 300},
+        'replica_policy': {'min_replicas': replicas,
+                           'max_replicas': replicas},
+        'replica_port': 9000,
+    }
+    task = {'name': name, 'run': 'true',
+            'resources': {'cloud': 'local'}}
+    serve_state.add_service(name, spec_json=json.dumps(spec),
+                            task_json=json.dumps(task), lb_port=0)
+    return name
+
+
+def _make_worker(name, cloud, *, ttl=2.0, scan_gap=0.05,
+                 check_gap=0.05, service_gap=0.1, concurrency=8,
+                 events=None):
+    synth_cloud.install(cloud)
+    hook = (events.append if events is not None else None)
+    return worker_lib.FleetWorker(
+        name, lease_ttl=ttl, scan_gap=scan_gap,
+        concurrency=concurrency, job_check_gap=check_gap,
+        service_loop_gap=service_gap,
+        job_controller_factory=synth_cloud.job_controller_factory(
+            check_gap),
+        service_manager_factory=synth_cloud.service_manager_factory(),
+        lease_event_hook=hook)
+
+
+# ------------------------------------------------------ lease semantics
+
+
+class TestLeaseTable:
+
+    def _table(self, tmp_path, clock):
+        db = statedb.StateDB(lambda: str(tmp_path / 'leases.db'))
+        return statedb.LeaseTable(db, clock=clock)
+
+    def test_claim_renew_release_roundtrip(self, tmp_path):
+        clock = retry_lib.FakeClock(100.0)
+        table = self._table(tmp_path, clock)
+        table.register(['job:1'])
+        lease = table.try_claim('job:1', 'w1', ttl=5.0)
+        assert lease.fence == 1 and lease.expires_at == 105.0
+        # Owned and unexpired: nobody else can claim.
+        assert table.try_claim('job:1', 'w2', ttl=5.0) is None
+        renewed = table.renew(lease, ttl=5.0)
+        assert renewed.expires_at == 105.0  # clock did not move
+        assert table.release(lease) is True
+        # Released: claimable again, fence keeps increasing.
+        lease2 = table.try_claim('job:1', 'w2', ttl=5.0)
+        assert lease2.fence == 2
+
+    def test_expiry_under_fakeclock_skew(self, tmp_path):
+        """Two workers with skewed clocks: the laggard's claim looks
+        live to itself but expired to the forward-skewed peer — the
+        peer takes over and the laggard's renewal fails (fence)."""
+        slow = retry_lib.FakeClock(100.0)
+        fast = retry_lib.FakeClock(100.0)
+        db = statedb.StateDB(lambda: str(tmp_path / 'leases.db'))
+        table_slow = statedb.LeaseTable(db, clock=slow)
+        table_fast = statedb.LeaseTable(db, clock=fast)
+        table_fast.register(['job:1'])
+        lease = table_fast.try_claim('job:1', 'wslow', ttl=5.0)
+        assert lease is not None
+        fast.advance(60.0)  # skew: fast sees the lease long expired
+        takeover = table_fast.try_claim('job:1', 'wfast', ttl=5.0)
+        assert takeover is not None and takeover.fence == 2
+        # The slow owner still thinks time barely moved — its renewal
+        # must fail on the fencing token, not on its own clock.
+        assert table_slow.renew(lease, ttl=5.0) is None
+        assert table_slow.release(lease) is False
+
+    def test_no_expiry_lease_never_claimable(self, tmp_path):
+        """A classic controller's lease (ttl=None) is not claimable by
+        expiry — only a release or an expect_owner usurp moves it."""
+        clock = retry_lib.FakeClock(0.0)
+        db = statedb.StateDB(lambda: str(tmp_path / 'leases.db'))
+        table = statedb.LeaseTable(db, clock=clock)
+        with db.transaction() as conn:
+            statedb.lease_force_claim(conn, 'ctl:1', 'pid:42',
+                                      clock.now(), ttl=None)
+        clock.advance(10_000.0)
+        assert table.claimable() == []
+        assert table.try_claim('ctl:1', 'w1', ttl=5.0) is None
+        usurped = table.try_claim('ctl:1', 'w1', ttl=5.0,
+                                  expect_owner='pid:42')
+        assert usurped is not None and usurped.fence == 2
+
+    def test_double_claim_race_single_winner(self, tmp_path):
+        """The CAS: N threads race for the same resource; exactly one
+        wins each round."""
+        clock = retry_lib.FakeClock(0.0)
+        table = self._table(tmp_path, clock)
+        table.register(['job:race'])
+        for round_no in range(5):
+            results = [None] * 8
+            barrier = threading.Barrier(8)
+
+            def contend(i):
+                barrier.wait()
+                results[i] = table.try_claim('job:race', f'w{i}',
+                                             ttl=5.0)
+
+            threads = [threading.Thread(target=contend, args=(i,),
+                                        daemon=True)
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            winners = [r for r in results if r is not None]
+            assert len(winners) == 1, results
+            assert winners[0].fence == round_no + 1
+            assert table.release(winners[0])
+
+    def test_stale_fencing_token_write_rejected(self, tmp_path):
+        """The fencing invariant: after a takeover, a guarded write
+        with the OLD lease raises LeaseLostError and applies ZERO
+        mutations (checked inside the same transaction)."""
+        clock = retry_lib.FakeClock(0.0)
+        db = statedb.StateDB(
+            lambda: str(tmp_path / 'leases.db'),
+            init_fn=lambda conn: conn.execute(
+                'CREATE TABLE IF NOT EXISTS t (x INTEGER)'))
+        table = statedb.LeaseTable(db, clock=clock)
+        table.register(['job:1'])
+        stale = table.try_claim('job:1', 'w1', ttl=5.0)
+        clock.advance(10.0)
+        assert table.try_claim('job:1', 'w2', ttl=5.0) is not None
+        with pytest.raises(statedb.LeaseLostError):
+            with statedb.guarded(table.guard(stale)):
+                with db.transaction() as conn:
+                    conn.execute('INSERT INTO t VALUES (1)')
+        with db.reader() as conn:
+            rows = conn.execute('SELECT COUNT(*) AS n FROM t')
+            assert rows.fetchone()['n'] == 0
+
+    def test_guard_revoke_fails_fast_without_db(self, tmp_path):
+        clock = retry_lib.FakeClock(0.0)
+        table = self._table(tmp_path, clock)
+        table.register(['job:1'])
+        lease = table.try_claim('job:1', 'w1', ttl=5.0)
+        guard = table.guard(lease)
+        guard.revoke()
+        with pytest.raises(statedb.LeaseLostError):
+            guard.validate()
+
+    def test_validate_guards_checkpoint(self, tmp_path):
+        """Non-statedb side effects (the synthetic cloud) use the
+        explicit checkpoint; it must see takeovers too."""
+        clock = retry_lib.FakeClock(0.0)
+        table = self._table(tmp_path, clock)
+        table.register(['job:1'])
+        lease = table.try_claim('job:1', 'w1', ttl=5.0)
+        with statedb.guarded(table.guard(lease)):
+            statedb.validate_guards()  # still current: no raise
+            clock.advance(10.0)
+            assert table.try_claim('job:1', 'w2', ttl=5.0) is not None
+            with pytest.raises(statedb.LeaseLostError):
+                statedb.validate_guards()
+
+    def test_claimable_ordering_abandoned_before_fresh(self, tmp_path):
+        clock = retry_lib.FakeClock(0.0)
+        table = self._table(tmp_path, clock)
+        table.register(['job:a', 'job:b', 'job:fresh'])
+        table.try_claim('job:b', 'w1', ttl=1.0)
+        clock.advance(0.5)
+        table.try_claim('job:a', 'w1', ttl=1.0)
+        clock.advance(5.0)
+        # Expired (abandoned) leases first, oldest expiry first; the
+        # never-claimed row last — a dead peer's in-flight work is
+        # adopted before fresh work.
+        assert table.claimable('job:') == ['job:b', 'job:a',
+                                           'job:fresh']
+
+
+class TestRestartClaimOnLeases:
+    """`try_claim_controller_restart` now rides the generic lease CAS
+    (satellite: the bespoke pid-CAS is gone)."""
+
+    def _job(self):
+        return _add_synth_job('rjob')
+
+    def test_set_controller_pid_claims_lease(self):
+        job_id = self._job()
+        jobs_state.set_controller_pid(job_id, 111)
+        table = statedb.LeaseTable(jobs_state.db())
+        row = table.get(jobs_state.controller_resource(job_id))
+        assert row['owner'] == 'pid:111' and row['fence'] == 1
+        assert row['expires_at'] is None  # no-heartbeat ownership
+        jobs_state.set_controller_pid(job_id, 222)
+        row = table.get(jobs_state.controller_resource(job_id))
+        assert row['owner'] == 'pid:222' and row['fence'] == 2
+
+    def test_claim_then_racers_lose(self):
+        job_id = self._job()
+        jobs_state.set_controller_pid(job_id, 111)
+        outcome, n = jobs_state.try_claim_controller_restart(
+            job_id, 111, limit=3)
+        assert (outcome, n) == ('claimed', 1)
+        # The claim moved the lease to the relauncher: a second racer
+        # observing the SAME dead pid loses inside the claim->spawn
+        # window (the window the old pid-CAS left open).
+        outcome, n = jobs_state.try_claim_controller_restart(
+            job_id, 111, limit=3)
+        assert outcome == 'lost'
+        # The spawned controller force-claims over the relauncher.
+        jobs_state.set_controller_pid(job_id, 222)
+        outcome, _ = jobs_state.try_claim_controller_restart(
+            job_id, 111, limit=3)
+        assert outcome == 'lost'
+
+    def test_exhausted_budget(self):
+        job_id = self._job()
+        for attempt in range(3):
+            pid = 100 + attempt
+            jobs_state.set_controller_pid(job_id, pid)
+            outcome, n = jobs_state.try_claim_controller_restart(
+                job_id, pid, limit=3)
+            assert (outcome, n) == ('claimed', attempt + 1)
+        jobs_state.set_controller_pid(job_id, 999)
+        outcome, n = jobs_state.try_claim_controller_restart(
+            job_id, 999, limit=3)
+        assert (outcome, n) == ('exhausted', 3)
+
+    def test_pre_lease_db_falls_back_to_row_pid(self):
+        """Migration path: a DB written before the lease table had
+        rows — the row pid is the only truth; the claim seeds the
+        lease so later racers hit the CAS."""
+        job_id = self._job()
+        with jobs_state.db().transaction() as conn:
+            conn.execute(
+                'UPDATE jobs SET controller_pid = 111 WHERE job_id = ?',
+                (job_id,))
+        outcome, n = jobs_state.try_claim_controller_restart(
+            job_id, 111, limit=3)
+        assert (outcome, n) == ('claimed', 1)
+        table = statedb.LeaseTable(jobs_state.db())
+        row = table.get(jobs_state.controller_resource(job_id))
+        assert row['owner'].startswith('relauncher:')
+
+    def test_serve_controller_pid_claims_lease(self):
+        name = _add_synth_service('psvc')
+        serve_state.set_service_controller_pid(name, 314)
+        table = statedb.LeaseTable(serve_state.db())
+        row = table.get(serve_state.controller_resource(name))
+        assert row['owner'] == 'pid:314' and row['fence'] == 1
+
+
+# -------------------------------------------- fleet worker + synth cloud
+
+
+class TestFleetWorkerSynth:
+
+    def test_single_worker_settles_jobs_and_service(self):
+        cloud = synth_cloud.SyntheticCloud(job_run_s=0.1,
+                                           replica_ready_s=0.05)
+        for i in range(4):
+            _add_synth_job(f'fjob-{i}')
+        _add_synth_service('fsvc', replicas=2)
+        worker = _make_worker('w0', cloud)
+        worker.start()
+        try:
+            _wait(lambda: all(
+                s.is_terminal()
+                for s in jobs_state.job_statuses().values()),
+                timeout=30, what='jobs terminal')
+            assert all(s is ManagedJobStatus.SUCCEEDED
+                       for s in jobs_state.job_statuses().values())
+            _wait(lambda: (serve_state.get_service('fsvc') or {}).get(
+                'status') is ServiceStatus.READY,
+                timeout=30, what='service READY')
+
+            def _teardown_done():
+                record = serve_state.get_service('fsvc')
+                if record is None:
+                    return True
+                if record['status'] is not ServiceStatus.SHUTTING_DOWN:
+                    # Keep re-marking: the worker may have written
+                    # READY over the first mark (benign race the
+                    # harness handles the same way).
+                    serve_state.set_service_status(
+                        'fsvc', ServiceStatus.SHUTTING_DOWN)
+                return False
+
+            _wait(_teardown_done, timeout=30, what='service removed')
+        finally:
+            worker.stop()
+        assert cloud.live_clusters() == []
+        assert jobs_state.open_intents() == []
+        assert serve_state.open_intents() == []
+        assert worker.settled['job'] == 4
+        assert worker.settled['service'] == 1
+
+    def test_worker_kill_takeover_and_fencing(self):
+        """Kill the only worker mid-run: a second worker adopts its
+        leases after expiry (fence bumped) and settles everything;
+        the dead worker's stale lease cannot write."""
+        cloud = synth_cloud.SyntheticCloud(job_run_s=0.8)
+        for i in range(3):
+            _add_synth_job(f'kjob-{i}')
+        events = []
+        w1 = _make_worker('w1', cloud, ttl=1.0, events=events)
+        w1.start()
+        _wait(lambda: len(w1.held()) >= 3, timeout=20,
+              what='w1 claims all jobs')
+        held = w1.held()
+        w1.kill()
+        w2 = _make_worker('w2', cloud, ttl=1.0, events=events)
+        w2.start()
+        try:
+            _wait(lambda: all(
+                s.is_terminal()
+                for s in jobs_state.job_statuses().values()),
+                timeout=40, what='takeover settles jobs')
+        finally:
+            w2.stop()
+        assert all(s is ManagedJobStatus.SUCCEEDED
+                   for s in jobs_state.job_statuses().values())
+        table = statedb.LeaseTable(jobs_state.db())
+        for resource, (_kind, _ident, stale) in held.items():
+            row = table.get(resource)
+            # The successor bumped the fence; once it settled the job
+            # it retired the row entirely (None) — either way the
+            # victim's handle is dead.
+            assert row is None or row['fence'] > stale.fence, resource
+            # Fencing: the dead worker's handle is rejected with zero
+            # mutations.
+            with pytest.raises(statedb.LeaseLostError):
+                with statedb.guarded(table.guard(stale)):
+                    with jobs_state.db().transaction():
+                        pass
+        assert cloud.live_clusters() == []
+        assert jobs_state.open_intents() == []
+        # Takeover claims are visible in the event log: some claim
+        # with fence >= 2 and no release between.
+        claim_fences = [e[3] for e in events if e[0] == 'claim']
+        assert max(claim_fences) >= 2
+
+    def test_preemption_recovery_under_worker(self):
+        cloud = synth_cloud.SyntheticCloud(job_run_s=1.5)
+        job_id = _add_synth_job('pjob')
+        worker = _make_worker('w0', cloud)
+        worker.start()
+        try:
+            _wait(lambda: cloud.live_clusters('pjob'), timeout=20,
+                  what='cluster up')
+            assert cloud.preempt('pjob')
+            _wait(lambda: jobs_state.job_statuses()[job_id]
+                  .is_terminal(), timeout=40, what='job recovers')
+        finally:
+            worker.stop()
+        record = jobs_state.get_job(job_id)
+        assert record['status'] is ManagedJobStatus.SUCCEEDED
+        assert record['recovery_count'] >= 1
+        assert cloud.preemptions == 1
+        assert cloud.live_clusters() == []
+
+
+# ------------------------------------- kill-at-crashpoint mid-renewal
+
+
+def _worker_cmd(name, extra):
+    return [
+        sys.executable, '-u', '-m', 'skypilot_tpu.fleet.worker',
+        '--name', name, '--synth', '--ttl', '1.5',
+        '--scan-gap', '0.1', '--check-gap', '0.1',
+        '--service-gap', '0.1',
+    ] + extra
+
+
+def _worker_env():
+    env = dict(os.environ)
+    existing = env.get('PYTHONPATH', '')
+    if _REPO_ROOT not in existing.split(os.pathsep):
+        env['PYTHONPATH'] = _REPO_ROOT + (
+            os.pathsep + existing if existing else '')
+    return env
+
+
+class TestWorkerCrashpoints:
+
+    def test_kill_at_renewal_crashpoint_then_takeover(self, tmp_path):
+        """A REAL worker process dies at fleet.worker.renew.mid (the
+        heartbeat's worst instruction: the lease looks healthy for
+        almost a full TTL). A second worker process takes the expired
+        leases over and settles the jobs — the at-any-point crash
+        contract extended to the fleet layer."""
+        for i in range(2):
+            _add_synth_job(f'cjob-{i}')
+        record = tmp_path / 'faults.jsonl'
+        plan = {
+            'seed': 0,
+            'record': str(record),
+            'faults': [{
+                'site': 'fleet.worker.renew.mid',
+                'kind': 'crash',
+                'after': 1,
+                'times': 1,
+            }],
+        }
+        env = _worker_env()
+        env['SKYTPU_FAULT_PLAN'] = json.dumps(plan)
+        proc = subprocess.run(
+            _worker_cmd('crashw', ['--job-run-s', '2.0',
+                                   '--deadline', '30']),
+            env=env, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == fault_injection.CRASH_EXIT_CODE, (
+            proc.stdout, proc.stderr)
+        assert record.exists()
+        injected = [json.loads(line)
+                    for line in record.read_text().splitlines()]
+        assert [f['site'] for f in injected] == [
+            'fleet.worker.renew.mid']
+        # The dead worker's leases are still owned (no cleanup ran)
+        # and the jobs are mid-flight.
+        table = statedb.LeaseTable(jobs_state.db())
+        owned = [r for r in table.snapshot('jobs.controller:')
+                 if r['owner'] and 'crashw' in r['owner']]
+        assert owned, table.snapshot()
+        statuses = jobs_state.job_statuses()
+        assert any(not s.is_terminal() for s in statuses.values())
+        dead_fences = {r['resource']: r['fence'] for r in owned}
+
+        # Phase 2: a fresh worker process (fresh synthetic cloud —
+        # cluster truth died with the crash, exactly like a zone
+        # wipe) expires the leases and settles via relaunch.
+        env2 = _worker_env()
+        env2.pop('SKYTPU_FAULT_PLAN', None)
+        proc2 = subprocess.run(
+            _worker_cmd('healw', ['--job-run-s', '0.2',
+                                  '--run-until-settled',
+                                  '--deadline', '60']),
+            env=env2, capture_output=True, text=True, timeout=90)
+        assert proc2.returncode == 0, (proc2.stdout, proc2.stderr)
+        report = json.loads(
+            [ln for ln in proc2.stdout.splitlines()
+             if ln.startswith('{')][-1])
+        assert report['settled']['job'] >= 1
+        statuses = jobs_state.job_statuses()
+        assert all(s is ManagedJobStatus.SUCCEEDED
+                   for s in statuses.values())
+        for resource, fence in dead_fences.items():
+            row = table.get(resource)
+            assert row is None or row['fence'] > fence, resource
+        assert jobs_state.open_intents() == []
+
+
+# ----------------------------------------------- harness + bench smoke
+
+
+class TestScaleHarness:
+
+    def test_smoke_plan_settles_with_kill_and_fencing(self):
+        plan = scale_harness.FleetPlan(
+            jobs=10, services=2, replicas_per_service=2, workers=3,
+            kill_workers=1, kill_after_settled_jobs=2,
+            kill_after_s=1.0, preempt_jobs=1, preempt_replicas=1,
+            # Short TTL so renewal sweeps (TTL/3) land inside this
+            # smoke run's few seconds — the renewals>0 assertion
+            # below is the point.
+            lease_ttl_s=1.0,
+            job_run_s=0.3, deadline_s=90.0, seed=3)
+        report = scale_harness.run_fleet_harness(plan)
+        assert report['ok'], report
+        assert report['jobs']['settled'] == 10
+        assert report['services']['settled'] == 2
+        assert len(report['kills']) == 1
+        kill = report['kills'][0]
+        assert kill['stale_write_rejected'] is True
+        assert kill['time_to_reconcile_s'] is not None
+        assert report['lease']['fence_violations'] == 0
+        assert report['invariants']['orphan_clusters'] == []
+        assert report['invariants']['open_intents'] == 0
+        assert report['lease']['claims'] > 0
+        assert report['lease']['renewals'] > 0
+
+    @pytest.mark.slow
+    def test_full_scale_sweep_1k_jobs(self):
+        """The acceptance-scale randomized sweep: 1000 jobs, 100
+        services, 4 workers, worker kill + seeded preemptions."""
+        plan = scale_harness.FleetPlan(
+            jobs=1000, services=100, replicas_per_service=2,
+            workers=4, kill_workers=1, kill_after_settled_jobs=50,
+            preempt_jobs=10, preempt_replicas=5, seed=42,
+            deadline_s=540.0)
+        report = scale_harness.run_fleet_harness(plan)
+        assert report['ok'], report['invariants']
+        assert report['jobs']['settled'] == 1000
+        assert report['services']['settled'] == 100
+        assert report['kills'][0]['stale_write_rejected'] is True
+
+
+class TestBenchFleetSmoke:
+
+    def test_bench_fleet_smoke_subprocess(self, tmp_path):
+        """`bench.py fleet` smoke: the deterministic tier-1 variant
+        of the acceptance path (synthetic cloud, seeded fault plan,
+        worker kill, invariants in the emitted JSON)."""
+        env = _worker_env()
+        env.update({
+            'BENCH_SMOKE': '1',
+            'JAX_PLATFORMS': 'cpu',
+            'BENCH_FLEET_JOBS': '10',
+            'BENCH_FLEET_SERVICES': '2',
+            'BENCH_FLEET_WORKERS': '3',
+            'BENCH_FLEET_DEADLINE_S': '90',
+        })
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO_ROOT, 'bench.py'),
+             'fleet'],
+            env=env, capture_output=True, text=True, timeout=150)
+        assert proc.returncode == 0, (proc.stdout[-2000:],
+                                      proc.stderr[-2000:])
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith('{')][-1]
+        result = json.loads(line)
+        assert result['metric'] == 'fleet_jobs_per_s'
+        assert result['vs_baseline'] == 1.0
+        detail = result['detail']
+        assert detail['ok'] is True
+        assert detail['jobs']['settled'] == 10
+        assert detail['workers'] == 3
+        assert len(detail['kills']) == 1
+        assert detail['kills'][0]['stale_write_rejected'] is True
+        assert detail['invariants']['orphan_clusters'] == []
+        assert detail['invariants']['fence_violations'] == 0
