@@ -1013,10 +1013,12 @@ def serve_stack_bench():
             while True:  # readiness (engine warmup)
                 try:
                     async with session.get(
-                            'http://127.0.0.1:18800/health') as r:
+                            'http://127.0.0.1:18800/health',
+                            timeout=aiohttp.ClientTimeout(
+                                total=5)) as r:
                         if r.status == 200:
                             break
-                except aiohttp.ClientError:
+                except (aiohttp.ClientError, asyncio.TimeoutError):
                     pass
                 await asyncio.sleep(0.5)
 
@@ -1080,6 +1082,258 @@ def serve_stack_bench():
     if trace_file:
         result['detail']['trace_file'] = trace_file
     print(json.dumps(result))
+
+
+def serve_chaos_bench():
+    """Replica-failure survivability bench (docs/failover.md): the
+    same seeded open-loop trace replayed twice through a real
+    LB -> replica-subprocess stack — once clean (the baseline), once
+    with a seeded schedule of real ``SIGKILL``s against replica
+    processes mid-run. The headline is goodput-under-chaos over the
+    same-seed no-chaos goodput: the fraction of SLO-attaining
+    throughput that survives losing replicas, with circuit breakers
+    ejecting the dead ones on first failure, TTFT hedging racing
+    slow first tokens, and greedy streams resumed (bitwise parity vs
+    the baseline run's uninterrupted token streams is asserted —
+    zero duplicated, zero dropped tokens).
+
+    Replicas always run on CPU (tiny model, tick pace stretched via
+    the ``engine.tick.hang`` chaos site so streams span wall-clock
+    time in BOTH runs): the measured article is the failover
+    machinery, not the chip. Same BENCH_CHAOS_SEED => byte-identical
+    trace and kill schedule.
+    """
+    import asyncio
+    import signal
+    import subprocess
+    import tempfile
+
+    from skypilot_tpu import loadgen
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import fault_injection
+
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    n_replicas = max(2, int(os.environ.get('BENCH_CHAOS_REPLICAS',
+                                           '2')))
+    n_kills = max(1, min(int(os.environ.get('BENCH_CHAOS_KILLS', '1')),
+                         n_replicas - 1))
+    seed = int(os.environ.get('BENCH_CHAOS_SEED', '0'))
+    min_ratio = float(os.environ.get('BENCH_CHAOS_MIN_RATIO', '0.9'))
+    n_requests = int(os.environ.get('BENCH_LOAD_REQUESTS',
+                                    '16' if smoke else '48'))
+    qps = float(os.environ.get('BENCH_LOAD_QPS',
+                               '6' if smoke else '8'))
+    slo = loadgen.SLO(
+        ttft_s=float(os.environ.get('BENCH_LOAD_SLO_TTFT', '10')),
+        itl_p99_s=float(os.environ.get('BENCH_LOAD_SLO_ITL', '5')))
+    # Replica shape: prompt_max + output_max <= max_prompt, so a
+    # resumed prompt (prompt + tokens-emitted-so-far) always fits the
+    # replica's prompt region and resumes never 400.
+    max_prompt, max_seq = 96, 128
+    spec = loadgen.WorkloadSpec(
+        seed=seed, n_requests=n_requests, qps=qps, arrival='poisson',
+        vocab_size=256,                  # LlamaConfig.tiny vocab
+        prompt_median=16, prompt_min=4, prompt_max=40,
+        output_median=14, output_sigma=0.3, output_min=8,
+        output_max=24)
+    trace = loadgen.generate(spec)
+    trace_digest = loadgen.digest(trace)
+    by_id = {r.request_id: r for r in trace}
+    span = max(r.arrival_s for r in trace)
+    schedule = loadgen.seeded_kill_schedule(
+        seed, n_kills, n_replicas,
+        t_min=0.25 * span, t_max=0.75 * span)
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-chaos-')
+    kill_record = os.path.join(tmp, 'kills.jsonl')
+    # Stretch every engine tick via the hang chaos site so token
+    # streams span wall-clock time (a tiny CPU model would otherwise
+    # finish a stream in milliseconds and no kill could land
+    # mid-stream). Applied identically to BOTH runs: the baseline
+    # pays the same tick tax, so the ratio isolates the kills.
+    replica_plan = json.dumps({'faults': [
+        {'site': 'engine.tick.hang', 'kind': 'hang', 'times': None,
+         'params': {'seconds': 0.05}}]})
+    base_port = int(os.environ.get('SKYTPU_SERVE_PORT', '19321'))
+
+    def spawn(i):
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        env['SKYTPU_FAULT_PLAN'] = replica_plan
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        log = open(os.path.join(tmp, f'replica{i}.log'), 'wb')
+        return subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.models.serving_http',
+             '--port', str(base_port + i), '--model', 'tiny',
+             '--batch', '4', '--max-prompt', str(max_prompt),
+             '--max-seq', str(max_seq), '--decode-chunk', '1',
+             '--prefill-chunk', '16', '--prefill-budget', '32',
+             '--max-pending', '64'],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+
+    procs = {i: spawn(i) for i in range(n_replicas)}
+    urls = {i: f'http://127.0.0.1:{base_port + i}'
+            for i in range(n_replicas)}
+
+    def kill_replica(i):
+        p = procs.get(i)
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
+
+    def counter_sum(summary, name):
+        return sum(v for k, v in summary.items()
+                   if k == name or k.startswith(name + '{'))
+
+    async def wait_ready():
+        import aiohttp
+        deadline = time.time() + 240
+        async with aiohttp.ClientSession() as s:
+            for url in urls.values():
+                while True:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f'replica {url} never became ready')
+                    try:
+                        async with s.get(
+                                url + '/health',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=2)) as r:
+                            if r.status == 200:
+                                break
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError, OSError):
+                        pass
+                    await asyncio.sleep(0.25)
+
+    async def run_round(chaos):
+        lb = LoadBalancer(port=0, policy='least_load')
+        await lb.start()
+        lb.set_replica_urls(list(urls.values()))
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        kills = 0
+        if chaos:
+            records, wall, kills = \
+                await loadgen.replay_http_chaos_async(
+                    base, trace, schedule, kill_replica,
+                    timeout_s=240, keep_tokens=True)
+        else:
+            records, wall = await loadgen.replay_http_async(
+                base, trace, timeout_s=240, keep_tokens=True)
+        await lb.stop()
+        return records, wall, kills
+
+    try:
+        asyncio.run(wait_ready())
+        with _bench_span('serve_chaos', replicas=n_replicas,
+                         kills=n_kills, requests=n_requests):
+            base_records, base_wall, _ = asyncio.run(
+                run_round(chaos=False))
+            base_report = loadgen.score(base_records, slo, base_wall)
+            pre = metrics_lib.summary()
+            with fault_injection.fault_plan(
+                    faults=[{'site': 'serve.replica.kill',
+                             'kind': 'crash', 'times': None}],
+                    record=kill_record):
+                chaos_records, chaos_wall, kills = asyncio.run(
+                    run_round(chaos=True))
+            chaos_report = loadgen.score(chaos_records, slo,
+                                         chaos_wall)
+            post = metrics_lib.summary()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    # Greedy-parity oracle: the baseline run IS the uninterrupted
+    # stream for every request — a resumed chaos stream must be
+    # bitwise identical to it (zero duplicated / dropped tokens).
+    base_tokens = {r.request_id: r.tokens for r in base_records
+                   if r.status == 'finished' and r.tokens is not None}
+    checked = mismatched = 0
+    for rec in chaos_records:
+        if not rec.resumed or rec.status != 'finished':
+            continue
+        oracle = base_tokens.get(rec.request_id)
+        if oracle is None:
+            continue
+        checked += 1
+        if rec.tokens != oracle:
+            mismatched += 1
+            print(f'# PARITY MISMATCH request {rec.request_id}: '
+                  f'chaos={rec.tokens} oracle={oracle}',
+                  file=sys.stderr)
+    # Token budgets are exact under greedy-no-EOS, so dropped/dup
+    # tokens also show as a length mismatch on ANY finished stream.
+    length_bad = sum(
+        1 for rec in chaos_records
+        if rec.status == 'finished' and rec.tokens is not None and
+        len(rec.tokens) != by_id[rec.request_id].max_new)
+
+    delta = {name: counter_sum(post, name) - counter_sum(pre, name)
+             for name in ('skytpu_lb_breaker_trips_total',
+                          'skytpu_lb_breaker_recoveries_total',
+                          'skytpu_lb_resumed_streams_total',
+                          'skytpu_lb_resume_failures_total')}
+    hedge_delta = {
+        outcome: (counter_sum(
+            post, f'skytpu_lb_hedges_total{{outcome="{outcome}"}}') -
+            counter_sum(
+                pre,
+                f'skytpu_lb_hedges_total{{outcome="{outcome}"}}'))
+        for outcome in ('won', 'lost', 'failed')}
+    # Robust denominator: an idle smoke trace can score ~0 goodput
+    # in both runs; fall back to completion ratio.
+    base_good = base_report['goodput_req_s']
+    ratio = (chaos_report['goodput_req_s'] / base_good
+             if base_good > 0 else
+             (1.0 if chaos_report['goodput_req_s'] ==
+              base_report['goodput_req_s'] else 0.0))
+    ok = (ratio >= min_ratio and mismatched == 0 and length_bad == 0
+          and kills >= 1)
+    result = {
+        'metric': 'llama_serve_chaos_goodput_ratio',
+        'value': round(ratio, 4),
+        'unit': 'chaos/baseline goodput',
+        'vs_baseline': round(ratio, 4),
+        'detail': {
+            'ok': ok,
+            'seed': seed,
+            'replicas': n_replicas,
+            'kills_scheduled': len(schedule),
+            'kills_executed': kills,
+            'kill_schedule': [{'at_s': round(e.at_s, 4),
+                               'replica': e.replica}
+                              for e in schedule],
+            'kill_record': kill_record,
+            'trace_sha256': trace_digest,
+            'schedule_head_s': [round(r.arrival_s, 6)
+                                for r in trace[:8]],
+            'min_ratio': min_ratio,
+            'baseline': base_report,
+            'chaos': chaos_report,
+            'breaker_trips':
+                delta['skytpu_lb_breaker_trips_total'],
+            'breaker_recoveries':
+                delta['skytpu_lb_breaker_recoveries_total'],
+            'streams_resumed':
+                delta['skytpu_lb_resumed_streams_total'],
+            'resume_failures':
+                delta['skytpu_lb_resume_failures_total'],
+            'hedges': hedge_delta,
+            'resume_parity': {'checked': checked,
+                              'mismatched': mismatched,
+                              'length_mismatches': length_bad},
+            'metrics': metrics_lib.summary(),
+        },
+    }
+    merged = _merged_trace_path()
+    if merged:
+        result['detail']['span_trace_file'] = merged
+    print(json.dumps(result))
+    return 0 if ok else 1
 
 
 # One subprocess per mode: every bench assumes a fresh chip (HBM
@@ -1202,6 +1456,11 @@ _ALL_MODES = {
     # arrivals at ~capacity, scored against TTFT/ITL SLOs — the
     # round's SLO-attainment number next to its raw req/s.
     'serve_load': {'BENCH_MODE': 'serve_load'},
+    # Replica-failure survivability (docs/failover.md): seeded
+    # SIGKILLs against replica subprocesses mid-trace; goodput under
+    # chaos vs the same-seed clean run, breaker/hedge/resume counts,
+    # greedy-parity of resumed streams. CPU replicas — no device.
+    'serve_chaos': {'BENCH_MODE': 'serve_chaos'},
     # Control-plane scale (docs/control_plane.md): lease-fleet
     # throughput on the synthetic cloud — jobs/s settled,
     # time-to-reconcile after a worker kill, lease churn. No device.
@@ -1405,15 +1664,18 @@ if __name__ == '__main__':
     _trace_mod.set_component(f'bench.{mode}')
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
-    # same thing); other modes probe in-process. 'fleet' never
-    # touches a device (pure control plane), so a dead TPU tunnel
-    # must not kill its round.
-    if mode != 'fleet':
+    # same thing); other modes probe in-process. 'fleet' and
+    # 'serve_chaos' never touch a device (pure control plane / CPU
+    # replica subprocesses), so a dead TPU tunnel must not kill
+    # their rounds.
+    if mode not in ('fleet', 'serve_chaos'):
         _device_watchdog(float(os.environ.get(
             'BENCH_DEVICE_TIMEOUT',
             '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
     if mode == 'fleet':
         sys.exit(fleet_bench())
+    if mode == 'serve_chaos':
+        sys.exit(serve_chaos_bench())
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':

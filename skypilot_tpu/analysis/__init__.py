@@ -1,7 +1,7 @@
 """skytpu-lint: repo-native static analysis (docs/static_analysis.md).
 
 A dependency-free AST lint pass encoding this repo's cross-cutting
-invariants as rules STL001–STL010 (exception hygiene, RetryPolicy
+invariants as rules STL001–STL012 (exception hygiene, RetryPolicy
 discipline, daemon-thread explicitness, a heuristic race detector,
 the SKYTPU_*/BENCH_* env registry, metric-registration hygiene,
 fault-injection site names, JAX recompile/tracer hazards), with

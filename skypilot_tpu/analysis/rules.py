@@ -1,4 +1,4 @@
-"""skytpu-lint rule catalog (STL001–STL011).
+"""skytpu-lint rule catalog (STL001–STL012).
 
 Each rule encodes one repo invariant that used to be enforced only at
 runtime or by convention; docs/static_analysis.md carries the full
@@ -846,6 +846,60 @@ class DirectClockInControlPlane(Rule):
                        span=(node.lineno, node.lineno))
 
 
+class HttpCallWithoutTimeout(Rule):
+    """STL012: an outbound HTTP client call without ``timeout=``.
+
+    Every intra-stack HTTP call — readiness probes, drain requests,
+    cancel broadcasts, metrics scrapes, cloud REST calls — must carry
+    an explicit bounded timeout: a peer that accepts the TCP connect
+    and then goes silent would otherwise hang the calling thread (a
+    probe loop, a teardown thread, the provisioner) indefinitely,
+    which is exactly the failure mode the replica-survivability layer
+    (docs/failover.md) exists to bound. Matched call shapes:
+    ``requests.<verb>(...)``, ``<...>session.<verb>(...)`` /
+    ``<...>_session.<verb>(...)`` (requests.Session and
+    aiohttp.ClientSession alike), and ``urlopen(...)``. Calls that
+    deliberately ride a session-level ``ClientTimeout`` (the serve
+    LB's pooled streaming session) suppress with a reason.
+    """
+
+    id = 'STL012'
+    name = 'http-timeout'
+    severity = 'error'
+    help = ('HTTP client call without an explicit timeout= argument: '
+            'a silent peer hangs the calling thread forever. Pass a '
+            'bounded (connect, read) tuple (requests) or '
+            'aiohttp.ClientTimeout, or suppress with a reason when a '
+            'session-level timeout is the deliberate bound.')
+    node_types = (ast.Call,)
+
+    _VERBS = ('get', 'post', 'put', 'delete', 'head', 'patch',
+              'request')
+
+    def check(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        dotted = core.call_name(node)
+        if not dotted:
+            return
+        parts = dotted.split('.')
+        verb = parts[-1]
+        is_http = False
+        if verb == 'urlopen':
+            is_http = True
+        elif verb in self._VERBS and len(parts) >= 2:
+            base = parts[-2]
+            is_http = (base == 'requests' or 'session' in base.lower())
+        if not is_http:
+            return
+        if any(kw.arg == 'timeout' for kw in node.keywords):
+            return
+        ctx.report(self, node,
+                   f'HTTP client call {dotted}() without timeout=: '
+                   'a silent peer hangs this thread forever — pass '
+                   'a bounded (connect, read) timeout',
+                   span=(node.lineno, node.lineno))
+
+
 def default_rules() -> List[Rule]:
     """Fresh rule instances (STL007/STL009 keep per-run state)."""
     return [
@@ -860,6 +914,7 @@ def default_rules() -> List[Rule]:
         BlockingSignalHandler(),
         RawSqliteOutsideStateDB(),
         DirectClockInControlPlane(),
+        HttpCallWithoutTimeout(),
     ]
 
 

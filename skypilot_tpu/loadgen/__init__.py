@@ -16,9 +16,14 @@ The measurement backbone for "faster at scale" claims
   attainment fractions, latency percentile tables and
   shed/expired/cancelled breakdowns.
 """
+from skypilot_tpu.loadgen.replay import KillEvent
 from skypilot_tpu.loadgen.replay import replay_engine
 from skypilot_tpu.loadgen.replay import replay_http
 from skypilot_tpu.loadgen.replay import replay_http_async
+from skypilot_tpu.loadgen.replay import replay_http_chaos
+from skypilot_tpu.loadgen.replay import replay_http_chaos_async
+from skypilot_tpu.loadgen.replay import run_kill_schedule
+from skypilot_tpu.loadgen.replay import seeded_kill_schedule
 from skypilot_tpu.loadgen.score import RequestRecord
 from skypilot_tpu.loadgen.score import SLO
 from skypilot_tpu.loadgen.score import score
@@ -32,8 +37,10 @@ from skypilot_tpu.loadgen.workload import load_jsonl_path
 from skypilot_tpu.loadgen.workload import to_jsonl
 
 __all__ = [
-    'RequestRecord', 'SLO', 'TraceRequest', 'WorkloadSpec', 'digest',
-    'dump_jsonl', 'generate', 'load_jsonl', 'load_jsonl_path',
-    'replay_engine', 'replay_http', 'replay_http_async', 'score',
-    'to_jsonl',
+    'KillEvent', 'RequestRecord', 'SLO', 'TraceRequest',
+    'WorkloadSpec', 'digest', 'dump_jsonl', 'generate', 'load_jsonl',
+    'load_jsonl_path', 'replay_engine', 'replay_http',
+    'replay_http_async', 'replay_http_chaos',
+    'replay_http_chaos_async', 'run_kill_schedule', 'score',
+    'seeded_kill_schedule', 'to_jsonl',
 ]

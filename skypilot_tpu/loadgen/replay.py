@@ -17,19 +17,92 @@ can never show. Two drivers share one record shape:
 
 Both return ``(records, wall_s)`` ready for :func:`loadgen.score.
 score`.
+
+Chaos replay (docs/failover.md): :func:`seeded_kill_schedule` turns a
+seed into trace-relative replica SIGKILL times, and
+:func:`replay_http_chaos` runs the open-loop HTTP replay with that
+schedule executing concurrently — each kill flows through the
+``serve.replica.kill`` fault site, so an armed fault plan can record
+(or veto) individual kills with the usual cross-process receipts.
+``bench.py serve_chaos`` scores the run against a same-seed no-chaos
+baseline.
 """
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import json
+import random
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
 
 from skypilot_tpu.loadgen.score import RequestRecord
 from skypilot_tpu.loadgen.workload import TraceRequest
+from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class KillEvent:
+    """One scheduled replica kill: WHEN (offset seconds from replay
+    start, the trace's own clock) and WHICH replica (index into the
+    harness's replica list)."""
+    at_s: float
+    replica: int
+
+
+def seeded_kill_schedule(seed: int, n_kills: int, n_replicas: int,
+                         t_min: float, t_max: float
+                         ) -> List[KillEvent]:
+    """Deterministic kill schedule: ``n_kills`` distinct replicas
+    (clamped so at least one survivor remains) at seeded times inside
+    ``[t_min, t_max]`` — mid-run, where streams are in flight. Same
+    seed => same times and same targets, the chaos bench's
+    determinism receipt."""
+    n_kills = max(0, min(n_kills, n_replicas - 1))
+    rng = random.Random(seed)
+    targets = rng.sample(range(n_replicas), n_kills)
+    span = max(0.0, t_max - t_min)
+    events = [KillEvent(at_s=t_min + rng.random() * span, replica=t)
+              for t in targets]
+    return sorted(events, key=lambda e: (e.at_s, e.replica))
+
+
+async def run_kill_schedule(schedule: Sequence[KillEvent],
+                            kill_fn: Callable[[int], None],
+                            executed: Optional[List[KillEvent]] = None
+                            ) -> int:
+    """Execute a kill schedule on the running event loop's clock.
+    Each kill polls the ``serve.replica.kill`` fault site first: with
+    an armed plan, only a fired CRASH spec kills (so a plan can veto
+    or count kills, and the record file proves what was killed
+    where); with no plan the schedule is authoritative. Returns the
+    number of kills executed; ``executed`` (if given) accumulates
+    them AS they happen, so a caller that cancels this coroutine
+    mid-schedule still sees the kills that already ran."""
+    loop = asyncio.get_event_loop()
+    start = loop.time()
+    count = 0
+    for ev in sorted(schedule, key=lambda e: (e.at_s, e.replica)):
+        await asyncio.sleep(max(0.0, ev.at_s - (loop.time() - start)))
+        spec = fault_injection.poll(
+            'serve.replica.kill',
+            kinds=(fault_injection.FaultKind.CRASH,),
+            replica=ev.replica)
+        if spec is None and fault_injection.active_plan() is not None:
+            logger.info('Kill of replica %d at t=%.2fs vetoed by the '
+                        'active fault plan.', ev.replica, ev.at_s)
+            continue
+        logger.warning('CHAOS: killing replica %d at t=%.2fs.',
+                       ev.replica, ev.at_s)
+        kill_fn(ev.replica)
+        count += 1
+        if executed is not None:
+            executed.append(ev)
+    return count
 
 
 def replay_engine(engine: Any, trace: Sequence[TraceRequest]
@@ -123,7 +196,8 @@ def replay_engine(engine: Any, trace: Sequence[TraceRequest]
 # ----------------------------------------------------------- HTTP
 async def _replay_one(session: Any, url: str, r: TraceRequest,
                       rec: RequestRecord, start: float,
-                      timeout_s: float) -> None:
+                      timeout_s: float,
+                      keep_tokens: bool = False) -> None:
     import aiohttp
 
     loop = asyncio.get_event_loop()
@@ -172,6 +246,13 @@ async def _replay_one(session: Any, url: str, r: TraceRequest,
                     rec.reason = event.get('reason')
                     rec.finished_s = now
                     rec.n_tokens = len(event.get('tokens') or ())
+                    # Recovery markers the LB stamps on spliced /
+                    # hedged streams (docs/failover.md) flow into the
+                    # scored breakdown.
+                    rec.resumed = int(event.get('resumed') or 0)
+                    rec.hedged = bool(event.get('hedged'))
+                    if keep_tokens:
+                        rec.tokens = list(event.get('tokens') or ())
                     return
                 if 'error' in event:
                     rec.status = 'error'
@@ -190,13 +271,16 @@ async def _replay_one(session: Any, url: str, r: TraceRequest,
 
 
 async def replay_http_async(url: str, trace: Sequence[TraceRequest],
-                            timeout_s: float = 600.0
+                            timeout_s: float = 600.0,
+                            keep_tokens: bool = False
                             ) -> Tuple[List[RequestRecord], float]:
     """Open-loop SSE replay against ``url`` (an EngineServer replica
     or the serve LB — both speak the same /generate). One task per
     request sleeps to its arrival offset, so concurrency is whatever
     the schedule demands — never capped by a semaphore that would
-    quietly turn the benchmark closed-loop."""
+    quietly turn the benchmark closed-loop. ``keep_tokens`` records
+    each finished request's final token ids (the chaos bench's
+    greedy-parity material)."""
     import aiohttp
 
     ordered = sorted(trace, key=lambda r: (r.arrival_s, r.request_id))
@@ -211,7 +295,8 @@ async def replay_http_async(url: str, trace: Sequence[TraceRequest],
         # that record's 'error' status — never the loss of every
         # other record in the run.
         outcomes = await asyncio.gather(
-            *(_replay_one(session, url, r, rec, start, timeout_s)
+            *(_replay_one(session, url, r, rec, start, timeout_s,
+                          keep_tokens=keep_tokens)
               for r, rec in zip(ordered, records)),
             return_exceptions=True)
     for rec, outcome in zip(records, outcomes):
@@ -224,7 +309,48 @@ async def replay_http_async(url: str, trace: Sequence[TraceRequest],
 
 
 def replay_http(url: str, trace: Sequence[TraceRequest],
-                timeout_s: float = 600.0
+                timeout_s: float = 600.0,
+                keep_tokens: bool = False
                 ) -> Tuple[List[RequestRecord], float]:
     return asyncio.run(replay_http_async(url, trace,
-                                         timeout_s=timeout_s))
+                                         timeout_s=timeout_s,
+                                         keep_tokens=keep_tokens))
+
+
+async def replay_http_chaos_async(
+        url: str, trace: Sequence[TraceRequest],
+        schedule: Sequence[KillEvent],
+        kill_fn: Callable[[int], None],
+        timeout_s: float = 600.0, keep_tokens: bool = True
+) -> Tuple[List[RequestRecord], float, int]:
+    """Open-loop HTTP replay with a concurrent seeded kill schedule:
+    the chaos run of ``bench.py serve_chaos``. ``kill_fn(replica)``
+    performs the real SIGKILL (the harness owns the subprocesses).
+    Returns ``(records, wall_s, kills_executed)``."""
+    executed: List[KillEvent] = []
+    killer = asyncio.ensure_future(
+        run_kill_schedule(schedule, kill_fn, executed=executed))
+    try:
+        records, wall = await replay_http_async(
+            url, trace, timeout_s=timeout_s, keep_tokens=keep_tokens)
+    finally:
+        if not killer.done():
+            killer.cancel()
+    try:
+        kills = await killer
+    except asyncio.CancelledError:
+        # The replay outlived the schedule window: the kills that
+        # already ran still count.
+        kills = len(executed)
+    return records, wall, kills
+
+
+def replay_http_chaos(url: str, trace: Sequence[TraceRequest],
+                      schedule: Sequence[KillEvent],
+                      kill_fn: Callable[[int], None],
+                      timeout_s: float = 600.0,
+                      keep_tokens: bool = True
+                      ) -> Tuple[List[RequestRecord], float, int]:
+    return asyncio.run(replay_http_chaos_async(
+        url, trace, schedule, kill_fn, timeout_s=timeout_s,
+        keep_tokens=keep_tokens))

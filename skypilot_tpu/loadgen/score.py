@@ -59,6 +59,17 @@ class RequestRecord:
     finished_s: Optional[float] = None
     n_tokens: int = 0
     deadline_s: Optional[float] = None
+    # Recovery markers (docs/failover.md): how many mid-stream
+    # replica deaths the LB resumed past for this request, and
+    # whether a TTFT hedge was raced. A resumed/hedged 'finished' is
+    # still SLO-scored like any other — the markers exist so chaos
+    # reports can distinguish clean finishes from recovered ones.
+    resumed: int = 0
+    hedged: bool = False
+    # Final token ids (populated by replay_http when requested):
+    # the chaos bench's greedy-parity check re-runs resumed prompts
+    # against a survivor and compares these bitwise.
+    tokens: Optional[List[int]] = None
 
     def itl_p99(self) -> Optional[float]:
         return percentile(self.itls, 0.99)
@@ -106,7 +117,11 @@ def score(records: Sequence[RequestRecord], slo: SLO,
       requests (ITL pooled across requests; per-request p99 is what
       the itl objective scores).
     - ``breakdown`` — terminal-status counts, sheds and expiries
-      split out (the load-shedding story in one dict).
+      split out (the load-shedding story in one dict), plus
+      ``resumed`` / ``hedged`` recovery counts (docs/failover.md):
+      requests that finished only because the LB spliced a
+      continuation past a dead replica, or raced a hedge — a chaos
+      report must distinguish clean finishes from recovered ones.
     """
     n = len(records)
     breakdown = Counter(r.status for r in records)
@@ -144,6 +159,11 @@ def score(records: Sequence[RequestRecord], slo: SLO,
         'output_tokens': sum(r.n_tokens for r in records),
         'breakdown': {
             **{s: breakdown.get(s, 0) for s in STATUSES},
+            # Recovery markers are orthogonal to terminal status
+            # (a resumed request still counts under 'finished'):
+            # sub-breakdowns, not new statuses.
+            'resumed': sum(1 for r in records if r.resumed),
+            'hedged': sum(1 for r in records if r.hedged),
             **{f'_{s}': c for s, c in breakdown.items()
                if s not in STATUSES},
         },

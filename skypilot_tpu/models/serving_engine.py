@@ -1005,6 +1005,20 @@ class ServingEngine:
     def decode_capacity(self) -> int:
         return self.max_seq - self.max_prompt
 
+    def limits(self) -> Dict[str, int]:
+        """The replica's static admission limits, advertised on
+        /health (docs/failover.md): the LB's stream-resumption path
+        re-submits prompt + tokens-emitted-so-far, and the grown
+        prompt must fit THIS replica's max_prompt — publishing the
+        limits lets callers (and the chaos bench) size workloads so
+        resumes stay admissible instead of discovering a 400."""
+        return {
+            'max_prompt': self.max_prompt,
+            'max_seq': self.max_seq,
+            'decode_capacity': self.decode_capacity(),
+            'batch_size': self.batch_size,
+        }
+
     def _num_pages(self, n: int) -> Optional[int]:
         """Page count for the next ``n``-step decode chunk: covers the
         live region [0, base + steps_done + n) rounded up per

@@ -104,7 +104,6 @@ class EngineServer:
         # surface. skytpu-lint: disable=STL004 — same discipline as
         # _futures: loop-thread-only mutation, atomic cross-thread get.
         self._by_reqid: Dict[str, Any] = {}
-        self._next_id = 0
         self._lock = threading.Lock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop = threading.Event()
@@ -472,7 +471,8 @@ class EngineServer:
 
     async def _handle_generate(self, request: web.Request,
                                req_id: str) -> web.StreamResponse:
-        from skypilot_tpu.models.serving_engine import Request
+        from skypilot_tpu.models.serving_engine import (
+            DuplicateRequestError, Request)
         if self._dead is not None:
             return web.json_response(
                 {'error': f'engine dead: {self._dead}'}, status=503,
@@ -515,9 +515,20 @@ class EngineServer:
             # warmup's own run() and silently lost.
             return web.json_response({'status': 'warming'}, status=503,
                                      headers=_rid_headers(req_id))
-        with self._lock:
-            rid = self._next_id
-            self._next_id += 1
+        # The engine request id IS the external X-Request-ID (minted
+        # above when the client sent none): the engine's
+        # DuplicateRequestError then guarantees at most one in-flight
+        # execution per id on THIS replica — the invariant the LB's
+        # hedge/retry machinery leans on (docs/failover.md). A
+        # duplicate is answered 409, a clean "already running" signal
+        # distinct from a 400 bad request.
+        rid = req_id
+        if req_id in self._by_reqid:
+            return web.json_response(
+                {'error': f'request {req_id!r} is already in flight '
+                          'on this replica',
+                 'reason': 'duplicate_request', 'request_id': req_id},
+                status=409, headers=_rid_headers(req_id))
         # skytpu-lint: disable=STL004 — _by_reqid is mutated only on
         # the event-loop thread; handle_cancel does an atomic get.
         self._by_reqid[req_id] = rid
@@ -537,6 +548,15 @@ class EngineServer:
                     self.engine.submit(Request(rid, tokens, max_new,
                                                temperature=temperature,
                                                deadline=deadline))
+            except DuplicateRequestError as e:
+                # Raced past the _by_reqid check (e.g. a hedge
+                # duplicate landing in the same loop turn): the
+                # engine's own in-flight set is the authority.
+                self._futures.pop(rid, None)
+                return web.json_response(
+                    {'error': str(e), 'reason': 'duplicate_request',
+                     'request_id': req_id},
+                    status=409, headers=_rid_headers(req_id))
             except ValueError as e:
                 self._futures.pop(rid, None)
                 return web.json_response({'error': str(e)}, status=400,
@@ -586,7 +606,8 @@ class EngineServer:
         surfaces the disconnect either as ConnectionResetError from
         ``write`` or by cancelling this handler task.
         """
-        from skypilot_tpu.models.serving_engine import Request
+        from skypilot_tpu.models.serving_engine import (
+            DuplicateRequestError, Request)
         q: asyncio.Queue = asyncio.Queue()
         # skytpu-lint: disable=STL004 — same discipline as _futures:
         # loop-thread-only mutation/iteration, atomic cross-thread get.
@@ -596,6 +617,12 @@ class EngineServer:
                 self.engine.submit(Request(rid, tokens, max_new,
                                            temperature=temperature,
                                            deadline=deadline))
+        except DuplicateRequestError as e:
+            self._streams.pop(rid, None)
+            return web.json_response(
+                {'error': str(e), 'reason': 'duplicate_request',
+                 'request_id': req_id},
+                status=409, headers=_rid_headers(req_id))
         except ValueError as e:
             self._streams.pop(rid, None)
             return web.json_response({'error': str(e)}, status=400,
@@ -698,11 +725,16 @@ class EngineServer:
         # The admission-pressure estimate rides on /health so probes
         # (and humans curling a replica) see queue pressure without a
         # full /metrics parse; the scraped gauge form is
-        # skytpu_engine_est_wait_seconds.
-        return web.json_response(
-            {'status': 'ok',
-             'est_wait_s': round(self.engine.estimate_wait_s(0, 1),
-                                 4)})
+        # skytpu_engine_est_wait_seconds. The static admission limits
+        # ride along (docs/failover.md) so callers can size resumable
+        # workloads against THIS replica's max_prompt.
+        body = {'status': 'ok',
+                'est_wait_s': round(self.engine.estimate_wait_s(0, 1),
+                                    4)}
+        limits = getattr(self.engine, 'limits', None)
+        if limits is not None:
+            body['limits'] = limits()
+        return web.json_response(body)
 
     async def handle_metrics(self, request: web.Request
                              ) -> web.Response:

@@ -109,8 +109,12 @@ class RestClient:
                 ok_statuses=(200,),
                 what: str = '') -> Dict[str, Any]:
         url = path if path.startswith('http') else self.base + path
+        # Explicit bounded (connect, read) timeout (skytpu-lint
+        # STL012): a wedged metadata/API endpoint must surface as a
+        # typed RequestException the provision retry machinery can
+        # act on, never hang a controller thread forever.
         resp = self.session.request(method, url, json=json_body,
-                                    params=params)
+                                    params=params, timeout=(10, 120))
         try:
             body = resp.json() if resp.content else {}
         except ValueError:

@@ -157,8 +157,11 @@ class KubeClient:
                  what: str = 'kubernetes api'
                  ) -> Tuple[int, Dict[str, Any]]:
         url = self.ctx.server.rstrip('/') + path
+        # Explicit bounded (connect, read) timeout (skytpu-lint
+        # STL012): an unresponsive apiserver must fail the call, not
+        # hang the provisioner.
         resp = self.session.request(method, url, json=body,
-                                    params=params)
+                                    params=params, timeout=(10, 120))
         try:
             payload = resp.json()
         except (ValueError, json.JSONDecodeError):

@@ -58,7 +58,12 @@ class ServeController:
         self.load_balancer = LoadBalancer(
             lb_port,
             policy=self.spec.load_balancing_policy,
-            on_request=self.autoscaler.record_request)
+            on_request=self.autoscaler.record_request,
+            # First-hand unreachability from the data plane demotes
+            # the replica NOW instead of after the probe cycle
+            # (docs/failover.md); the LB invokes this off its event
+            # loop.
+            on_replica_down=self.replica_manager.note_unreachable)
         self.loop_gap = loop_gap
         self._shutdown = asyncio.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None

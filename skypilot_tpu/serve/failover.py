@@ -1,0 +1,207 @@
+"""Per-replica circuit breakers for the serve load balancer.
+
+Replica-failure survivability (docs/failover.md): the probe loop
+discovers a dead replica in seconds, but a proxy attempt discovers it
+in ONE round trip. The breaker turns that first-hand evidence into
+routing: a replica whose proxy attempts fail is ejected from the
+pickable set immediately (``closed -> open``), held out for a
+cooldown, then re-admitted through a single half-open trial request
+(``open -> half_open -> closed``), instead of burning a client
+attempt per probe cycle.
+
+State machine::
+
+      +--------+  trip (hard connect failure, or     +------+
+      | closed | ----- threshold soft failures) ---> | open |
+      +--------+                                     +------+
+          ^                                             |
+          | trial success                               | cooldown
+          | (recovery)                                  v elapsed
+          |                 trial failure          +-----------+
+          +------------------- re-opens <--------- | half_open |
+                                                   +-----------+
+
+A *hard* failure is a connect refused/reset: the replica never
+received the request, and a process that will not accept TCP is down,
+not slow — one strike opens the breaker. *Soft* failures (timeouts,
+mid-stream death, upstream 5xx) count a consecutive streak against
+``SKYTPU_LB_BREAKER_THRESHOLD``. Any success resets the streak.
+
+Single-threaded by design: breakers live on the LB's event loop, and
+every transition happens synchronously between awaits (``blocked`` /
+``acquire`` / ``record_*`` never await). Time is injectable
+(``retry.Clock``) so tests drive the cooldown with a FakeClock.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import retry as retry_lib
+from skypilot_tpu.utils import statedb
+
+logger = sky_logging.init_logger(__name__)
+
+CLOSED = 'closed'
+OPEN = 'open'
+HALF_OPEN = 'half_open'
+
+# Gauge encoding of the state (docs/metrics.md): 0 closed (healthy),
+# 1 open (ejected), 2 half-open (one trial in flight or allowed).
+STATE_VALUES = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+_M_STATE = metrics_lib.gauge(
+    'skytpu_lb_breaker_state',
+    'Per-replica circuit-breaker state at the LB: 0 closed '
+    '(routable), 1 open (ejected after proxy failures), 2 half-open '
+    '(one trial request re-probing the replica). docs/failover.md.',
+    labels=('replica',))
+_M_TRIPS = metrics_lib.counter(
+    'skytpu_lb_breaker_trips_total',
+    'Circuit-breaker trips (closed/half-open -> open) per replica: '
+    'each is a replica ejected from the routable set on first-hand '
+    'proxy evidence instead of waiting out probe cycles.',
+    labels=('replica',))
+_M_RECOVERIES = metrics_lib.counter(
+    'skytpu_lb_breaker_recoveries_total',
+    'Circuit-breaker recoveries (half-open trial succeeded -> '
+    'closed) per replica.',
+    labels=('replica',))
+
+
+def breaker_threshold() -> int:
+    return max(1, int(env_registry.get(
+        env_registry.SKYTPU_LB_BREAKER_THRESHOLD, '3')))
+
+
+def breaker_cooldown_s() -> float:
+    return max(0.0, float(env_registry.get(
+        env_registry.SKYTPU_LB_BREAKER_COOLDOWN_S, '2')))
+
+
+class _StateDBClock(retry_lib.Clock):
+    """Default clock: the injectable control-plane wall clock
+    (statedb.set_wall_clock steers it in tests and the fleet
+    harness), resolved per call rather than captured at import."""
+
+    def now(self) -> float:
+        return statedb.wall_now()
+
+    def sleep(self, seconds: float) -> None:
+        statedb.wall_clock().sleep(seconds)
+
+
+class CircuitBreaker:
+    """One replica's breaker. The LB consults :meth:`blocked` when it
+    builds a pick-exclusion set, calls :meth:`acquire` for the URL it
+    actually picked (this is what consumes the single half-open
+    trial), and reports the attempt outcome via
+    :meth:`record_success` / :meth:`record_failure`."""
+
+    def __init__(self, replica: str,
+                 threshold: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock: Optional[retry_lib.Clock] = None) -> None:
+        self.replica = replica
+        self.threshold = (breaker_threshold()
+                          if threshold is None else max(1, threshold))
+        self.cooldown_s = (breaker_cooldown_s()
+                           if cooldown_s is None else cooldown_s)
+        self._clock = clock or _StateDBClock()
+        self.state = CLOSED
+        self._soft_streak = 0
+        self._opened_at: Optional[float] = None
+        self._trial_inflight = False
+        self.trips = 0
+        self.recoveries = 0
+        _M_STATE.set(STATE_VALUES[CLOSED], replica=replica)
+
+    # ------------------------------------------------------- queries
+    def blocked(self) -> bool:
+        """True while the replica must not be picked: open with the
+        cooldown still running, or half-open with its one trial
+        already in flight. An open breaker whose cooldown elapsed is
+        NOT blocked — the next pick becomes the half-open trial."""
+        if self.state == CLOSED:
+            return False
+        if self.state == OPEN:
+            assert self._opened_at is not None
+            return (self._clock.now() - self._opened_at <
+                    self.cooldown_s)
+        return self._trial_inflight          # HALF_OPEN
+
+    # ----------------------------------------------------- lifecycle
+    def acquire(self) -> None:
+        """The LB picked this replica. In CLOSED this is a no-op; an
+        elapsed-cooldown OPEN transitions to HALF_OPEN and marks the
+        single trial in flight (further picks are blocked until the
+        trial resolves)."""
+        if self.state == OPEN and not self.blocked():
+            self._set_state(HALF_OPEN)
+            self._trial_inflight = True
+        elif self.state == HALF_OPEN and not self._trial_inflight:
+            self._trial_inflight = True
+
+    def record_success(self) -> None:
+        self._soft_streak = 0
+        if self.state == HALF_OPEN:
+            self.recoveries += 1
+            _M_RECOVERIES.inc(1, replica=self.replica)
+            logger.info('Breaker for %s: half-open trial succeeded; '
+                        'replica re-admitted.', self.replica)
+        if self.state != CLOSED:
+            self._set_state(CLOSED)
+        self._trial_inflight = False
+
+    def record_failure(self, hard: bool = False) -> None:
+        """``hard`` = connect refused/reset (the replica never saw
+        the request): trips immediately. Soft failures trip after
+        ``threshold`` consecutive ones. Either failure kind re-opens
+        a half-open breaker."""
+        if self.state == HALF_OPEN:
+            self._trial_inflight = False
+            self._trip('half-open trial failed')
+            return
+        if hard:
+            self._soft_streak = 0
+            if self.state != OPEN:
+                self._trip('connect failure')
+            else:
+                self._opened_at = self._clock.now()
+            return
+        self._soft_streak += 1
+        if self.state == CLOSED and \
+                self._soft_streak >= self.threshold:
+            self._trip(f'{self._soft_streak} consecutive failures')
+
+    def abandon_trial(self) -> None:
+        """The attempt that consumed the half-open trial ended with
+        NO verdict on the replica's health — a shed (capacity, not
+        sickness), a client hangup, a cancelled hedge loser. Release
+        the trial so the next pick re-probes; without this the
+        breaker would wedge half-open-blocked forever (no outcome
+        can ever be recorded for an ejected replica). No-op when a
+        verdict already resolved the trial."""
+        if self.state == HALF_OPEN and self._trial_inflight:
+            self._trial_inflight = False
+
+    def remove(self) -> None:
+        """The replica left the fleet for good: retire its series."""
+        _M_STATE.remove(replica=self.replica)
+
+    # ------------------------------------------------------ internals
+    def _trip(self, why: str) -> None:
+        self._soft_streak = 0
+        self._opened_at = self._clock.now()
+        self.trips += 1
+        _M_TRIPS.inc(1, replica=self.replica)
+        self._set_state(OPEN)
+        logger.warning('Breaker for %s tripped OPEN (%s); replica '
+                       'ejected for %.1fs.', self.replica, why,
+                       self.cooldown_s)
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        _M_STATE.set(STATE_VALUES[state], replica=self.replica)

@@ -24,6 +24,20 @@ left, a past-deadline request is answered 504 and never retried, and
 a replica's 429/503 shed is retried on another replica — with the
 last shed's Retry-After and reason forwarded when every candidate
 sheds.
+
+Replica-failure survivability (docs/failover.md): every replica has a
+circuit breaker (serve/failover.py) fed by first-hand proxy evidence
+— a connect-refused trips it immediately (and notifies the replica
+manager, which would otherwise only learn from the next probe cycle),
+consecutive soft failures trip it at a threshold, and a half-open
+trial request re-admits a recovered replica. Streaming ``/generate``
+requests additionally get TTFT *hedging* (zero bytes streamed after a
+p95-TTFT-derived delay races a second replica; the loser is cancelled
+by request id, so at most one token stream ever reaches the client)
+and mid-stream *resumption* for greedy requests (a replica dying
+mid-stream re-submits prompt + tokens-emitted-so-far to a healthy
+replica and splices the bitwise-identical continuation into the
+client's SSE stream — no duplicated, no dropped tokens).
 """
 from __future__ import annotations
 
@@ -31,13 +45,15 @@ import asyncio
 import itertools
 import json
 import threading
-from typing import Callable, Dict, List, Optional, Set
+import types
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.serve import failover
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
@@ -81,6 +97,27 @@ _M_DEADLINE_REJECTS = metrics_lib.counter(
     'Requests answered 504 at the LB because their deadline passed '
     'before (or between) proxy attempts — a past-deadline request '
     'is never retried (docs/request_lifecycle.md).')
+# Failure-survivability counters (docs/failover.md).
+_M_HEDGES = metrics_lib.counter(
+    'skytpu_lb_hedges_total',
+    'TTFT hedges launched for streaming /generate, by outcome: won '
+    '(the hedge produced the first token and served the client), '
+    'lost (the primary produced first; the hedge was cancelled), '
+    'failed (the hedge itself errored or was shed before any first '
+    'token).',
+    labels=('outcome',))
+_M_RESUMED = metrics_lib.counter(
+    'skytpu_lb_resumed_streams_total',
+    'Greedy SSE streams whose replica died mid-stream and whose '
+    'continuation was successfully re-prefilled on a healthy '
+    'replica and spliced into the client stream with no duplicated '
+    'or dropped tokens (docs/failover.md).')
+_M_RESUME_FAILURES = metrics_lib.counter(
+    'skytpu_lb_resume_failures_total',
+    'Mid-stream deaths the LB could NOT resume (non-greedy request, '
+    'resumption disabled, no healthy replica, resume budget '
+    'exhausted, or the resumed prompt exceeded the replica\'s '
+    'max_prompt): the client saw a truncated stream.')
 
 
 class LoadBalancingPolicy:
@@ -184,25 +221,48 @@ class LoadBalancer:
     MAX_ATTEMPTS = 3
 
     def __init__(self, port: int, policy: str = 'least_load',
-                 on_request: Optional[Callable[[], None]] = None) -> None:
+                 on_request: Optional[Callable[[], None]] = None,
+                 on_replica_down: Optional[Callable[[str], None]] = None
+                 ) -> None:
         # port 0 = let the OS pick; the actual port is in `bound_port`
         # after start() (avoids probe-then-rebind TOCTOU races).
         self.port = port
         self.bound_port: Optional[int] = None
         self.policy: LoadBalancingPolicy = POLICIES[policy]()
         self.on_request = on_request
+        # Called (off the event loop) with a replica URL the moment a
+        # proxy attempt proves it unreachable — the replica manager
+        # demotes it without waiting for the next probe cycle
+        # (docs/failover.md).
+        self.on_replica_down = on_replica_down
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._draining: Set[str] = set()
+        # Per-replica circuit breakers (serve/failover.py): loop-
+        # affine, fed by proxy outcomes, consulted at every pick.
+        self._breakers: Dict[str, failover.CircuitBreaker] = {}
         # Sliding p99 window behind the cumulative per-replica
         # latency histograms (docs/load_testing.md): per-instance so
         # a rebuilt LB starts a fresh window, feeding the
         # skytpu_lb_request_p99_seconds gauge.
+        window_s = float(env_registry.get(
+            env_registry.SKYTPU_SLO_WINDOW_S, '60'))
         self._latency_window = metrics_lib.SlidingWindowPercentile(
-            float(env_registry.get(env_registry.SKYTPU_SLO_WINDOW_S,
-                                   '60')))
+            window_s)
+        # Sliding TTFT window over streaming /generate (time from
+        # attempt start to first token event): its p95 IS the hedge
+        # delay once it has samples (docs/failover.md).
+        self._ttft_window = metrics_lib.SlidingWindowPercentile(
+            window_s)
 
     def set_replica_urls(self, urls: List[str]) -> None:
+        for gone in set(self.policy.urls()) - set(urls):
+            # The replica left the fleet (scale-down, terminate, or
+            # manager demotion): retire its breaker — if it returns
+            # via a READY probe it deserves a fresh closed breaker.
+            b = self._breakers.pop(gone, None)
+            if b is not None:
+                b.remove()
         self.policy.set_urls(urls)
         self._draining &= set(urls)
 
@@ -223,6 +283,76 @@ class LoadBalancer:
             await asyncio.sleep(0.05)
         return True
 
+    # ------------------------------------------------ breaker plumbing
+    def _breaker(self, url: str) -> failover.CircuitBreaker:
+        b = self._breakers.get(url)
+        if b is None:
+            b = failover.CircuitBreaker(url)
+            self._breakers[url] = b
+        return b
+
+    def _blocked_urls(self) -> Set[str]:
+        return {u for u, b in self._breakers.items() if b.blocked()}
+
+    def _pick(self, exclude: Set[str]) -> Optional[str]:
+        """Breaker-aware pick: open breakers are excluded; picking a
+        cooled-down open breaker consumes its single half-open trial.
+        Synchronous end to end, so two interleaved requests can never
+        both claim the same trial."""
+        url = self.policy.pick(exclude=exclude | self._blocked_urls())
+        if url is not None:
+            self._breaker(url).acquire()
+        return url
+
+    def _note_success(self, url: str) -> None:
+        self._breaker(url).record_success()
+
+    def _note_neutral(self, url: str) -> None:
+        """The attempt ended with no health verdict (shed, client
+        hangup, cancelled hedge loser): release a consumed half-open
+        trial so the breaker cannot wedge. No-op when the attempt
+        already recorded success/failure."""
+        b = self._breakers.get(url)
+        if b is not None:
+            b.abandon_trial()
+
+    def _note_failure(self, url: str, *, hard: bool = False) -> None:
+        """Feed the breaker; a hard failure (connect refused/reset —
+        the replica never received the request) also notifies the
+        replica manager so the ready set shrinks NOW instead of after
+        the probe cycle."""
+        self._breaker(url).record_failure(hard=hard)
+        if hard and self.on_replica_down is not None:
+            try:
+                asyncio.get_running_loop().run_in_executor(
+                    None, self.on_replica_down, url)
+            except RuntimeError:
+                self.on_replica_down(url)
+
+    # --------------------------------------------------- hedge knobs
+    @staticmethod
+    def _hedge_enabled() -> bool:
+        return env_registry.get(env_registry.SKYTPU_LB_HEDGE,
+                                '1') == '1'
+
+    @staticmethod
+    def _resume_enabled() -> bool:
+        return env_registry.get(env_registry.SKYTPU_LB_RESUME,
+                                '1') == '1'
+
+    @staticmethod
+    def _resume_max() -> int:
+        return max(0, int(env_registry.get(
+            env_registry.SKYTPU_LB_RESUME_MAX, '3')))
+
+    def _hedge_delay_s(self) -> float:
+        p95 = self._ttft_window.quantile(0.95)
+        if p95 is None:
+            return max(0.0, float(env_registry.get(
+                env_registry.SKYTPU_LB_HEDGE_DELAY_S, '2')))
+        return max(float(env_registry.get(
+            env_registry.SKYTPU_LB_HEDGE_MIN_S, '0.05')), p95)
+
     # ------------------------------------------------------------------
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         # One request span per proxied call, continuing the client's
@@ -237,7 +367,40 @@ class LoadBalancer:
             if (request.method == 'POST' and
                     request.rel_url.path.startswith('/cancel/')):
                 return await self._cancel_broadcast(request)
+            if (request.method == 'POST' and
+                    request.rel_url.path == '/generate'):
+                body = await request.read()
+                parsed = self._sse_generate_body(body)
+                if parsed is not None:
+                    # Streaming generate: the SSE-aware path with
+                    # TTFT hedging and mid-stream resumption
+                    # (docs/failover.md).
+                    return await self._proxy_generate_sse(request,
+                                                          parsed)
             return await self._proxy_attempts(request)
+
+    @staticmethod
+    def _sse_generate_body(body: bytes) -> Optional[Dict[str, Any]]:
+        """The parsed /generate body IF it is a streaming request the
+        SSE path can own (valid token list + max_new). Anything else
+        returns None and flows through the opaque proxy — the replica
+        is the authority on malformed bodies (400)."""
+        try:
+            parsed = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(parsed, dict) or not parsed.get('stream'):
+            return None
+        tokens = parsed.get('tokens')
+        max_new = parsed.get('max_new', 64)
+        if (not isinstance(tokens, list) or not tokens or
+                not all(isinstance(t, int) and not isinstance(t, bool)
+                        for t in tokens)):
+            return None
+        if (not isinstance(max_new, int) or isinstance(max_new, bool)
+                or max_new < 1):
+            return None
+        return parsed
 
     async def _cancel_broadcast(self, request: web.Request
                                 ) -> web.Response:
@@ -280,6 +443,24 @@ class LoadBalancer:
         return web.Response(status=chosen[0], body=chosen[1],
                             content_type=chosen[2].split(';')[0])
 
+    async def _cancel_on(self, url: str, req_id: str) -> None:
+        """Targeted best-effort cancel on ONE replica: the hedge
+        loser's (or a dead primary's) in-flight request must not
+        keep decoding tokens nobody will read. Request-id-keyed: the
+        replica maps the id to its engine request, and its engine's
+        DuplicateRequestError semantics mean the id identifies at
+        most one in-flight request per replica."""
+        if self._session is None:
+            return
+        try:
+            async with self._session.post(
+                    url.rstrip('/') + '/cancel/' + req_id,
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
+
+    # ------------------------------------------------ opaque proxying
     async def _proxy_attempts(self, request: web.Request
                               ) -> web.StreamResponse:
         if self.on_request is not None:
@@ -309,7 +490,7 @@ class LoadBalancer:
                     {'error': 'deadline exceeded before the request '
                               'could be served',
                      'reason': 'deadline_exceeded'}, status=504)
-            url = self.policy.pick(exclude=tried | self._draining)
+            url = self._pick(exclude=tried | self._draining)
             if url is None:
                 break
             tried.add(url)
@@ -327,6 +508,14 @@ class LoadBalancer:
                 p99 = self._latency_window.quantile(0.99)
                 if p99 is not None:
                     _M_LATENCY_P99.set(p99)
+                if resp.status >= 500:
+                    # An upstream 5xx passes through (it is the
+                    # replica's own verdict) but still counts against
+                    # the breaker: a replica whose app 500s every
+                    # request is sick, not busy.
+                    self._note_failure(url)
+                else:
+                    self._note_success(url)
                 return resp
             except _ReplicaShedError as e:
                 # The replica REFUSED the request (429 queue-full /
@@ -335,6 +524,8 @@ class LoadBalancer:
                 # method. If every candidate sheds, the LAST shed
                 # response — Retry-After and reason included — is
                 # forwarded to the client instead of being swallowed.
+                # A shed is a capacity verdict from a live replica:
+                # it feeds neither breaker arm.
                 sp.finish(status=e.status, error='shed')
                 logger.info('Replica %s shed the request (%d, '
                             'reason=%s); trying another (trace=%s)',
@@ -344,12 +535,15 @@ class LoadBalancer:
             except aiohttp.ClientConnectorError as e:
                 # TCP connect failed: the replica NEVER received the
                 # request — safe to retry on another replica for any
-                # method.
+                # method. Hard breaker trip + manager notification:
+                # a replica that refuses TCP is down, not slow
+                # (docs/failover.md).
                 sp.finish(error='connect')
                 logger.warning('Replica %s unreachable (%s); retrying '
                                'on another replica (trace=%s)', url, e,
                                trace_id)
                 _M_ERRORS.inc(1, replica=url, kind='connect')
+                self._note_failure(url, hard=True)
                 last_err = e
             except aiohttp.ClientConnectionError as e:
                 # Connection dropped after the request was sent (e.g.
@@ -358,6 +552,7 @@ class LoadBalancer:
                 # non-idempotent work, so only safe methods retry.
                 sp.finish(error='disconnect')
                 _M_ERRORS.inc(1, replica=url, kind='disconnect')
+                self._note_failure(url)
                 if request.method not in ('GET', 'HEAD', 'OPTIONS'):
                     logger.warning('Replica %s dropped mid-request '
                                    '(%s); not retrying %s (trace=%s)',
@@ -375,12 +570,14 @@ class LoadBalancer:
                 logger.warning('Replica %s died mid-response: %s '
                                '(trace=%s)', url, e.cause, trace_id)
                 _M_ERRORS.inc(1, replica=url, kind='mid_stream')
+                self._note_failure(url)
                 return e.response
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 sp.finish(error='upstream')
                 logger.warning('Proxy to %s failed: %s (trace=%s)',
                                url, e, trace_id)
                 _M_ERRORS.inc(1, replica=url, kind='upstream')
+                self._note_failure(url)
                 last_err = e
                 if request.method not in ('GET', 'HEAD', 'OPTIONS'):
                     # Same double-execution risk as the dropped-
@@ -397,6 +594,9 @@ class LoadBalancer:
                 if sp.end_time is None:
                     sp.finish(error='aborted')
                 self.policy.done(url)
+                # Verdict-less endings (shed, aborted) must release a
+                # consumed half-open trial (no-op otherwise).
+                self._note_neutral(url)
         if last_shed is not None and not may_have_executed:
             # Every candidate shed (or was unreachable without ever
             # receiving the request): surface the last replica's own
@@ -412,6 +612,45 @@ class LoadBalancer:
         return web.Response(status=502,
                             text=f'Replica unreachable: {last_err}\n')
 
+    def _forward_headers(self, request: web.Request,
+                         deadline: Optional[float],
+                         drop: Sequence = ()) -> Dict[str, str]:
+        """Headers for one upstream attempt: hop headers stripped,
+        the active span's traceparent replacing any client-sent one
+        (the replica must parent under THIS hop), and the budget
+        STILL LEFT re-stamped (a retry after a slow failure hands the
+        replica less than the original)."""
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() not in _HOP_HEADERS and
+            k.lower() not in {d.lower() for d in drop}
+        }
+        tp = trace_lib.traceparent_headers()
+        if tp:
+            headers = {k: v for k, v in headers.items()
+                       if k.lower() != trace_lib.TRACEPARENT_HEADER}
+            headers.update(tp)
+        budget = lifecycle.budget_headers(deadline)
+        if budget:
+            headers = {k: v for k, v in headers.items()
+                       if k.lower() != lifecycle.DEADLINE_HEADER.lower()}
+            headers.update(budget)
+        return headers
+
+    def _poll_connect_fault(self, url: str, path: str) -> None:
+        """Chaos site lb.replica.connect (docs/fault_injection.md):
+        act out a TCP connect failure on this proxy attempt — the
+        deterministic way to drive the circuit breaker without
+        killing a process."""
+        spec = fault_injection.poll(
+            'lb.replica.connect',
+            kinds=(fault_injection.FaultKind.CONNECT_FAILURE,),
+            replica=url, path=path)
+        if spec is not None:
+            raise _InjectedConnectError(
+                f'[fault-injection] connect_failure at '
+                f'lb.replica.connect ({url})')
+
     async def _proxy_once(self, request: web.Request, url: str,
                           body: bytes,
                           deadline: Optional[float] = None
@@ -419,28 +658,13 @@ class LoadBalancer:
         target = url.rstrip('/') + '/' + request.rel_url.path.lstrip('/')
         if request.rel_url.query_string:
             target += '?' + request.rel_url.query_string
-        headers = {
-            k: v for k, v in request.headers.items()
-            if k.lower() not in _HOP_HEADERS
-        }
-        # Continue the trace into the replica: the active lb.proxy
-        # span replaces any client-sent traceparent (the replica must
-        # parent under THIS hop, not skip it). When tracing is off
-        # this is {} and the client's own header passes through.
-        tp = trace_lib.traceparent_headers()
-        if tp:
-            headers = {k: v for k, v in headers.items()
-                       if k.lower() != trace_lib.TRACEPARENT_HEADER}
-            headers.update(tp)
-        # Stamp the budget STILL LEFT for this attempt (a retry after
-        # a slow failure hands the replica less than the original):
-        # the replica turns it back into an absolute local deadline.
-        budget = lifecycle.budget_headers(deadline)
-        if budget:
-            headers = {k: v for k, v in headers.items()
-                       if k.lower() != lifecycle.DEADLINE_HEADER.lower()}
-            headers.update(budget)
+        headers = self._forward_headers(request, deadline)
+        self._poll_connect_fault(url, request.rel_url.path)
         assert self._session is not None, 'start() not called'
+        # skytpu-lint: disable=STL012 — deliberate session-level
+        # bound: the pooled session's ClientTimeout (sock_connect=10,
+        # sock_read=300) governs every proxied call; a per-call total
+        # would cut legitimate long-lived SSE streams.
         async with self._session.request(request.method, target,
                                          headers=headers,
                                          data=body) as resp:
@@ -500,6 +724,36 @@ class LoadBalancer:
                     raise _MidStreamError(out, e) from e
                 raise
 
+    # ------------------------------------- streaming /generate (SSE)
+    async def _proxy_generate_sse(self, request: web.Request,
+                                  parsed: Dict[str, Any]
+                                  ) -> web.StreamResponse:
+        """The failure-survivable path for streaming /generate
+        (docs/failover.md). Parses the replica's SSE events instead of
+        forwarding opaque chunks, which is what makes three things
+        possible:
+
+        - **TTFT hedging**: while ZERO tokens have streamed, a slow
+          primary (no first event within the p95-TTFT-derived hedge
+          delay) races a second replica; the first replica to produce
+          a token serves the client, the loser is cancelled by
+          request id. At most one token stream ever reaches the
+          client.
+        - **Mid-stream resumption** (greedy only): a replica dying
+          mid-stream re-submits prompt + tokens-emitted-so-far to a
+          healthy replica — greedy determinism (plus the prefix cache
+          making the re-prefill cheap) yields a continuation bitwise
+          equal to the uninterrupted stream, spliced in with no
+          duplicated or dropped tokens. The final ``done`` event is
+          rewritten to carry the FULL token list (and ``resumed`` /
+          ``hedged`` markers for scoring).
+        - **Breaker feeding** identical to the opaque path.
+        """
+        if self.on_request is not None:
+            self.on_request()
+        driver = _SSEGenerateDriver(self, request, parsed)
+        return await driver.run()
+
     async def _handle_metrics(self, request: web.Request
                               ) -> web.Response:
         """The controller-side scrape point: this process's LB +
@@ -542,6 +796,756 @@ class LoadBalancer:
             self._session = None
         if self._runner is not None:
             await self._runner.cleanup()
+
+
+class _SSEUpstream:
+    """One upstream streaming /generate attempt: owns the pooled-
+    session response and a line-wise SSE event parser."""
+
+    def __init__(self, lb: LoadBalancer, url: str,
+                 payload: Dict[str, Any],
+                 headers: Dict[str, str]) -> None:
+        self._lb = lb
+        self.url = url
+        self._payload = payload
+        self._headers = headers
+        self.resp: Optional[aiohttp.ClientResponse] = None
+        # Loop-clock instant start() ran: TTFT observations measure
+        # from the OWNING upstream's start, so a hedge winner's
+        # sample is its own connect+first-token time, not the hedge
+        # delay it waited behind (which would ratchet the p95-derived
+        # delay upward on every win).
+        self.started_at: Optional[float] = None
+        self._buf = bytearray()
+
+    async def start(self) -> aiohttp.ClientResponse:
+        self.started_at = asyncio.get_event_loop().time()
+        self._lb._poll_connect_fault(self.url, '/generate')  # pylint: disable=protected-access
+        assert self._lb._session is not None, 'start() not called'  # pylint: disable=protected-access
+        # skytpu-lint: disable=STL012 — same session-level bound as
+        # _proxy_once: sock_connect/sock_read on the pooled session;
+        # an SSE stream legitimately outlives any per-call total.
+        self.resp = await self._lb._session.post(  # pylint: disable=protected-access
+            self.url.rstrip('/') + '/generate', json=self._payload,
+            headers=self._headers)
+        return self.resp
+
+    async def _readline(self) -> bytes:
+        """Own line buffering instead of StreamReader.readline():
+        aiohttp's readline raises ValueError past its 64 KiB line
+        limit, and a done event's full token list routinely exceeds
+        that. Upstream is an intra-stack replica, so the unbounded
+        line buffer is trusted the same way the opaque proxy's
+        passthrough was."""
+        assert self.resp is not None
+        while True:
+            i = self._buf.find(b'\n')
+            if i >= 0:
+                line = bytes(self._buf[:i + 1])
+                del self._buf[:i + 1]
+                return line
+            chunk = await self.resp.content.read(1 << 16)
+            if not chunk:
+                if self._buf:
+                    line = bytes(self._buf)
+                    self._buf.clear()
+                    return line
+                return b''
+            self._buf.extend(chunk)
+
+    async def next_event(self) -> Optional[Dict[str, Any]]:
+        """The next parsed ``data:`` event, or None at a clean EOF.
+        A torn line (replica died mid-write) surfaces as a payload
+        error — the caller treats it like any mid-stream death."""
+        while True:
+            line = await self._readline()
+            if not line:
+                return None
+            line = line.strip()
+            if not line.startswith(b'data:'):
+                continue
+            try:
+                event = json.loads(
+                    line[len(b'data:'):].decode('utf-8', 'replace'))
+            except ValueError as e:
+                raise aiohttp.ClientPayloadError(
+                    'malformed SSE event from replica') from e
+            if isinstance(event, dict):
+                return event
+
+    def close(self) -> None:
+        if self.resp is not None:
+            self.resp.close()
+
+
+class _SSEGenerateDriver:
+    """State machine for ONE client streaming /generate request:
+    attempt loop, hedge race, mid-stream resume, SSE splice.
+
+    Invariants:
+
+    - at most one upstream ever streams to the client (the hedge
+      loser is cancelled by request id before any of its tokens are
+      forwarded);
+    - ``emitted`` is exactly the token sequence the client has seen,
+      so a resume re-submits ``prompt + emitted`` and the rewritten
+      ``done`` event carries ``emitted + continuation`` — no token is
+      duplicated or dropped;
+    - every picked URL is released (``policy.done``) exactly once,
+      via the ``_held`` list.
+    """
+
+    def __init__(self, lb: LoadBalancer, request: web.Request,
+                 parsed: Dict[str, Any]) -> None:
+        self.lb = lb
+        self.request = request
+        self.parsed = parsed
+        self.tokens: List[int] = list(parsed['tokens'])
+        self.max_new: int = int(parsed.get('max_new', 64))
+        temp = parsed.get('temperature')
+        self.greedy = temp is None or temp == 0
+        # The request id is the hedge/resume/cancel correlation key:
+        # minted HERE if the client did not send one, and stamped on
+        # every upstream attempt so a targeted /cancel on the loser
+        # replica hits exactly this request.
+        self.req_id = (request.headers.get(trace_lib.REQUEST_ID_HEADER)
+                       or trace_lib.new_request_id())
+        self.deadline = lifecycle.deadline_from_headers(request.headers)
+        self.emitted: List[int] = []      # tokens the CLIENT has seen
+        self.client: Optional[web.StreamResponse] = None
+        self.tried: Set[str] = set()
+        # Replicas that died MID-STREAM on this request: the only
+        # hard exclusion for resume attempts. ``tried`` governs
+        # pre-stream retries/hedges; a resume may legitimately
+        # return to a replica that merely lost the hedge race (its
+        # duplicate was cancelled).
+        self.dead_urls: Set[str] = set()
+        self._dup_retries = 0
+        # Exception already breaker-noted inside the hedge race (the
+        # primary's failure is noted at failure time, since the hedge
+        # may win and swallow it): run()'s arm must not double-note.
+        self._noted_exc: Optional[BaseException] = None
+        self.resumes = 0
+        self.hedged = False
+        self.last_shed: Optional[_ReplicaShedError] = None
+        self.last_err: Optional[BaseException] = None
+        self._disconnect_spec = None
+        self._winner: Optional[_SSEUpstream] = None
+        # URLs whose pick is currently held (inflight gauge): the
+        # primary of the running attempt, plus a hedge while racing.
+        self._held: List[str] = []
+        self._active_url: Optional[str] = None
+        self._loop = asyncio.get_event_loop()
+        self._t0 = self._loop.time()
+        self._trace_id = trace_lib.current_trace_id()
+
+    # ------------------------------------------------------- helpers
+    def _upstream(self, url: str) -> _SSEUpstream:
+        payload = dict(self.parsed)
+        payload['tokens'] = self.tokens + self.emitted
+        payload['max_new'] = self.max_new - len(self.emitted)
+        payload['stream'] = True
+        headers = self.lb._forward_headers(  # pylint: disable=protected-access
+            self.request, self.deadline,
+            drop=('content-type', 'content-length'))
+        headers[trace_lib.REQUEST_ID_HEADER] = self.req_id
+        return _SSEUpstream(self.lb, url, payload, headers)
+
+    def _release(self, url: str) -> None:
+        if url in self._held:
+            self._held.remove(url)
+            self.lb.policy.done(url)
+            # Verdict-less endings (shed, cancelled hedge loser,
+            # client hangup) must release a consumed half-open trial
+            # (no-op when success/failure already resolved it).
+            self.lb._note_neutral(url)  # pylint: disable=protected-access
+
+    def _classify(self, exc: BaseException) -> str:
+        """Map an attempt exception onto the error-kind taxonomy
+        (pure; no breaker side effects)."""
+        if isinstance(exc, aiohttp.ClientConnectorError):
+            return 'connect'
+        if self.client is not None:
+            return 'mid_stream'
+        if isinstance(exc, aiohttp.ClientConnectionError):
+            return 'disconnect'
+        return 'upstream'
+
+    def _note_kind(self, url: str, kind: str) -> None:
+        """Feed the breaker + error counters exactly like the opaque
+        path (a connect failure is the hard, notify-the-manager
+        kind)."""
+        self.lb._note_failure(url, hard=(kind == 'connect'))  # pylint: disable=protected-access
+        _M_ERRORS.inc(1, replica=url, kind=kind)
+
+    def _note_race_failure(self, url: str,
+                           exc: Optional[BaseException]) -> None:
+        """Breaker/error accounting for an upstream that failed
+        INSIDE the hedge race (its exception may never surface to
+        run()'s arms — e.g. the primary dies while the hedge wins,
+        or the hedge itself is refused). Sheds and non-stream
+        verdicts keep their opaque-path semantics: a shed feeds
+        neither breaker arm, a 5xx verdict is a soft failure."""
+        if exc is None or isinstance(exc, _ReplicaShedError):
+            if exc is not None:
+                _M_ERRORS.inc(1, replica=url, kind='shed')
+            return
+        if isinstance(exc, _NonStreamVerdict):
+            if exc.status >= 500:
+                self.lb._note_failure(url)  # pylint: disable=protected-access
+            else:
+                self.lb._note_success(url)  # pylint: disable=protected-access
+            return
+        self._note_kind(url, self._classify(exc))
+
+    async def _write_event(self, payload: Dict[str, Any]) -> None:
+        if self.client is None:
+            self.client = web.StreamResponse(headers={
+                'Content-Type': 'text/event-stream',
+                'Cache-Control': 'no-cache',
+                'X-Accel-Buffering': 'no',
+                trace_lib.REQUEST_ID_HEADER: self.req_id,
+            })
+            await self.client.prepare(self.request)
+        await self.client.write(
+            f'data: {json.dumps(payload)}\n\n'.encode())
+
+    async def _finish_stream(self) -> web.StreamResponse:
+        assert self.client is not None
+        try:
+            await self.client.write_eof()
+        except (ConnectionResetError, aiohttp.ClientError):
+            pass
+        return self.client
+
+    def _synthesize_done(self) -> Dict[str, Any]:
+        """A done event the LB composes itself — used when every
+        budgeted token already reached the client but the replica
+        died before its own done event could (nothing is left to
+        resume; the stream IS complete)."""
+        payload: Dict[str, Any] = {
+            'done': True,
+            'tokens': list(self.emitted),
+            'latency_s': round(self._loop.time() - self._t0, 4),
+            'status': lifecycle.FINISHED,
+            'reason': None,
+        }
+        if self.resumes:
+            payload['resumed'] = self.resumes
+        if self.hedged:
+            payload['hedged'] = True
+        return payload
+
+    # ----------------------------------------------------------- run
+    async def run(self) -> web.StreamResponse:
+        attempts_left = self.lb.MAX_ATTEMPTS
+        resume_budget = self.lb._resume_max()  # pylint: disable=protected-access
+        while attempts_left > 0:
+            attempts_left -= 1
+            left = lifecycle.remaining(self.deadline)
+            if left is not None and left <= 0:
+                if self.client is None:
+                    _M_DEADLINE_REJECTS.inc()
+                    logger.warning(
+                        'Deadline passed before attempt (trace=%s); '
+                        'answering 504.', self._trace_id)
+                    return web.json_response(
+                        {'error': 'deadline exceeded before the '
+                                  'request could be served',
+                         'reason': 'deadline_exceeded'}, status=504)
+                # Mid-stream deadline: the replica's own expiry owns
+                # this; ending truncated here is all the LB can do.
+                break
+            # Pre-stream attempts avoid every replica already tried;
+            # a RESUME only needs to avoid the replicas that died
+            # mid-stream on this request (a hedge loser whose
+            # duplicate was cancelled is a perfectly good resume
+            # target — with 2 replicas it is often the ONLY one).
+            exclude = (self.dead_urls if self.client is not None
+                       else self.tried)
+            url = self.lb._pick(  # pylint: disable=protected-access
+                exclude=exclude | self.lb._draining)  # pylint: disable=protected-access
+            if url is None:
+                break
+            self.tried.add(url)
+            self._held.append(url)
+            self._active_url = url
+            sp = trace_lib.start_span(
+                'lb.proxy', replica=url, sse=True,
+                **({'budget_s': round(left, 3)}
+                   if left is not None else {}))
+            up = self._upstream(url)
+            try:
+                with trace_lib.activate(sp):
+                    outcome = await self._drive_attempt(up, sp)
+                sp.finish(status=200)
+                win_url = self._active_url
+                _M_LATENCY.observe(sp.duration, exemplar=sp.exemplar,
+                                   replica=win_url)
+                self.lb._latency_window.observe(sp.duration)  # pylint: disable=protected-access
+                p99 = self.lb._latency_window.quantile(0.99)  # pylint: disable=protected-access
+                if p99 is not None:
+                    _M_LATENCY_P99.set(p99)
+                return outcome
+            except _NonStreamVerdict as v:
+                sp.finish(status=v.status)
+                if v.status >= 500:
+                    self.lb._note_failure(self._active_url)  # pylint: disable=protected-access
+                else:
+                    self.lb._note_success(self._active_url)  # pylint: disable=protected-access
+                if (v.status == 409 and self.client is not None and
+                        self._dup_retries < 4):
+                    # Resume raced the hedge loser's cancel: the
+                    # duplicate id is still terminal-izing on that
+                    # replica. A tick from now it is free — retry
+                    # rather than truncate the client's stream.
+                    self._dup_retries += 1
+                    attempts_left = max(attempts_left, 1)
+                    logger.info(
+                        'Resume on %s hit duplicate_request (cancel '
+                        'still applying); retrying (%d, trace=%s).',
+                        self._active_url, self._dup_retries,
+                        self._trace_id)
+                    await asyncio.sleep(0.25)
+                    continue
+                if self.client is not None:
+                    # A resumed attempt was refused (e.g. 400: the
+                    # grown prompt exceeds the replica's max_prompt):
+                    # the client already holds a partial stream —
+                    # nothing to forward, end truncated.
+                    _M_RESUME_FAILURES.inc()
+                    logger.warning(
+                        'Resume attempt on %s refused (HTTP %d); '
+                        'ending truncated stream (trace=%s).',
+                        self._active_url, v.status, self._trace_id)
+                    return await self._finish_stream()
+                return v.response
+            except _ClientGone:
+                # The LB-side client-disconnect chaos fired (or the
+                # real client hung up): upstream already closed so
+                # the replica cancels; end exactly like the opaque
+                # path — truncated response, no retry, no resume.
+                sp.finish(error='mid_stream')
+                _M_ERRORS.inc(1, replica=self._active_url,
+                              kind='mid_stream')
+                assert self.client is not None
+                return self.client
+            except _ReplicaShedError as e:
+                sp.finish(status=e.status, error='shed')
+                logger.info('Replica %s shed the request (%d, '
+                            'reason=%s); trying another (trace=%s)',
+                            self._active_url, e.status, e.reason,
+                            self._trace_id)
+                _M_ERRORS.inc(1, replica=self._active_url,
+                              kind='shed')
+                self.last_shed = e
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                fail_url = self._active_url
+                kind = self._classify(e)
+                if e is not self._noted_exc:
+                    self._note_kind(fail_url, kind)
+                sp.finish(error=kind)
+                self.last_err = e
+                if self.client is not None:
+                    self.dead_urls.add(fail_url)
+                if self.client is None:
+                    # ZERO bytes have streamed: a retry on another
+                    # replica is safe — the request id keys a cancel
+                    # to the possibly-started replica, and at most
+                    # one stream ever reaches the client.
+                    logger.warning(
+                        'Replica %s failed pre-first-token (%s: %s); '
+                        'retrying on another replica (trace=%s).',
+                        fail_url, kind, e, self._trace_id)
+                    asyncio.ensure_future(
+                        self.lb._cancel_on(fail_url, self.req_id))  # pylint: disable=protected-access
+                    continue
+                # Bytes reached the client: resume (greedy) or end
+                # truncated.
+                if self.max_new - len(self.emitted) < 1:
+                    # Every budgeted token is already with the
+                    # client; only the done event died. The stream
+                    # is complete — say so.
+                    await self._write_event(self._synthesize_done())
+                    return await self._finish_stream()
+                can_resume = (self.greedy and
+                              self.lb._resume_enabled() and  # pylint: disable=protected-access
+                              self.resumes < resume_budget)
+                if not can_resume:
+                    _M_RESUME_FAILURES.inc()
+                    logger.warning(
+                        'Replica %s died mid-stream after %d tokens; '
+                        'not resumable (greedy=%s budget=%d/%d) — '
+                        'truncated (trace=%s).', fail_url,
+                        len(self.emitted), self.greedy, self.resumes,
+                        resume_budget, self._trace_id)
+                    return await self._finish_stream()
+                self.resumes += 1
+                # One more attempt slot for the resume itself: the
+                # resume budget (SKYTPU_LB_RESUME_MAX) is the real
+                # bound, not the pre-stream attempt count.
+                attempts_left = max(attempts_left, 1)
+                logger.warning(
+                    'Replica %s died mid-stream after %d/%d tokens; '
+                    'resuming on another replica (trace=%s).',
+                    fail_url, len(self.emitted), self.max_new,
+                    self._trace_id)
+                continue
+            finally:
+                if sp.end_time is None:
+                    sp.finish(error='aborted')
+                for u in list(self._held):
+                    self._release(u)
+        # Out of candidates/attempts.
+        if self.client is not None:
+            _M_RESUME_FAILURES.inc()
+            logger.warning(
+                'Stream for request %s could not be resumed (no '
+                'healthy candidate / attempts exhausted after %d '
+                'tokens); ending truncated (trace=%s).', self.req_id,
+                len(self.emitted), self._trace_id)
+            return await self._finish_stream()
+        if self.last_shed is not None:
+            return self.last_shed.client_response()
+        if self.last_err is None:
+            return web.Response(status=503,
+                                text='No ready replicas.\n')
+        return web.Response(
+            status=502,
+            text=f'Replica unreachable: {self.last_err}\n')
+
+    # ------------------------------------------------ attempt driving
+    async def _drive_attempt(self, up: _SSEUpstream,
+                             sp) -> web.StreamResponse:
+        """Run one upstream attempt to client-stream completion.
+        Raises _ReplicaShedError / _NonStreamVerdict / _ClientGone /
+        aiohttp errors for run()'s arms; returns the finished client
+        response on success."""
+        attempt_started = self._loop.time()
+        resume_sp = None
+        if self.resumes:
+            resume_sp = trace_lib.start_span(
+                'lb.resume', to_replica=up.url,
+                tokens_done=len(self.emitted), attempt=self.resumes)
+        try:
+            first_event = await self._first_event(up)
+        except BaseException:
+            if resume_sp is not None and resume_sp.end_time is None:
+                # The resume target failed too: the span must still
+                # land (with ok=False) rather than leak open.
+                resume_sp.finish(ok=False)
+            raise
+        # The hedge race may have handed the stream to another
+        # upstream.
+        if self._winner is not None:
+            up = self._winner
+        self._active_url = up.url
+        # Hedge-delay signal: first-token latency of the upstream
+        # that PRODUCED it, measured from its own start (a hedge
+        # winner's sample must not embed the delay it waited behind).
+        # Resume continuations skip the window — their prefix-cached
+        # re-prefill TTFT is not an arrival-time sample.
+        if not self.resumes:
+            ttft = self._loop.time() - (up.started_at
+                                        or attempt_started)
+            self.lb._ttft_window.observe(ttft)  # pylint: disable=protected-access
+        if resume_sp is not None:
+            # The resume span's duration IS the stream gap the client
+            # saw between the dead replica's last token and the new
+            # replica's first event.
+            resume_sp.finish(ok=True)
+            _M_RESUMED.inc()
+            logger.info('Stream resumed on %s after %d tokens '
+                        '(trace=%s).', up.url, len(self.emitted),
+                        self._trace_id)
+        attempt_base = list(self.emitted)
+        ev: Optional[Dict[str, Any]] = first_event
+        try:
+            return await self._forward_events(up, ev, attempt_base)
+        except (asyncio.CancelledError, ConnectionResetError):
+            # The real client hung up — aiohttp either cancels the
+            # handler task or client.write() raises
+            # ConnectionResetError (the same two modes serving_http's
+            # stream handler documents). Abort upstream so the
+            # replica sees the hangup and cancels its request, then
+            # let the exception unwind (the opaque path propagates
+            # client-side write failures the same way).
+            up.close()
+            raise
+
+    async def _forward_events(self, up: _SSEUpstream,
+                              ev: Optional[Dict[str, Any]],
+                              attempt_base: List[int]
+                              ) -> web.StreamResponse:
+        while True:
+            if ev is None:
+                raise aiohttp.ServerDisconnectedError(
+                    'stream ended without a done event')
+            if ev.get('done'):
+                payload = dict(ev)
+                payload['tokens'] = (attempt_base +
+                                     list(ev.get('tokens') or ()))
+                if self.resumes:
+                    payload['resumed'] = self.resumes
+                if self.hedged:
+                    payload['hedged'] = True
+                await self._write_event(payload)
+                self.lb._note_success(up.url)  # pylint: disable=protected-access
+                return await self._finish_stream()
+            if 'error' in ev:
+                # Engine-side error event: forward verbatim and end —
+                # exactly what the replica's own stream would do.
+                await self._write_event(ev)
+                return await self._finish_stream()
+            toks = list(ev.get('tokens') or ())
+            first_chunk = self.client is None
+            await self._write_event({'tokens': toks})
+            self.emitted.extend(toks)
+            if first_chunk:
+                # Chaos parity with the opaque path: the client-
+                # disconnect site is polled once a chunk actually
+                # streamed.
+                self._disconnect_spec = fault_injection.poll(
+                    'lb.client_disconnect',
+                    kinds=(fault_injection.FaultKind
+                           .CLIENT_DISCONNECT,),
+                    replica=up.url, path='/generate')
+            if self._disconnect_spec is not None:
+                up.close()             # abort upstream: replica sees
+                raise _ClientGone()    # the hangup and cancels
+            ev = await up.next_event()
+
+    async def _first_event(self, up: _SSEUpstream
+                           ) -> Optional[Dict[str, Any]]:
+        """Start ``up`` and wait for its first SSE event, hedging on
+        a second replica when the primary streams nothing within the
+        hedge delay. Sets self._winner to the upstream that owns the
+        stream. Raises shed/verdict/transport errors from the
+        primary when no hedge saves the attempt."""
+        self._winner = None
+        await self._start_checked(up)
+        primary_task = asyncio.ensure_future(up.next_event())
+        can_hedge = (not self.emitted and not self.hedged and
+                     self.lb._hedge_enabled())  # pylint: disable=protected-access
+        if can_hedge:
+            delay = self.lb._hedge_delay_s()  # pylint: disable=protected-access
+            try:
+                ev = await asyncio.wait_for(
+                    asyncio.shield(primary_task), timeout=delay)
+                self._winner = up
+                return ev
+            except asyncio.TimeoutError:
+                pass
+            except BaseException:
+                primary_task.cancel()
+                up.close()
+                raise
+            return await self._hedge_race(up, primary_task, delay)
+        try:
+            ev = await primary_task
+            self._winner = up
+            return ev
+        except BaseException:
+            up.close()
+            raise
+
+    async def _start_checked(self, up: _SSEUpstream) -> None:
+        """start() + status triage: sheds raise _ReplicaShedError,
+        any other non-200 raises _NonStreamVerdict (passthrough)."""
+        resp = await up.start()
+        if resp.status in (429, 503):
+            body = await resp.read()
+            up.close()
+            raise _ReplicaShedError(resp.status, body,
+                                    dict(resp.headers))
+        if resp.status != 200:
+            body = await resp.read()
+            headers = {
+                k: v for k, v in resp.headers.items()
+                if k.lower() not in _HOP_HEADERS and
+                k.lower() != 'content-length'
+            }
+            up.close()
+            raise _NonStreamVerdict(
+                resp.status,
+                web.Response(status=resp.status, body=body,
+                             headers=headers))
+
+    async def _hedge_race(self, primary: _SSEUpstream, primary_task,
+                          delay: float) -> Optional[Dict[str, Any]]:
+        """The primary streamed nothing within the hedge delay: race
+        a second replica for the first token. Exactly one upstream
+        wins and owns the client stream; the loser is closed AND its
+        replica-side request cancelled by id."""
+        hedge_url = self.lb._pick(  # pylint: disable=protected-access
+            exclude=self.tried | self.lb._draining)  # pylint: disable=protected-access
+        if hedge_url is None:
+            # Nobody to hedge on: keep waiting on the primary alone.
+            try:
+                ev = await primary_task
+                self._winner = primary
+                return ev
+            except BaseException:
+                primary.close()
+                raise
+        self.tried.add(hedge_url)
+        self._held.append(hedge_url)
+        self.hedged = True
+        hsp = trace_lib.start_span('lb.hedge', primary=primary.url,
+                                   replica=hedge_url,
+                                   delay_s=round(delay, 4))
+        hedge = self._upstream(hedge_url)
+
+        async def hedge_first():
+            await self._start_checked(hedge)
+            return await hedge.next_event()
+
+        hedge_task = asyncio.ensure_future(hedge_first())
+        arms = {primary_task: primary, hedge_task: hedge}
+        pending = set(arms)
+        hedge_alive = True
+        primary_alive = True
+        primary_exc: Optional[BaseException] = None
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                winner_task = next(
+                    (t for t in done if t.exception() is None and
+                     t.result() is not None), None)
+                if winner_task is not None:
+                    winner = arms[winner_task]
+                    loser_task = (primary_task
+                                  if winner_task is hedge_task
+                                  else hedge_task)
+                    loser = arms[loser_task]
+                    loser_live = (primary_alive
+                                  if loser is primary
+                                  else hedge_alive)
+                    # The loser may have landed in the SAME wait()
+                    # batch: retrieve its outcome (else asyncio logs
+                    # 'exception was never retrieved' and a dead
+                    # replica's failure is never breaker-fed).
+                    loser_exc: Optional[BaseException] = None
+                    loser_streamed = False
+                    if loser_live and loser_task.done():
+                        loser_exc = loser_task.exception()
+                        if loser_exc is None:
+                            loser_streamed = (loser_task.result()
+                                              is not None)
+                            if not loser_streamed:
+                                loser_exc = (
+                                    aiohttp.ServerDisconnectedError(
+                                        'stream ended without '
+                                        'events'))
+                    if winner_task is hedge_task:
+                        outcome = 'won'
+                    elif not hedge_alive:
+                        # Hedge already failed in an earlier batch:
+                        # counted 'failed' there.
+                        outcome = None
+                    elif loser_exc is not None:
+                        outcome = 'failed'   # failed in THIS batch
+                    else:
+                        outcome = 'lost'
+                    if outcome is not None:
+                        _M_HEDGES.inc(1, outcome=outcome)
+                    if hsp.end_time is None:
+                        hsp.finish(outcome=outcome or 'failed')
+                    if loser_live:
+                        if not loser_task.done():
+                            loser_task.cancel()
+                        loser.close()
+                        if loser_exc is not None:
+                            self._note_race_failure(loser.url,
+                                                    loser_exc)
+                        else:
+                            # Cancelled mid-flight (or it streamed an
+                            # event nobody will forward): the loser
+                            # replica may hold the request — cancel
+                            # it so its slot frees now.
+                            asyncio.ensure_future(
+                                self.lb._cancel_on(loser.url,  # pylint: disable=protected-access
+                                                   self.req_id))
+                    self._release(loser.url)
+                    self._winner = winner
+                    logger.info(
+                        'Hedge race for request %s: %s won '
+                        '(primary=%s hedge=%s, trace=%s).',
+                        self.req_id, outcome or 'primary', primary.url,
+                        hedge_url, self._trace_id)
+                    return winner_task.result()
+                for t in done:
+                    # This arm failed (error, shed, or EOF without an
+                    # event): drop it from the race.
+                    exc = t.exception()
+                    if t is hedge_task:
+                        hedge_alive = False
+                        _M_HEDGES.inc(1, outcome='failed')
+                        if hsp.end_time is None:
+                            hsp.finish(outcome='failed')
+                        hedge.close()
+                        # A refused/dead hedge must feed the breaker
+                        # too — its exception never reaches run()'s
+                        # arms (the primary may still win).
+                        self._note_race_failure(hedge_url, exc)
+                        self._release(hedge_url)
+                        logger.info(
+                            'Hedge on %s failed (%s); primary still '
+                            'pending (trace=%s).', hedge_url, exc,
+                            self._trace_id)
+                    else:
+                        primary_alive = False
+                        primary_exc = (
+                            exc or aiohttp.ServerDisconnectedError(
+                                'stream ended without events'))
+                        primary.close()
+                        # Note the primary NOW: if the hedge wins,
+                        # this exception is swallowed and run() never
+                        # sees it; if both fail, run() skips the
+                        # double-note via _noted_exc.
+                        self._note_race_failure(primary.url,
+                                                primary_exc)
+                        self._noted_exc = primary_exc
+            # Both arms failed: surface the primary's failure so
+            # run()'s retry/resume arms see the usual taxonomy.
+            raise (primary_exc or
+                   aiohttp.ServerDisconnectedError(
+                       'hedge race produced no stream'))
+        finally:
+            if hsp.end_time is None:
+                hsp.finish(outcome='aborted')
+
+
+class _NonStreamVerdict(Exception):
+    """The replica answered /generate with a non-200, non-shed
+    response (400 bad request, 404, 409 duplicate id...): a final
+    verdict to pass through, not an attempt failure."""
+
+    def __init__(self, status: int, response: web.Response) -> None:
+        super().__init__(f'replica verdict {status}')
+        self.status = status
+        self.response = response
+
+
+class _ClientGone(Exception):
+    """The client hung up mid-stream (or the lb.client_disconnect
+    chaos site acted it out): end the attempt without retry/resume —
+    there is nobody left to stream to."""
+
+
+class _InjectedConnectError(aiohttp.ClientConnectorError):
+    """A fault-injected TCP connect failure (site lb.replica.connect):
+    walks the exact except arm a real ECONNREFUSED would."""
+
+    def __init__(self, msg: str) -> None:  # pylint: disable=super-init-not-called
+        self._conn_key = types.SimpleNamespace(host='fault-injection',
+                                               port=0, ssl=None)
+        self._os_error = ConnectionRefusedError(msg)
+        self._msg = msg
+
+    def __str__(self) -> str:
+        return self._msg
 
 
 class _MidStreamError(Exception):

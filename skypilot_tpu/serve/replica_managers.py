@@ -595,6 +595,36 @@ class ReplicaManager:
             _M_PROBE_FAILURES.inc(1, replica=url)
             return 'down'
 
+    def note_unreachable(self, url: str) -> None:
+        """First-hand unreachability evidence from the data plane
+        (docs/failover.md): the LB got a connection refused/reset on
+        a PROXY attempt — the replica process is gone or wedged NOW.
+        Demote the replica out of the routable set immediately
+        instead of waiting for the probe cycle to notice, and feed
+        the same consecutive-failure streak a failed probe would, so
+        a dead-app replica still reaches the terminate threshold.
+        Idempotent and cheap; called off the LB's event loop."""
+        for replica in serve_state.get_replicas(self.service_name):
+            if replica.get('url') != url:
+                continue
+            if replica['status'] not in (ReplicaStatus.READY,
+                                         ReplicaStatus.NOT_READY):
+                continue
+            rid = replica['replica_id']
+            with self._lock:
+                self._failed_probes[rid] = (
+                    self._failed_probes.get(rid, 0) + 1)
+                streak = self._failed_probes[rid]
+            _M_PROBE_FAILURES.inc(1, replica=url)
+            if replica['status'] is ReplicaStatus.READY:
+                logger.warning(
+                    'Replica %d at %s unreachable on a proxy attempt '
+                    '(streak %d): demoting to NOT_READY without '
+                    'waiting for the probe cycle.', rid, url, streak)
+                serve_state.set_replica_status(
+                    self.service_name, rid, ReplicaStatus.NOT_READY)
+            return
+
     def probe_all(self) -> None:
         """One probe pass: drive the FSM for every live replica."""
         spec_cache: Dict[int, ServiceSpec] = {}

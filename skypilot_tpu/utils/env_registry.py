@@ -289,6 +289,47 @@ SKYTPU_TICK_HANG_SECONDS = register(
     'many seconds logs a trace-tagged warning and bumps '
     'skytpu_engine_tick_hangs_total (0 disables; default 30).')
 
+# ------------------------------------------- replica failover (LB)
+SKYTPU_LB_BREAKER_THRESHOLD = register(
+    'SKYTPU_LB_BREAKER_THRESHOLD',
+    'Consecutive soft proxy failures (timeout, mid-stream death, '
+    '5xx) before the LB\'s per-replica circuit breaker trips open '
+    '(docs/failover.md; default 3). A hard connect-refused/reset '
+    'trips immediately regardless.')
+SKYTPU_LB_BREAKER_COOLDOWN_S = register(
+    'SKYTPU_LB_BREAKER_COOLDOWN_S',
+    'Seconds an open circuit breaker holds a replica out of the '
+    'routable set before admitting ONE half-open trial request '
+    '(success re-closes, failure re-opens; default 2).')
+SKYTPU_LB_HEDGE = register(
+    'SKYTPU_LB_HEDGE',
+    'TTFT hedging for streaming /generate at the LB: a request that '
+    'has streamed ZERO bytes after the hedge delay is raced on a '
+    'second replica, the loser cancelled by request id '
+    '(docs/failover.md). Default on; set 0 to disable.')
+SKYTPU_LB_HEDGE_DELAY_S = register(
+    'SKYTPU_LB_HEDGE_DELAY_S',
+    'Fallback hedge delay in seconds while the LB\'s sliding TTFT '
+    'window has no samples yet (default 2). Once the window fills, '
+    'the delay is its p95 TTFT (never below '
+    'SKYTPU_LB_HEDGE_MIN_S).')
+SKYTPU_LB_HEDGE_MIN_S = register(
+    'SKYTPU_LB_HEDGE_MIN_S',
+    'Floor on the p95-TTFT-derived hedge delay in seconds (default '
+    '0.05): a very fast window must not hedge every request that '
+    'hits one slow tick.')
+SKYTPU_LB_RESUME = register(
+    'SKYTPU_LB_RESUME',
+    'Mid-stream resumption for GREEDY streaming /generate at the '
+    'LB: when a replica dies mid-stream, the prompt plus the tokens '
+    'already streamed are re-submitted to a healthy replica and the '
+    'continuation spliced into the client\'s SSE stream '
+    '(docs/failover.md). Default on; set 0 to disable.')
+SKYTPU_LB_RESUME_MAX = register(
+    'SKYTPU_LB_RESUME_MAX',
+    'Max resume attempts per client stream before the LB gives up '
+    'and ends the (truncated) stream (default 3).')
+
 # ------------------------------------------------- bench.py (BENCH_*)
 BENCH_SMOKE = register(
     'BENCH_SMOKE',
@@ -476,6 +517,25 @@ BENCH_FLEET_DEADLINE_S = register(
     'BENCH_FLEET_DEADLINE_S',
     'fleet bench: overall settle deadline in seconds before the '
     'round reports a timeout.')
+BENCH_CHAOS_REPLICAS = register(
+    'BENCH_CHAOS_REPLICAS',
+    'serve_chaos bench: replica subprocesses behind the in-process '
+    'LB (default 2). Replicas always run on CPU — the measured '
+    'article is the failover machinery, not the chip.')
+BENCH_CHAOS_KILLS = register(
+    'BENCH_CHAOS_KILLS',
+    'serve_chaos bench: replicas to SIGKILL mid-run at seeded '
+    'trace-relative times (default 1; clamped below the replica '
+    'count so at least one survivor remains).')
+BENCH_CHAOS_SEED = register(
+    'BENCH_CHAOS_SEED',
+    'serve_chaos bench: seed for the workload trace AND the kill '
+    'schedule (same seed => same trace bytes and same kill '
+    'times/targets — the determinism receipt).')
+BENCH_CHAOS_MIN_RATIO = register(
+    'BENCH_CHAOS_MIN_RATIO',
+    'serve_chaos bench: minimum goodput-under-chaos over same-seed '
+    'no-chaos baseline for the round to report ok (default 0.9).')
 BENCH_SPEC_K = register(
     'BENCH_SPEC_K',
     'Speculative-decoding draft length for the decode/serve benches '

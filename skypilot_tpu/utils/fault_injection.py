@@ -88,6 +88,13 @@ KNOWN_SITES = (
     'engine.tick.hang',
     'serve.replica.drain',
     'lb.client_disconnect',
+    # Replica-failure survivability sites (docs/failover.md): an
+    # injected connect failure on a proxy attempt (drives the LB's
+    # per-replica circuit breaker without killing a process), and the
+    # chaos-replay harness's seeded replica SIGKILL schedule (an armed
+    # plan can veto or record individual kills; loadgen/replay.py).
+    'lb.replica.connect',
+    'serve.replica.kill',
     # Crashpoints (docs/crash_recovery.md): named instructions inside
     # the controllers' multi-step operations where a `crash` fault
     # os._exit()s the process — the chaos analogue of `kill -9` at
@@ -136,6 +143,10 @@ class FaultKind(str, enum.Enum):
     # params['seconds']) and a client that hangs up mid-response.
     HANG = 'hang'
     CLIENT_DISCONNECT = 'client_disconnect'
+    # A TCP connect that is refused/reset before the request is ever
+    # received (lb.replica.connect): the caller KNOWS the peer never
+    # saw the request, so retry/breaker logic may act immediately.
+    CONNECT_FAILURE = 'connect_failure'
     # Crash-only-software kind: the process os._exit()s at the site —
     # no excepts run, no finallys, no atexit — indistinguishable from
     # `kill -9` at that instruction (docs/crash_recovery.md).
@@ -384,6 +395,8 @@ def make_exception(spec: FaultSpec, site: str) -> Exception:
         return TimeoutError(msg)
     if spec.kind is FaultKind.CLIENT_DISCONNECT:
         return ConnectionResetError(msg)
+    if spec.kind is FaultKind.CONNECT_FAILURE:
+        return ConnectionRefusedError(msg)
     if spec.kind is FaultKind.CRASH:
         # CRASH is meant for crashpoint() (which never raises); via
         # inject() it manifests as the exit it would have been.

@@ -51,8 +51,11 @@ _LATE_FILES = ('test_retry.py', 'test_fault_injection.py',
 # drive real local clusters through kill+restart cycles — priced like
 # the chaos suite, at the very end of the fast tier. The fleet suite
 # (multi-worker harness runs + subprocess kill-at-crashpoint round
-# trips + the bench fleet smoke) is priced the same way.
-_LATEST_FILES = ('test_crash_recovery.py', 'test_fleet.py')
+# trips + the bench fleet smoke) is priced the same way, as is the
+# failover suite (real replica subprocesses SIGKILLed mid-stream +
+# the bench serve_chaos smoke).
+_LATEST_FILES = ('test_crash_recovery.py', 'test_fleet.py',
+                 'test_failover.py')
 
 
 def pytest_collection_modifyitems(config, items):

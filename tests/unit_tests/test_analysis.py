@@ -728,6 +728,76 @@ class TestDirectClockInControlPlane:
             assert [v for v in vs if v.rule == 'STL011'] == [], rel
 
 
+# ---------------------------------------------------------------- STL012
+class TestHttpCallWithoutTimeout:
+
+    def test_fires_on_requests_verbs(self):
+        vs = lint('''
+            import requests
+            r = requests.get('http://x/health')
+            ''')
+        assert rules_of(vs) == ['STL012']
+        assert 'timeout=' in vs[0].message
+
+    def test_fires_on_session_calls(self):
+        for call in ('self.session.request("GET", url)',
+                     'self._session.post(url, json=body)',
+                     'session.get(url)'):
+            vs = lint(f'''
+                def f(self, session, url, body):
+                    return {call}
+                ''')
+            assert rules_of(vs) == ['STL012'], call
+
+    def test_fires_on_urlopen(self):
+        vs = lint('''
+            import urllib.request
+            r = urllib.request.urlopen('http://x/metrics')
+            ''')
+        assert rules_of(vs) == ['STL012']
+
+    def test_quiet_with_timeout(self):
+        assert lint('''
+            import requests
+            import urllib.request
+            r = requests.post('http://x', json={}, timeout=(5, 15))
+            u = urllib.request.urlopen('http://x', timeout=2)
+
+            def f(session, url):
+                return session.get(url, timeout=1)
+            ''') == []
+
+    def test_quiet_on_non_http_lookalikes(self):
+        # dict.get / non-session attribute bases / non-verb methods
+        # on a session must not fire.
+        assert lint('''
+            def f(d, session, cache):
+                a = d.get('k')
+                b = cache.get('k', None)
+                c = session.get_credentials()
+                return a, b, c
+            ''') == []
+
+    def test_repo_http_sites_are_clean(self):
+        """The audited call sites (probe, drain, cancel broadcast,
+        metrics scrape, cloud REST) are the rule's motivating
+        examples — targeted canary on top of the repo gate."""
+        for rel in ('serve/replica_managers.py',
+                    'serve/autoscalers.py',
+                    'serve/load_balancer.py',
+                    'provision/gcp/api.py',
+                    'provision/kubernetes/api.py',
+                    'usage/usage_lib.py',
+                    'loadgen/replay.py'):
+            path = os.path.join(_REPO_ROOT, 'skypilot_tpu',
+                                *rel.split('/'))
+            with open(path, encoding='utf-8') as f:
+                vs = analyze_source(f.read(),
+                                    path=f'skypilot_tpu/{rel}',
+                                    project=Project())
+            assert [v for v in vs if v.rule == 'STL012'] == [], rel
+
+
 # ----------------------------------------------------------- suppression
 class TestSuppression:
 

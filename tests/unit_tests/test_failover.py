@@ -1,0 +1,632 @@
+"""Replica-failure survivability tests (docs/failover.md).
+
+- Circuit-breaker state machine under a FakeClock: hard-failure
+  trips, soft-failure thresholds, open rejects, the single half-open
+  trial, re-open on trial failure, recovery on trial success;
+- connection-refused on a PROXY attempt (killed listener AND the
+  ``lb.replica.connect`` chaos site) ejects the replica immediately
+  and notifies the replica manager (``note_unreachable`` demotes
+  without waiting for the probe cycle);
+- TTFT hedging: a slow primary races a hedge, exactly ONE stream
+  reaches the client, the loser is cancelled by request id;
+- duplicate X-Request-ID on one replica answers 409 (the engine's
+  DuplicateRequestError surfaced over HTTP — the hedge dedup key);
+- mid-stream SIGKILL of a real replica subprocess: the stream is
+  resumed on the survivor and the spliced tokens are bitwise equal
+  to an uninterrupted oracle run (zero duplicated, zero dropped);
+- ``bench.py serve_chaos`` smoke: deterministic trace + kill
+  schedule across two subprocess runs, goodput ratio gate, parity.
+"""
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+from aiohttp import web
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.serve import failover
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.utils import fault_injection as fi
+from skypilot_tpu.utils import retry as retry_lib
+
+pytestmark = pytest.mark.failover
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _counter(name, **labels):
+    metric = metrics_lib.REGISTRY.get(name)
+    return 0.0 if metric is None else metric.value(**labels)
+
+
+def _gauge(name, **labels):
+    metric = metrics_lib.REGISTRY.get(name)
+    return None if metric is None else metric.value(**labels)
+
+
+# ================================================== circuit breaker
+class TestCircuitBreaker:
+
+    def _b(self, clock, threshold=3, cooldown=2.0):
+        return failover.CircuitBreaker('http://r:1',
+                                       threshold=threshold,
+                                       cooldown_s=cooldown,
+                                       clock=clock)
+
+    def test_hard_failure_trips_immediately(self):
+        clock = retry_lib.FakeClock()
+        b = self._b(clock)
+        assert b.state == failover.CLOSED and not b.blocked()
+        b.record_failure(hard=True)
+        assert b.state == failover.OPEN
+        assert b.blocked()
+        assert b.trips == 1
+        assert _counter('skytpu_lb_breaker_trips_total',
+                        replica='http://r:1') == 1
+        assert _gauge('skytpu_lb_breaker_state',
+                      replica='http://r:1') == 1
+
+    def test_soft_failures_trip_at_threshold_and_success_resets(self):
+        clock = retry_lib.FakeClock()
+        b = self._b(clock, threshold=3)
+        b.record_failure()
+        b.record_failure()
+        assert b.state == failover.CLOSED
+        b.record_success()              # streak resets
+        b.record_failure()
+        b.record_failure()
+        assert b.state == failover.CLOSED
+        b.record_failure()              # third consecutive
+        assert b.state == failover.OPEN
+        assert b.trips == 1
+
+    def test_open_blocks_until_cooldown_then_single_trial(self):
+        clock = retry_lib.FakeClock()
+        b = self._b(clock, cooldown=2.0)
+        b.record_failure(hard=True)
+        assert b.blocked()
+        clock.advance(1.0)
+        assert b.blocked()              # cooldown still running
+        clock.advance(1.5)
+        assert not b.blocked()          # candidate again
+        b.acquire()                     # the pick consumes the trial
+        assert b.state == failover.HALF_OPEN
+        assert _gauge('skytpu_lb_breaker_state',
+                      replica='http://r:1') == 2
+        assert b.blocked()              # only ONE trial in flight
+
+    def test_trial_failure_reopens(self):
+        clock = retry_lib.FakeClock()
+        b = self._b(clock, cooldown=2.0)
+        b.record_failure(hard=True)
+        clock.advance(3.0)
+        b.acquire()
+        b.record_failure()
+        assert b.state == failover.OPEN
+        assert b.trips == 2
+        assert b.blocked()              # fresh cooldown from now
+        clock.advance(2.5)
+        assert not b.blocked()
+
+    def test_abandoned_trial_releases_instead_of_wedging(self):
+        """A consumed half-open trial whose attempt ends with NO
+        verdict (shed / client hangup / cancelled hedge loser) must
+        release the trial — otherwise the replica is blocked forever
+        with no way to ever record an outcome."""
+        clock = retry_lib.FakeClock()
+        b = self._b(clock, cooldown=2.0)
+        b.record_failure(hard=True)
+        clock.advance(3.0)
+        b.acquire()                      # trial consumed
+        assert b.blocked()
+        b.abandon_trial()                # shed: no verdict
+        assert b.state == failover.HALF_OPEN
+        assert not b.blocked()           # next pick re-probes
+        b.acquire()
+        b.record_success()
+        assert b.state == failover.CLOSED
+        # After a resolved trial, abandon is a no-op.
+        b.abandon_trial()
+        assert b.state == failover.CLOSED and not b.blocked()
+
+    def test_trial_success_recovers(self):
+        clock = retry_lib.FakeClock()
+        b = self._b(clock, cooldown=2.0)
+        b.record_failure(hard=True)
+        clock.advance(3.0)
+        b.acquire()
+        b.record_success()
+        assert b.state == failover.CLOSED
+        assert not b.blocked()
+        assert b.recoveries == 1
+        assert _counter('skytpu_lb_breaker_recoveries_total',
+                        replica='http://r:1') == 1
+        assert _gauge('skytpu_lb_breaker_state',
+                      replica='http://r:1') == 0
+
+
+# ============================================= manager notification
+def test_note_unreachable_demotes_and_feeds_streak(monkeypatch):
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    mgr.service_name = 'svc'
+    mgr._lock = threading.Lock()
+    mgr._failed_probes = {}
+    rows = [{'replica_id': 7, 'url': 'http://r7:9000',
+             'status': ReplicaStatus.READY},
+            {'replica_id': 8, 'url': 'http://r8:9000',
+             'status': ReplicaStatus.READY}]
+    transitions = []
+    monkeypatch.setattr(replica_managers.serve_state, 'get_replicas',
+                        lambda name: rows)
+    monkeypatch.setattr(
+        replica_managers.serve_state, 'set_replica_status',
+        lambda name, rid, status, **kw: transitions.append(
+            (rid, status)))
+    mgr.note_unreachable('http://r7:9000')
+    assert transitions == [(7, ReplicaStatus.NOT_READY)]
+    assert mgr._failed_probes == {7: 1}    # feeds the probe streak
+    # Unknown URL: no-op.
+    mgr.note_unreachable('http://nope:1')
+    assert transitions == [(7, ReplicaStatus.NOT_READY)]
+    # Already NOT_READY: streak still advances toward terminate, but
+    # no redundant status write.
+    rows[0]['status'] = ReplicaStatus.NOT_READY
+    mgr.note_unreachable('http://r7:9000')
+    assert mgr._failed_probes == {7: 2}
+    assert transitions == [(7, ReplicaStatus.NOT_READY)]
+
+
+# ================================================ LB breaker wiring
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _ok_app(calls):
+    async def generate(request):
+        calls.append(request.headers.get('X-Request-ID'))
+        return web.json_response({'ok': True})
+
+    app = web.Application()
+    app.router.add_post('/generate', generate)
+    return app
+
+
+def test_connect_refused_ejects_and_notifies():
+    """Satellite: a connection-refused on PROXY (killed listener, not
+    a probe) immediately removes the replica from the pickable set
+    and notifies the replica manager callback."""
+    dead_port = _free_port()           # bound then closed: refuses
+    dead = f'http://127.0.0.1:{dead_port}'
+    calls, downs = [], []
+
+    async def scenario():
+        import aiohttp
+        runner = web.AppRunner(_ok_app(calls))
+        await runner.setup()
+        site = web.TCPSite(runner, '127.0.0.1', 0)
+        await site.start()
+        live_port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+        live = f'http://127.0.0.1:{live_port}'
+        lb = LoadBalancer(port=0, on_replica_down=downs.append)
+        await lb.start()
+        # Dead FIRST so least-load's tie-break picks it first.
+        lb.set_replica_urls([dead, live])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + '/generate',
+                              json={'x': 1}) as r:
+                assert r.status == 200          # retried onto live
+            # Second request: the open breaker excludes the dead
+            # replica outright — no second connect attempt.
+            async with s.post(base + '/generate',
+                              json={'x': 2}) as r:
+                assert r.status == 200
+        await asyncio.sleep(0.1)   # executor callback lands
+        await lb.stop()
+        await runner.cleanup()
+
+    asyncio.run(scenario())
+    assert len(calls) == 2
+    assert downs == [dead]
+    assert _gauge('skytpu_lb_breaker_state', replica=dead) == 1
+    assert _counter('skytpu_lb_breaker_trips_total',
+                    replica=dead) == 1
+    assert _counter('skytpu_lb_replica_errors_total',
+                    replica=dead, kind='connect') == 1
+
+
+def test_injected_connect_fault_drives_breaker():
+    """The lb.replica.connect chaos site: an injected connect failure
+    walks the exact hard-failure path — breaker trips, request is
+    retried on another replica — without killing any process."""
+    calls, downs = [], []
+
+    async def scenario():
+        import aiohttp
+        apps = []
+        urls = []
+        for _ in range(2):
+            runner = web.AppRunner(_ok_app(calls))
+            await runner.setup()
+            site = web.TCPSite(runner, '127.0.0.1', 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+            apps.append(runner)
+            urls.append(f'http://127.0.0.1:{port}')
+        lb = LoadBalancer(port=0, on_replica_down=downs.append)
+        await lb.start()
+        lb.set_replica_urls(urls)
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + '/generate',
+                              json={'x': 1}) as r:
+                assert r.status == 200
+        await asyncio.sleep(0.1)
+        await lb.stop()
+        for runner in apps:
+            await runner.cleanup()
+        return urls
+
+    with fi.fault_plan(faults=[
+            {'site': 'lb.replica.connect', 'kind': 'connect_failure',
+             'times': 1}]):
+        urls = asyncio.run(scenario())
+    assert len(calls) == 1             # one replica served it
+    assert len(downs) == 1 and downs[0] in urls
+    assert _counter('skytpu_faults_injected_total',
+                    site='lb.replica.connect',
+                    kind='connect_failure') == 1
+    assert _counter('skytpu_lb_breaker_trips_total',
+                    replica=downs[0]) == 1
+
+
+# ========================================================== hedging
+def _sse_replica_app(tokens, calls, cancels, first_delay=0.0):
+    async def generate(request):
+        calls.append(request.headers.get('X-Request-ID'))
+        resp = web.StreamResponse(headers={
+            'Content-Type': 'text/event-stream'})
+        await resp.prepare(request)
+        try:
+            if first_delay:
+                await asyncio.sleep(first_delay)
+            for t in tokens:
+                await resp.write(
+                    f'data: {json.dumps({"tokens": [t]})}\n\n'
+                    .encode())
+            done = {'done': True, 'tokens': list(tokens),
+                    'latency_s': 0.01, 'status': 'finished',
+                    'reason': None}
+            await resp.write(
+                f'data: {json.dumps(done)}\n\n'.encode())
+            await resp.write_eof()
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        return resp
+
+    async def cancel(request):
+        cancels.append(request.match_info['request_id'])
+        return web.json_response({'cancelling': True}, status=202)
+
+    app = web.Application()
+    app.router.add_post('/generate', generate)
+    app.router.add_post('/cancel/{request_id}', cancel)
+    return app
+
+
+def test_hedge_slow_primary_exactly_one_stream(monkeypatch):
+    """TTFT hedging: the primary streams nothing within the hedge
+    delay, the hedge wins, EXACTLY one token stream reaches the
+    client, and the loser is cancelled by request id."""
+    monkeypatch.setenv('SKYTPU_LB_HEDGE_DELAY_S', '0.15')
+    slow_calls, slow_cancels = [], []
+    fast_calls, fast_cancels = [], []
+
+    async def scenario():
+        import aiohttp
+        slow = web.AppRunner(_sse_replica_app(
+            [101, 102], slow_calls, slow_cancels, first_delay=5.0))
+        fast = web.AppRunner(_sse_replica_app(
+            [7, 8, 9], fast_calls, fast_cancels))
+        urls = []
+        for runner in (slow, fast):
+            await runner.setup()
+            site = web.TCPSite(runner, '127.0.0.1', 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+            urls.append(f'http://127.0.0.1:{port}')
+        lb = LoadBalancer(port=0)
+        await lb.start()
+        lb.set_replica_urls(urls)      # slow first: picked as primary
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        inc, dones = [], []
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    base + '/generate',
+                    json={'tokens': [1, 2], 'max_new': 3,
+                          'stream': True},
+                    headers={'X-Request-ID': 'hedge-1'}) as r:
+                assert r.status == 200
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith('data:'):
+                        continue
+                    ev = json.loads(line[5:])
+                    if ev.get('done'):
+                        dones.append(ev)
+                    else:
+                        inc.extend(ev.get('tokens') or [])
+        await asyncio.sleep(0.3)       # loser-cancel task lands
+        await lb.stop()
+        await slow.cleanup()
+        await fast.cleanup()
+        return inc, dones
+
+    inc, dones = asyncio.run(scenario())
+    # Exactly one terminal stream, and it is the hedge's.
+    assert len(dones) == 1
+    assert dones[0]['tokens'] == [7, 8, 9]
+    assert dones[0].get('hedged') is True
+    assert inc == [7, 8, 9]            # no slow-replica token leaked
+    # Both replicas saw the SAME request id; the loser got the
+    # targeted cancel.
+    assert slow_calls == ['hedge-1'] and fast_calls == ['hedge-1']
+    assert slow_cancels == ['hedge-1']
+    assert fast_cancels == []
+    assert _counter('skytpu_lb_hedges_total', outcome='won') == 1
+    assert _counter('skytpu_lb_hedges_total', outcome='lost') == 0
+
+
+# ================================================ duplicate req ids
+def test_duplicate_request_id_409():
+    """The engine's DuplicateRequestError surfaces as HTTP 409 for a
+    second /generate with the SAME X-Request-ID while the first is in
+    flight on the same replica — the per-replica at-most-once
+    execution guarantee hedging leans on."""
+    import jax
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    from skypilot_tpu.models.serving_http import EngineServer
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, cfg, batch_size=2, max_prompt=16,
+                           max_seq=256, decode_chunk=2,
+                           prefill_chunk=8, prefill_budget=16)
+    server = EngineServer(engine, warmup=False)
+
+    async def scenario():
+        import aiohttp
+        runner = await server.start(0)
+        port = runner.addresses[0][1]
+        base = f'http://127.0.0.1:{port}'
+        async with aiohttp.ClientSession() as s:
+            for _ in range(600):
+                async with s.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(0.05)
+            hdr = {'X-Request-ID': 'dup-1'}
+            r1 = await s.post(base + '/generate',
+                              json={'tokens': [1, 2], 'max_new': 200,
+                                    'stream': True}, headers=hdr)
+            assert r1.status == 200
+            await r1.content.readline()    # first bytes: in flight
+            async with s.post(base + '/generate',
+                              json={'tokens': [1, 2], 'max_new': 4},
+                              headers=hdr) as r2:
+                assert r2.status == 409
+                body = await r2.json()
+                assert body['reason'] == 'duplicate_request'
+            r1.close()
+            # The disconnect cancels request 1; the id frees for
+            # reuse once terminal.
+            for _ in range(400):
+                if not engine.num_active() and not engine.queue:
+                    break
+                await asyncio.sleep(0.05)
+            async with s.post(base + '/generate',
+                              json={'tokens': [1, 2], 'max_new': 2},
+                              headers=hdr) as r3:
+                assert r3.status == 200
+        await runner.cleanup()
+
+    with fi.fault_plan(faults=[
+            {'site': 'engine.tick.hang', 'kind': 'hang',
+             'times': None, 'params': {'seconds': 0.02}}]):
+        asyncio.run(scenario())
+    server.stop()
+
+
+# ======================================== mid-stream SIGKILL resume
+def _spawn_replica(port, extra_env=None):
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.models.serving_http',
+         '--port', str(port), '--model', 'tiny', '--batch', '4',
+         '--max-prompt', '96', '--max-seq', '128',
+         '--decode-chunk', '1', '--prefill-chunk', '16',
+         '--prefill-budget', '32'],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _wait_ready(url, deadline_s=240):
+    t0 = time.time()
+    while True:
+        assert time.time() - t0 < deadline_s, \
+            f'replica {url} never became ready'
+        try:
+            with urllib.request.urlopen(url + '/health',
+                                        timeout=1) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.2)
+
+
+def test_midstream_sigkill_resume_bitwise_parity():
+    """The acceptance headline in miniature: a real replica
+    subprocess is SIGKILLed mid-stream; the LB resumes the greedy
+    stream on the survivor and the spliced token sequence is BITWISE
+    equal to an uninterrupted oracle run — zero duplicated, zero
+    dropped tokens."""
+    hang = json.dumps({'faults': [
+        {'site': 'engine.tick.hang', 'kind': 'hang', 'times': None,
+         'params': {'seconds': 0.05}}]})
+    ports = [_free_port(), _free_port()]
+    procs = [_spawn_replica(p, {'SKYTPU_FAULT_PLAN': hang})
+             for p in ports]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    try:
+        for u in urls:
+            _wait_ready(u)
+        resumed_before = _counter('skytpu_lb_resumed_streams_total')
+
+        async def scenario():
+            import aiohttp
+            lb = LoadBalancer(port=0)
+            await lb.start()
+            lb.set_replica_urls(urls)
+            base = f'http://127.0.0.1:{lb.bound_port}'
+            req = {'tokens': [1, 2, 3, 4], 'max_new': 30,
+                   'stream': True}
+
+            async def stream(payload, kill_after=None):
+                inc, done = [], None
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(base + '/generate',
+                                      json=payload) as r:
+                        assert r.status == 200
+                        async for raw in r.content:
+                            line = raw.decode().strip()
+                            if not line.startswith('data:'):
+                                continue
+                            ev = json.loads(line[5:])
+                            if ev.get('done'):
+                                done = ev
+                                break
+                            inc.extend(ev.get('tokens') or [])
+                            if (kill_after is not None and
+                                    len(inc) >= kill_after and
+                                    kill_after >= 0):
+                                for i, u in enumerate(urls):
+                                    if lb.inflight(u) > 0:
+                                        procs[i].send_signal(
+                                            signal.SIGKILL)
+                                        break
+                                kill_after = -1   # once
+                return inc, done
+
+            oracle_inc, oracle_done = await stream(req)
+            inc, done = await stream(req, kill_after=5)
+            await lb.stop()
+            return oracle_inc, oracle_done, inc, done
+
+        oracle_inc, oracle_done, inc, done = asyncio.run(scenario())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    assert oracle_done['status'] == 'finished'
+    assert len(oracle_done['tokens']) == 30
+    # The resumed stream finished, says so, and is bitwise identical
+    # to the uninterrupted oracle — incremental events AND the
+    # rewritten done event.
+    assert done is not None and done['status'] == 'finished'
+    assert done.get('resumed') == 1
+    assert done['tokens'] == oracle_done['tokens']
+    assert inc == oracle_inc == oracle_done['tokens']
+    assert (_counter('skytpu_lb_resumed_streams_total') -
+            resumed_before) == 1
+    assert _counter('skytpu_lb_resume_failures_total') == 0
+
+
+# ================================================== score breakdown
+def test_score_breakdown_resumed_hedged_golden():
+    """Satellite: the goodput report's breakdown gains resumed/hedged
+    recovery counts — golden-test the exact shape."""
+    from skypilot_tpu import loadgen
+    recs = [
+        loadgen.RequestRecord(request_id=0, scheduled_s=0.0,
+                              submitted_s=0.0, status='finished',
+                              ttft_s=0.1, finished_s=1.0, n_tokens=4,
+                              resumed=1, tokens=[1, 2, 3, 4]),
+        loadgen.RequestRecord(request_id=1, scheduled_s=0.5,
+                              submitted_s=0.5, status='finished',
+                              ttft_s=0.2, finished_s=1.2, n_tokens=4,
+                              hedged=True),
+        loadgen.RequestRecord(request_id=2, scheduled_s=1.0,
+                              submitted_s=1.0, status='shed',
+                              reason='queue_full'),
+    ]
+    rep = loadgen.score(recs, loadgen.SLO(ttft_s=1.0), wall_s=2.0)
+    assert rep['breakdown'] == {
+        'finished': 2, 'expired': 0, 'cancelled': 0, 'shed': 1,
+        'deadline_rejected': 0, 'error': 0,
+        'resumed': 1, 'hedged': 1,
+    }
+
+
+# =============================================== chaos bench (smoke)
+def _run_chaos_bench(seed):
+    env = {**os.environ, 'BENCH_SMOKE': '1', 'JAX_PLATFORMS': 'cpu',
+           'BENCH_MODE': 'serve_chaos', 'BENCH_CHAOS_SEED': str(seed),
+           'BENCH_LOAD_REQUESTS': '10',
+           # Laxer gate than the real round's 0.9: a loaded CI box
+           # slows both runs but not perfectly symmetrically.
+           'BENCH_CHAOS_MIN_RATIO': '0.6'}
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, 'bench.py')],
+        env=env, cwd=_REPO_ROOT, capture_output=True, text=True,
+        timeout=540)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith('{')]
+    assert lines, proc.stdout[-2000:] + proc.stderr[-2000:]
+    return proc.returncode, json.loads(lines[-1])
+
+
+def test_bench_serve_chaos_smoke_deterministic():
+    """bench.py serve_chaos under BENCH_SMOKE: real replica
+    subprocesses, a real SIGKILL, goodput scored vs the same-seed
+    baseline. Two runs must agree on the trace digest AND the kill
+    schedule (the determinism receipts); the run must report ok with
+    at least one kill executed, a breaker trip, and zero resumed-
+    stream parity mismatches."""
+    rc1, first = _run_chaos_bench(seed=3)
+    d = first['detail']
+    assert rc1 == 0, json.dumps(first)[:2000]
+    assert d['ok'] is True
+    assert d['kills_executed'] >= 1
+    assert d['breaker_trips'] >= 1
+    assert d['resume_parity']['mismatched'] == 0
+    assert d['resume_parity']['length_mismatches'] == 0
+
+    rc2, second = _run_chaos_bench(seed=3)
+    assert rc2 == 0
+    d2 = second['detail']
+    assert d2['trace_sha256'] == d['trace_sha256']
+    assert d2['kill_schedule'] == d['kill_schedule']
+    assert d2['schedule_head_s'] == d['schedule_head_s']
