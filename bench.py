@@ -1336,6 +1336,341 @@ def serve_chaos_bench():
     return 0 if ok else 1
 
 
+def serve_spot_bench():
+    """Spot-native serving bench (docs/spot_serving.md): the same
+    seeded open-loop trace replayed twice through a real LB ->
+    replica-subprocess stack — once against the pool billed entirely
+    on-demand (the baseline), once against a mixed spot/on-demand
+    pool under a seeded notice→SIGKILL preemption schedule. Each
+    doomed spot replica gets a cloud-style advance notice
+    ``BENCH_SPOT_NOTICE_S`` seconds before its kill: the LB stops
+    routing to it and proactively migrates its live streams to
+    survivors (preferring on-demand on load ties), so a noticed
+    preemption costs zero client-visible errors and the migrated
+    streams stay bitwise-identical to the baseline's uninterrupted
+    ones.
+
+    The headline is goodput under preemptions over the same-seed
+    clean goodput; the detail carries the $/Mtok proxy — chip-seconds
+    per good (finished) token for both runs, with spot chip-seconds
+    discounted at ``BENCH_SPOT_PRICE_RATIO`` — the economic argument
+    for running serving on spot at all. Replicas always run on CPU
+    (tick pace stretched via ``engine.tick.hang`` in BOTH runs, so
+    the ratio isolates the preemptions). Same BENCH_SPOT_SEED =>
+    byte-identical trace and preemption schedule.
+    """
+    import asyncio
+    import signal
+    import subprocess
+    import tempfile
+
+    import aiohttp
+
+    from skypilot_tpu import loadgen
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import fault_injection
+
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    n_spot = max(2, int(os.environ.get('BENCH_SPOT_REPLICAS', '2')))
+    n_od = max(1, int(os.environ.get('BENCH_SPOT_ONDEMAND', '1')))
+    n_total = n_spot + n_od
+    # At least one spot survivor: the point is migration, not
+    # annihilation (killing ALL spot leaves only the on-demand floor,
+    # which docs/spot_serving.md's headroom math already covers).
+    n_kills = max(1, min(int(os.environ.get('BENCH_SPOT_KILLS', '1')),
+                         n_spot - 1))
+    seed = int(os.environ.get('BENCH_SPOT_SEED', '0'))
+    min_ratio = float(os.environ.get('BENCH_SPOT_MIN_RATIO', '0.9'))
+    notice_s = max(0.0, float(os.environ.get('BENCH_SPOT_NOTICE_S',
+                                             '2')))
+    price_ratio = float(os.environ.get('BENCH_SPOT_PRICE_RATIO',
+                                       '0.3'))
+    n_requests = int(os.environ.get('BENCH_LOAD_REQUESTS',
+                                    '16' if smoke else '48'))
+    qps = float(os.environ.get('BENCH_LOAD_QPS',
+                               '6' if smoke else '8'))
+    slo = loadgen.SLO(
+        ttft_s=float(os.environ.get('BENCH_LOAD_SLO_TTFT', '10')),
+        itl_p99_s=float(os.environ.get('BENCH_LOAD_SLO_ITL', '5')))
+    # Same workload shape as serve_chaos: prompt_max + output_max <=
+    # max_prompt so migrated continuations always fit the replica's
+    # prompt region.
+    max_prompt, max_seq = 96, 128
+    spec = loadgen.WorkloadSpec(
+        seed=seed, n_requests=n_requests, qps=qps, arrival='poisson',
+        vocab_size=256,
+        prompt_median=16, prompt_min=4, prompt_max=40,
+        output_median=14, output_sigma=0.3, output_min=8,
+        output_max=24)
+    trace = loadgen.generate(spec)
+    trace_digest = loadgen.digest(trace)
+    by_id = {r.request_id: r for r in trace}
+    span = max(r.arrival_s for r in trace)
+    # Preemptions draw over SPOT indices only (0..n_spot-1): the
+    # cloud never reclaims the on-demand fallback.
+    schedule = loadgen.seeded_kill_schedule(
+        seed, n_kills, n_spot,
+        t_min=0.25 * span, t_max=0.75 * span)
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-spot-')
+    preempt_record = os.path.join(tmp, 'preemptions.jsonl')
+    replica_plan = json.dumps({'faults': [
+        {'site': 'engine.tick.hang', 'kind': 'hang', 'times': None,
+         'params': {'seconds': 0.05}}]})
+    base_port = int(os.environ.get('SKYTPU_SERVE_PORT', '19341'))
+    spot_ids = list(range(n_spot))
+
+    def spawn(i):
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        env['SKYTPU_FAULT_PLAN'] = replica_plan
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        log = open(os.path.join(tmp, f'replica{i}.log'), 'wb')
+        argv = [sys.executable, '-m',
+                'skypilot_tpu.models.serving_http',
+                '--port', str(base_port + i), '--model', 'tiny',
+                '--batch', '4', '--max-prompt', str(max_prompt),
+                '--max-seq', str(max_seq), '--decode-chunk', '1',
+                '--prefill-chunk', '16', '--prefill-budget', '32',
+                '--max-pending', '64']
+        if i in spot_ids:
+            argv.append('--is-spot')
+        return subprocess.Popen(argv, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+
+    procs = {i: spawn(i) for i in range(n_total)}
+    urls = {i: f'http://127.0.0.1:{base_port + i}'
+            for i in range(n_total)}
+
+    def kill_replica(i):
+        p = procs.get(i)
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
+
+    def counter_sum(summary, name):
+        return sum(v for k, v in summary.items()
+                   if k == name or k.startswith(name + '{'))
+
+    async def wait_ready():
+        deadline = time.time() + 240
+        async with aiohttp.ClientSession() as s:
+            for url in urls.values():
+                while True:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f'replica {url} never became ready')
+                    try:
+                        async with s.get(
+                                url + '/health',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=2)) as r:
+                            if r.status == 200:
+                                break
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError, OSError):
+                        pass
+                    await asyncio.sleep(0.25)
+
+    async def run_round(preempt):
+        lb = LoadBalancer(port=0, policy='least_load')
+        await lb.start()
+        if preempt:
+            lb.set_replica_urls(list(urls.values()),
+                                spot_urls=[urls[i]
+                                           for i in spot_ids])
+        else:
+            # Baseline: the SAME pool billed entirely on-demand —
+            # no spot tie-break, no preemptions.
+            lb.set_replica_urls(list(urls.values()))
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        notices = kills = 0
+
+        def notice_replica(i):
+            u = urls[i]
+
+            async def deliver():
+                # LB first: routing stops and live streams migrate
+                # before the replica-side health flip, so there is
+                # zero window to start a stream on a doomed replica.
+                await lb.mark_preempting(u)
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.post(
+                                u + '/preempt_notice',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=5)) as r:
+                            await r.read()
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError):
+                    pass
+
+            asyncio.ensure_future(deliver())
+
+        if preempt:
+            records, wall, notices, kills = \
+                await loadgen.replay_http_preempt_async(
+                    base, trace, schedule, notice_replica,
+                    kill_replica, notice_s, timeout_s=240,
+                    keep_tokens=True)
+        else:
+            records, wall = await loadgen.replay_http_async(
+                base, trace, timeout_s=240, keep_tokens=True)
+        await lb.stop()
+        return records, wall, notices, kills
+
+    try:
+        asyncio.run(wait_ready())
+        with _bench_span('serve_spot', spot=n_spot, ondemand=n_od,
+                         kills=n_kills, requests=n_requests):
+            base_records, base_wall, _, _ = asyncio.run(
+                run_round(preempt=False))
+            base_report = loadgen.score(base_records, slo, base_wall)
+            pre = metrics_lib.summary()
+            with fault_injection.fault_plan(
+                    faults=[{'site': 'serve.replica.preempt_notice',
+                             'kind': 'preempt_notice', 'times': None},
+                            {'site': 'serve.replica.kill',
+                             'kind': 'crash', 'times': None}],
+                    record=preempt_record):
+                spot_records, spot_wall, notices, kills = asyncio.run(
+                    run_round(preempt=True))
+            spot_report = loadgen.score(spot_records, slo, spot_wall)
+            post = metrics_lib.summary()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    # Parity oracle: the baseline run IS the uninterrupted stream for
+    # every request — a migrated/resumed spot-run stream must match
+    # it bitwise (zero duplicated, zero dropped tokens).
+    base_tokens = {r.request_id: r.tokens for r in base_records
+                   if r.status == 'finished' and r.tokens is not None}
+    checked = mismatched = 0
+    for rec in spot_records:
+        # A notice-migrated stream may finish WITHOUT a resume (the
+        # close landed after its last token; the done event was
+        # synthesized) — it still must match the oracle bitwise.
+        if rec.status != 'finished' or not (rec.resumed or
+                                            rec.migrated):
+            continue
+        oracle = base_tokens.get(rec.request_id)
+        if oracle is None:
+            continue
+        checked += 1
+        if rec.tokens != oracle:
+            mismatched += 1
+            print(f'# PARITY MISMATCH request {rec.request_id}: '
+                  f'spot={rec.tokens} oracle={oracle}',
+                  file=sys.stderr)
+    length_bad = sum(
+        1 for rec in spot_records
+        if rec.status == 'finished' and rec.tokens is not None and
+        len(rec.tokens) != by_id[rec.request_id].max_new)
+    # The whole point of the notice path: NO client ever sees a
+    # transport error — noticed replicas are drained of streams
+    # before their kill lands.
+    errors = sum(1 for r in spot_records if r.status == 'error')
+    migrated = sum(1 for r in spot_records if r.migrated)
+
+    phase_delta = {
+        phase: (counter_sum(
+            post,
+            f'skytpu_serve_preemptions_total{{phase="{phase}"}}') -
+            counter_sum(
+                pre,
+                f'skytpu_serve_preemptions_total{{phase="{phase}"}}'))
+        for phase in ('notice', 'kill')}
+    migrations_delta = (
+        counter_sum(post, 'skytpu_lb_migrations_total') -
+        counter_sum(pre, 'skytpu_lb_migrations_total'))
+    resumed_delta = (
+        counter_sum(post, 'skytpu_lb_resumed_streams_total') -
+        counter_sum(pre, 'skytpu_lb_resumed_streams_total'))
+
+    # $/Mtok proxy: chip-seconds per good (finished) token, spot
+    # chip-seconds discounted at the spot/on-demand price ratio. A
+    # killed spot replica stops billing at its (scheduled) kill
+    # instant; everything else bills the round's wall clock.
+    kill_at = {e.replica: e.at_s for e in schedule}
+
+    def cost_proxy(records, wall, mixed):
+        good = sum(r.n_tokens for r in records
+                   if r.status == 'finished')
+        if mixed:
+            spot_chip_s = sum(
+                min(kill_at.get(i, wall), wall) for i in spot_ids)
+            od_chip_s = n_od * wall
+        else:
+            spot_chip_s, od_chip_s = 0.0, n_total * wall
+        chip_s = spot_chip_s * price_ratio + od_chip_s
+        return {
+            'good_tokens': good,
+            'spot_chip_s': round(spot_chip_s, 3),
+            'ondemand_chip_s': round(od_chip_s, 3),
+            'discounted_chip_s': round(chip_s, 3),
+            'chip_s_per_good_token':
+                round(chip_s / good, 6) if good else None,
+        }
+
+    base_cost = cost_proxy(base_records, base_wall, mixed=False)
+    spot_cost = cost_proxy(spot_records, spot_wall, mixed=True)
+    base_good = base_report['goodput_req_s']
+    ratio = (spot_report['goodput_req_s'] / base_good
+             if base_good > 0 else
+             (1.0 if spot_report['goodput_req_s'] ==
+              base_report['goodput_req_s'] else 0.0))
+    ok = (ratio >= min_ratio and notices >= 1 and kills >= 1
+          and errors == 0 and mismatched == 0 and length_bad == 0)
+    result = {
+        'metric': 'llama_serve_spot_goodput_ratio',
+        'value': round(ratio, 4),
+        'unit': 'spot/on-demand goodput',
+        'vs_baseline': round(ratio, 4),
+        'detail': {
+            'ok': ok,
+            'seed': seed,
+            'spot_replicas': n_spot,
+            'ondemand_replicas': n_od,
+            'notice_s': notice_s,
+            'price_ratio': price_ratio,
+            'preempt_schedule': [
+                {'at_s': round(e.at_s, 4),
+                 'notice_at_s': round(
+                     max(0.0, e.at_s - notice_s), 4),
+                 'replica': e.replica} for e in schedule],
+            'notices_executed': notices,
+            'kills_executed': kills,
+            'preempt_record': preempt_record,
+            'trace_sha256': trace_digest,
+            'schedule_head_s': [round(r.arrival_s, 6)
+                                for r in trace[:8]],
+            'min_ratio': min_ratio,
+            'baseline': base_report,
+            'spot': spot_report,
+            'client_errors': errors,
+            'streams_migrated': migrated,
+            'lb_migrations': migrations_delta,
+            'streams_resumed': resumed_delta,
+            'preemptions': phase_delta,
+            'resume_parity': {'checked': checked,
+                              'mismatched': mismatched,
+                              'length_mismatches': length_bad},
+            'cost_proxy': {'baseline': base_cost,
+                           'spot': spot_cost},
+            'metrics': metrics_lib.summary(),
+        },
+    }
+    merged = _merged_trace_path()
+    if merged:
+        result['detail']['span_trace_file'] = merged
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 # One subprocess per mode: every bench assumes a fresh chip (HBM
 # fragmentation from a previous mode would contaminate timings), and
 # a crash in one mode must not take down the rest.
@@ -1461,6 +1796,12 @@ _ALL_MODES = {
     # chaos vs the same-seed clean run, breaker/hedge/resume counts,
     # greedy-parity of resumed streams. CPU replicas — no device.
     'serve_chaos': {'BENCH_MODE': 'serve_chaos'},
+    # Spot-native serving (docs/spot_serving.md): seeded notice→kill
+    # preemptions against a mixed spot/on-demand pool; goodput vs the
+    # all-on-demand same-seed baseline, zero client-visible errors on
+    # noticed preemptions, $/Mtok chip-seconds proxy. CPU replicas —
+    # no device.
+    'serve_spot': {'BENCH_MODE': 'serve_spot'},
     # Control-plane scale (docs/control_plane.md): lease-fleet
     # throughput on the synthetic cloud — jobs/s settled,
     # time-to-reconcile after a worker kill, lease churn. No device.
@@ -1664,11 +2005,11 @@ if __name__ == '__main__':
     _trace_mod.set_component(f'bench.{mode}')
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
-    # same thing); other modes probe in-process. 'fleet' and
-    # 'serve_chaos' never touch a device (pure control plane / CPU
-    # replica subprocesses), so a dead TPU tunnel must not kill
-    # their rounds.
-    if mode not in ('fleet', 'serve_chaos'):
+    # same thing); other modes probe in-process. 'fleet',
+    # 'serve_chaos' and 'serve_spot' never touch a device (pure
+    # control plane / CPU replica subprocesses), so a dead TPU
+    # tunnel must not kill their rounds.
+    if mode not in ('fleet', 'serve_chaos', 'serve_spot'):
         _device_watchdog(float(os.environ.get(
             'BENCH_DEVICE_TIMEOUT',
             '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
@@ -1676,6 +2017,8 @@ if __name__ == '__main__':
         sys.exit(fleet_bench())
     if mode == 'serve_chaos':
         sys.exit(serve_chaos_bench())
+    if mode == 'serve_spot':
+        sys.exit(serve_spot_bench())
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':

@@ -22,7 +22,9 @@ from skypilot_tpu.loadgen.replay import replay_http
 from skypilot_tpu.loadgen.replay import replay_http_async
 from skypilot_tpu.loadgen.replay import replay_http_chaos
 from skypilot_tpu.loadgen.replay import replay_http_chaos_async
+from skypilot_tpu.loadgen.replay import replay_http_preempt_async
 from skypilot_tpu.loadgen.replay import run_kill_schedule
+from skypilot_tpu.loadgen.replay import run_preempt_schedule
 from skypilot_tpu.loadgen.replay import seeded_kill_schedule
 from skypilot_tpu.loadgen.score import RequestRecord
 from skypilot_tpu.loadgen.score import SLO
@@ -41,6 +43,7 @@ __all__ = [
     'WorkloadSpec', 'digest', 'dump_jsonl', 'generate', 'load_jsonl',
     'load_jsonl_path', 'replay_engine', 'replay_http',
     'replay_http_async', 'replay_http_chaos',
-    'replay_http_chaos_async', 'run_kill_schedule', 'score',
+    'replay_http_chaos_async', 'replay_http_preempt_async',
+    'run_kill_schedule', 'run_preempt_schedule', 'score',
     'seeded_kill_schedule', 'to_jsonl',
 ]

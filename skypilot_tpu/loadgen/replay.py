@@ -37,12 +37,23 @@ import time
 from typing import (Any, Callable, Dict, List, Optional, Sequence,
                     Tuple)
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.loadgen.score import RequestRecord
 from skypilot_tpu.loadgen.workload import TraceRequest
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
+
+# Shared with serve/replica_managers.py via the registry's
+# get-or-create semantics: the bench's preempt-schedule runner has no
+# probe loop, so it accounts notice/kill phases itself
+# (docs/spot_serving.md).
+_M_PREEMPTIONS = metrics_lib.counter(
+    'skytpu_serve_preemptions_total',
+    'Spot replica preemptions, by phase: notice (advance warning '
+    'observed) and kill (the replica actually went away).',
+    labels=('phase',))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +114,82 @@ async def run_kill_schedule(schedule: Sequence[KillEvent],
         if executed is not None:
             executed.append(ev)
     return count
+
+
+async def run_preempt_schedule(
+        schedule: Sequence[KillEvent],
+        notice_fn: Callable[[int], None],
+        kill_fn: Callable[[int], None],
+        notice_s: float,
+        executed_notices: Optional[List[KillEvent]] = None,
+        executed_kills: Optional[List[KillEvent]] = None
+) -> Tuple[int, int]:
+    """Execute a notice→kill preemption schedule on the loop clock
+    (docs/spot_serving.md): each :class:`KillEvent`'s replica gets a
+    cloud-style preemption notice ``notice_s`` seconds before its
+    kill (clamped at t=0), then the SIGKILL at the scheduled time.
+    The notice flows through the ``serve.replica.preempt_notice``
+    fault site (kind ``preempt_notice``) and the kill through
+    ``serve.replica.kill`` (kind ``crash``), each with the usual
+    armed-plan veto/record semantics — a vetoed notice still lets
+    its kill fire, which IS an unnoticed preemption (the reactive
+    path). Each executed phase bumps
+    ``skytpu_serve_preemptions_total{phase}``; the bench harness has
+    no probe loop to account them. Returns ``(notices, kills)``
+    executed; the optional lists accumulate events AS they run, so a
+    caller cancelling mid-schedule still sees what happened."""
+    timeline = []
+    for ev in schedule:
+        timeline.append((max(0.0, ev.at_s - max(0.0, notice_s)),
+                         'notice', ev))
+        timeline.append((ev.at_s, 'kill', ev))
+    # Kills sort after notices at equal instants (notice_s=0 still
+    # delivers the warning first).
+    timeline.sort(key=lambda t: (t[0], t[1] == 'kill', t[2].replica))
+    loop = asyncio.get_event_loop()
+    start = loop.time()
+    notices = kills = 0
+    for at_s, phase, ev in timeline:
+        await asyncio.sleep(max(0.0, at_s - (loop.time() - start)))
+        if phase == 'notice':
+            spec = fault_injection.poll(
+                'serve.replica.preempt_notice',
+                kinds=(fault_injection.FaultKind.PREEMPT_NOTICE,),
+                replica=ev.replica)
+            if (spec is None and
+                    fault_injection.active_plan() is not None):
+                logger.info(
+                    'Preemption notice for replica %d at t=%.2fs '
+                    'vetoed by the active fault plan (its kill '
+                    'becomes unnoticed).', ev.replica, at_s)
+                continue
+            logger.warning(
+                'CHAOS: preemption notice for replica %d at t=%.2fs '
+                '(kill at t=%.2fs).', ev.replica, at_s, ev.at_s)
+            notice_fn(ev.replica)
+            _M_PREEMPTIONS.inc(1, phase='notice')
+            notices += 1
+            if executed_notices is not None:
+                executed_notices.append(ev)
+        else:
+            spec = fault_injection.poll(
+                'serve.replica.kill',
+                kinds=(fault_injection.FaultKind.CRASH,),
+                replica=ev.replica)
+            if (spec is None and
+                    fault_injection.active_plan() is not None):
+                logger.info(
+                    'Kill of replica %d at t=%.2fs vetoed by the '
+                    'active fault plan.', ev.replica, at_s)
+                continue
+            logger.warning('CHAOS: killing replica %d at t=%.2fs.',
+                           ev.replica, at_s)
+            kill_fn(ev.replica)
+            _M_PREEMPTIONS.inc(1, phase='kill')
+            kills += 1
+            if executed_kills is not None:
+                executed_kills.append(ev)
+    return notices, kills
 
 
 def replay_engine(engine: Any, trace: Sequence[TraceRequest]
@@ -250,6 +337,7 @@ async def _replay_one(session: Any, url: str, r: TraceRequest,
                     # hedged streams (docs/failover.md) flow into the
                     # scored breakdown.
                     rec.resumed = int(event.get('resumed') or 0)
+                    rec.migrated = int(event.get('migrated') or 0)
                     rec.hedged = bool(event.get('hedged'))
                     if keep_tokens:
                         rec.tokens = list(event.get('tokens') or ())
@@ -343,6 +431,40 @@ async def replay_http_chaos_async(
         # already ran still count.
         kills = len(executed)
     return records, wall, kills
+
+
+async def replay_http_preempt_async(
+        url: str, trace: Sequence[TraceRequest],
+        schedule: Sequence[KillEvent],
+        notice_fn: Callable[[int], None],
+        kill_fn: Callable[[int], None],
+        notice_s: float,
+        timeout_s: float = 600.0, keep_tokens: bool = True
+) -> Tuple[List[RequestRecord], float, int, int]:
+    """Open-loop HTTP replay under a concurrent notice→kill
+    preemption schedule: the mixed-pool run of ``bench.py
+    serve_spot`` (docs/spot_serving.md). ``notice_fn(replica)``
+    delivers the advance warning (POST /preempt_notice + LB
+    mark_preempting); ``kill_fn(replica)`` performs the real SIGKILL.
+    Returns ``(records, wall_s, notices, kills)``."""
+    executed_n: List[KillEvent] = []
+    executed_k: List[KillEvent] = []
+    runner = asyncio.ensure_future(run_preempt_schedule(
+        schedule, notice_fn, kill_fn, notice_s,
+        executed_notices=executed_n, executed_kills=executed_k))
+    try:
+        records, wall = await replay_http_async(
+            url, trace, timeout_s=timeout_s, keep_tokens=keep_tokens)
+    finally:
+        if not runner.done():
+            runner.cancel()
+    try:
+        notices, kills = await runner
+    except asyncio.CancelledError:
+        # The replay outlived the schedule window: the events that
+        # already ran still count.
+        notices, kills = len(executed_n), len(executed_k)
+    return records, wall, notices, kills
 
 
 def replay_http_chaos(url: str, trace: Sequence[TraceRequest],

@@ -66,6 +66,11 @@ class RequestRecord:
     # reports can distinguish clean finishes from recovered ones.
     resumed: int = 0
     hedged: bool = False
+    # Spot-native marker (docs/spot_serving.md): resumes triggered by
+    # a preemption NOTICE — the LB proactively migrated this stream
+    # off a doomed replica before the kill, rather than reacting to
+    # a death. migrated <= resumed always.
+    migrated: int = 0
     # Final token ids (populated by replay_http when requested):
     # the chaos bench's greedy-parity check re-runs resumed prompts
     # against a survivor and compares these bitwise.
@@ -163,6 +168,7 @@ def score(records: Sequence[RequestRecord], slo: SLO,
             # (a resumed request still counts under 'finished'):
             # sub-breakdowns, not new statuses.
             'resumed': sum(1 for r in records if r.resumed),
+            'migrated': sum(1 for r in records if r.migrated),
             'hedged': sum(1 for r in records if r.hedged),
             **{f'_{s}': c for s, c in breakdown.items()
                if s not in STATUSES},

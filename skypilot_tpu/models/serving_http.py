@@ -113,6 +113,15 @@ class EngineServer:
         # 'draining' and /generate sheds — drain() then runs the
         # bounded wait + force-cancel sequence.
         self._drain_requested = threading.Event()
+        # Flipped by POST /preempt_notice (the cloud-style spot
+        # reclaim warning, docs/spot_serving.md): /health reports
+        # 'preempting' and new /generate requests shed, but in-flight
+        # streams KEEP RUNNING until the SIGKILL lands — the LB uses
+        # the notice window to migrate them to survivors.
+        self._preempt_requested = threading.Event()
+        # Advertised on /health so the LB's tie-break can prefer
+        # on-demand survivors (docs/spot_serving.md).
+        self.is_spot = False
         # True once drain()/stop() ended with every in-flight request
         # terminal and the driver thread joined.
         self.clean_shutdown: Optional[bool] = None
@@ -207,6 +216,20 @@ class EngineServer:
     @property
     def draining(self) -> bool:
         return self._drain_requested.is_set()
+
+    @property
+    def preempting(self) -> bool:
+        return self._preempt_requested.is_set()
+
+    def request_preempt(self) -> None:
+        """Flip the server into preempting mode (idempotent, safe
+        from any thread): the spot reclaim notice arrived and the
+        SIGKILL follows in SKYTPU_PREEMPT_NOTICE_S seconds. /health
+        reports 'preempting' (503) so the probe demotes this replica
+        and the LB stops routing here; new /generate requests shed;
+        in-flight streams run on — the LB proactively migrates them
+        during the window, so no drain sequence runs."""
+        self._preempt_requested.set()
 
     def request_drain(self) -> None:
         """Flip the server into draining mode (idempotent, safe from
@@ -388,6 +411,22 @@ class EngineServer:
             status=503, headers={'Retry-After': '1',
                                  **_rid_headers(req_id)})
 
+    def _preempting_response(self, req_id: str
+                             ) -> Optional[web.Response]:
+        """503 + Retry-After once the preemption notice arrived: this
+        replica dies within the notice window, so new work belongs on
+        a survivor (in-flight work keeps running — the LB migrates
+        it)."""
+        if not self.preempting:
+            return None
+        _M_SHEDS.inc(1, reason='preempting')
+        return web.json_response(
+            {'error': 'replica received a preemption notice',
+             'status': 'preempting', 'reason': 'preempting',
+             'request_id': req_id},
+            status=503, headers={'Retry-After': '1',
+                                 **_rid_headers(req_id)})
+
     def _deadline_shed_response(self, req_id: str,
                                 deadline: Optional[float],
                                 tokens, max_new: int
@@ -503,6 +542,9 @@ class EngineServer:
         draining = self._draining_response(req_id)
         if draining is not None:
             return draining
+        preempting = self._preempting_response(req_id)
+        if preempting is not None:
+            return preempting
         overloaded = self._overloaded_response(req_id)
         if overloaded is not None:
             return overloaded
@@ -711,6 +753,21 @@ class EngineServer:
              'budget_s': max(0.0, lifecycle.drain_timeout_s())},
             status=202)
 
+    async def handle_preempt_notice(self, request: web.Request
+                                    ) -> web.Response:
+        """POST /preempt_notice: the cloud-style spot reclaim warning
+        (docs/spot_serving.md). Flips /health to 'preempting' and
+        sheds new work; in-flight streams keep running until the
+        kill — the caller (notice harness / LB) owns migrating them.
+        Returns immediately; the body echoes the notice lead time so
+        the caller knows the window it is working with."""
+        del request
+        self.request_preempt()
+        return web.json_response(
+            {'status': 'preempting',
+             'notice_s': lifecycle.preempt_notice_s()},
+            status=202)
+
     async def handle_health(self, request: web.Request) -> web.Response:
         if self._dead is not None:
             return web.json_response(
@@ -720,6 +777,12 @@ class EngineServer:
             # routing here; the body names the reason so a deliberate
             # drain is distinguishable from a crash.
             return web.json_response({'status': 'draining'}, status=503)
+        if self.preempting:
+            # Same contract as draining: deliberate, not a failure —
+            # the probe demotes without feeding the terminate streak.
+            return web.json_response({'status': 'preempting',
+                                      'is_spot': self.is_spot},
+                                     status=503)
         if not self._ready.is_set():
             return web.json_response({'status': 'warming'}, status=503)
         # The admission-pressure estimate rides on /health so probes
@@ -730,7 +793,8 @@ class EngineServer:
         # workloads against THIS replica's max_prompt.
         body = {'status': 'ok',
                 'est_wait_s': round(self.engine.estimate_wait_s(0, 1),
-                                    4)}
+                                    4),
+                'is_spot': self.is_spot}
         limits = getattr(self.engine, 'limits', None)
         if limits is not None:
             body['limits'] = limits()
@@ -754,6 +818,8 @@ class EngineServer:
         app.router.add_post('/generate', self.handle_generate)
         app.router.add_post('/cancel/{request_id}', self.handle_cancel)
         app.router.add_post('/drain', self.handle_drain)
+        app.router.add_post('/preempt_notice',
+                            self.handle_preempt_notice)
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
         return app
@@ -958,6 +1024,11 @@ def main() -> None:
                         help='Max queued (unadmitted) requests before '
                         '/generate answers 429 + Retry-After; '
                         '<= 0 means unbounded.')
+    parser.add_argument('--is-spot', action='store_true',
+                        help='Advertise this replica as spot capacity '
+                        'on /health: the LB tie-break prefers '
+                        'on-demand survivors for hedges/resumes '
+                        '(docs/spot_serving.md).')
     args = parser.parse_args()
 
     # Name this replica's span-spool file (docs/tracing.md).
@@ -966,6 +1037,7 @@ def main() -> None:
         _build_engine(args),
         max_pending=(args.max_pending if args.max_pending > 0
                      else None))
+    server.is_spot = bool(args.is_spot)
     # SIGTERM/SIGINT flow into a graceful drain
     # (docs/request_lifecycle.md): the handler only sets a flag; the
     # main task below notices and runs the bounded drain sequence.

@@ -27,6 +27,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import log as sky_logging
 
@@ -72,6 +73,89 @@ class ScalingDecision:
     num_ondemand: Optional[int] = None
 
 
+class SpotPreemptionRateEstimator:
+    """EWMA estimate of the spot preemption rate, in preemptions per
+    spot-replica-hour (docs/spot_serving.md).
+
+    Exposure-weighted: ``advance(now, num_ready_spot)`` — called once
+    per autoscaler evaluation — decays both accumulators with the
+    half-life ``SKYTPU_SPOT_RATE_HALFLIFE_S`` (default 1800 s) and
+    integrates the elapsed spot-replica-hours of exposure;
+    ``record_preemption()`` adds one event (fed by the replica
+    manager on the FIRST evidence of each spot preemption — the
+    notice when one was observed, else the kill). The rate is decayed
+    events over decayed exposure, so one kill in a 10-replica fleet
+    reads 10x lower than the same kill in a 1-replica fleet, and an
+    old preemption storm fades on the half-life instead of haunting
+    the headroom forever. Zero events (or zero exposure) estimates
+    exactly 0.0 — the over-provisioning math then degenerates to the
+    rate-blind split, bit for bit."""
+
+    def __init__(self) -> None:
+        self._events = 0.0
+        self._exposure_h = 0.0
+        self._last_at: Optional[float] = None
+
+    @staticmethod
+    def _halflife_s() -> float:
+        raw = env_registry.get(
+            env_registry.SKYTPU_SPOT_RATE_HALFLIFE_S, '1800')
+        try:
+            return max(1.0, float(raw))
+        except ValueError:
+            return 1800.0
+
+    def advance(self, now: float, num_ready_spot: int) -> None:
+        """Account exposure since the last call: ``num_ready_spot``
+        replicas were preemptible for the elapsed interval."""
+        if self._last_at is None:
+            self._last_at = now
+            return
+        dt = now - self._last_at
+        self._last_at = now
+        if dt <= 0:
+            return
+        decay = 0.5 ** (dt / self._halflife_s())
+        self._events *= decay
+        self._exposure_h *= decay
+        self._exposure_h += max(0, num_ready_spot) * dt / 3600.0
+
+    def record_preemption(self) -> None:
+        self._events += 1.0
+
+    def rate_per_replica_hour(self) -> float:
+        if self._exposure_h <= 0.0:
+            return 0.0
+        return self._events / self._exposure_h
+
+    def expected_losses(self, num_spot: int,
+                        lead_time_s: float) -> float:
+        """Spot replicas statistically expected to be preempted out of
+        ``num_spot`` within one recovery lead time."""
+        return (self.rate_per_replica_hour() * max(0, num_spot) *
+                max(0.0, lead_time_s) / 3600.0)
+
+    # ------------------------------------------------- durability
+    def to_state(self) -> dict:
+        return {'events': self._events,
+                'exposure_h': self._exposure_h,
+                'last_at': self._last_at}
+
+    def restore(self, state: dict) -> None:
+        """Tolerant by construction: a missing/old-format dict leaves
+        the estimator cold (rate 0), never raises."""
+        try:
+            self._events = max(0.0, float(state.get('events', 0.0)))
+            self._exposure_h = max(
+                0.0, float(state.get('exposure_h', 0.0)))
+            last = state.get('last_at')
+            self._last_at = None if last is None else float(last)
+        except (AttributeError, TypeError, ValueError):
+            self._events = 0.0
+            self._exposure_h = 0.0
+            self._last_at = None
+
+
 class FixedReplicaAutoscaler:
     """No target_qps: hold min_replicas."""
 
@@ -79,6 +163,7 @@ class FixedReplicaAutoscaler:
                  service: str = 'default') -> None:
         self.spec = spec
         self._service = service
+        self.spot_rate = SpotPreemptionRateEstimator()
 
     def record_request(self, now: Optional[float] = None) -> None:
         # No scaling decision reads it, but the traffic series still
@@ -86,11 +171,16 @@ class FixedReplicaAutoscaler:
         del now
         _M_REQUESTS.inc(1, service=self._service)
 
+    def record_preemption(self) -> None:
+        """One spot replica was preempted (docs/spot_serving.md):
+        feeds the EWMA rate behind the over-provisioning headroom."""
+        self.spot_rate.record_preemption()
+
     def to_state(self) -> dict:
-        return {}
+        return {'spot': self.spot_rate.to_state()}
 
     def restore(self, state: dict) -> None:
-        pass
+        self.spot_rate.restore(state.get('spot') or {})
 
     def initial(self) -> ScalingDecision:
         return initial_decision(self.spec)
@@ -98,9 +188,12 @@ class FixedReplicaAutoscaler:
     def evaluate(self, current_replicas: int,
                  now: Optional[float] = None,
                  num_ready_spot: int = 0) -> ScalingDecision:
+        now = now if now is not None else statedb.wall_now()
+        self.spot_rate.advance(now, num_ready_spot)
         return _with_spot_split(self.spec,
                                 ScalingDecision(self.spec.min_replicas),
-                                num_ready_spot)
+                                num_ready_spot,
+                                estimator=self.spot_rate)
 
 
 def initial_decision(spec: ServiceSpec) -> ScalingDecision:
@@ -110,8 +203,11 @@ def initial_decision(spec: ServiceSpec) -> ScalingDecision:
                             num_ready_spot=0)
 
 
-def _with_spot_split(spec: ServiceSpec, decision: ScalingDecision,
-                     num_ready_spot: int) -> ScalingDecision:
+def _with_spot_split(
+        spec: ServiceSpec, decision: ScalingDecision,
+        num_ready_spot: int,
+        estimator: Optional[SpotPreemptionRateEstimator] = None
+) -> ScalingDecision:
     """Split a target into (spot, on-demand) per the spec's spot policy.
 
     Mirrors reference ``FallbackRequestRateAutoscaler``
@@ -121,15 +217,32 @@ def _with_spot_split(spec: ServiceSpec, decision: ScalingDecision,
     replicas cover whatever part of the spot target is not READY yet
     (spot stockout / preemption storm), draining again as spot
     recovers.
+
+    Rate-aware over-provisioning (docs/spot_serving.md): with an
+    estimator, the spot target additionally carries
+    ``ceil(rate * target * lead_time / 3600)`` headroom replicas —
+    the losses statistically expected within one
+    ``spot_recovery_lead_time_s`` at the EWMA preemption rate — so
+    the fleet still meets the demand target while replacements
+    provision, instead of starting each relaunch only after the kill.
+    The dynamic fallback then covers whatever part of the *headroomed*
+    spot plan is not READY, sizing the on-demand safety net
+    proactively. At an estimated rate of zero the headroom is zero
+    and the split is bit-identical to the rate-blind one.
     """
     if not spec.use_spot:
         return decision
     target = decision.target_replicas
+    headroom = 0
+    if estimator is not None and target > 0:
+        headroom = max(0, math.ceil(estimator.expected_losses(
+            target, spec.spot_recovery_lead_time_s) - 1e-9))
+    spot = target + headroom
     ondemand = spec.base_ondemand_fallback_replicas
     if spec.dynamic_ondemand_fallback:
-        ondemand += max(0, target - num_ready_spot)
-    return ScalingDecision(target_replicas=target + ondemand,
-                           num_spot=target, num_ondemand=ondemand)
+        ondemand += max(0, spot - num_ready_spot)
+    return ScalingDecision(target_replicas=spot + ondemand,
+                           num_spot=spot, num_ondemand=ondemand)
 
 
 class RequestRateAutoscaler:
@@ -170,6 +283,10 @@ class RequestRateAutoscaler:
         # When the raw desire first diverged in the current direction.
         self._desire_since: Optional[float] = None
         self._desired: Optional[int] = None
+        # Preemption-rate estimate behind the spot over-provisioning
+        # headroom (docs/spot_serving.md) — idle unless a spot-aware
+        # subclass advances it at evaluation time.
+        self.spot_rate = SpotPreemptionRateEstimator()
 
     def initial(self) -> ScalingDecision:
         return initial_decision(self.spec)
@@ -186,6 +303,7 @@ class RequestRateAutoscaler:
             'target': self._target,
             'desired': self._desired,
             'desire_since': self._desire_since,
+            'spot': self.spot_rate.to_state(),
         }
 
     def restore(self, state: dict) -> None:
@@ -213,6 +331,14 @@ class RequestRateAutoscaler:
             self._target = min(self._target, self.spec.max_replicas)
         self._desired = state.get('desired')
         self._desire_since = state.get('desire_since')
+        # Old-format state (pre-spot) simply leaves the estimator
+        # cold — rate 0, split unchanged.
+        self.spot_rate.restore(state.get('spot') or {})
+
+    def record_preemption(self) -> None:
+        """One spot replica was preempted (docs/spot_serving.md):
+        feeds the EWMA rate behind the over-provisioning headroom."""
+        self.spot_rate.record_preemption()
 
     # ------------------------------------------------------------------
     def record_request(self, now: Optional[float] = None) -> None:
@@ -440,7 +566,9 @@ class SLOAutoscaler(RequestRateAutoscaler):
                 self._target = new
                 self._last_slo_scale_at = now
             decision = ScalingDecision(self._target)
-        return _with_spot_split(self.spec, decision, num_ready_spot)
+        self.spot_rate.advance(now, num_ready_spot)
+        return _with_spot_split(self.spec, decision, num_ready_spot,
+                                estimator=self.spot_rate)
 
 
 class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
@@ -452,8 +580,11 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     def evaluate(self, current_replicas: Optional[int] = None,
                  now: Optional[float] = None,
                  num_ready_spot: int = 0) -> ScalingDecision:
+        now = now if now is not None else statedb.wall_now()
         decision = super().evaluate(current_replicas, now)
-        return _with_spot_split(self.spec, decision, num_ready_spot)
+        self.spot_rate.advance(now, num_ready_spot)
+        return _with_spot_split(self.spec, decision, num_ready_spot,
+                                estimator=self.spot_rate)
 
 
 def make_autoscaler(spec: ServiceSpec, service: str = 'default'):

@@ -64,6 +64,14 @@ class ServeController:
             # (docs/failover.md); the LB invokes this off its event
             # loop.
             on_replica_down=self.replica_manager.note_unreachable)
+        # Spot-native serving (docs/spot_serving.md): each spot
+        # preemption feeds the autoscaler's EWMA rate estimator, and
+        # a preemption NOTICE proactively migrates the replica's live
+        # streams at the LB before the kill lands. Late-bound through
+        # self.autoscaler so a rolling update's rebuilt autoscaler
+        # keeps receiving events.
+        self.replica_manager.on_preemption = self._record_preemption
+        self.replica_manager.on_preempt_notice = self._preempt_notice
         self.loop_gap = loop_gap
         self._shutdown = asyncio.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -81,6 +89,29 @@ class ServeController:
             fut.result(timeout=90)
         except Exception:  # pylint: disable=broad-except
             logger.warning('Drain of %s did not complete:\n%s', url,
+                           traceback.format_exc())
+
+    def _record_preemption(self) -> None:
+        record = getattr(self.autoscaler, 'record_preemption', None)
+        if record is not None:
+            record()
+
+    def _preempt_notice(self, url: str) -> None:
+        """Bridge a replica's preemption notice to the LB: stop
+        routing to ``url`` and migrate its live streams to survivors
+        NOW — blocking the probe thread briefly so the migration is
+        in flight before the probe loop (and the cloud's kill clock)
+        moves on (docs/spot_serving.md)."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self.load_balancer.mark_preempting(url), loop)
+            fut.result(timeout=10)
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('Preemption migration of %s did not '
+                           'complete:\n%s', url,
                            traceback.format_exc())
 
     def _refresh_version(self) -> None:
@@ -163,8 +194,15 @@ class ServeController:
                     self.name, self.autoscaler.to_state())
                 await asyncio.to_thread(self.replica_manager.reconcile,
                                         decision)
-                urls = self.replica_manager.ready_urls()
-                self.load_balancer.set_replica_urls(urls)
+                ready = self.replica_manager.ready_replicas()
+                urls = [r['url'] for r in ready]
+                # Spot-ness rides along so the LB's tie-break prefers
+                # on-demand survivors for new streams, hedges, and
+                # resume targets (docs/spot_serving.md).
+                self.load_balancer.set_replica_urls(
+                    urls,
+                    spot_urls=[r['url'] for r in ready
+                               if r['is_spot']])
                 serve_state.set_service_status(
                     self.name, ServiceStatus.READY
                     if urls else ServiceStatus.REPLICA_INIT)

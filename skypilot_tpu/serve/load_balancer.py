@@ -118,6 +118,16 @@ _M_RESUME_FAILURES = metrics_lib.counter(
     'resumption disabled, no healthy replica, resume budget '
     'exhausted, or the resumed prompt exceeded the replica\'s '
     'max_prompt): the client saw a truncated stream.')
+# Spot-native serving (docs/spot_serving.md).
+_M_MIGRATIONS = metrics_lib.counter(
+    'skytpu_lb_migrations_total',
+    'Live SSE streams the LB proactively migrated off a replica '
+    'that received a spot-preemption notice, by trigger. Each '
+    'migration closes the doomed upstream so the stream re-drives '
+    'through the mid-stream resume path on a survivor BEFORE the '
+    'kill lands — a noticed preemption costs zero client-visible '
+    'errors (docs/spot_serving.md).',
+    labels=('trigger',))
 
 
 class LoadBalancingPolicy:
@@ -127,9 +137,18 @@ class LoadBalancingPolicy:
 
     def __init__(self) -> None:
         self._urls: List[str] = []
+        self._spot: Set[str] = set()
 
     def urls(self) -> List[str]:
         return list(self._urls)
+
+    def set_spot_urls(self, spot_urls: Sequence[str]) -> None:
+        """Which replicas run on spot capacity
+        (docs/spot_serving.md): tie-break material for load-aware
+        policies — spot may vanish on short notice, so on equal load
+        an on-demand survivor is the stabler pick for new streams,
+        hedges, and resume targets. Base policies ignore it."""
+        self._spot = set(spot_urls)
 
     def set_urls(self, urls: List[str]) -> None:
         for gone in set(self._urls) - set(urls):
@@ -203,8 +222,13 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                           if not exclude or u not in exclude]
             if not candidates:
                 return None
+            # Load first; on ties prefer on-demand over spot
+            # (docs/spot_serving.md): the spot replica may get a
+            # preemption notice any moment, and a stream started on
+            # an on-demand survivor never needs migrating.
             url = min(candidates,
-                      key=lambda u: _M_INFLIGHT.value(replica=u))
+                      key=lambda u: (_M_INFLIGHT.value(replica=u),
+                                     u in self._spot))
             _M_INFLIGHT.inc(1, replica=url)
             return url
 
@@ -238,6 +262,15 @@ class LoadBalancer:
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._draining: Set[str] = set()
+        # Replicas that received a spot-preemption notice
+        # (docs/spot_serving.md): excluded from every pick the moment
+        # mark_preempting() runs, while their live streams migrate to
+        # survivors ahead of the kill.
+        self._preempting: Set[str] = set()
+        # Live SSE drivers, so mark_preempting() can find (and
+        # migrate) the streams currently attached to a doomed
+        # replica. Registered for the duration of driver.run().
+        self._drivers: Set[Any] = set()
         # Per-replica circuit breakers (serve/failover.py): loop-
         # affine, fed by proxy outcomes, consulted at every pick.
         self._breakers: Dict[str, failover.CircuitBreaker] = {}
@@ -255,7 +288,9 @@ class LoadBalancer:
         self._ttft_window = metrics_lib.SlidingWindowPercentile(
             window_s)
 
-    def set_replica_urls(self, urls: List[str]) -> None:
+    def set_replica_urls(self, urls: List[str],
+                         spot_urls: Optional[Sequence[str]] = None
+                         ) -> None:
         for gone in set(self.policy.urls()) - set(urls):
             # The replica left the fleet (scale-down, terminate, or
             # manager demotion): retire its breaker — if it returns
@@ -264,7 +299,16 @@ class LoadBalancer:
             if b is not None:
                 b.remove()
         self.policy.set_urls(urls)
+        # Spot-ness rides on every fleet push (docs/spot_serving.md):
+        # None means "no spot info" — e.g. a bench/test LB fed plain
+        # URL lists — and clears the tie-break set.
+        self.policy.set_spot_urls(
+            [u for u in (spot_urls or ()) if u in set(urls)])
         self._draining &= set(urls)
+        # A preempting replica that left the fleet (the kill landed,
+        # or the notice was walked back and it re-probed READY) sheds
+        # its mark; re-notice re-marks it.
+        self._preempting &= set(urls)
 
     def inflight(self, url: str) -> int:
         # One store for in-flight load: the scraped gauge, maintained
@@ -283,6 +327,33 @@ class LoadBalancer:
             await asyncio.sleep(0.05)
         return True
 
+    async def mark_preempting(self, url: str,
+                              trigger: str = 'notice') -> int:
+        """``url`` received a spot-preemption notice
+        (docs/spot_serving.md): stop routing to it NOW and
+        proactively migrate its live SSE streams to survivors.
+        Migration closes each stream's doomed upstream, so the
+        driver's pending read surfaces as a transport error and walks
+        the ordinary mid-stream resume arm — on a replica the pick
+        exclusion already keeps away from ``url``. Done BEFORE the
+        kill lands, a noticed preemption costs zero client-visible
+        errors. Returns the number of streams migrated."""
+        self._preempting.add(url)
+        migrating = [d for d in list(self._drivers)
+                     if d.active_url() == url]
+        with trace_lib.span('lb.migrate', replica=url,
+                            trigger=trigger,
+                            streams=len(migrating)):
+            for d in migrating:
+                _M_MIGRATIONS.inc(1, trigger=trigger)
+                d.migrate()
+        if migrating:
+            logger.info(
+                'Preemption notice for %s: migrating %d live '
+                'stream(s) to survivors (trigger=%s).', url,
+                len(migrating), trigger)
+        return len(migrating)
+
     # ------------------------------------------------ breaker plumbing
     def _breaker(self, url: str) -> failover.CircuitBreaker:
         b = self._breakers.get(url)
@@ -298,8 +369,12 @@ class LoadBalancer:
         """Breaker-aware pick: open breakers are excluded; picking a
         cooled-down open breaker consumes its single half-open trial.
         Synchronous end to end, so two interleaved requests can never
-        both claim the same trial."""
-        url = self.policy.pick(exclude=exclude | self._blocked_urls())
+        both claim the same trial. Preempting replicas
+        (docs/spot_serving.md) are excluded HERE so every pick —
+        opaque retry, SSE attempt, hedge, resume target — avoids a
+        replica whose kill is seconds away."""
+        url = self.policy.pick(exclude=exclude | self._blocked_urls()
+                               | self._preempting)
         if url is not None:
             self._breaker(url).acquire()
         return url
@@ -410,7 +485,9 @@ class LoadBalancer:
         which replica holds the request; round-robining it would let
         a wrong-replica 404 mask the right replica's 202
         (docs/request_lifecycle.md)."""
-        urls = set(self.policy.urls()) | self._draining
+        # Draining AND preempting replicas still hold in-flight
+        # requests, so the cancel broadcast must reach them too.
+        urls = set(self.policy.urls()) | self._draining | self._preempting
         if not urls:
             return web.Response(status=503,
                                 text='No ready replicas.\n')
@@ -752,7 +829,13 @@ class LoadBalancer:
         if self.on_request is not None:
             self.on_request()
         driver = _SSEGenerateDriver(self, request, parsed)
-        return await driver.run()
+        # Registered so mark_preempting() can find (and migrate) the
+        # streams attached to a noticed replica (docs/spot_serving.md).
+        self._drivers.add(driver)
+        try:
+            return await driver.run()
+        finally:
+            self._drivers.discard(driver)
 
     async def _handle_metrics(self, request: web.Request
                               ) -> web.Response:
@@ -927,6 +1010,12 @@ class _SSEGenerateDriver:
         self._noted_exc: Optional[BaseException] = None
         self.resumes = 0
         self.hedged = False
+        # Proactive migrations off preempting replicas
+        # (docs/spot_serving.md): each one re-drives the stream
+        # through the resume path, so ``migrated <= resumes`` once
+        # the continuation lands.
+        self.migrated = 0
+        self._current_up: Optional[_SSEUpstream] = None
         self.last_shed: Optional[_ReplicaShedError] = None
         self.last_err: Optional[BaseException] = None
         self._disconnect_spec = None
@@ -950,6 +1039,23 @@ class _SSEGenerateDriver:
             drop=('content-type', 'content-length'))
         headers[trace_lib.REQUEST_ID_HEADER] = self.req_id
         return _SSEUpstream(self.lb, url, payload, headers)
+
+    def active_url(self) -> Optional[str]:
+        """The replica URL the current attempt streams from (None
+        between attempts) — mark_preempting()'s match key."""
+        return self._active_url
+
+    def migrate(self) -> None:
+        """Proactively move this stream off its (preempting) replica
+        (docs/spot_serving.md): close the live upstream so the
+        pending read surfaces as a transport error and the ordinary
+        mid-stream resume arm re-drives the stream on a survivor —
+        the migration IS a resume, just triggered before the replica
+        dies instead of after."""
+        self.migrated += 1
+        up = self._current_up
+        if up is not None:
+            up.close()
 
     def _release(self, url: str) -> None:
         if url in self._held:
@@ -1032,6 +1138,8 @@ class _SSEGenerateDriver:
         }
         if self.resumes:
             payload['resumed'] = self.resumes
+        if self.migrated:
+            payload['migrated'] = self.migrated
         if self.hedged:
             payload['hedged'] = True
         return payload
@@ -1075,6 +1183,7 @@ class _SSEGenerateDriver:
                 **({'budget_s': round(left, 3)}
                    if left is not None else {}))
             up = self._upstream(url)
+            self._current_up = up
             try:
                 with trace_lib.activate(sp):
                     outcome = await self._drive_attempt(up, sp)
@@ -1142,9 +1251,15 @@ class _SSEGenerateDriver:
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 fail_url = self._active_url
                 kind = self._classify(e)
-                if e is not self._noted_exc:
+                # A proactive migration (mark_preempting closed the
+                # upstream) is not a replica failure: the replica is
+                # alive and healthy until the kill lands, so it must
+                # feed neither the breaker nor the error counters
+                # (docs/spot_serving.md).
+                migrating = fail_url in self.lb._preempting  # pylint: disable=protected-access
+                if e is not self._noted_exc and not migrating:
                     self._note_kind(fail_url, kind)
-                sp.finish(error=kind)
+                sp.finish(error='migrate' if migrating else kind)
                 self.last_err = e
                 if self.client is not None:
                     self.dead_urls.add(fail_url)
@@ -1186,10 +1301,11 @@ class _SSEGenerateDriver:
                 # bound, not the pre-stream attempt count.
                 attempts_left = max(attempts_left, 1)
                 logger.warning(
-                    'Replica %s died mid-stream after %d/%d tokens; '
-                    'resuming on another replica (trace=%s).',
-                    fail_url, len(self.emitted), self.max_new,
-                    self._trace_id)
+                    'Replica %s %s after %d/%d tokens; resuming on '
+                    'another replica (trace=%s).', fail_url,
+                    'is preempting — migrating stream' if migrating
+                    else 'died mid-stream',
+                    len(self.emitted), self.max_new, self._trace_id)
                 continue
             finally:
                 if sp.end_time is None:
@@ -1240,6 +1356,7 @@ class _SSEGenerateDriver:
         if self._winner is not None:
             up = self._winner
         self._active_url = up.url
+        self._current_up = up
         # Hedge-delay signal: first-token latency of the upstream
         # that PRODUCED it, measured from its own start (a hedge
         # winner's sample must not embed the delay it waited behind).
@@ -1287,6 +1404,11 @@ class _SSEGenerateDriver:
                                      list(ev.get('tokens') or ()))
                 if self.resumes:
                     payload['resumed'] = self.resumes
+                if self.migrated:
+                    # Resumes triggered by a preemption notice
+                    # (docs/spot_serving.md) — lets the bench tell
+                    # notice-migrated streams from reactive resumes.
+                    payload['migrated'] = self.migrated
                 if self.hedged:
                     payload['hedged'] = True
                 await self._write_event(payload)

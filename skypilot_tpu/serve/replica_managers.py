@@ -65,6 +65,17 @@ _M_PROBE_FAILURES = metrics_lib.counter(
     'Failed replica readiness probes (including injected faults).',
     labels=('replica',))
 
+# Spot-preemption lifecycle (docs/spot_serving.md): one 'notice' per
+# replica whose probe first answers 'preempting', one 'kill' per
+# PREEMPTED transition (cluster gone). The notice->kill replay
+# harness (loadgen/replay.py) and the LB's migration path share this
+# family via the registry's get-or-create semantics.
+_M_PREEMPTIONS = metrics_lib.counter(
+    'skytpu_serve_preemptions_total',
+    'Spot replica preemptions, by phase: notice (advance warning '
+    'observed) and kill (the replica actually went away).',
+    labels=('phase',))
+
 _M_RECONCILED = metrics_lib.counter(
     'skytpu_serve_reconciled_intents_total',
     'Open scale-up/scale-down intent records replayed at controller '
@@ -102,8 +113,24 @@ class ReplicaManager:
         # paths (preemption, failed probes) skip it — the replica is
         # already gone.
         self.drain_fn = drain_fn
+        # One estimator event per spot preemption (docs/
+        # spot_serving.md): called on the FIRST evidence — the notice
+        # when one arrives, the PREEMPTED transition otherwise — so a
+        # noticed-then-killed replica counts once, not twice. Set by
+        # the controller to feed the autoscaler's rate estimator.
+        self.on_preemption: Optional[Callable[[], None]] = None
+        # Called with the replica URL on the FIRST 'preempting' probe
+        # answer: the controller bridges this to the LB's
+        # mark_preempting(), which migrates the replica's live
+        # streams to survivors inside the notice window
+        # (docs/spot_serving.md).
+        self.on_preempt_notice: Optional[Callable[[str], None]] = None
         self._lock = threading.Lock()
         self._failed_probes: Dict[int, int] = {}
+        # Replica ids whose probe already answered 'preempting': the
+        # notice metric/estimator event fires once per replica, and
+        # the later PREEMPTED transition knows it was already counted.
+        self._preempt_noticed: set = set()
         # Replica ids with a termination thread in flight (guards the
         # reconcile sweep from double-terminating what probe_all
         # already handed to a background thread).
@@ -559,15 +586,17 @@ class ReplicaManager:
     def _probe_ready(self, url: str, spec: ServiceSpec,
                      replica_id: Optional[int] = None) -> str:
         """One readiness probe with an explicit, always-bounded
-        per-request timeout; returns 'ready', 'draining' or 'down'.
-        A single failed probe never declares a replica dead —
-        probe_all counts consecutive failures against
-        not_ready_threshold / probe_failure_terminate_threshold. A
-        'draining' answer (the replica got SIGTERM and is finishing
-        its in-flight work, docs/request_lifecycle.md) is a
-        DELIBERATE state, not a failure: the replica leaves the
-        routable set immediately but is not counted toward the
-        failed-probe terminate streak."""
+        per-request timeout; returns 'ready', 'draining',
+        'preempting' or 'down'. A single failed probe never declares
+        a replica dead — probe_all counts consecutive failures
+        against not_ready_threshold /
+        probe_failure_terminate_threshold. A 'draining' answer (the
+        replica got SIGTERM and is finishing its in-flight work,
+        docs/request_lifecycle.md) and a 'preempting' answer (a spot
+        reclaim notice arrived; the SIGKILL follows shortly,
+        docs/spot_serving.md) are DELIBERATE states, not failures:
+        the replica leaves the routable set immediately but is not
+        counted toward the failed-probe terminate streak."""
         fault = fault_injection.poll('serve.replica.probe_ready',
                                      replica_id=replica_id, url=url)
         if fault is not None:
@@ -584,8 +613,9 @@ class ReplicaManager:
                 timeout=(connect_timeout, read_timeout))
             if resp.status_code >= 500:
                 try:
-                    if (resp.json() or {}).get('status') == 'draining':
-                        return 'draining'
+                    answered = (resp.json() or {}).get('status')
+                    if answered in ('draining', 'preempting'):
+                        return answered
                 except ValueError:
                     pass
                 _M_PROBE_FAILURES.inc(1, replica=url)
@@ -649,6 +679,16 @@ class ReplicaManager:
                             rid, cluster)
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.PREEMPTED)
+                _M_PREEMPTIONS.inc(1, phase='kill')
+                with self._lock:
+                    noticed = rid in self._preempt_noticed
+                    self._preempt_noticed.discard(rid)
+                if (not noticed and replica.get('is_spot') and
+                        self.on_preemption is not None):
+                    # Killed without (observed) warning: this is the
+                    # preemption's FIRST evidence, so the estimator
+                    # event fires here instead of the notice path.
+                    self.on_preemption()
                 self._terminate_in_background(rid, remove=True)
                 continue
             url = self._replica_url(rid, cluster, spec)
@@ -657,6 +697,9 @@ class ReplicaManager:
             if probe == 'ready':
                 with self._lock:
                     self._failed_probes[rid] = 0
+                    # A notice the cloud walked back (capacity
+                    # restored): a later notice is a NEW preemption.
+                    self._preempt_noticed.discard(rid)
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.READY,
                                                url=url)
@@ -669,6 +712,28 @@ class ReplicaManager:
                 # path owns this replica's teardown).
                 logger.info('Replica %d is draining: demoting to '
                             'NOT_READY.', rid)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.NOT_READY)
+            elif probe == 'preempting':
+                # Spot reclaim notice (docs/spot_serving.md): same
+                # contract as draining — leave the routable set NOW,
+                # never feed the terminate streak (the kill arrives on
+                # the cloud's clock; terminating early would only
+                # throw away the migration window). The notice
+                # metric/estimator event fires once per replica.
+                with self._lock:
+                    first = rid not in self._preempt_noticed
+                    self._preempt_noticed.add(rid)
+                if first:
+                    logger.info(
+                        'Replica %d got a preemption notice: demoting '
+                        'to NOT_READY until the kill lands.', rid)
+                    _M_PREEMPTIONS.inc(1, phase='notice')
+                    if (replica.get('is_spot') and
+                            self.on_preemption is not None):
+                        self.on_preemption()
+                    if self.on_preempt_notice is not None and url:
+                        self.on_preempt_notice(url)
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.NOT_READY)
             elif status in (ReplicaStatus.READY,
@@ -818,3 +883,14 @@ class ReplicaManager:
             r['url'] for r in serve_state.get_replicas(self.service_name)
             if r['status'] == ReplicaStatus.READY and r['url']
         ]
+
+    def ready_replicas(self) -> List[dict]:
+        """READY replicas with their routing-relevant attributes
+        (url + is_spot): the controller hands this to the LB so
+        hedge/resume target selection can prefer on-demand survivors
+        over the next potential victim (docs/spot_serving.md)."""
+        return [{
+            'url': r['url'],
+            'is_spot': bool(r.get('is_spot')),
+        } for r in serve_state.get_replicas(self.service_name)
+            if r['status'] == ReplicaStatus.READY and r['url']]

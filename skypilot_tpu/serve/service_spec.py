@@ -44,6 +44,12 @@ class ServiceSpec:
     use_spot: bool = False
     base_ondemand_fallback_replicas: int = 0
     dynamic_ondemand_fallback: bool = False
+    # Rate-aware over-provisioning (docs/spot_serving.md): how long a
+    # replacement replica takes from launch to READY. At a non-zero
+    # estimated preemption rate, the spot target carries headroom for
+    # the losses statistically expected within one lead time, so the
+    # fleet still meets demand while replacements provision.
+    spot_recovery_lead_time_s: float = 300.0
     # SLO-driven scaling (docs/load_testing.md): latency objectives
     # the autoscaler holds by adding replicas — p99 TTFT / p99
     # inter-token latency (scraped from each replica's sliding-window
@@ -105,6 +111,8 @@ class ServiceSpec:
                 policy.get('base_ondemand_fallback_replicas', 0)),
             dynamic_ondemand_fallback=bool(
                 policy.get('dynamic_ondemand_fallback', False)),
+            spot_recovery_lead_time_s=float(
+                policy.get('spot_recovery_lead_time_s', 300.0)),
             target_ttft_p99_s=(
                 float(policy['target_ttft_p99_s'])
                 if policy.get('target_ttft_p99_s') is not None else
@@ -187,6 +195,9 @@ class ServiceSpec:
                 'on-demand fallback requires use_spot: true '
                 '(fallback is the on-demand safety net under spot '
                 'replicas)')
+        if self.spot_recovery_lead_time_s < 0:
+            raise exceptions.InvalidTaskError(
+                'spot_recovery_lead_time_s must be >= 0')
 
     def to_yaml_config(self) -> Dict[str, Any]:
         return {
@@ -211,6 +222,8 @@ class ServiceSpec:
                     self.base_ondemand_fallback_replicas,
                 'dynamic_ondemand_fallback':
                     self.dynamic_ondemand_fallback,
+                'spot_recovery_lead_time_s':
+                    self.spot_recovery_lead_time_s,
             },
             'replica_port': self.replica_port,
             'load_balancing_policy': self.load_balancing_policy,

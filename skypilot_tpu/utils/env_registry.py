@@ -536,6 +536,54 @@ BENCH_CHAOS_MIN_RATIO = register(
     'BENCH_CHAOS_MIN_RATIO',
     'serve_chaos bench: minimum goodput-under-chaos over same-seed '
     'no-chaos baseline for the round to report ok (default 0.9).')
+# ------------------------------------------------- spot-native serving
+SKYTPU_PREEMPT_NOTICE_S = register(
+    'SKYTPU_PREEMPT_NOTICE_S',
+    'Spot-preemption notice lead time in seconds: how long before '
+    'the SIGKILL the cloud-style warning arrives (docs/'
+    'spot_serving.md). Read by the notice delivery harness; the LB '
+    'uses the window to proactively migrate live streams off the '
+    'doomed replica. Default 2.')
+SKYTPU_SPOT_RATE_HALFLIFE_S = register(
+    'SKYTPU_SPOT_RATE_HALFLIFE_S',
+    'Half-life in seconds of the EWMA spot-preemption-rate estimator '
+    '(preemptions per spot-replica-hour, serve/autoscalers.py): '
+    'shorter reacts faster to a preemption storm, longer smooths '
+    'isolated reclaims (default 1800).')
+BENCH_SPOT_REPLICAS = register(
+    'BENCH_SPOT_REPLICAS',
+    'serve_spot bench: spot replica subprocesses in the mixed pool '
+    '(default 2). Replicas always run on CPU — the measured article '
+    'is the notice/migration machinery, not the chip.')
+BENCH_SPOT_ONDEMAND = register(
+    'BENCH_SPOT_ONDEMAND',
+    'serve_spot bench: on-demand replica subprocesses in the mixed '
+    'pool (default 1; these survive every preemption).')
+BENCH_SPOT_KILLS = register(
+    'BENCH_SPOT_KILLS',
+    'serve_spot bench: spot replicas to preempt (notice then '
+    'SIGKILL) mid-run at seeded trace-relative times (default 1; '
+    'clamped below the spot count).')
+BENCH_SPOT_SEED = register(
+    'BENCH_SPOT_SEED',
+    'serve_spot bench: seed for the workload trace AND the '
+    'notice->kill schedule (same seed => same trace bytes and same '
+    'notice/kill times/targets — the determinism receipt).')
+BENCH_SPOT_NOTICE_S = register(
+    'BENCH_SPOT_NOTICE_S',
+    'serve_spot bench: notice lead time in seconds between the '
+    'preemption notice and the SIGKILL (SKYTPU_PREEMPT_NOTICE_S '
+    'analog; default 2).')
+BENCH_SPOT_MIN_RATIO = register(
+    'BENCH_SPOT_MIN_RATIO',
+    'serve_spot bench: minimum goodput of the preempted mixed-pool '
+    'run over the same-seed all-on-demand baseline for the round to '
+    'report ok (default 0.9).')
+BENCH_SPOT_PRICE_RATIO = register(
+    'BENCH_SPOT_PRICE_RATIO',
+    'serve_spot bench: spot price as a fraction of on-demand for '
+    'the $/Mtok proxy (spot chip-seconds are discounted by this '
+    'factor; default 0.3 — the ~70%% spot discount).')
 BENCH_SPEC_K = register(
     'BENCH_SPEC_K',
     'Speculative-decoding draft length for the decode/serve benches '

@@ -95,6 +95,12 @@ KNOWN_SITES = (
     # plan can veto or record individual kills; loadgen/replay.py).
     'lb.replica.connect',
     'serve.replica.kill',
+    # Spot-preemption notice (docs/spot_serving.md): the cloud-style
+    # warning delivered SKYTPU_PREEMPT_NOTICE_S seconds before a spot
+    # replica's SIGKILL. The notice→kill replay harness polls it per
+    # scheduled notice (an armed plan can veto or record individual
+    # notices, same semantics as serve.replica.kill).
+    'serve.replica.preempt_notice',
     # Crashpoints (docs/crash_recovery.md): named instructions inside
     # the controllers' multi-step operations where a `crash` fault
     # os._exit()s the process — the chaos analogue of `kill -9` at
@@ -151,6 +157,10 @@ class FaultKind(str, enum.Enum):
     # no excepts run, no finallys, no atexit — indistinguishable from
     # `kill -9` at that instruction (docs/crash_recovery.md).
     CRASH = 'crash'
+    # The cloud's advance warning that a spot instance will be
+    # reclaimed shortly (docs/spot_serving.md): the site delivers the
+    # notice to the replica/LB rather than failing anything itself.
+    PREEMPT_NOTICE = 'preempt_notice'
 
 
 @dataclasses.dataclass

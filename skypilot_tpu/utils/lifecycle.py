@@ -36,6 +36,9 @@ DEADLINE_HEADER = 'X-Request-Deadline'
 DEFAULT_DRAIN_TIMEOUT_SECONDS = 30.0
 # Default tick-hang threshold when SKYTPU_TICK_HANG_SECONDS is unset.
 DEFAULT_TICK_HANG_SECONDS = 30.0
+# Default spot-preemption notice lead time when
+# SKYTPU_PREEMPT_NOTICE_S is unset (docs/spot_serving.md).
+DEFAULT_PREEMPT_NOTICE_S = 2.0
 
 # Terminal request states (docs/request_lifecycle.md state diagram).
 FINISHED = 'finished'
@@ -65,6 +68,15 @@ def tick_hang_s() -> float:
     """Engine-tick watchdog threshold in seconds; 0 disables."""
     return _float_env(env_registry.SKYTPU_TICK_HANG_SECONDS,
                       DEFAULT_TICK_HANG_SECONDS)
+
+
+def preempt_notice_s() -> float:
+    """Spot-preemption notice lead time in seconds: the window
+    between the cloud-style warning and the SIGKILL, inside which the
+    LB migrates the doomed replica's live streams
+    (docs/spot_serving.md)."""
+    return _float_env(env_registry.SKYTPU_PREEMPT_NOTICE_S,
+                      DEFAULT_PREEMPT_NOTICE_S)
 
 
 def parse_budget(value: Any) -> Optional[float]:

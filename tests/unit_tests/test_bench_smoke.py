@@ -149,10 +149,11 @@ def test_probe_device_records_exception_detail():
 
 
 def test_bench_smoke_serve_load():
-    """serve_load emits a deterministic goodput report: two runs of
-    the same seed produce an IDENTICAL trace digest and request
-    schedule, and the report carries goodput + per-objective
-    attainment + shed/expired breakdowns."""
+    """serve_load emits a deterministic goodput report: its trace
+    digest and request schedule must match an independent same-seed
+    build of the trace in THIS process (cross-process determinism at
+    half the cost of a second bench run), and the report carries
+    goodput + per-objective attainment + shed/expired breakdowns."""
     first = _run_smoke('serve_load')
     assert first['metric'] == 'llama_serve_goodput_req_s'
     assert first['value'] > 0
@@ -173,7 +174,19 @@ def test_bench_smoke_serve_load():
         assert status in d['breakdown'], status
     assert sum(v for k, v in d['breakdown'].items()
                if not k.startswith('_')) == d['n_requests']
-    # Same seed => identical trace and schedule, across processes.
-    second = _run_smoke('serve_load')
-    assert second['detail']['trace_sha256'] == d['trace_sha256']
-    assert second['detail']['schedule_head_s'] == d['schedule_head_s']
+    # Same seed => identical trace and schedule, across processes:
+    # rebuild the smoke trace here (mirrors bench.py's CPU-smoke
+    # WorkloadSpec — every field but the seed is a constant there; a
+    # drifted parameter breaks this receipt loudly, which is the
+    # point) and compare digests with the subprocess's report.
+    from skypilot_tpu import loadgen
+    spec = loadgen.WorkloadSpec(
+        seed=0, n_requests=24, qps=40.0, arrival='bursty',
+        burst_factor=4.0, vocab_size=256,
+        prompt_median=16, prompt_min=4, prompt_max=64,
+        output_median=4, output_min=1, output_max=8,
+        n_prefixes=0, prefix_len=0, deadline_s=None)
+    trace = loadgen.generate(spec)
+    assert d['trace_sha256'] == loadgen.digest(trace)
+    assert d['schedule_head_s'] == [
+        round(r.arrival_s, 6) for r in trace[:8]]

@@ -32,9 +32,12 @@ import urllib.request
 import pytest
 from aiohttp import web
 
+from skypilot_tpu import loadgen
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.serve import failover
+from skypilot_tpu.serve.load_balancer import LeastLoadPolicy
 from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.service_spec import ServiceSpec
 from skypilot_tpu.utils import fault_injection as fi
 from skypilot_tpu.utils import retry as retry_lib
 
@@ -187,6 +190,95 @@ def test_note_unreachable_demotes_and_feeds_streak(monkeypatch):
     mgr.note_unreachable('http://r7:9000')
     assert mgr._failed_probes == {7: 2}
     assert transitions == [(7, ReplicaStatus.NOT_READY)]
+
+
+def test_preempting_probe_demotes_without_streak(monkeypatch):
+    """Satellite (docs/spot_serving.md): a 'preempting' health answer
+    mirrors the 'draining' rule — the replica leaves the routable set
+    immediately (NOT_READY) but the terminate streak is NEVER fed
+    (the kill arrives on the cloud's clock; terminating early throws
+    away the migration window). The notice callback and estimator
+    event fire exactly once per notice, and a walked-back notice
+    re-arms."""
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    mgr.service_name = 'svc'
+    mgr._lock = threading.Lock()
+    mgr._failed_probes = {}
+    mgr._preempt_noticed = set()
+    preemptions, notices = [], []
+    mgr.on_preemption = lambda: preemptions.append(1)
+    mgr.on_preempt_notice = notices.append
+    rows = [{'replica_id': 7, 'status': ReplicaStatus.READY,
+             'version': 1, 'cluster_name': 'c7', 'is_spot': True}]
+    transitions = []
+    monkeypatch.setattr(replica_managers.serve_state, 'get_replicas',
+                        lambda name: rows)
+    monkeypatch.setattr(
+        replica_managers.serve_state, 'set_replica_status',
+        lambda name, rid, status, **kw: transitions.append(
+            (rid, status)))
+    monkeypatch.setattr(mgr, '_version_spec',
+                        lambda version: ServiceSpec(min_replicas=1))
+    monkeypatch.setattr(mgr, '_cluster_is_up', lambda cluster: True)
+    monkeypatch.setattr(mgr, '_replica_url',
+                        lambda rid, cluster, spec: 'http://r7:9000')
+    probe_answers = ['preempting']
+    monkeypatch.setattr(
+        mgr, '_probe_ready',
+        lambda url, spec, replica_id=None: probe_answers[-1])
+    notice_before = _counter('skytpu_serve_preemptions_total',
+                             phase='notice')
+    mgr.probe_all()
+    assert transitions == [(7, ReplicaStatus.NOT_READY)]
+    assert mgr._failed_probes == {}          # streak NOT fed
+    assert notices == ['http://r7:9000']
+    assert preemptions == [1]
+    assert (_counter('skytpu_serve_preemptions_total', phase='notice')
+            - notice_before) == 1
+    # A second 'preempting' pass: still demoted, but the notice
+    # callback/metric/estimator do NOT fire again.
+    mgr.probe_all()
+    assert len(notices) == 1 and len(preemptions) == 1
+    assert mgr._failed_probes == {}
+    # Capacity restored (cloud walked the notice back): a later
+    # notice is a NEW preemption and fires again.
+    probe_answers.append('ready')
+    mgr.probe_all()
+    probe_answers.append('preempting')
+    mgr.probe_all()
+    assert len(notices) == 2 and len(preemptions) == 2
+    assert mgr._failed_probes == {7: 0}      # reset by 'ready', unfed
+
+
+def test_leastload_tie_break_prefers_ondemand():
+    """Satellite (docs/spot_serving.md): on an inflight tie the
+    least-load pick prefers an on-demand survivor over a spot one —
+    new streams, hedges and migration resume targets all land on
+    capacity the cloud cannot reclaim, all else equal."""
+    p = LeastLoadPolicy()
+    # 'a' sorts before 'b': without spot-awareness the tie goes to
+    # 'a'. Marking 'a' as spot flips the pick to the on-demand 'b'.
+    p.set_urls(['a', 'b'])
+    p.set_spot_urls(['a'])
+    assert p.pick() == 'b'
+    p.set_spot_urls(['b'])
+    assert p.pick() == 'a'
+    # Both spot: plain lexical tie-break again.
+    p.set_spot_urls(['a', 'b'])
+    assert p.pick() == 'a'
+    # Load dominates spot-ness: a loaded on-demand loses to an idle
+    # spot replica (the tie-break is a tie-break, not an override).
+    metrics_lib.REGISTRY.get(
+        'skytpu_lb_replica_inflight').set(3, replica='b')
+    p.set_spot_urls(['a'])
+    try:
+        assert p.pick() == 'a'
+    finally:
+        metrics_lib.REGISTRY.get(
+            'skytpu_lb_replica_inflight').set(0, replica='b')
 
 
 # ================================================ LB breaker wiring
@@ -485,22 +577,46 @@ def _wait_ready(url, deadline_s=240):
         time.sleep(0.2)
 
 
-def test_midstream_sigkill_resume_bitwise_parity():
-    """The acceptance headline in miniature: a real replica
-    subprocess is SIGKILLed mid-stream; the LB resumes the greedy
-    stream on the survivor and the spliced token sequence is BITWISE
-    equal to an uninterrupted oracle run — zero duplicated, zero
-    dropped tokens."""
-    hang = json.dumps({'faults': [
-        {'site': 'engine.tick.hang', 'kind': 'hang', 'times': None,
-         'params': {'seconds': 0.05}}]})
-    ports = [_free_port(), _free_port()]
-    procs = [_spawn_replica(p, {'SKYTPU_FAULT_PLAN': hang})
-             for p in ports]
-    urls = [f'http://127.0.0.1:{p}' for p in ports]
-    try:
-        for u in urls:
-            _wait_ready(u)
+class TestRealReplicaRoundTrips:
+    """The mid-stream SIGKILL resume and the preemption-notice
+    migration round trips share ONE pool of real replica subprocesses
+    (test-budget satellite): pool spawn — jax import + engine compile
+    + ready-wait — dominates both tests' cost, and together they kill
+    only 3 of the 4 members. Class scope reaps the pool the moment
+    the second test finishes, so the idle replica driver loops never
+    compete with the bench subprocesses further down the file."""
+
+    @pytest.fixture(scope='class')
+    def replica_pool(self):
+        hang = json.dumps({'faults': [
+            {'site': 'engine.tick.hang', 'kind': 'hang',
+             'times': None, 'params': {'seconds': 0.05}}]})
+        ports = [_free_port() for _ in range(4)]
+        procs = [_spawn_replica(p, {'SKYTPU_FAULT_PLAN': hang})
+                 for p in ports]
+        urls = [f'http://127.0.0.1:{p}' for p in ports]
+        try:
+            for u in urls:
+                _wait_ready(u)
+            yield list(zip(urls, procs))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+    def test_midstream_sigkill_resume_bitwise_parity(
+            self, replica_pool):
+        """The acceptance headline in miniature: a real replica
+        subprocess is SIGKILLed mid-stream; the LB resumes the greedy
+        stream on the survivor and the spliced token sequence is
+        BITWISE equal to an uninterrupted oracle run — zero
+        duplicated, zero dropped tokens."""
+        alive = [(u, p) for u, p in replica_pool
+                 if p.poll() is None]
+        assert len(alive) >= 2
+        urls = [u for u, _ in alive[:2]]
+        procs = [p for _, p in alive[:2]]
         resumed_before = _counter('skytpu_lb_resumed_streams_total')
 
         async def scenario():
@@ -544,23 +660,144 @@ def test_midstream_sigkill_resume_bitwise_parity():
             return oracle_inc, oracle_done, inc, done
 
         oracle_inc, oracle_done, inc, done = asyncio.run(scenario())
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait(timeout=10)
-    assert oracle_done['status'] == 'finished'
-    assert len(oracle_done['tokens']) == 30
-    # The resumed stream finished, says so, and is bitwise identical
-    # to the uninterrupted oracle — incremental events AND the
-    # rewritten done event.
-    assert done is not None and done['status'] == 'finished'
-    assert done.get('resumed') == 1
-    assert done['tokens'] == oracle_done['tokens']
-    assert inc == oracle_inc == oracle_done['tokens']
-    assert (_counter('skytpu_lb_resumed_streams_total') -
-            resumed_before) == 1
-    assert _counter('skytpu_lb_resume_failures_total') == 0
+        assert oracle_done['status'] == 'finished'
+        assert len(oracle_done['tokens']) == 30
+        # The resumed stream finished, says so, and is bitwise
+        # identical to the uninterrupted oracle — incremental events
+        # AND the rewritten done event.
+        assert done is not None and done['status'] == 'finished'
+        assert done.get('resumed') == 1
+        assert done['tokens'] == oracle_done['tokens']
+        assert inc == oracle_inc == oracle_done['tokens']
+        assert (_counter('skytpu_lb_resumed_streams_total') -
+                resumed_before) == 1
+        assert _counter('skytpu_lb_resume_failures_total') == 0
+
+    def test_preempt_notice_migrates_stream_zero_errors_parity(
+            self, replica_pool):
+        """The spot tentpole in miniature (docs/spot_serving.md): a
+        real replica subprocess gets a preemption notice mid-stream —
+        its /health flips to 'preempting', the LB proactively
+        migrates the live stream to a survivor, and the SIGKILL that
+        lands after the notice window hits an already-empty replica.
+        The client sees ZERO errors and a token stream bitwise equal
+        to the uninterrupted oracle — and equal to the reactive
+        kill-only path on the same request. Migration feeds neither
+        the breaker nor the error counters (the replica was healthy
+        when it left)."""
+        alive = [(u, p) for u, p in replica_pool
+                 if p.poll() is None]
+        assert len(alive) >= 3
+        urls = [u for u, _ in alive[:3]]
+        procs = [p for _, p in alive[:3]]
+        migrations_before = _metric_sum('skytpu_lb_migrations_total')
+        resume_fail_before = _metric_sum(
+            'skytpu_lb_resume_failures_total')
+
+        async def scenario():
+            import aiohttp
+            lb = LoadBalancer(port=0)
+            await lb.start()
+            lb.set_replica_urls(urls)
+            base = f'http://127.0.0.1:{lb.bound_port}'
+            req = {'tokens': [1, 2, 3, 4], 'max_new': 30,
+                   'stream': True}
+            health = {}
+
+            async def stream(payload, preempt_after=None,
+                             kill_after=None):
+                inc, done = [], None
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(base + '/generate',
+                                      json=payload) as r:
+                        assert r.status == 200
+                        async for raw in r.content:
+                            line = raw.decode().strip()
+                            if not line.startswith('data:'):
+                                continue
+                            ev = json.loads(line[5:])
+                            if ev.get('done'):
+                                done = ev
+                                break
+                            inc.extend(ev.get('tokens') or [])
+                            if (preempt_after is not None and
+                                    len(inc) >= preempt_after):
+                                preempt_after = None
+                                victim = next(
+                                    i for i, u in enumerate(urls)
+                                    if lb.inflight(u) > 0)
+                                vu = urls[victim]
+                                # The notice: replica flips health,
+                                # LB stops routing + migrates NOW.
+                                async with s.post(
+                                        vu + '/preempt_notice') as nr:
+                                    assert nr.status == 202
+                                async with s.get(vu + '/health') as h:
+                                    health['status'] = h.status
+                                    health['body'] = await h.json()
+                                await lb.mark_preempting(vu)
+
+                                async def kill_later(idx):
+                                    # The cloud's kill, AFTER the
+                                    # notice window.
+                                    await asyncio.sleep(0.6)
+                                    procs[idx].send_signal(
+                                        signal.SIGKILL)
+
+                                asyncio.ensure_future(
+                                    kill_later(victim))
+                            if (kill_after is not None and
+                                    len(inc) >= kill_after):
+                                kill_after = None
+                                victim = next(
+                                    i for i, u in enumerate(urls)
+                                    if lb.inflight(u) > 0 and
+                                    procs[i].poll() is None)
+                                procs[victim].send_signal(
+                                    signal.SIGKILL)
+                return inc, done
+
+            oracle_inc, oracle_done = await stream(req)
+            mig_inc, mig_done = await stream(req, preempt_after=5)
+            trips_after_migration = _metric_sum(
+                'skytpu_lb_breaker_trips_total')
+            # Reactive kill-only path on the SAME request: the two
+            # survivors carry it; parity must match the migrated run.
+            re_inc, re_done = await stream(req, kill_after=5)
+            await lb.stop()
+            return (oracle_inc, oracle_done, mig_inc, mig_done,
+                    re_inc, re_done, health, trips_after_migration)
+
+        (oracle_inc, oracle_done, mig_inc, mig_done, re_inc,
+         re_done, health, trips_after_migration) = asyncio.run(
+             scenario())
+        assert oracle_done['status'] == 'finished'
+        assert len(oracle_done['tokens']) == 30
+        # Noticed preemption: the replica answered 'preempting' on
+        # /health (503 = out of the routable set) before the kill.
+        assert health['status'] == 503
+        assert health['body']['status'] == 'preempting'
+        # The migrated stream finished with zero client-visible
+        # errors, carries both markers, and is bitwise equal to the
+        # oracle.
+        assert mig_done is not None and mig_done['status'] == 'finished'
+        assert mig_done.get('migrated') == 1
+        assert mig_done.get('resumed') == 1
+        assert mig_done['tokens'] == oracle_done['tokens']
+        assert mig_inc == oracle_inc == oracle_done['tokens']
+        # ... and to the reactive kill-only path on the same request.
+        assert re_done is not None and re_done['status'] == 'finished'
+        assert re_done.get('resumed') == 1
+        assert 'migrated' not in re_done
+        assert re_done['tokens'] == oracle_done['tokens']
+        assert re_inc == oracle_inc
+        # Exactly one proactive migration; it fed neither the
+        # breaker nor the resume-failure counter.
+        assert (_metric_sum('skytpu_lb_migrations_total') -
+                migrations_before) == 1
+        assert trips_after_migration == 0
+        assert (_metric_sum('skytpu_lb_resume_failures_total') -
+                resume_fail_before) == 0
 
 
 # ================================================== score breakdown
@@ -572,7 +809,8 @@ def test_score_breakdown_resumed_hedged_golden():
         loadgen.RequestRecord(request_id=0, scheduled_s=0.0,
                               submitted_s=0.0, status='finished',
                               ttft_s=0.1, finished_s=1.0, n_tokens=4,
-                              resumed=1, tokens=[1, 2, 3, 4]),
+                              resumed=1, migrated=1,
+                              tokens=[1, 2, 3, 4]),
         loadgen.RequestRecord(request_id=1, scheduled_s=0.5,
                               submitted_s=0.5, status='finished',
                               ttft_s=0.2, finished_s=1.2, n_tokens=4,
@@ -585,11 +823,33 @@ def test_score_breakdown_resumed_hedged_golden():
     assert rep['breakdown'] == {
         'finished': 2, 'expired': 0, 'cancelled': 0, 'shed': 1,
         'deadline_rejected': 0, 'error': 0,
-        'resumed': 1, 'hedged': 1,
+        'resumed': 1, 'migrated': 1, 'hedged': 1,
     }
 
 
 # =============================================== chaos bench (smoke)
+def _expected_bench_receipts(seed, n_kills, n_targets):
+    """Recompute the smoke bench's trace + kill schedule in THIS
+    process. Mirrors the chaos/spot benches' smoke WorkloadSpec
+    (every field but the seed is a constant there): same seed must
+    mean the same trace and schedule in every process that builds
+    them, so comparing the subprocess's receipts against an
+    independent in-process build IS the determinism check — at half
+    the cost of running the whole bench twice (tier-1 budget)."""
+    spec = loadgen.WorkloadSpec(
+        seed=seed, n_requests=10, qps=6.0, arrival='poisson',
+        vocab_size=256, prompt_median=16, prompt_min=4,
+        prompt_max=40, output_median=14, output_sigma=0.3,
+        output_min=8, output_max=24)
+    trace = loadgen.generate(spec)
+    span = max(r.arrival_s for r in trace)
+    schedule = loadgen.seeded_kill_schedule(
+        seed, n_kills, n_targets,
+        t_min=0.25 * span, t_max=0.75 * span)
+    return (loadgen.digest(trace),
+            [round(r.arrival_s, 6) for r in trace[:8]], schedule)
+
+
 def _run_chaos_bench(seed):
     env = {**os.environ, 'BENCH_SMOKE': '1', 'JAX_PLATFORMS': 'cpu',
            'BENCH_MODE': 'serve_chaos', 'BENCH_CHAOS_SEED': str(seed),
@@ -624,9 +884,76 @@ def test_bench_serve_chaos_smoke_deterministic():
     assert d['resume_parity']['mismatched'] == 0
     assert d['resume_parity']['length_mismatches'] == 0
 
-    rc2, second = _run_chaos_bench(seed=3)
-    assert rc2 == 0
-    d2 = second['detail']
-    assert d2['trace_sha256'] == d['trace_sha256']
-    assert d2['kill_schedule'] == d['kill_schedule']
-    assert d2['schedule_head_s'] == d['schedule_head_s']
+    # Determinism: the subprocess's receipts must match an
+    # independent same-seed build of the trace + schedule here.
+    digest, head, schedule = _expected_bench_receipts(
+        seed=3, n_kills=1, n_targets=d['replicas'])
+    assert d['trace_sha256'] == digest
+    assert d['schedule_head_s'] == head
+    assert d['kill_schedule'] == [
+        {'at_s': round(e.at_s, 4), 'replica': e.replica}
+        for e in schedule]
+
+
+def _metric_sum(name):
+    return sum(v for k, v in metrics_lib.summary().items()
+               if k == name or k.startswith(name + '{'))
+
+
+# ================================================ spot bench (smoke)
+def _run_spot_bench(seed):
+    env = {**os.environ, 'BENCH_SMOKE': '1', 'JAX_PLATFORMS': 'cpu',
+           'BENCH_MODE': 'serve_spot', 'BENCH_SPOT_SEED': str(seed),
+           'BENCH_LOAD_REQUESTS': '10',
+           # Laxer gate than the real round's 0.9: a loaded CI box
+           # slows both runs but not perfectly symmetrically.
+           'BENCH_SPOT_MIN_RATIO': '0.6'}
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, 'bench.py')],
+        env=env, cwd=_REPO_ROOT, capture_output=True, text=True,
+        timeout=540)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith('{')]
+    assert lines, proc.stdout[-2000:] + proc.stderr[-2000:]
+    return proc.returncode, json.loads(lines[-1])
+
+
+def test_bench_serve_spot_smoke_deterministic():
+    """bench.py serve_spot under BENCH_SMOKE: a mixed spot/on-demand
+    pool of real replica subprocesses under a seeded notice→SIGKILL
+    schedule vs the all-on-demand baseline. The run must report ok
+    with at least one noticed preemption executed, zero
+    client-visible errors, zero parity mismatches, and the $/Mtok
+    chip-seconds proxy for both runs; the run's receipts must agree
+    with an independent same-seed trace + preemption schedule."""
+    rc1, first = _run_spot_bench(seed=5)
+    d = first['detail']
+    assert rc1 == 0, json.dumps(first)[:2000]
+    assert d['ok'] is True
+    assert d['notices_executed'] >= 1
+    assert d['kills_executed'] >= 1
+    assert d['preemptions']['notice'] >= 1
+    assert d['preemptions']['kill'] >= 1
+    assert d['client_errors'] == 0
+    assert d['resume_parity']['mismatched'] == 0
+    assert d['resume_parity']['length_mismatches'] == 0
+    cost = d['cost_proxy']
+    assert cost['baseline']['chip_s_per_good_token'] > 0
+    assert cost['spot']['chip_s_per_good_token'] > 0
+    # The economics headline: the discounted mixed pool is cheaper
+    # per good token than paying on-demand for everything.
+    assert (cost['spot']['chip_s_per_good_token'] <
+            cost['baseline']['chip_s_per_good_token'])
+
+    # Determinism: the preemption schedule draws over SPOT indices
+    # only, and the receipts must match an independent same-seed
+    # build of the trace + schedule in this process.
+    digest, head, schedule = _expected_bench_receipts(
+        seed=5, n_kills=1, n_targets=d['spot_replicas'])
+    assert d['trace_sha256'] == digest
+    assert d['schedule_head_s'] == head
+    assert d['preempt_schedule'] == [
+        {'at_s': round(e.at_s, 4),
+         'notice_at_s': round(max(0.0, e.at_s - d['notice_s']), 4),
+         'replica': e.replica} for e in schedule]

@@ -55,7 +55,7 @@ class FakeSession:
         self.handler = handler
         self.calls = []
 
-    def request(self, method, url, json=None, params=None):
+    def request(self, method, url, json=None, params=None, timeout=None):
         self.calls.append((method, url, json, params))
         return FakeResp(*self.handler(method, url, json, params))
 

@@ -133,6 +133,141 @@ def test_spec_spot_policy_roundtrip_and_validation():
         })  # fallback without use_spot
 
 
+# -------------------------------- spot preemption rate + headroom
+
+def test_spot_rate_estimator_ewma_decay_and_state(monkeypatch):
+    """Exposure-weighted EWMA (docs/spot_serving.md): events and
+    exposure decay by the SAME half-life factor, so pure time passing
+    holds the rate estimate steady while fresh exposure without
+    events dilutes it."""
+    monkeypatch.setenv('SKYTPU_SPOT_RATE_HALFLIFE_S', '1800')
+    est = autoscalers.SpotPreemptionRateEstimator()
+    assert est.rate_per_replica_hour() == 0.0
+    t0 = 1000.0
+    est.advance(t0, 2)            # first call only anchors the clock
+    assert est.rate_per_replica_hour() == 0.0
+    # One half-life of 2-replica exposure, then one preemption:
+    # exposure = 2 * 1800/3600 = 1.0 replica-hour.
+    est.advance(t0 + 1800, 2)
+    est.record_preemption()
+    assert est.rate_per_replica_hour() == pytest.approx(1.0)
+    # Another half-life with ZERO spot running: events and exposure
+    # both halve — the estimate holds instead of decaying to zero.
+    est.advance(t0 + 3600, 0)
+    assert est.rate_per_replica_hour() == pytest.approx(1.0)
+    # Fresh incident-free exposure dilutes the rate downward.
+    est.advance(t0 + 5400, 4)
+    assert est.rate_per_replica_hour() < 1.0
+    # Expected losses scale with pool size and lead time.
+    assert est.expected_losses(0, 300.0) == 0.0
+    assert est.expected_losses(
+        4, 3600.0) == pytest.approx(4 * est.rate_per_replica_hour())
+    # Exact state round-trip.
+    clone = autoscalers.SpotPreemptionRateEstimator()
+    clone.restore(est.to_state())
+    assert clone.to_state() == est.to_state()
+    assert clone.rate_per_replica_hour() == est.rate_per_replica_hour()
+    # Garbage / old-format state restores COLD, never raises.
+    for bad in ({}, {'events': 'not-a-number', 'exposure_h': []},
+                {'events': object()}, {'last_at': 'later'}):
+        cold = autoscalers.SpotPreemptionRateEstimator()
+        cold.restore(bad)
+        assert cold.rate_per_replica_hour() == 0.0
+
+
+def test_fixed_autoscaler_rate_aware_headroom(monkeypatch):
+    """Rate-aware over-provisioning: a non-zero observed preemption
+    rate adds ceil(rate * spot_target * lead_time) headroom to the
+    spot ask, and the dynamic on-demand fallback is sized against the
+    HEADROOMED plan. Zero observed rate stays bit-identical to the
+    rate-blind split."""
+    monkeypatch.setenv('SKYTPU_SPOT_RATE_HALFLIFE_S', '1800')
+    spec = ServiceSpec(min_replicas=3, use_spot=True,
+                       base_ondemand_fallback_replicas=1,
+                       dynamic_ondemand_fallback=True,
+                       spot_recovery_lead_time_s=1200.0)
+    scaler = autoscalers.make_autoscaler(spec)
+    assert isinstance(scaler, autoscalers.FixedReplicaAutoscaler)
+    t0 = 5000.0
+    # Cold estimator: exactly today's split (3 spot + 1 base od).
+    d = scaler.evaluate(3, now=t0, num_ready_spot=3)
+    assert (d.target_replicas, d.num_spot, d.num_ondemand) == (4, 3, 1)
+    # 1h of 3-replica exposure with 3 preemptions -> ~1.0 per
+    # replica-hour; expected losses within the 1200s lead time =
+    # 1.0 * 3 * 1200/3600 = 1 replica of headroom.
+    scaler.evaluate(3, now=t0 + 3600, num_ready_spot=3)
+    for _ in range(3):
+        scaler.record_preemption()
+    d = scaler.evaluate(3, now=t0 + 3601, num_ready_spot=3)
+    assert d.num_spot == 4                       # 3 target + 1 headroom
+    # Dynamic fallback covers the headroomed plan: 4 wanted, 3 ready.
+    assert d.num_ondemand == 1 + 1
+    assert d.target_replicas == 6
+    # Persistence: the rate survives a controller restart via
+    # to_state()/restore() and yields the SAME decision.
+    fresh = autoscalers.make_autoscaler(spec)
+    fresh.restore(scaler.to_state())
+    d2 = fresh.evaluate(3, now=t0 + 3601, num_ready_spot=3)
+    assert (d2.num_spot, d2.num_ondemand) == (d.num_spot, d.num_ondemand)
+    # Old-format state (no 'spot' key) restores cold: rate-blind
+    # split, no error.
+    legacy = autoscalers.make_autoscaler(spec)
+    legacy.restore({})
+    d3 = legacy.evaluate(3, now=t0, num_ready_spot=3)
+    assert (d3.num_spot, d3.num_ondemand) == (3, 1)
+
+
+def test_fallback_autoscaler_headroom_rides_qps_target(monkeypatch):
+    """The QPS-derived spot target carries the same headroom: the
+    estimator state also round-trips inside the request-rate
+    autoscaler's persisted dict (alongside the QPS window)."""
+    monkeypatch.setenv('SKYTPU_SPOT_RATE_HALFLIFE_S', '1800')
+    spec = ServiceSpec(min_replicas=1, max_replicas=10,
+                       target_qps_per_replica=1.0,
+                       upscale_delay_seconds=0,
+                       downscale_delay_seconds=0,
+                       use_spot=True,
+                       base_ondemand_fallback_replicas=1,
+                       dynamic_ondemand_fallback=True,
+                       spot_recovery_lead_time_s=1200.0)
+    scaler = autoscalers.make_autoscaler(spec)
+    t0 = 7000.0
+    for i in range(180):
+        scaler.record_request(t0 + i / 3.0)      # 3 qps -> 3 spot
+    scaler.evaluate(3, t0 + 60, num_ready_spot=3)
+    d = scaler.evaluate(3, t0 + 61, num_ready_spot=3)
+    assert (d.num_spot, d.num_ondemand) == (3, 1)
+    # An hour of 3-replica exposure with 3 preemptions -> ~1.0 per
+    # replica-hour; traffic keeps flowing so the QPS target holds.
+    for i in range(180):
+        scaler.record_request(t0 + 3600 + i / 3.0)
+    scaler.evaluate(3, t0 + 3661, num_ready_spot=3)
+    for _ in range(3):
+        scaler.record_preemption()
+    d = scaler.evaluate(3, t0 + 3662, num_ready_spot=3)
+    assert d.num_spot == 4 and d.num_ondemand == 2
+    state = scaler.to_state()
+    assert 'spot' in state and 'timestamps' in state
+    fresh = autoscalers.make_autoscaler(spec)
+    fresh.restore(state)
+    assert (fresh.spot_rate.rate_per_replica_hour() ==
+            pytest.approx(scaler.spot_rate.rate_per_replica_hour()))
+
+
+def test_spec_spot_lead_time_roundtrip_and_validation():
+    spec = ServiceSpec.from_yaml_config({
+        'replica_policy': {'min_replicas': 1, 'use_spot': True,
+                           'spot_recovery_lead_time_s': 600},
+    })
+    assert spec.spot_recovery_lead_time_s == 600.0
+    assert ServiceSpec.from_yaml_config(spec.to_yaml_config()) == spec
+    with pytest.raises(exceptions.InvalidTaskError):
+        ServiceSpec.from_yaml_config({
+            'replica_policy': {'min_replicas': 1, 'use_spot': True,
+                               'spot_recovery_lead_time_s': -5},
+        })
+
+
 # ------------------------------------------------------------ LB
 
 def test_round_robin_policy():
