@@ -957,6 +957,201 @@ def serve_load_bench():
     print(json.dumps(result))
 
 
+def serve_qos_bench():
+    """Multi-tenant isolation proof (docs/qos.md): a seeded
+    interactive+bulk tenant mix replayed open-loop into the engine
+    four times — {baseline, bulk-tenant 10x burst} x {QoS on, QoS
+    off} — on the SAME interactive sub-stream (per-tenant seeded
+    trace streams make the victim's requests byte-identical across
+    arms; the report proves it). Gates:
+
+    - QoS ON absorbs the burst: interactive p99 TTFT <=
+      BENCH_QOS_MAX_TTFT_RATIO x and interactive goodput >=
+      BENCH_QOS_MIN_GOODPUT_RATIO x the burst-free same-seed run.
+    - QoS OFF (SKYTPU_QOS_DISABLE=1, the legacy FIFO control) must
+      violate at least one of those bounds on the same traffic —
+      otherwise the scheduler is being credited for isolation the
+      workload never demanded.
+
+    Always the tiny CPU-class config: the claim under test is
+    SCHEDULING, not chip throughput — every engine tick is stretched
+    via the engine.tick.hang chaos site (identically in all four
+    runs) so queueing spans wall-clock time a scheduler can matter
+    to."""
+    import jax
+
+    from skypilot_tpu import loadgen
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    from skypilot_tpu.models.serving_engine import Request  # noqa: F401
+    from skypilot_tpu.utils import fault_injection
+
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    seed = int(os.environ.get('BENCH_QOS_SEED', '0'))
+    n_requests = int(os.environ.get(
+        'BENCH_QOS_REQUESTS', '16' if smoke else '40'))
+    qps = float(os.environ.get('BENCH_QOS_QPS', '24'))
+    burst = float(os.environ.get('BENCH_QOS_BURST', '10'))
+    max_ttft_ratio = float(os.environ.get(
+        'BENCH_QOS_MAX_TTFT_RATIO', '1.2'))
+    min_goodput_ratio = float(os.environ.get(
+        'BENCH_QOS_MIN_GOODPUT_RATIO', '0.9'))
+    # Stretch ticks far enough that the victim's OWN queueing (same
+    # traffic in both arms, so it cancels in the ratio) dominates its
+    # p99 TTFT; with per-tenant n this small, nearest-rank p99 is the
+    # worst sample, and a worst case set by tick-quantized self-
+    # queueing is stable where one set by scheduler noise is not.
+    hang_s = 0.04
+
+    cfg = models.LlamaConfig.tiny(max_seq=256)
+    batch, max_prompt, max_seq, chunk = 4, 64, 160, 4
+    params = models.family(cfg).init_params(cfg, jax.random.PRNGKey(1))
+
+    def mix(burst_mult):
+        # The victim's sub-stream is seeded by (seed, tenant index)
+        # alone: scaling the bulk tenant's rate cannot perturb one
+        # byte of interactive traffic (workload.TenantSpec).
+        # sigma=0 pins every tenant's lengths to its medians: service
+        # time is deterministic, so the victim's p99 (its worst
+        # sample at these n) is set by seeded arrivals + tick count,
+        # not by length-draw luck — the ratio gate needs that.
+        return loadgen.WorkloadSpec(
+            seed=seed, vocab_size=cfg.vocab_size,
+            prompt_median=16, prompt_sigma=0.0,
+            prompt_min=4, prompt_max=48,
+            output_median=6, output_sigma=0.0,
+            output_min=1, output_max=8,
+            tenants=[
+                loadgen.TenantSpec(
+                    'victim', 'interactive', n_requests=n_requests,
+                    qps=qps, deadline_s=8.0),
+                loadgen.TenantSpec(
+                    'noisy', 'bulk', n_requests=n_requests,
+                    qps=(qps / 4.0) * burst_mult,
+                    prompt_median=32, output_median=8),
+            ])
+
+    base_trace = loadgen.generate(mix(1.0))
+    burst_trace = loadgen.generate(mix(burst))
+    victim_key = lambda t: [  # noqa: E731
+        (r.request_id, round(r.arrival_s, 6), tuple(r.tokens),
+         r.max_new) for r in t if r.tenant == 'victim']
+    victim_identical = victim_key(base_trace) == victim_key(burst_trace)
+
+    # Rate 400 tick-tokens/s: above the victim's demand (~240/s at
+    # 24 qps x a 10-token charge) so the victim never throttles,
+    # well below the noisy tenant's 10x burst (~960/s) so the flood
+    # is paced. Isolation is mostly the DRR class ordering (bulk
+    # never admits past a queued interactive) plus fast preemption.
+    qos_env = {
+        'SKYTPU_QOS_TENANT_RATE': '400',
+        'SKYTPU_QOS_TENANT_BURST': '400',
+        'SKYTPU_QOS_MAX_QUEUE': '32',
+        'SKYTPU_QOS_PREEMPT_AFTER_S': '0.02',
+    }
+    fifo_env = {'SKYTPU_QOS_DISABLE': '1'}
+    managed = sorted(set(qos_env) | set(fifo_env))
+
+    slo = loadgen.SLO(ttft_s=3.0, itl_p99_s=2.0)
+
+    def run_round(trace, env):
+        saved = {k: os.environ.pop(k, None) for k in managed}
+        try:
+            os.environ.update(env)
+            engine = ServingEngine(params, cfg, batch_size=batch,
+                                   max_prompt=max_prompt,
+                                   max_seq=max_seq,
+                                   decode_chunk=chunk,
+                                   prefill_chunk=16)
+            engine.warmup()
+        finally:
+            for k, v in saved.items():
+                os.environ.pop(k, None)
+                if v is not None:
+                    os.environ[k] = v
+        # Identical tick tax in every arm: the ratios isolate the
+        # scheduler, not the stretch.
+        with fault_injection.fault_plan(faults=[
+                {'site': 'engine.tick.hang', 'kind': 'hang',
+                 'times': None, 'params': {'seconds': hang_s}}]):
+            records, wall = loadgen.replay_engine(engine, trace)
+        return loadgen.score(records, slo, wall)
+
+    with _bench_span('serve_qos', requests=2 * n_requests, qps=qps,
+                     burst=burst):
+        on_base = run_round(base_trace, qos_env)
+        on_burst = run_round(burst_trace, qos_env)
+        off_base = run_round(base_trace, fifo_env)
+        off_burst = run_round(burst_trace, fifo_env)
+
+    def victim_stats(report):
+        v = report['tenants']['victim']
+        p99 = v['ttft']['p99']
+        return {'ttft_p99': p99 if p99 is not None else float('inf'),
+                'goodput': v['goodput_req_s'],
+                'breakdown': v['breakdown']}
+
+    vb, vu = victim_stats(on_base), victim_stats(on_burst)
+    fb, fu = victim_stats(off_base), victim_stats(off_burst)
+
+    def ratios(base, under):
+        ttft_r = (under['ttft_p99'] / base['ttft_p99']
+                  if base['ttft_p99'] > 0 else float('inf'))
+        good_r = (under['goodput'] / base['goodput']
+                  if base['goodput'] > 0 else
+                  (1.0 if under['goodput'] == base['goodput'] else 0.0))
+        return round(ttft_r, 4), round(good_r, 4)
+
+    on_ttft_r, on_good_r = ratios(vb, vu)
+    off_ttft_r, off_good_r = ratios(fb, fu)
+    qos_holds = (on_ttft_r <= max_ttft_ratio and
+                 on_good_r >= min_goodput_ratio)
+    control_violates = (off_ttft_r > max_ttft_ratio or
+                        off_good_r < min_goodput_ratio)
+    ok = qos_holds and control_violates and victim_identical
+    result = {
+        'metric': 'llama_serve_qos_isolation_ratio',
+        # Headline: how much of the victim's burst-free goodput the
+        # QoS scheduler preserves under the 10x bulk burst.
+        'value': on_good_r,
+        'unit': 'burst/baseline interactive goodput',
+        'vs_baseline': on_good_r,
+        'detail': {
+            'ok': ok,
+            'seed': seed,
+            'n_requests_per_tenant': n_requests,
+            'qps': qps,
+            'burst_mult': burst,
+            'tick_hang_s': hang_s,
+            'victim_substream_identical': victim_identical,
+            'base_trace_sha256': loadgen.digest(base_trace),
+            'burst_trace_sha256': loadgen.digest(burst_trace),
+            'gates': {
+                'max_ttft_ratio': max_ttft_ratio,
+                'min_goodput_ratio': min_goodput_ratio,
+                'qos_on_ttft_ratio': on_ttft_r,
+                'qos_on_goodput_ratio': on_good_r,
+                'qos_off_ttft_ratio': off_ttft_r,
+                'qos_off_goodput_ratio': off_good_r,
+                'qos_holds': qos_holds,
+                'control_violates': control_violates,
+            },
+            'qos_env': qos_env,
+            'victim': {'qos_baseline': vb, 'qos_burst': vu,
+                       'fifo_baseline': fb, 'fifo_burst': fu},
+            'qos_on_burst_report': on_burst,
+            'qos_off_burst_report': off_burst,
+            'metrics': metrics_lib.summary(),
+        },
+    }
+    merged = _merged_trace_path()
+    if merged:
+        result['detail']['span_trace_file'] = merged
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def serve_stack_bench():
     """Served QPS through the REAL serving stack: concurrent HTTP
     clients -> serve LoadBalancer (reverse proxy, least-load policy)
@@ -1791,6 +1986,11 @@ _ALL_MODES = {
     # arrivals at ~capacity, scored against TTFT/ITL SLOs — the
     # round's SLO-attainment number next to its raw req/s.
     'serve_load': {'BENCH_MODE': 'serve_load'},
+    # Multi-tenant isolation (docs/qos.md): interactive+bulk tenant
+    # mix replayed 4 ways ({baseline, 10x bulk burst} x {QoS on,
+    # FIFO control}); gates that QoS preserves the victim's p99 TTFT
+    # and goodput while the FIFO control visibly does not.
+    'serve_qos': {'BENCH_MODE': 'serve_qos'},
     # Replica-failure survivability (docs/failover.md): seeded
     # SIGKILLs against replica subprocesses mid-trace; goodput under
     # chaos vs the same-seed clean run, breaker/hedge/resume counts,
@@ -2027,6 +2227,8 @@ if __name__ == '__main__':
         sys.exit(serve_stack_bench())
     if mode == 'serve_load':
         sys.exit(serve_load_bench())
+    if mode == 'serve_qos':
+        sys.exit(serve_qos_bench())
     if mode == 'all':
         sys.exit(all_bench())
     sys.exit(main())

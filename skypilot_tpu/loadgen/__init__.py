@@ -29,6 +29,7 @@ from skypilot_tpu.loadgen.replay import seeded_kill_schedule
 from skypilot_tpu.loadgen.score import RequestRecord
 from skypilot_tpu.loadgen.score import SLO
 from skypilot_tpu.loadgen.score import score
+from skypilot_tpu.loadgen.workload import TenantSpec
 from skypilot_tpu.loadgen.workload import TraceRequest
 from skypilot_tpu.loadgen.workload import WorkloadSpec
 from skypilot_tpu.loadgen.workload import digest
@@ -39,7 +40,7 @@ from skypilot_tpu.loadgen.workload import load_jsonl_path
 from skypilot_tpu.loadgen.workload import to_jsonl
 
 __all__ = [
-    'KillEvent', 'RequestRecord', 'SLO', 'TraceRequest',
+    'KillEvent', 'RequestRecord', 'SLO', 'TenantSpec', 'TraceRequest',
     'WorkloadSpec', 'digest', 'dump_jsonl', 'generate', 'load_jsonl',
     'load_jsonl_path', 'replay_engine', 'replay_http',
     'replay_http_async', 'replay_http_chaos',

@@ -209,7 +209,9 @@ def replay_engine(engine: Any, trace: Sequence[TraceRequest]
     records: Dict[int, RequestRecord] = {
         r.request_id: RequestRecord(request_id=r.request_id,
                                     scheduled_s=r.arrival_s,
-                                    deadline_s=r.deadline_s)
+                                    deadline_s=r.deadline_s,
+                                    tenant=r.tenant,
+                                    priority_class=r.priority_class)
         for r in ordered}
     last_emit: Dict[Any, float] = {}
 
@@ -246,7 +248,9 @@ def replay_engine(engine: Any, trace: Sequence[TraceRequest]
                         r.request_id, list(r.tokens), r.max_new,
                         deadline=(time.time() + r.deadline_s
                                   if r.deadline_s is not None
-                                  else None)))
+                                  else None),
+                        tenant=r.tenant,
+                        priority_class=r.priority_class))
                 except ValueError as e:
                     rec.status = 'error'
                     rec.reason = str(e)
@@ -294,6 +298,13 @@ async def _replay_one(session: Any, url: str, r: TraceRequest,
             'stream': True}
     if r.deadline_s is not None:
         body['timeout_s'] = r.deadline_s
+    # QoS tags ride the body (docs/qos.md): replicas accept them as
+    # header OR body keys, and body keys survive every LB hop (the
+    # SSE driver re-sends the parsed payload on hedge/resume).
+    if r.tenant is not None:
+        body['tenant'] = r.tenant
+    if r.priority_class is not None:
+        body['priority_class'] = r.priority_class
     try:
         async with session.post(
                 url.rstrip('/') + '/generate', json=body,
@@ -374,7 +385,9 @@ async def replay_http_async(url: str, trace: Sequence[TraceRequest],
     ordered = sorted(trace, key=lambda r: (r.arrival_s, r.request_id))
     records = [RequestRecord(request_id=r.request_id,
                              scheduled_s=r.arrival_s,
-                             deadline_s=r.deadline_s)
+                             deadline_s=r.deadline_s,
+                             tenant=r.tenant,
+                             priority_class=r.priority_class)
                for r in ordered]
     loop = asyncio.get_event_loop()
     start = loop.time()

@@ -75,6 +75,12 @@ class RequestRecord:
     # the chaos bench's greedy-parity check re-runs resumed prompts
     # against a survivor and compares these bitwise.
     tokens: Optional[List[int]] = None
+    # Multi-tenant QoS attribution (docs/qos.md): which tenant the
+    # request belonged to and the priority class it ran under. None =
+    # untagged (pre-QoS traces) — the report's per-tenant/per-class
+    # sections only appear when at least one record carries a tag.
+    tenant: Optional[str] = None
+    priority_class: Optional[str] = None
 
     def itl_p99(self) -> Optional[float]:
         return percentile(self.itls, 0.99)
@@ -106,6 +112,28 @@ def _pct_table(samples: Sequence[float]) -> Dict[str, Optional[float]]:
         p = percentile(s, q)
         out[f'p{int(q * 100)}'] = None if p is None else round(p, 4)
     return out
+
+
+def _group_report(recs: Sequence[RequestRecord], slo: SLO,
+                  wall_s: float) -> Dict[str, Any]:
+    """The per-tenant / per-class slice of the goodput report: the
+    same objectives and wall clock as the headline, folded over one
+    group's records, so 'tenant A kept its goodput while tenant B
+    burst' is a statement the report itself can make."""
+    good = 0
+    for r in recs:
+        good += _attained(r, slo)['all']
+    finished = [r for r in recs if r.status == 'finished']
+    ttfts = [r.ttft_s for r in finished if r.ttft_s is not None]
+    breakdown = Counter(r.status for r in recs)
+    return {
+        'n_requests': len(recs),
+        'goodput_req_s': round(good / wall_s, 3),
+        'attainment_all': (round(good / len(recs), 4)
+                           if recs else None),
+        'ttft': _pct_table(ttfts),
+        'breakdown': {s: breakdown.get(s, 0) for s in STATUSES},
+    }
 
 
 def score(records: Sequence[RequestRecord], slo: SLO,
@@ -149,7 +177,7 @@ def score(records: Sequence[RequestRecord], slo: SLO,
     span = (max(r.scheduled_s for r in records) -
             min(r.scheduled_s for r in records)) if records else 0.0
     offered = n / span if span > 0 else n / wall_s
-    return {
+    report: Dict[str, Any] = {
         'n_requests': n,
         'wall_s': round(wall_s, 3),
         'offered_req_s': round(offered, 3),
@@ -174,3 +202,22 @@ def score(records: Sequence[RequestRecord], slo: SLO,
                if s not in STATUSES},
         },
     }
+    # Per-tenant / per-class slices (docs/qos.md) only when some
+    # record is tagged: untagged replays keep the pre-QoS report
+    # shape byte-for-byte (golden tests depend on it).
+    if any(r.tenant is not None or r.priority_class is not None
+           for r in records):
+        by_tenant: Dict[str, List[RequestRecord]] = {}
+        by_class: Dict[str, List[RequestRecord]] = {}
+        for r in records:
+            by_tenant.setdefault(r.tenant or '_untagged',
+                                 []).append(r)
+            by_class.setdefault(r.priority_class or '_untagged',
+                                []).append(r)
+        report['tenants'] = {
+            t: _group_report(recs, slo, wall_s)
+            for t, recs in sorted(by_tenant.items())}
+        report['classes'] = {
+            c: _group_report(recs, slo, wall_s)
+            for c, recs in sorted(by_class.items())}
+    return report

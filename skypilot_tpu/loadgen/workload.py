@@ -35,6 +35,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from skypilot_tpu.utils import qos as qos_lib
+
 TRACE_FORMAT_VERSION = 1
 
 ARRIVAL_MODELS = ('uniform', 'poisson', 'bursty')
@@ -55,9 +57,14 @@ class TraceRequest:
     # starts with; None = a unique prompt. Carried so replay reports
     # can split hit/miss traffic without re-deriving prefixes.
     prefix_rank: Optional[int] = None
+    # Multi-tenant QoS attribution (docs/qos.md); None = untagged.
+    # Serialized only when set, so single-tenant traces keep their
+    # pre-QoS canonical bytes (and digests).
+    tenant: Optional[str] = None
+    priority_class: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             'id': self.request_id,
             'arrival_s': round(self.arrival_s, 6),
             'tokens': list(self.tokens),
@@ -65,6 +72,11 @@ class TraceRequest:
             'deadline_s': self.deadline_s,
             'prefix_rank': self.prefix_rank,
         }
+        if self.tenant is not None:
+            d['tenant'] = self.tenant
+        if self.priority_class is not None:
+            d['priority_class'] = self.priority_class
+        return d
 
     @classmethod
     def from_json(cls, d: Dict[str, Any]) -> 'TraceRequest':
@@ -75,7 +87,38 @@ class TraceRequest:
                    deadline_s=(None if d.get('deadline_s') is None
                                else float(d['deadline_s'])),
                    prefix_rank=(None if d.get('prefix_rank') is None
-                                else int(d['prefix_rank'])))
+                                else int(d['prefix_rank'])),
+                   tenant=(None if d.get('tenant') is None
+                           else str(d['tenant'])),
+                   priority_class=(
+                       None if d.get('priority_class') is None
+                       else str(d['priority_class'])))
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    """One tenant's sub-stream in a multi-tenant mix (docs/qos.md).
+
+    Each tenant draws from its OWN rng, seeded by (workload seed,
+    tenant index), so tenant i's requests — arrivals, lengths,
+    tokens — are a pure function of (seed, i, this TenantSpec).
+    Cranking one tenant's rate or count leaves every other tenant's
+    sub-stream byte-identical, which is exactly the property the
+    burst-isolation A/B bench leans on: the victim traffic in the
+    control and treatment arms is the same trace.
+
+    Fields left at ``None`` inherit the base :class:`WorkloadSpec`
+    value; ``n_requests``/``qps`` are always per-tenant.
+    """
+    name: str
+    priority_class: str = 'standard'
+    n_requests: int = 32
+    qps: float = 4.0
+    arrival: Optional[str] = None
+    prompt_median: Optional[int] = None
+    output_median: Optional[int] = None
+    # Tenant deadline budget; None inherits the base spec's.
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -108,6 +151,11 @@ class WorkloadSpec:
     zipf_s: float = 1.1
     # Relative per-request deadline budget; None = no deadlines.
     deadline_s: Optional[float] = None
+    # Multi-tenant mix (docs/qos.md): when non-empty, the trace is
+    # the arrival-ordered merge of one independently seeded
+    # sub-stream per tenant (spec.n_requests/qps/arrival become the
+    # per-tenant defaults; each TenantSpec overrides its own).
+    tenants: List[TenantSpec] = dataclasses.field(default_factory=list)
 
     def validate(self) -> None:
         if self.arrival not in ARRIVAL_MODELS:
@@ -125,6 +173,28 @@ class WorkloadSpec:
                 f'a suffix under prompt_max ({self.prompt_max})')
         if self.burst_factor < 1.0:
             raise ValueError('burst_factor must be >= 1')
+        seen = set()
+        for t in self.tenants:
+            if qos_lib.validate_tenant(t.name) is None:
+                raise ValueError(
+                    f'tenant name must be non-empty, got {t.name!r}')
+            if t.name in seen:
+                raise ValueError(f'duplicate tenant {t.name!r}')
+            seen.add(t.name)
+            qos_lib.validate_class(t.priority_class)
+            if t.qps <= 0 or t.n_requests <= 0:
+                raise ValueError(
+                    f'tenant {t.name!r}: qps and n_requests must be '
+                    f'positive')
+            if (t.arrival is not None and
+                    t.arrival not in ARRIVAL_MODELS):
+                raise ValueError(
+                    f'tenant {t.name!r}: arrival must be one of '
+                    f'{ARRIVAL_MODELS}, got {t.arrival!r}')
+            if t.n_requests >= _TENANT_ID_STRIDE:
+                raise ValueError(
+                    f'tenant {t.name!r}: n_requests must stay under '
+                    f'{_TENANT_ID_STRIDE} (request-id namespacing)')
 
     def to_json(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -176,13 +246,54 @@ def _lengths(rng: np.random.Generator, n: int, median: int,
     return np.clip(raw.astype(np.int64), lo, hi)
 
 
+# Request-id namespace per tenant sub-stream: tenant i's requests
+# are numbered i*stride, i*stride+1, ... — stable across mix changes
+# so A/B runs can join per-request records by id.
+_TENANT_ID_STRIDE = 1_000_000
+
+
 def generate(spec: WorkloadSpec) -> List[TraceRequest]:
     """Spec -> deterministic trace. One seeded RNG drives arrivals,
     lengths, prefix picks and token draws in a fixed order, so the
     whole trace — schedule included — is a pure function of the
-    spec."""
+    spec. With ``spec.tenants`` set, each tenant gets its own
+    ``default_rng((seed, tenant_index))`` sub-stream and the trace is
+    the arrival-ordered merge — perturbing one tenant's knobs leaves
+    every other sub-stream byte-identical."""
     spec.validate()
-    rng = np.random.default_rng(spec.seed)
+    if spec.tenants:
+        merged: List[TraceRequest] = []
+        for idx, tenant in enumerate(spec.tenants):
+            sub = dataclasses.replace(
+                spec,
+                tenants=[],
+                n_requests=tenant.n_requests,
+                qps=tenant.qps,
+                arrival=(tenant.arrival if tenant.arrival is not None
+                         else spec.arrival),
+                prompt_median=(tenant.prompt_median
+                               if tenant.prompt_median is not None
+                               else spec.prompt_median),
+                output_median=(tenant.output_median
+                               if tenant.output_median is not None
+                               else spec.output_median),
+                deadline_s=(tenant.deadline_s
+                            if tenant.deadline_s is not None
+                            else spec.deadline_s),
+            )
+            rng = np.random.default_rng((spec.seed, idx))
+            for r in _generate_stream(sub, rng):
+                r.request_id += idx * _TENANT_ID_STRIDE
+                r.tenant = tenant.name
+                r.priority_class = tenant.priority_class
+                merged.append(r)
+        merged.sort(key=lambda r: (r.arrival_s, r.request_id))
+        return merged
+    return _generate_stream(spec, np.random.default_rng(spec.seed))
+
+
+def _generate_stream(spec: WorkloadSpec,
+                     rng: np.random.Generator) -> List[TraceRequest]:
     arrivals = _arrivals(spec, rng)
     n = spec.n_requests
     plens = _lengths(rng, n, spec.prompt_median, spec.prompt_sigma,

@@ -107,6 +107,7 @@ from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import qos as qos_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -210,6 +211,36 @@ _M_SLO_VIOLATIONS = metrics_lib.counter(
     'inter-token gap for itl (SKYTPU_SLO_ITL_S) — a long stream '
     'with many slow gaps counts each stall it inflicted.',
     labels=('kind',))
+# Multi-tenant QoS telemetry (docs/qos.md). Class labels are a
+# closed 3-value set; tenant labels are caller-controlled, so that
+# series is EXPLICITLY bounded — past max_series new tenants fold
+# into the registry's '_other' bucket instead of growing it.
+_M_SHEDS = metrics_lib.counter(
+    'skytpu_engine_sheds_total',
+    'Queued requests shed by the QoS queue-pressure bound '
+    '(SKYTPU_QOS_MAX_QUEUE), by priority class — bulk sheds before '
+    'standard before interactive (docs/qos.md).',
+    labels=('class',), max_series=8)
+_M_PREEMPTS = metrics_lib.counter(
+    'skytpu_engine_preempted_total',
+    'Decode slots preempt-cancelled (reason=preempted_by_priority) '
+    'to unblock a sustained higher-priority admission stall '
+    '(SKYTPU_QOS_PREEMPT_AFTER_S), by the VICTIM\'s priority class.',
+    labels=('class',), max_series=8)
+_M_TENANT_TOKENS = metrics_lib.counter(
+    'skytpu_engine_tenant_tokens_total',
+    'Output tokens emitted, by tenant (requests that name no tenant '
+    'are not counted here — skytpu_engine_tokens_total is the '
+    'all-traffic series). Bounded: past max_series tenants fold '
+    'into _other.',
+    labels=('tenant',), max_series=64)
+_M_CLASS_TTFT_P99 = metrics_lib.gauge(
+    'skytpu_engine_class_ttft_p99_seconds',
+    'Sliding-window p99 of submit-to-first-token latency by '
+    'priority class (SKYTPU_SLO_WINDOW_S): the per-class SLO signal '
+    'the autoscaler scrapes when the ServiceSpec declares per-class '
+    'targets (docs/qos.md).',
+    labels=('class',), max_series=8)
 
 # Consecutive no-draft proposal rounds before the engine goes "dry":
 # while dry, ticks stay fully pipelined (no flush) and proposals only
@@ -274,6 +305,13 @@ class Request:
     # request (queued or mid-decode) once it passes, surfacing a
     # partial Result with status='expired'. None = immortal (legacy).
     deadline: Optional[float] = None
+    # Multi-tenant QoS (docs/qos.md): the submitting tenant (None =
+    # anonymous — exempt from token-bucket rate limiting) and the
+    # priority class ('interactive' | 'standard' | 'bulk'; None =
+    # standard). Requests that set neither ride the legacy FIFO path
+    # bit-for-bit.
+    tenant: Optional[str] = None
+    priority_class: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -318,6 +356,11 @@ class _SlotState:
     # region; only newly generated tokens append per tick.
     chain_buf: Optional[np.ndarray] = None
     chain_len: int = 0
+    # QoS identity, copied from the Request at admission: the
+    # preemption victim choice and the per-tenant/per-class
+    # telemetry read these off the slot.
+    tenant: Optional[str] = None
+    priority_class: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -765,6 +808,36 @@ class ServingEngine:
             window_s)
         self._itl_window = metrics_lib.SlidingWindowPercentile(
             window_s)
+        # Multi-tenant QoS (docs/qos.md), resolved at construction
+        # like every other dispatch knob. The scheduler stays DORMANT
+        # — _admit runs the legacy FIFO pop bit-for-bit — until a
+        # request actually names a tenant or a non-default class, or
+        # the per-tenant token buckets are configured; _qos_active
+        # latches on first sight and never clears (single-class
+        # traffic therefore never pays the DRR scan).
+        self._qos_cfg = qos_lib.qos_config_from_env()
+        self._qos_weights = qos_lib.parse_weights()
+        # DRR quantum = one decode chunk of tick-tokens per weight
+        # unit per round: small enough that interleave granularity
+        # tracks class weights, large enough that a typical charge
+        # clears in a handful of rounds.
+        self._drr = qos_lib.DeficitRoundRobin(
+            self._qos_weights, quantum=float(self.decode_chunk))
+        self._buckets: Dict[str, qos_lib.TokenBucket] = {}
+        self._qos_active = (self._qos_cfg['tenant_rate'] > 0 and
+                            not self._qos_cfg['disable'])
+        # Monotonic timestamp since when the best-ranked queued
+        # request has been admission-blocked while a strictly
+        # lower-class slot runs (the preemption timer); None = not
+        # currently blocked that way.
+        self._qos_blocked_since: Optional[float] = None
+        # Synthetic-burst id counter (engine.tenant.burst fault site).
+        self._burst_seq = 0
+        # Per-class sliding TTFT windows behind
+        # skytpu_engine_class_ttft_p99_seconds.
+        self._class_ttft_windows = {
+            cls: metrics_lib.SlidingWindowPercentile(window_s)
+            for cls in qos_lib.PRIORITY_CLASSES}
         # Next refresh_slo_gauges() deadline (perf_counter): bounds
         # the est-wait O(queue) scan to 4 Hz however hot the tick
         # loop runs.
@@ -961,6 +1034,17 @@ class ServingEngine:
             raise ValueError(
                 f'max_new ({request.max_new}) exceeds the decode '
                 f'capacity ({self.decode_capacity()}); raise max_seq.')
+        if (not self._qos_active and
+                not self._qos_cfg['disable'] and
+                (request.tenant is not None or
+                 (request.priority_class is not None and
+                  request.priority_class != qos_lib.DEFAULT_CLASS))):
+            # Sticky latch (GIL-atomic bool write; the driver reads
+            # it at the next tick boundary): from the first request
+            # that names a tenant or a non-default class, admission
+            # switches from the legacy FIFO pop to the QoS scheduler.
+            # SKYTPU_QOS_DISABLE=1 pins the legacy path regardless.
+            self._qos_active = True
         # Duplicate check + tracking writes + append under one lock:
         # check-then-append without it lets two concurrent submitters
         # of the same id both pass the membership test — exactly the
@@ -1113,18 +1197,215 @@ class ServingEngine:
                 return False
         return True
 
+    def _admission_charge(self, req: Request) -> int:
+        """The request's admission cost in tick-tokens — the SAME
+        cost model _fits charges against the decode region: max_new
+        decode steps plus decode_chunk region steps per prefill tick
+        of the uncached suffix. This is the currency the QoS token
+        buckets and DRR deficits are priced in (docs/qos.md), so
+        rate limits and fairness track actual capacity consumption,
+        not request counts."""
+        if self.prefix is None or self._warming:
+            suffix = len(req.tokens)
+        else:
+            suffix = self._suffix_len(len(req.tokens), req.tokens,
+                                      holder=req)
+        return (req.max_new +
+                self._prefill_ticks(suffix) * self.decode_chunk)
+
+    def _bucket_for(self, tenant: Optional[str]
+                    ) -> Optional[qos_lib.TokenBucket]:
+        """The tenant's token bucket (created full on first sight).
+        None when rate limiting is off or the request is anonymous —
+        tenancy is opt-in, and an unnamed request cannot be rate-
+        limited against anyone in particular."""
+        if tenant is None or self._qos_cfg['tenant_rate'] <= 0:
+            return None
+        bkt = self._buckets.get(tenant)
+        if bkt is None:
+            bkt = qos_lib.TokenBucket(
+                rate=self._qos_cfg['tenant_rate'],
+                burst=self._qos_cfg['tenant_burst'],
+                updated=time.monotonic())
+            self._buckets[tenant] = bkt
+        return bkt
+
+    def _qos_select(self) -> Optional[int]:
+        """Queue index of the next request the QoS scheduler would
+        admit, or None when every stream head is blocked by its
+        token bucket or DRR deficit this round.
+
+        One call = one DRR round: every live (tenant, class) stream
+        earns quantum * weight deficit, then streams are visited in
+        class-rank order (rotation within a rank) and the first head
+        whose charge clears BOTH its bucket and its deficit wins.
+        Nothing is spent here — _admit charges on actual admission,
+        so a head later rejected by _fits keeps its budget. Index
+        scan, not iteration: submit() may append concurrently
+        (appends keep indexes valid; this driver is the sole popper).
+        """
+        heads: Dict[tuple, tuple] = {}
+        for i in range(len(self.queue)):
+            try:
+                r = self.queue[i]
+            except IndexError:
+                break
+            key = (r.tenant,
+                   r.priority_class or qos_lib.DEFAULT_CLASS)
+            if key not in heads:
+                heads[key] = (i, r)
+        if not heads:
+            return None
+        self._drr.earn(list(heads.keys()))
+        now = time.monotonic()
+        for key in self._drr.order():
+            if key not in heads:
+                continue
+            idx, r = heads[key]
+            charge = self._admission_charge(r)
+            bkt = self._bucket_for(key[0])
+            if bkt is not None and not bkt.peek(charge, now):
+                continue
+            if not self._drr.can_spend(key, charge):
+                continue
+            return idx
+        return None
+
+    def _qos_shed_queue(self) -> None:
+        """Queue-pressure shedding (SKYTPU_QOS_MAX_QUEUE): while the
+        queue exceeds the bound, cancel the NEWEST request of the
+        LOWEST class — bulk sheds before standard before interactive,
+        and within a class the most recently submitted goes first
+        (it has waited least). Terminal status is 'cancelled' with
+        reason='shed_by_priority' (lifecycle has exactly three
+        terminal states; the reason is the QoS discriminator)."""
+        bound = self._qos_cfg['max_queue']
+        if bound <= 0 or len(self.queue) <= bound:
+            return
+        while len(self.queue) > bound:
+            victim = None      # (rank, queue index, request)
+            for i in range(len(self.queue)):
+                try:
+                    r = self.queue[i]
+                except IndexError:
+                    break
+                cand = (qos_lib.class_rank(r.priority_class), i, r)
+                if victim is None or cand > victim:
+                    victim = cand
+            if victim is None:
+                return
+            _, _, req = victim
+            cls = req.priority_class or qos_lib.DEFAULT_CLASS
+            self._cancel_now(req.request_id, 'shed_by_priority',
+                             lifecycle.CANCELLED)
+            if not self._warming:
+                _M_SHEDS.inc(1, **{'class': cls})
+
+    def _qos_maybe_preempt(self) -> None:
+        """Sustained-overload preemption (SKYTPU_QOS_PREEMPT_AFTER_S):
+        when the best-ranked queued request has been admission-
+        blocked for the threshold while a STRICTLY lower class holds
+        a decode slot, preempt-cancel the youngest lowest-class slot
+        (reason='preempted_by_priority' — PR 7's cancel path frees
+        the slot at this same tick boundary). At most one victim per
+        tick: preemption is a pressure valve, not a scheduler."""
+        threshold = self._qos_cfg['preempt_after_s']
+        if threshold <= 0:
+            return
+        best = None            # (rank, request)
+        for i in range(len(self.queue)):
+            try:
+                r = self.queue[i]
+            except IndexError:
+                break
+            rank = qos_lib.class_rank(r.priority_class)
+            if best is None or rank < best[0]:
+                best = (rank, r)
+        if best is None:
+            self._qos_blocked_since = None
+            return
+        rank, head = best
+        victim = None          # (victim rank, seq, slot state)
+        for s in self.slots:
+            if s is None:
+                continue
+            vrank = qos_lib.class_rank(s.priority_class)
+            if vrank <= rank:
+                continue
+            cand = (vrank, s.seq, s)
+            if victim is None or (cand[0], cand[1]) > (victim[0],
+                                                       victim[1]):
+                victim = cand
+        blocked = (victim is not None and
+                   (not any(s is None for s in self.slots) or
+                    not self._fits(head)))
+        if not blocked:
+            self._qos_blocked_since = None
+            return
+        now = time.monotonic()
+        if self._qos_blocked_since is None:
+            self._qos_blocked_since = now
+            return
+        if now - self._qos_blocked_since < threshold:
+            return
+        state = victim[2]
+        cls = state.priority_class or qos_lib.DEFAULT_CLASS
+        self._cancel_now(state.request_id, 'preempted_by_priority',
+                         lifecycle.CANCELLED)
+        if not self._warming:
+            _M_PREEMPTS.inc(1, **{'class': cls})
+        self._qos_blocked_since = None
+
+    def _inject_tenant_burst(self, params: Dict[str, Any]) -> None:
+        """Act out a fired engine.tenant.burst fault: submit the
+        params-described synthetic requests from the named tenant
+        into our own queue. Deterministic (seeded rng, counter-
+        unique ids) so chaos isolation tests replay bit-identically
+        without a load generator (docs/qos.md)."""
+        tenant = str(params.get('tenant', 'chaos-tenant'))
+        cls = str(params.get('priority_class', 'bulk'))
+        n = int(params.get('n', 8))
+        prompt_len = min(int(params.get('prompt_len', 32)),
+                         self.max_prompt)
+        max_new = min(int(params.get('max_new', 16)),
+                      self.decode_capacity())
+        rng = np.random.default_rng(int(params.get('seed', 0)))
+        for _ in range(max(0, n)):
+            self._burst_seq += 1
+            toks = rng.integers(
+                1, max(2, self.cfg.vocab_size - 1),
+                size=max(1, prompt_len)).tolist()
+            self.submit(Request(
+                request_id=f'burst-{tenant}-{self._burst_seq}',
+                tokens=toks, max_new=max(1, max_new),
+                tenant=tenant, priority_class=cls))
+
     def _admit(self) -> None:
-        """Move queued requests into free slots (FIFO, host-side only
-        — no device call: prefill happens chunk-by-chunk in the tick
+        """Move queued requests into free slots (host-side only — no
+        device call: prefill happens chunk-by-chunk in the tick
         loop). Prefilling slots are capped at the budget's row count
-        so every one of them is scheduled every tick."""
+        so every one of them is scheduled every tick.
+
+        Ordering: strict FIFO until QoS engages (_qos_active — a
+        request named a tenant/non-default class, or token buckets
+        are configured), then deficit-round-robin weighted-fair
+        selection across (tenant, class) streams (_qos_select). The
+        FIFO path below is bit-for-bit the pre-QoS admission loop —
+        single-class traffic's regression guarantee."""
         free = [i for i, s in enumerate(self.slots) if s is None]
         n_prefilling = sum(1 for s in self.slots
                            if s is not None and s.phase == 'prefill')
         admitted = False
+        qos_on = self._qos_active
         while (self.queue and free and
                n_prefilling < self._prefill_rows):
-            req = self.queue[0]
+            if qos_on:
+                idx = self._qos_select()
+                if idx is None:
+                    break   # every stream head is budget-blocked
+            else:
+                idx = 0
+            req = self.queue[idx]
             if not self._fits(req):
                 if (self.num_active() == 0 and not admitted and
                         self._pending is None and self._steps_done):
@@ -1137,6 +1418,17 @@ class ServingEngine:
                     _M_RESETS.inc()
                     continue
                 break  # wait for running requests to drain
+            if qos_on:
+                # Spend ONLY on actual admission: the charge clears
+                # the stream's DRR deficit and (when rate limiting
+                # is on and the request names a tenant) its bucket.
+                charge = self._admission_charge(req)
+                key = (req.tenant,
+                       req.priority_class or qos_lib.DEFAULT_CLASS)
+                self._drr.spend(key, charge)
+                bkt = self._bucket_for(req.tenant)
+                if bkt is not None:
+                    bkt.spend(charge, time.monotonic())
             # Slot assignment BEFORE popleft: the request must never
             # be in neither container, or a concurrent submit() of
             # the same id passes the duplicate check in that window
@@ -1150,8 +1442,12 @@ class ServingEngine:
                 generated=[], prompt=list(req.tokens),
                 prompt_len=len(req.tokens), phase='prefill',
                 prefill_pos=0, seq=self._seq, epoch=self._epoch,
-                deadline=req.deadline)
-            self.queue.popleft()
+                deadline=req.deadline, tenant=req.tenant,
+                priority_class=req.priority_class)
+            if idx:
+                del self.queue[idx]
+            else:
+                self.queue.popleft()
             self._temps[slot_idx] = (
                 req.temperature if req.temperature is not None
                 else self.temperature)
@@ -1346,7 +1642,8 @@ class ServingEngine:
             self._cancel_now(rid, 'deadline', lifecycle.EXPIRED)
 
     def estimate_wait_s(self, prompt_len: int, max_new: int,
-                        tokens: Optional[Sequence[int]] = None
+                        tokens: Optional[Sequence[int]] = None,
+                        priority_class: Optional[str] = None
                         ) -> float:
         """Estimated submit-to-finish seconds for a request arriving
         NOW, from pending queue depth, prefill backlog and decode
@@ -1362,10 +1659,23 @@ class ServingEngine:
         request's (and each queued request's) prefill work is charged
         over the post-lookup UNCACHED suffix — high-hit-rate traffic
         must not be spuriously shed with ``wont_make_deadline`` for
-        prefill it will never run."""
+        prefill it will never run.
+
+        Class-aware when ``priority_class`` is given AND the QoS
+        scheduler is live: queued work of STRICTLY lower priority is
+        excluded from the backlog, because weighted-fair ordering
+        will jump this request over it — an interactive arrival must
+        not be shed with ``wont_make_deadline`` for bulk work it
+        would never wait behind (docs/qos.md). Slot-resident work is
+        always charged (running requests cannot be jumped, only
+        preempted, and the estimate stays conservative). None keeps
+        the legacy all-backlog estimate."""
         tick = self._tick_ewma
         if tick is None:
             return 0.0
+        skip_below = None
+        if priority_class is not None and self._qos_active:
+            skip_below = qos_lib.class_rank(priority_class)
         own = (self._prefill_ticks(self._suffix_len(prompt_len,
                                                     tokens)) +
                -(-max_new // self.decode_chunk))
@@ -1394,6 +1704,9 @@ class ServingEngine:
                 # both containers — counting it twice would inflate
                 # the estimate and spuriously shed deadline'd work.
                 continue
+            if (skip_below is not None and
+                    qos_lib.class_rank(r.priority_class) > skip_below):
+                continue    # work this class would jump via DRR
             backlog += (self._prefill_ticks(
                 self._suffix_len(len(r.tokens), r.tokens, holder=r)) +
                         -(-r.max_new // self.decode_chunk))
@@ -1561,18 +1874,35 @@ class ServingEngine:
         """
         t0 = time.perf_counter()
         hang = None
+        burst = None
         if not self._warming:
             # Warmup ticks never poll: compile-time ticks would burn
             # a chaos plan's counters before serving even starts.
             hang = fault_injection.poll(
                 'engine.tick.hang',
                 kinds=(fault_injection.FaultKind.HANG,))
+            burst = fault_injection.poll(
+                'engine.tenant.burst',
+                kinds=(fault_injection.FaultKind.TENANT_BURST,))
         if hang is not None:
             # Act out a wedged device tick: the watchdog (below) must
             # see the stall exactly as it would a real one.
             time.sleep(float(hang.params.get('seconds', 0.05)))
+        if burst is not None:
+            # A misbehaving tenant materializes: the fault plan's
+            # synthetic requests hit the queue before this tick's
+            # lifecycle work, exactly like a client burst landing
+            # between ticks (docs/qos.md).
+            self._inject_tenant_burst(burst.params)
         self._apply_cancellations()
         self._expire_deadlines()
+        if self._qos_active:
+            # QoS lifecycle work at the same boundary: queue-pressure
+            # shedding (bulk first), then the sustained-overload
+            # preemption timer — both act through _cancel_now, so a
+            # freed slot is admissible in THIS tick's _admit.
+            self._qos_shed_queue()
+            self._qos_maybe_preempt()
         self._admit()
         self._tick_accepted = 0
         emitted = 0
@@ -1718,6 +2048,12 @@ class ServingEngine:
         _M_TTFT_P99.set(p99 if p99 is not None else 0.0)
         p99 = self._itl_window.quantile(0.99)
         _M_ITL_P99.set(p99 if p99 is not None else 0.0)
+        # Per-class TTFT p99 (docs/qos.md): same decay-to-0 contract
+        # as the aggregate gauge, one series per priority class.
+        for cls, win in self._class_ttft_windows.items():
+            p99 = win.quantile(0.99)
+            _M_CLASS_TTFT_P99.set(p99 if p99 is not None else 0.0,
+                                  **{'class': cls})
         # Rises with a burst the moment the queue does — ticks before
         # the 60 s QPS window moves.
         _M_EST_WAIT.set(self.estimate_wait_s(0, 1))
@@ -1947,6 +2283,14 @@ class ServingEngine:
                     state.request_id, now)
                 _M_TTFT.observe(ttft)
                 self._observe_slo('ttft', ttft, None)
+            # Per-class window behind
+            # skytpu_engine_class_ttft_p99_seconds (docs/qos.md):
+            # classless requests observe as DEFAULT_CLASS, so the
+            # per-class signal covers all traffic.
+            cls = state.priority_class or qos_lib.DEFAULT_CLASS
+            win = self._class_ttft_windows.get(cls)
+            if win is not None:
+                win.observe(ttft)
         return [tok]
 
     def _observe_slo(self, kind: str, value: float,
@@ -2086,6 +2430,11 @@ class ServingEngine:
                 _M_ITL.observe(itl, exemplar=itl_exemplar)
                 self._observe_slo('itl', itl, itl_exemplar)
             state.last_emit_at = now_pc
+            if state.tenant is not None and not self._warming:
+                # Bounded per-tenant attribution (max_series=64, then
+                # the registry folds to _other): anonymous traffic
+                # stays out — tokens_total is the all-traffic series.
+                _M_TENANT_TOKENS.inc(len(fresh), tenant=state.tenant)
             if self.on_token is not None:
                 self.on_token(state.request_id, fresh)
             if self._is_done(state):

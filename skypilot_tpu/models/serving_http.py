@@ -52,6 +52,7 @@ from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
+from skypilot_tpu.utils import qos as qos_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -429,7 +430,8 @@ class EngineServer:
 
     def _deadline_shed_response(self, req_id: str,
                                 deadline: Optional[float],
-                                tokens, max_new: int
+                                tokens, max_new: int,
+                                priority_class: Optional[str] = None
                                 ) -> Optional[web.Response]:
         """Deadline-aware admission (docs/request_lifecycle.md):
         shed a request whose ESTIMATED queue wait already exceeds its
@@ -439,16 +441,29 @@ class EngineServer:
         immediately instead of timing out after burning a slot. The
         token ids flow into the estimate so a prefix-cache hit is
         charged only its uncached suffix — high-hit-rate traffic must
-        not be shed for prefill it will never run."""
+        not be shed for prefill it will never run.
+
+        Class-aware (docs/qos.md): the estimate excludes queued work
+        of strictly lower priority — at the same queue depth an
+        interactive request is admitted while a bulk one sheds,
+        because DRR ordering really will jump it over that backlog.
+        The Retry-After hint scales by class rank (interactive x1,
+        standard x2, bulk x4): lower classes should back off longer
+        from a contended replica."""
         if deadline is None:
             return None
         left = deadline - time.time()
-        est = self.engine.estimate_wait_s(len(tokens), max_new,
-                                          tokens=tokens)
+        est = self.engine.estimate_wait_s(
+            len(tokens), max_new, tokens=tokens,
+            priority_class=priority_class)
         if est <= left:
             return None
         _M_SHEDS.inc(1, reason='wont_make_deadline')
-        retry = max(1, min(30, int(est - max(left, 0.0)) + 1))
+        # Classless requests keep the legacy hint bit-for-bit.
+        scale = (1 if priority_class is None
+                 else 1 << qos_lib.class_rank(priority_class))
+        retry = max(1, min(30,
+                           (int(est - max(left, 0.0)) + 1) * scale))
         logger.warning(
             'Shedding /generate (estimated wait %.2fs > remaining '
             'budget %.2fs) request=%s trace=%s', est, left, req_id,
@@ -494,6 +509,29 @@ class EngineServer:
         return (tokens, max_new, temperature,
                 bool(body.get('stream')), timeout_s)
 
+    @staticmethod
+    def _resolve_qos(headers, body: Any) -> tuple:
+        """Tenant + priority class for a /generate request
+        (docs/qos.md): the X-Tenant-ID / X-Priority-Class headers
+        win (the LB forwards and re-stamps them per attempt, so a
+        hedged/resumed/migrated stream keeps its identity); body
+        keys 'tenant' / 'priority_class' are the direct-client
+        fallback. Raises ValueError (-> 400) on a malformed tenant
+        id or an unknown class. Returns (tenant|None, class|None) —
+        None class means "never stated", which the engine treats as
+        standard but the Retry-After scaling leaves on the legacy
+        path."""
+        tenant_raw = headers.get(qos_lib.TENANT_HEADER)
+        if tenant_raw is None and isinstance(body, dict):
+            tenant_raw = body.get('tenant')
+        cls_raw = headers.get(qos_lib.CLASS_HEADER)
+        if cls_raw is None and isinstance(body, dict):
+            cls_raw = body.get('priority_class')
+        tenant = qos_lib.validate_tenant(tenant_raw)
+        if cls_raw is None or cls_raw == '':
+            return tenant, None
+        return tenant, qos_lib.validate_class(cls_raw)
+
     async def handle_generate(self, request: web.Request
                               ) -> web.StreamResponse:
         # Correlation surface (docs/tracing.md): accept (or mint) an
@@ -530,6 +568,8 @@ class EngineServer:
                 raise ValueError(
                     f'max_new ({max_new}) exceeds the decode '
                     f'capacity ({self.engine.decode_capacity()}).')
+            tenant, priority_class = self._resolve_qos(
+                request.headers, body)
         except (ValueError, UnicodeDecodeError) as e:
             return web.json_response({'error': str(e)}, status=400,
                                      headers=_rid_headers(req_id))
@@ -549,7 +589,8 @@ class EngineServer:
         if overloaded is not None:
             return overloaded
         shed = self._deadline_shed_response(req_id, deadline,
-                                            tokens, max_new)
+                                            tokens, max_new,
+                                            priority_class)
         if shed is not None:
             return shed
         if not self._ready.is_set():
@@ -578,7 +619,8 @@ class EngineServer:
             if stream:
                 return await self._generate_stream(
                     request, rid, req_id, tokens, max_new, temperature,
-                    deadline)
+                    deadline, tenant=tenant,
+                    priority_class=priority_class)
             fut = asyncio.get_event_loop().create_future()
             # skytpu-lint: disable=STL004 — _futures is mutated and
             # iterated only on the event-loop thread (fail_all runs
@@ -587,9 +629,10 @@ class EngineServer:
             self._futures[rid] = fut
             try:
                 with self._lock:
-                    self.engine.submit(Request(rid, tokens, max_new,
-                                               temperature=temperature,
-                                               deadline=deadline))
+                    self.engine.submit(Request(
+                        rid, tokens, max_new, temperature=temperature,
+                        deadline=deadline, tenant=tenant,
+                        priority_class=priority_class))
             except DuplicateRequestError as e:
                 # Raced past the _by_reqid check (e.g. a hedge
                 # duplicate landing in the same loop turn): the
@@ -638,7 +681,9 @@ class EngineServer:
     async def _generate_stream(self, request: web.Request, rid: Any,
                                req_id: str, tokens, max_new,
                                temperature,
-                               deadline: Optional[float] = None
+                               deadline: Optional[float] = None,
+                               tenant: Optional[str] = None,
+                               priority_class: Optional[str] = None
                                ) -> web.StreamResponse:
         """SSE: one ``data:`` event per decode chunk, then ``done``.
 
@@ -656,9 +701,10 @@ class EngineServer:
         self._streams[rid] = q
         try:
             with self._lock:
-                self.engine.submit(Request(rid, tokens, max_new,
-                                           temperature=temperature,
-                                           deadline=deadline))
+                self.engine.submit(Request(
+                    rid, tokens, max_new, temperature=temperature,
+                    deadline=deadline, tenant=tenant,
+                    priority_class=priority_class))
         except DuplicateRequestError as e:
             self._streams.pop(rid, None)
             return web.json_response(

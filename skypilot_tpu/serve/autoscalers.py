@@ -53,6 +53,18 @@ SLO_SIGNALS = (
      'target_queue_wait_s'),
 )
 
+# Per-class TTFT signal (docs/qos.md): the engine exports one
+# labeled series per priority class; parse_values() keys labeled
+# samples as 'name{label="value"}', so the scrape lookup is an exact
+# string match per configured class.
+_CLASS_TTFT_METRIC = 'skytpu_engine_class_ttft_p99_seconds'
+
+
+def _class_signal_key(cls: str) -> str:
+    """Sample-dict key for one class's TTFT signal (namespaced so a
+    class name can never collide with an aggregate signal key)."""
+    return f'class_ttft:{cls}'
+
 # The scaling signal IS the scraped series (docs/metrics.md): every
 # record_request increments this counter, and current_qps derives
 # from its deltas — an operator graphing rate(skytpu_lb_requests_total)
@@ -258,9 +270,11 @@ class RequestRateAutoscaler:
                  service: str = 'default') -> None:
         # The SLOAutoscaler subclass may run latency-only (no QPS
         # target): the QPS path then holds min_replicas and only the
-        # SLO path moves the target.
+        # SLO path moves the target. Per-class TTFT targets count —
+        # a class-only spec is a legitimate SLO-autoscaled service.
         assert (spec.target_qps_per_replica is not None or
-                spec.slo_targets()), spec
+                spec.slo_targets() or
+                spec.class_slo_targets()), spec
         self.spec = spec
         self._service = service
         # (timestamp, cumulative count) per recorded request, where
@@ -433,6 +447,12 @@ class SLOAutoscaler(RequestRateAutoscaler):
             v = values.get(metric)
             if v is not None:
                 sample[key] = float(v)
+        for cls in self.spec.class_slo_targets():
+            v = values.get(f'{_CLASS_TTFT_METRIC}{{class="{cls}"}}')
+            if v is not None and float(v) > 0.0:
+                # 0.0 is the gauge's "no observations yet" export —
+                # a class with no traffic has no latency to judge.
+                sample[_class_signal_key(cls)] = float(v)
         self._slo_samples[url] = sample
 
     def scrape_replicas(self, urls: List[str],
@@ -475,7 +495,9 @@ class SLOAutoscaler(RequestRateAutoscaler):
         """(ratio, signal, url) of the worst fresh signal relative to
         its target, or None with no usable samples. ratio > 1 means
         the objective is being missed."""
-        targets = self.spec.slo_targets()
+        targets = dict(self.spec.slo_targets())
+        for cls, target in self.spec.class_slo_targets().items():
+            targets[_class_signal_key(cls)] = target
         worst: Optional[Tuple[float, str, str]] = None
         for url, sample in self._slo_samples.items():
             if now - sample['at'] > _SLO_SAMPLE_TTL_SECONDS:
@@ -588,10 +610,10 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
 
 
 def make_autoscaler(spec: ServiceSpec, service: str = 'default'):
-    if spec.slo_targets():
-        # SLO targets win: the SLOAutoscaler keeps the QPS path as
-        # its demand floor (when configured) and applies the spot
-        # split itself.
+    if spec.slo_targets() or spec.class_slo_targets():
+        # SLO targets win (aggregate or per-class): the SLOAutoscaler
+        # keeps the QPS path as its demand floor (when configured)
+        # and applies the spot split itself.
         return SLOAutoscaler(spec, service=service)
     if spec.target_qps_per_replica is None:
         return FixedReplicaAutoscaler(spec, service=service)

@@ -57,6 +57,7 @@ from skypilot_tpu.serve import failover
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
+from skypilot_tpu.utils import qos as qos_lib
 from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import log as sky_logging
 
@@ -118,6 +119,16 @@ _M_RESUME_FAILURES = metrics_lib.counter(
     'resumption disabled, no healthy replica, resume budget '
     'exhausted, or the resumed prompt exceeded the replica\'s '
     'max_prompt): the client saw a truncated stream.')
+# Multi-tenant QoS (docs/qos.md): per-tenant in-flight load at the
+# LB. Tenant ids are caller-controlled header values, so the series
+# set is EXPLICITLY bounded — past max_series tenants fold into the
+# registry's '_other' bucket on both write and read.
+_M_TENANT_INFLIGHT = metrics_lib.gauge(
+    'skytpu_lb_tenant_inflight',
+    'Requests currently proxied on behalf of the tenant (X-Tenant-ID '
+    'header; anonymous traffic is not counted). Bounded: past '
+    'max_series tenants fold into _other.',
+    labels=('tenant',), max_series=64)
 # Spot-native serving (docs/spot_serving.md).
 _M_MIGRATIONS = metrics_lib.counter(
     'skytpu_lb_migrations_total',
@@ -436,23 +447,38 @@ class LoadBalancer:
         # per-replica latency observation (single timing source), and
         # whose trace id rides on the histogram as an exemplar.
         ctx = trace_lib.context_from_headers(request.headers)
-        with trace_lib.span('lb.request', parent=ctx,
-                            method=request.method,
-                            path=request.rel_url.path):
-            if (request.method == 'POST' and
-                    request.rel_url.path.startswith('/cancel/')):
-                return await self._cancel_broadcast(request)
-            if (request.method == 'POST' and
-                    request.rel_url.path == '/generate'):
-                body = await request.read()
-                parsed = self._sse_generate_body(body)
-                if parsed is not None:
-                    # Streaming generate: the SSE-aware path with
-                    # TTFT hedging and mid-stream resumption
-                    # (docs/failover.md).
-                    return await self._proxy_generate_sse(request,
-                                                          parsed)
-            return await self._proxy_attempts(request)
+        # Per-tenant in-flight gauge (docs/qos.md): best-effort — a
+        # malformed tenant id is NOT rejected here (the replica owns
+        # the 400), it is just not attributed.
+        tenant = None
+        try:
+            tenant = qos_lib.validate_tenant(
+                request.headers.get(qos_lib.TENANT_HEADER))
+        except ValueError:
+            pass
+        if tenant is not None:
+            _M_TENANT_INFLIGHT.inc(1, tenant=tenant)
+        try:
+            with trace_lib.span('lb.request', parent=ctx,
+                                method=request.method,
+                                path=request.rel_url.path):
+                if (request.method == 'POST' and
+                        request.rel_url.path.startswith('/cancel/')):
+                    return await self._cancel_broadcast(request)
+                if (request.method == 'POST' and
+                        request.rel_url.path == '/generate'):
+                    body = await request.read()
+                    parsed = self._sse_generate_body(body)
+                    if parsed is not None:
+                        # Streaming generate: the SSE-aware path with
+                        # TTFT hedging and mid-stream resumption
+                        # (docs/failover.md).
+                        return await self._proxy_generate_sse(
+                            request, parsed)
+                return await self._proxy_attempts(request)
+        finally:
+            if tenant is not None:
+                _M_TENANT_INFLIGHT.dec(1, floor=0.0, tenant=tenant)
 
     @staticmethod
     def _sse_generate_body(body: bytes) -> Optional[Dict[str, Any]]:

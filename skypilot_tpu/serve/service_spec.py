@@ -20,6 +20,7 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import qos as qos_lib
 
 
 @dataclasses.dataclass
@@ -66,6 +67,15 @@ class ServiceSpec:
     # user-visible NOW, while raw QPS growth tolerates minutes of
     # confirmation.
     slo_upscale_delay_seconds: int = 60
+    # Per-class TTFT SLO targets (docs/qos.md): priority class ->
+    # p99 TTFT seconds, scraped from each replica's
+    # skytpu_engine_class_ttft_p99_seconds{class=...} gauge. Lets
+    # the autoscaler hold 'interactive p99 TTFT <= 0.5s' while bulk
+    # traffic runs at whatever latency capacity allows — an
+    # aggregate-only target either over-scales for bulk or
+    # under-protects interactive.
+    class_target_ttft_p99_s: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -127,6 +137,10 @@ class ServiceSpec:
                 None),
             slo_upscale_delay_seconds=int(
                 policy.get('slo_upscale_delay_seconds', 60)),
+            class_target_ttft_p99_s={
+                str(k): float(v)
+                for k, v in (policy.get('class_target_ttft_p99_s')
+                             or {}).items()},
         )
         spec.validate()
         return spec
@@ -143,6 +157,13 @@ class ServiceSpec:
         if self.target_queue_wait_s is not None:
             out['est_wait'] = self.target_queue_wait_s
         return out
+
+    def class_slo_targets(self) -> Dict[str, float]:
+        """Per-class p99 TTFT objectives (priority class -> seconds;
+        docs/qos.md). Empty = no per-class SLO scaling. Any entry
+        makes the service an SLO-autoscaled one exactly like the
+        aggregate targets do."""
+        return dict(self.class_target_ttft_p99_s)
 
     def validate(self) -> None:
         if self.min_replicas < 0:
@@ -166,12 +187,22 @@ class ServiceSpec:
             if value is not None and value <= 0:
                 raise exceptions.InvalidTaskError(
                     f'{name} must be > 0')
-        if self.slo_targets() and self.max_replicas is None:
+        for cls, value in self.class_target_ttft_p99_s.items():
+            if cls not in qos_lib.CLASS_RANK:
+                raise exceptions.InvalidTaskError(
+                    f'class_target_ttft_p99_s: unknown priority '
+                    f'class {cls!r} (expected one of '
+                    f'{qos_lib.PRIORITY_CLASSES})')
+            if value <= 0:
+                raise exceptions.InvalidTaskError(
+                    f'class_target_ttft_p99_s[{cls}] must be > 0')
+        any_slo = bool(self.slo_targets() or self.class_slo_targets())
+        if any_slo and self.max_replicas is None:
             raise exceptions.InvalidTaskError(
                 'SLO autoscaling (target_ttft_p99_s / '
-                'target_itl_p99_s / target_queue_wait_s) requires '
-                'max_replicas')
-        if (self.slo_targets() and self.min_replicas < 1 and
+                'target_itl_p99_s / target_queue_wait_s / '
+                'class_target_ttft_p99_s) requires max_replicas')
+        if (any_slo and self.min_replicas < 1 and
                 self.target_qps_per_replica is None):
             # Latency-only SLO scaling gets every signal from ready
             # replicas' /metrics: at zero replicas there is nothing to
@@ -217,6 +248,8 @@ class ServiceSpec:
                 'target_queue_wait_s': self.target_queue_wait_s,
                 'slo_upscale_delay_seconds':
                     self.slo_upscale_delay_seconds,
+                'class_target_ttft_p99_s':
+                    dict(self.class_target_ttft_p99_s),
                 'use_spot': self.use_spot,
                 'base_ondemand_fallback_replicas':
                     self.base_ondemand_fallback_replicas,

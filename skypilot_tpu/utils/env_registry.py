@@ -590,3 +590,77 @@ BENCH_SPEC_K = register(
     '(SKYTPU_SPEC_K analog): 0 disables the spec phase. Default 4 '
     'under BENCH_SMOKE, 0 otherwise (the decode_spec / serve_spec '
     'modes of `bench.py all` opt in).')
+# ------------------------------------------------- multi-tenant QoS
+SKYTPU_QOS_WEIGHTS = register(
+    'SKYTPU_QOS_WEIGHTS',
+    'Deficit-round-robin weights per priority class for the QoS '
+    'admission scheduler (docs/qos.md), as '
+    '"interactive=8,standard=4,bulk=1" (the default). A class\'s '
+    'weight scales the tick-token quantum its subqueues earn per DRR '
+    'round — interactive drains ~8x faster than bulk under '
+    'contention.')
+SKYTPU_QOS_TENANT_RATE = register(
+    'SKYTPU_QOS_TENANT_RATE',
+    'Per-tenant token-bucket refill rate in tick-tokens/second '
+    '(docs/qos.md; a request costs max_new + '
+    'ceil(uncached_suffix/prefill_chunk) * decode_chunk). 0 or unset '
+    '= no rate limiting (buckets disabled). Admission holds a '
+    'tenant\'s requests while its bucket is empty instead of '
+    'rejecting them.')
+SKYTPU_QOS_TENANT_BURST = register(
+    'SKYTPU_QOS_TENANT_BURST',
+    'Per-tenant token-bucket capacity in tick-tokens (the burst a '
+    'quiet tenant may spend at once). Default 4x '
+    'SKYTPU_QOS_TENANT_RATE.')
+SKYTPU_QOS_MAX_QUEUE = register(
+    'SKYTPU_QOS_MAX_QUEUE',
+    'Queue-pressure shed bound: when the engine queue exceeds this '
+    'many requests, the newest lowest-class queued request is shed '
+    '(status=cancelled, reason=shed_by_priority) until the bound '
+    'holds — bulk sheds before standard before interactive '
+    '(docs/qos.md). 0 or unset = no queue-pressure shedding.')
+SKYTPU_QOS_PREEMPT_AFTER_S = register(
+    'SKYTPU_QOS_PREEMPT_AFTER_S',
+    'Sustained-overload preemption threshold in seconds: when the '
+    'queue head is a higher-priority request that _fits() has '
+    'rejected for this long while a strictly lower class holds a '
+    'decode slot, the youngest lowest-class slot is preempt-'
+    'cancelled (reason=preempted_by_priority) to free capacity. 0 '
+    'or unset = never preempt.')
+SKYTPU_QOS_DISABLE = register(
+    'SKYTPU_QOS_DISABLE',
+    'Kill switch: 1 forces legacy FIFO admission even for tenant-'
+    'tagged / classed traffic (tags are still validated and '
+    'attributed in metrics, but ordering, buckets, shedding and '
+    'preemption are all off). The serve_qos bench\'s control arm; '
+    'operationally, the fastest way to take QoS out of the blast '
+    'radius of an incident.')
+BENCH_QOS_SEED = register(
+    'BENCH_QOS_SEED',
+    'serve_qos bench: workload seed for BOTH the baseline and the '
+    'misbehaving-tenant runs (same seed => the interactive sub-'
+    'stream is byte-identical across A/B — the isolation claim\'s '
+    'determinism receipt).')
+BENCH_QOS_REQUESTS = register(
+    'BENCH_QOS_REQUESTS',
+    'serve_qos bench: requests per tenant stream before the burst '
+    'is added (default 40; 16 under BENCH_SMOKE).')
+BENCH_QOS_QPS = register(
+    'BENCH_QOS_QPS',
+    'serve_qos bench: offered load per tenant stream in '
+    'requests/second.')
+BENCH_QOS_BURST = register(
+    'BENCH_QOS_BURST',
+    'serve_qos bench: rate multiplier of the misbehaving bulk '
+    'tenant\'s burst arm (default 10 — the "10x burst" of the '
+    'isolation gate).')
+BENCH_QOS_MAX_TTFT_RATIO = register(
+    'BENCH_QOS_MAX_TTFT_RATIO',
+    'serve_qos bench gate: max interactive-class p99 TTFT of the '
+    'QoS-on burst run over the same-seed burst-free baseline '
+    '(default 1.2).')
+BENCH_QOS_MIN_GOODPUT_RATIO = register(
+    'BENCH_QOS_MIN_GOODPUT_RATIO',
+    'serve_qos bench gate: min interactive-class goodput of the '
+    'QoS-on burst run over the same-seed burst-free baseline '
+    '(default 0.9).')

@@ -121,6 +121,13 @@ KNOWN_SITES = (
     'fleet.synth.launch',
     'fleet.worker.claim.post',
     'fleet.worker.renew.mid',
+    # Multi-tenant QoS (docs/qos.md): a fault-plan-driven synthetic
+    # burst from a named tenant — the engine's tick loop polls this
+    # and, when a spec fires, submits params-described requests
+    # (tenant, n, prompt_len, max_new, priority_class, seed) directly
+    # into its own queue. Deterministic chaos isolation tests without
+    # a load generator in the loop.
+    'engine.tenant.burst',
 )
 
 # Default exit code for `crash` faults: distinctive in wait statuses,
@@ -161,6 +168,10 @@ class FaultKind(str, enum.Enum):
     # reclaimed shortly (docs/spot_serving.md): the site delivers the
     # notice to the replica/LB rather than failing anything itself.
     PREEMPT_NOTICE = 'preempt_notice'
+    # A misbehaving tenant's synthetic request burst (docs/qos.md):
+    # the engine polls engine.tenant.burst each tick and a fired spec
+    # makes it submit the params-described requests to itself.
+    TENANT_BURST = 'tenant_burst'
 
 
 @dataclasses.dataclass

@@ -190,3 +190,30 @@ def test_bench_smoke_serve_load():
     assert d['trace_sha256'] == loadgen.digest(trace)
     assert d['schedule_head_s'] == [
         round(r.arrival_s, 6) for r in trace[:8]]
+
+
+def test_bench_smoke_serve_qos():
+    """serve_qos must PASS its own isolation gates on CPU (rc 0 is
+    the gate, asserted by _run_smoke): QoS on holds the interactive
+    tenant's p99 TTFT and goodput under a 10x bulk burst while the
+    SKYTPU_QOS_DISABLE FIFO control violates a bound on the same
+    traffic — and the victim sub-stream is byte-identical across the
+    base and burst traces (per-tenant seeding)."""
+    result = _run_smoke('serve_qos')
+    assert result['metric'] == 'llama_serve_qos_isolation_ratio'
+    d = result['detail']
+    assert d['ok'] is True
+    assert d['victim_substream_identical'] is True
+    g = d['gates']
+    assert g['qos_holds'] is True
+    assert g['control_violates'] is True
+    assert g['qos_on_ttft_ratio'] <= g['max_ttft_ratio']
+    assert g['qos_on_goodput_ratio'] >= g['min_goodput_ratio']
+    # The victim's OWN trace never changes; only the scheduler does.
+    assert d['base_trace_sha256'] != d['burst_trace_sha256']
+    vic = d['victim']
+    assert sum(vic['qos_burst']['breakdown'].values()) == \
+        d['n_requests_per_tenant']
+    # The class-labeled QoS counters are live in the burst arm: the
+    # engine had to shed or preempt bulk work to protect the victim.
+    assert 'metrics' in d

@@ -374,7 +374,8 @@ def test_http_deadline_shed_passes_tokens_to_estimate():
         def decode_capacity(self):
             return 64
 
-        def estimate_wait_s(self, prompt_len, max_new, tokens=None):
+        def estimate_wait_s(self, prompt_len, max_new, tokens=None,
+                            priority_class=None):
             self.seen = (prompt_len, max_new, tokens)
             return 0.0
 

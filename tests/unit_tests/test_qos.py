@@ -1,0 +1,802 @@
+"""Multi-tenant QoS tests (docs/qos.md).
+
+- the pure primitives: per-tenant token bucket and deficit-round-
+  robin scheduler under an explicit fake clock, validators, weights;
+- FIFO equivalence: untagged traffic never engages the QoS scheduler
+  (results bitwise-equal to the legacy path), and the
+  SKYTPU_QOS_DISABLE kill switch forces legacy FIFO even for tagged
+  traffic;
+- engine policy: weighted-fair admission ordering (interactive jumps
+  earlier-queued bulk), per-tenant bucket blocking, queue-pressure
+  shedding (bulk first, newest first) and sustained-overload
+  preemption of bulk slots, each with its class-labeled counter;
+- class-aware deadline admission: estimate_wait_s excludes the
+  backlog a class would jump, Retry-After scales by class rank, and
+  at the same queue depth an interactive request is admitted while a
+  bulk one sheds;
+- header propagation: X-Tenant-ID / X-Priority-Class reach every
+  replica attempt through the LB's hedge race and mid-stream resume;
+- bounded telemetry: a 10k-tenant flood folds into '_other' on both
+  the write and the read path;
+- per-tenant goodput scoring, tenant-mix workload determinism, the
+  engine.tenant.burst chaos site, and the per-class SLO autoscaler
+  breach signal;
+- a seeded burst-isolation A/B: the same victim trace, with and
+  without QoS, under a bulk flood — QoS must keep the victim's TTFT
+  a multiple below the FIFO arm's.
+"""
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import loadgen
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.loadgen.score import RequestRecord
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import qos as qos_lib
+
+pytestmark = pytest.mark.qos
+
+
+def _counter(name, **labels):
+    metric = metrics_lib.REGISTRY.get(name)
+    return 0.0 if metric is None else metric.value(**labels)
+
+
+# ==================================================== token bucket
+def test_token_bucket_starts_full_and_rate_limits():
+    b = qos_lib.TokenBucket(rate=10.0, burst=40.0)
+    assert b.peek(40.0, now=0.0)           # fresh tenant gets burst
+    assert b.spend(30.0, now=0.0)
+    assert not b.spend(20.0, now=0.0)      # 10 left: spend refused
+    assert b.tokens == pytest.approx(10.0)
+    # peek never spends.
+    assert b.peek(10.0, now=0.0) and b.tokens == pytest.approx(10.0)
+    # 2 seconds at rate 10 refills 20 (clamped to burst later).
+    assert b.spend(25.0, now=2.0)
+    assert b.tokens == pytest.approx(5.0)
+    # Refill clamps at burst capacity.
+    assert b.peek(0.0, now=1e9) and b.tokens == pytest.approx(40.0)
+
+
+def test_token_bucket_clock_never_runs_backwards():
+    b = qos_lib.TokenBucket(rate=1.0, burst=10.0)
+    assert b.spend(10.0, now=5.0)
+    # A stale 'now' must not mint tokens (nor crash).
+    assert not b.spend(1.0, now=4.0)
+    assert b.tokens == pytest.approx(0.0)
+
+
+# ============================================================= DRR
+def test_drr_orders_by_class_then_rotates():
+    drr = qos_lib.DeficitRoundRobin(quantum=4.0)
+    a = ('a', 'bulk')
+    b = ('b', 'interactive')
+    c = ('c', 'interactive')
+    drr.earn([a, b, c])
+    order = drr.order()
+    assert order[-1] == a                  # bulk always last
+    assert set(order[:2]) == {b, c}
+    first = order[0]
+    # Serving the front interactive stream rotates it behind its
+    # equal-rank peer for the next round.
+    drr.spend(first, 1.0)
+    drr.earn([a, b, c])
+    assert drr.order()[0] != first
+
+
+def test_drr_deficit_accrual_and_forfeit():
+    drr = qos_lib.DeficitRoundRobin(
+        weights={'interactive': 8, 'standard': 4, 'bulk': 1},
+        quantum=2.0)
+    i = ('t', 'interactive')
+    k = ('t', 'bulk')
+    drr.earn([i, k])
+    assert drr.can_spend(i, 16.0) and not drr.can_spend(i, 16.1)
+    assert drr.can_spend(k, 2.0) and not drr.can_spend(k, 2.1)
+    drr.earn([i, k])                       # deficits accumulate
+    assert drr.can_spend(k, 4.0)
+    drr.spend(k, 3.0)
+    assert drr.can_spend(k, 1.0) and not drr.can_spend(k, 1.1)
+    # A stream absent from the next round forfeits its banked
+    # deficit entirely (classic DRR: idle flows bank nothing).
+    drr.earn([i])
+    assert not drr.can_spend(k, 0.1)
+    drr.prune()
+    assert not drr.can_spend(i, 0.1)
+    assert drr.order() == []
+
+
+# ====================================================== validators
+def test_validate_tenant():
+    assert qos_lib.validate_tenant(None) is None
+    assert qos_lib.validate_tenant('') is None
+    assert qos_lib.validate_tenant('acme-corp.1_2') == 'acme-corp.1_2'
+    for bad in ('spaces here', 'a' * 65, 'new\nline', 'quote"x',
+                'semi;colon'):
+        with pytest.raises(ValueError):
+            qos_lib.validate_tenant(bad)
+
+
+def test_validate_class_and_rank():
+    assert qos_lib.validate_class(None) == 'standard'
+    assert qos_lib.validate_class('') == 'standard'
+    assert qos_lib.validate_class('Interactive') == 'interactive'
+    with pytest.raises(ValueError):
+        qos_lib.validate_class('gold')
+    # class_rank never raises: ordering code may see unvalidated
+    # values and must degrade to the default class.
+    assert qos_lib.class_rank(None) == 1
+    assert qos_lib.class_rank('interactive') == 0
+    assert qos_lib.class_rank('bulk') == 2
+    assert qos_lib.class_rank('no-such-class') == 1
+
+
+def test_parse_weights():
+    assert qos_lib.parse_weights('') == qos_lib.DEFAULT_WEIGHTS
+    w = qos_lib.parse_weights('interactive=16, bulk=0')
+    assert w['interactive'] == 16
+    assert w['standard'] == 4              # missing keeps default
+    assert w['bulk'] == 1                  # zero clamps to 1
+    with pytest.raises(ValueError):
+        qos_lib.parse_weights('gold=3')
+    with pytest.raises(ValueError):
+        qos_lib.parse_weights('interactive')
+
+
+def test_qos_config_from_env(monkeypatch):
+    monkeypatch.setenv('SKYTPU_QOS_TENANT_RATE', '50')
+    monkeypatch.delenv('SKYTPU_QOS_TENANT_BURST', raising=False)
+    monkeypatch.setenv('SKYTPU_QOS_DISABLE', '1')
+    cfg = qos_lib.qos_config_from_env()
+    assert cfg['tenant_rate'] == 50.0
+    assert cfg['tenant_burst'] == 200.0    # default 4x rate
+    assert cfg['disable'] is True
+
+
+# ==================================================== engine setup
+@pytest.fixture(scope='module')
+def tiny_model():
+    import jax
+
+    from skypilot_tpu import models
+    cfg = models.LlamaConfig.tiny(max_seq=256)
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(tiny_model, **kw):
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    cfg, params = tiny_model
+    base = dict(batch_size=1, max_prompt=32, max_seq=96,
+                decode_chunk=4, prefill_chunk=16)
+    base.update(kw)
+    return ServingEngine(params, cfg, **base)
+
+
+def _prompt(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    return [int(t) for t in rng.integers(1, cfg.vocab_size, n)]
+
+
+def _drain(engine):
+    while engine.queue or engine.num_active() or engine.has_pending:
+        engine.step()
+    return engine.drain_results()
+
+
+# ================================================ FIFO equivalence
+def test_untagged_traffic_bitwise_equals_legacy_fifo(tiny_model):
+    """Single-class (untagged) traffic must never engage the QoS
+    scheduler: _qos_active stays False and the results are bitwise
+    identical to a tagged run under the SKYTPU_QOS_DISABLE kill
+    switch (the legacy-FIFO control arm the serve_qos bench uses)."""
+    from skypilot_tpu.models.serving_engine import Request
+    cfg, _ = tiny_model
+    prompts = [_prompt(cfg, 6 + 2 * i, 100 + i) for i in range(4)]
+
+    eng = _engine(tiny_model, batch_size=2)
+    legacy = eng.run([Request(i, p, max_new=6)
+                      for i, p in enumerate(prompts)])
+    assert eng._qos_active is False
+
+    import os
+    os.environ['SKYTPU_QOS_DISABLE'] = '1'
+    os.environ['SKYTPU_QOS_TENANT_RATE'] = '100'
+    try:
+        eng2 = _engine(tiny_model, batch_size=2)
+        tagged = eng2.run([
+            Request(i, p, max_new=6, tenant=f'tenant-{i % 2}',
+                    priority_class=('interactive', 'bulk')[i % 2])
+            for i, p in enumerate(prompts)])
+        # Kill switch holds even for tagged traffic + configured
+        # buckets: no latch, same FIFO admission, same tokens.
+        assert eng2._qos_active is False
+    finally:
+        del os.environ['SKYTPU_QOS_DISABLE']
+        del os.environ['SKYTPU_QOS_TENANT_RATE']
+    assert set(legacy) == set(tagged)
+    for i in legacy:
+        assert legacy[i].tokens == tagged[i].tokens
+        assert legacy[i].status == tagged[i].status == 'finished'
+
+
+# ============================================== weighted admission
+def test_interactive_jumps_earlier_queued_bulk(tiny_model):
+    """DRR class ordering at the admission point: with one slot and
+    bulk submitted FIRST, the interactive arrival still wins the
+    slot — the core isolation move."""
+    from skypilot_tpu.models.serving_engine import Request
+    cfg, _ = tiny_model
+    eng = _engine(tiny_model)
+    eng.warmup()
+    eng.submit(Request('b', _prompt(cfg, 8, 1), max_new=8,
+                       tenant='noisy', priority_class='bulk'))
+    eng.submit(Request('i', _prompt(cfg, 8, 2), max_new=4,
+                       tenant='victim', priority_class='interactive'))
+    assert eng._qos_active is True         # latched by tagged submit
+    eng.step()
+    holders = {s.request_id for s in eng.slots if s is not None}
+    assert holders == {'i'}
+    assert [r.request_id for r in eng.queue] == ['b']
+    results = _drain(eng)
+    assert results['i'].status == 'finished'
+    assert results['b'].status == 'finished'
+
+
+def test_broke_tenant_bucket_skipped_not_head_blocking(tiny_model):
+    """A tenant whose token bucket cannot cover its head's charge is
+    skipped — the next tenant's head admits instead of the whole
+    queue stalling behind the broke one."""
+    from skypilot_tpu.models.serving_engine import Request
+    import os
+    os.environ['SKYTPU_QOS_TENANT_RATE'] = '0.001'
+    os.environ['SKYTPU_QOS_TENANT_BURST'] = '100'
+    try:
+        cfg, _ = tiny_model
+        eng = _engine(tiny_model)          # unwarmed: host-side only
+        eng.submit(Request('a1', _prompt(cfg, 8, 3), max_new=8,
+                           tenant='a', priority_class='interactive'))
+        eng.submit(Request('a2', _prompt(cfg, 8, 4), max_new=8,
+                           tenant='a', priority_class='interactive'))
+        eng.submit(Request('b1', _prompt(cfg, 8, 5), max_new=8,
+                           tenant='b', priority_class='interactive'))
+        # Drain tenant a's bucket below one admission charge.
+        bkt = eng._bucket_for('a')
+        assert bkt is not None and bkt.spend(95.0, time.monotonic())
+        idx = eng._qos_select()
+        assert idx is not None
+        assert eng.queue[idx].request_id == 'b1'
+    finally:
+        del os.environ['SKYTPU_QOS_TENANT_RATE']
+        del os.environ['SKYTPU_QOS_TENANT_BURST']
+
+
+# ================================================ shedding/preempt
+def test_queue_pressure_sheds_bulk_first_newest_first(tiny_model):
+    from skypilot_tpu.models.serving_engine import Request
+    import os
+    os.environ['SKYTPU_QOS_MAX_QUEUE'] = '2'
+    try:
+        cfg, _ = tiny_model
+        eng = _engine(tiny_model)
+        eng.warmup()
+        eng.submit(Request('i1', _prompt(cfg, 8, 6), max_new=4,
+                           tenant='v', priority_class='interactive'))
+        eng.submit(Request('s1', _prompt(cfg, 8, 7), max_new=4,
+                           tenant='w', priority_class='standard'))
+        eng.submit(Request('b1', _prompt(cfg, 8, 8), max_new=4,
+                           tenant='n', priority_class='bulk'))
+        eng.submit(Request('b2', _prompt(cfg, 8, 9), max_new=4,
+                           tenant='n', priority_class='bulk'))
+        eng.step()
+        shed = eng.drain_results()
+        assert set(shed) == {'b1', 'b2'}   # bulk shed, never i1/s1
+        for rid in ('b1', 'b2'):
+            assert shed[rid].status == 'cancelled'
+            assert shed[rid].reason == 'shed_by_priority'
+        assert _counter('skytpu_engine_sheds_total',
+                        **{'class': 'bulk'}) == 2
+        assert _counter('skytpu_engine_sheds_total',
+                        **{'class': 'interactive'}) == 0
+        results = _drain(eng)
+        assert results['i1'].status == 'finished'
+        assert results['s1'].status == 'finished'
+    finally:
+        del os.environ['SKYTPU_QOS_MAX_QUEUE']
+
+
+def test_sustained_overload_preempts_bulk_slot(tiny_model):
+    from skypilot_tpu.models.serving_engine import Request
+    import os
+    os.environ['SKYTPU_QOS_PREEMPT_AFTER_S'] = '0.01'
+    try:
+        cfg, _ = tiny_model
+        eng = _engine(tiny_model)
+        eng.warmup()
+        eng.submit(Request('b', _prompt(cfg, 8, 10), max_new=24,
+                           tenant='noisy', priority_class='bulk'))
+        # A bulk stream earns quantum * weight(bulk)=1 deficit per
+        # round, so admission takes several DRR rounds (one per
+        # tick) before its charge fits — step until it owns the slot.
+        for _ in range(20):
+            eng.step()
+            if {s.request_id for s in eng.slots if s} == {'b'}:
+                break
+        assert {s.request_id for s in eng.slots if s} == {'b'}
+        eng.submit(Request('i', _prompt(cfg, 8, 11), max_new=4,
+                           tenant='victim',
+                           priority_class='interactive'))
+        eng.step()                         # arms the blocked timer
+        time.sleep(0.03)
+        results = _drain(eng)
+        assert results['b'].status == 'cancelled'
+        assert results['b'].reason == 'preempted_by_priority'
+        assert results['i'].status == 'finished'
+        assert len(results['i'].tokens) == 4
+        assert _counter('skytpu_engine_preempted_total',
+                        **{'class': 'bulk'}) == 1
+    finally:
+        del os.environ['SKYTPU_QOS_PREEMPT_AFTER_S']
+
+
+# ======================================== class-aware deadline est
+def _queued_engine(tiny_model, priority_class, n=8):
+    """Unwarmed engine with a synthetic tick EWMA and n tagged
+    requests queued (prompt 16 -> 1 prefill tick, max_new 8 -> 1
+    decode tick each): deterministic estimate arithmetic with no
+    device work."""
+    from skypilot_tpu.models.serving_engine import Request
+    cfg, _ = tiny_model
+    eng = _engine(tiny_model, batch_size=4, decode_chunk=8)
+    eng._tick_ewma = 0.05
+    for j in range(n):
+        eng.submit(Request(f'q{j}', _prompt(cfg, 16, 20 + j),
+                           max_new=8, tenant='bg',
+                           priority_class=priority_class))
+    assert eng._qos_active is True
+    return eng
+
+
+def test_estimate_wait_excludes_lower_class_backlog(tiny_model):
+    eng = _queued_engine(tiny_model, 'bulk')
+    # own work: 1 prefill tick + 1 decode tick = 2 ticks * 50ms.
+    est_i = eng.estimate_wait_s(8, 4, priority_class='interactive')
+    est_b = eng.estimate_wait_s(8, 4, priority_class='bulk')
+    est_legacy = eng.estimate_wait_s(8, 4)
+    assert est_i == pytest.approx(0.1)
+    # bulk waits behind the whole bulk backlog (16 ticks / width 4).
+    assert est_b == pytest.approx(0.3)
+    # Classless callers keep the legacy all-backlog estimate.
+    assert est_legacy == pytest.approx(est_b)
+
+
+def test_deadline_shed_admits_interactive_sheds_bulk(tiny_model):
+    """Same queue depth, same deadline: the interactive request is
+    admitted (None) while the bulk request sheds 429 — the
+    regression the class-aware estimate exists for."""
+    from skypilot_tpu.models.serving_http import EngineServer
+    eng = _queued_engine(tiny_model, 'bulk')
+    srv = EngineServer(eng, warmup=False)
+    toks = _prompt(tiny_model[0], 8, 40)
+    deadline = time.time() + 0.2
+    assert srv._deadline_shed_response(
+        'r-i', deadline, toks, 4, 'interactive') is None
+    resp = srv._deadline_shed_response(
+        'r-b', deadline, toks, 4, 'bulk')
+    assert resp is not None and resp.status == 429
+    assert json.loads(resp.text)['reason'] == 'wont_make_deadline'
+
+
+def test_retry_after_scales_by_class(tiny_model):
+    from skypilot_tpu.models.serving_http import EngineServer
+    eng = _queued_engine(tiny_model, 'interactive')
+    srv = EngineServer(eng, warmup=False)
+    toks = _prompt(tiny_model[0], 8, 41)
+
+    def retry(cls):
+        resp = srv._deadline_shed_response(
+            f'r-{cls}', time.time() + 0.05, toks, 4, cls)
+        assert resp is not None and resp.status == 429
+        return int(resp.headers['Retry-After'])
+
+    assert retry('interactive') == 1
+    assert retry('standard') == 2
+    assert retry('bulk') == 4
+    assert retry(None) == 1                # legacy hint, bit-for-bit
+
+
+# ================================================ header resolution
+def test_resolve_qos_header_wins_body_falls_back():
+    from skypilot_tpu.models.serving_http import EngineServer
+    resolve = EngineServer._resolve_qos
+    assert resolve({}, {}) == (None, None)
+    assert resolve({}, {'tenant': 'acme',
+                        'priority_class': 'bulk'}) == ('acme', 'bulk')
+    assert resolve({'X-Tenant-ID': 'hdr',
+                    'X-Priority-Class': 'interactive'},
+                   {'tenant': 'body', 'priority_class': 'bulk'}) == \
+        ('hdr', 'interactive')
+    assert resolve({'X-Tenant-ID': 'acme'}, {}) == ('acme', None)
+    with pytest.raises(ValueError):
+        resolve({'X-Tenant-ID': 'bad tenant!'}, {})
+    with pytest.raises(ValueError):
+        resolve({}, {'priority_class': 'gold'})
+
+
+# =========================================== LB header propagation
+def _qos_replica_app(tokens, seen, die_after=None, first_delay=0.0):
+    """Fake SSE replica recording the QoS headers of every /generate;
+    with die_after set it aborts the TCP stream after that many
+    token events (mid-stream death -> the LB's resume arm);
+    first_delay stalls before the first token (the hedge trigger)."""
+    async def generate(request):
+        seen.append((request.headers.get('X-Tenant-ID'),
+                     request.headers.get('X-Priority-Class')))
+        resp = web.StreamResponse(headers={
+            'Content-Type': 'text/event-stream'})
+        await resp.prepare(request)
+        try:
+            if first_delay:
+                await asyncio.sleep(first_delay)
+            for k, t in enumerate(tokens):
+                await resp.write(
+                    f'data: {json.dumps({"tokens": [t]})}\n\n'
+                    .encode())
+                if die_after is not None and k + 1 >= die_after:
+                    request.transport.close()
+                    return resp
+            done = {'done': True, 'tokens': list(tokens),
+                    'latency_s': 0.01, 'status': 'finished',
+                    'reason': None}
+            await resp.write(f'data: {json.dumps(done)}\n\n'.encode())
+            await resp.write_eof()
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        return resp
+
+    async def cancel(request):
+        return web.json_response({'cancelling': True}, status=202)
+
+    app = web.Application()
+    app.router.add_post('/generate', generate)
+    app.router.add_post('/cancel/{request_id}', cancel)
+    return app
+
+
+async def _two_replica_stream(apps, req_headers):
+    import aiohttp
+
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    runners, urls = [], []
+    for app in apps:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, '127.0.0.1', 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]  # pylint: disable=protected-access
+        runners.append(runner)
+        urls.append(f'http://127.0.0.1:{port}')
+    lb = LoadBalancer(port=0)
+    await lb.start()
+    lb.set_replica_urls(urls)
+    dones = []
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f'http://127.0.0.1:{lb.bound_port}/generate',
+                    json={'tokens': [1, 2], 'max_new': 3,
+                          'stream': True},
+                    headers=req_headers) as r:
+                assert r.status == 200
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if line.startswith('data:'):
+                        ev = json.loads(line[5:])
+                        if ev.get('done'):
+                            dones.append(ev)
+        await asyncio.sleep(0.3)
+    finally:
+        await lb.stop()
+        for runner in runners:
+            await runner.cleanup()
+    return dones
+
+
+def test_hedge_carries_tenant_headers(monkeypatch):
+    """Both the primary attempt AND the hedge attempt present the
+    client's tenant identity to their replicas."""
+    monkeypatch.setenv('SKYTPU_LB_HEDGE_DELAY_S', '0.15')
+    slow_seen, fast_seen = [], []
+    dones = asyncio.run(_two_replica_stream(
+        [_qos_replica_app([101, 102], slow_seen, first_delay=5.0),
+         _qos_replica_app([7, 8, 9], fast_seen)],
+        {'X-Request-ID': 'qos-hedge-1', 'X-Tenant-ID': 'acme',
+         'X-Priority-Class': 'interactive'}))
+    assert len(dones) == 1 and dones[0]['tokens'] == [7, 8, 9]
+    assert dones[0].get('hedged') is True
+    assert slow_seen == [('acme', 'interactive')]
+    assert fast_seen == [('acme', 'interactive')]
+
+
+def test_resume_carries_tenant_headers():
+    """A replica dies mid-stream; the resumed attempt on the
+    survivor still presents the tenant identity (structural: every
+    attempt goes through _forward_headers)."""
+    dying_seen, survivor_seen = [], []
+    dones = asyncio.run(_two_replica_stream(
+        [_qos_replica_app([7, 8, 9, 10], dying_seen, die_after=2),
+         _qos_replica_app([9, 10], survivor_seen)],
+        {'X-Request-ID': 'qos-resume-1', 'X-Tenant-ID': 'acme',
+         'X-Priority-Class': 'bulk'}))
+    assert len(dones) == 1
+    assert dones[0].get('resumed') == 1
+    assert dying_seen == [('acme', 'bulk')]
+    assert survivor_seen == [('acme', 'bulk')]
+
+
+# ========================================== telemetry cardinality
+def test_tenant_label_cardinality_folds_at_10k():
+    from skypilot_tpu.models import serving_engine as se
+    for i in range(10_000):
+        se._M_TENANT_TOKENS.inc(1, tenant=f't-{i}')
+    series = se._M_TENANT_TOKENS.series()
+    assert len(series) == 65               # 64 owned + '_other'
+    # Early tenants keep their own series; the flood folds.
+    assert se._M_TENANT_TOKENS.value(tenant='t-5') == 1.0
+    folded = 10_000 - 64
+    assert se._M_TENANT_TOKENS.value(
+        tenant=metrics_lib.OVERFLOW_LABEL) == folded
+    # READS fold too: a folded tenant must see the shared series,
+    # not a phantom zero.
+    assert se._M_TENANT_TOKENS.value(tenant='t-9999') == folded
+    # And the fold is visible on the scrape path.
+    values = metrics_lib.parse_values(metrics_lib.render_exposition())
+    assert values[
+        'skytpu_engine_tenant_tokens_total{tenant="_other"}'] == folded
+
+
+# ================================================ per-tenant score
+def _rec(i, tenant=None, cls=None, status='finished', ttft=0.02):
+    return RequestRecord(
+        request_id=i, scheduled_s=0.01 * i, submitted_s=0.01 * i,
+        status=status, ttft_s=ttft if status == 'finished' else None,
+        itls=[0.005] if status == 'finished' else [],
+        finished_s=0.01 * i + 0.1 if status == 'finished' else None,
+        n_tokens=4 if status == 'finished' else 0,
+        tenant=tenant, priority_class=cls)
+
+
+def test_score_per_tenant_breakdown():
+    slo = loadgen.SLO(ttft_s=0.1, itl_p99_s=0.1)
+    recs = [
+        _rec(0, 'victim', 'interactive'),
+        _rec(1, 'victim', 'interactive', ttft=0.5),   # misses TTFT
+        _rec(2, 'noisy', 'bulk'),
+        _rec(3, 'noisy', 'bulk', status='cancelled'),
+        _rec(4),                                      # untagged
+    ]
+    rep = loadgen.score(recs, slo, wall_s=2.0)
+    assert set(rep['tenants']) == {'victim', 'noisy', '_untagged'}
+    assert set(rep['classes']) == {'interactive', 'bulk', '_untagged'}
+    v = rep['tenants']['victim']
+    assert v['n_requests'] == 2
+    assert v['attainment_all'] == 0.5
+    assert v['goodput_req_s'] == pytest.approx(0.5)
+    n = rep['tenants']['noisy']
+    assert n['breakdown']['cancelled'] == 1
+    assert rep['classes']['bulk']['n_requests'] == 2
+
+
+def test_score_untagged_report_keeps_legacy_shape():
+    slo = loadgen.SLO(ttft_s=0.1)
+    rep = loadgen.score([_rec(0), _rec(1)], slo, wall_s=1.0)
+    assert 'tenants' not in rep and 'classes' not in rep
+
+
+# ============================================== tenant-mix traces
+def test_tenant_mix_substream_stable_under_burst():
+    """Cranking one tenant's rate/count leaves every other tenant's
+    sub-stream byte-identical — the property the burst-isolation A/B
+    leans on."""
+    def spec(bulk_n, bulk_qps):
+        return loadgen.WorkloadSpec(
+            seed=9, arrival='uniform', prompt_max=64,
+            tenants=[
+                loadgen.TenantSpec('victim', 'interactive',
+                                   n_requests=6, qps=20.0),
+                loadgen.TenantSpec('noisy', 'bulk',
+                                   n_requests=bulk_n, qps=bulk_qps),
+            ])
+
+    base = loadgen.generate(spec(6, 10.0))
+    burst = loadgen.generate(spec(60, 100.0))
+    key = lambda r: (r.request_id, r.tenant, r.priority_class,  # noqa: E731
+                     r.arrival_s, tuple(r.tokens), r.max_new)
+    vic_base = sorted((key(r) for r in base if r.tenant == 'victim'))
+    vic_burst = sorted((key(r) for r in burst
+                        if r.tenant == 'victim'))
+    assert vic_base == vic_burst
+    # ids are namespaced per tenant and the merge is arrival-sorted.
+    assert all(r.request_id >= 1_000_000 for r in base
+               if r.tenant == 'noisy')
+    arr = [r.arrival_s for r in burst]
+    assert arr == sorted(arr)
+    # Determinism digest covers the tags.
+    assert loadgen.digest(base) == loadgen.digest(
+        loadgen.generate(spec(6, 10.0)))
+
+
+def test_tenant_mix_jsonl_roundtrip_and_legacy_purity(tmp_path):
+    spec = loadgen.WorkloadSpec(
+        seed=4, prompt_max=64,
+        tenants=[loadgen.TenantSpec('a', 'bulk', n_requests=3,
+                                    qps=5.0)])
+    trace = loadgen.generate(spec)
+    path = str(tmp_path / 'mix.jsonl')
+    loadgen.dump_jsonl(trace, path, spec)
+    back = loadgen.load_jsonl_path(path)
+    assert [(r.tenant, r.priority_class) for r in back] == \
+        [('a', 'bulk')] * 3
+    assert loadgen.digest(back) == loadgen.digest(trace)
+    # Legacy (no-tenant) traces serialize without the QoS keys at
+    # all: byte-stable digests across the QoS change.
+    legacy = loadgen.generate(loadgen.WorkloadSpec(
+        seed=4, n_requests=3, qps=5.0))
+    assert '"tenant"' not in loadgen.to_jsonl(legacy)
+
+
+def test_tenant_mix_validation():
+    with pytest.raises(ValueError):
+        loadgen.WorkloadSpec(tenants=[
+            loadgen.TenantSpec('a'), loadgen.TenantSpec('a'),
+        ]).validate()
+    with pytest.raises(ValueError):
+        loadgen.WorkloadSpec(tenants=[
+            loadgen.TenantSpec('a', priority_class='gold'),
+        ]).validate()
+    with pytest.raises(ValueError):
+        loadgen.WorkloadSpec(tenants=[
+            loadgen.TenantSpec('a', n_requests=0),
+        ]).validate()
+
+
+# ================================================ chaos burst site
+@pytest.mark.chaos
+def test_tenant_burst_fault_site_injects_tagged_requests(tiny_model):
+    assert 'engine.tenant.burst' in fault_injection.KNOWN_SITES
+    eng = _engine(tiny_model, batch_size=2)
+    eng.warmup()
+    with fault_injection.fault_plan(faults=[{
+            'site': 'engine.tenant.burst', 'kind': 'tenant_burst',
+            'times': 1,
+            'params': {'tenant': 'mal', 'n': 3, 'prompt_len': 8,
+                       'max_new': 2, 'priority_class': 'bulk',
+                       'seed': 7}}]):
+        eng.step()
+        live = ({r.request_id: r.tenant for r in list(eng.queue)} |
+                {s.request_id: s.tenant for s in eng.slots if s})
+        burst_ids = {k for k in live if str(k).startswith('burst-mal')}
+        assert len(burst_ids) == 3
+        assert all(live[k] == 'mal' for k in burst_ids)
+        assert eng._qos_active is True
+    results = _drain(eng)
+    assert sum(1 for rid in results
+               if str(rid).startswith('burst-mal')) == 3
+
+
+# ============================================ per-class autoscaler
+def _class_spec(**over):
+    base = dict(min_replicas=1, max_replicas=8,
+                class_target_ttft_p99_s={'interactive': 0.05},
+                slo_upscale_delay_seconds=5,
+                upscale_delay_seconds=300,
+                downscale_delay_seconds=1200)
+    base.update(over)
+    return ServiceSpec(**base)
+
+
+def test_class_slo_breach_scales_up():
+    spec = _class_spec()
+    spec.validate()
+    scaler = autoscalers.make_autoscaler(spec, service='qos-svc')
+    # Class-only targets still select the SLO autoscaler.
+    assert isinstance(scaler, autoscalers.SLOAutoscaler)
+    t0 = 1000.0
+    scaler.observe_replica(
+        'http://r1',
+        {'skytpu_engine_class_ttft_p99_seconds{class="interactive"}':
+         1.0},
+        now=t0)
+    assert scaler.evaluate(now=t0).target_replicas == 1  # not sustained
+    assert scaler.evaluate(now=t0 + 6).target_replicas > 1
+
+
+def test_class_slo_zero_sample_is_no_traffic_not_breach():
+    scaler = autoscalers.SLOAutoscaler(_class_spec())
+    t0 = 2000.0
+    scaler.observe_replica(
+        'http://r1',
+        {'skytpu_engine_class_ttft_p99_seconds{class="interactive"}':
+         0.0},
+        now=t0)
+    scaler.evaluate(now=t0)
+    assert scaler.evaluate(now=t0 + 6).target_replicas == 1
+
+
+def test_class_slo_spec_validation():
+    with pytest.raises(exceptions.InvalidTaskError):
+        _class_spec(class_target_ttft_p99_s={'gold': 0.1}).validate()
+    with pytest.raises(exceptions.InvalidTaskError):
+        _class_spec(
+            class_target_ttft_p99_s={'bulk': -1.0}).validate()
+    with pytest.raises(exceptions.InvalidTaskError):
+        _class_spec(max_replicas=None).validate()
+    # Round-trips through the YAML config surface.
+    spec = _class_spec()
+    back = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert back.class_slo_targets() == {'interactive': 0.05}
+
+
+# ========================================== seeded burst isolation
+@pytest.mark.chaos
+def test_burst_isolation_ab_engine_level(tiny_model):
+    """The in-process miniature of bench.py serve_qos: the same
+    victim trace under a 10x bulk flood, once with QoS on and once
+    under the SKYTPU_QOS_DISABLE FIFO control. Ticks are stretched
+    by the engine.tick.hang chaos site (identical in both arms) so
+    queueing, not compute jitter, dominates. QoS must keep the
+    victim's mean TTFT a multiple below the FIFO arm's."""
+    import os
+    cfg, _ = tiny_model
+    spec = loadgen.WorkloadSpec(
+        seed=13, arrival='uniform', vocab_size=cfg.vocab_size,
+        prompt_median=16, prompt_sigma=0.0, prompt_min=4,
+        prompt_max=48, output_median=4, output_sigma=0.0,
+        output_min=1, output_max=8,
+        tenants=[
+            loadgen.TenantSpec('victim', 'interactive',
+                               n_requests=6, qps=40.0),
+            loadgen.TenantSpec('noisy', 'bulk', n_requests=18,
+                               qps=60.0, prompt_median=32,
+                               output_median=6),
+        ])
+    trace = loadgen.generate(spec)
+
+    def run_arm(env):
+        saved = {}
+        keys = ('SKYTPU_QOS_TENANT_RATE', 'SKYTPU_QOS_TENANT_BURST',
+                'SKYTPU_QOS_PREEMPT_AFTER_S', 'SKYTPU_QOS_DISABLE')
+        for k in keys:
+            saved[k] = os.environ.pop(k, None)
+        os.environ.update(env)
+        try:
+            eng = _engine(tiny_model, batch_size=2, max_prompt=64,
+                          max_seq=160)
+            eng.warmup()
+        finally:
+            for k in keys:
+                os.environ.pop(k, None)
+                if saved[k] is not None:
+                    os.environ[k] = saved[k]
+        with fault_injection.fault_plan(faults=[{
+                'site': 'engine.tick.hang', 'kind': 'hang',
+                'times': None, 'params': {'seconds': 0.02}}]):
+            records, _wall = loadgen.replay_engine(eng, trace)
+        vic = [r for r in records if r.tenant == 'victim']
+        assert len(vic) == 6
+        assert all(r.status == 'finished' for r in vic)
+        return float(np.mean([r.ttft_s for r in vic]))
+
+    on_mean = run_arm({'SKYTPU_QOS_TENANT_RATE': '400',
+                       'SKYTPU_QOS_TENANT_BURST': '400',
+                       'SKYTPU_QOS_PREEMPT_AFTER_S': '0.01'})
+    off_mean = run_arm({'SKYTPU_QOS_DISABLE': '1'})
+    assert off_mean > on_mean * 1.3, (on_mean, off_mean)
