@@ -777,6 +777,12 @@ def serve_bench():
             'n_params': n_params, 'param_bytes': param_bytes,
             'chip': gen,
             'backend': jax.default_backend(),
+            # The decode-attention impl the engine actually dispatches
+            # (mirrors the skytpu_engine_attn_impl info gauge) and the
+            # mesh shape, so the harness can spot silent downgrades
+            # and normalize per-chip without guessing the topology.
+            'attn_impl': engine.attn_impl,
+            'mesh': engine.mesh_info(),
             # Mixed-load latency decomposition (client-side exact
             # samples, not histogram-bucket approximations).
             'ttft_p50_s': _pct(ttft_samples, 0.50),
@@ -807,6 +813,194 @@ def serve_bench():
             # histograms, prefill-token counter, cache resets) from
             # THIS run: the perf trajectory and the serving metrics
             # come from one source.
+            'metrics': metrics_lib.summary(),
+        },
+    }
+    trace_file = _merged_trace_path()
+    if trace_file:
+        result['detail']['trace_file'] = trace_file
+    print(json.dumps(result))
+
+
+def serve_tp_bench():
+    """Multi-chip TP serving proof (PERFORMANCE.md "Multi-chip
+    serving"): one seeded shared-prefix workload served through TWO
+    engines — a mesh-off tp=1 baseline and a tp=BENCH_SERVE_TP mesh
+    arm over the first tp devices (kv-head-sharded cache + prefix
+    pool, shard_map'd paged kernels when the paged impl is active) —
+    asserting bitwise greedy token parity between the arms and
+    no-recompile-after-warmup on the mesh arm, and reporting per-chip
+    tok/s and req/s for both so scaling efficiency is
+    harness-computable. CPU smoke: BENCH_SMOKE=1 (the __main__
+    dispatch forces --xla_force_host_platform_device_count=8 for this
+    mode when too few host devices are configured).
+    """
+    import jax
+    import jax.numpy as jnp  # noqa: F401 - device backend warm import
+    import numpy as np
+
+    from skypilot_tpu import models
+    from skypilot_tpu.models.serving_engine import Request, ServingEngine
+    from skypilot_tpu.parallel import make_mesh, plan_mesh
+    from skypilot_tpu.utils import env_registry
+
+    tp = int(env_registry.get(env_registry.BENCH_SERVE_TP, '2'))
+    if tp < 2:
+        raise SystemExit(
+            'BENCH_SERVE_TP must be >= 2 (tp=1 is the plain serve '
+            'mode)')
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise SystemExit(
+            f'serve_tp needs >= {tp} devices, found {len(devices)} '
+            '(CPU smoke: XLA_FLAGS=--xla_force_host_platform_'
+            'device_count=8)')
+    gen = _detect_generation(devices[0])
+    on_tpu = jax.default_backend() not in ('cpu',)
+
+    n_requests = int(os.environ.get('BENCH_SERVE_REQUESTS', '64'))
+    batch = int(os.environ.get('BENCH_SERVE_BATCH', '32'))
+    max_prompt = int(os.environ.get('BENCH_SERVE_PROMPT', '1024'))
+    max_new = int(os.environ.get('BENCH_SERVE_MAX_NEW', '64'))
+    kv_quant = os.environ.get('BENCH_SERVE_QUANT', '1') == '1'
+    chunk = int(os.environ.get('BENCH_SERVE_CHUNK', '16'))
+    spec_k = int(os.environ.get('BENCH_SPEC_K', '4'))
+    if not on_tpu:
+        # Same tiny smoke shape as serve_bench's prefix arm so the
+        # prefix pool really hits at 64-token prompts.
+        n_requests, batch, max_prompt, max_new = 6, 2, 64, 8
+        cfg = models.LlamaConfig.tiny(max_seq=256)
+        max_seq = 128
+        page, prefill_chunk, prefill_budget = 16, 16, 32
+        # auto resolves to 'lax' off-TPU, but this mode exists to
+        # prove the shard_map'd Pallas kernels — force the paged
+        # impl (interpret-mode on CPU) so both arms dispatch the
+        # same code path the TPU run does.
+        decode_attn = 'paged'
+    else:
+        model = os.environ.get('BENCH_SERVE_MODEL', 'tpu_1b')
+        max_seq = max_prompt + 4 * max_new
+        cfg = models.config_preset(model)(max_seq=max_seq,
+                                          param_dtype=jnp.bfloat16)
+        page = prefill_chunk = prefill_budget = None
+        decode_attn = None
+    n_kv = cfg.n_kv_heads
+    if n_kv % tp:
+        raise SystemExit(
+            f'n_kv_heads {n_kv} not divisible by BENCH_SERVE_TP {tp} '
+            '(pick a config whose kv heads split over the tp axis)')
+    n_params = _count_params(cfg)
+    params = models.family(cfg).init_params(cfg, jax.random.PRNGKey(1))
+
+    # One seeded shared-prefix workload (Zipf over 2 prefixes, fresh
+    # random suffixes) consumed by BOTH arms — parity is only
+    # meaningful on identical inputs.
+    rng = np.random.default_rng(0)
+    n_prefixes = 2
+    plen_prefix = max(1, min((3 * max_prompt) // 4, max_prompt - 1))
+    prefixes = [[int(t) for t in rng.integers(0, cfg.vocab_size,
+                                              plen_prefix)]
+                for _ in range(n_prefixes)]
+    weights = np.arange(1, n_prefixes + 1, dtype=np.float64) ** -1.1
+    weights /= weights.sum()
+
+    def _requests():
+        out = []
+        for i in range(n_requests):
+            pfx = prefixes[int(rng.choice(n_prefixes, p=weights))]
+            slen = int(rng.integers(
+                1, max(2, max_prompt - plen_prefix)))
+            toks = pfx + [int(t) for t in
+                          rng.integers(0, cfg.vocab_size, slen)]
+            out.append(Request(i, toks, max_new=max_new))
+        return out
+    reqs = _requests()
+
+    def _arm(mesh):
+        """Build, warm, time, and tear down one engine; returns
+        (results, detail-dict)."""
+        engine = ServingEngine(params, cfg, batch_size=batch,
+                               max_prompt=max_prompt, max_seq=max_seq,
+                               kv_quant=kv_quant, decode_chunk=chunk,
+                               prefill_chunk=prefill_chunk,
+                               prefill_budget=prefill_budget,
+                               page=page, prefix_cache=True,
+                               spec_decode=spec_k > 0,
+                               spec_k=spec_k if spec_k > 0 else None,
+                               decode_attn=decode_attn, mesh=mesh)
+        engine.warmup()
+
+        def _counts():
+            return {'decode': engine._decode._cache_size(),
+                    'mixed': engine._mixed._cache_size(),
+                    'spec': engine._spec._cache_size(),
+                    'prefix': engine.prefix.compile_cache_sizes()}
+        warm = _counts()
+        t0 = time.perf_counter()
+        results = engine.run([Request(r.request_id, list(r.tokens),
+                                      max_new=r.max_new)
+                              for r in reqs])
+        dt = time.perf_counter() - t0
+        after = _counts()
+        chips = engine.mesh.size if engine.mesh is not None else 1
+        out_tokens = sum(len(r.tokens) for r in results.values())
+        detail = {
+            'chips': chips,
+            'wall_s': round(dt, 2),
+            'req_s': round(n_requests / dt, 2),
+            'output_tok_s': round(out_tokens / dt, 1),
+            'req_s_per_chip': round(n_requests / dt / chips, 3),
+            'output_tok_s_per_chip': round(out_tokens / dt / chips, 1),
+            'attn_impl': engine.attn_impl,
+            'mesh': engine.mesh_info(),
+            'prefix': engine.prefix.stats(),
+            'spec': engine.spec_stats(),
+            'recompiles': {k: after[k] != warm[k] for k in warm},
+        }
+        return results, detail
+
+    base_results, base_detail = _arm(None)
+
+    mesh = make_mesh(plan_mesh(tp, tp=tp), devices=devices[:tp])
+    with _bench_span('serve_tp', requests=n_requests, tp=tp):
+        tp_results, tp_detail = _arm(mesh)
+
+    # No-recompile-after-warmup, mesh-on: every tick program (and the
+    # prefix cache's copy/dmask programs) compiled in warmup; a miss
+    # here means page-count or shape churn re-traced under the mesh.
+    recompiled = [k for k, hit in tp_detail['recompiles'].items()
+                  if hit]
+    if recompiled:
+        raise SystemExit(
+            f'mesh arm recompiled after warmup: {recompiled}')
+    # Bitwise greedy parity, mesh-on vs mesh-off: the shard_map'd
+    # kernels and the TP-sharded prefix pool must not change a single
+    # sampled token.
+    mismatch = [i for i in base_results
+                if tp_results[i].tokens != base_results[i].tokens]
+    if mismatch:
+        raise SystemExit(
+            f'greedy tokens diverge mesh-on vs mesh-off for request '
+            f'ids {mismatch[:8]}')
+
+    from skypilot_tpu import metrics as metrics_lib
+    result = {
+        'metric': 'llama_serve_tp_req_s',
+        'value': tp_detail['req_s'],
+        'unit': 'req/s',
+        # Scaling efficiency vs the same-seed single-chip arm: 1.0
+        # means the tp mesh adds nothing per chip, tp means linear.
+        'vs_baseline': round(
+            tp_detail['req_s'] / max(base_detail['req_s'], 1e-9), 3),
+        'detail': {
+            'tp': tp,
+            'parity': 'bitwise',
+            'n_requests': n_requests, 'batch_slots': batch,
+            'max_new': max_new, 'kv_quant': kv_quant,
+            'spec_k': spec_k, 'n_params': n_params,
+            'chip': gen, 'backend': jax.default_backend(),
+            'baseline': base_detail,
+            'tp_arm': tp_detail,
             'metrics': metrics_lib.summary(),
         },
     }
@@ -1982,6 +2176,10 @@ _ALL_MODES = {
     'decode_spec': {'BENCH_MODE': 'decode', 'BENCH_SPEC_K': '4'},
     'serve_spec': {'BENCH_MODE': 'serve', 'BENCH_SPEC_K': '4'},
     'serve_stack': {'BENCH_MODE': 'serve_stack'},
+    # Multi-chip TP serving (PERFORMANCE.md "Multi-chip serving"):
+    # same-seed tp=1 vs tp=BENCH_SERVE_TP arms, bitwise greedy
+    # parity + no-recompile asserted, per-chip tok/s + req/s.
+    'serve_tp': {'BENCH_MODE': 'serve_tp'},
     # Trace-driven open-loop goodput (docs/load_testing.md): bursty
     # arrivals at ~capacity, scored against TTFT/ITL SLOs — the
     # round's SLO-attainment number next to its raw req/s.
@@ -2185,6 +2383,15 @@ def _device_watchdog(timeout_s: float = 180.0) -> None:
 if __name__ == '__main__':
     mode = (sys.argv[1] if len(sys.argv) > 1 else
             os.environ.get('BENCH_MODE', 'train'))
+    if (mode == 'serve_tp' and os.environ.get('BENCH_SMOKE') == '1'
+            and 'xla_force_host_platform_device_count'
+            not in os.environ.get('XLA_FLAGS', '')):
+        # The CPU smoke needs a multi-device host platform and the
+        # flag only takes effect before the backend initialises —
+        # force it here, ahead of the watchdog's first device probe.
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=8').strip()
     if os.environ.get('BENCH_SMOKE') == '1':
         # Force the CPU backend BEFORE any device op: env var for
         # child processes, jax.config because the image's
@@ -2223,6 +2430,8 @@ if __name__ == '__main__':
         sys.exit(decode_bench())
     if mode == 'serve':
         sys.exit(serve_bench())
+    if mode == 'serve_tp':
+        sys.exit(serve_tp_bench())
     if mode == 'serve_stack':
         sys.exit(serve_stack_bench())
     if mode == 'serve_load':

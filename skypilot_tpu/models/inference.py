@@ -325,10 +325,11 @@ def decode_step(params: Dict,
     s_max = cache['k'].shape[2]
     page = page or decode_attn.default_page()
     impl = decode_attn.resolve_impl(attn_impl)
-    if mesh is not None or s_max % page != 0:
-        # The paged kernel is single-device (a sharded cache would
-        # need a shard_map wrapper) and needs page-aligned caches;
-        # the lax path still honors the length-aware slice below.
+    if s_max % page != 0:
+        # The paged kernel needs page-aligned caches; the lax path
+        # still honors the length-aware slice below. (Meshes no
+        # longer downgrade: the sharded cache goes through the
+        # shard_map wrapper below.)
         impl = 'lax'
     n_slots = None
     if num_pages is not None:
@@ -370,11 +371,21 @@ def decode_step(params: Dict,
                                                keepdims=False)
         if impl == 'paged':
             # Grid-limited to num_pages; per-row early exit inside.
-            o = decode_attn.paged_gqa_decode_attention(
-                q, page_k, page_v, valid, row_bound,
-                k_self=k, v_self=v,
-                k_scale=page_ks, v_scale=page_vs,
-                page=page, num_pages=num_pages)
+            if mesh is not None:
+                # Mesh-sharded cache: each shard runs the unchanged
+                # kernel on its local kv-head slice (batch stays on
+                # the data axes, row bounds replicated over 'tp').
+                o = decode_attn.sharded_paged_gqa_decode_attention(
+                    q, page_k, page_v, valid, row_bound,
+                    k_self=k, v_self=v,
+                    k_scale=page_ks, v_scale=page_vs,
+                    mesh=mesh, page=page, num_pages=num_pages)
+            else:
+                o = decode_attn.paged_gqa_decode_attention(
+                    q, page_k, page_v, valid, row_bound,
+                    k_self=k, v_self=v,
+                    k_scale=page_ks, v_scale=page_vs,
+                    page=page, num_pages=num_pages)
         else:
             pk, pv, vd = page_k, page_v, valid
             pks, pvs = page_ks, page_vs
@@ -561,9 +572,11 @@ def prefill_chunk(params: Dict,
         if quant:
             rows_ks = wrt(rows_ks, sk, starts)
             rows_vs = wrt(rows_vs, sv, starts)
+        # Meshes no longer force the einsum reference: the Pallas
+        # path shard_maps over 'tp' (kv heads), the xla path is
+        # GSPMD-partitioned either way.
         o = chunk_prefill_attention(
-            q, rows_k, rows_v, starts, rows_ks, rows_vs,
-            impl=None if mesh is None else 'xla')
+            q, rows_k, rows_v, starts, rows_ks, rows_vs, mesh=mesh)
         o = o.reshape(g, c, cfg.n_heads * hd).astype(cdt)
         x = x + dot(o, lp['wo'], cdt)
 
@@ -714,10 +727,10 @@ def verify_step(params: Dict,
         n_slots = min(num_pages * page, s_max)
         if n_slots >= s_max:
             n_slots = None                   # full cache; no slicing
-    # int8 caches and sharded meshes verify through the exact einsum
-    # reference (same rule as chunk prefill); bf16 single-chip TPU
-    # runs the Pallas verify kernel.
-    impl = 'xla' if (mesh is not None or quant) else None
+    # int8 caches verify through the exact einsum reference (same
+    # rule as chunk prefill); bf16 TPU runs the Pallas verify kernel
+    # — shard_map'd over the mesh when one is set.
+    impl = 'xla' if quant else None
 
     fed = jnp.concatenate(
         [tokens[:, None], drafts.astype(jnp.int32)], axis=1)  # [B, V]
@@ -778,7 +791,8 @@ def verify_step(params: Dict,
                 pks = pks[:, :n_slots]
                 pvs = pvs[:, :n_slots]
         o = verify_attention(q, pk, pv, vd, slot,
-                             k_scale=pks, v_scale=pvs, impl=impl)
+                             k_scale=pks, v_scale=pvs, impl=impl,
+                             mesh=mesh)
         o = o.reshape(b, v, cfg.n_heads * cfg.head_dim).astype(cdt)
         x = x + qdot(o, lp['wo'], cdt)
 

@@ -44,9 +44,19 @@ Threading: all mutation (acquire/publish/evict) happens on the
 engine's driver thread; ``reusable_tokens`` is a pure read safe to
 call from HTTP threads (the deadline-shed estimate).
 
+Sharded engines: with a device mesh the pool carries the same
+kv-head 'tp' sharding as the live cache (``POOL_SPEC`` mirrors
+``inference.CACHE_SPEC``), and the three copy programs are
+sharding-constrained so a page copy-in/out moves each shard's local
+head slice device-to-device — nothing ever gathers to one chip. The
+copies only ever slice the layer/page/position axes, so GSPMD keeps
+them collective-free.
+
 Knobs: ``SKYTPU_PREFIX_CACHE`` (set to 1 to enable; off means the
-engine is bit-identical to a build without this module) and
-``SKYTPU_PREFIX_POOL_PAGES`` (pool size; at the engine's page size).
+engine is bit-identical to a build without this module),
+``SKYTPU_PREFIX_POOL_PAGES`` (pool size; at the engine's page size)
+and ``SKYTPU_PREFIX_POOL_SHARD`` (default 1; 0 keeps the pool
+replicated on mesh engines — a debugging escape hatch).
 """
 from __future__ import annotations
 
@@ -58,11 +68,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.models import inference
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log as sky_logging
 
 logger = sky_logging.init_logger(__name__)
+
+# Pool layout [n_layers, pool_pages, page, n_kv, head_dim]: kv heads
+# shard on 'tp' exactly like the live cache (inference.CACHE_SPEC);
+# everything else is replicated (the pool is shared by all rows).
+POOL_SPEC = P(None, None, None, 'tp', None)
+POOL_SCALE_SPEC = P(None, None, None, 'tp')
 
 # Default pool size in pages (SKYTPU_PREFIX_POOL_PAGES overrides): at
 # the default 128-token page and an 8B int8 KV shape this is ~100 MB
@@ -127,7 +146,7 @@ class PrefixCache:
     """
 
     def __init__(self, cfg, *, page: int, pool_pages: int,
-                 kv_quant: bool = False) -> None:
+                 kv_quant: bool = False, mesh=None) -> None:
         if page < 1:
             raise ValueError(f'page ({page}) must be positive')
         if pool_pages < 1:
@@ -135,16 +154,43 @@ class PrefixCache:
                 f'pool_pages ({pool_pages}) must be positive')
         self.page = int(page)
         self.pool_pages = int(pool_pages)
+        if mesh is not None and env_registry.get(
+                env_registry.SKYTPU_PREFIX_POOL_SHARD, '1') != '1':
+            mesh = None
+        self.mesh = mesh
         kv_dtype = jnp.int8 if kv_quant else cfg.compute_dtype
         shape = (cfg.n_layers, self.pool_pages, self.page,
                  cfg.n_kv_heads, cfg.head_dim)
         self._fields: Tuple[str, ...] = ('k', 'v')
         pool = {'k': jnp.zeros(shape, kv_dtype),
                 'v': jnp.zeros(shape, kv_dtype)}
+        pool_specs = {'k': POOL_SPEC, 'v': POOL_SPEC}
         if kv_quant:
             self._fields += ('k_scale', 'v_scale')
             pool['k_scale'] = jnp.ones(shape[:4], jnp.bfloat16)
             pool['v_scale'] = jnp.ones(shape[:4], jnp.bfloat16)
+            pool_specs['k_scale'] = POOL_SCALE_SPEC
+            pool_specs['v_scale'] = POOL_SCALE_SPEC
+        # The live cache's per-field specs (inference.cache_specs
+        # family): constraint targets for the copy programs.
+        cache_specs = {'k': inference.CACHE_SPEC,
+                       'v': inference.CACHE_SPEC,
+                       'k_scale': inference.SCALE_SPEC,
+                       'v_scale': inference.SCALE_SPEC}
+
+        def _c(x, spec):
+            """Pin ``x`` to ``spec`` on the mesh (no-op unsharded)."""
+            if mesh is None:
+                return x
+            return lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, spec))
+
+        if mesh is not None:
+            # Pool lives kv-head-sharded from birth: copy-in/out then
+            # move each shard's local slice, never a gathered page.
+            pool = {f: jax.device_put(
+                a, jax.sharding.NamedSharding(mesh, pool_specs[f]))
+                for f, a in pool.items()}
         self.pool = pool
 
         # Host directory: hash -> pool page index, plus per-page
@@ -178,22 +224,25 @@ class PrefixCache:
         def _copy_in(kv, pool, slot, dst_off, src):
             """Pool page ``src`` -> cache row ``slot`` at position
             ``dst_off``. All indices traced: one compiled program
-            serves every (slot, page) pair."""
+            serves every (slot, page) pair. Sharding-constrained: the
+            slice never touches the kv-head axis, so each shard moves
+            its local head slice in place."""
             out = dict(kv)
             for f in self._fields:
                 sizes = (n_layers, 1) + pool[f].shape[2:]
                 blk = lax.dynamic_slice(
                     pool[f], (0, src) + (0,) * (pool[f].ndim - 2),
                     sizes)
-                out[f] = lax.dynamic_update_slice(
+                out[f] = _c(lax.dynamic_update_slice(
                     kv[f], blk,
-                    (0, slot, dst_off) + (0,) * (kv[f].ndim - 3))
+                    (0, slot, dst_off) + (0,) * (kv[f].ndim - 3)),
+                    cache_specs[f])
             return out
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _copy_out(kv, pool, slot, src_off, dst):
             """Cache row ``slot`` page at ``src_off`` -> pool page
-            ``dst`` (publish)."""
+            ``dst`` (publish); sharding-constrained like _copy_in."""
             out = dict(pool)
             for f in self._fields:
                 sizes = (n_layers, 1) + pool[f].shape[2:]
@@ -201,8 +250,10 @@ class PrefixCache:
                     kv[f],
                     (0, slot, src_off) + (0,) * (kv[f].ndim - 3),
                     sizes)
-                out[f] = lax.dynamic_update_slice(
-                    pool[f], blk, (0, dst) + (0,) * (pool[f].ndim - 2))
+                out[f] = _c(lax.dynamic_update_slice(
+                    pool[f], blk,
+                    (0, dst) + (0,) * (pool[f].ndim - 2)),
+                    pool_specs[f])
             return out
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -216,6 +267,12 @@ class PrefixCache:
             s_max = dmask.shape[1]
             row = (jnp.arange(s_max, dtype=jnp.int32) <
                    jnp.asarray(cached, jnp.int32))[None]
+            # No output constraints: dmask/length are tiny replicated
+            # arrays, and constraining them here would stamp sharding
+            # specs that differ TEXTUALLY from the tick programs'
+            # GSPMD-normalized forms — every downstream tick would
+            # then retrace on the new jit key. Propagating the input
+            # shardings keeps one canonical form in circulation.
             dmask = lax.dynamic_update_slice(dmask, row, (slot, 0))
             length = length.at[slot].set(
                 jnp.asarray(cached, length.dtype))
@@ -405,10 +462,17 @@ class PrefixCache:
         untouched — page 0 receives garbage the first real publish
         overwrites before it is ever mapped."""
         sub = {f: cache[f] for f in self._fields}
-        sub = self._copy_in(sub, self.pool, 0, 0, 0)
-        self.pool = self._copy_out(sub, self.pool, 0, 0, 0)
-        dmask, length = self._mask_fix(cache['dmask'], cache['length'],
-                                       0, 0)
+        # Two rounds, threading each program's outputs back in: the
+        # first compiles against the freshly device_put pool (verbose
+        # sharding specs), the second against the program-emitted
+        # (GSPMD-normalized) specs every later call circulates — jit
+        # keys on input shardings, so under a mesh both variants must
+        # be compiled here or the first real publish retraces.
+        dmask, length = cache['dmask'], cache['length']
+        for _ in range(2 if self.mesh is not None else 1):
+            sub = self._copy_in(sub, self.pool, 0, 0, 0)
+            self.pool = self._copy_out(sub, self.pool, 0, 0, 0)
+            dmask, length = self._mask_fix(dmask, length, 0, 0)
         out = dict(cache)
         out.update(sub)
         out['dmask'] = dmask

@@ -93,7 +93,7 @@ import dataclasses
 import functools
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
 import jax
 import jax.numpy as jnp
@@ -241,6 +241,30 @@ _M_CLASS_TTFT_P99 = metrics_lib.gauge(
     'the autoscaler scrapes when the ServiceSpec declares per-class '
     'targets (docs/qos.md).',
     labels=('class',), max_series=8)
+_M_ATTN_IMPL = metrics_lib.gauge(
+    'skytpu_engine_attn_impl',
+    'Info gauge (value 1, impl label): the decode-attention impl '
+    'this engine actually dispatches. A downgrade from the requested '
+    "'paged' fast path (page-misaligned max_seq) is warned once and "
+    'surfaces here in a scrape — a perf cliff must show up in '
+    'monitoring, not in a roofline postmortem (docs/metrics.md).',
+    labels=('impl',), max_series=4)
+
+# Warn-once registry for attention-impl downgrades: every engine in a
+# process shares the page/env configuration, so one warning per
+# reason is signal and N are noise.
+_ATTN_DOWNGRADE_WARNED: Set[str] = set()
+
+
+def _warn_attn_downgrade(reason: str, detail: str) -> None:
+    if reason in _ATTN_DOWNGRADE_WARNED:
+        return
+    _ATTN_DOWNGRADE_WARNED.add(reason)
+    logger.warning(
+        'Decode attention downgraded to the lax reference (%s): %s. '
+        'The effective impl is exported as skytpu_engine_attn_impl '
+        'and in bench detail.', reason, detail)
+
 
 # Consecutive no-draft proposal rounds before the engine goes "dry":
 # while dry, ticks stay fully pipelined (no flush) and proposals only
@@ -481,6 +505,20 @@ class ServingEngine:
         self.paged_dispatch = paged_dispatch
         self._total_pages = -(-self.max_seq // self._page)
         self._base_pages = -(-max_prompt // self._page)
+        # Resolve any dispatch downgrade HERE, observably —
+        # inference.decode_step would silently fall back to 'lax' for
+        # a page-misaligned cache; the engine instead warns once and
+        # exports the EFFECTIVE impl to /metrics and bench detail.
+        # (Meshes no longer downgrade: the sharded cache runs the
+        # shard_map'd kernel.)
+        if self._attn_impl == 'paged' and self.max_seq % self._page:
+            _warn_attn_downgrade(
+                'page_misaligned',
+                f'max_seq {self.max_seq} is not a multiple of the '
+                f'decode page size {self._page}')
+            self._attn_impl = 'lax'
+        self.attn_impl = self._attn_impl
+        _M_ATTN_IMPL.set(1, impl=self._attn_impl)
         # Decode steps per host round-trip. Each tick scans `chunk`
         # steps on device and syncs token values once — slots that
         # finish mid-chunk idle until the tick ends (≈chunk/2 wasted
@@ -513,23 +551,19 @@ class ServingEngine:
         if enable_prefix is None:
             enable_prefix = env_registry.is_enabled(
                 env_registry.SKYTPU_PREFIX_CACHE)
-        if enable_prefix and mesh is not None:
-            # The pool copy programs are single-device (a sharded
-            # cache would need shard_map plumbing, like the paged
-            # decode kernel) — serve correctness over the feature.
-            logger.warning(
-                'Prefix caching is single-chip only for now: '
-                'disabling it for this mesh-sharded engine.')
-            enable_prefix = False
         self.prefix = None
         if enable_prefix:
+            # Mesh engines shard the pool on kv heads (the cache's
+            # own 'tp' layout), so prefix hits, COW and
+            # admission-suffix pricing compose under TP — no more
+            # single-chip-only warn+disable.
             from skypilot_tpu.models import prefix_cache as prefix_mod
             pool_pages = prefix_pool_pages or int(env_registry.get(
                 env_registry.SKYTPU_PREFIX_POOL_PAGES,
                 str(prefix_mod.DEFAULT_POOL_PAGES)))
             self.prefix = prefix_mod.PrefixCache(
                 cfg, page=self._page, pool_pages=pool_pages,
-                kv_quant=kv_quant)
+                kv_quant=kv_quant, mesh=mesh)
         # Speculative multi-token decoding (SKYTPU_SPEC_DECODE /
         # SKYTPU_SPEC_K / SKYTPU_SPEC_NGRAM; PERFORMANCE.md
         # "Speculative decoding"): a host-side prompt-lookup proposer
@@ -663,14 +697,25 @@ class ServingEngine:
                 empty['k_scale'] = jnp.ones(kv_shape[:4], jnp.bfloat16)
                 empty['v_scale'] = jnp.ones(kv_shape[:4], jnp.bfloat16)
             if mesh is not None:
+                # Fresh caches adopt the EXACT sharding objects the
+                # tick programs emit once warmup has captured them
+                # (self._cache_shardings): jit keys its compile cache
+                # on input shardings, and GSPMD normalizes specs on
+                # program outputs (size-1 mesh axes dropped) while
+                # device_put keeps the written spec verbatim — two
+                # textual variants of one physical layout that would
+                # otherwise retrace every warmed pair after reset().
                 specs = inference.cache_specs(kv_quant)
                 empty = {
                     f: jax.device_put(
-                        v, jax.sharding.NamedSharding(mesh, specs[f]))
+                        v, self._cache_shardings.get(
+                            f, jax.sharding.NamedSharding(
+                                mesh, specs[f])))
                     for f, v in empty.items()
                 }
             return empty
 
+        self._cache_shardings: Dict[str, Any] = {}
         self._make_empty = _make_empty
         self.cache = _make_empty()
 
@@ -998,13 +1043,39 @@ class ServingEngine:
                     *chunk_args, no_active, sub,
                     jnp.asarray(self._temps), drafts0, slen0,
                     n=0, num_pages=np_, spec=v)
+        if self.mesh is not None:
+            # Capture the tick-emitted shardings (BEFORE prefix.warm
+            # — its copy programs stamp their own textual variants)
+            # so _make_empty (every reset) and the post-admission
+            # rewrap rebuild caches that hash identically to
+            # post-tick ones — see the _make_empty comment.
+            self._cache_shardings = {
+                f: v.sharding for f, v in self.cache.items()
+                if hasattr(v, 'sharding')}
         if self.prefix is not None:
             # Prefix-cache copy programs (page copy-in/out + the
             # dmask/length fix): fixed shapes with traced indices —
             # ONE program each, compiled here so a cache hit never
             # pays an XLA compile inside admission.
             self.cache = self.prefix.warm(self.cache)
+            self.cache = self._recanon(self.cache)
         self.reset()
+
+    def _recanon(self, cache: Dict) -> Dict:
+        """Rewrap cache fields with the tick-emitted shardings
+        captured in warmup. The prefix copy programs return arrays
+        whose sharding specs are physically identical but TEXTUALLY
+        different from the tick programs' GSPMD-normalized forms
+        (e.g. P() vs P(None,) for a replicated vector), and jit keys
+        its compile cache on input shardings — without this rewrap
+        the first tick after a cache hit retraces. device_put onto an
+        equivalent sharding moves no data."""
+        if not self._cache_shardings:
+            return cache
+        return {
+            f: (jax.device_put(v, self._cache_shardings[f])
+                if f in self._cache_shardings else v)
+            for f, v in cache.items()}
 
     def reset(self) -> None:
         """Drop all cache state (keeps compiled programs). Only valid
@@ -1481,8 +1552,8 @@ class ServingEngine:
                 # prompt.
                 st.prompt_hashes = hashes
                 if reuse:
-                    self.cache = self.prefix.copy_into(
-                        self.cache, slot_idx, pages, reuse)
+                    self.cache = self._recanon(self.prefix.copy_into(
+                        self.cache, slot_idx, pages, reuse))
                     st.prefill_pos = reuse
                     st.reused = reuse
                 sp.finish(matched_pages=len(pages),
@@ -1833,6 +1904,23 @@ class ServingEngine:
         speculation off (accepted is always 0)."""
         _M_TOKEN_LATENCY.observe(
             interval / max(1, emitted - self._tick_accepted))
+
+    def mesh_info(self) -> Optional[Dict[str, Any]]:
+        """Mesh shape / device count for /health and bench detail.
+
+        None for single-chip engines. The harness computes per-chip
+        normalization (tok/s/chip, req/s/chip) from ``devices``
+        instead of hand-deriving it in PERFORMANCE.md.
+        """
+        if self.mesh is None:
+            return None
+        axes = {str(name): int(size) for name, size in
+                zip(self.mesh.axis_names, self.mesh.devices.shape)}
+        return {
+            'devices': int(self.mesh.size),
+            'axes': {k: v for k, v in axes.items() if v > 1},
+            'tp': axes.get('tp', 1),
+        }
 
     def spec_stats(self) -> Dict[str, Any]:
         """Speculation accounting for bench detail / introspection."""

@@ -49,6 +49,7 @@ from aiohttp import web
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
 from skypilot_tpu.utils import log as sky_logging
@@ -844,6 +845,12 @@ class EngineServer:
         limits = getattr(self.engine, 'limits', None)
         if limits is not None:
             body['limits'] = limits()
+        # Mesh shape / device count (None single-chip): the harness
+        # computes per-chip normalization from this, and probes see
+        # at a glance whether a replica is a pod slice or one chip.
+        mesh_info = getattr(self.engine, 'mesh_info', None)
+        if mesh_info is not None:
+            body['mesh'] = mesh_info()
         return web.json_response(body)
 
     async def handle_metrics(self, request: web.Request
@@ -1063,9 +1070,12 @@ def main() -> None:
                         '--checkpoint the bf16 tree loads then '
                         'quantizes (must fit dense); without, a '
                         'born-int8 random tree serves (bench mode).')
-    parser.add_argument('--tp', type=int, default=1,
+    parser.add_argument('--tp', type=int,
+                        default=int(env_registry.get(
+                            env_registry.SKYTPU_TP, '1')),
                         help='Tensor-parallel ways over local chips '
-                        '(serve models larger than one chip).')
+                        '(serve models larger than one chip). '
+                        'Defaults to SKYTPU_TP.')
     parser.add_argument('--max-pending', type=int, default=256,
                         help='Max queued (unadmitted) requests before '
                         '/generate answers 429 + Retry-After; '

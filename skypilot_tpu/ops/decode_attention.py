@@ -309,3 +309,78 @@ def paged_gqa_decode_attention(q, kc, vc, valid, row_bound,
                c2[..., None] * v_self[:, :, None].astype(jnp.float32)
                ) / l2[..., None]
     return out.reshape(b, n_kv * rep * hd).astype(q.dtype)
+
+
+def sharded_paged_gqa_decode_attention(q, kc, vc, valid, row_bound,
+                                       k_self=None, v_self=None,
+                                       k_scale=None, v_scale=None, *,
+                                       mesh,
+                                       page: Optional[int] = None,
+                                       num_pages: Optional[int] = None,
+                                       interpret: Optional[bool] = None
+                                       ) -> jax.Array:
+    """Mesh-native paged decode: ``shard_map`` the single-device
+    kernel over the mesh's data and tensor axes.
+
+    Attention is embarrassingly parallel per KV head and the cache is
+    already laid out kv-heads-on-'tp' / batch-on-('dp','fsdp')
+    (``models.inference.CACHE_SPEC``), so each shard runs the
+    unchanged kernel on its local head slice with the
+    scalar-prefetched ``row_bound`` replicated across 'tp'. Query
+    heads fold kv-group-major ([B, n_kv, rep, hd] — the same blocks a
+    column-sharded wq produces), so concatenating the local
+    [B, n_kv_local*rep*hd] outputs along the head axis IS the global
+    unsharded result: no collective inside, the wo contraction's
+    all-reduce stays where GSPMD already puts it. Requires
+    ``n_kv_heads % tp == 0`` — the divisibility the sharded cache
+    itself needs.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax as _jax
+    # Honor an ambient partial-manual mesh (see
+    # parallel.ring_attention.ring_attention_sharded).
+    ambient = getattr(_jax.sharding, 'get_abstract_mesh',
+                      lambda: None)()
+    if ambient is not None and len(ambient.shape) > 0:
+        mesh = ambient
+    n_kv = kc.shape[2]
+    tp = dict(mesh.shape).get('tp', 1)
+    if n_kv % tp:
+        raise ValueError(f'n_kv_heads {n_kv} not divisible by '
+                         f'tp {tp}')
+    data = ('dp', 'fsdp')
+    q_spec = P(data, 'tp', None)           # [B, heads, hd]
+    kv_spec = P(data, None, 'tp', None)    # [B, S, n_kv, hd]
+    in_specs = [q_spec, kv_spec, kv_spec, P(data, None), P(data)]
+    args = [q, kc, vc, valid, row_bound]
+    has_self = k_self is not None
+    has_scale = k_scale is not None
+    if has_self:
+        in_specs += [q_spec, q_spec]       # [B, n_kv, hd]
+        args += [k_self, v_self]
+    if has_scale:
+        in_specs += [P(data, None, 'tp')] * 2   # [B, S, n_kv]
+        args += [k_scale, v_scale]
+
+    def inner(q, kc, vc, valid, row_bound, *rest):
+        rest = list(rest)
+        ks = vs = ksc = vsc = None
+        if has_self:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
+        if has_scale:
+            ksc, vsc = rest
+        return paged_gqa_decode_attention(
+            q, kc, vc, valid, row_bound, k_self=ks, v_self=vs,
+            k_scale=ksc, v_scale=vsc, page=page, num_pages=num_pages,
+            interpret=interpret)
+
+    # check_rep=False: there is no replication rule for pallas_call,
+    # and every output axis is genuinely sharded anyway.
+    fn = shard_map(inner, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=P(data, 'tp'), check_rep=False)
+    return fn(*args)

@@ -616,6 +616,33 @@ def chunk_attention_reference(q, k, v, q_offset, k_scale=None,
                                        v_scale)
 
 
+def _sharded_chunk_call(inner, mesh, q_specs, args):
+    """shard_map one of the chunk-shaped Pallas kernels over a mesh.
+
+    ``q_specs``: per-arg PartitionSpecs (kv-heads on 'tp'; scalars
+    replicated). Attention is embarrassingly parallel per kv head, so
+    each shard runs the unchanged single-device kernel on its local
+    head slice; the kv-group-major query fold (hi // rep) keeps the
+    concatenated local outputs identical to the unsharded layout.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    import jax as _jax
+    # Honor an ambient partial-manual mesh (see
+    # parallel.ring_attention.ring_attention_sharded).
+    ambient = getattr(_jax.sharding, 'get_abstract_mesh',
+                      lambda: None)()
+    if ambient is not None and len(ambient.shape) > 0:
+        mesh = ambient
+    in_specs, out_spec = q_specs
+    # check_rep=False: pallas_call has no replication rule.
+    fn = shard_map(inner, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_spec, check_rep=False)
+    return fn(*args)
+
+
 def _chunk_impl(impl, s, block_k, k_scale):
     """Shared impl resolution for the chunk-shaped kernels (chunk
     prefill + spec-decode verify): Pallas on TPU for non-quantized
@@ -645,8 +672,8 @@ def chunk_prefill_attention(q: jax.Array,
                             *,
                             impl: Optional[str] = None,
                             block_k: Optional[int] = None,
-                            interpret: Optional[bool] = None
-                            ) -> jax.Array:
+                            interpret: Optional[bool] = None,
+                            mesh=None) -> jax.Array:
     """Query-offset causal attention for one prefill chunk.
 
     q: [G, C, H, D] — C-token prompt slices, row g's queries sit at
@@ -661,7 +688,12 @@ def chunk_prefill_attention(q: jax.Array,
     ``impl``: 'pallas' | 'xla' | None (auto: Pallas on TPU for
     non-quantized caches when S divides by block_k, the exact einsum
     elsewhere — interpret-mode Pallas is orders slower on CPU, so
-    tests opt in explicitly).
+    tests opt in explicitly). ``mesh``: with a mesh, the Pallas path
+    runs under shard_map with kv heads sharded over 'tp' (rows stay
+    replicated across the data axes — the engine's chunk rows are
+    gathered across batch slots, so they carry no stable batch
+    sharding); the xla path needs nothing, GSPMD partitions the
+    einsums.
     """
     s = k.shape[1]
     if block_k is None:
@@ -670,6 +702,15 @@ def chunk_prefill_attention(q: jax.Array,
         interpret = jax.default_backend() != 'tpu'
     impl = _chunk_impl(impl, s, block_k, k_scale)
     if impl == 'pallas':
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            h_spec = P(None, None, 'tp', None)
+            return _sharded_chunk_call(
+                functools.partial(_chunk_fwd_pallas, block_k=block_k,
+                                  interpret=interpret),
+                mesh,
+                ((h_spec, h_spec, h_spec, P(None)), h_spec),
+                (q, k, v, q_offset))
         return _chunk_fwd_pallas(q, k, v, q_offset, block_k=block_k,
                                  interpret=interpret)
     return chunk_attention_reference(q, k, v, q_offset, k_scale,
@@ -843,7 +884,8 @@ def verify_attention(q: jax.Array,
                      *,
                      impl: Optional[str] = None,
                      block_k: Optional[int] = None,
-                     interpret: Optional[bool] = None) -> jax.Array:
+                     interpret: Optional[bool] = None,
+                     mesh=None) -> jax.Array:
     """dmask-valid + segment-causal attention for one verify pass.
 
     q: [B, V, H, D] — the V-token verify segment's queries (current
@@ -857,7 +899,10 @@ def verify_attention(q: jax.Array,
     (self-inclusive). Returns [B, V, H, D].
 
     ``impl``: 'pallas' | 'xla' | None — same auto rule as
-    ``chunk_prefill_attention``.
+    ``chunk_prefill_attention``. ``mesh``: with a mesh, the Pallas
+    path runs under shard_map — kv heads on 'tp', batch on the data
+    axes (mirroring the cache's CACHE_SPEC), the seg_start scalar
+    replicated.
     """
     s = k.shape[1]
     if block_k is None:
@@ -866,6 +911,17 @@ def verify_attention(q: jax.Array,
         interpret = jax.default_backend() != 'tpu'
     impl = _chunk_impl(impl, s, block_k, k_scale)
     if impl == 'pallas':
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            data = ('dp', 'fsdp')
+            return _sharded_chunk_call(
+                functools.partial(_verify_fwd_pallas, block_k=block_k,
+                                  interpret=interpret),
+                mesh,
+                ((P(data, None, 'tp', None), P(data, None, 'tp', None),
+                  P(data, None, 'tp', None), P(data, None), P()),
+                 P(data, None, 'tp', None)),
+                (q, k, v, valid, jnp.asarray(seg_start, jnp.int32)))
         return _verify_fwd_pallas(q, k, v, valid, seg_start,
                                   block_k=block_k, interpret=interpret)
     return verify_attention_reference(q, k, v, valid, seg_start,

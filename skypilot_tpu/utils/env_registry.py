@@ -255,6 +255,19 @@ SKYTPU_SPEC_NGRAM = register(
     'Max n-gram length the prompt-lookup draft proposer matches '
     'against the slot token chain (default 3; longer suffix matches '
     'are tried first, most recent occurrence wins).')
+SKYTPU_TP = register(
+    'SKYTPU_TP',
+    'Default tensor-parallel ways for the HTTP serving replica '
+    '(serving_http --tp overrides; default 1). The engine builds a '
+    'tp-axis mesh over the first N local chips and every fast path — '
+    'paged decode, chunk prefill, verify, prefix cache — runs '
+    'sharded on it (PERFORMANCE.md "Multi-chip serving").')
+SKYTPU_PREFIX_POOL_SHARD = register(
+    'SKYTPU_PREFIX_POOL_SHARD',
+    'Default 1: on mesh engines the prefix-cache page pool shards '
+    'its kv-head axis over \'tp\' like the live cache, so page '
+    'copy-in/out never gathers to one chip. Set 0 to keep the pool '
+    'replicated (debugging escape hatch; correctness-neutral).')
 
 # ----------------------------------------------------------------- SLO
 SKYTPU_SLO_TTFT_S = register(
@@ -399,6 +412,13 @@ BENCH_SERVE_PREFIX_PAGES = register(
     'BENCH_SERVE_PREFIX_PAGES',
     'Serve bench: engine prefix-pool capacity in pages '
     '(SKYTPU_PREFIX_POOL_PAGES analog).')
+BENCH_SERVE_TP = register(
+    'BENCH_SERVE_TP',
+    'serve_tp bench: tensor-parallel ways for the mesh arm (default '
+    '2; needs that many visible devices — CPU smoke uses '
+    'XLA_FLAGS=--xla_force_host_platform_device_count=8). The mode '
+    'reports per-chip tok/s and req/s next to a same-seed tp=1 '
+    'baseline and asserts bitwise greedy parity between the arms.')
 BENCH_SERVE_MAX_NEW = register(
     'BENCH_SERVE_MAX_NEW', 'Serve bench max new tokens per request.')
 BENCH_SERVE_REQUESTS = register(

@@ -148,6 +148,44 @@ def test_probe_device_records_exception_detail():
     assert 'PJRT plugin exploded' in detail['error']
 
 
+def test_bench_smoke_serve_tp():
+    """serve_tp runs both arms (tp=1 baseline, tp=N mesh) on the
+    forced-host-device CPU mesh and must prove the mesh-native fast
+    path: bitwise greedy parity mesh-on vs mesh-off with the prefix
+    cache AND speculative decoding enabled, Pallas paged dispatch on
+    both arms (no silent lax downgrade), and zero post-warmup
+    recompiles under the mesh — the jit-sharding-key regression this
+    smoke exists to catch."""
+    result = _run_smoke('serve_tp')
+    assert result['metric'] == 'llama_serve_tp_req_s'
+    assert result['value'] > 0
+    d = result['detail']
+    assert d['parity'] == 'bitwise'
+    assert d['tp'] >= 2
+    base, tp_arm = d['baseline'], d['tp_arm']
+    assert base['mesh'] is None and base['chips'] == 1
+    assert tp_arm['mesh'] == {'devices': d['tp'],
+                              'axes': {'tp': d['tp']},
+                              'tp': d['tp']}
+    assert tp_arm['chips'] == d['tp']
+    for arm in (base, tp_arm):
+        # The sharded kernels really dispatched (interpret-mode
+        # Pallas on CPU), on both sides of the parity check.
+        assert arm['attn_impl'] == 'paged'
+        assert arm['prefix']['hits'] > 0
+        assert arm['spec']['enabled'] is True
+        # Warmup covered every (decode-steps, page-count) pair and
+        # every sharding variant: steady state never retraces.
+        assert not any(arm['recompiles'].values()), arm['recompiles']
+        assert arm['req_s_per_chip'] > 0
+        assert arm['output_tok_s_per_chip'] > 0
+    # Per-chip normalization is arithmetic, not a re-measurement
+    # (req_s and req_s_per_chip are rounded independently to 2 and 3
+    # decimal places, so allow the combined rounding slack).
+    assert abs(tp_arm['req_s_per_chip'] * tp_arm['chips']
+               - tp_arm['req_s']) < 0.005 * tp_arm['chips'] + 0.005
+
+
 def test_bench_smoke_serve_load():
     """serve_load emits a deterministic goodput report: its trace
     digest and request schedule must match an independent same-seed
