@@ -2060,6 +2060,292 @@ def serve_spot_bench():
     return 0 if ok else 1
 
 
+def serve_disagg_bench():
+    """Disaggregated prefill/decode bench (docs/disaggregation.md):
+    a seeded heavy-prefill Zipf trace (the ``loadgen.long_prompt``
+    shape) replayed at EQUAL chip count through two real replica
+    pools — two mixed-role replicas behind an ordinary LB (the
+    interleaved baseline) and a prefill+decode split pool behind the
+    disagg router (kv_prefill handoff -> page manifest -> decode
+    replica pulls KV pages over ``/kv/fetch`` and streams). A third
+    round SIGKILLs the prefill replica mid-run: every in-flight or
+    subsequent handoff must fall back to interleaved re-prefill on
+    the decode replica, invisibly to the client.
+
+    Gates (exit nonzero unless ALL hold): every finished disagg
+    stream is bitwise-identical to the baseline oracle (greedy
+    parity — KV import is exact, not approximate), at least one
+    request arriving after the kill survives via the fallback path,
+    and disagg goodput >= ``BENCH_DISAGG_MIN_RATIO`` x interleaved.
+    Replicas always run on CPU with a small page size
+    (``SKYTPU_DECODE_PAGE=16``) so the long prompts really span
+    multiple transferable pages; the tick pace is stretched via the
+    ``engine.tick.hang`` site identically in every round. Same
+    BENCH_DISAGG_SEED => byte-identical trace and kill time.
+    """
+    import asyncio
+    import random
+    import signal
+    import subprocess
+    import tempfile
+
+    import aiohttp
+
+    from skypilot_tpu import loadgen
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import fault_injection
+
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    seed = int(os.environ.get('BENCH_DISAGG_SEED', '0'))
+    min_ratio = float(os.environ.get('BENCH_DISAGG_MIN_RATIO', '0.9'))
+    n_requests = int(os.environ.get('BENCH_DISAGG_REQUESTS',
+                                    '12' if smoke else '32'))
+    qps = float(os.environ.get('BENCH_DISAGG_QPS',
+                               '3' if smoke else '4'))
+    slo = loadgen.SLO(
+        ttft_s=float(os.environ.get('BENCH_LOAD_SLO_TTFT', '10')),
+        itl_p99_s=float(os.environ.get('BENCH_LOAD_SLO_ITL', '5')))
+    # Replica shape: page 16 so a median prompt spans ~3 full pages
+    # (the transferable unit), prompt_max + output_max <= max_prompt
+    # so fallback re-prefill (prompt + emitted tokens) always fits,
+    # and max_seq a page multiple (paged-attn invariant).
+    page, max_prompt, max_seq = 16, 128, 160
+    spec = loadgen.long_prompt(
+        seed=seed, n_requests=n_requests, qps=qps,
+        vocab_size=256,                  # LlamaConfig.tiny vocab
+        prompt_median=48, prompt_sigma=0.4,
+        prompt_min=32, prompt_max=96,
+        output_median=6, output_sigma=0.3,
+        output_min=4, output_max=16,
+        n_prefixes=4, prefix_len=32)
+    trace = loadgen.generate(spec)
+    trace_digest = loadgen.digest(trace)
+    by_id = {r.request_id: r for r in trace}
+    span = max(r.arrival_s for r in trace)
+    # One seeded mid-run kill of THE prefill replica — the disagg
+    # pool's single point of handoff, which is exactly the failure
+    # the fallback path must absorb.
+    kill_at = span * (0.35 + 0.3 * random.Random(seed).random())
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-disagg-')
+    kill_record = os.path.join(tmp, 'kills.jsonl')
+    replica_plan = json.dumps({'faults': [
+        {'site': 'engine.tick.hang', 'kind': 'hang', 'times': None,
+         'params': {'seconds': 0.05}}]})
+    base_port = int(os.environ.get('SKYTPU_SERVE_PORT', '19361'))
+    # Process layout: 0,1 = mixed (baseline pool); 2 = prefill,
+    # 3 = decode (disagg pool). Both pools are 2 replicas — the
+    # equal-chip-count comparison the headline rests on.
+    roles = {0: 'mixed', 1: 'mixed', 2: 'prefill', 3: 'decode'}
+    PREFILL = 2
+
+    def spawn(i):
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        env['SKYTPU_FAULT_PLAN'] = replica_plan
+        env['SKYTPU_DECODE_PAGE'] = str(page)
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        log = open(os.path.join(tmp, f'replica{i}.log'), 'wb')
+        return subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.models.serving_http',
+             '--port', str(base_port + i), '--model', 'tiny',
+             '--batch', '4', '--max-prompt', str(max_prompt),
+             '--max-seq', str(max_seq), '--decode-chunk', '1',
+             '--prefill-chunk', str(page), '--prefill-budget', '32',
+             '--max-pending', '64', '--prefix-cache',
+             '--prefix-pool-pages', '64', '--role', roles[i]],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+
+    procs = {i: spawn(i) for i in roles}
+    urls = {i: f'http://127.0.0.1:{base_port + i}' for i in roles}
+
+    def kill_replica(i):
+        p = procs.get(i)
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=10)
+
+    def counter_sum(summary, name):
+        return sum(v for k, v in summary.items()
+                   if k == name or k.startswith(name + '{'))
+
+    async def wait_ready():
+        deadline = time.time() + 240
+        async with aiohttp.ClientSession() as s:
+            for url in urls.values():
+                while True:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f'replica {url} never became ready')
+                    try:
+                        async with s.get(
+                                url + '/health',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=2)) as r:
+                            if r.status == 200:
+                                break
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError, OSError):
+                        pass
+                    await asyncio.sleep(0.25)
+
+    async def run_round(pool, prefill=None, schedule=None):
+        lb = LoadBalancer(port=0, policy='least_load')
+        await lb.start()
+        lb.set_replica_urls([urls[i] for i in pool],
+                            prefill_urls=[urls[i] for i in
+                                          (prefill or ())])
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        kills = 0
+        if schedule:
+            records, wall, kills = \
+                await loadgen.replay_http_chaos_async(
+                    base, trace, schedule, kill_replica,
+                    timeout_s=240, keep_tokens=True)
+        else:
+            records, wall = await loadgen.replay_http_async(
+                base, trace, timeout_s=240, keep_tokens=True)
+        await lb.stop()
+        return records, wall, kills
+
+    def scrape_decode_imports():
+        # The decode replica's own import counter: proof the KV pages
+        # MOVED — parity alone can't tell a real transfer from a
+        # silent every-request fallback (re-prefill is also exact).
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    urls[3] + '/metrics', timeout=5) as resp:
+                text = resp.read().decode('utf-8', 'replace')
+            return counter_sum(
+                metrics_lib.parse_values(text),
+                'skytpu_engine_prefix_pages_imported_total')
+        except (OSError, ValueError):
+            return 0.0
+
+    try:
+        asyncio.run(wait_ready())
+        with _bench_span('serve_disagg', requests=n_requests,
+                         qps=qps):
+            base_records, base_wall, _ = asyncio.run(
+                run_round(pool=(0, 1)))
+            for r in base_records:
+                r.arm = 'interleaved'
+            pre = metrics_lib.summary()
+            disagg_records, disagg_wall, _ = asyncio.run(
+                run_round(pool=(2, 3), prefill=(PREFILL,)))
+            for r in disagg_records:
+                r.arm = 'disagg'
+            pages_imported = scrape_decode_imports()
+            mid = metrics_lib.summary()
+            with fault_injection.fault_plan(
+                    faults=[{'site': 'serve.replica.kill',
+                             'kind': 'crash', 'times': None}],
+                    record=kill_record):
+                chaos_records, chaos_wall, kills = asyncio.run(
+                    run_round(
+                        pool=(2, 3), prefill=(PREFILL,),
+                        schedule=[loadgen.KillEvent(
+                            at_s=kill_at, replica=PREFILL)]))
+            for r in chaos_records:
+                r.arm = 'disagg_chaos'
+            post = metrics_lib.summary()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    # A/B report (the new per-arm score split): one fold over both
+    # clean rounds — per-arm goodput shares a wall clock, so the
+    # ratio is a pure completion/attainment comparison.
+    ab = loadgen.score(base_records + disagg_records, slo,
+                       max(base_wall, disagg_wall))
+    chaos_report = loadgen.score(chaos_records, slo, chaos_wall)
+
+    # Greedy-parity oracle: the interleaved baseline IS the
+    # uninterrupted stream for every request — a KV-imported disagg
+    # stream (and a fallback-re-prefilled chaos one) must be bitwise
+    # identical to it.
+    base_tokens = {r.request_id: r.tokens for r in base_records
+                   if r.status == 'finished' and r.tokens is not None}
+    checked = mismatched = 0
+    for rec in list(disagg_records) + list(chaos_records):
+        if rec.status != 'finished':
+            continue
+        oracle = base_tokens.get(rec.request_id)
+        if oracle is None:
+            continue
+        checked += 1
+        if rec.tokens != oracle:
+            mismatched += 1
+            print(f'# PARITY MISMATCH request {rec.request_id} '
+                  f'({rec.arm}): got={rec.tokens} oracle={oracle}',
+                  file=sys.stderr)
+    length_bad = sum(
+        1 for rec in list(disagg_records) + list(chaos_records)
+        if rec.status == 'finished' and rec.tokens is not None and
+        len(rec.tokens) != by_id[rec.request_id].max_new)
+
+    def delta(a, b, name):
+        return counter_sum(b, name) - counter_sum(a, name)
+
+    handoffs = delta(pre, mid, 'skytpu_lb_disagg_handoffs_total')
+    chaos_handoffs = delta(mid, post,
+                           'skytpu_lb_disagg_handoffs_total')
+    fallbacks = delta(
+        mid, post,
+        'skytpu_lb_disagg_fallbacks_total{reason="prefill_error"}')
+    # Survivors: requests scheduled AFTER the kill that still
+    # finished — each one rode the interleaved-fallback path on the
+    # decode replica (the prefill pool was a corpse by then).
+    survivors = sum(1 for rec in chaos_records
+                    if rec.status == 'finished' and
+                    rec.scheduled_s >= kill_at)
+    arms = ab.get('arms', {})
+    base_good = arms.get('interleaved', {}).get('goodput_req_s', 0.0)
+    disagg_good = arms.get('disagg', {}).get('goodput_req_s', 0.0)
+    ratio = (disagg_good / base_good if base_good > 0 else
+             (1.0 if disagg_good == base_good else 0.0))
+    ok = (ratio >= min_ratio and mismatched == 0 and length_bad == 0
+          and handoffs >= 1 and pages_imported >= 1 and kills == 1
+          and fallbacks >= 1 and survivors >= 1)
+    result = {
+        'metric': 'llama_serve_disagg_goodput_ratio',
+        'value': round(ratio, 4),
+        'unit': 'disagg/interleaved goodput',
+        'vs_baseline': round(ratio, 4),
+        'detail': {
+            'ok': ok,
+            'seed': seed,
+            'min_ratio': min_ratio,
+            'trace_sha256': trace_digest,
+            'schedule_head_s': [round(r.arrival_s, 6)
+                                for r in trace[:8]],
+            'kill_at_s': round(kill_at, 4),
+            'kills_executed': kills,
+            'kill_record': kill_record,
+            'ab': ab,
+            'chaos': chaos_report,
+            'handoffs': handoffs,
+            'decode_pages_imported': pages_imported,
+            'chaos_handoffs': chaos_handoffs,
+            'chaos_fallbacks': fallbacks,
+            'post_kill_survivors': survivors,
+            'parity': {'checked': checked,
+                       'mismatched': mismatched,
+                       'length_mismatches': length_bad},
+            'metrics': metrics_lib.summary(),
+        },
+    }
+    merged = _merged_trace_path()
+    if merged:
+        result['detail']['span_trace_file'] = merged
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 # One subprocess per mode: every bench assumes a fresh chip (HBM
 # fragmentation from a previous mode would contaminate timings), and
 # a crash in one mode must not take down the rest.
@@ -2200,6 +2486,13 @@ _ALL_MODES = {
     # noticed preemptions, $/Mtok chip-seconds proxy. CPU replicas —
     # no device.
     'serve_spot': {'BENCH_MODE': 'serve_spot'},
+    # Disaggregated prefill/decode (docs/disaggregation.md): heavy-
+    # prefill Zipf trace through an interleaved pool vs a
+    # prefill+decode split pool at equal chip count; KV pages move
+    # over /kv/fetch, greedy parity vs the interleaved oracle, a
+    # mid-run prefill-replica kill absorbed by the interleaved
+    # fallback. CPU replicas — no device.
+    'serve_disagg': {'BENCH_MODE': 'serve_disagg'},
     # Control-plane scale (docs/control_plane.md): lease-fleet
     # throughput on the synthetic cloud — jobs/s settled,
     # time-to-reconcile after a worker kill, lease churn. No device.
@@ -2413,10 +2706,11 @@ if __name__ == '__main__':
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
     # same thing); other modes probe in-process. 'fleet',
-    # 'serve_chaos' and 'serve_spot' never touch a device (pure
-    # control plane / CPU replica subprocesses), so a dead TPU
-    # tunnel must not kill their rounds.
-    if mode not in ('fleet', 'serve_chaos', 'serve_spot'):
+    # 'serve_chaos', 'serve_spot' and 'serve_disagg' never touch a
+    # device (pure control plane / CPU replica subprocesses), so a
+    # dead TPU tunnel must not kill their rounds.
+    if mode not in ('fleet', 'serve_chaos', 'serve_spot',
+                    'serve_disagg'):
         _device_watchdog(float(os.environ.get(
             'BENCH_DEVICE_TIMEOUT',
             '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
@@ -2426,6 +2720,8 @@ if __name__ == '__main__':
         sys.exit(serve_chaos_bench())
     if mode == 'serve_spot':
         sys.exit(serve_spot_bench())
+    if mode == 'serve_disagg':
+        sys.exit(serve_disagg_bench())
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':

@@ -37,12 +37,13 @@ from skypilot_tpu.loadgen.workload import dump_jsonl
 from skypilot_tpu.loadgen.workload import generate
 from skypilot_tpu.loadgen.workload import load_jsonl
 from skypilot_tpu.loadgen.workload import load_jsonl_path
+from skypilot_tpu.loadgen.workload import long_prompt
 from skypilot_tpu.loadgen.workload import to_jsonl
 
 __all__ = [
     'KillEvent', 'RequestRecord', 'SLO', 'TenantSpec', 'TraceRequest',
     'WorkloadSpec', 'digest', 'dump_jsonl', 'generate', 'load_jsonl',
-    'load_jsonl_path', 'replay_engine', 'replay_http',
+    'load_jsonl_path', 'long_prompt', 'replay_engine', 'replay_http',
     'replay_http_async', 'replay_http_chaos',
     'replay_http_chaos_async', 'replay_http_preempt_async',
     'run_kill_schedule', 'run_preempt_schedule', 'score',

@@ -81,6 +81,12 @@ class RequestRecord:
     # sections only appear when at least one record carries a tag.
     tenant: Optional[str] = None
     priority_class: Optional[str] = None
+    # Serving-arm attribution (docs/disaggregation.md): which A/B arm
+    # served this request ('interleaved', 'disagg', ...). None =
+    # untagged — the report's per-arm section only appears when at
+    # least one record carries an arm, keeping pre-disagg report
+    # bytes intact.
+    arm: Optional[str] = None
 
     def itl_p99(self) -> Optional[float]:
         return percentile(self.itls, 0.99)
@@ -132,6 +138,34 @@ def _group_report(recs: Sequence[RequestRecord], slo: SLO,
         'attainment_all': (round(good / len(recs), 4)
                            if recs else None),
         'ttft': _pct_table(ttfts),
+        'breakdown': {s: breakdown.get(s, 0) for s in STATUSES},
+    }
+
+
+def _arm_report(recs: Sequence[RequestRecord], slo: SLO,
+                wall_s: float) -> Dict[str, Any]:
+    """Per-serving-arm slice (docs/disaggregation.md): the disagg A/B
+    story is a TTFT-vs-ITL trade, so unlike the tenant slice this one
+    splits attainment BY OBJECTIVE and carries both latency tables —
+    'disagg held ITL while interleaved missed it' must be readable
+    straight off the report."""
+    att = {k: 0 for k in ('ttft', 'itl', 'deadline', 'all')}
+    for r in recs:
+        a = _attained(r, slo)
+        for k in att:
+            att[k] += a[k]
+    finished = [r for r in recs if r.status == 'finished']
+    ttfts = [r.ttft_s for r in finished if r.ttft_s is not None]
+    itls = [g for r in finished for g in r.itls]
+    n = len(recs)
+    breakdown = Counter(r.status for r in recs)
+    return {
+        'n_requests': n,
+        'goodput_req_s': round(att['all'] / wall_s, 3),
+        'attainment': {k: round(v / n, 4) if n else None
+                       for k, v in att.items()},
+        'ttft': _pct_table(ttfts),
+        'itl': _pct_table(itls),
         'breakdown': {s: breakdown.get(s, 0) for s in STATUSES},
     }
 
@@ -220,4 +254,13 @@ def score(records: Sequence[RequestRecord], slo: SLO,
         report['classes'] = {
             c: _group_report(recs, slo, wall_s)
             for c, recs in sorted(by_class.items())}
+    # Per-serving-arm slice (docs/disaggregation.md), same
+    # only-when-tagged rule: untagged replays keep their bytes.
+    if any(r.arm is not None for r in records):
+        by_arm: Dict[str, List[RequestRecord]] = {}
+        for r in records:
+            by_arm.setdefault(r.arm or '_untagged', []).append(r)
+        report['arms'] = {
+            a: _arm_report(recs, slo, wall_s)
+            for a, recs in sorted(by_arm.items())}
     return report

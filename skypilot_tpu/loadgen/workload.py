@@ -332,6 +332,36 @@ def _generate_stream(spec: WorkloadSpec,
     return trace
 
 
+# ---------------------------------------------------------- presets
+def long_prompt(seed: int = 0, n_requests: int = 64,
+                qps: float = 8.0, **overrides: Any) -> WorkloadSpec:
+    """Heavy-prefill mix (docs/disaggregation.md): the workload shape
+    disaggregated prefill/decode exists for. Long log-normal prompts
+    (median 192, tail to 512) over Zipf-shared 64-token prefixes,
+    SHORT outputs (median 8) — per-request compute is dominated by
+    prefill, so interleaved serving stalls decode streams behind
+    prefill chunks while a split pool keeps ITL flat. Keyword
+    overrides replace any field after the preset shape is applied."""
+    spec = WorkloadSpec(
+        seed=seed,
+        n_requests=n_requests,
+        qps=qps,
+        arrival='poisson',
+        prompt_median=192,
+        prompt_sigma=0.5,
+        prompt_min=64,
+        prompt_max=512,
+        output_median=8,
+        output_sigma=0.4,
+        output_min=1,
+        output_max=24,
+        n_prefixes=8,
+        prefix_len=64,
+        zipf_s=1.1,
+    )
+    return dataclasses.replace(spec, **overrides) if overrides else spec
+
+
 # ------------------------------------------------------------ JSONL
 def to_jsonl(trace: Sequence[TraceRequest],
              spec: Optional[WorkloadSpec] = None) -> str:

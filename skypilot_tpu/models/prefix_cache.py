@@ -107,6 +107,11 @@ _M_EVICTIONS = metrics_lib.counter(
     'skytpu_engine_prefix_evictions_total',
     'Cold (unpinned) prefix pages evicted LRU to make room for a '
     'newly published page.')
+_M_IMPORTED = metrics_lib.counter(
+    'skytpu_engine_prefix_pages_imported_total',
+    'Remote KV pages landed into the local prefix pool via the '
+    'transfer import path (serve/kv_transfer.py; '
+    'docs/disaggregation.md).')
 
 
 def page_hashes(tokens: Sequence[int], page: int) -> List[bytes]:
@@ -278,9 +283,40 @@ class PrefixCache:
                 jnp.asarray(cached, length.dtype))
             return dmask, length
 
+        @jax.jit
+        def _export_page(pool, src):
+            """Pool page ``src`` -> one per-field block ready for a
+            host copy (the KV-transfer export path). Traced index —
+            one compiled program serves every page, so exports never
+            add compiles after warmup. No donation: the pool must
+            survive an export (HTTP threads read while the driver
+            publishes)."""
+            out = {}
+            for f in self._fields:
+                sizes = (n_layers, 1) + pool[f].shape[2:]
+                out[f] = _c(lax.dynamic_slice(
+                    pool[f], (0, src) + (0,) * (pool[f].ndim - 2),
+                    sizes), pool_specs[f])
+            return out
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _import_page(pool, blk, dst):
+            """One remote page block -> pool page ``dst`` (the
+            KV-transfer import path); sharding-constrained like
+            _copy_out, traced index, pool donated in place."""
+            out = dict(pool)
+            for f in self._fields:
+                out[f] = _c(lax.dynamic_update_slice(
+                    pool[f], blk[f],
+                    (0, dst) + (0,) * (pool[f].ndim - 2)),
+                    pool_specs[f])
+            return out
+
         self._copy_in = _copy_in
         self._copy_out = _copy_out
         self._mask_fix = _mask_fix
+        self._export_page = _export_page
+        self._import_page = _import_page
 
     # --------------------------------------------------------- lookup
     def _hashes_of(self, tokens: Sequence[int],
@@ -333,6 +369,22 @@ class PrefixCache:
         deadline shed) calls this from HTTP threads."""
         return self._reuse_len(len(self.match_pages(tokens, holder)),
                                len(tokens), chunk)
+
+    def would_reuse(self, tokens: Sequence[int], chunk: int,
+                    extra_hashes: Sequence[bytes] = ()) -> int:
+        """Reuse length IF the pages named by ``extra_hashes`` were
+        also in the pool. Pure read: the decode-side import path
+        reports expected re-prefill savings (the X-KV-Reused-Tokens
+        header) before the driver thread has landed the queued
+        pages."""
+        extra = set(extra_hashes)
+        n = 0
+        for h in page_hashes(tokens, self.page):
+            if h in self._by_hash or h in extra:
+                n += 1
+            else:
+                break
+        return self._reuse_len(n, len(tokens), chunk)
 
     # ----------------------------------------------------- admission
     def acquire(self, request_id: Any, tokens: Sequence[int],
@@ -454,6 +506,101 @@ class PrefixCache:
         self._tick += 1
         self._stamp[idx] = self._tick
 
+    # ------------------------------------------------------ transfer
+    def page_signature(self) -> Dict[str, Any]:
+        """Wire-compat signature of this pool's page blocks (serve/
+        kv_transfer.py): page size plus per-field dtype and block
+        shape ``[n_layers, 1, page, ...]``. Two replicas exchange
+        pages iff their signatures are equal — the cheap structural
+        check in front of the content-address guarantee."""
+        return {
+            'page': self.page,
+            'fields': {
+                f: {'dtype': str(np.dtype(self.pool[f].dtype)),
+                    'shape': [int(self.pool[f].shape[0]), 1] +
+                             [int(d) for d in self.pool[f].shape[2:]]}
+                for f in self._fields},
+        }
+
+    def export_page(self, h: bytes
+                    ) -> Optional[Dict[str, np.ndarray]]:
+        """Host copy of the pool page for chain hash ``h`` (None on a
+        miss). Safe from HTTP threads against a concurrently
+        publishing driver: the copy programs DONATE the pool, so a
+        publish can invalidate the buffers this read is walking —
+        the directory is checked before AND after the device->host
+        copy and the copy retried (bounded) when the page moved or
+        the buffer died underneath it. A page that cannot be read
+        consistently is reported as a miss; the requester re-prefills
+        those positions."""
+        for _ in range(3):
+            idx = self._by_hash.get(h)
+            if idx is None:
+                return None
+            pool = self.pool
+            try:
+                blk = jax.device_get(self._export_page(pool, idx))
+            except RuntimeError:
+                # Donated-away buffer (publish/import raced us):
+                # re-read the directory and try again.
+                continue
+            if self._by_hash.get(h) == idx and pool is self.pool:
+                return {f: np.asarray(blk[f]) for f in self._fields}
+        return None
+
+    def import_pages(
+            self,
+            items: Sequence[Tuple[bytes, Dict[str, np.ndarray]]]
+    ) -> int:
+        """Land fetched remote pages into the pool (dedup by hash;
+        DRIVER THREAD ONLY — this mutates the pool and directory
+        exactly like publish). Shape/dtype are trusted here: the
+        kv_transfer decoder already validated every block against
+        the local signature. Stops at the first allocation failure
+        (every page pinned) — the remaining pages simply miss and
+        re-prefill. Returns the number of pages imported."""
+        imported = 0
+        for h, blk in items:
+            if h in self._by_hash:
+                self._touch(self._by_hash[h])
+                continue
+            dst = self._alloc()
+            if dst is None:
+                logger.debug(
+                    'Prefix pool exhausted (all %d pages pinned): '
+                    'dropping remaining KV import(s).',
+                    self.pool_pages)
+                break
+            dev = {f: jnp.asarray(np.asarray(blk[f]),
+                                  dtype=self.pool[f].dtype)
+                   for f in self._fields}
+            self.pool = self._import_page(self.pool, dev, dst)
+            self._by_hash[h] = dst
+            self._hash_of[dst] = h
+            self._refs[dst] = 0
+            self._touch(dst)
+            self.version += 1
+            imported += 1
+        if imported:
+            _M_IMPORTED.inc(imported)
+            _M_POOL.set(len(self._by_hash))
+        return imported
+
+    def prefix_summary(self, sample: int = 8) -> Dict[str, Any]:
+        """Cheap directory summary for /health (docs/disaggregation.
+        md): occupied-page count, page size and a most-recently-
+        touched hash sample — the surface cache-aware routing
+        scrapes. Pure host read; no device work."""
+        occupied = [(self._stamp[i], h)
+                    for i, h in enumerate(self._hash_of)
+                    if h is not None]
+        occupied.sort(reverse=True)
+        return {
+            'pages': len(self._by_hash),
+            'page': self.page,
+            'sample': [h.hex() for _, h in occupied[:max(0, sample)]],
+        }
+
     # ------------------------------------------------------ plumbing
     def warm(self, cache: Dict) -> Dict:
         """Compile all three programs with dummy indices (engine
@@ -469,10 +616,19 @@ class PrefixCache:
         # keys on input shardings, so under a mesh both variants must
         # be compiled here or the first real publish retraces.
         dmask, length = cache['dmask'], cache['length']
+        # The import warm block mirrors what a real fetch stages:
+        # uncommitted host-built arrays (jnp.asarray of numpy in
+        # import_pages), so the warmed jit key matches live imports.
+        zero_blk = {
+            f: jnp.zeros((self.pool[f].shape[0], 1) +
+                         self.pool[f].shape[2:], self.pool[f].dtype)
+            for f in self._fields}
         for _ in range(2 if self.mesh is not None else 1):
             sub = self._copy_in(sub, self.pool, 0, 0, 0)
             self.pool = self._copy_out(sub, self.pool, 0, 0, 0)
             dmask, length = self._mask_fix(dmask, length, 0, 0)
+            jax.device_get(self._export_page(self.pool, 0))
+            self.pool = self._import_page(self.pool, zero_blk, 0)
         out = dict(cache)
         out.update(sub)
         out['dmask'] = dmask
@@ -485,6 +641,14 @@ class PrefixCache:
         return (self._copy_in._cache_size(),
                 self._copy_out._cache_size(),
                 self._mask_fix._cache_size())
+
+    def import_compile_cache_size(self) -> Tuple[int, int]:
+        """Compiled-program counts of the transfer ops (export,
+        import) — the disagg no-recompile assertion's counterpart to
+        compile_cache_sizes (kept separate so that 3-tuple's star-
+        unpacking consumers never move)."""
+        return (self._export_page._cache_size(),
+                self._import_page._cache_size())
 
     def stats(self) -> Dict[str, Any]:
         """Flat summary for bench detail (same numbers the metric

@@ -658,6 +658,14 @@ class ServingEngine:
         # mutated without racing an in-flight device tick.
         self._cancels: Dict[Any, str] = {}
         self._cancel_lock = threading.Lock()
+        # Fetched remote KV pages awaiting import (docs/
+        # disaggregation.md): HTTP threads enqueue [(hash, block)]
+        # batches, the driver lands them into the prefix pool at the
+        # next tick boundary BEFORE admission — a request submitted
+        # after its pages were queued is guaranteed to see them at
+        # its own admission. deque append/popleft are atomic; no
+        # lock needed.
+        self._kv_imports: collections.deque = collections.deque()
         # Serializes concurrent submit() callers so the duplicate-id
         # check and the queue append are one atomic step.
         self._submit_lock = threading.Lock()
@@ -1655,6 +1663,31 @@ class ServingEngine:
         for rid, reason in cancels.items():
             self._cancel_now(rid, reason, lifecycle.CANCELLED)
 
+    def queue_kv_import(self, items) -> bool:
+        """Queue fetched remote KV pages (``[(chain_hash, {field:
+        np.ndarray})]``, the kv_transfer decode shape) for import
+        into the prefix pool. Any-thread safe; returns False when no
+        prefix cache is configured (the caller's cue that imports
+        can never help here). The driver lands queued batches at the
+        next tick boundary, BEFORE admission — pages queued before a
+        submit are visible to that request's own admission lookup
+        (docs/disaggregation.md)."""
+        if self.prefix is None or not items:
+            return self.prefix is not None
+        self._kv_imports.append(list(items))
+        return True
+
+    def _apply_kv_imports(self) -> None:
+        """Driver-thread boundary work: land every queued KV import
+        batch into the prefix pool (dedup/alloc/eviction semantics
+        are import_pages' — identical to publish)."""
+        while self._kv_imports:
+            try:
+                batch = self._kv_imports.popleft()
+            except IndexError:
+                break
+            self.prefix.import_pages(batch)
+
     def _cancel_now(self, rid: Any, reason: str,
                     status: str) -> bool:
         """Driver-thread cancellation: remove the request wherever it
@@ -1982,6 +2015,12 @@ class ServingEngine:
             # lifecycle work, exactly like a client burst landing
             # between ticks (docs/qos.md).
             self._inject_tenant_burst(burst.params)
+        if self._kv_imports:
+            # Land fetched remote KV pages before anything else at
+            # the boundary: a request whose pages were queued ahead
+            # of its submit must see them in THIS tick's admission
+            # lookup (docs/disaggregation.md).
+            self._apply_kv_imports()
         self._apply_cancellations()
         self._expire_deadlines()
         if self._qos_active:

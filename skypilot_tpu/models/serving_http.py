@@ -73,6 +73,13 @@ _M_DRAIN = metrics_lib.histogram(
     'in-flight request reaching a terminal state (bounded by '
     'SKYTPU_DRAIN_TIMEOUT_SECONDS plus the force-cancel sweep).',
     buckets=metrics_lib.LATENCY_BUCKETS)
+_M_ROLE = metrics_lib.gauge(
+    'skytpu_engine_role',
+    "Info gauge (value 1, role label): this replica's serving role "
+    "in a disaggregated pool — 'prefill', 'decode' or 'mixed' "
+    '(docs/disaggregation.md). Also advertised on /health; the LB '
+    'routes tagged requests prefill→decode by it.',
+    labels=('role',), max_series=4)
 
 
 def _rid_headers(req_id: str) -> Dict[str, str]:
@@ -124,6 +131,15 @@ class EngineServer:
         # Advertised on /health so the LB's tie-break can prefer
         # on-demand survivors (docs/spot_serving.md).
         self.is_spot = False
+        # Serving role in a disaggregated pool
+        # (docs/disaggregation.md): 'prefill' replicas answer
+        # kv_prefill manifests and export pages on /kv/fetch;
+        # 'decode' replicas pull pages and stream; 'mixed' (default)
+        # does both. Advertised on /health — a routing hint, never
+        # enforced, so a degraded pool can still route anything
+        # anywhere.
+        self.role = 'mixed'
+        _M_ROLE.set(1, role=self.role)
         # True once drain()/stop() ended with every in-flight request
         # terminal and the driver thread joined.
         self.clean_shutdown: Optional[bool] = None
@@ -232,6 +248,20 @@ class EngineServer:
         in-flight streams run on — the LB proactively migrates them
         during the window, so no drain sequence runs."""
         self._preempt_requested.set()
+
+    def set_role(self, role: str) -> None:
+        """Assign this replica's disaggregation role and re-point the
+        skytpu_engine_role info gauge at it (the stale series zeroes
+        so a scrape sees exactly one role at 1)."""
+        if role not in ('mixed', 'prefill', 'decode'):
+            raise ValueError(f'unknown replica role {role!r}')
+        if role != self.role:
+            _M_ROLE.set(0, role=self.role)
+        # skytpu-lint: disable=STL004 — GIL-atomic str write, set once
+        # at process start (CLI --role) before the server thread runs;
+        # readers (/health, the gauge) tolerate either value mid-swap.
+        self.role = role
+        _M_ROLE.set(1, role=self.role)
 
     def request_drain(self) -> None:
         """Flip the server into draining mode (idempotent, safe from
@@ -533,6 +563,111 @@ class EngineServer:
             return tenant, None
         return tenant, qos_lib.validate_class(cls_raw)
 
+    async def _import_remote_kv(self, url: str,
+                                tokens) -> Optional[int]:
+        """Pull this prompt's KV pages from ``url`` (a prefill peer)
+        and queue them for import at the next tick boundary — the
+        queue is drained BEFORE admission, so a request submitted
+        after this call sees the pages in its reuse lookup
+        (docs/disaggregation.md). Returns the expected reused-token
+        count (the X-KV-Reused-Tokens surface), or None when the
+        fetch failed and the request falls back to a plain local
+        prefill — a fetch failure slows a request down but never
+        fails it."""
+        from skypilot_tpu.models import prefix_cache as prefix_mod
+        from skypilot_tpu.serve import kv_transfer
+        prefix = self.engine.prefix
+        page = prefix.page
+        n_full = len(tokens) // page
+        if n_full <= 0:
+            return 0
+        hashes = prefix_mod.page_hashes(tokens[:n_full * page], page)
+        # skytpu-lint: disable=STL004 — read-only membership probe;
+        # pylint: disable=protected-access — same-package peek, the
+        # same discipline would_reuse uses internally.
+        need = [h for h in hashes if h not in prefix._by_hash]
+        fetched = []
+        if need:
+            try:
+                fetched = await asyncio.to_thread(
+                    kv_transfer.fetch, url, need,
+                    expect_sig=prefix.page_signature())
+            except kv_transfer.KVFetchError as e:
+                logger.warning(
+                    'KV fetch from %s failed (%s): falling back to '
+                    'local prefill. trace=%s', url, e,
+                    trace_lib.current_trace_id())
+                return None
+            if fetched:
+                self.engine.queue_kv_import(fetched)
+        return prefix.would_reuse(
+            tokens, self.engine.prefill_chunk,
+            extra_hashes=[h for h, _ in fetched])
+
+    async def _generate_prefill_manifest(
+            self, rid: Any, req_id: str, tokens, temperature,
+            deadline: Optional[float],
+            tenant: Optional[str] = None,
+            priority_class: Optional[str] = None) -> web.Response:
+        """The prefill half of a disaggregated handoff
+        (docs/disaggregation.md): run the prompt through the normal
+        chunked-prefill path with a single decode step — the
+        terminal retire is what publishes the prompt's full pages
+        into the prefix pool — then answer with a page MANIFEST
+        instead of a token stream: the chain hashes now exported on
+        /kv/fetch, the pool's page signature, and the page size. The
+        decode side recomputes the same chain hashes from the same
+        tokens; the manifest is the router's receipt that they are
+        fetchable here."""
+        from skypilot_tpu.models import prefix_cache as prefix_mod
+        from skypilot_tpu.models.serving_engine import (
+            DuplicateRequestError, Request)
+        fut = asyncio.get_event_loop().create_future()
+        # skytpu-lint: disable=STL004 — same discipline as the
+        # non-streaming path: loop-thread mutation, driver-side pop.
+        self._futures[rid] = fut
+        try:
+            with self._lock:
+                self.engine.submit(Request(
+                    rid, tokens, 1, temperature=temperature,
+                    deadline=deadline, tenant=tenant,
+                    priority_class=priority_class))
+        except DuplicateRequestError as e:
+            self._futures.pop(rid, None)
+            return web.json_response(
+                {'error': str(e), 'reason': 'duplicate_request',
+                 'request_id': req_id},
+                status=409, headers=_rid_headers(req_id))
+        except ValueError as e:
+            self._futures.pop(rid, None)
+            return web.json_response({'error': str(e)}, status=400,
+                                     headers=_rid_headers(req_id))
+        if self._dead is not None:
+            self._futures.pop(rid, None)
+            return web.json_response(
+                {'error': f'engine dead: {self._dead}'}, status=503,
+                headers=_rid_headers(req_id))
+        try:
+            result = await fut
+        except asyncio.CancelledError:
+            self._futures.pop(rid, None)
+            self.engine.cancel(rid, reason='client_disconnect')
+            raise
+        prefix = self.engine.prefix
+        page = prefix.page
+        n_full = len(tokens) // page
+        hashes = prefix_mod.page_hashes(tokens[:n_full * page], page)
+        return web.json_response(
+            {'manifest': True,
+             'page': page,
+             'prompt_len': len(tokens),
+             'hashes': [h.hex() for h in hashes],
+             'sig': prefix.page_signature(),
+             'tokens': result.tokens,
+             'status': result.status,
+             'reason': result.reason},
+            headers=_rid_headers(req_id))
+
     async def handle_generate(self, request: web.Request
                               ) -> web.StreamResponse:
         # Correlation surface (docs/tracing.md): accept (or mint) an
@@ -617,11 +752,34 @@ class EngineServer:
         # the event-loop thread; handle_cancel does an atomic get.
         self._by_reqid[req_id] = rid
         try:
+            has_prefix = getattr(self.engine, 'prefix', None) is not None
+            if body.get('kv_prefill'):
+                # Disaggregated handoff, prefill half: publish pages,
+                # answer a manifest (docs/disaggregation.md).
+                if not has_prefix:
+                    return web.json_response(
+                        {'error': 'kv_prefill requires a prefix '
+                                  'cache on this replica',
+                         'reason': 'no_prefix_cache',
+                         'request_id': req_id},
+                        status=409, headers=_rid_headers(req_id))
+                return await self._generate_prefill_manifest(
+                    rid, req_id, tokens, temperature, deadline,
+                    tenant=tenant, priority_class=priority_class)
+            kv_source = body.get('kv_source')
+            kv_reused: Optional[int] = None
+            if (isinstance(kv_source, str) and kv_source and
+                    has_prefix):
+                # Disaggregated handoff, decode half: pull the
+                # prompt's pages from the prefill peer before submit.
+                kv_reused = await self._import_remote_kv(
+                    kv_source, tokens)
             if stream:
                 return await self._generate_stream(
                     request, rid, req_id, tokens, max_new, temperature,
                     deadline, tenant=tenant,
-                    priority_class=priority_class)
+                    priority_class=priority_class,
+                    kv_reused=kv_reused)
             fut = asyncio.get_event_loop().create_future()
             # skytpu-lint: disable=STL004 — _futures is mutated and
             # iterated only on the event-loop thread (fail_all runs
@@ -684,7 +842,8 @@ class EngineServer:
                                temperature,
                                deadline: Optional[float] = None,
                                tenant: Optional[str] = None,
-                               priority_class: Optional[str] = None
+                               priority_class: Optional[str] = None,
+                               kv_reused: Optional[int] = None
                                ) -> web.StreamResponse:
         """SSE: one ``data:`` event per decode chunk, then ``done``.
 
@@ -723,12 +882,20 @@ class EngineServer:
             return web.json_response(
                 {'error': f'engine dead: {self._dead}'}, status=503,
                 headers=_rid_headers(req_id))
-        resp = web.StreamResponse(headers={
+        headers = {
             'Content-Type': 'text/event-stream',
             'Cache-Control': 'no-cache',
             'X-Accel-Buffering': 'no',
             **_rid_headers(req_id),
-        })
+        }
+        if kv_reused is not None:
+            # Disaggregated/KV-assisted streams advertise how many
+            # prompt tokens the fetched pages will cover, BEFORE the
+            # first byte: the LB attaches it to its resume span and
+            # the skytpu_lb_resume_kv_reused_tokens_total counter
+            # (docs/disaggregation.md).
+            headers['X-KV-Reused-Tokens'] = str(kv_reused)
+        resp = web.StreamResponse(headers=headers)
         try:
             # prepare() is INSIDE the guarded region: a client that
             # hangs up this early cancels the handler right here, and
@@ -815,6 +982,46 @@ class EngineServer:
              'notice_s': lifecycle.preempt_notice_s()},
             status=202)
 
+    async def handle_kv_fetch(self, request: web.Request
+                              ) -> web.Response:
+        """POST /kv/fetch: serve prefix-cache pages by chain hash
+        (docs/disaggregation.md). Body ``{'hashes': [hex, ...]}``;
+        the response is one SKKV1 payload holding every requested
+        page the pool still has — whole pages only, bounded by
+        SKYTPU_KV_FETCH_MAX_BYTES. Absence of a page is the miss
+        signal (the peer re-prefills those positions), so a cold
+        hash never 404s; 400 on malformed bodies, 503 while warming
+        or when this replica has no prefix cache."""
+        from skypilot_tpu.serve import kv_transfer
+        if self._dead is not None:
+            return web.json_response(
+                {'error': f'engine dead: {self._dead}'}, status=503)
+        if not self._ready.is_set():
+            return web.json_response({'status': 'warming'},
+                                     status=503)
+        prefix = getattr(self.engine, 'prefix', None)
+        if prefix is None:
+            return web.json_response(
+                {'error': 'no prefix cache on this replica'},
+                status=503)
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError('body must be a JSON object')
+            hashes = body.get('hashes')
+            if (not isinstance(hashes, list) or
+                    not all(isinstance(h, str) for h in hashes)):
+                raise ValueError(
+                    "'hashes' must be a list of hex chain hashes")
+        except (ValueError, UnicodeDecodeError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        # Off-loop: pack_pages does device->host copies per page.
+        payload = await asyncio.to_thread(
+            kv_transfer.pack_pages, prefix, hashes)
+        return web.Response(
+            body=payload,
+            headers={'Content-Type': 'application/octet-stream'})
+
     async def handle_health(self, request: web.Request) -> web.Response:
         if self._dead is not None:
             return web.json_response(
@@ -841,7 +1048,8 @@ class EngineServer:
         body = {'status': 'ok',
                 'est_wait_s': round(self.engine.estimate_wait_s(0, 1),
                                     4),
-                'is_spot': self.is_spot}
+                'is_spot': self.is_spot,
+                'role': self.role}
         limits = getattr(self.engine, 'limits', None)
         if limits is not None:
             body['limits'] = limits()
@@ -851,6 +1059,13 @@ class EngineServer:
         mesh_info = getattr(self.engine, 'mesh_info', None)
         if mesh_info is not None:
             body['mesh'] = mesh_info()
+        # Cheap prefix summary (pool occupancy + a recency-ordered
+        # hash sample): the disagg router and humans curling a
+        # replica see cache heat without a /metrics parse
+        # (docs/disaggregation.md).
+        prefix = getattr(self.engine, 'prefix', None)
+        if prefix is not None:
+            body['prefix'] = prefix.prefix_summary()
         return web.json_response(body)
 
     async def handle_metrics(self, request: web.Request
@@ -873,6 +1088,7 @@ class EngineServer:
         app.router.add_post('/drain', self.handle_drain)
         app.router.add_post('/preempt_notice',
                             self.handle_preempt_notice)
+        app.router.add_post('/kv/fetch', self.handle_kv_fetch)
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
         return app
@@ -1085,6 +1301,16 @@ def main() -> None:
                         'on /health: the LB tie-break prefers '
                         'on-demand survivors for hedges/resumes '
                         '(docs/spot_serving.md).')
+    parser.add_argument('--role',
+                        choices=('mixed', 'prefill', 'decode'),
+                        default='mixed',
+                        help='Serving role in a disaggregated pool '
+                        '(docs/disaggregation.md): prefill replicas '
+                        'answer kv_prefill manifests and export KV '
+                        'pages on /kv/fetch; decode replicas pull '
+                        'pages from prefill peers and stream. '
+                        'Advertised on /health — a routing hint, '
+                        'never enforced.')
     args = parser.parse_args()
 
     # Name this replica's span-spool file (docs/tracing.md).
@@ -1094,6 +1320,7 @@ def main() -> None:
         max_pending=(args.max_pending if args.max_pending > 0
                      else None))
     server.is_spot = bool(args.is_spot)
+    server.set_role(args.role)
     # SIGTERM/SIGINT flow into a graceful drain
     # (docs/request_lifecycle.md): the handler only sets a flag; the
     # main task below notices and runs the bounded drain sequence.

@@ -83,6 +83,13 @@ class ScalingDecision:
     # replica uses the task's own resources as written.
     num_spot: Optional[int] = None
     num_ondemand: Optional[int] = None
+    # Disaggregated pool split (docs/disaggregation.md). None = not a
+    # disaggregated service. When set, ``target_replicas`` (and the
+    # spot split above) size the DECODE pool — ``num_prefill`` rides
+    # on top as its own independently-scaled pool of prefill-role
+    # replicas.
+    num_prefill: Optional[int] = None
+    num_decode: Optional[int] = None
 
 
 class SpotPreemptionRateEstimator:
@@ -434,6 +441,14 @@ class SLOAutoscaler(RequestRateAutoscaler):
         self._slo_samples: Dict[str, Dict[str, float]] = {}
         self._breach_since: Optional[float] = None
         self._last_slo_scale_at: Optional[float] = None
+        # Disaggregated prefill pool (docs/disaggregation.md): its
+        # own target with its own breach/cooldown clocks — TTFT
+        # breaches scale prefill, ITL/queue-wait breaches scale
+        # decode, independently.
+        self._prefill_target = spec.min_prefill_replicas
+        self._prefill_breach_since: Optional[float] = None
+        self._last_prefill_scale_at: Optional[float] = None
+        self._prefill_idle_since: Optional[float] = None
 
     # --------------------------------------------------- ingestion
     def observe_replica(self, url: str, values: Dict[str, float],
@@ -490,11 +505,21 @@ class SLOAutoscaler(RequestRateAutoscaler):
                              if u in keep}
 
     # ------------------------------------------------------ breach
-    def _worst_breach(self, now: float
+    @staticmethod
+    def _is_prefill_signal(key: str) -> bool:
+        """TTFT-family signals (aggregate or per-class) are prefill
+        pressure in a disaggregated service: time-to-first-token is
+        dominated by prefill queueing and compute, while ITL and
+        queue-wait are decode-side (docs/disaggregation.md)."""
+        return key == 'ttft_p99' or key.startswith('class_ttft:')
+
+    def _worst_breach(self, now: float, want=None
                       ) -> Optional[Tuple[float, str, str]]:
         """(ratio, signal, url) of the worst fresh signal relative to
         its target, or None with no usable samples. ratio > 1 means
-        the objective is being missed."""
+        the objective is being missed. ``want`` optionally filters
+        signal keys (the disaggregated pool split evaluates prefill
+        and decode signals separately)."""
         targets = dict(self.spec.slo_targets())
         for cls, target in self.spec.class_slo_targets().items():
             targets[_class_signal_key(cls)] = target
@@ -503,6 +528,8 @@ class SLOAutoscaler(RequestRateAutoscaler):
             if now - sample['at'] > _SLO_SAMPLE_TTL_SECONDS:
                 continue
             for key, target in targets.items():
+                if want is not None and not want(key):
+                    continue
                 value = sample.get(key)
                 if value is None:
                     continue
@@ -519,6 +546,10 @@ class SLOAutoscaler(RequestRateAutoscaler):
             'last_scale_at': self._last_slo_scale_at,
             'samples': {u: dict(s)
                         for u, s in self._slo_samples.items()},
+            'prefill_target': self._prefill_target,
+            'prefill_breach_since': self._prefill_breach_since,
+            'prefill_last_scale_at': self._last_prefill_scale_at,
+            'prefill_idle_since': self._prefill_idle_since,
         }
         return state
 
@@ -532,6 +563,11 @@ class SLOAutoscaler(RequestRateAutoscaler):
         slo = state.get('slo') or {}
         self._breach_since = slo.get('breach_since')
         self._last_slo_scale_at = slo.get('last_scale_at')
+        self._prefill_target = int(slo.get(
+            'prefill_target', self.spec.min_prefill_replicas))
+        self._prefill_breach_since = slo.get('prefill_breach_since')
+        self._last_prefill_scale_at = slo.get('prefill_last_scale_at')
+        self._prefill_idle_since = slo.get('prefill_idle_since')
         samples = slo.get('samples') or {}
         self._slo_samples = {
             str(u): {k: float(v) for k, v in s.items()}
@@ -539,11 +575,66 @@ class SLOAutoscaler(RequestRateAutoscaler):
             if isinstance(s, dict) and 'at' in s}
 
     # -------------------------------------------------- evaluation
+    def _evaluate_prefill(self, now: float) -> int:
+        """Prefill-pool target for a disaggregated service
+        (docs/disaggregation.md): the SAME sustained-breach /
+        proportional-step / cooldown shape as the aggregate path,
+        run over the TTFT-family signals only and clamped to
+        [min_prefill_replicas, max_prefill_replicas]. Quiet periods
+        walk the pool back toward its floor one replica per
+        downscale delay."""
+        breach = self._worst_breach(now, want=self._is_prefill_signal)
+        breached = breach is not None and breach[0] > 1.0
+        if not breached:
+            self._prefill_breach_since = None
+            if self._prefill_target > self.spec.min_prefill_replicas:
+                if self._prefill_idle_since is None:
+                    self._prefill_idle_since = now
+                elif (now - self._prefill_idle_since >=
+                      self.spec.downscale_delay_seconds):
+                    self._prefill_target -= 1
+                    self._prefill_idle_since = now
+            else:
+                self._prefill_idle_since = None
+            return self._prefill_target
+        self._prefill_idle_since = None
+        if self._prefill_breach_since is None:
+            self._prefill_breach_since = now
+        ratio, signal, url = breach
+        delay = self.spec.slo_upscale_delay_seconds
+        sustained = now - self._prefill_breach_since >= delay
+        cooled = (self._last_prefill_scale_at is None or
+                  now - self._last_prefill_scale_at >= delay)
+        hi = self.spec.max_prefill_replicas
+        if sustained and cooled and \
+                (hi is None or self._prefill_target < hi):
+            step = max(1, math.ceil(
+                self._prefill_target * (min(ratio, 2.0) - 1.0)))
+            new = self._prefill_target + step
+            if hi is not None:
+                new = min(new, hi)
+            logger.info(
+                'SLO prefill-pool scale-up %d -> %d: %s breached '
+                '%.2fx at %s (sustained %.0fs).',
+                self._prefill_target, new, signal, ratio, url,
+                now - self._prefill_breach_since)
+            self._prefill_target = new
+            self._last_prefill_scale_at = now
+        return self._prefill_target
+
     def evaluate(self, current_replicas: Optional[int] = None,
                  now: Optional[float] = None,
                  num_ready_spot: int = 0) -> ScalingDecision:
         now = now if now is not None else statedb.wall_now()
-        breach = self._worst_breach(now)
+        disagg = self.spec.disaggregated()
+        # In a disaggregated service the aggregate path owns only
+        # the DECODE pool: TTFT-family breaches are routed to the
+        # prefill pool below, so they neither grow the decode fleet
+        # nor freeze its demand hysteresis. A classic service keeps
+        # every signal on the one pool, bit for bit.
+        want = ((lambda k: not self._is_prefill_signal(k))
+                if disagg else None)
+        breach = self._worst_breach(now, want=want)
         breached = breach is not None and breach[0] > 1.0
         if not breached:
             self._breach_since = None
@@ -589,8 +680,14 @@ class SLOAutoscaler(RequestRateAutoscaler):
                 self._last_slo_scale_at = now
             decision = ScalingDecision(self._target)
         self.spot_rate.advance(now, num_ready_spot)
-        return _with_spot_split(self.spec, decision, num_ready_spot,
-                                estimator=self.spot_rate)
+        decision = _with_spot_split(self.spec, decision, num_ready_spot,
+                                    estimator=self.spot_rate)
+        if disagg:
+            # Set AFTER the spot split — it may build a fresh
+            # ScalingDecision and would drop the pool fields.
+            decision.num_prefill = self._evaluate_prefill(now)
+            decision.num_decode = decision.target_replicas
+        return decision
 
 
 class FallbackRequestRateAutoscaler(RequestRateAutoscaler):

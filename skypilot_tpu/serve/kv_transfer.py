@@ -1,0 +1,307 @@
+"""KV-page transfer: prefix-cache pages as an addressable, movable
+resource (docs/disaggregation.md).
+
+The prefix cache (models/prefix_cache.py) already gives every KV page
+a content address — a chain hash committing to the page's WHOLE token
+prefix — and a fixed wire-friendly shape (quantized caches carry
+their int8 planes plus bf16 scales as first-class fields). This
+module is the missing half: a canonical byte encoding for a batch of
+pages, a replica-side packer for the ``POST /kv/fetch`` surface, and
+a client fetcher the disaggregated router and the KV-assisted resume
+path share.
+
+Wire format (version ``SKKV1``)::
+
+    b"SKKV1\\n"
+    <one JSON header line, sorted keys>
+    <concatenated raw page payloads>
+
+The header names the producer's page *signature* — page size plus
+per-field dtype and block shape — and one record per page: its chain
+hash (hex), payload length and a blake2b checksum. Decoding validates
+magic, header, per-page checksums and the byte math; importing
+replicas additionally compare the signature against their OWN pool's
+(``PrefixCache.page_signature()``) and reject on any mismatch — a
+fetched page either lands bit-exact in the local pool or not at all.
+Because page keys are chain hashes, a transferred page means the same
+thing on every replica running the same model: content addressing IS
+the transfer protocol's correctness argument.
+
+Failure semantics: every client entry point raises
+:class:`KVFetchError` (transport, wire, signature — one exception
+type), and callers degrade to interleaved re-prefill; a fetch can
+slow a request down but never corrupt it. The ``serve.kv.fetch``
+fault site is polled before each fetch so chaos plans can sever the
+prefill→decode handoff deterministically (``connect_failure``) or
+stall it (``hang``), with the usual cross-process receipts.
+
+Knobs: ``SKYTPU_KV_FETCH_MAX_BYTES`` bounds a single response payload
+(the replica packs whole pages until the budget is spent — absence of
+a requested page in the response is the protocol's miss signal, never
+an error) and ``SKYTPU_KV_FETCH_TIMEOUT_S`` bounds the client's wait.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.utils import env_registry
+from skypilot_tpu.utils import fault_injection
+from skypilot_tpu.utils import log as sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+MAGIC = b'SKKV1\n'
+
+_M_FETCHES = metrics_lib.counter(
+    'skytpu_kv_fetches_total',
+    'KV page fetches issued against peer replicas, by outcome: ok, '
+    'error (transport/wire/signature), injected (a serve.kv.fetch '
+    'chaos spec fired).',
+    labels=('outcome',))
+_M_PAGES_SENT = metrics_lib.counter(
+    'skytpu_kv_pages_sent_total',
+    'Prefix-cache pages this replica packed into /kv/fetch responses '
+    '(the prefill→decode transfer volume, in pages).')
+_M_PAGES_FETCHED = metrics_lib.counter(
+    'skytpu_kv_pages_fetched_total',
+    'Prefix-cache pages fetched from peer replicas (decode-side '
+    'arrivals; import into the pool is counted separately by '
+    'skytpu_engine_prefix_pages_imported_total).')
+
+
+class WireError(ValueError):
+    """A byte stream that is not a valid SKKV1 payload."""
+
+
+class KVFetchError(RuntimeError):
+    """A KV fetch that produced no usable pages (transport, wire or
+    signature failure). Callers fall back to interleaved re-prefill."""
+
+
+def max_fetch_bytes() -> int:
+    raw = env_registry.get(env_registry.SKYTPU_KV_FETCH_MAX_BYTES,
+                           str(64 * 1024 * 1024))
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 64 * 1024 * 1024
+
+
+def fetch_timeout_s() -> float:
+    raw = env_registry.get(env_registry.SKYTPU_KV_FETCH_TIMEOUT_S,
+                           '10')
+    try:
+        return max(0.1, float(raw))
+    except ValueError:
+        return 10.0
+
+
+def page_nbytes(sig: Dict[str, Any]) -> int:
+    """Payload bytes of ONE page under ``sig`` (every page is the
+    same fixed shape — the budget math needs no per-page probing)."""
+    total = 0
+    for f in sorted(sig['fields']):
+        spec = sig['fields'][f]
+        n = 1
+        for d in spec['shape']:
+            n *= int(d)
+        total += n * np.dtype(spec['dtype']).itemsize
+    return total
+
+
+# ---------------------------------------------------------- encoding
+def encode(sig: Dict[str, Any],
+           pages: Sequence[Tuple[bytes, Dict[str, np.ndarray]]]
+           ) -> bytes:
+    """Canonical wire bytes for ``pages`` (``[(chain_hash, {field:
+    array})]``) under signature ``sig``. Fields serialize in sorted
+    name order; each page carries a blake2b checksum of its payload
+    so truncation/corruption fails decode, not decode's caller."""
+    order = sorted(sig['fields'])
+    payload = io.BytesIO()
+    recs: List[Dict[str, Any]] = []
+    for h, blk in pages:
+        start = payload.tell()
+        digest = hashlib.blake2b(digest_size=16)
+        for f in order:
+            spec = sig['fields'][f]
+            arr = np.ascontiguousarray(
+                np.asarray(blk[f], dtype=np.dtype(spec['dtype'])))
+            if list(arr.shape) != [int(d) for d in spec['shape']]:
+                raise WireError(
+                    f'page field {f!r} has shape {arr.shape}, '
+                    f'signature says {spec["shape"]}')
+            raw = arr.tobytes()
+            digest.update(raw)
+            payload.write(raw)
+        recs.append({'hash': h.hex(),
+                     'len': payload.tell() - start,
+                     'sum': digest.hexdigest()})
+    header = json.dumps({'sig': sig, 'fields': order, 'pages': recs},
+                        sort_keys=True)
+    return MAGIC + header.encode('utf-8') + b'\n' + payload.getvalue()
+
+
+def decode(data: bytes) -> Tuple[Dict[str, Any],
+                                 List[Tuple[bytes,
+                                            Dict[str, np.ndarray]]]]:
+    """Parse wire bytes back into ``(sig, [(chain_hash, {field:
+    array})])``. Every malformation — bad magic, bad header, short
+    payload, checksum mismatch — raises :class:`WireError`; a decoded
+    page is byte-for-byte what the producer exported."""
+    if not data.startswith(MAGIC):
+        raise WireError('not an SKKV1 payload (bad magic)')
+    nl = data.find(b'\n', len(MAGIC))
+    if nl < 0:
+        raise WireError('truncated SKKV1 header')
+    try:
+        header = json.loads(data[len(MAGIC):nl].decode('utf-8'))
+        sig = header['sig']
+        order = list(header['fields'])
+        recs = list(header['pages'])
+    except (ValueError, KeyError, TypeError) as e:
+        raise WireError(f'malformed SKKV1 header: {e}') from e
+    if sorted(sig.get('fields', {})) != sorted(order):
+        raise WireError('SKKV1 field order disagrees with signature')
+    field_specs = []
+    for f in order:
+        try:
+            spec = sig['fields'][f]
+            shape = tuple(int(d) for d in spec['shape'])
+            dtype = np.dtype(spec['dtype'])
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f'malformed field spec for {f!r}: {e}') \
+                from e
+        field_specs.append((f, shape, dtype,
+                            int(np.prod(shape)) * dtype.itemsize))
+    page_len = sum(nb for _, _, _, nb in field_specs)
+    out: List[Tuple[bytes, Dict[str, np.ndarray]]] = []
+    off = nl + 1
+    for rec in recs:
+        try:
+            h = bytes.fromhex(rec['hash'])
+            declared = int(rec['len'])
+            checksum = str(rec['sum'])
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f'malformed page record: {e}') from e
+        if declared != page_len:
+            raise WireError(
+                f'page payload length {declared} != signature page '
+                f'size {page_len}')
+        raw = data[off:off + page_len]
+        if len(raw) != page_len:
+            raise WireError('truncated SKKV1 payload')
+        if hashlib.blake2b(raw,
+                           digest_size=16).hexdigest() != checksum:
+            raise WireError(f'page {rec["hash"]} checksum mismatch')
+        blk: Dict[str, np.ndarray] = {}
+        f_off = 0
+        for f, shape, dtype, nb in field_specs:
+            blk[f] = np.frombuffer(
+                raw[f_off:f_off + nb], dtype=dtype).reshape(shape)
+            f_off += nb
+        out.append((h, blk))
+        off += page_len
+    if off != len(data):
+        raise WireError(
+            f'{len(data) - off} trailing byte(s) after last page')
+    return sig, out
+
+
+# ------------------------------------------------------ replica side
+def pack_pages(cache: Any, hashes_hex: Sequence[str],
+               max_bytes: Optional[int] = None) -> bytes:
+    """Build a ``/kv/fetch`` response body: export each requested
+    page from the local pool, skipping hashes the pool no longer
+    holds (absence IS the miss signal — the requester re-prefills
+    those positions), packing whole pages until the byte budget is
+    spent. Safe to call from HTTP threads: ``export_page`` validates
+    the directory around its host copy and drops pages that move
+    under it."""
+    sig = cache.page_signature()
+    budget = max_bytes if max_bytes is not None else max_fetch_bytes()
+    per_page = page_nbytes(sig)
+    pages: List[Tuple[bytes, Dict[str, np.ndarray]]] = []
+    spent = 0
+    for hx in hashes_hex:
+        try:
+            h = bytes.fromhex(str(hx))
+        except ValueError:
+            continue
+        if spent + per_page > budget:
+            break
+        blk = cache.export_page(h)
+        if blk is None:
+            continue
+        pages.append((h, blk))
+        spent += per_page
+    _M_PAGES_SENT.inc(len(pages))
+    return encode(sig, pages)
+
+
+# ------------------------------------------------------- client side
+def fetch(url: str, hashes: Sequence[Any],
+          timeout_s: Optional[float] = None,
+          expect_sig: Optional[Dict[str, Any]] = None
+          ) -> List[Tuple[bytes, Dict[str, np.ndarray]]]:
+    """Fetch pages by chain hash from ``url``'s ``POST /kv/fetch``.
+
+    Synchronous (urllib) by design: the decode replica calls it off
+    its event loop via a thread, and the LB never calls it at all
+    (transfer is replica-to-replica — the router only carries
+    hashes). Polls ``serve.kv.fetch`` first: an armed
+    ``connect_failure`` raises without touching the network (the
+    chaos handle for a mid-handoff peer death) and a ``hang`` stalls
+    ``params['seconds']`` before the request. Returns the pages the
+    peer had; raises :class:`KVFetchError` on transport, wire or
+    signature failure — the caller's cue to fall back to interleaved
+    re-prefill.
+    """
+    spec = fault_injection.poll(
+        'serve.kv.fetch',
+        kinds=(fault_injection.FaultKind.CONNECT_FAILURE,
+               fault_injection.FaultKind.HANG),
+        url=url)
+    if spec is not None:
+        if spec.kind is fault_injection.FaultKind.HANG:
+            time.sleep(float(spec.params.get('seconds', 1.0)))
+        else:
+            _M_FETCHES.inc(1, outcome='injected')
+            raise KVFetchError(
+                f'injected connect failure fetching KV from {url}')
+    body = json.dumps({'hashes': [
+        h.hex() if isinstance(h, bytes) else str(h)
+        for h in hashes]}).encode('utf-8')
+    req = urllib.request.Request(
+        url.rstrip('/') + '/kv/fetch', data=body,
+        headers={'Content-Type': 'application/json'})
+    timeout = timeout_s if timeout_s is not None else fetch_timeout_s()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            data = resp.read()
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        _M_FETCHES.inc(1, outcome='error')
+        raise KVFetchError(f'KV fetch from {url} failed: {e}') from e
+    try:
+        sig, pages = decode(data)
+    except WireError as e:
+        _M_FETCHES.inc(1, outcome='error')
+        raise KVFetchError(
+            f'KV fetch from {url}: bad payload: {e}') from e
+    if expect_sig is not None and sig != expect_sig:
+        _M_FETCHES.inc(1, outcome='error')
+        raise KVFetchError(
+            f'KV fetch from {url}: peer page signature {sig} does '
+            f'not match local pool signature {expect_sig}')
+    _M_FETCHES.inc(1, outcome='ok')
+    _M_PAGES_FETCHED.inc(len(pages))
+    return pages

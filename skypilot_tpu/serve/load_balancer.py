@@ -139,6 +139,31 @@ _M_MIGRATIONS = metrics_lib.counter(
     'kill lands — a noticed preemption costs zero client-visible '
     'errors (docs/spot_serving.md).',
     labels=('trigger',))
+# Disaggregated prefill/decode (docs/disaggregation.md).
+_M_DISAGG_HANDOFFS = metrics_lib.counter(
+    'skytpu_lb_disagg_handoffs_total',
+    'Streaming /generate requests routed through the disaggregated '
+    'prefill→manifest→decode path: a prefill replica published the '
+    "prompt's KV pages and answered a manifest, and the decode "
+    'attempt carried kv_source so the decode replica pulls those '
+    'pages instead of re-prefilling.')
+_M_DISAGG_FALLBACKS = metrics_lib.counter(
+    'skytpu_lb_disagg_fallbacks_total',
+    'Disaggregated handoffs that degraded to the interleaved path, '
+    'by reason: disabled (SKYTPU_DISAGG=0 with a prefill pool '
+    'present), no_prefill (every prefill replica excluded — '
+    'draining, preempting, breaker-open), prefill_error (the '
+    'prefill POST failed or answered no manifest — e.g. the '
+    "replica died mid-handoff). The decode side's own fetch "
+    'failures are not counted here: they fall back inside the '
+    'replica (skytpu_kv_fetches_total{outcome!="ok"}).',
+    labels=('reason',))
+_M_RESUME_KV = metrics_lib.counter(
+    'skytpu_lb_resume_kv_reused_tokens_total',
+    'Prompt tokens a resumed or migrated stream did NOT re-prefill '
+    'because its resume target fetched the KV pages from the '
+    "dying/doomed replica's cache (the X-KV-Reused-Tokens response "
+    'header summed over resume attempts; docs/disaggregation.md).')
 
 
 class LoadBalancingPolicy:
@@ -278,6 +303,12 @@ class LoadBalancer:
         # mark_preempting() runs, while their live streams migrate to
         # survivors ahead of the kill.
         self._preempting: Set[str] = set()
+        # Prefill-role replicas (docs/disaggregation.md): a subset of
+        # the fleet that ONLY takes the disagg router's kv_prefill
+        # handoffs — excluded from every ordinary pick (new streams,
+        # retries, hedges, resume targets) so decode traffic never
+        # lands on them.
+        self._prefill_urls: Set[str] = set()
         # Live SSE drivers, so mark_preempting() can find (and
         # migrate) the streams currently attached to a doomed
         # replica. Registered for the duration of driver.run().
@@ -300,7 +331,8 @@ class LoadBalancer:
             window_s)
 
     def set_replica_urls(self, urls: List[str],
-                         spot_urls: Optional[Sequence[str]] = None
+                         spot_urls: Optional[Sequence[str]] = None,
+                         prefill_urls: Optional[Sequence[str]] = None
                          ) -> None:
         for gone in set(self.policy.urls()) - set(urls):
             # The replica left the fleet (scale-down, terminate, or
@@ -320,6 +352,11 @@ class LoadBalancer:
         # or the notice was walked back and it re-probed READY) sheds
         # its mark; re-notice re-marks it.
         self._preempting &= set(urls)
+        # Prefill roles ride on every fleet push too
+        # (docs/disaggregation.md): None/empty means no prefill pool
+        # — the disagg router then falls back to interleaved.
+        self._prefill_urls = {u for u in (prefill_urls or ())
+                              if u in set(urls)}
 
     def inflight(self, url: str) -> int:
         # One store for in-flight load: the scraped gauge, maintained
@@ -383,11 +420,32 @@ class LoadBalancer:
         both claim the same trial. Preempting replicas
         (docs/spot_serving.md) are excluded HERE so every pick —
         opaque retry, SSE attempt, hedge, resume target — avoids a
-        replica whose kill is seconds away."""
+        replica whose kill is seconds away; prefill-role replicas
+        (docs/disaggregation.md) likewise, so decode traffic never
+        lands on them."""
         url = self.policy.pick(exclude=exclude | self._blocked_urls()
-                               | self._preempting)
+                               | self._preempting
+                               | self._prefill_urls)
         if url is not None:
             self._breaker(url).acquire()
+        return url
+
+    def _pick_prefill(self) -> Optional[str]:
+        """Least-loaded pick WITHIN the prefill pool
+        (docs/disaggregation.md), honoring the same exclusions as
+        _pick (draining, preempting, open breakers) and holding the
+        same in-flight gauge — released via ``policy.done(url)`` like
+        any pick. None when no prefill replica is usable: the disagg
+        router's cue to fall back to interleaved."""
+        cands = [u for u in self._prefill_urls
+                 if u not in self._draining and
+                 u not in self._preempting and
+                 u not in self._blocked_urls()]
+        if not cands:
+            return None
+        url = min(cands, key=lambda u: _M_INFLIGHT.value(replica=u))
+        _M_INFLIGHT.inc(1, replica=url)
+        self._breaker(url).acquire()
         return url
 
     def _note_success(self, url: str) -> None:
@@ -430,6 +488,16 @@ class LoadBalancer:
     def _resume_max() -> int:
         return max(0, int(env_registry.get(
             env_registry.SKYTPU_LB_RESUME_MAX, '3')))
+
+    @staticmethod
+    def _disagg_enabled() -> bool:
+        return env_registry.get(env_registry.SKYTPU_DISAGG,
+                                '1') == '1'
+
+    @staticmethod
+    def _resume_kv_enabled() -> bool:
+        return env_registry.get(env_registry.SKYTPU_LB_RESUME_KV,
+                                '1') == '1'
 
     def _hedge_delay_s(self) -> float:
         p95 = self._ttft_window.quantile(0.95)
@@ -1036,6 +1104,13 @@ class _SSEGenerateDriver:
         self._noted_exc: Optional[BaseException] = None
         self.resumes = 0
         self.hedged = False
+        # KV-transfer source (docs/disaggregation.md): when set,
+        # every upstream attempt carries kv_source=<url> so the
+        # decode replica pulls the prompt's published pages from
+        # that peer before prefilling. Set by the disagg phase-0
+        # handoff (prefill peer) or by the KV-assisted resume arm
+        # (the dying/doomed replica).
+        self.kv_source: Optional[str] = None
         # Proactive migrations off preempting replicas
         # (docs/spot_serving.md): each one re-drives the stream
         # through the resume path, so ``migrated <= resumes`` once
@@ -1060,6 +1135,14 @@ class _SSEGenerateDriver:
         payload['tokens'] = self.tokens + self.emitted
         payload['max_new'] = self.max_new - len(self.emitted)
         payload['stream'] = True
+        payload.pop('disagg', None)
+        if self.kv_source and self.kv_source != url:
+            payload['kv_source'] = self.kv_source
+        else:
+            # Never ask a replica to fetch from itself, and never
+            # forward a client-supplied kv_source the LB did not
+            # establish.
+            payload.pop('kv_source', None)
         headers = self.lb._forward_headers(  # pylint: disable=protected-access
             self.request, self.deadline,
             drop=('content-type', 'content-length'))
@@ -1170,10 +1253,97 @@ class _SSEGenerateDriver:
             payload['hedged'] = True
         return payload
 
+    # ------------------------------------------- disagg phase 0
+    async def _maybe_prefill_handoff(self) -> None:
+        """Disaggregated phase 0 (docs/disaggregation.md): when a
+        prefill pool exists, POST the prompt to a prefill replica as
+        ``kv_prefill`` — it runs chunked prefill, publishes the
+        prompt's KV pages, and answers a page manifest. On success,
+        every decode attempt carries ``kv_source=<prefill url>`` so
+        the decode replica pulls those pages instead of
+        re-prefilling. EVERY failure — no usable prefill replica,
+        transport error, non-manifest answer, SKYTPU_DISAGG=0, the
+        client opting out with ``disagg: false`` — falls back to the
+        ordinary interleaved path: disaggregation can slow a request
+        down, never fail it."""
+        if not self.lb._prefill_urls:  # pylint: disable=protected-access
+            return
+        if not self.parsed.get('disagg', True):
+            return
+        if not self.lb._disagg_enabled():  # pylint: disable=protected-access
+            _M_DISAGG_FALLBACKS.inc(1, reason='disabled')
+            return
+        url = self.lb._pick_prefill()  # pylint: disable=protected-access
+        if url is None:
+            _M_DISAGG_FALLBACKS.inc(1, reason='no_prefill')
+            return
+        payload = dict(self.parsed)
+        payload['tokens'] = list(self.tokens)
+        payload['kv_prefill'] = True
+        payload['stream'] = False
+        payload['max_new'] = 1
+        payload.pop('disagg', None)
+        payload.pop('kv_source', None)
+        headers = self.lb._forward_headers(  # pylint: disable=protected-access
+            self.request, self.deadline,
+            drop=('content-type', 'content-length'))
+        # Distinct request id: the prefill half must not collide
+        # with the decode stream's id in any replica's duplicate
+        # detection (a mixed pool could see both).
+        headers[trace_lib.REQUEST_ID_HEADER] = self.req_id + '.pf'
+        sp = trace_lib.start_span('lb.disagg_prefill', replica=url,
+                                  prompt_len=len(self.tokens))
+        try:
+            assert self.lb._session is not None, 'start() not called'  # pylint: disable=protected-access
+            self.lb._poll_connect_fault(url, '/generate')  # pylint: disable=protected-access
+            # skytpu-lint: disable=STL012 — session-level bound, same
+            # rationale as _proxy_once: sock_read bounds replica
+            # silence; a long prefill is legitimate work.
+            async with self.lb._session.post(  # pylint: disable=protected-access
+                    url.rstrip('/') + '/generate', json=payload,
+                    headers=headers) as resp:
+                body = await resp.read()
+                if resp.status != 200:
+                    raise _DisaggPrefillError(
+                        f'prefill replica answered {resp.status}')
+                manifest = json.loads(body)
+                if not (isinstance(manifest, dict) and
+                        manifest.get('manifest')):
+                    raise _DisaggPrefillError(
+                        'prefill replica answered no manifest')
+            self.kv_source = url
+            self.lb._note_success(url)  # pylint: disable=protected-access
+            _M_DISAGG_HANDOFFS.inc()
+            sp.finish(ok=True,
+                      pages=len(manifest.get('hashes') or ()))
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                _DisaggPrefillError, ValueError) as e:
+            # The mid-handoff death path: the prefill replica was
+            # killed (or shed, or answered garbage) while the
+            # handoff was in flight. Fall back to interleaved —
+            # the request must survive, just without the handoff.
+            sp.finish(ok=False, error=str(e)[:200])
+            _M_DISAGG_FALLBACKS.inc(1, reason='prefill_error')
+            if isinstance(e, aiohttp.ClientConnectorError):
+                self.lb._note_failure(url, hard=True)  # pylint: disable=protected-access
+            elif isinstance(e, (aiohttp.ClientError,
+                                asyncio.TimeoutError)):
+                self.lb._note_failure(url)  # pylint: disable=protected-access
+            logger.warning(
+                'Disagg prefill handoff to %s failed (%s); falling '
+                'back to interleaved (trace=%s).', url, e,
+                self._trace_id)
+        finally:
+            if sp.end_time is None:
+                sp.finish(error='aborted')
+            self.lb.policy.done(url)
+            self.lb._note_neutral(url)  # pylint: disable=protected-access
+
     # ----------------------------------------------------------- run
     async def run(self) -> web.StreamResponse:
         attempts_left = self.lb.MAX_ATTEMPTS
         resume_budget = self.lb._resume_max()  # pylint: disable=protected-access
+        await self._maybe_prefill_handoff()
         while attempts_left > 0:
             attempts_left -= 1
             left = lifecycle.remaining(self.deadline)
@@ -1322,6 +1492,17 @@ class _SSEGenerateDriver:
                         resume_budget, self._trace_id)
                     return await self._finish_stream()
                 self.resumes += 1
+                if (self.kv_source is None and
+                        self.lb._resume_kv_enabled()):  # pylint: disable=protected-access
+                    # KV-assisted resume (docs/disaggregation.md):
+                    # point the resume attempt's kv_source at the
+                    # failing replica. A migration's doomed replica
+                    # is alive until the kill lands, so its published
+                    # pages are fetchable; a hard-dead replica makes
+                    # the fetch fail fast and the resume target
+                    # re-prefills exactly as before. Never overrides
+                    # a disagg prefill peer already in place.
+                    self.kv_source = fail_url
                 # One more attempt slot for the resume itself: the
                 # resume budget (SKYTPU_LB_RESUME_MAX) is the real
                 # bound, not the pre-stream attempt count.
@@ -1392,11 +1573,27 @@ class _SSEGenerateDriver:
             ttft = self._loop.time() - (up.started_at
                                         or attempt_started)
             self.lb._ttft_window.observe(ttft)  # pylint: disable=protected-access
+        # KV-transfer savings receipt (docs/disaggregation.md): the
+        # replica advertises how many prompt tokens its fetched pages
+        # cover BEFORE the first byte, so the reading is attempt-
+        # scoped and exact.
+        kv_reused = 0
+        if up.resp is not None:
+            raw = up.resp.headers.get('X-KV-Reused-Tokens')
+            if raw:
+                try:
+                    kv_reused = max(0, int(raw))
+                except ValueError:
+                    kv_reused = 0
+        if kv_reused:
+            sp.set_attr(kv_reused_tokens=kv_reused)
         if resume_sp is not None:
             # The resume span's duration IS the stream gap the client
             # saw between the dead replica's last token and the new
             # replica's first event.
-            resume_sp.finish(ok=True)
+            resume_sp.finish(ok=True, kv_reused_tokens=kv_reused)
+            if kv_reused:
+                _M_RESUME_KV.inc(kv_reused)
             _M_RESUMED.inc()
             logger.info('Stream resumed on %s after %d tokens '
                         '(trace=%s).', up.url, len(self.emitted),
@@ -1674,6 +1871,12 @@ class _NonStreamVerdict(Exception):
         super().__init__(f'replica verdict {status}')
         self.status = status
         self.response = response
+
+
+class _DisaggPrefillError(Exception):
+    """The disagg phase-0 prefill handoff produced no usable
+    manifest (non-200, or a 200 without one): fall back to the
+    interleaved path (docs/disaggregation.md)."""
 
 
 class _ClientGone(Exception):

@@ -76,6 +76,15 @@ class ServiceSpec:
     # under-protects interactive.
     class_target_ttft_p99_s: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    # Disaggregated prefill/decode (docs/disaggregation.md): a
+    # separate pool of prefill-role replicas the LB's disagg router
+    # hands prompts to (kv_prefill manifests + /kv/fetch exports);
+    # min/max_replicas above then size the decode pool. 0/None keeps
+    # the classic interleaved fleet. The SLO autoscaler scales the
+    # prefill pool on TTFT breaches and the decode pool on ITL
+    # breaches, independently.
+    min_prefill_replicas: int = 0
+    max_prefill_replicas: Optional[int] = None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -141,6 +150,12 @@ class ServiceSpec:
                 str(k): float(v)
                 for k, v in (policy.get('class_target_ttft_p99_s')
                              or {}).items()},
+            min_prefill_replicas=int(
+                policy.get('min_prefill_replicas', 0)),
+            max_prefill_replicas=(
+                int(policy['max_prefill_replicas'])
+                if policy.get('max_prefill_replicas') is not None
+                else None),
         )
         spec.validate()
         return spec
@@ -164,6 +179,14 @@ class ServiceSpec:
         makes the service an SLO-autoscaled one exactly like the
         aggregate targets do."""
         return dict(self.class_target_ttft_p99_s)
+
+    def disaggregated(self) -> bool:
+        """True when the service runs a prefill pool
+        (docs/disaggregation.md): the replica manager then launches
+        prefill-role replicas alongside the decode pool and the LB
+        routes tagged requests prefill→manifest→decode."""
+        return (self.min_prefill_replicas > 0 or
+                (self.max_prefill_replicas or 0) > 0)
 
     def validate(self) -> None:
         if self.min_replicas < 0:
@@ -229,6 +252,19 @@ class ServiceSpec:
         if self.spot_recovery_lead_time_s < 0:
             raise exceptions.InvalidTaskError(
                 'spot_recovery_lead_time_s must be >= 0')
+        if self.min_prefill_replicas < 0:
+            raise exceptions.InvalidTaskError(
+                'min_prefill_replicas must be >= 0')
+        if (self.max_prefill_replicas is not None and
+                self.max_prefill_replicas < self.min_prefill_replicas):
+            raise exceptions.InvalidTaskError(
+                'max_prefill_replicas must be >= '
+                'min_prefill_replicas')
+        if self.disaggregated() and self.min_replicas < 1:
+            raise exceptions.InvalidTaskError(
+                'a disaggregated service (min/max_prefill_replicas) '
+                'requires min_replicas >= 1: the decode pool streams '
+                'every response, so it can never be empty')
 
     def to_yaml_config(self) -> Dict[str, Any]:
         return {
@@ -257,6 +293,8 @@ class ServiceSpec:
                     self.dynamic_ondemand_fallback,
                 'spot_recovery_lead_time_s':
                     self.spot_recovery_lead_time_s,
+                'min_prefill_replicas': self.min_prefill_replicas,
+                'max_prefill_replicas': self.max_prefill_replicas,
             },
             'replica_port': self.replica_port,
             'load_balancing_policy': self.load_balancing_policy,

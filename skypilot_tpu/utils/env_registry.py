@@ -684,3 +684,46 @@ BENCH_QOS_MIN_GOODPUT_RATIO = register(
     'serve_qos bench gate: min interactive-class goodput of the '
     'QoS-on burst run over the same-seed burst-free baseline '
     '(default 0.9).')
+# --------------------------------------- disaggregated prefill/decode
+SKYTPU_KV_FETCH_MAX_BYTES = register(
+    'SKYTPU_KV_FETCH_MAX_BYTES',
+    'Byte budget of one POST /kv/fetch response (docs/'
+    'disaggregation.md): the replica packs whole prefix-cache pages '
+    'until the budget is spent; requested pages that do not fit are '
+    'simply absent (the requester re-prefills them). Default 64 MiB.')
+SKYTPU_KV_FETCH_TIMEOUT_S = register(
+    'SKYTPU_KV_FETCH_TIMEOUT_S',
+    'Client-side timeout in seconds for one KV page fetch against a '
+    'peer replica (serve/kv_transfer.py). On expiry the fetch raises '
+    'and the caller falls back to interleaved re-prefill. Default '
+    '10.')
+SKYTPU_DISAGG = register(
+    'SKYTPU_DISAGG',
+    'Kill switch for the LB\'s disaggregated prefill->decode router '
+    '(docs/disaggregation.md): 0 disables the handoff even when a '
+    'prefill pool is configured — every request runs interleaved on '
+    'the decode/mixed pool. Default on (any other value).')
+SKYTPU_LB_RESUME_KV = register(
+    'SKYTPU_LB_RESUME_KV',
+    'KV-assisted resume (docs/disaggregation.md): 1 (default) lets '
+    'the LB\'s mid-stream resume/migration attempts name the dying '
+    'replica as a kv_source, so the survivor fetches its published '
+    'prompt pages instead of re-prefilling prompt+emitted from '
+    'token 0. 0 restores the pure re-prefill resume path.')
+BENCH_DISAGG_REQUESTS = register(
+    'BENCH_DISAGG_REQUESTS',
+    'serve_disagg bench: requests in the long-prompt Zipf trace '
+    '(default 12 under BENCH_SMOKE, 32 otherwise).')
+BENCH_DISAGG_QPS = register(
+    'BENCH_DISAGG_QPS',
+    'serve_disagg bench: offered load in requests/second.')
+BENCH_DISAGG_SEED = register(
+    'BENCH_DISAGG_SEED',
+    'serve_disagg bench: seed for the workload trace AND the '
+    'mid-handoff prefill-replica kill (same seed => same trace '
+    'bytes and same kill time — the determinism receipt).')
+BENCH_DISAGG_MIN_RATIO = register(
+    'BENCH_DISAGG_MIN_RATIO',
+    'serve_disagg bench gate: minimum disagg-arm goodput over the '
+    'same-seed equal-chip interleaved baseline for the round to '
+    'report ok (default 0.9).')

@@ -128,6 +128,12 @@ KNOWN_SITES = (
     # into its own queue. Deterministic chaos isolation tests without
     # a load generator in the loop.
     'engine.tenant.burst',
+    # Disaggregated prefill/decode (docs/disaggregation.md): polled
+    # by the KV page fetch client (serve/kv_transfer.py) before each
+    # peer fetch — connect_failure severs the prefill->decode handoff
+    # (the caller falls back to interleaved re-prefill), hang stalls
+    # it params['seconds'].
+    'serve.kv.fetch',
 )
 
 # Default exit code for `crash` faults: distinctive in wait statuses,

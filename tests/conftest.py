@@ -72,7 +72,8 @@ _LATE_FILES = ('test_prefix_cache.py', 'test_managed_jobs.py',
                'test_decode_attention.py', 'test_request_lifecycle.py',
                'test_server_load.py', 'test_fleet.py',
                'test_loadgen.py', 'test_recovery_strategy.py',
-               'test_qos.py', 'test_mesh_fastpath.py')
+               'test_qos.py', 'test_mesh_fastpath.py',
+               'test_kv_transfer.py')
 
 # The three most expensive files (>100 s each, measured) run at the
 # very end: bench smoke subprocesses, the failover/spot suites' real

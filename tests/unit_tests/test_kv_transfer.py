@@ -1,0 +1,388 @@
+"""Disaggregated prefill/decode (docs/disaggregation.md): SKKV1 wire
+roundtrip (quant on and off, bitwise), /kv/fetch + kv_prefill manifest
+semantics over real HTTP servers, fetch-failure fallback parity, the
+``serve.kv.fetch`` chaos site severing a handoff mid-flight, the
+role-aware autoscaler pool split, and the no-recompile-after-warmup
+invariant with remote page imports in the mix.
+
+Engine tests use small page/chunk sizes (page=8, chunk=8) so tiny
+prompts span several transferable pages; every greedy output is
+pinned against the solo ``inference.generate`` oracle — the same bar
+the prefix-cache suite sets.
+"""
+import asyncio
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.models import prefix_cache as prefix_mod
+from skypilot_tpu.models.serving_engine import Request, ServingEngine
+from skypilot_tpu.serve import kv_transfer
+from skypilot_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.kvtransfer
+
+
+def _setup(seed=0, **cfg_kw):
+    cfg = models.LlamaConfig.tiny(**cfg_kw)
+    params = models.init_params(cfg, jax.random.PRNGKey(seed))
+    return cfg, params
+
+
+def _prompt(cfg, n, seed):
+    key = jax.random.PRNGKey(seed)
+    return [int(t) for t in np.asarray(
+        jax.random.randint(key, (n,), 0, cfg.vocab_size))]
+
+
+def _solo_generate(params, cfg, prompt, max_new):
+    out = inference.generate(
+        params, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cfg, max_new=max_new)
+    return list(np.asarray(out[0]))
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault('batch_size', 2)
+    kw.setdefault('max_prompt', 32)
+    kw.setdefault('max_seq', 96)
+    kw.setdefault('decode_chunk', 4)
+    kw.setdefault('prefill_chunk', 8)
+    kw.setdefault('prefill_budget', 16)
+    kw.setdefault('page', 8)
+    kw.setdefault('prefix_cache', True)
+    kw.setdefault('prefix_pool_pages', 16)
+    return ServingEngine(params, cfg, **kw)
+
+
+def _counter(name):
+    return sum(v for k, v in metrics_lib.summary().items()
+               if k == name or k.startswith(name + '{'))
+
+
+def _publish_pages(eng, prompt):
+    """Run one request to completion so its full pages land in the
+    pool, and return their chain hashes."""
+    res = eng.run([Request('pub', list(prompt), max_new=2)])
+    assert res['pub'].status == 'finished'
+    n_full = len(prompt) // eng.prefix.page
+    hashes = prefix_mod.page_hashes(
+        list(prompt)[:n_full * eng.prefix.page], eng.prefix.page)
+    assert hashes and all(
+        eng.prefix.export_page(h) is not None for h in hashes)
+    return hashes
+
+
+# ------------------------------------------------------ wire format
+
+
+@pytest.mark.parametrize('kv_quant', [False, True],
+                         ids=['bf16', 'int8'])
+def test_wire_roundtrip_bitwise(kv_quant):
+    """encode/decode is the identity on exported pages — every field
+    (including the int8 scale planes) byte-for-byte — and pack_pages
+    produces exactly that encoding for the hashes the pool holds."""
+    cfg, params = _setup()
+    eng = _engine(params, cfg, kv_quant=kv_quant)
+    prompt = _prompt(cfg, 20, 11)
+    hashes = _publish_pages(eng, prompt)
+    cache = eng.prefix
+    sig = cache.page_signature()
+    if kv_quant:
+        # Quantized pools carry the scale planes as first-class wire
+        # fields — a page without them would dequantize to garbage.
+        assert any('scale' in f for f in sig['fields'])
+
+    exported = [(h, cache.export_page(h)) for h in hashes]
+    data = kv_transfer.encode(sig, exported)
+    got_sig, got_pages = kv_transfer.decode(data)
+    assert got_sig == sig
+    assert [h for h, _ in got_pages] == hashes
+    for (h, blk), (gh, gblk) in zip(exported, got_pages):
+        assert set(gblk) == set(sig['fields'])
+        for f in gblk:
+            want = np.asarray(blk[f],
+                              dtype=np.dtype(sig['fields'][f]['dtype']))
+            assert want.tobytes() == gblk[f].tobytes(), (h.hex(), f)
+
+    # pack_pages == encode(export): the /kv/fetch body is the same
+    # canonical bytes, with unknown hashes silently skipped.
+    packed = kv_transfer.pack_pages(
+        cache, [h.hex() for h in hashes] + ['ab' * 16, 'not-hex'])
+    assert packed == data
+    # A zero budget packs zero pages but still a valid payload.
+    _, empty = kv_transfer.decode(
+        kv_transfer.pack_pages(cache, [hashes[0].hex()], max_bytes=1))
+    assert empty == []
+
+    # Malformations raise WireError, never return wrong bytes.
+    with pytest.raises(kv_transfer.WireError):
+        kv_transfer.decode(b'NOPE' + data)
+    with pytest.raises(kv_transfer.WireError):
+        kv_transfer.decode(data[:-3])          # truncated payload
+    corrupt = bytearray(data)
+    corrupt[-1] ^= 0xFF                        # checksum mismatch
+    with pytest.raises(kv_transfer.WireError):
+        kv_transfer.decode(bytes(corrupt))
+
+
+# ------------------------- manifest / fetch / fallback over real HTTP
+
+
+def test_manifest_fetch_import_fallback_and_chaos():
+    """The full disaggregated handoff against two real EngineServers:
+    kv_prefill returns a page manifest (and publishes the pages),
+    /kv/fetch serves them bit-exact, a decode-side generate with
+    kv_source imports them (X-KV-Reused-Tokens) and stays bitwise
+    equal to the solo oracle; a dead peer and an injected
+    ``serve.kv.fetch`` connect failure both degrade to local
+    re-prefill with identical tokens."""
+    from skypilot_tpu.models.serving_http import EngineServer
+
+    cfg, params = _setup()
+    eng_a = _engine(params, cfg)
+    eng_b = _engine(params, cfg)
+    server_a = EngineServer(eng_a)
+    server_b = EngineServer(eng_b)
+    server_a.set_role('prefill')
+    server_b.set_role('decode')
+
+    p1 = _prompt(cfg, 20, 21)      # 2 full pages + 4-token tail
+    p2 = _prompt(cfg, 17, 22)
+    p3 = _prompt(cfg, 19, 23)
+    oracle = {1: _solo_generate(params, cfg, p1, 4),
+              2: _solo_generate(params, cfg, p2, 4),
+              3: _solo_generate(params, cfg, p3, 4)}
+
+    async def wait_ready(session, url):
+        for _ in range(600):
+            try:
+                async with session.get(url + '/health') as r:
+                    if r.status == 200:
+                        return await r.json()
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.1)
+        raise TimeoutError(f'{url} never became ready')
+
+    async def sse(session, url, body):
+        """POST a streaming generate; return (headers, final_event)."""
+        async with session.post(url + '/generate', json=body) as resp:
+            assert resp.status == 200, await resp.text()
+            headers = dict(resp.headers)
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith('data:'):
+                    continue
+                event = __import__('json').loads(line[len('data:'):])
+                if event.get('done'):
+                    return headers, event
+        raise AssertionError('stream ended without a done event')
+
+    async def scenario():
+        runner_a = await server_a.start(0)
+        runner_b = await server_b.start(0)
+        url_a = f'http://127.0.0.1:{runner_a.addresses[0][1]}'
+        url_b = f'http://127.0.0.1:{runner_b.addresses[0][1]}'
+        out = {}
+        async with aiohttp.ClientSession() as s:
+            health_a = await wait_ready(s, url_a)
+            await wait_ready(s, url_b)
+            out['health_a'] = health_a
+
+            # Prefill half: manifest, not a stream.
+            async with s.post(url_a + '/generate',
+                              json={'tokens': p1, 'max_new': 4,
+                                    'kv_prefill': True}) as r:
+                assert r.status == 200, await r.text()
+                out['manifest'] = await r.json()
+
+            # The advertised pages are fetchable, bit-exact.
+            async with s.post(url_a + '/kv/fetch',
+                              json={'hashes':
+                                    out['manifest']['hashes']}) as r:
+                assert r.status == 200
+                out['payload'] = await r.read()
+
+            # Decode half: pull pages from A, stream, greedy parity.
+            pre = _counter('skytpu_engine_prefix_pages_imported_total')
+            out['h1'], out['e1'] = await sse(
+                s, url_b, {'tokens': p1, 'max_new': 4, 'stream': True,
+                           'kv_source': url_a})
+            out['imported'] = _counter(
+                'skytpu_engine_prefix_pages_imported_total') - pre
+
+            # Fallback 1: dead peer — fetch fails, request succeeds.
+            out['h2'], out['e2'] = await sse(
+                s, url_b, {'tokens': p2, 'max_new': 4, 'stream': True,
+                           'kv_source': 'http://127.0.0.1:9'})
+
+            # Fallback 2: mid-handoff chaos — the serve.kv.fetch site
+            # severs the transfer before it touches the network.
+            pre_inj = _counter('skytpu_kv_fetches_total'
+                               '{outcome="injected"}')
+            with fi.fault_plan(faults=[{'site': 'serve.kv.fetch',
+                                        'kind': 'connect_failure',
+                                        'times': 1}]):
+                out['h3'], out['e3'] = await sse(
+                    s, url_b, {'tokens': p3, 'max_new': 4,
+                               'stream': True, 'kv_source': url_a})
+            out['injected'] = _counter(
+                'skytpu_kv_fetches_total{outcome="injected"}') - pre_inj
+        await runner_a.cleanup()
+        await runner_b.cleanup()
+        return out
+
+    try:
+        out = asyncio.run(scenario())
+    finally:
+        server_a.stop()
+        server_b.stop()
+
+    # /health advertises role + prefix summary (satellite surface the
+    # disagg router and cache-aware routing scrape).
+    assert out['health_a']['role'] == 'prefill'
+    assert out['health_a']['prefix']['page'] == 8
+    assert isinstance(out['health_a']['prefix']['sample'], list)
+
+    m = out['manifest']
+    assert m['manifest'] is True and m['page'] == 8
+    assert m['prompt_len'] == len(p1) and m['status'] == 'finished'
+    assert m['hashes'] == [
+        h.hex() for h in prefix_mod.page_hashes(p1[:16], 8)]
+    assert m['sig'] == eng_a.prefix.page_signature()
+    # The manifest's single decode step is the oracle's first token.
+    assert m['tokens'] == oracle[1][:1]
+
+    sig, pages = kv_transfer.decode(out['payload'])
+    assert sig == eng_a.prefix.page_signature()
+    assert [h.hex() for h, _ in pages] == m['hashes']
+
+    # Decode-side import: both full pages landed and were reused.
+    assert out['imported'] == 2
+    assert out['h1'].get('X-KV-Reused-Tokens') == '16'
+    assert out['e1']['tokens'] == oracle[1]
+
+    # Fallbacks: no reuse header, bitwise-identical output anyway.
+    for key, hkey, want in (('e2', 'h2', oracle[2]),
+                            ('e3', 'h3', oracle[3])):
+        assert out[key]['status'] == 'finished'
+        assert out[key]['tokens'] == want
+        assert 'X-KV-Reused-Tokens' not in out[hkey]
+    assert out['injected'] == 1
+
+
+# ------------------------------------------- role-aware SLO autoscaler
+
+
+def _slo_spec(**kw):
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+    base = dict(min_replicas=2, max_replicas=8,
+                target_ttft_p99_s=1.0, target_itl_p99_s=0.1,
+                slo_upscale_delay_seconds=30)
+    base.update(kw)
+    spec = ServiceSpec(**base)
+    spec.validate()
+    return spec
+
+
+def _feed(a, ttft, itl, t0=100, t1=400):
+    d = None
+    for t in range(t0, t1, 10):
+        a.observe_replica('http://r1',
+                          {'skytpu_engine_ttft_p99_seconds': ttft,
+                           'skytpu_engine_itl_p99_seconds': itl},
+                          now=float(t))
+        d = a.evaluate(2, now=float(t))
+    return d
+
+
+def test_autoscaler_scales_pools_independently():
+    """Disaggregated: TTFT breaches grow ONLY the prefill pool, ITL
+    breaches ONLY the decode pool; non-disaggregated behavior is
+    unchanged (pool fields stay None)."""
+    from skypilot_tpu.serve import autoscalers
+
+    spec = _slo_spec(min_prefill_replicas=1, max_prefill_replicas=4)
+    a = autoscalers.make_autoscaler(spec)
+    assert type(a).__name__ == 'SLOAutoscaler'
+    d = a.evaluate(2, now=50.0)
+    assert (d.num_prefill, d.num_decode) == (1, d.target_replicas)
+
+    d = _feed(a, ttft=5.0, itl=0.01)     # prefill-side pressure only
+    assert d.num_prefill == 4            # clamped at max_prefill
+    assert d.num_decode == d.target_replicas == 2
+
+    b = autoscalers.make_autoscaler(spec)
+    d = _feed(b, ttft=0.1, itl=5.0)      # decode-side pressure only
+    assert d.num_prefill == 1
+    assert d.num_decode == d.target_replicas == 8
+
+    c = autoscalers.make_autoscaler(_slo_spec())   # classic service
+    d = _feed(c, ttft=5.0, itl=0.01)
+    assert d.target_replicas == 8        # TTFT drives the one pool
+    assert d.num_prefill is None and d.num_decode is None
+
+
+def test_autoscaler_prefill_state_survives_restore():
+    from skypilot_tpu.serve import autoscalers
+
+    spec = _slo_spec(min_prefill_replicas=1, max_prefill_replicas=4)
+    a = autoscalers.make_autoscaler(spec)
+    _feed(a, ttft=5.0, itl=0.01)
+    fresh = autoscalers.make_autoscaler(spec)
+    fresh.restore(a.to_state())
+    d = fresh.evaluate(2, now=401.0)
+    assert d.num_prefill == 4            # scaled target, not the floor
+
+
+def test_service_spec_prefill_pool_roundtrip_and_validation():
+    from skypilot_tpu.serve.service_spec import ServiceSpec
+
+    spec = _slo_spec(min_prefill_replicas=1, max_prefill_replicas=4)
+    assert spec.disaggregated()
+    again = ServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again == spec
+    assert not _slo_spec().disaggregated()
+    with pytest.raises(ValueError):
+        _slo_spec(min_prefill_replicas=-1)
+    with pytest.raises(ValueError):
+        _slo_spec(min_prefill_replicas=3, max_prefill_replicas=2)
+
+
+# --------------------------------------- no-recompile with KV imports
+
+
+def test_no_recompile_after_warmup_with_imports():
+    """Remote-page import rides pinned copy-in programs: after
+    warmup, importing peer pages and serving a request that reuses
+    them compiles ZERO new programs — and the reused stream is
+    bitwise the solo oracle."""
+    cfg, params = _setup()
+    producer = _engine(params, cfg)
+    prompt = _prompt(cfg, 20, 31)
+    hashes = _publish_pages(producer, prompt)
+    items = [(h, producer.prefix.export_page(h)) for h in hashes]
+
+    consumer = _engine(params, cfg)
+    consumer.warmup()
+    sizes = (consumer._decode._cache_size(),
+             consumer._mixed._cache_size(),
+             *consumer.prefix.compile_cache_sizes(),
+             *consumer.prefix.import_compile_cache_size())
+    assert consumer.queue_kv_import(items)
+    res = consumer.run([Request('r', list(prompt), max_new=4)])
+    assert res['r'].status == 'finished'
+    assert res['r'].tokens == _solo_generate(params, cfg, prompt, 4)
+    assert consumer.prefix.hits >= 1     # the imported pages hit
+    after = (consumer._decode._cache_size(),
+             consumer._mixed._cache_size(),
+             *consumer.prefix.compile_cache_sizes(),
+             *consumer.prefix.import_compile_cache_size())
+    assert after == sizes, (sizes, after)
