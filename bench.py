@@ -2346,6 +2346,424 @@ def serve_disagg_bench():
     return 0 if ok else 1
 
 
+def serve_affinity_bench():
+    """Cache-aware routing bench (docs/affinity_routing.md): the same
+    seeded Zipf shared-prefix trace replayed at EQUAL chip count
+    through two real CPU replica pools — two replicas behind the
+    least-load LB (the cache-oblivious baseline and greedy-parity
+    oracle) and two behind ``prefix_affinity``, with the LB's prefix
+    summaries fed on a probe-cadence task from each replica's own
+    /health digest (exactly the controller's wiring). A third round
+    replays the trace against the affinity pool again and scales up
+    mid-trace: a cold replica is spawned, peer-warmed from the
+    hottest donor over the real ``/kv/warm`` -> ``/kv/fetch`` wire
+    path, proven to serve a warmed-page hit BEFORE joining the pool,
+    then added to the LB.
+
+    Gates (exit nonzero unless ALL hold): fleet-wide prefix hit-rate
+    AND goodput of the affinity arm >= ``BENCH_AFFINITY_MIN_RATIO`` x
+    the least-load arm, every finished affinity stream is bitwise
+    identical to the least-load oracle (routing must never change
+    tokens), the scaled-up replica imports >= 1 page and serves >= 1
+    hit on a warmed page while it has served nothing else, the LB's
+    own affinity-hit counter moved, and no inflight sample ever
+    exceeds the imbalance guard's cap (max <= skew x mean + 1 read
+    slack). Same BENCH_AFFINITY_SEED => byte-identical trace and
+    scale-up time.
+    """
+    import asyncio
+    import subprocess
+    import tempfile
+
+    import aiohttp
+
+    from skypilot_tpu import loadgen
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve.load_balancer import LoadBalancer
+    from skypilot_tpu.utils import chain_hash
+    from skypilot_tpu.utils import env_registry
+
+    smoke = os.environ.get('BENCH_SMOKE') == '1'
+    seed = int(os.environ.get('BENCH_AFFINITY_SEED', '0'))
+    min_ratio = float(os.environ.get('BENCH_AFFINITY_MIN_RATIO',
+                                     '1.0'))
+    n_requests = int(os.environ.get('BENCH_AFFINITY_REQUESTS',
+                                    '16' if smoke else '48'))
+    qps = float(os.environ.get('BENCH_AFFINITY_QPS',
+                               '3' if smoke else '4'))
+    skew = max(1.0, float(os.environ.get(
+        env_registry.SKYTPU_AFFINITY_MAX_SKEW, '2.0')))
+    warm_budget = int(os.environ.get(
+        env_registry.SKYTPU_WARM_MAX_PAGES, '64'))
+    slo = loadgen.SLO(
+        ttft_s=float(os.environ.get('BENCH_LOAD_SLO_TTFT', '10')),
+        itl_p99_s=float(os.environ.get('BENCH_LOAD_SLO_ITL', '5')))
+    # Same replica shape as the disagg bench: page 16 so the shared
+    # 32-token prefixes span exactly 2 transferable/hashable pages.
+    page, max_prompt, max_seq = 16, 128, 160
+    prefix_len = 32
+    spec = loadgen.long_prompt(
+        seed=seed, n_requests=n_requests, qps=qps,
+        vocab_size=256,                  # LlamaConfig.tiny vocab
+        prompt_median=48, prompt_sigma=0.4,
+        prompt_min=32, prompt_max=96,
+        output_median=6, output_sigma=0.3,
+        output_min=4, output_max=16,
+        n_prefixes=4, prefix_len=prefix_len)
+    trace = loadgen.generate(spec)
+    trace_digest = loadgen.digest(trace)
+    by_id = {r.request_id: r for r in trace}
+    span = max(r.arrival_s for r in trace)
+    # One seeded mid-trace scale-up instant — late enough that the
+    # donor pool has published the hot prefixes, early enough that
+    # routed traffic still reaches the warmed newcomer.
+    import random as _random
+    scale_at = span * (0.4 + 0.2 * _random.Random(seed + 7).random())
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-affinity-')
+    replica_plan = json.dumps({'faults': [
+        {'site': 'engine.tick.hang', 'kind': 'hang', 'times': None,
+         'params': {'seconds': 0.05}}]})
+    base_port = int(os.environ.get('SKYTPU_SERVE_PORT', '19381'))
+    # Process layout: 0,1 = least-load pool; 2,3 = affinity pool
+    # (disjoint so BOTH arms start with cold caches); 4 = the
+    # scale-up replica, spawned cold mid-round-3.
+    SCALEUP = 4
+
+    def spawn(i):
+        env = dict(os.environ)
+        env['JAX_PLATFORMS'] = 'cpu'
+        env['SKYTPU_FAULT_PLAN'] = replica_plan
+        env['SKYTPU_DECODE_PAGE'] = str(page)
+        env.pop('PALLAS_AXON_POOL_IPS', None)
+        log = open(os.path.join(tmp, f'replica{i}.log'), 'wb')
+        return subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.models.serving_http',
+             '--port', str(base_port + i), '--model', 'tiny',
+             '--batch', '4', '--max-prompt', str(max_prompt),
+             '--max-seq', str(max_seq), '--decode-chunk', '1',
+             '--prefill-chunk', str(page), '--prefill-budget', '32',
+             '--max-pending', '64', '--prefix-cache',
+             '--prefix-pool-pages', '64', '--role', 'mixed'],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+
+    procs = {i: spawn(i) for i in range(4)}
+    urls = {i: f'http://127.0.0.1:{base_port + i}'
+            for i in range(5)}
+
+    def counter_sum(summary, name):
+        return sum(v for k, v in summary.items()
+                   if k == name or k.startswith(name + '{'))
+
+    async def wait_ready(targets):
+        deadline = time.time() + 240
+        async with aiohttp.ClientSession() as s:
+            for url in targets:
+                while True:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f'replica {url} never became ready')
+                    try:
+                        async with s.get(
+                                url + '/health',
+                                timeout=aiohttp.ClientTimeout(
+                                    total=2)) as r:
+                            if r.status == 200:
+                                break
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError, OSError):
+                        pass
+                    await asyncio.sleep(0.25)
+
+    async def scrape_health_prefix(session, url):
+        try:
+            async with session.get(
+                    url + '/health',
+                    timeout=aiohttp.ClientTimeout(total=2)) as r:
+                if r.status != 200:
+                    return None
+                return (await r.json()).get('prefix')
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                OSError, ValueError):
+            return None
+
+    def scrape_counters(url, names):
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    url + '/metrics', timeout=5) as resp:
+                text = resp.read().decode('utf-8', 'replace')
+            values = metrics_lib.parse_values(text)
+            return {n: counter_sum(values, n) for n in names}
+        except (OSError, ValueError):
+            return {n: 0.0 for n in names}
+
+    HITS = 'skytpu_engine_prefix_hits_total'
+    SAVED = 'skytpu_engine_prefix_tokens_saved_total'
+    IMPORTED = 'skytpu_engine_prefix_pages_imported_total'
+
+    def fleet_hits(pool):
+        return sum(scrape_counters(urls[i], (HITS,))[HITS]
+                   for i in pool)
+
+    async def push_summaries(session, lb, pool_urls):
+        # The controller's probe-cadence wiring, miniaturized: the
+        # policy only ever sees what /health already advertised.
+        summaries = {}
+        for u in pool_urls:
+            digest = await scrape_health_prefix(session, u)
+            if digest is not None:
+                summaries[u] = digest
+        lb.update_prefix_summaries(summaries)
+
+    async def run_round(pool, affinity=False, scaleup=None):
+        """Replay the trace through an in-process LB over ``pool``.
+        ``scaleup`` (round 3) = dict collecting the warm receipts;
+        its presence arms the mid-trace scale-up task."""
+        lb = LoadBalancer(
+            port=0,
+            policy='prefix_affinity' if affinity else 'least_load')
+        await lb.start()
+        pool_urls = [urls[i] for i in pool]
+        lb.set_replica_urls(list(pool_urls))
+        base = f'http://127.0.0.1:{lb.bound_port}'
+        stop = asyncio.Event()
+        skew_stats = {'samples': 0, 'max_ratio': 0.0,
+                      'violations': 0}
+
+        async def cadence_task():
+            async with aiohttp.ClientSession() as s:
+                while not stop.is_set():
+                    await push_summaries(s, lb, list(pool_urls))
+                    try:
+                        await asyncio.wait_for(stop.wait(),
+                                               timeout=0.5)
+                    except asyncio.TimeoutError:
+                        pass
+
+        async def skew_task():
+            while not stop.is_set():
+                loads = [lb.inflight(u) for u in pool_urls]
+                mean = sum(loads) / max(1, len(loads))
+                if mean > 0:
+                    ratio = max(loads) / mean
+                    skew_stats['samples'] += 1
+                    skew_stats['max_ratio'] = max(
+                        skew_stats['max_ratio'], ratio)
+                    # +1.0 absorbs the unlocked multi-gauge read
+                    # racing a concurrent pick/done.
+                    if max(loads) > skew * mean + 1.0:
+                        skew_stats['violations'] += 1
+                await asyncio.sleep(0.05)
+
+        async def scaleup_task():
+            await asyncio.sleep(scale_at)
+            procs[SCALEUP] = spawn(SCALEUP)
+            await wait_ready([urls[SCALEUP]])
+            async with aiohttp.ClientSession() as s:
+                digests = {u: await scrape_health_prefix(s, u)
+                           for u in pool_urls}
+            # Hottest donor = most advertised pages (ties -> lowest
+            # URL, deterministic), same rule the replica manager
+            # applies on STARTING->READY.
+            ranked = sorted(
+                ((len((d or {}).get('hashes', ())), u)
+                 for u, d in digests.items()), reverse=True)
+            donor = ranked[0][1]
+            want = list((digests[donor] or {}).get('hashes', ()))
+            # Put the modal prefix's chain first so the warmed-hit
+            # probe below is guaranteed to target warmed pages.
+            probe_chain = [
+                h.hex() for h in chain_hash.page_hashes(
+                    probe_prefix, page)]
+            want = (probe_chain +
+                    [h for h in want if h not in set(probe_chain)])
+            want = want[:max(0, warm_budget)]
+            imported = await asyncio.to_thread(
+                replica_managers.peer_warm, urls[SCALEUP], donor,
+                want)
+            scaleup['donor'] = donor
+            scaleup['warm_requested'] = len(want)
+            scaleup['warm_imported'] = imported
+            # Warmed-page-hit receipt, airtight: BEFORE the newcomer
+            # joins the pool its cache holds ONLY warmed pages, so a
+            # prefix hit on this direct probe request can only come
+            # from them.
+            before = await asyncio.to_thread(
+                scrape_counters, urls[SCALEUP],
+                (HITS, SAVED, IMPORTED))
+            probe = loadgen.TraceRequest(
+                request_id=9000, arrival_s=0.0,
+                tokens=list(probe_prefix) + [7, 11, 13, 17],
+                max_new=4)
+            await loadgen.replay_http_async(
+                urls[SCALEUP], [probe], timeout_s=120)
+            after = await asyncio.to_thread(
+                scrape_counters, urls[SCALEUP],
+                (HITS, SAVED, IMPORTED))
+            scaleup['probe_hit_delta'] = after[HITS] - before[HITS]
+            scaleup['probe_tokens_saved'] = (after[SAVED] -
+                                             before[SAVED])
+            scaleup['pages_imported'] = after[IMPORTED]
+            pool_urls.append(urls[SCALEUP])
+            lb.set_replica_urls(list(pool_urls))
+
+        tasks = []
+        if affinity:
+            tasks.append(asyncio.ensure_future(cadence_task()))
+            tasks.append(asyncio.ensure_future(skew_task()))
+        if scaleup is not None:
+            tasks.append(asyncio.ensure_future(scaleup_task()))
+        try:
+            records, wall = await loadgen.replay_http_async(
+                base, trace, timeout_s=240, keep_tokens=True)
+        finally:
+            stop.set()
+            for t in tasks:
+                try:
+                    # The scale-up task may still be mid-warm when
+                    # the replay drains; let it land its receipts.
+                    await asyncio.wait_for(t, timeout=300)
+                except Exception:  # pylint: disable=broad-except
+                    t.cancel()
+            await lb.stop()
+        return records, wall, skew_stats
+
+    # The modal (Zipf rank 0) shared prefix: every trace request
+    # tagged prefix_rank=0 starts with these prefix_len tokens.
+    probe_prefix = next(
+        list(r.tokens[:prefix_len]) for r in trace
+        if r.prefix_rank == 0)
+
+    scaleup_receipts = {}
+    try:
+        asyncio.run(wait_ready([urls[i] for i in range(4)]))
+        with _bench_span('serve_affinity', requests=n_requests,
+                         qps=qps):
+            base_hits0 = fleet_hits((0, 1))
+            base_records, base_wall, _ = asyncio.run(
+                run_round(pool=(0, 1)))
+            for r in base_records:
+                r.arm = 'least_load'
+            base_hits = fleet_hits((0, 1)) - base_hits0
+            pre = metrics_lib.summary()
+            aff_hits0 = fleet_hits((2, 3))
+            aff_records, aff_wall, aff_skew = asyncio.run(
+                run_round(pool=(2, 3), affinity=True))
+            for r in aff_records:
+                r.arm = 'affinity'
+            aff_hits = fleet_hits((2, 3)) - aff_hits0
+            mid = metrics_lib.summary()
+            scale_records, scale_wall, scale_skew = asyncio.run(
+                run_round(pool=(2, 3), affinity=True,
+                          scaleup=scaleup_receipts))
+            for r in scale_records:
+                r.arm = 'affinity_scaleup'
+            post = metrics_lib.summary()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    # Per-arm goodput over a shared wall clock (equal-chip rounds
+    # only — the scale-up round has an extra replica for its tail, so
+    # it gates on parity + warm receipts, not on the ratio).
+    ab = loadgen.score(base_records + aff_records, slo,
+                       max(base_wall, aff_wall))
+    scale_report = loadgen.score(scale_records, slo, scale_wall)
+
+    # Greedy-parity oracle: routing policy must never change tokens.
+    base_tokens = {r.request_id: r.tokens for r in base_records
+                   if r.status == 'finished' and r.tokens is not None}
+    checked = mismatched = 0
+    for rec in list(aff_records) + list(scale_records):
+        if rec.status != 'finished':
+            continue
+        oracle = base_tokens.get(rec.request_id)
+        if oracle is None:
+            continue
+        checked += 1
+        if rec.tokens != oracle:
+            mismatched += 1
+            print(f'# PARITY MISMATCH request {rec.request_id} '
+                  f'({rec.arm}): got={rec.tokens} oracle={oracle}',
+                  file=sys.stderr)
+    length_bad = sum(
+        1 for rec in list(aff_records) + list(scale_records)
+        if rec.status == 'finished' and rec.tokens is not None and
+        len(rec.tokens) != by_id[rec.request_id].max_new)
+
+    def delta(a, b, name):
+        return counter_sum(b, name) - counter_sum(a, name)
+
+    lb_aff_hits = delta(pre, mid, 'skytpu_lb_affinity_hits_total')
+    lb_aff_tokens = delta(pre, mid,
+                          'skytpu_lb_affinity_matched_tokens_total')
+    lb_overrides = delta(pre, post,
+                         'skytpu_lb_affinity_overrides_total')
+    warmed_pages = delta(mid, post,
+                         'skytpu_serve_warmed_pages_total')
+
+    arms = ab.get('arms', {})
+    base_good = arms.get('least_load', {}).get('goodput_req_s', 0.0)
+    aff_good = arms.get('affinity', {}).get('goodput_req_s', 0.0)
+    good_ratio = (aff_good / base_good if base_good > 0 else
+                  (1.0 if aff_good == base_good else 0.0))
+    base_rate = base_hits / max(1, n_requests)
+    aff_rate = aff_hits / max(1, n_requests)
+    hit_ratio = (aff_rate / base_rate if base_rate > 0 else
+                 (999.0 if aff_rate > 0 else 1.0))
+    skew_violations = (aff_skew['violations'] +
+                       scale_skew['violations'])
+    ok = (good_ratio >= min_ratio and hit_ratio >= min_ratio
+          and mismatched == 0 and length_bad == 0
+          and lb_aff_hits >= 1
+          and scaleup_receipts.get('warm_imported', 0) >= 1
+          and scaleup_receipts.get('probe_hit_delta', 0) >= 1
+          and warmed_pages >= 1
+          and skew_violations == 0)
+    result = {
+        'metric': 'llama_serve_affinity_hit_ratio',
+        'value': round(hit_ratio, 4),
+        'unit': 'affinity/least-load fleet prefix hit-rate',
+        'vs_baseline': round(good_ratio, 4),
+        'detail': {
+            'ok': ok,
+            'seed': seed,
+            'min_ratio': min_ratio,
+            'trace_sha256': trace_digest,
+            'schedule_head_s': [round(r.arrival_s, 6)
+                                for r in trace[:8]],
+            'scale_at_s': round(scale_at, 4),
+            'goodput_ratio': round(good_ratio, 4),
+            'fleet_hit_rate': {'least_load': round(base_rate, 4),
+                               'affinity': round(aff_rate, 4)},
+            'lb_affinity_hits': lb_aff_hits,
+            'lb_affinity_matched_tokens': lb_aff_tokens,
+            'lb_affinity_overrides': lb_overrides,
+            'warmed_pages_total': warmed_pages,
+            'scaleup': scaleup_receipts,
+            'skew': {'bound': skew,
+                     'clean_round': aff_skew,
+                     'scaleup_round': scale_skew,
+                     'violations': skew_violations},
+            'ab': ab,
+            'scaleup_score': scale_report,
+            'parity': {'checked': checked,
+                       'mismatched': mismatched,
+                       'length_mismatches': length_bad},
+            'metrics': metrics_lib.summary(),
+        },
+    }
+    merged = _merged_trace_path()
+    if merged:
+        result['detail']['span_trace_file'] = merged
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 # One subprocess per mode: every bench assumes a fresh chip (HBM
 # fragmentation from a previous mode would contaminate timings), and
 # a crash in one mode must not take down the rest.
@@ -2493,6 +2911,11 @@ _ALL_MODES = {
     # mid-run prefill-replica kill absorbed by the interleaved
     # fallback. CPU replicas — no device.
     'serve_disagg': {'BENCH_MODE': 'serve_disagg'},
+    # Cache-aware routing (docs/affinity_routing.md): fleet prefix
+    # hit-rate + goodput, affinity vs least-load at equal chips,
+    # with a mid-trace peer-warmed scale-up. CPU replicas — no
+    # device.
+    'serve_affinity': {'BENCH_MODE': 'serve_affinity'},
     # Control-plane scale (docs/control_plane.md): lease-fleet
     # throughput on the synthetic cloud — jobs/s settled,
     # time-to-reconcile after a worker kill, lease churn. No device.
@@ -2598,7 +3021,7 @@ def _probe_once(timeout_s: float) -> tuple:
 
 
 def _probe_device(timeout_s: float, attempts: int,
-                  probe_fn=None) -> 'dict | None':
+                  probe_fn=None, clock=None) -> 'dict | None':
     """Run the device probe under a bounded RetryPolicy; returns None
     on success or the ``bench_error`` detail dict after exhausting
     the budget. The r05 round died with a bare 'probe did not
@@ -2621,7 +3044,7 @@ def _probe_device(timeout_s: float, attempts: int,
     policy = retry_lib.RetryPolicy(
         max_attempts=attempts, initial_backoff=2.0, max_backoff=15.0,
         multiplier=2.0, jitter='none', deadline=timeout_s * 1.5,
-        site='bench.device_probe')
+        site='bench.device_probe', clock=clock)
     state = policy.new_state()
     durations = []
     last_err = None
@@ -2706,11 +3129,12 @@ if __name__ == '__main__':
     # 'all' probes ONCE in the parent (12 children each paying the
     # timeout against a dead tunnel would burn ~36 min saying the
     # same thing); other modes probe in-process. 'fleet',
-    # 'serve_chaos', 'serve_spot' and 'serve_disagg' never touch a
-    # device (pure control plane / CPU replica subprocesses), so a
-    # dead TPU tunnel must not kill their rounds.
+    # 'serve_chaos', 'serve_spot', 'serve_disagg' and
+    # 'serve_affinity' never touch a device (pure control plane /
+    # CPU replica subprocesses), so a dead TPU tunnel must not kill
+    # their rounds.
     if mode not in ('fleet', 'serve_chaos', 'serve_spot',
-                    'serve_disagg'):
+                    'serve_disagg', 'serve_affinity'):
         _device_watchdog(float(os.environ.get(
             'BENCH_DEVICE_TIMEOUT',
             '60' if os.environ.get('BENCH_SMOKE') == '1' else '180')))
@@ -2722,6 +3146,8 @@ if __name__ == '__main__':
         sys.exit(serve_spot_bench())
     if mode == 'serve_disagg':
         sys.exit(serve_disagg_bench())
+    if mode == 'serve_affinity':
+        sys.exit(serve_affinity_bench())
     if mode == 'decode':
         sys.exit(decode_bench())
     if mode == 'serve':

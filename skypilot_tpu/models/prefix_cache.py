@@ -61,7 +61,6 @@ replicated on mesh engines — a debugging escape hatch).
 from __future__ import annotations
 
 import functools
-import hashlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -72,6 +71,7 @@ from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu.models import inference
+from skypilot_tpu.utils import chain_hash
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import log as sky_logging
 
@@ -114,27 +114,23 @@ _M_IMPORTED = metrics_lib.counter(
     'docs/disaggregation.md).')
 
 
-def page_hashes(tokens: Sequence[int], page: int) -> List[bytes]:
-    """Chain hash per FULL page of ``tokens``: digest i commits to
-    tokens[0 : (i+1)*page], so equal hashes mean equal whole
-    prefixes — a lookup can never alias two prompts that share a
-    block but diverge earlier."""
-    out: List[bytes] = []
-    prev = b''
-    n_full = len(tokens) // page
-    if not n_full:
-        return out
-    # One fixed-width int32 buffer for the whole hashable region:
-    # ~10x cheaper than per-token str() encoding on the driver's hot
-    # admission path (host-side only — never inside a jit).
-    buf = np.asarray(tokens[:n_full * page], np.int32).tobytes()
-    stride = 4 * page
-    for i in range(n_full):
-        d = hashlib.blake2b(prev, digest_size=16)
-        d.update(buf[i * stride:(i + 1) * stride])
-        prev = d.digest()
-        out.append(prev)
-    return out
+# Chain hashing is shared with the serve LB's PrefixAffinityPolicy —
+# the one definition lives in utils/chain_hash.py so the two sides
+# can never diverge. Re-exported here under its historical name.
+page_hashes = chain_hash.page_hashes
+
+# Schema version of the /health prefix digest (prefix_summary);
+# shared with the LB via chain_hash so both sides compare one value.
+SUMMARY_SCHEMA_VERSION = chain_hash.SUMMARY_SCHEMA_VERSION
+
+
+def summary_pages() -> int:
+    """Bound on the hash list a /health digest advertises
+    (SKYTPU_AFFINITY_SUMMARY_PAGES). 32 hex chars per page: the
+    default 128 is ~4 KB of probe-cadence JSON for full directory
+    visibility on every test/bench pool size used here."""
+    return max(0, int(env_registry.get(
+        env_registry.SKYTPU_AFFINITY_SUMMARY_PAGES, '128')))
 
 
 class PrefixCache:
@@ -221,6 +217,9 @@ class PrefixCache:
         # a pure function of (tokens, version), which is what lets
         # the engine memoize its per-tick _fits lookup.
         self.version = 0
+        # prefix_summary memo: (version, bound, dict). Invalidated by
+        # comparison, never cleared — safe to read from HTTP threads.
+        self._summary_cache: Optional[Tuple[int, int, Dict]] = None
         _M_POOL.touch()
 
         n_layers = cfg.n_layers
@@ -586,20 +585,39 @@ class PrefixCache:
             _M_POOL.set(len(self._by_hash))
         return imported
 
-    def prefix_summary(self, sample: int = 8) -> Dict[str, Any]:
-        """Cheap directory summary for /health (docs/disaggregation.
-        md): occupied-page count, page size and a most-recently-
-        touched hash sample — the surface cache-aware routing
-        scrapes. Pure host read; no device work."""
+    def prefix_summary(self,
+                       sample: Optional[int] = None) -> Dict[str, Any]:
+        """Versioned directory digest for /health (docs/
+        affinity_routing.md): occupied-page count, page size, the
+        directory ``version``, and a recency-ordered bounded hash
+        list with an explicit ``truncated`` flag — so the LB can
+        tell "no match" (hash absent, not truncated) from "sample
+        too small" (truncated: absence proves nothing). Memoized on
+        the directory version: probes between pool mutations reuse
+        the same dict with zero re-serialization. Pure host read; no
+        device work."""
+        if sample is None:
+            sample = summary_pages()
+        sample = max(0, int(sample))
+        version = self.version
+        cached = self._summary_cache
+        if (cached is not None and cached[0] == version
+                and cached[1] == sample):
+            return cached[2]
         occupied = [(self._stamp[i], h)
                     for i, h in enumerate(self._hash_of)
                     if h is not None]
         occupied.sort(reverse=True)
-        return {
+        summary = {
+            'v': SUMMARY_SCHEMA_VERSION,
+            'version': version,
             'pages': len(self._by_hash),
             'page': self.page,
-            'sample': [h.hex() for _, h in occupied[:max(0, sample)]],
+            'hashes': [h.hex() for _, h in occupied[:sample]],
+            'truncated': len(occupied) > sample,
         }
+        self._summary_cache = (version, sample, summary)
+        return summary
 
     # ------------------------------------------------------ plumbing
     def warm(self, cache: Dict) -> Dict:

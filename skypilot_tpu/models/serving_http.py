@@ -1022,6 +1022,66 @@ class EngineServer:
             body=payload,
             headers={'Content-Type': 'application/octet-stream'})
 
+    async def handle_kv_warm(self, request: web.Request
+                             ) -> web.Response:
+        """POST /kv/warm: peer cache warming
+        (docs/affinity_routing.md). Body ``{'donor': url, 'hashes':
+        [hex, ...]}`` — pull the named pages from the donor replica
+        over /kv/fetch and queue them for import at the next tick
+        boundary (the same ``queue_kv_import`` path a disagg handoff
+        uses, so the already-warmed jit programs serve the copies
+        with zero recompiles). Answers the fetched-page count; a
+        donor failure answers ``imported: 0`` with the error named —
+        a 200 either way, so a dead donor degrades the caller to a
+        cold start instead of an error that could block readiness."""
+        from skypilot_tpu.serve import kv_transfer
+        if self._dead is not None:
+            return web.json_response(
+                {'error': f'engine dead: {self._dead}'}, status=503)
+        if not self._ready.is_set():
+            return web.json_response({'status': 'warming'},
+                                     status=503)
+        prefix = getattr(self.engine, 'prefix', None)
+        if prefix is None:
+            return web.json_response(
+                {'error': 'no prefix cache on this replica'},
+                status=503)
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError('body must be a JSON object')
+            donor = body.get('donor')
+            if not isinstance(donor, str) or not donor:
+                raise ValueError("'donor' must be a replica URL")
+            hashes = body.get('hashes')
+            if (not isinstance(hashes, list) or
+                    not all(isinstance(h, str) for h in hashes)):
+                raise ValueError(
+                    "'hashes' must be a list of hex chain hashes")
+            want = [bytes.fromhex(h) for h in hashes]
+        except (ValueError, UnicodeDecodeError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        # skytpu-lint: disable=STL004 — read-only membership probe;
+        # pylint: disable=protected-access — same-package peek, the
+        # same discipline _import_remote_kv uses.
+        need = [h for h in want if h not in prefix._by_hash]
+        fetched = []
+        if need:
+            try:
+                fetched = await asyncio.to_thread(
+                    kv_transfer.fetch, donor, need,
+                    expect_sig=prefix.page_signature())
+            except kv_transfer.KVFetchError as e:
+                logger.warning(
+                    'Peer-warm fetch from donor %s failed (%s): '
+                    'starting cold.', donor, e)
+                return web.json_response(
+                    {'imported': 0, 'error': str(e)})
+            if fetched:
+                self.engine.queue_kv_import(fetched)
+        return web.json_response({'imported': len(fetched),
+                                  'already': len(want) - len(need)})
+
     async def handle_health(self, request: web.Request) -> web.Response:
         if self._dead is not None:
             return web.json_response(
@@ -1059,10 +1119,11 @@ class EngineServer:
         mesh_info = getattr(self.engine, 'mesh_info', None)
         if mesh_info is not None:
             body['mesh'] = mesh_info()
-        # Cheap prefix summary (pool occupancy + a recency-ordered
-        # hash sample): the disagg router and humans curling a
-        # replica see cache heat without a /metrics parse
-        # (docs/disaggregation.md).
+        # Versioned prefix digest (pool occupancy + a recency-ordered
+        # bounded hash list): the LB's cache-aware routing scores
+        # replicas from exactly this surface on the probe cadence
+        # (docs/affinity_routing.md), and humans curling a replica
+        # see cache heat without a /metrics parse.
         prefix = getattr(self.engine, 'prefix', None)
         if prefix is not None:
             body['prefix'] = prefix.prefix_summary()
@@ -1089,6 +1150,7 @@ class EngineServer:
         app.router.add_post('/preempt_notice',
                             self.handle_preempt_notice)
         app.router.add_post('/kv/fetch', self.handle_kv_fetch)
+        app.router.add_post('/kv/warm', self.handle_kv_warm)
         app.router.add_get('/health', self.handle_health)
         app.router.add_get('/metrics', self.handle_metrics)
         return app

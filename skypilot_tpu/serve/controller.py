@@ -203,6 +203,12 @@ class ServeController:
                     urls,
                     spot_urls=[r['url'] for r in ready
                                if r['is_spot']])
+                # Prefix digests ride the same probe cadence
+                # (docs/affinity_routing.md): the cache-aware policy
+                # scores replicas from what the probes ALREADY
+                # fetched — the LB never makes its own HTTP call.
+                self.load_balancer.update_prefix_summaries(
+                    self.replica_manager.prefix_digests())
                 serve_state.set_service_status(
                     self.name, ServiceStatus.READY
                     if urls else ServiceStatus.REPLICA_INIT)

@@ -42,9 +42,11 @@ client's SSE stream — no duplicated, no dropped tokens).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import itertools
 import json
 import threading
+import time
 import types
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set
 
@@ -54,6 +56,7 @@ from aiohttp import web
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.serve import failover
+from skypilot_tpu.utils import chain_hash
 from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
@@ -164,6 +167,32 @@ _M_RESUME_KV = metrics_lib.counter(
     'because its resume target fetched the KV pages from the '
     "dying/doomed replica's cache (the X-KV-Reused-Tokens response "
     'header summed over resume attempts; docs/disaggregation.md).')
+# Cache-aware routing (docs/affinity_routing.md). Hit, miss and
+# override partition every prefix-scored pick (a pick whose request
+# body carried tokens, under the prefix_affinity policy with
+# SKYTPU_AFFINITY on): exactly one of the three increments per pick.
+_M_AFFINITY_HITS = metrics_lib.counter(
+    'skytpu_lb_affinity_hits_total',
+    'Prefix-scored picks routed to a replica advertising a matching '
+    'cached prefix (>=1 chain-hashed page) in its /health digest — '
+    'the request lands where its prefill is already paid for.')
+_M_AFFINITY_MISSES = metrics_lib.counter(
+    'skytpu_lb_affinity_misses_total',
+    'Prefix-scored picks where no usable replica advertised a match: '
+    'routed by consistent hashing on the first prompt block (so the '
+    'NEXT request with this prefix hits) or by least-load when the '
+    'prompt has no full page / no digest is fresh.')
+_M_AFFINITY_OVERRIDES = metrics_lib.counter(
+    'skytpu_lb_affinity_overrides_total',
+    'Prefix-scored picks whose affinity/consistent-hash target was '
+    'rejected by the inflight imbalance guard '
+    '(SKYTPU_AFFINITY_MAX_SKEW) and routed least-load instead — '
+    'affinity never creates a hotspot deeper than the guard bound.')
+_M_AFFINITY_TOKENS = metrics_lib.counter(
+    'skytpu_lb_affinity_matched_tokens_total',
+    'Prompt tokens covered by the matched prefix on affinity hits '
+    '(matched pages x page size): rate() is the fleet prefill '
+    'compute cache-aware routing steers onto already-warm caches.')
 
 
 class LoadBalancingPolicy:
@@ -206,7 +235,11 @@ class LoadBalancingPolicy:
     def _on_set_urls(self, urls: List[str]) -> None:
         pass
 
-    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
+    def pick(self, exclude: Optional[Set[str]] = None,
+             tokens: Optional[Sequence[int]] = None) -> Optional[str]:
+        """``tokens`` is the parsed prompt when the caller has one
+        (the SSE /generate path): cache-aware policies score it;
+        base policies ignore it."""
         raise NotImplementedError
 
     def done(self, url: str) -> None:
@@ -230,7 +263,9 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         if urls != self._urls:
             self._it = itertools.cycle(urls)
 
-    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
+    def pick(self, exclude: Optional[Set[str]] = None,
+             tokens: Optional[Sequence[int]] = None) -> Optional[str]:
+        del tokens
         if not self._urls:
             return None
         for _ in range(len(self._urls)):
@@ -252,26 +287,244 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         super().__init__()
         self._lock = threading.Lock()
 
-    def pick(self, exclude: Optional[Set[str]] = None) -> Optional[str]:
+    def pick(self, exclude: Optional[Set[str]] = None,
+             tokens: Optional[Sequence[int]] = None) -> Optional[str]:
+        del tokens
         with self._lock:
             candidates = [u for u in self._urls
                           if not exclude or u not in exclude]
             if not candidates:
                 return None
-            # Load first; on ties prefer on-demand over spot
-            # (docs/spot_serving.md): the spot replica may get a
-            # preemption notice any moment, and a stream started on
-            # an on-demand survivor never needs migrating.
-            url = min(candidates,
-                      key=lambda u: (_M_INFLIGHT.value(replica=u),
-                                     u in self._spot))
-            _M_INFLIGHT.inc(1, replica=url)
-            return url
+            return self._pick_least_load_locked(candidates)
+
+    def _pick_least_load_locked(self, candidates: List[str]) -> str:
+        """The one least-load selection rule (callers hold _lock).
+        Load first; on ties prefer on-demand over spot
+        (docs/spot_serving.md): the spot replica may get a preemption
+        notice any moment, and a stream started on an on-demand
+        survivor never needs migrating. PrefixAffinityPolicy's
+        fallback arm calls exactly this, so affinity-off/fallback
+        routing is the tie-break-for-tie-break same as least_load."""
+        url = min(candidates,
+                  key=lambda u: (_M_INFLIGHT.value(replica=u),
+                                 u in self._spot))
+        _M_INFLIGHT.inc(1, replica=url)
+        return url
+
+
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Cache-aware routing (docs/affinity_routing.md): route to the
+    replica already holding the longest cached prefix of the prompt.
+
+    The policy keeps a TTL'd cache of per-replica /health prefix
+    digests, pushed in on the replica manager's probe cadence
+    (``update_summaries`` — never a per-request HTTP call) with a
+    version-gated delta path: a digest whose directory ``version``
+    is unchanged refreshes its staleness stamp without re-parsing
+    the hash list. A pick with tokens chain-hashes the prompt's full
+    pages (utils/chain_hash.py — the SAME bytes the engine's prefix
+    pool keys on) and scores every candidate by longest matching
+    advertised prefix:
+
+    - best match > 0 pages -> affinity target (ties broken least-
+      load, then on-demand-over-spot — the PR 16 tie-break);
+    - no match but a fresh digest told us the page size ->
+      consistent (rendezvous) hashing on the first prompt block, so
+      a cold prefix lands on ONE deterministic replica and the next
+      request with it hits;
+    - no full page / no fresh digest / SKYTPU_AFFINITY=0 /
+      tokens-less pick (opaque proxy, hedge) -> exactly
+      least_load's selection.
+
+    Any affinity or rendezvous target must pass the inflight
+    imbalance guard: if its post-pick in-flight count would exceed
+    ``max(SKYTPU_AFFINITY_MAX_SKEW * fleet_mean, SKYTPU_AFFINITY_-
+    MAX_SKEW)`` the pick is overridden to least-load — affinity can
+    never create a hotspot deeper than the guard bound. Exclusions
+    (draining, preempting, breaker-open, prefill-role) are applied
+    by the caller BEFORE scoring, so a doomed replica is never
+    picked no matter how long a prefix it advertises."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        # url -> parsed digest: hashes (frozenset of hex), version,
+        # pages, page, truncated, stamp (monotonic receipt time).
+        self._summaries: Dict[str, Dict[str, Any]] = {}
+        # Attrs of the latest scored pick, for the caller's
+        # lb.affinity span (take_last_decision pops it).
+        self._last_decision: Optional[Dict[str, Any]] = None
+
+    # ----------------------------------------------------- knobs
+    @staticmethod
+    def _affinity_enabled() -> bool:
+        return env_registry.get(env_registry.SKYTPU_AFFINITY,
+                                '1') != '0'
+
+    @staticmethod
+    def _ttl_s() -> float:
+        return float(env_registry.get(
+            env_registry.SKYTPU_AFFINITY_TTL_S, '60'))
+
+    @staticmethod
+    def _max_skew() -> float:
+        return max(1.0, float(env_registry.get(
+            env_registry.SKYTPU_AFFINITY_MAX_SKEW, '2.0')))
+
+    # ------------------------------------------- digest ingestion
+    def update_summaries(
+            self, summaries: Dict[str, Optional[Dict[str, Any]]]
+    ) -> None:
+        """Ingest per-replica /health prefix digests (probe cadence;
+        any thread). A malformed/alien-schema digest is ignored; a
+        replica absent from ``summaries`` keeps its previous digest
+        until the TTL retires it (one missed probe must not blind
+        affinity for a whole cycle)."""
+        now = time.monotonic()
+        with self._lock:
+            for url, digest in summaries.items():
+                if not isinstance(digest, dict):
+                    continue
+                if digest.get('v') != chain_hash.SUMMARY_SCHEMA_VERSION:
+                    continue
+                prev = self._summaries.get(url)
+                if (prev is not None
+                        and prev['version'] == digest.get('version')):
+                    # Delta path: unchanged directory version means
+                    # the hash list is byte-identical — refresh the
+                    # staleness stamp only.
+                    prev['stamp'] = now
+                    continue
+                try:
+                    parsed = {
+                        'hashes': frozenset(digest.get('hashes') or ()),
+                        'version': digest.get('version'),
+                        'pages': int(digest.get('pages', 0)),
+                        'page': int(digest.get('page', 0)),
+                        'truncated': bool(digest.get('truncated')),
+                        'stamp': now,
+                    }
+                except (TypeError, ValueError):
+                    continue
+                if parsed['page'] < 1:
+                    continue
+                self._summaries[url] = parsed
+
+    def _on_set_urls(self, urls: List[str]) -> None:
+        with self._lock:
+            for gone in set(self._summaries) - set(urls):
+                self._summaries.pop(gone)
+
+    def take_last_decision(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            d, self._last_decision = self._last_decision, None
+            return d
+
+    # ------------------------------------------------------ pick
+    def pick(self, exclude: Optional[Set[str]] = None,
+             tokens: Optional[Sequence[int]] = None) -> Optional[str]:
+        with self._lock:
+            candidates = [u for u in self._urls
+                          if not exclude or u not in exclude]
+            if not candidates:
+                return None
+            if tokens is None or not self._affinity_enabled():
+                # Tokens-less (opaque proxy, hedge) or disabled:
+                # exactly least_load, no affinity accounting.
+                return self._pick_least_load_locked(candidates)
+            return self._pick_scored_locked(candidates, tokens)
+
+    def _pick_scored_locked(self, candidates: List[str],
+                            tokens: Sequence[int]) -> str:
+        now = time.monotonic()
+        ttl = self._ttl_s()
+        fresh = {u: s for u, s in self._summaries.items()
+                 if u in set(candidates) and now - s['stamp'] <= ttl}
+        # Chain hashes are page-size dependent; replicas advertise
+        # their page in the digest, so a (never-expected) mixed-page
+        # fleet still scores correctly — each page size hashes once.
+        hashes_by_page: Dict[int, List[str]] = {}
+
+        def _hashes(page: int) -> List[str]:
+            if page not in hashes_by_page:
+                hashes_by_page[page] = [
+                    h.hex()
+                    for h in chain_hash.page_hashes(tokens, page)]
+            return hashes_by_page[page]
+
+        scored: List[Any] = []
+        for u in candidates:
+            s = fresh.get(u)
+            if s is None:
+                continue
+            n = chain_hash.match_len(_hashes(s['page']), s['hashes'])
+            if n > 0:
+                scored.append((u, n, n * s['page']))
+        target = None
+        mode = 'miss'
+        matched_pages = 0
+        matched_tokens = 0
+        if scored:
+            best = max(n for _, n, _ in scored)
+            ties = [(u, t) for u, n, t in scored if n == best]
+            target = min(
+                ties,
+                key=lambda ut: (_M_INFLIGHT.value(replica=ut[0]),
+                                ut[0] in self._spot))[0]
+            mode = 'hit'
+            matched_pages = best
+            matched_tokens = dict(ties)[target]
+        elif fresh:
+            # Cold prefix with live digests: consistent (rendezvous)
+            # hash on the first prompt block, so equal prefixes stop
+            # scattering. Keyed on the chain hash at the smallest
+            # advertised page size (deterministic across LBs); a
+            # prompt under one full page has nothing cacheable —
+            # least-load is simply correct.
+            page = min(s['page'] for s in fresh.values())
+            first = _hashes(page)
+            if first:
+                key = bytes.fromhex(first[0])
+                target = max(
+                    fresh,
+                    key=lambda u: hashlib.blake2b(
+                        key + u.encode(), digest_size=8).digest())
+                mode = 'rendezvous'
+        overridden = False
+        if target is not None:
+            # Imbalance guard: mean is post-pick (this request
+            # included), so one request on an idle fleet never
+            # trips it.
+            loads = {u: _M_INFLIGHT.value(replica=u)
+                     for u in candidates}
+            mean_after = (sum(loads.values()) + 1.0) / len(candidates)
+            skew = self._max_skew()
+            if loads[target] + 1.0 > max(skew * mean_after, skew):
+                overridden = True
+                target = None
+        if target is not None:
+            _M_INFLIGHT.inc(1, replica=target)
+        else:
+            target = self._pick_least_load_locked(candidates)
+        if overridden:
+            _M_AFFINITY_OVERRIDES.inc()
+        elif mode == 'hit':
+            _M_AFFINITY_HITS.inc()
+            _M_AFFINITY_TOKENS.inc(matched_tokens)
+        else:
+            _M_AFFINITY_MISSES.inc()
+        self._last_decision = {
+            'replica': target,
+            'mode': 'override' if overridden else mode,
+            'matched_pages': matched_pages,
+            'matched_tokens': matched_tokens,
+        }
+        return target
 
 
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_load': LeastLoadPolicy,
+    'prefix_affinity': PrefixAffinityPolicy,
 }
 
 
@@ -413,7 +666,8 @@ class LoadBalancer:
     def _blocked_urls(self) -> Set[str]:
         return {u for u, b in self._breakers.items() if b.blocked()}
 
-    def _pick(self, exclude: Set[str]) -> Optional[str]:
+    def _pick(self, exclude: Set[str],
+              tokens: Optional[Sequence[int]] = None) -> Optional[str]:
         """Breaker-aware pick: open breakers are excluded; picking a
         cooled-down open breaker consumes its single half-open trial.
         Synchronous end to end, so two interleaved requests can never
@@ -422,13 +676,39 @@ class LoadBalancer:
         opaque retry, SSE attempt, hedge, resume target — avoids a
         replica whose kill is seconds away; prefill-role replicas
         (docs/disaggregation.md) likewise, so decode traffic never
-        lands on them."""
+        lands on them. ``tokens`` (the parsed prompt, SSE path only)
+        lets a cache-aware policy score the pick
+        (docs/affinity_routing.md); the exclusions above are applied
+        BEFORE scoring, so a breaker-open or preempting replica is
+        never picked no matter what prefix it advertises."""
         url = self.policy.pick(exclude=exclude | self._blocked_urls()
                                | self._preempting
-                               | self._prefill_urls)
+                               | self._prefill_urls,
+                               tokens=tokens)
         if url is not None:
             self._breaker(url).acquire()
+            take = getattr(self.policy, 'take_last_decision', None)
+            if take is not None:
+                decision = take()
+                if decision is not None:
+                    # Zero-duration marker span: the routing decision
+                    # and its evidence, under the request's lb.request
+                    # span (docs/tracing.md).
+                    with trace_lib.span('lb.affinity', **decision):
+                        pass
         return url
+
+    def update_prefix_summaries(
+            self, summaries: Dict[str, Optional[Dict[str, Any]]]
+    ) -> None:
+        """Push per-replica /health prefix digests into a cache-aware
+        policy (docs/affinity_routing.md). Called by the controller on
+        the replica manager's probe cadence — the LB itself NEVER
+        makes an HTTP call to score a request. No-op for policies
+        without affinity."""
+        update = getattr(self.policy, 'update_summaries', None)
+        if update is not None:
+            update(summaries)
 
     def _pick_prefill(self) -> Optional[str]:
         """Least-loaded pick WITHIN the prefill pool
@@ -1368,7 +1648,8 @@ class _SSEGenerateDriver:
             exclude = (self.dead_urls if self.client is not None
                        else self.tried)
             url = self.lb._pick(  # pylint: disable=protected-access
-                exclude=exclude | self.lb._draining)  # pylint: disable=protected-access
+                exclude=exclude | self.lb._draining,  # pylint: disable=protected-access
+                tokens=self.tokens)
             if url is None:
                 break
             self.tried.add(url)

@@ -23,6 +23,8 @@ from skypilot_tpu.backend import backend_utils
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.utils import chain_hash
+from skypilot_tpu.utils import env_registry
 from skypilot_tpu.utils import statedb
 from skypilot_tpu.utils import fault_injection
 from skypilot_tpu.utils import lifecycle
@@ -82,6 +84,51 @@ _M_RECONCILED = metrics_lib.counter(
     'startup, by outcome (adopt / roll_forward / roll_back / orphan).',
     labels=('action',))
 
+# Peer cache warming (docs/affinity_routing.md): pages a newly
+# provisioned replica (scale-up or spot replacement) pre-fetched from
+# a warm donor's prefix pool before being marked READY.
+_M_WARMED = metrics_lib.counter(
+    'skytpu_serve_warmed_pages_total',
+    'Prefix-cache pages pre-fetched into a new replica from a warm '
+    'donor before the replica was marked READY (bounded by '
+    'SKYTPU_WARM_MAX_PAGES; failures degrade to a cold start).')
+
+
+def peer_warm(url: str, donor_url: str, hashes_hex: List[str],
+              timeout_s: Optional[float] = None) -> int:
+    """Tell the replica at ``url`` to pull the donor's pages: one
+    POST /kv/warm carrying the donor URL and its hottest chain
+    hashes (the recency-ordered /health digest list, already bounded
+    by the caller's warm budget). Returns pages fetched; ANY failure
+    returns 0 — warming is strictly best-effort and the caller marks
+    the replica READY either way (docs/affinity_routing.md). Shared
+    by the replica manager and the serve_affinity bench so both warm
+    through the same wire path."""
+    if not hashes_hex:
+        return 0
+    if timeout_s is None:
+        timeout_s = float(env_registry.get(
+            env_registry.SKYTPU_WARM_TIMEOUT_S, '15'))
+    try:
+        resp = requests.post(
+            url.rstrip('/') + '/kv/warm',
+            json={'donor': donor_url, 'hashes': list(hashes_hex)},
+            timeout=(min(_PROBE_CONNECT_TIMEOUT_SECONDS, timeout_s),
+                     timeout_s))
+        if resp.status_code != 200:
+            logger.info('Peer warm of %s from %s answered %d: '
+                        'starting cold.', url, donor_url,
+                        resp.status_code)
+            return 0
+        imported = int((resp.json() or {}).get('imported', 0))
+    except (requests.RequestException, ValueError, TypeError):
+        logger.info('Peer warm of %s from %s failed: starting cold.',
+                    url, donor_url)
+        return 0
+    if imported > 0:
+        _M_WARMED.inc(imported)
+    return imported
+
 # Replica-cluster teardown goes through the shared RetryPolicy: cloud
 # teardown calls are flaky exactly when the cloud is having the bad
 # day that killed the replica. ClusterDoesNotExist is success.
@@ -127,6 +174,12 @@ class ReplicaManager:
         self.on_preempt_notice: Optional[Callable[[str], None]] = None
         self._lock = threading.Lock()
         self._failed_probes: Dict[int, int] = {}
+        # Latest parsed /health body per replica URL, stashed by
+        # successful readiness probes: the prefix digests the
+        # controller forwards to the LB's cache-aware policy on the
+        # probe cadence, and the donor directory peer warming picks
+        # from (docs/affinity_routing.md).
+        self._probe_health: Dict[str, dict] = {}
         # Replica ids whose probe already answered 'preempting': the
         # notice metric/estimator event fires once per replica, and
         # the later PREEMPTED transition knows it was already counted.
@@ -620,10 +673,85 @@ class ReplicaManager:
                     pass
                 _M_PROBE_FAILURES.inc(1, replica=url)
                 return 'down'
+            try:
+                body = resp.json()
+            except ValueError:
+                body = None
+            if isinstance(body, dict):
+                self._note_health(url, body)
             return 'ready'
         except requests.RequestException:
             _M_PROBE_FAILURES.inc(1, replica=url)
             return 'down'
+
+    def _note_health(self, url: str, body: dict) -> None:
+        """Stash a ready probe's parsed /health body — the prefix
+        digest source for affinity routing and peer warming. Guarded
+        so a bare ``__new__``-built manager (unit-test idiom) can
+        still run _probe_ready."""
+        store = getattr(self, '_probe_health', None)
+        if store is None:
+            return
+        with self._lock:
+            store[url] = body
+
+    def prefix_digests(self) -> Dict[str, Optional[dict]]:
+        """Latest advertised /health prefix digest per replica URL
+        (None for replicas without a prefix cache). The controller
+        pushes this to the LB's cache-aware policy every probe cycle
+        — probe cadence, never per-request HTTP
+        (docs/affinity_routing.md)."""
+        with self._lock:
+            return {u: (b or {}).get('prefix')
+                    for u, b in self._probe_health.items()}
+
+    def _maybe_peer_warm(self, replica_id: int, url: str) -> None:
+        """Peer cache warming at the STARTING->READY edge
+        (docs/affinity_routing.md): before a newly provisioned
+        replica becomes routable, pick the warmest READY donor from
+        the stashed /health digests and have the new replica pull
+        the donor's hottest pages (its recency-ordered digest list,
+        truncated to the SKYTPU_WARM_MAX_PAGES budget) through
+        /kv/warm -> /kv/fetch -> queue_kv_import. Strictly bounded
+        and best-effort: any failure, a digest-less fleet, or an
+        exhausted SKYTPU_WARM_TIMEOUT_S leaves the replica to start
+        cold — readiness is delayed by at most the timeout, never
+        blocked."""
+        budget = max(0, int(env_registry.get(
+            env_registry.SKYTPU_WARM_MAX_PAGES, '64')))
+        if budget <= 0:
+            return
+        ready_urls = {
+            r.get('url')
+            for r in serve_state.get_replicas(self.service_name)
+            if r['status'] is ReplicaStatus.READY and r.get('url')}
+        ready_urls.discard(url)
+        with self._lock:
+            digests = {
+                u: (self._probe_health.get(u) or {}).get('prefix')
+                for u in ready_urls}
+        donor: Optional[str] = None
+        donor_hashes: List[str] = []
+        # Warmest donor = most advertised pages; sorted for a
+        # deterministic pick on ties.
+        for u, d in sorted(digests.items()):
+            if (not isinstance(d, dict) or
+                    d.get('v') != chain_hash.SUMMARY_SCHEMA_VERSION):
+                continue
+            hx = d.get('hashes') or []
+            if len(hx) > len(donor_hashes):
+                donor, donor_hashes = u, hx
+        if donor is None:
+            return
+        want = donor_hashes[:budget]
+        with trace_lib.span('serve.peer_warm', replica=url,
+                            donor=donor, requested=len(want)):
+            imported = peer_warm(url, donor, want)
+        if imported:
+            logger.info(
+                'Peer-warmed replica %d at %s with %d page(s) from '
+                'donor %s before READY.', replica_id, url, imported,
+                donor)
 
     def note_unreachable(self, url: str) -> None:
         """First-hand unreachability evidence from the data plane
@@ -695,6 +823,14 @@ class ReplicaManager:
             probe = ('down' if url is None else
                      self._probe_ready(url, spec, replica_id=rid))
             if probe == 'ready':
+                if status is ReplicaStatus.STARTING:
+                    # First ready probe of a newly provisioned
+                    # replica (scale-up or spot replacement): peer
+                    # warming happens HERE, before the READY
+                    # transition makes it routable — bounded by the
+                    # warm budget/timeout, degrading to a cold start
+                    # on any failure (docs/affinity_routing.md).
+                    self._maybe_peer_warm(rid, url)
                 with self._lock:
                     self._failed_probes[rid] = 0
                     # A notice the cloud walked back (capacity

@@ -1,0 +1,68 @@
+"""Chain hashing of prompt token blocks — the SINGLE definition.
+
+Both sides of the fleet's prefix economy key KV pages by the same
+function: the engine's prefix pool (``models/prefix_cache.py``)
+publishes pages under these digests, and the serve load balancer's
+PrefixAffinityPolicy (``serve/load_balancer.py``) recomputes them per
+request to score replicas by longest cached prefix. Factoring the
+hash here is what makes "LB and engine can never diverge" a property
+of the import graph instead of a code-review promise: there is one
+byte layout, one digest size, one chaining rule.
+
+The LB runs in the controller process, which must never pay a jax
+import for routing — this module depends on numpy + hashlib only.
+
+Digest semantics: digest ``i`` commits to ``tokens[0:(i+1)*page]``
+(hash(page_i) folds in hash(page_{i-1})), so equal hashes mean equal
+WHOLE prefixes — a lookup can never alias two prompts that share a
+block but diverge earlier. 16-byte blake2b keeps the per-page key
+small enough to ship thousands in a /health summary.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+import numpy as np
+
+DIGEST_SIZE = 16
+
+# Schema version of the /health prefix digest built over these hashes
+# (prefix_cache.prefix_summary / the LB's PrefixAffinityPolicy). Bump
+# when the digest dict's shape changes; the LB ignores digests it
+# does not understand rather than mis-scoring them.
+SUMMARY_SCHEMA_VERSION = 1
+
+
+def page_hashes(tokens: Sequence[int], page: int) -> List[bytes]:
+    """Chain hash per FULL page of ``tokens``: digest i commits to
+    tokens[0 : (i+1)*page]. Host-side only — never inside a jit."""
+    out: List[bytes] = []
+    prev = b''
+    n_full = len(tokens) // page
+    if not n_full:
+        return out
+    # One fixed-width int32 buffer for the whole hashable region:
+    # ~10x cheaper than per-token str() encoding on the driver's hot
+    # admission path (and on the LB's per-request scoring path).
+    buf = np.asarray(tokens[:n_full * page], np.int32).tobytes()
+    stride = 4 * page
+    for i in range(n_full):
+        d = hashlib.blake2b(prev, digest_size=DIGEST_SIZE)
+        d.update(buf[i * stride:(i + 1) * stride])
+        prev = d.digest()
+        out.append(prev)
+    return out
+
+
+def match_len(hashes_hex: Sequence[str], advertised: frozenset) -> int:
+    """Longest prefix (in pages) of ``hashes_hex`` present in
+    ``advertised``. Chain hashing makes a prefix scan sound: page i
+    can only be cached usefully if pages 0..i-1 match too, so stop at
+    the first miss instead of set-intersecting the whole chain."""
+    n = 0
+    for h in hashes_hex:
+        if h not in advertised:
+            break
+        n += 1
+    return n

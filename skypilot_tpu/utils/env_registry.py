@@ -727,3 +727,62 @@ BENCH_DISAGG_MIN_RATIO = register(
     'serve_disagg bench gate: minimum disagg-arm goodput over the '
     'same-seed equal-chip interleaved baseline for the round to '
     'report ok (default 0.9).')
+# ------------------------- cache-aware routing + peer cache warming
+SKYTPU_AFFINITY = register(
+    'SKYTPU_AFFINITY',
+    'Kill switch for prefix-affinity scoring inside the '
+    'prefix_affinity LB policy (docs/affinity_routing.md): 0 makes '
+    'the policy behave exactly like least_load (the bitwise-parity '
+    'baseline arm). Default on (any other value).')
+SKYTPU_AFFINITY_SUMMARY_PAGES = register(
+    'SKYTPU_AFFINITY_SUMMARY_PAGES',
+    'Bound on the recency-ordered hash list a replica\'s /health '
+    'prefix digest advertises (models/prefix_cache.py '
+    'prefix_summary). Digests past the bound set truncated=true so '
+    'the LB scores them conservatively instead of reading absence '
+    'as a miss. Default 128 (~4 KB of probe-cadence JSON).')
+SKYTPU_AFFINITY_TTL_S = register(
+    'SKYTPU_AFFINITY_TTL_S',
+    'Staleness bound in seconds on a replica\'s advertised prefix '
+    'digest (docs/affinity_routing.md): past the TTL the LB stops '
+    'scoring the replica by affinity (it still serves via the '
+    'least-load fallback) until the next probe refreshes the '
+    'digest. Default 60 (6 probe cycles).')
+SKYTPU_AFFINITY_MAX_SKEW = register(
+    'SKYTPU_AFFINITY_MAX_SKEW',
+    'Imbalance guard of the prefix_affinity policy (docs/'
+    'affinity_routing.md): an affinity pick is overridden to '
+    'least-load when the target\'s inflight gauge would exceed '
+    'max(mean_inflight * MAX_SKEW, MAX_SKEW) across ready '
+    'replicas — affinity can never create a hotspot deeper than '
+    'this factor. Default 2.0.')
+SKYTPU_WARM_MAX_PAGES = register(
+    'SKYTPU_WARM_MAX_PAGES',
+    'Peer-warming page budget (docs/affinity_routing.md): max '
+    'prefix-pool pages a newly provisioned replica pre-fetches from '
+    'its warm donor before being marked READY. 0 disables warming. '
+    'Default 64.')
+SKYTPU_WARM_TIMEOUT_S = register(
+    'SKYTPU_WARM_TIMEOUT_S',
+    'Wall-clock bound in seconds on the whole peer-warming attempt '
+    '(donor digest read + /kv/warm pull). On expiry or any error '
+    'the replica is marked READY cold — warming can delay '
+    'readiness by at most this bound, never block it. Default 15.')
+BENCH_AFFINITY_REQUESTS = register(
+    'BENCH_AFFINITY_REQUESTS',
+    'serve_affinity bench: requests in the Zipf shared-prefix trace '
+    '(default 16 under BENCH_SMOKE, 48 otherwise).')
+BENCH_AFFINITY_QPS = register(
+    'BENCH_AFFINITY_QPS',
+    'serve_affinity bench: offered load in requests/second.')
+BENCH_AFFINITY_SEED = register(
+    'BENCH_AFFINITY_SEED',
+    'serve_affinity bench: seed for the workload trace AND the '
+    'mid-trace scale-up point (same seed => same trace bytes — the '
+    'determinism receipt).')
+BENCH_AFFINITY_MIN_RATIO = register(
+    'BENCH_AFFINITY_MIN_RATIO',
+    'serve_affinity bench gate: minimum affinity-arm fleet '
+    'prefix-hit-rate AND goodput over the same-seed equal-chip '
+    'least-load arm for the round to report ok (default 1.0 — '
+    'affinity must not lose; raise to demand a margin).')

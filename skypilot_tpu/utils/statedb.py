@@ -164,7 +164,13 @@ def connect(path: str, *, row_factory: bool = True) -> sqlite3.Connection:
                            isolation_level=None)
     if row_factory:
         conn.row_factory = sqlite3.Row
-    conn.execute('PRAGMA journal_mode=WAL')
+    # The journal-mode switch needs an exclusive lock, and SQLite
+    # skips the busy handler when it suspects a deadlock — so two
+    # processes racing to convert a fresh DB to WAL can see
+    # SQLITE_BUSY despite the 10s timeout above. Retry through the
+    # standard policy instead of surfacing a spurious lock error.
+    _retry_policy('statedb.connect').call(conn.execute,
+                                          'PRAGMA journal_mode=WAL')
     conn.execute(f'PRAGMA busy_timeout={BUSY_TIMEOUT_MS}')
     conn.execute('PRAGMA synchronous=NORMAL')
     return conn

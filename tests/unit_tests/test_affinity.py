@@ -1,0 +1,675 @@
+"""Cache-aware routing + peer cache warming (docs/affinity_routing.md):
+chain-hash parity between the LB-side helper and the engine's prefix
+pool, versioned /health digest semantics (memoization, truncation,
+recency order), PrefixAffinityPolicy scoring / TTL / version-gated
+deltas / imbalance-guard override / rendezvous cold fallback /
+affinity-off bitwise parity with least-load, exclusion correctness
+(breaker-open, preempting, prefill-role), the lb.affinity span and
+metric goldens, the peer-warm round trip over two real EngineServers
+(including donor-death degradation and the no-recompile invariant),
+the replica manager's STARTING->READY warm hook, and the
+serve_affinity bench smoke with its determinism receipts.
+"""
+import asyncio
+import hashlib
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import loadgen
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu import models
+from skypilot_tpu.models import inference
+from skypilot_tpu.models import prefix_cache as prefix_mod
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve.load_balancer import (LeastLoadPolicy,
+                                              LoadBalancer,
+                                              PrefixAffinityPolicy)
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import ServiceSpec
+from skypilot_tpu.trace import core as trace_core
+from skypilot_tpu.trace import export as trace_export
+from skypilot_tpu.utils import chain_hash
+
+pytestmark = pytest.mark.affinity
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PAGE = 8
+
+
+def _counter(name):
+    return sum(v for k, v in metrics_lib.summary().items()
+               if k == name or k.startswith(name + '{'))
+
+
+def _gauge():
+    return metrics_lib.REGISTRY.get('skytpu_lb_replica_inflight')
+
+
+def _chain_hex(tokens, page=PAGE):
+    return [h.hex() for h in chain_hash.page_hashes(tokens, page)]
+
+
+def _digest(hashes_hex, version=1, page=PAGE, truncated=False):
+    return {'v': chain_hash.SUMMARY_SCHEMA_VERSION,
+            'version': version, 'pages': len(hashes_hex),
+            'page': page, 'hashes': list(hashes_hex),
+            'truncated': truncated}
+
+
+# ------------------------------------------------- chain-hash parity
+def test_chain_hash_single_source_and_match_len():
+    """utils/chain_hash.py IS the prefix pool's key function (one
+    definition, re-exported), its digests are the documented chained
+    blake2b-16 over int32 page slices, and match_len is a strict
+    longest-prefix scan (a later page without its predecessor scores
+    zero — chain keys make that impossible to hit by accident)."""
+    toks = list(range(1, 21))                 # 2 full pages + tail
+    got = chain_hash.page_hashes(toks, PAGE)
+    buf = np.asarray(toks[:16], np.int32).tobytes()
+    prev, want = b'', []
+    for i in range(2):
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(buf[i * 4 * PAGE:(i + 1) * 4 * PAGE])
+        prev = h.digest()
+        want.append(prev)
+    assert got == want
+    assert prefix_mod.page_hashes is chain_hash.page_hashes
+    assert chain_hash.page_hashes([1, 2, 3], PAGE) == []
+
+    hx = [h.hex() for h in got]
+    assert chain_hash.match_len(hx, frozenset(hx)) == 2
+    assert chain_hash.match_len(hx, frozenset(hx[:1])) == 1
+    assert chain_hash.match_len(hx, frozenset(hx[1:])) == 0
+    assert chain_hash.match_len([], frozenset(hx)) == 0
+
+
+def test_prefix_digest_versioned_memoized_truncated(monkeypatch):
+    """The /health digest (docs/affinity_routing.md): schema-
+    versioned, memoized on the pool directory version (two scrapes
+    between publishes return the SAME object — probe cadence costs
+    no re-walk), recency-ordered hottest-first, bounded by
+    SKYTPU_AFFINITY_SUMMARY_PAGES with an explicit truncated flag."""
+    cfg = models.LlamaConfig.tiny()
+    pc = prefix_mod.PrefixCache(cfg, page=PAGE, pool_pages=4)
+    d0 = pc.prefix_summary()
+    assert d0 == {'v': chain_hash.SUMMARY_SCHEMA_VERSION,
+                  'version': 0, 'pages': 0, 'page': PAGE,
+                  'hashes': [], 'truncated': False}
+
+    shp = (cfg.n_layers, 1, 64, cfg.n_kv_heads, cfg.head_dim)
+    cache = {'k': jnp.zeros(shp, cfg.compute_dtype),
+             'v': jnp.zeros(shp, cfg.compute_dtype)}
+    tok_a, tok_b = list(range(100, 108)), list(range(200, 208))
+    pc.publish(tok_a, PAGE, cache, 0)
+    d1 = pc.prefix_summary()
+    assert d1['version'] > 0 and len(d1['hashes']) == 1
+    assert pc.prefix_summary() is d1          # memoized: same object
+    pc.publish(tok_b, PAGE, cache, 0)
+    d2 = pc.prefix_summary()
+    assert d2 is not d1 and d2['version'] > d1['version']
+    assert set(d2['hashes']) == {_chain_hex(tok_a)[0],
+                                 _chain_hex(tok_b)[0]}
+    assert d2['truncated'] is False
+
+    # Bounded digest: hottest (most recently stamped) page first,
+    # truncated=True distinguishes "not advertised" from "not held".
+    d3 = pc.prefix_summary(sample=1)
+    assert d3['hashes'] == [_chain_hex(tok_b)[0]]
+    assert d3['truncated'] is True
+    monkeypatch.setenv('SKYTPU_AFFINITY_SUMMARY_PAGES', '1')
+    assert pc.prefix_summary()['truncated'] is True
+
+
+# ------------------------------------ policy scoring / TTL / deltas
+def test_affinity_scoring_ttl_and_version_gated_delta(monkeypatch):
+    p = PrefixAffinityPolicy()
+    p.set_urls(['http://a', 'http://b'])
+    toks = list(range(1, 25))                 # 3 full pages
+    ch = _chain_hex(toks)
+    p.update_summaries({'http://a': _digest(ch[:1]),
+                        'http://b': _digest(ch[:2])})
+    url = p.pick(tokens=toks)
+    assert url == 'http://b'                  # longest match wins
+    assert p.take_last_decision() == {
+        'replica': 'http://b', 'mode': 'hit',
+        'matched_pages': 2, 'matched_tokens': 16}
+    p.done(url)
+    assert _counter('skytpu_lb_affinity_hits_total') == 1
+    assert _counter('skytpu_lb_affinity_matched_tokens_total') == 16
+
+    # Version-gated delta: an unchanged directory version refreshes
+    # the staleness stamp WITHOUT re-parsing the hash list — b keeps
+    # scoring from its original hashes.
+    p.update_summaries({'http://b': _digest([], version=1)})
+    assert p.pick(tokens=toks) == 'http://b'
+    p.done('http://b')
+    # A bumped version re-parses: b now advertises nothing, so the
+    # 1-page match on a wins.
+    p.update_summaries({'http://b': _digest([], version=2)})
+    assert p.pick(tokens=toks) == 'http://a'
+    assert p.take_last_decision()['matched_pages'] == 1
+    p.done('http://a')
+    # Alien schema version: ignored, a's digest stays live.
+    p.update_summaries({'http://a': {'v': 99, 'version': 9,
+                                     'hashes': [], 'page': PAGE}})
+    assert p.pick(tokens=toks) == 'http://a'
+    p.done('http://a')
+
+    # TTL: stale digests stop scoring — the pick degrades to the
+    # miss path (least-load fallback) instead of routing on
+    # yesterday's cache map.
+    monkeypatch.setenv('SKYTPU_AFFINITY_TTL_S', '-1')
+    misses = _counter('skytpu_lb_affinity_misses_total')
+    url = p.pick(tokens=toks)
+    assert p.take_last_decision()['mode'] == 'miss'
+    p.done(url)
+    assert _counter('skytpu_lb_affinity_misses_total') == misses + 1
+
+
+def test_affinity_fallback_tie_break_prefers_ondemand(monkeypatch):
+    """Satellite (docs/spot_serving.md): the least-load on-demand-
+    over-spot tie-break survives BOTH as the affinity fallback's rule
+    (miss path) and inside hit ties — affinity never un-learns spot
+    awareness."""
+    p = PrefixAffinityPolicy()
+    p.set_urls(['a', 'b'])
+    toks = list(range(1, 17))
+    # Miss path (no digests at all): exactly least_load's tie-break.
+    p.set_spot_urls(['a'])
+    assert p.pick(tokens=toks) == 'b'
+    p.done('b')
+    p.set_spot_urls(['b'])
+    assert p.pick(tokens=toks) == 'a'
+    p.done('a')
+    # Hit ties break the same way: both advertise the full chain.
+    ch = _chain_hex(toks)
+    p.update_summaries({'a': _digest(ch), 'b': _digest(ch)})
+    p.set_spot_urls(['a'])
+    assert p.pick(tokens=toks) == 'b'
+    assert p.take_last_decision()['mode'] == 'hit'
+    p.done('b')
+    p.set_spot_urls(['b'])
+    assert p.pick(tokens=toks) == 'a'
+    p.done('a')
+
+
+def test_imbalance_guard_overrides_hot_affinity_target():
+    """A loaded affinity target past max(skew*mean, skew) is
+    overridden to least-load (counted, span mode 'override'); an
+    idle fleet's single request never trips the guard (the mean is
+    post-pick)."""
+    p = PrefixAffinityPolicy()
+    p.set_urls(['a', 'b', 'c'])
+    toks = list(range(1, 17))
+    p.update_summaries({'a': _digest(_chain_hex(toks))})
+    # Idle fleet: the guard must NOT trip on the first request.
+    assert p.pick(tokens=toks) == 'a'
+    assert p.take_last_decision()['mode'] == 'hit'
+    p.done('a')
+    # Hot target: loads (4,0,0), skew 2.0 -> cap = 2*(5/3) ~ 3.33 <
+    # 5, so the affinity pick is overridden to the least-load pick.
+    _gauge().set(4, replica='a')
+    overrides = _counter('skytpu_lb_affinity_overrides_total')
+    url = p.pick(tokens=toks)
+    assert url in ('b', 'c')
+    d = p.take_last_decision()
+    assert d['mode'] == 'override' and d['replica'] == url
+    assert d['matched_pages'] == 2            # what was given up
+    p.done(url)
+    assert (_counter('skytpu_lb_affinity_overrides_total')
+            == overrides + 1)
+    # Load drained: affinity resumes.
+    _gauge().set(0, replica='a')
+    assert p.pick(tokens=toks) == 'a'
+    p.done('a')
+
+
+def test_rendezvous_cold_prefix_deterministic():
+    """A cold prefix (no advertised match, fresh digests) lands on
+    ONE deterministic replica via rendezvous hashing on the first
+    block's chain hash — two independently built policies (two LBs)
+    agree, so the second request with that prefix hits. A prompt
+    under one full page has nothing cacheable: plain miss."""
+    urls = ['http://r1', 'http://r2', 'http://r3']
+    toks = list(range(50, 80))
+    other = _digest(_chain_hex(list(range(1, 9))))
+    picks = []
+    for _ in range(2):
+        p = PrefixAffinityPolicy()
+        p.set_urls(list(urls))
+        p.update_summaries({u: dict(other) for u in urls})
+        url = p.pick(tokens=toks)
+        d = p.take_last_decision()
+        assert d['mode'] == 'rendezvous' and d['matched_pages'] == 0
+        p.done(url)
+        picks.append(url)
+    key = chain_hash.page_hashes(toks, PAGE)[0]
+    want = max(urls, key=lambda u: hashlib.blake2b(
+        key + u.encode(), digest_size=8).digest())
+    assert picks == [want, want]
+    assert _counter('skytpu_lb_affinity_misses_total') == 2
+
+    p = PrefixAffinityPolicy()
+    p.set_urls(list(urls))
+    p.update_summaries({u: dict(other) for u in urls})
+    p.done(p.pick(tokens=[1, 2, 3]))          # < 1 page
+    assert p.take_last_decision()['mode'] == 'miss'
+
+
+def test_affinity_off_and_tokensless_match_least_load(monkeypatch):
+    """SKYTPU_AFFINITY=0 and tokens-less picks (opaque proxy, hedge)
+    are bitwise least_load: identical pick sequence on mirrored
+    state, zero affinity accounting, no decision recorded."""
+    toks = list(range(1, 25))
+
+    def script(p, names):
+        ch = _digest(_chain_hex(toks))
+        if isinstance(p, PrefixAffinityPolicy):
+            p.update_summaries({names[2]: dict(ch)})
+        seq = []
+        for tokens in (toks, toks, None, toks):
+            u = p.pick(tokens=tokens)
+            seq.append(names.index(u))
+            if len(seq) == 2:
+                p.done(u)                     # release one mid-script
+        return seq
+
+    monkeypatch.setenv('SKYTPU_AFFINITY', '0')
+    aff = PrefixAffinityPolicy()
+    aff.set_urls(['u0', 'u1', 'u2'])
+    aff.set_spot_urls(['u1'])
+    base = LeastLoadPolicy()
+    base.set_urls(['v0', 'v1', 'v2'])
+    base.set_spot_urls(['v1'])
+    assert (script(aff, ['u0', 'u1', 'u2'])
+            == script(base, ['v0', 'v1', 'v2']))
+    assert aff.take_last_decision() is None
+    for name in ('hits', 'misses', 'overrides'):
+        assert _counter(f'skytpu_lb_affinity_{name}_total') == 0
+
+    # Affinity ON but tokens-less: still exactly least_load, still
+    # no accounting.
+    monkeypatch.setenv('SKYTPU_AFFINITY', '1')
+    p = PrefixAffinityPolicy()
+    p.set_urls(['w0', 'w1'])
+    p.update_summaries({'w1': _digest(_chain_hex(toks))})
+    assert p.pick(tokens=None) == 'w0'        # least-load lexical
+    assert p.take_last_decision() is None
+    assert _counter('skytpu_lb_affinity_hits_total') == 0
+
+
+# ------------------------------------------- LB-level exclusions/span
+def test_breaker_open_preempting_and_prefill_never_affinity_picked():
+    """Satellite: exclusions compose BEFORE scoring — a breaker-open,
+    preempting, or prefill-role replica is never affinity-picked no
+    matter how long a prefix it advertises (the disagg decode pick
+    honors affinity within the decode pool)."""
+    toks = list(range(1, 17))
+    ch = _digest(_chain_hex(toks))
+    lb = LoadBalancer(port=0, policy='prefix_affinity')
+    lb.set_replica_urls(['http://x', 'http://y'])
+    lb.update_prefix_summaries({'http://x': dict(ch)})
+    assert lb._pick(exclude=set(), tokens=toks) == 'http://x'
+    lb.policy.done('http://x')
+
+    # Preempting: excluded before scoring.
+    lb._preempting.add('http://x')
+    assert lb._pick(exclude=set(), tokens=toks) == 'http://y'
+    lb.policy.done('http://y')
+    lb._preempting.clear()
+
+    # Breaker-open: same.
+    breaker = lb._breaker('http://x')
+    for _ in range(32):
+        if breaker.blocked():
+            break
+        breaker.record_failure(hard=True)
+    assert breaker.blocked()
+    assert lb._pick(exclude=set(), tokens=toks) == 'http://y'
+    lb.policy.done('http://y')
+
+    # Disagg: the prefill replica may hold the longest prefix, but
+    # decode traffic scores only the decode pool.
+    lb2 = LoadBalancer(port=0, policy='prefix_affinity')
+    lb2.set_replica_urls(['http://d1', 'http://d2', 'http://p'],
+                         prefill_urls=['http://p'])
+    half = _digest(_chain_hex(toks)[:1])
+    lb2.update_prefix_summaries({'http://p': dict(ch),
+                                 'http://d2': half})
+    assert lb2._pick(exclude=set(), tokens=toks) == 'http://d2'
+    lb2.policy.done('http://d2')
+
+
+def test_lb_affinity_span_and_metric_goldens(tmp_path, monkeypatch):
+    """Every scored pick emits ONE zero-duration lb.affinity marker
+    span whose attrs are the decision evidence (docs/tracing.md), and
+    hit/miss/override partition the scored picks exactly."""
+    monkeypatch.setenv(trace_core.TRACE_DIR_ENV,
+                       str(tmp_path / 'spool'))
+    monkeypatch.delenv(trace_core.TRACE_CONTEXT_ENV, raising=False)
+    toks = list(range(1, 25))
+    lb = LoadBalancer(port=0, policy='prefix_affinity')
+    lb.set_replica_urls(['http://x', 'http://y'])
+    lb.update_prefix_summaries(
+        {'http://x': _digest(_chain_hex(toks)[:2]),
+         'http://y': _digest([])})
+    lb.policy.done(lb._pick(exclude=set(), tokens=toks))       # hit
+    cold = list(range(500, 530))
+    lb.policy.done(lb._pick(exclude=set(), tokens=cold))  # rendezvous
+    lb.policy.done(lb._pick(exclude=set()))               # tokens-less
+
+    spans = [s for s in trace_export.read_spans(
+        str(tmp_path / 'spool')) if s['name'] == 'lb.affinity']
+    assert len(spans) == 2                    # tokens-less: no span
+    assert spans[0]['attrs'] == {
+        'replica': 'http://x', 'mode': 'hit',
+        'matched_pages': 2, 'matched_tokens': 16}
+    assert spans[1]['attrs']['mode'] == 'rendezvous'
+    assert spans[1]['attrs']['matched_pages'] == 0
+    assert _counter('skytpu_lb_affinity_hits_total') == 1
+    assert _counter('skytpu_lb_affinity_misses_total') == 1
+    assert _counter('skytpu_lb_affinity_matched_tokens_total') == 16
+
+
+# --------------------------------------- manager warm hook (unit)
+def test_manager_picks_warmest_donor_and_bounds_budget(monkeypatch):
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    mgr.service_name = 'svc'
+    mgr._lock = threading.Lock()
+    rich = _chain_hex(list(range(1, 41)))          # 5 pages
+    poor = _chain_hex(list(range(100, 117)))       # 2 pages
+    mgr._probe_health = {
+        'http://d1': {'prefix': _digest(poor)},
+        'http://d2': {'prefix': _digest(rich)},
+        'http://alien': {'prefix': {'v': 99, 'hashes': ['ff' * 16]}},
+    }
+    rows = [{'status': ReplicaStatus.READY, 'url': 'http://d1'},
+            {'status': ReplicaStatus.READY, 'url': 'http://d2'},
+            {'status': ReplicaStatus.READY, 'url': 'http://alien'},
+            {'status': ReplicaStatus.STARTING, 'url': 'http://new'}]
+    monkeypatch.setattr(replica_managers.serve_state, 'get_replicas',
+                        lambda name: rows)
+    calls = []
+    monkeypatch.setattr(
+        replica_managers, 'peer_warm',
+        lambda url, donor, want: calls.append(
+            (url, donor, list(want))) or 3)
+    monkeypatch.setenv('SKYTPU_WARM_MAX_PAGES', '3')
+    mgr._maybe_peer_warm(9, 'http://new')
+    # Warmest donor (most advertised pages, alien schema skipped),
+    # want bounded to the budget, the new replica NEVER its own donor.
+    assert calls == [('http://new', 'http://d2', rich[:3])]
+
+    monkeypatch.setenv('SKYTPU_WARM_MAX_PAGES', '0')
+    mgr._maybe_peer_warm(9, 'http://new')
+    assert len(calls) == 1                    # budget 0 disables
+    monkeypatch.setenv('SKYTPU_WARM_MAX_PAGES', '64')
+    mgr._probe_health = {}
+    mgr._maybe_peer_warm(9, 'http://new')
+    assert len(calls) == 1                    # digest-less fleet: cold
+
+
+def test_probe_all_warms_on_starting_to_ready_edge(monkeypatch):
+    """probe_all calls the warm hook exactly at the STARTING->READY
+    edge, BEFORE the READY write makes the replica routable — and
+    never again once READY."""
+    mgr = replica_managers.ReplicaManager.__new__(
+        replica_managers.ReplicaManager)
+    mgr.service_name = 'svc'
+    mgr._lock = threading.Lock()
+    mgr._failed_probes = {}
+    mgr._preempt_noticed = set()
+    mgr._probe_health = {}
+    rows = [{'replica_id': 3, 'status': ReplicaStatus.STARTING,
+             'version': 1, 'cluster_name': 'c3', 'is_spot': False}]
+    events = []
+    monkeypatch.setattr(replica_managers.serve_state, 'get_replicas',
+                        lambda name: rows)
+    monkeypatch.setattr(
+        replica_managers.serve_state, 'set_replica_status',
+        lambda name, rid, status, **kw: events.append(
+            ('status', rid, status)))
+    monkeypatch.setattr(mgr, '_version_spec',
+                        lambda version: ServiceSpec(min_replicas=1))
+    monkeypatch.setattr(mgr, '_cluster_is_up', lambda cluster: True)
+    monkeypatch.setattr(mgr, '_replica_url',
+                        lambda rid, cluster, spec: 'http://r3:9000')
+    monkeypatch.setattr(
+        mgr, '_probe_ready',
+        lambda url, spec, replica_id=None: 'ready')
+    monkeypatch.setattr(
+        mgr, '_maybe_peer_warm',
+        lambda rid, url: events.append(('warm', rid, url)))
+    mgr.probe_all()
+    assert events == [('warm', 3, 'http://r3:9000'),
+                      ('status', 3, ReplicaStatus.READY)]
+    # Already READY: probed again, never re-warmed.
+    rows[0]['status'] = ReplicaStatus.READY
+    mgr.probe_all()
+    assert [e for e in events if e[0] == 'warm'] == [
+        ('warm', 3, 'http://r3:9000')]
+
+
+# ------------------------------- peer-warm round trip (real servers)
+@pytest.fixture(scope='module')
+def tiny_model():
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompt(cfg, n, seed):
+    key = jax.random.PRNGKey(seed)
+    return [int(t) for t in np.asarray(
+        jax.random.randint(key, (n,), 0, cfg.vocab_size))]
+
+
+def _engine(params, cfg):
+    from skypilot_tpu.models.serving_engine import ServingEngine
+    return ServingEngine(params, cfg, batch_size=2, max_prompt=32,
+                         max_seq=96, decode_chunk=4, prefill_chunk=8,
+                         prefill_budget=16, page=PAGE,
+                         prefix_cache=True, prefix_pool_pages=16)
+
+
+def test_peer_warm_roundtrip_two_servers(tiny_model):
+    """The manager's warm path end to end over two real
+    EngineServers: donor publishes pages, its /health digest carries
+    the prompt's chain (wire-level chain-hash parity), peer_warm
+    pulls them through /kv/warm -> /kv/fetch -> queue_kv_import, the
+    warmed replica's first serve of the prompt HITS with bitwise
+    solo-oracle output and ZERO post-warmup recompiles; a dead donor
+    and a malformed body degrade to a cold start, never an error
+    that could block readiness."""
+    from skypilot_tpu.models.serving_http import EngineServer
+    cfg, params = tiny_model
+    eng_a, eng_b = _engine(params, cfg), _engine(params, cfg)
+    server_a, server_b = EngineServer(eng_a), EngineServer(eng_b)
+    prompt = _prompt(cfg, 20, 41)             # 2 full pages + tail
+    oracle = list(np.asarray(inference.generate(
+        params, jnp.asarray([prompt], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cfg, max_new=4)[0]))
+
+    async def wait_ready(session, url):
+        for _ in range(600):
+            try:
+                async with session.get(url + '/health') as r:
+                    if r.status == 200:
+                        return await r.json()
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(0.1)
+        raise TimeoutError(f'{url} never became ready')
+
+    async def sse(session, url, body):
+        async with session.post(url + '/generate', json=body) as resp:
+            assert resp.status == 200, await resp.text()
+            async for raw in resp.content:
+                line = raw.decode().strip()
+                if not line.startswith('data:'):
+                    continue
+                event = json.loads(line[len('data:'):])
+                if event.get('done'):
+                    return event
+        raise AssertionError('stream ended without a done event')
+
+    async def scenario():
+        runner_a = await server_a.start(0)
+        runner_b = await server_b.start(0)
+        url_a = f'http://127.0.0.1:{runner_a.addresses[0][1]}'
+        url_b = f'http://127.0.0.1:{runner_b.addresses[0][1]}'
+        out = {}
+        async with aiohttp.ClientSession() as s:
+            await wait_ready(s, url_a)
+            await wait_ready(s, url_b)
+            # Donor publishes the prompt's pages.
+            pub = await sse(s, url_a, {'tokens': prompt, 'max_new': 2,
+                                       'stream': True})
+            assert pub['status'] == 'finished'
+            async with s.get(url_a + '/health') as r:
+                out['digest'] = (await r.json())['prefix']
+
+            # The server warmed the consumer engine before reporting
+            # ready; snapshot every compile cache.
+            out['sizes'] = (
+                eng_b._decode._cache_size(),
+                eng_b._mixed._cache_size(),
+                *eng_b.prefix.compile_cache_sizes(),
+                *eng_b.prefix.import_compile_cache_size())
+
+            # Donor-death degradation FIRST (b still cold): 0 pages,
+            # no error, no metric movement.
+            dead = await asyncio.to_thread(
+                replica_managers.peer_warm, url_b,
+                'http://127.0.0.1:9', out['digest']['hashes'], 5.0)
+            assert dead == 0
+            # Malformed body: a 400, not a crash.
+            async with s.post(url_b + '/kv/warm',
+                              json={'donor': 123}) as r:
+                out['bad_status'] = r.status
+
+            # The real warm, through the real wire path.
+            pre = _counter('skytpu_serve_warmed_pages_total')
+            out['imported'] = await asyncio.to_thread(
+                replica_managers.peer_warm, url_b, url_a,
+                out['digest']['hashes'])
+            out['warmed_metric'] = (
+                _counter('skytpu_serve_warmed_pages_total') - pre)
+
+            # First serve on the warmed replica: hit + parity (the
+            # queued imports drain at this tick boundary, before
+            # admission — the zero-recompile path).
+            out['event'] = await sse(
+                s, url_b, {'tokens': prompt, 'max_new': 4,
+                           'stream': True})
+            out['b_hits'] = eng_b.prefix.hits
+            # Idempotent once drained: everything already held ->
+            # 0 new imports.
+            out['imported_again'] = await asyncio.to_thread(
+                replica_managers.peer_warm, url_b, url_a,
+                out['digest']['hashes'])
+            out['sizes_after'] = (
+                eng_b._decode._cache_size(),
+                eng_b._mixed._cache_size(),
+                *eng_b.prefix.compile_cache_sizes(),
+                *eng_b.prefix.import_compile_cache_size())
+        await runner_a.cleanup()
+        await runner_b.cleanup()
+        return out
+
+    try:
+        out = asyncio.run(scenario())
+    finally:
+        server_a.stop()
+        server_b.stop()
+
+    # Wire-level chain-hash parity: the donor's digest advertises
+    # EXACTLY the chain the LB-side helper computes for the prompt.
+    digest = out['digest']
+    assert digest['v'] == chain_hash.SUMMARY_SCHEMA_VERSION
+    assert digest['page'] == PAGE and digest['truncated'] is False
+    want_chain = _chain_hex(prompt)
+    assert chain_hash.match_len(want_chain,
+                                frozenset(digest['hashes'])) == 2
+
+    assert out['bad_status'] == 400
+    assert out['imported'] == 2 == out['warmed_metric']
+    assert out['imported_again'] == 0
+    assert out['event']['status'] == 'finished'
+    assert out['event']['tokens'] == oracle   # bitwise solo oracle
+    assert out['b_hits'] >= 1                 # served FROM the warm
+    assert out['sizes_after'] == out['sizes']  # zero recompiles
+
+
+# ----------------------------------------- bench smoke + determinism
+def test_bench_serve_affinity_smoke_deterministic():
+    """bench.py serve_affinity under BENCH_SMOKE: real replica
+    subprocesses, affinity vs least-load at equal chips, a mid-trace
+    peer-warmed scale-up. The run must report ok with every receipt
+    (hit-rate/goodput ratio, warmed-page hit on the newcomer, zero
+    parity mismatches, zero guard violations), and its trace +
+    scale-up receipts must match an independent same-seed in-process
+    rebuild — the determinism check at half the cost of a second
+    run."""
+    seed = 11
+    env = {**os.environ, 'BENCH_SMOKE': '1', 'JAX_PLATFORMS': 'cpu',
+           'BENCH_MODE': 'serve_affinity',
+           'BENCH_AFFINITY_SEED': str(seed),
+           'BENCH_AFFINITY_REQUESTS': '10',
+           # qps 5 (vs the smoke default 3) trims ~1.3s off each of
+           # the three rounds' replay span — tier-1 budget — without
+           # touching the receipts: both arms replay the same
+           # schedule, and the scale-up instant scales with the span.
+           'BENCH_AFFINITY_QPS': '5',
+           'SKYTPU_SERVE_PORT': '19481',
+           # Laxer than the real round's 1.0: a loaded CI box slows
+           # the probe cadence, which costs some (not all) hits.
+           'BENCH_AFFINITY_MIN_RATIO': '0.8'}
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, 'bench.py')],
+        env=env, cwd=_REPO_ROOT, capture_output=True, text=True,
+        timeout=540)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith('{')]
+    assert lines, proc.stdout[-2000:] + proc.stderr[-2000:]
+    result = json.loads(lines[-1])
+    d = result['detail']
+    assert proc.returncode == 0, json.dumps(result)[:2000]
+    assert d['ok'] is True
+    assert d['parity']['mismatched'] == 0
+    assert d['parity']['length_mismatches'] == 0
+    assert d['scaleup']['warm_imported'] >= 1
+    assert d['scaleup']['probe_hit_delta'] >= 1
+    assert d['skew']['violations'] == 0
+    assert d['lb_affinity_hits'] >= 1
+
+    # Determinism receipts: same seed -> byte-identical trace and
+    # scale-up instant, rebuilt independently in THIS process.
+    spec = loadgen.long_prompt(
+        seed=seed, n_requests=10, qps=5.0, vocab_size=256,
+        prompt_median=48, prompt_sigma=0.4,
+        prompt_min=32, prompt_max=96,
+        output_median=6, output_sigma=0.3,
+        output_min=4, output_max=16,
+        n_prefixes=4, prefix_len=32)
+    trace = loadgen.generate(spec)
+    span = max(r.arrival_s for r in trace)
+    assert d['trace_sha256'] == loadgen.digest(trace)
+    assert d['schedule_head_s'] == [round(r.arrival_s, 6)
+                                    for r in trace[:8]]
+    assert d['scale_at_s'] == round(
+        span * (0.4 + 0.2 * random.Random(seed + 7).random()), 4)

@@ -110,7 +110,10 @@ def test_probe_device_retries_with_bounded_attempts():
     """r05 regression: the device probe now runs under
     utils/retry.RetryPolicy and its bench_error detail carries the
     attempt count, per-attempt durations and the active trace id —
-    enough to tell a flaky tunnel from a dead one."""
+    enough to tell a flaky tunnel from a dead one. FakeClock: the
+    exponential backoffs (2s + 4s here) advance virtual time instead
+    of sleeping tier-1 wall clock."""
+    from skypilot_tpu.utils import retry as retry_lib
     bench = _load_bench_module()
     calls = []
 
@@ -118,7 +121,8 @@ def test_probe_device_retries_with_bounded_attempts():
         calls.append(timeout_s)
         return False, None
 
-    detail = bench._probe_device(9.0, 3, probe_fn=always_dead)
+    detail = bench._probe_device(9.0, 3, probe_fn=always_dead,
+                                 clock=retry_lib.FakeClock())
     assert detail is not None
     assert detail['attempts'] == 3
     assert len(calls) == 3
@@ -133,17 +137,21 @@ def test_probe_device_retries_with_bounded_attempts():
 
 
 def test_probe_device_recovers_after_transient_failure():
+    from skypilot_tpu.utils import retry as retry_lib
     bench = _load_bench_module()
     outcomes = iter([(False, None), (True, None)])
     assert bench._probe_device(
-        4.0, 2, probe_fn=lambda t: next(outcomes)) is None
+        4.0, 2, probe_fn=lambda t: next(outcomes),
+        clock=retry_lib.FakeClock()) is None
 
 
 def test_probe_device_records_exception_detail():
+    from skypilot_tpu.utils import retry as retry_lib
     bench = _load_bench_module()
     boom = RuntimeError('PJRT plugin exploded')
     detail = bench._probe_device(
-        4.0, 2, probe_fn=lambda t: (False, boom))
+        4.0, 2, probe_fn=lambda t: (False, boom),
+        clock=retry_lib.FakeClock())
     assert detail['attempts'] == 2
     assert 'PJRT plugin exploded' in detail['error']
 

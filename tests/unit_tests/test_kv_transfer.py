@@ -29,9 +29,15 @@ from skypilot_tpu.utils import fault_injection as fi
 pytestmark = pytest.mark.kvtransfer
 
 
-def _setup(seed=0, **cfg_kw):
-    cfg = models.LlamaConfig.tiny(**cfg_kw)
-    params = models.init_params(cfg, jax.random.PRNGKey(seed))
+@pytest.fixture(scope='module')
+def tiny_model():
+    """One (cfg, params) for the whole module (test-budget satellite):
+    every engine test here uses the identical seed-0 tiny config, and
+    params init is pure — sharing it drops three redundant init+jit
+    rounds without coupling the tests (each still builds its own
+    engines/pools)."""
+    cfg = models.LlamaConfig.tiny()
+    params = models.init_params(cfg, jax.random.PRNGKey(0))
     return cfg, params
 
 
@@ -84,11 +90,11 @@ def _publish_pages(eng, prompt):
 
 @pytest.mark.parametrize('kv_quant', [False, True],
                          ids=['bf16', 'int8'])
-def test_wire_roundtrip_bitwise(kv_quant):
+def test_wire_roundtrip_bitwise(kv_quant, tiny_model):
     """encode/decode is the identity on exported pages — every field
     (including the int8 scale planes) byte-for-byte — and pack_pages
     produces exactly that encoding for the hashes the pool holds."""
-    cfg, params = _setup()
+    cfg, params = tiny_model
     eng = _engine(params, cfg, kv_quant=kv_quant)
     prompt = _prompt(cfg, 20, 11)
     hashes = _publish_pages(eng, prompt)
@@ -135,7 +141,7 @@ def test_wire_roundtrip_bitwise(kv_quant):
 # ------------------------- manifest / fetch / fallback over real HTTP
 
 
-def test_manifest_fetch_import_fallback_and_chaos():
+def test_manifest_fetch_import_fallback_and_chaos(tiny_model):
     """The full disaggregated handoff against two real EngineServers:
     kv_prefill returns a page manifest (and publishes the pages),
     /kv/fetch serves them bit-exact, a decode-side generate with
@@ -145,7 +151,7 @@ def test_manifest_fetch_import_fallback_and_chaos():
     re-prefill with identical tokens."""
     from skypilot_tpu.models.serving_http import EngineServer
 
-    cfg, params = _setup()
+    cfg, params = tiny_model
     eng_a = _engine(params, cfg)
     eng_b = _engine(params, cfg)
     server_a = EngineServer(eng_a)
@@ -245,11 +251,15 @@ def test_manifest_fetch_import_fallback_and_chaos():
         server_a.stop()
         server_b.stop()
 
-    # /health advertises role + prefix summary (satellite surface the
-    # disagg router and cache-aware routing scrape).
+    # /health advertises role + the versioned prefix digest the
+    # disagg router and cache-aware LB scrape (docs/affinity_routing.md).
     assert out['health_a']['role'] == 'prefill'
-    assert out['health_a']['prefix']['page'] == 8
-    assert isinstance(out['health_a']['prefix']['sample'], list)
+    digest = out['health_a']['prefix']
+    assert digest['v'] == prefix_mod.SUMMARY_SCHEMA_VERSION
+    assert digest['page'] == 8
+    assert isinstance(digest['version'], int)
+    assert isinstance(digest['hashes'], list)
+    assert digest['truncated'] is False
 
     m = out['manifest']
     assert m['manifest'] is True and m['page'] == 8
@@ -359,12 +369,12 @@ def test_service_spec_prefill_pool_roundtrip_and_validation():
 # --------------------------------------- no-recompile with KV imports
 
 
-def test_no_recompile_after_warmup_with_imports():
+def test_no_recompile_after_warmup_with_imports(tiny_model):
     """Remote-page import rides pinned copy-in programs: after
     warmup, importing peer pages and serving a request that reuses
     them compiles ZERO new programs — and the reused stream is
     bitwise the solo oracle."""
-    cfg, params = _setup()
+    cfg, params = tiny_model
     producer = _engine(params, cfg)
     prompt = _prompt(cfg, 20, 31)
     hashes = _publish_pages(producer, prompt)

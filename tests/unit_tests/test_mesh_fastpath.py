@@ -158,13 +158,20 @@ def _prompt(cfg, n, seed):
     return [int(t) for t in rng.integers(0, cfg.vocab_size, n)]
 
 
-@pytest.mark.parametrize('tp', [1, 2, 4])
-def test_tp_engine_prefix_spec_paged_parity(tp):
-    """The acceptance gate: for tp in {1, 2, 4}, a mesh engine with
-    the prefix cache AND speculative decoding enabled, dispatching
-    the PAGED Pallas impl (interpret on CPU), serves bitwise the
-    unsharded engine's greedy tokens — with a genuinely sharded
-    cache and zero recompiles after warmup."""
+_TP_PARITY_KW = dict(batch_size=2, max_prompt=32, max_seq=128,
+                     decode_chunk=4, page=16, prefill_chunk=16,
+                     prefill_budget=32, decode_attn='paged',
+                     prefix_cache=True, spec_decode=True, spec_k=2)
+
+
+@pytest.fixture(scope='module')
+def tp_parity_oracle():
+    """The unsharded oracle arm for the tp parity gate, built ONCE
+    for the module (test-budget satellite): the plain engine, its
+    requests, and its greedy tokens are identical across the tp
+    parametrizations — only the mesh arm varies — so the three runs
+    share one interpret-mode Pallas oracle instead of paying the
+    plain engine's compile + run three times."""
     from skypilot_tpu.models.serving_engine import (Request,
                                                     ServingEngine)
     # tp=4 needs n_kv_heads % 4 == 0.
@@ -177,15 +184,24 @@ def test_tp_engine_prefix_spec_paged_parity(tp):
     shared = _prompt(cfg, 16, 99)
     reqs = [Request(i, shared + _prompt(cfg, 4 + i, i), max_new=5)
             for i in range(3)]
-    kw = dict(batch_size=2, max_prompt=32, max_seq=128,
-              decode_chunk=4, page=16, prefill_chunk=16,
-              prefill_budget=32, decode_attn='paged',
-              prefix_cache=True, spec_decode=True, spec_k=2)
-
-    plain = ServingEngine(params, cfg, **kw)
+    plain = ServingEngine(params, cfg, **_TP_PARITY_KW)
     assert plain.attn_impl == 'paged'
     want = plain.run([Request(r.request_id, list(r.tokens),
                               max_new=r.max_new) for r in reqs])
+    return cfg, params, reqs, {i: want[i].tokens for i in want}
+
+
+@pytest.mark.parametrize('tp', [1, 2, 4])
+def test_tp_engine_prefix_spec_paged_parity(tp, tp_parity_oracle):
+    """The acceptance gate: for tp in {1, 2, 4}, a mesh engine with
+    the prefix cache AND speculative decoding enabled, dispatching
+    the PAGED Pallas impl (interpret on CPU), serves bitwise the
+    unsharded engine's greedy tokens — with a genuinely sharded
+    cache and zero recompiles after warmup."""
+    from skypilot_tpu.models.serving_engine import (Request,
+                                                    ServingEngine)
+    cfg, params, reqs, want = tp_parity_oracle
+    kw = _TP_PARITY_KW
 
     eng = ServingEngine(params, cfg, mesh=_mesh(tp), **kw)
     assert eng.attn_impl == 'paged'
@@ -207,8 +223,8 @@ def test_tp_engine_prefix_spec_paged_parity(tp):
                       eng._spec._cache_size(),
                       eng.prefix.compile_cache_sizes())
     for i in want:
-        assert got[i].tokens == want[i].tokens, (
-            tp, i, got[i].tokens, want[i].tokens)
+        assert got[i].tokens == want[i], (
+            tp, i, got[i].tokens, want[i])
     assert eng.prefix.hits > 0               # prefix reuse really ran
 
 
